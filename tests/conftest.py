@@ -1,0 +1,78 @@
+"""Test configuration.
+
+Tests run on CPU with 8 virtual XLA devices so multi-chip sharding is
+exercised without TPU hardware — the capability the reference lacks entirely
+(it cannot test its 2-node MPI path without two real nodes; SURVEY.md §4).
+Must run before the first jax import in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+from tpu_bfs.graph import io as gio
+from tpu_bfs.graph.generate import random_graph, rmat_graph
+
+
+# The reference README's implied smoke graph: tiny, undirected, connected.
+TOY_TEXT = """\
+16 20
+0 1
+0 2
+1 3
+2 3
+3 4
+4 5
+5 6
+6 7
+7 8
+8 9
+9 10
+10 11
+11 12
+12 13
+13 14
+14 15
+15 0
+2 8
+5 11
+1 14
+"""
+
+
+@pytest.fixture(scope="session")
+def toy_graph():
+    return gio.read_edge_list_text(TOY_TEXT)
+
+
+@pytest.fixture(scope="session")
+def random_small():
+    # Seeded fixture, the analog of readGraph's srand(12345) mode (bfs.cu:892).
+    return random_graph(500, 2000, seed=12345)
+
+
+@pytest.fixture(scope="session")
+def random_disconnected():
+    # Sparse enough to leave isolated components.
+    return random_graph(300, 150, seed=7)
+
+
+@pytest.fixture(scope="session")
+def rmat_small():
+    return rmat_graph(10, 8, seed=3)
+
+
+@pytest.fixture(scope="session")
+def line_graph():
+    # Path 0-1-2-...-63: max diameter, one-vertex frontiers every level.
+    n = 64
+    u = np.arange(n - 1)
+    return gio.from_edges(u, u + 1, num_vertices=n)

@@ -1,0 +1,118 @@
+"""Single-device JAX BFS vs the CPU golden oracle.
+
+The reference's own test pattern (main: CPU BFS -> GPU BFS -> checkOutput,
+bfs.cu:798-815), systematized: every backend, multiple fixtures, all-sources
+sweeps on small graphs, parent property validation.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_bfs import validate
+from tpu_bfs.algorithms.bfs import BfsEngine, bfs
+from tpu_bfs.graph.csr import INF_DIST
+from tpu_bfs.reference import bfs_python
+
+BACKENDS = ["segment", "scatter"]
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_toy_all_sources(toy_graph, backend):
+    eng = BfsEngine(toy_graph, backend=backend)
+    for src in range(toy_graph.num_vertices):
+        golden, _ = bfs_python(toy_graph, src)
+        res = eng.run(src)
+        validate.check_distances(res.distance, golden)
+        validate.check_parents(toy_graph, src, res.distance, res.parent)
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_random_graph(random_small, backend):
+    eng = BfsEngine(random_small, backend=backend)
+    for src in [0, 123, 499]:
+        golden, _ = bfs_python(random_small, src)
+        res = eng.run(src)
+        validate.check_distances(res.distance, golden)
+        validate.check_parents(random_small, src, res.distance, res.parent)
+
+
+def test_disconnected(random_disconnected):
+    eng = BfsEngine(random_disconnected)
+    golden, _ = bfs_python(random_disconnected, 0)
+    res = eng.run(0)
+    validate.check_distances(res.distance, golden)
+    assert np.all(res.parent[res.distance == INF_DIST] == -1)
+
+
+def test_line_graph_deep(line_graph):
+    # 63 levels: exercises long while_loop trip counts and 1-vertex frontiers.
+    eng = BfsEngine(line_graph)
+    res = eng.run(0)
+    np.testing.assert_array_equal(res.distance, np.arange(64))
+    assert res.num_levels == 63
+    np.testing.assert_array_equal(res.parent[1:], np.arange(63))
+
+
+def test_rmat(rmat_small):
+    eng = BfsEngine(rmat_small)
+    golden, _ = bfs_python(rmat_small, 1)
+    res = eng.run(1)
+    validate.check_distances(res.distance, golden)
+    validate.check_parents(rmat_small, 1, res.distance, res.parent)
+
+
+def test_min_parent_determinism(random_small):
+    # Same source twice -> bit-identical parents (the reference cannot promise
+    # this: its parent is an atomic-race winner, bfs.cu:146-147).
+    eng = BfsEngine(random_small)
+    p1 = eng.run(7).parent
+    p2 = eng.run(7).parent
+    np.testing.assert_array_equal(p1, p2)
+    mp = validate.min_parent_from_dist(random_small, 7, eng.run(7).distance)
+    np.testing.assert_array_equal(p1, mp)
+
+
+def test_max_levels_cutoff(line_graph):
+    eng = BfsEngine(line_graph)
+    res = eng.run(0, max_levels=10, with_parents=False)
+    assert res.num_levels == 10
+    assert np.all(res.distance[:11] == np.arange(11))
+    assert np.all(res.distance[11:] == INF_DIST)
+
+
+def test_result_stats(toy_graph):
+    res = bfs(toy_graph, 0)
+    assert res.reached == 16
+    assert res.edges_traversed == toy_graph.num_input_edges
+    sizes = res.level_sizes()
+    assert sizes.sum() == res.reached
+    assert sizes[0] == 1
+
+
+def test_edges_traversed_directed():
+    # Directed single-insert graph: no halving of the slot count.
+    import io as _io
+
+    from tpu_bfs.graph.io import read_stdin
+
+    g = read_stdin(_io.StringIO("4 3\n0 1\n1 2\n3 0\n"))  # directed
+    res = bfs(g, 0)
+    # Reached from 0: {0, 1, 2}. Edges with both endpoints reached: (0,1), (1,2).
+    assert res.reached == 3
+    assert res.edges_traversed == 2
+
+
+def test_source_change_no_recompile(toy_graph):
+    # source and max_levels are traced, not static: running many sources must
+    # hit the jit cache (the reference re-uploads + would recompile to change
+    # DeviceNum, bfs.cu:19, 402-422).
+    from tpu_bfs.algorithms.bfs import _bfs_core
+
+    eng = BfsEngine(toy_graph)
+    eng.run(0)
+    size_before = _bfs_core._cache_size()
+    for src in (1, 5, 9):
+        res = eng.run(src)
+        golden, _ = bfs_python(toy_graph, src)
+        validate.check_distances(res.distance, golden)
+    assert _bfs_core._cache_size() == size_before

@@ -1,0 +1,81 @@
+"""Golden CPU BFS oracles + validation harness (reference rows 4-6)."""
+
+import numpy as np
+import pytest
+
+from tpu_bfs.graph.csr import INF_DIST
+from tpu_bfs.reference import bfs_python, bfs_scipy
+from tpu_bfs import validate
+
+
+def test_python_vs_scipy(random_small):
+    for src in [0, 17, 499]:
+        d1, _ = bfs_python(random_small, src)
+        d2 = bfs_scipy(random_small, src)
+        np.testing.assert_array_equal(d1, d2)
+
+
+def test_line_graph_distances(line_graph):
+    d, p = bfs_python(line_graph, 0)
+    np.testing.assert_array_equal(d, np.arange(64))
+    np.testing.assert_array_equal(p[1:], np.arange(63))
+    assert p[0] == 0
+
+
+def test_disconnected(random_disconnected):
+    d, p = bfs_python(random_disconnected, 0)
+    assert np.any(d == INF_DIST)
+    assert np.all(p[d == INF_DIST] == -1)
+
+
+def test_check_distances_passes_and_fails():
+    a = np.array([0, 1, 2], dtype=np.int32)
+    validate.check_distances(a, a.copy())
+    b = a.copy()
+    b[2] = 5
+    with pytest.raises(validate.ValidationError):
+        validate.check_distances(a, b)
+
+
+def test_check_parents_accepts_golden(toy_graph, random_small, random_disconnected):
+    for g in (toy_graph, random_small, random_disconnected):
+        d, p = bfs_python(g, 0)
+        validate.check_parents(g, 0, d, p)
+
+
+def test_check_parents_rejects_bad_tree(toy_graph):
+    d, p = bfs_python(toy_graph, 0)
+    bad = p.copy()
+    # point a reached vertex at a non-adjacent parent
+    v = int(np.flatnonzero(d == 2)[0])
+    # find a vertex not adjacent to v at the wrong level
+    bad[v] = v  # self-parent at dist>0: level property violated
+    with pytest.raises(validate.ValidationError):
+        validate.check_parents(toy_graph, 0, d, bad)
+
+
+def test_check_parents_rejects_unreached_parent(random_disconnected):
+    d, p = bfs_python(random_disconnected, 0)
+    unreached = np.flatnonzero(d == INF_DIST)
+    if len(unreached):
+        bad = p.copy()
+        bad[unreached[0]] = 0
+        with pytest.raises(validate.ValidationError):
+            validate.check_parents(random_disconnected, 0, d, bad)
+
+
+def test_min_parent_from_dist(toy_graph):
+    d, _ = bfs_python(toy_graph, 0)
+    mp = validate.min_parent_from_dist(toy_graph, 0, d)
+    validate.check_parents(toy_graph, 0, d, mp)
+    # min-parent is the smallest valid predecessor: for every reached v != src,
+    # no neighbor u < parent[v] has dist[u] == dist[v]-1.
+    for v in range(toy_graph.num_vertices):
+        if d[v] in (0, INF_DIST):
+            continue
+        preds = [
+            u
+            for u in range(toy_graph.num_vertices)
+            if toy_graph.has_edge(u, v) and d[u] == d[v] - 1
+        ]
+        assert mp[v] == min(preds)

@@ -1,0 +1,124 @@
+"""Graph construction, loaders, generators (reference rows 1-3, SURVEY.md §2a)."""
+
+import io
+
+import numpy as np
+import pytest
+
+from tpu_bfs.graph import io as gio
+from tpu_bfs.graph.csr import DeviceGraph, build_csr
+from tpu_bfs.graph.generate import random_graph, rmat_graph, rmat_edges
+
+
+def test_edge_list_roundtrip(toy_graph):
+    g = toy_graph
+    assert g.num_vertices == 16
+    assert g.num_input_edges == 20
+    # Undirected double-insert (bfs.cu:860-861): 2m directed slots.
+    assert g.num_edges == 40
+    assert g.has_edge(0, 1) and g.has_edge(1, 0)
+    assert g.has_edge(2, 8) and g.has_edge(8, 2)
+    assert not g.has_edge(0, 5)
+    # Degrees sum to num_edges.
+    assert g.degrees.sum() == g.num_edges
+
+
+def test_csr_sorted_neighbors(toy_graph):
+    g = toy_graph
+    for v in range(g.num_vertices):
+        nb = g.col_idx[g.row_ptr[v] : g.row_ptr[v + 1]]
+        assert np.all(np.diff(nb) >= 0)
+
+
+def test_comment_skipping_and_mtx_header():
+    text = "%%MatrixMarket matrix coordinate pattern symmetric\n% comment\n3 3 2\n1 2\n2 3\n"
+    g = gio.read_edge_list_text(text)
+    assert g.num_vertices == 3
+    assert g.has_edge(0, 1) and g.has_edge(1, 2) and g.has_edge(1, 0)
+
+
+def test_mtx_weight_column():
+    # Float weight column is tolerated and ignored.
+    text = "3 3 2\n1 2 1.5\n2 3 0.25\n"
+    g = gio.read_edge_list_text(text)
+    assert g.num_edges == 4
+    assert g.has_edge(1, 2)
+
+
+def test_directed_load():
+    text = "3 2\n0 1\n1 2\n"
+    g = gio.read_edge_list_text(text, directed=True)
+    assert g.has_edge(0, 1) and not g.has_edge(1, 0)
+
+
+def test_stdin_reader():
+    g = gio.read_stdin(io.StringIO("3 2\n0 1\n1 2\n"))
+    assert g.num_vertices == 3 and g.num_edges == 2
+
+
+def test_bad_header():
+    with pytest.raises(ValueError):
+        gio.read_edge_list_text("1 2 3 4\n")
+
+
+def test_out_of_range_vertex():
+    with pytest.raises(ValueError):
+        gio.read_edge_list_text("2 1\n0 5\n")
+
+
+def test_random_graph_seeded():
+    g1 = random_graph(100, 400, seed=12345)
+    g2 = random_graph(100, 400, seed=12345)
+    np.testing.assert_array_equal(g1.col_idx, g2.col_idx)
+    np.testing.assert_array_equal(g1.row_ptr, g2.row_ptr)
+    g3 = random_graph(100, 400, seed=54321)
+    assert not np.array_equal(g1.col_idx, g3.col_idx)
+
+
+def test_rmat_shape_and_determinism():
+    u1, v1 = rmat_edges(8, 4, seed=9)
+    u2, v2 = rmat_edges(8, 4, seed=9)
+    np.testing.assert_array_equal(u1, u2)
+    assert len(u1) == 4 * 256
+    assert u1.max() < 256 and u1.min() >= 0
+    g = rmat_graph(8, 4, seed=9)
+    assert g.num_vertices == 256
+
+
+def test_rmat_skew():
+    # RMAT with a=0.57 must be heavy-tailed: max degree far above mean.
+    g = rmat_graph(12, 8, seed=1)
+    assert g.degrees.max() > 8 * g.degrees.mean()
+
+
+def test_npz_roundtrip(tmp_path, toy_graph):
+    p = str(tmp_path / "g.npz")
+    gio.save_npz(p, toy_graph)
+    g2 = gio.load_npz(p)
+    np.testing.assert_array_equal(g2.row_ptr, toy_graph.row_ptr)
+    np.testing.assert_array_equal(g2.col_idx, toy_graph.col_idx)
+    assert g2.num_input_edges == toy_graph.num_input_edges
+
+
+def test_device_graph_padding(toy_graph):
+    dg = DeviceGraph.from_graph(toy_graph)
+    assert dg.vp % 1024 == 0 and dg.vp > toy_graph.num_vertices
+    assert dg.ep % 1024 == 0 and dg.ep >= toy_graph.num_edges
+    # dst-major sort.
+    assert np.all(np.diff(dg.dst) >= 0)
+    # Padding edges are phantom self-loops.
+    pad = slice(dg.num_edges, dg.ep)
+    assert np.all(dg.src[pad] == dg.vp - 1)
+    assert np.all(dg.dst[pad] == dg.vp - 1)
+    # No real edge touches a phantom vertex.
+    real = slice(0, dg.num_edges)
+    assert dg.src[real].max() < toy_graph.num_vertices
+    assert dg.dst[real].max() < toy_graph.num_vertices
+    # in_row_ptr consistent with dst.
+    counts = np.diff(dg.in_row_ptr)
+    np.testing.assert_array_equal(counts, np.bincount(dg.dst, minlength=dg.vp))
+
+
+def test_build_csr_rejects_bad_ids():
+    with pytest.raises(ValueError):
+        build_csr(np.array([0, 5]), np.array([1, 1]), num_vertices=3)

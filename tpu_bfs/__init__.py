@@ -1,0 +1,23 @@
+"""tpu_bfs — a TPU-native distributed BFS framework.
+
+Re-implements the capabilities of the reference CUDA framework
+(xxcclong/Distributed-CUDA-BFS, /root/reference/bfs.cu + bfs_mpi.cu) as an
+idiomatic JAX/XLA/Pallas stack:
+
+- ``tpu_bfs.graph``      — graph I/O, CSR representation, generators
+                           (reference: Graph struct bfs.cu:21-28, loaders bfs.cu:829-920)
+- ``tpu_bfs.reference``  — CPU golden BFS oracle (reference: bfsCPU bfs.cu:923-945)
+- ``tpu_bfs.validate``   — distance + parent validation (reference: checkOutput bfs.cu:374-384)
+- ``tpu_bfs.algorithms`` — single-device BFS level steps + drivers
+                           (reference: multiBfs bfs.cu:101-130, queueBfs bfs.cu:134-165)
+- ``tpu_bfs.parallel``   — mesh/partition/collectives + distributed BFS
+                           (reference: getDev bfs.cu:29-32, runCudaQueueBfs bfs.cu:542-629,
+                           MPI driver bfs_mpi.cu:549-643)
+- ``tpu_bfs.ops``        — Pallas TPU kernels for the hot level step
+- ``tpu_bfs.utils``      — timing, stats, config
+"""
+
+__version__ = "0.1.0"
+
+from tpu_bfs.graph.csr import Graph, DeviceGraph  # noqa: F401
+from tpu_bfs.algorithms.bfs import bfs, BfsResult  # noqa: F401

@@ -1,0 +1,2 @@
+from tpu_bfs.algorithms.bfs import bfs, BfsEngine, BfsResult  # noqa: F401
+from tpu_bfs.algorithms.frontier import level_step, extract_parents  # noqa: F401

@@ -1,0 +1,181 @@
+"""Single-device BFS driver.
+
+The analog of the reference's host level loops (runCudaSimpleBfsMulti
+bfs.cu:475-539, runCudaQueueBfs bfs.cu:542-629) — but device-resident: the
+reference crosses the host<->device boundary four times per level (launch,
+sync, peer copy, counter read — SURVEY.md §3.1); here the entire level loop is
+a ``lax.while_loop`` compiled into one XLA program, and only the final
+distance array comes back to the host.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_bfs.graph.csr import Graph, DeviceGraph, INF_DIST
+from tpu_bfs.algorithms.frontier import level_step, extract_parents, INT32_MAX
+
+
+@partial(jax.jit, static_argnames=("backend",), donate_argnums=())
+def _bfs_core(src, dst, frontier0, visited0, dist0, max_levels, *, backend):
+    """The compiled level loop. All shapes static; source/max_levels traced."""
+
+    def cond(state):
+        frontier, _, _, level = state
+        return jnp.any(frontier) & (level < max_levels)
+
+    def body(state):
+        frontier, visited, dist, level = state
+        new = level_step(src, dst, frontier, visited, backend=backend)
+        dist = jnp.where(new, level + 1, dist)
+        visited = visited | new
+        return new, visited, dist, level + 1
+
+    _, _, dist, level = jax.lax.while_loop(
+        cond, body, (frontier0, visited0, dist0, jnp.int32(0))
+    )
+    return dist, level
+
+
+@dataclasses.dataclass
+class BfsResult:
+    source: int
+    distance: np.ndarray  # [V] int32, INF_DIST if unreached
+    parent: np.ndarray | None  # [V] int32, -1 if unreached, source->source
+    num_levels: int  # eccentricity of the source (max distance reached)
+    reached: int  # vertices reached (incl. source)
+    edges_traversed: int  # input edges with both endpoints reached (Graph500 TEPS convention)
+    elapsed_s: float | None = None
+
+    @property
+    def teps(self) -> float | None:
+        if not self.elapsed_s:
+            return None
+        return self.edges_traversed / self.elapsed_s
+
+    def level_sizes(self) -> np.ndarray:
+        """Frontier size per level, recovered from the distance histogram —
+        replaces the reference's per-level managed-counter reads (bfs.cu:617)."""
+        reached = self.distance[self.distance != INF_DIST]
+        return np.bincount(reached, minlength=self.num_levels + 1)
+
+
+class BfsEngine:
+    """Holds a device-resident graph and runs BFS from any source.
+
+    Analog of initCuda2 (bfs.cu:308-360) + runCudaQueueBfs: construction
+    uploads the (padded, dst-sorted) edge arrays once; ``run`` executes the
+    compiled level loop for a traced source, so changing source does NOT
+    recompile (the reference recompiles to change DeviceNum and re-uploads per
+    source, bfs.cu:402-422).
+    """
+
+    def __init__(
+        self,
+        graph: Graph | DeviceGraph,
+        *,
+        backend: str = "segment",
+        device=None,
+    ):
+        dg = DeviceGraph.from_graph(graph) if isinstance(graph, Graph) else graph
+        self.dg = dg
+        self.backend = backend
+        put = partial(jax.device_put, device=device) if device else jax.device_put
+        self.src = put(jnp.asarray(dg.src))
+        self.dst = put(jnp.asarray(dg.dst))
+
+    @property
+    def vp(self) -> int:
+        return self.dg.vp
+
+    def _init_state(self, source):
+        vp = self.vp
+        frontier0 = jnp.zeros((vp,), jnp.bool_).at[source].set(True)
+        visited0 = frontier0
+        dist0 = jnp.full((vp,), INT32_MAX, jnp.int32).at[source].set(0)
+        return frontier0, visited0, dist0
+
+    def distances(self, source: int, *, max_levels: int | None = None):
+        """Device distance array [vp] + level count; no host transfer."""
+        frontier0, visited0, dist0 = self._init_state(source)
+        ml = jnp.int32(max_levels if max_levels is not None else self.vp)
+        return _bfs_core(
+            self.src, self.dst, frontier0, visited0, dist0, ml, backend=self.backend
+        )
+
+    def run(
+        self,
+        source: int,
+        *,
+        max_levels: int | None = None,
+        with_parents: bool = True,
+        time_it: bool = False,
+    ) -> BfsResult:
+        if not (0 <= source < self.dg.num_vertices):
+            raise ValueError(f"source {source} out of range")
+        elapsed = None
+        if time_it:
+            # warm-up to exclude compilation, as the reference's chrono timings
+            # exclude initCuda2 but not compile (it has no JIT).
+            self.distances(source, max_levels=max_levels)[0].block_until_ready()
+            import time
+
+            t0 = time.perf_counter()
+            dist_dev, level = self.distances(source, max_levels=max_levels)
+            dist_dev.block_until_ready()
+            elapsed = time.perf_counter() - t0
+        else:
+            dist_dev, level = self.distances(source, max_levels=max_levels)
+
+        parent = None
+        if with_parents:
+            parent_dev = extract_parents(self.src, self.dst, dist_dev, source)
+            parent = np.asarray(parent_dev)[: self.dg.num_vertices]
+
+        v = self.dg.num_vertices
+        dist = np.asarray(dist_dev)[:v]
+        reached_mask = dist != INF_DIST
+        reached = int(reached_mask.sum())
+        # `level` counts body executions, including the final step that finds
+        # an empty frontier; the source eccentricity is the max distance.
+        num_levels = int(dist[reached_mask].max()) if reached else 0
+        edges_traversed = self._count_traversed_edges(reached_mask)
+        return BfsResult(
+            source=source,
+            distance=dist,
+            parent=parent,
+            num_levels=num_levels,
+            reached=reached,
+            edges_traversed=edges_traversed,
+            elapsed_s=elapsed,
+        )
+
+    def _count_traversed_edges(self, reached_mask: np.ndarray) -> int:
+        """Graph500 TEPS numerator: input edges with both endpoints reached.
+
+        Counted over directed slots, halved only for undirected graphs (where
+        each input edge contributes two slots, bfs.cu:860-861)."""
+        e = self.dg.num_edges
+        slots = int(
+            (reached_mask[self.dg.src[:e]] & reached_mask[self.dg.dst[:e]]).sum()
+        )
+        return slots // 2 if self.dg.undirected else slots
+
+
+def bfs(
+    graph: Graph,
+    source: int,
+    *,
+    backend: str = "segment",
+    with_parents: bool = True,
+    max_levels: int | None = None,
+) -> BfsResult:
+    """One-shot BFS convenience wrapper (builds a BfsEngine per call)."""
+    return BfsEngine(graph, backend=backend).run(
+        source, with_parents=with_parents, max_levels=max_levels
+    )

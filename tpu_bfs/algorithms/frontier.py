@@ -1,0 +1,92 @@
+"""Edge-centric, atomics-free BFS level primitives.
+
+The reference has two kernel formulations:
+
+- dense level-synchronous ``multiBfs`` (bfs.cu:101-130): one thread per owned
+  vertex, racy peer stores, a shared ``changed`` flag;
+- frontier-queue ``queueBfs`` (bfs.cu:134-165): ``atomicMin`` visited-claim +
+  ``atomicAdd`` queue append.
+
+Neither maps to TPU (no atomics, no dynamic shapes — SURVEY.md §7 "hard
+parts"). The TPU-native formulation here is edge-centric and race-free by
+construction:
+
+    active[e]  = frontier[src[e]]                  (gather)
+    hit[v]     = OR over edges e with dst[e]==v of active[e]   (scatter-or /
+                                                    segment-or; edges are
+                                                    dst-sorted in DeviceGraph)
+    next       = hit & ~visited
+
+Distances come from the level counter; parents are extracted AFTER the level
+loop in one O(E) pass (``extract_parents``) — they are a pure function of the
+final distance array, so the hot loop carries no parent state at all. The
+deterministic min-parent rule replaces the reference's atomic-race winner
+(bfs.cu:146-147).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+INT32_MAX = jnp.iinfo(jnp.int32).max
+
+# Registry of frontier-expansion backends; 'pallas' is registered by
+# tpu_bfs.ops when available.
+_EXPAND_BACKENDS = {}
+
+
+def expand_or(active, dst, vp: int, *, backend: str = "segment"):
+    """hit[v] = OR_{e: dst[e]==v} active[e].  ``dst`` must be non-decreasing
+    for the 'segment' backend (DeviceGraph guarantees this)."""
+    return _EXPAND_BACKENDS[backend](active, dst, vp)
+
+
+def _expand_scatter(active, dst, vp):
+    return jnp.zeros((vp,), jnp.bool_).at[dst].max(active, mode="drop")
+
+
+def _expand_segment(active, dst, vp):
+    seg = jax.ops.segment_max(
+        active.astype(jnp.int32), dst, num_segments=vp, indices_are_sorted=True
+    )
+    return seg > 0
+
+
+_EXPAND_BACKENDS["scatter"] = _expand_scatter
+_EXPAND_BACKENDS["segment"] = _expand_segment
+
+
+def level_step(src, dst, frontier, visited, *, backend: str = "segment"):
+    """One BFS level: returns the next frontier mask.
+
+    Semantics of one iteration of the reference's level loop
+    (runCudaQueueBfs, bfs.cu:569-621 / multiBfs, bfs.cu:101-130), with the
+    visited test folded in (`& ~visited` replaces the atomicMin claim).
+    """
+    active = frontier[src]
+    hit = expand_or(active, dst, frontier.shape[0], backend=backend)
+    return hit & ~visited
+
+
+@partial(jax.jit, static_argnames=("vp",))
+def _extract_parents_impl(src, dst, dist, source, vp: int):
+    du = dist[src]
+    dv = dist[dst]
+    ok = (du != INT32_MAX) & (du + 1 == dv)
+    cand = jnp.where(ok, src, INT32_MAX)
+    parent = jnp.full((vp,), INT32_MAX, jnp.int32).at[dst].min(cand, mode="drop")
+    parent = jnp.where(parent == INT32_MAX, -1, parent)
+    parent = jnp.where(dist == INT32_MAX, -1, parent)
+    return parent.at[source].set(source)
+
+
+def extract_parents(src, dst, dist, source):
+    """Deterministic min-parent tree from the final distance array.
+
+    parent[v] = min{ u : (u,v) in E, dist[u] = dist[v]-1 }; source -> itself;
+    unreached -> -1. One O(E) scatter-min, outside the hot loop.
+    """
+    return _extract_parents_impl(src, dst, dist, source, dist.shape[0])

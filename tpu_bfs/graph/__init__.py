@@ -1,0 +1,3 @@
+from tpu_bfs.graph.csr import Graph, DeviceGraph  # noqa: F401
+from tpu_bfs.graph.io import load_edge_list, read_edge_list_text, from_edges  # noqa: F401
+from tpu_bfs.graph.generate import random_graph, rmat_graph  # noqa: F401

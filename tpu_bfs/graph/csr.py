@@ -1,0 +1,186 @@
+"""Graph representations.
+
+``Graph`` is the host-side CSR graph, the analog of the reference's global ``Graph``
+struct (bfs.cu:21-28: ``adjacencyList`` / ``edgesOffset`` / ``edgesSize`` /
+``numVertices`` / ``numEdges``) — but immutable, NumPy-backed, and never global.
+
+``DeviceGraph`` is the padded, device-ready form consumed by the JAX/Pallas level
+kernels: static shapes (vertex and edge counts rounded up to TPU-friendly
+multiples), edge-centric COO view sorted by destination, and a phantom vertex
+range absorbing padding. The reference instead replicates raw CSR pointers to
+every device (initCuda2, bfs.cu:346-351); here padding/layout is done once on
+host so everything downstream is static-shaped for XLA.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import cached_property
+
+import numpy as np
+
+# Sentinel for "unreached" distance; reference uses INT_MAX (bfs.cu:404-406).
+INF_DIST = np.int32(np.iinfo(np.int32).max)
+NO_PARENT = np.int32(-1)
+
+# Pad vertex counts to a multiple of this (TPU lane width x sublanes for int32).
+VERTEX_PAD = 1024
+EDGE_PAD = 1024
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+@dataclasses.dataclass(frozen=True)
+class Graph:
+    """Host-side CSR graph (0-indexed, directed edge slots).
+
+    An undirected input edge (u, v) is stored as two directed slots, matching
+    the reference loader's double-insert (bfs.cu:860-861), so ``num_edges`` is
+    2m for an undirected graph with m input edges.
+    """
+
+    row_ptr: np.ndarray  # [V+1] int64 — reference: edgesOffset (bfs.cu:24)
+    col_idx: np.ndarray  # [E]   int32 — reference: adjacencyList (bfs.cu:23)
+    num_input_edges: int  # m as given in the input (before direction doubling)
+    undirected: bool = True  # True when edge slots are the double-insert of input edges
+
+    def __post_init__(self):
+        assert self.row_ptr.ndim == 1 and self.col_idx.ndim == 1
+        assert self.row_ptr[0] == 0 and self.row_ptr[-1] == len(self.col_idx)
+
+    @property
+    def num_vertices(self) -> int:
+        return len(self.row_ptr) - 1
+
+    @property
+    def num_edges(self) -> int:
+        """Directed edge slots (reference: numEdges = adjacencyList.size(), bfs.cu:875)."""
+        return len(self.col_idx)
+
+    @cached_property
+    def degrees(self) -> np.ndarray:
+        """Per-vertex out-degree (reference: edgesSize, bfs.cu:25)."""
+        return np.diff(self.row_ptr).astype(np.int64)
+
+    @cached_property
+    def coo(self) -> tuple[np.ndarray, np.ndarray]:
+        """Edge-centric (src, dst) view, row-major (sorted by src)."""
+        src = np.repeat(
+            np.arange(self.num_vertices, dtype=np.int32), self.degrees
+        )
+        return src, self.col_idx.astype(np.int32)
+
+    def has_edge(self, u: int, v: int) -> bool:
+        lo, hi = self.row_ptr[u], self.row_ptr[u + 1]
+        sl = self.col_idx[lo:hi]
+        j = np.searchsorted(sl, v)
+        if j < len(sl) and sl[j] == v:
+            return True
+        # Adjacency may be unsorted when built with sort_neighbors=False.
+        return bool(np.any(sl == v))
+
+    def to_scipy(self):
+        import scipy.sparse as sp
+
+        data = np.ones(self.num_edges, dtype=np.int8)
+        return sp.csr_matrix(
+            (data, self.col_idx, self.row_ptr),
+            shape=(self.num_vertices, self.num_vertices),
+        )
+
+
+def build_csr(
+    src: np.ndarray,
+    dst: np.ndarray,
+    num_vertices: int,
+    *,
+    num_input_edges: int | None = None,
+    sort_neighbors: bool = True,
+    undirected: bool = True,
+) -> Graph:
+    """Build a CSR Graph from directed edge slots.
+
+    The reference builds CSR by concatenating per-vertex adjacency vectors
+    (readGraphFromFile, bfs.cu:866-872); here it is a vectorized counting sort.
+    ``sort_neighbors`` additionally orders each adjacency list, enabling
+    O(log d) edge-existence checks in validation.
+    """
+    src = np.asarray(src, dtype=np.int64)
+    dst = np.asarray(dst, dtype=np.int64)
+    assert src.shape == dst.shape
+    if len(src) and (src.min() < 0 or src.max() >= num_vertices):
+        raise ValueError("src vertex id out of range")
+    if len(dst) and (dst.min() < 0 or dst.max() >= num_vertices):
+        raise ValueError("dst vertex id out of range")
+
+    if sort_neighbors:
+        order = np.lexsort((dst, src))
+    else:
+        order = np.argsort(src, kind="stable")
+    src_sorted = src[order]
+    col_idx = dst[order].astype(np.int32)
+    counts = np.bincount(src_sorted, minlength=num_vertices)
+    row_ptr = np.zeros(num_vertices + 1, dtype=np.int64)
+    np.cumsum(counts, out=row_ptr[1:])
+    return Graph(
+        row_ptr=row_ptr,
+        col_idx=col_idx,
+        num_input_edges=num_input_edges if num_input_edges is not None else len(src),
+        undirected=undirected,
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceGraph:
+    """Padded, static-shape, device-ready edge-centric graph.
+
+    - Vertex ids in [num_vertices, vp) are phantoms: no real edge touches them,
+      padding edges are phantom->phantom self-loops, and phantoms are never in
+      the frontier, so they are inert in every level step.
+    - Edges are sorted by (dst, src): destination-major order makes the
+      scatter-min in the level step segment-local, which the scan/Pallas
+      backends exploit; the min-src tie-break makes parents deterministic
+      (unlike the reference's atomic-race winner, bfs.cu:146-147).
+    """
+
+    src: np.ndarray  # [ep] int32, dst-major order
+    dst: np.ndarray  # [ep] int32, non-decreasing
+    num_vertices: int  # real V
+    num_edges: int  # real directed edge slots
+    num_input_edges: int
+    undirected: bool
+    vp: int  # padded vertex count (>= V+1, multiple of VERTEX_PAD)
+    ep: int  # padded edge count (multiple of EDGE_PAD)
+    # CSR-by-destination over the padded arrays: in_row_ptr[v] is the first
+    # padded-edge index with dst == v. Used for segment boundaries.
+    in_row_ptr: np.ndarray  # [vp+1] int64
+
+    @classmethod
+    def from_graph(cls, g: Graph, *, vertex_pad: int = VERTEX_PAD,
+                   edge_pad: int = EDGE_PAD) -> "DeviceGraph":
+        v, e = g.num_vertices, g.num_edges
+        # Always leave at least one phantom vertex so padding edges have a target.
+        vp = _round_up(v + 1, vertex_pad)
+        ep = _round_up(max(e, 1), edge_pad)
+        src, dst = g.coo
+        order = np.lexsort((src, dst))  # dst-major, src-minor
+        src_p = np.full(ep, vp - 1, dtype=np.int32)
+        dst_p = np.full(ep, vp - 1, dtype=np.int32)
+        src_p[:e] = src[order]
+        dst_p[:e] = dst[order]
+        counts = np.bincount(dst_p.astype(np.int64), minlength=vp)
+        in_row_ptr = np.zeros(vp + 1, dtype=np.int64)
+        np.cumsum(counts, out=in_row_ptr[1:])
+        return cls(
+            src=src_p,
+            dst=dst_p,
+            num_vertices=v,
+            num_edges=e,
+            num_input_edges=g.num_input_edges,
+            undirected=g.undirected,
+            vp=vp,
+            ep=ep,
+            in_row_ptr=in_row_ptr,
+        )

@@ -1,0 +1,95 @@
+"""Seeded graph generators.
+
+- ``random_graph``: the capability of the reference's seeded random generator
+  (readGraph, bfs.cu:892-907: ``srand(12345)``, m uniform edges, undirected
+  double-insert) — reproducible from a seed, vectorized.
+- ``rmat_graph``: Graph500-style RMAT generator (absent from the reference;
+  required by the scale-22/26 target configs in BASELINE.json).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tpu_bfs.graph.csr import Graph
+from tpu_bfs.graph.io import from_edges
+
+
+def random_graph(
+    num_vertices: int,
+    num_edges: int,
+    *,
+    seed: int = 12345,
+    directed: bool = False,
+    drop_self_loops: bool = False,
+) -> Graph:
+    """Uniform random multigraph, seeded and reproducible.
+
+    Mirrors readGraph's generator mode (bfs.cu:892-907): m uniform (u, v)
+    pairs, undirected double-insert, self-loops allowed (the reference allows
+    them too).
+    """
+    rng = np.random.default_rng(seed)
+    u = rng.integers(0, num_vertices, size=num_edges, dtype=np.int64)
+    v = rng.integers(0, num_vertices, size=num_edges, dtype=np.int64)
+    if drop_self_loops:
+        keep = u != v
+        u, v = u[keep], v[keep]
+    return from_edges(
+        u, v, num_vertices=num_vertices, directed=directed, num_input_edges=num_edges
+    )
+
+
+def rmat_edges(
+    scale: int,
+    edge_factor: int = 16,
+    *,
+    seed: int = 1,
+    a: float = 0.57,
+    b: float = 0.19,
+    c: float = 0.19,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Graph500 RMAT edge list: 2^scale vertices, edge_factor * 2^scale edges.
+
+    Vectorized per bit-level: each of the `scale` bits of (u, v) is drawn from
+    the quadrant distribution (a, b, c, d). Vertex ids are then permuted, as
+    the Graph500 spec requires, to destroy the locality the recursion creates.
+    """
+    n = 1 << scale
+    m = edge_factor << scale
+    rng = np.random.default_rng(seed)
+    u = np.zeros(m, dtype=np.int64)
+    v = np.zeros(m, dtype=np.int64)
+    ab = a + b
+    a_norm = a / ab
+    c_norm = c / (1.0 - ab)
+    for _ in range(scale):
+        u <<= 1
+        v <<= 1
+        r_u = rng.random(m)
+        r_v = rng.random(m)
+        u_bit = r_u > ab
+        v_bit = np.where(u_bit, r_v > c_norm, r_v > a_norm)
+        u |= u_bit
+        v |= v_bit
+    perm = rng.permutation(n)
+    return perm[u], perm[v]
+
+
+def rmat_graph(
+    scale: int,
+    edge_factor: int = 16,
+    *,
+    seed: int = 1,
+    drop_self_loops: bool = True,
+    dedup: bool = False,
+    **quadrants,
+) -> Graph:
+    u, v = rmat_edges(scale, edge_factor, seed=seed, **quadrants)
+    m = len(u)
+    if drop_self_loops:
+        keep = u != v
+        u, v = u[keep], v[keep]
+    return from_edges(
+        u, v, num_vertices=1 << scale, directed=False, num_input_edges=m, dedup=dedup
+    )

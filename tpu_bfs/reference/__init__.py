@@ -1,0 +1,1 @@
+from tpu_bfs.reference.cpu_bfs import bfs_python, bfs_scipy, bfs_golden  # noqa: F401

@@ -1,0 +1,68 @@
+"""CPU golden BFS oracles.
+
+The reference's entire correctness story is a sequential CPU BFS run before the
+GPU run and compared elementwise (bfsCPU, bfs.cu:923-945; checkOutput,
+bfs.cu:374-384). We keep that pattern with two independent oracles:
+
+- ``bfs_python``: a dependency-free queue BFS, the direct analog of bfsCPU.
+  Note the reference stores parent as the *edge index* into adjacencyList
+  (bfs.cu:940); we store the predecessor *vertex* id, which is
+  deterministic under our min-parent rule and actually checkable (§3.4 of
+  SURVEY.md: the reference's parent output is race-nondeterministic and never
+  validated).
+- ``bfs_scipy``: scipy.sparse.csgraph BFS at C speed, for large-graph tests
+  and benchmark validation.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+import numpy as np
+
+from tpu_bfs.graph.csr import Graph, INF_DIST, NO_PARENT
+
+
+def bfs_python(g: Graph, source: int) -> tuple[np.ndarray, np.ndarray]:
+    """Sequential queue BFS (analog of bfsCPU, bfs.cu:923-945).
+
+    Returns (distance, parent): distance[v] = INF_DIST if unreached;
+    parent[source] = source, parent[unreached] = -1. parent[v] is the first
+    discoverer in BFS queue order — a *valid* BFS tree but not necessarily the
+    device kernels' deterministic min-parent; compare parents by property
+    (tpu_bfs.validate.check_parents), never elementwise.
+    """
+    v_count = g.num_vertices
+    dist = np.full(v_count, INF_DIST, dtype=np.int32)
+    parent = np.full(v_count, NO_PARENT, dtype=np.int32)
+    dist[source] = 0
+    parent[source] = source
+    q = deque([source])
+    row_ptr, col_idx = g.row_ptr, g.col_idx
+    while q:
+        u = q.popleft()
+        du = dist[u]
+        for v in col_idx[row_ptr[u] : row_ptr[u + 1]]:
+            if dist[v] == INF_DIST:
+                dist[v] = du + 1
+                parent[v] = u
+                q.append(v)
+    return dist, parent
+
+
+def bfs_scipy(g: Graph, source: int) -> np.ndarray:
+    """Distances only, via scipy.sparse.csgraph (C implementation)."""
+    import scipy.sparse.csgraph as csgraph
+
+    d = csgraph.dijkstra(g.to_scipy(), unweighted=True, indices=source, min_only=False)
+    dist = np.full(g.num_vertices, INF_DIST, dtype=np.int32)
+    reached = np.isfinite(d)
+    dist[reached] = d[reached].astype(np.int32)
+    return dist
+
+
+def bfs_golden(g: Graph, source: int, *, python_threshold: int = 200_000):
+    """Pick the pure-Python oracle for small graphs, scipy for large ones."""
+    if g.num_edges <= python_threshold:
+        return bfs_python(g, source)[0]
+    return bfs_scipy(g, source)

@@ -1,0 +1,81 @@
+"""Native (C++) fast paths, loaded via ctypes.
+
+The reference's loader and CSR build are C++ (readGraphFromFile,
+bfs.cu:829-880); the equivalents here live in ``native/`` at the repo root and
+are compiled to ``libtpubfs.so``. Everything degrades gracefully to the NumPy
+implementations when the shared library has not been built.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+_LIB = None
+_TRIED = False
+
+
+def _find_lib():
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    for cand in (
+        os.path.join(here, "native", "build", "libtpubfs.so"),
+        os.path.join(here, "native", "libtpubfs.so"),
+    ):
+        if os.path.exists(cand):
+            try:
+                lib = ctypes.CDLL(cand)
+                lib.tpubfs_parse_edge_list.restype = ctypes.c_longlong
+                lib.tpubfs_parse_edge_list.argtypes = [
+                    ctypes.c_char_p,  # path
+                    ctypes.POINTER(ctypes.c_longlong),  # out n
+                    ctypes.POINTER(ctypes.c_longlong),  # out m
+                    ctypes.POINTER(ctypes.POINTER(ctypes.c_longlong)),  # out u
+                    ctypes.POINTER(ctypes.POINTER(ctypes.c_longlong)),  # out v
+                ]
+                lib.tpubfs_free.argtypes = [ctypes.POINTER(ctypes.c_longlong)]
+                _LIB = lib
+                break
+            except OSError:
+                pass
+    return _LIB
+
+
+def available() -> bool:
+    return _find_lib() is not None
+
+
+def load_edge_list_native(path: str, *, directed: bool = False, drop_self_loops: bool = False):
+    """Parse an edge-list file with the C++ loader. Returns a Graph, or None
+    if the native library is unavailable (callers fall back to NumPy)."""
+    lib = _find_lib()
+    if lib is None:
+        return None
+    n = ctypes.c_longlong()
+    m = ctypes.c_longlong()
+    up = ctypes.POINTER(ctypes.c_longlong)()
+    vp = ctypes.POINTER(ctypes.c_longlong)()
+    rc = lib.tpubfs_parse_edge_list(
+        path.encode(), ctypes.byref(n), ctypes.byref(m), ctypes.byref(up), ctypes.byref(vp)
+    )
+    if rc != 0:
+        raise IOError(f"native loader failed on {path} (rc={rc})")
+    try:
+        u = np.ctypeslib.as_array(up, shape=(m.value,)).copy()
+        v = np.ctypeslib.as_array(vp, shape=(m.value,)).copy()
+    finally:
+        lib.tpubfs_free(up)
+        lib.tpubfs_free(vp)
+    if drop_self_loops:
+        keep = u != v
+        u, v = u[keep], v[keep]
+    from tpu_bfs.graph.io import from_edges
+
+    return from_edges(
+        u, v, num_vertices=int(n.value), directed=directed, num_input_edges=int(m.value)
+    )

@@ -1,0 +1,113 @@
+"""Result validation.
+
+The reference validates distances elementwise and exits on first mismatch
+(checkOutput, bfs.cu:374-384) and never validates parents — it can't: its
+parent is an atomic-race winner stored as an edge index (bfs.cu:146-147, 940).
+Here:
+
+- ``check_distances``: the same elementwise oracle compare, as a function
+  returning mismatches instead of exit(1).
+- ``check_parents``: property-based BFS-tree validation in the Graph500 style:
+  parent edges must exist in the graph and satisfy dist[parent[v]] ==
+  dist[v] - 1; exactly the reached set has parents.
+- ``min_parent_from_dist``: the deterministic min-parent tree implied by a
+  distance array — the device kernels' parent definition, computable on host
+  for exact comparison.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tpu_bfs.graph.csr import Graph, INF_DIST, NO_PARENT
+
+
+class ValidationError(AssertionError):
+    pass
+
+
+def check_distances(dist: np.ndarray, expected: np.ndarray, *, max_report: int = 10) -> None:
+    """Elementwise distance compare (reference: checkOutput, bfs.cu:374-384)."""
+    dist = np.asarray(dist)
+    expected = np.asarray(expected)
+    if dist.shape != expected.shape:
+        raise ValidationError(f"shape mismatch: {dist.shape} vs {expected.shape}")
+    bad = np.flatnonzero(dist != expected)
+    if len(bad):
+        lines = [
+            f"  v={v}: got {dist[v]}, expected {expected[v]}" for v in bad[:max_report]
+        ]
+        raise ValidationError(
+            f"{len(bad)} distance mismatches:\n" + "\n".join(lines)
+        )
+
+
+def check_parents(
+    g: Graph, source: int, dist: np.ndarray, parent: np.ndarray
+) -> None:
+    """Property-based parent (BFS tree) validation.
+
+    Checks, vectorized over all vertices:
+      1. parent[source] == source and dist[source] == 0.
+      2. v reached (dist < INF) and v != source  =>  parent[v] is reached,
+         dist[parent[v]] == dist[v] - 1, and edge (parent[v], v) exists.
+      3. v unreached  =>  parent[v] == NO_PARENT.
+    """
+    dist = np.asarray(dist)
+    parent = np.asarray(parent)
+    v_count = g.num_vertices
+    if dist.shape != (v_count,) or parent.shape != (v_count,):
+        raise ValidationError("dist/parent shape mismatch")
+    if dist[source] != 0 or parent[source] != source:
+        raise ValidationError(
+            f"source: dist={dist[source]}, parent={parent[source]}"
+        )
+    reached = dist != INF_DIST
+    if not np.all(parent[~reached] == NO_PARENT):
+        raise ValidationError("unreached vertex with a parent")
+    vs = np.flatnonzero(reached)
+    vs = vs[vs != source]
+    ps = parent[vs]
+    if np.any(ps < 0) or np.any(ps >= v_count):
+        raise ValidationError("reached vertex with out-of-range parent")
+    bad_level = dist[ps] != dist[vs] - 1
+    if np.any(bad_level):
+        v = vs[np.argmax(bad_level)]
+        raise ValidationError(
+            f"v={v}: dist[parent]={dist[parent[v]]} but dist[v]={dist[v]}"
+        )
+    # Edge existence: every (parent[v], v) must be in the CSR. Fully
+    # vectorized: pack endpoints into int64 keys and binary-search the packed,
+    # sorted edge set (works for sorted or unsorted adjacency).
+    src_all, dst_all = g.coo
+    n = np.int64(g.num_vertices)
+    edge_keys = np.sort(src_all.astype(np.int64) * n + dst_all)
+    query = ps.astype(np.int64) * n + vs
+    pos = np.searchsorted(edge_keys, query)
+    pos = np.minimum(pos, len(edge_keys) - 1)
+    found = edge_keys[pos] == query if len(edge_keys) else np.zeros(len(vs), bool)
+    if not np.all(found):
+        v = vs[np.argmin(found)]
+        raise ValidationError(f"edge (parent[v]={parent[v]}, v={v}) not in graph")
+
+
+def min_parent_from_dist(g: Graph, source: int, dist: np.ndarray) -> np.ndarray:
+    """Deterministic min-parent tree implied by a distance array.
+
+    parent[v] = min{ u : (u, v) in E, dist[u] == dist[v] - 1 } for reached
+    v != source; source maps to itself; unreached to NO_PARENT. This is the
+    exact tree the device kernels produce (scatter-min over predecessors),
+    replacing the reference's nondeterministic atomic-race parent.
+    """
+    dist = np.asarray(dist).astype(np.int64)
+    src, dst = g.coo
+    # Predecessor candidates: edge (u, v) with dist[u] + 1 == dist[v].
+    du = dist[src]
+    dv = dist[dst]
+    ok = (du != INF_DIST) & (du + 1 == dv)
+    parent = np.full(g.num_vertices, np.iinfo(np.int64).max, dtype=np.int64)
+    np.minimum.at(parent, dst[ok], src[ok])
+    out = np.where(parent == np.iinfo(np.int64).max, NO_PARENT, parent).astype(np.int32)
+    out[dist == INF_DIST] = NO_PARENT
+    out[source] = source
+    return out

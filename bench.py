@@ -1,0 +1,83 @@
+"""Benchmark: Graph500-style BFS on a seeded RMAT graph, one real chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline target: 10 GTEPS/chip (BASELINE.json north_star). TEPS follows the
+Graph500 convention: traversed input edges / harmonic-mean time over sources.
+
+Env overrides: TPU_BFS_BENCH_SCALE (default 22), TPU_BFS_BENCH_EF (16),
+TPU_BFS_BENCH_SOURCES (8), TPU_BFS_BENCH_VALIDATE (1).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> int:
+    scale = int(os.environ.get("TPU_BFS_BENCH_SCALE", "22"))
+    ef = int(os.environ.get("TPU_BFS_BENCH_EF", "16"))
+    n_sources = int(os.environ.get("TPU_BFS_BENCH_SOURCES", "8"))
+    do_validate = os.environ.get("TPU_BFS_BENCH_VALIDATE", "1") == "1"
+
+    from tpu_bfs.algorithms.bfs import BfsEngine
+    from tpu_bfs.graph.generate import rmat_graph
+
+    t0 = time.perf_counter()
+    g = rmat_graph(scale, ef, seed=1)
+    print(
+        f"# rmat scale={scale} ef={ef}: V={g.num_vertices} slots={g.num_edges} "
+        f"gen={time.perf_counter() - t0:.1f}s",
+        file=sys.stderr,
+    )
+
+    t0 = time.perf_counter()
+    engine = BfsEngine(g)
+    # Graph500 samples search keys among non-isolated vertices.
+    rng = np.random.default_rng(7)
+    candidates = np.flatnonzero(g.degrees > 0)
+    sources = rng.choice(candidates, size=n_sources, replace=False)
+    # Warm-up / compile on the first source.
+    engine.run(int(sources[0]), with_parents=False)
+    print(f"# setup+compile {time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+    teps = []
+    for s in sources:
+        res = engine.run(int(s), with_parents=False, time_it=True)
+        teps.append(res.teps)
+        print(
+            f"# src={int(s)} t={res.elapsed_s * 1e3:.2f}ms levels={res.num_levels} "
+            f"reached={res.reached} GTEPS={res.teps / 1e9:.3f}",
+            file=sys.stderr,
+        )
+
+    if do_validate:
+        from tpu_bfs import validate
+        from tpu_bfs.reference import bfs_scipy
+
+        s0 = int(sources[0])
+        t0 = time.perf_counter()
+        validate.check_distances(
+            engine.run(s0, with_parents=False).distance, bfs_scipy(g, s0)
+        )
+        print(f"# validated src={s0} in {time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+    hmean = len(teps) / sum(1.0 / t for t in teps)
+    gteps = hmean / 1e9
+    print(
+        json.dumps(
+            {
+                "metric": f"BFS harmonic-mean GTEPS, RMAT scale-{scale} ef={ef}, 1 chip",
+                "value": round(gteps, 4),
+                "unit": "GTEPS",
+                "vs_baseline": round(gteps / 10.0, 4),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,23 +3,41 @@
 Tests run on CPU with 8 virtual XLA devices so multi-chip sharding is
 exercised without TPU hardware — the capability the reference lacks entirely
 (it cannot test its 2-node MPI path without two real nodes; SURVEY.md §4).
-Must run before the first jax import in the test process.
+
+NOTE: this environment boots a TPU PJRT plugin from sitecustomize at
+interpreter start, and that registration overrides the JAX_PLATFORMS env var.
+``jax.config.update("jax_platforms", "cpu")`` after import (but before first
+backend use) reliably forces CPU; XLA_FLAGS must be set before first use too.
+A session-scoped guard asserts the 8 virtual devices actually materialized —
+without it the distributed tests silently collapse to 1-device meshes and
+pass vacuously (the reference's own validation sin, bfs_mpi.cu:844-846).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
 import numpy as np
 import pytest
 
 from tpu_bfs.graph import io as gio
 from tpu_bfs.graph.generate import random_graph, rmat_graph
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _require_virtual_devices():
+    devs = jax.devices()
+    assert len(devs) >= 8 and devs[0].platform == "cpu", (
+        f"tests require 8 virtual CPU devices, got {devs}"
+    )
 
 
 # The reference README's implied smoke graph: tiny, undirected, connected.

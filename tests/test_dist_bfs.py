@@ -1,0 +1,114 @@
+"""Distributed BFS on a virtual 8-device CPU mesh.
+
+Exercises the multi-chip path the reference can only test with two real
+nodes (SURVEY.md §4) — partitioning, ring exchange, psum termination, parent
+merge — against the CPU golden oracle and the single-device engine.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from tpu_bfs import validate
+from tpu_bfs.algorithms.bfs import BfsEngine
+from tpu_bfs.graph.csr import INF_DIST
+from tpu_bfs.parallel.dist_bfs import DistBfsEngine, make_mesh
+from tpu_bfs.parallel.partition import Partition1D, partition_1d
+from tpu_bfs.reference import bfs_python
+
+MESH_SIZES = [1, 2, 4, 8]
+
+
+def test_partition_roundtrip(random_small):
+    part, src_st, dst_st, rp_st = partition_1d(random_small, 4)
+    v = random_small.num_vertices
+    ids = np.arange(v)
+    # Padded-id map is a strictly monotone bijection on real ids.
+    pids = part.to_padded(ids)
+    assert np.all(np.diff(pids) > 0)
+    np.testing.assert_array_equal(part.from_padded(pids), ids)
+    # Owner is remainder-correct (the reference's getDev maps tail vertices
+    # out of range when V % P != 0, bfs.cu:29-32).
+    assert part.owner(v - 1) == min(3, (v - 1) // part.cpk) < 4
+    # Every real edge lands on its source's owner chip.
+    src, dst = random_small.coo
+    for k in range(4):
+        chip_src = src_st[k]
+        real = chip_src != (k + 1) * part.vloc - 1
+        owners = chip_src[real] // part.vloc
+        assert np.all(owners == k)
+    # Total real edges preserved.
+    total = sum(
+        int((src_st[k] != (k + 1) * part.vloc - 1).sum()) for k in range(4)
+    )
+    assert total == random_small.num_edges
+    # Per-chip dst stays non-decreasing (scan backend requirement) and the
+    # row pointer is consistent with it.
+    for k in range(4):
+        assert np.all(np.diff(dst_st[k]) >= 0)
+        np.testing.assert_array_equal(
+            np.diff(rp_st[k]), np.bincount(dst_st[k], minlength=part.vp)
+        )
+
+
+@pytest.mark.parametrize("p", MESH_SIZES)
+@pytest.mark.parametrize("exchange", ["ring", "allreduce"])
+def test_dist_matches_golden(toy_graph, p, exchange):
+    eng = DistBfsEngine(toy_graph, make_mesh(p), exchange=exchange)
+    for src in [0, 5, 15]:
+        golden, _ = bfs_python(toy_graph, src)
+        res = eng.run(src)
+        validate.check_distances(res.distance, golden)
+        validate.check_parents(toy_graph, src, res.distance, res.parent)
+
+
+@pytest.mark.parametrize("exchange", ["ring", "allreduce"])
+def test_dist_random_graph(random_small, exchange):
+    eng = DistBfsEngine(random_small, make_mesh(8), exchange=exchange)
+    golden, _ = bfs_python(random_small, 3)
+    res = eng.run(3)
+    validate.check_distances(res.distance, golden)
+    validate.check_parents(random_small, 3, res.distance, res.parent)
+
+
+def test_dist_parents_match_single_device(random_small):
+    # Same deterministic min-parent tree regardless of device count.
+    single = BfsEngine(random_small).run(11)
+    multi = DistBfsEngine(random_small, make_mesh(8)).run(11)
+    np.testing.assert_array_equal(single.distance, multi.distance)
+    np.testing.assert_array_equal(single.parent, multi.parent)
+
+
+def test_dist_disconnected(random_disconnected):
+    eng = DistBfsEngine(random_disconnected, make_mesh(4))
+    golden, _ = bfs_python(random_disconnected, 0)
+    res = eng.run(0)
+    validate.check_distances(res.distance, golden)
+    assert np.all(res.parent[res.distance == INF_DIST] == -1)
+
+
+def test_dist_deep_graph(line_graph):
+    # 63 levels of 1-vertex frontiers across 8 chips.
+    eng = DistBfsEngine(line_graph, make_mesh(8))
+    res = eng.run(0)
+    np.testing.assert_array_equal(res.distance, np.arange(64))
+    assert res.num_levels == 63
+
+
+def test_dist_rmat(rmat_small):
+    eng = DistBfsEngine(rmat_small, make_mesh(8))
+    golden, _ = bfs_python(rmat_small, 1)
+    res = eng.run(1)
+    validate.check_distances(res.distance, golden)
+    validate.check_parents(rmat_small, 1, res.distance, res.parent)
+
+
+def test_dist_stats_match_single(toy_graph):
+    s = BfsEngine(toy_graph).run(0)
+    d = DistBfsEngine(toy_graph, make_mesh(2)).run(0)
+    assert (s.reached, s.edges_traversed, s.num_levels) == (
+        d.reached,
+        d.edges_traversed,
+        d.num_levels,
+    )

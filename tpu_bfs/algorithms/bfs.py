@@ -22,7 +22,7 @@ from tpu_bfs.algorithms.frontier import level_step, extract_parents, INT32_MAX
 
 
 @partial(jax.jit, static_argnames=("backend",), donate_argnums=())
-def _bfs_core(src, dst, frontier0, visited0, dist0, max_levels, *, backend):
+def _bfs_core(src, dst, in_row_ptr, frontier0, visited0, dist0, max_levels, *, backend):
     """The compiled level loop. All shapes static; source/max_levels traced."""
 
     def cond(state):
@@ -31,7 +31,7 @@ def _bfs_core(src, dst, frontier0, visited0, dist0, max_levels, *, backend):
 
     def body(state):
         frontier, visited, dist, level = state
-        new = level_step(src, dst, frontier, visited, backend=backend)
+        new = level_step(src, dst, in_row_ptr, frontier, visited, backend=backend)
         dist = jnp.where(new, level + 1, dist)
         visited = visited | new
         return new, visited, dist, level + 1
@@ -79,15 +79,22 @@ class BfsEngine:
         self,
         graph: Graph | DeviceGraph,
         *,
-        backend: str = "segment",
+        backend: str = "scan",
         device=None,
     ):
         dg = DeviceGraph.from_graph(graph) if isinstance(graph, Graph) else graph
+        if dg.ep >= 2**31 - 1:
+            raise ValueError(
+                f"{dg.ep} edge slots overflow the int32 device row pointers; "
+                "use DistBfsEngine to shard edges across chips"
+            )
         self.dg = dg
         self.backend = backend
         put = partial(jax.device_put, device=device) if device else jax.device_put
         self.src = put(jnp.asarray(dg.src))
         self.dst = put(jnp.asarray(dg.dst))
+        self.in_row_ptr = put(jnp.asarray(dg.in_row_ptr.astype(np.int32)))
+        self._warmed = False
 
     @property
     def vp(self) -> int:
@@ -105,7 +112,14 @@ class BfsEngine:
         frontier0, visited0, dist0 = self._init_state(source)
         ml = jnp.int32(max_levels if max_levels is not None else self.vp)
         return _bfs_core(
-            self.src, self.dst, frontier0, visited0, dist0, ml, backend=self.backend
+            self.src,
+            self.dst,
+            self.in_row_ptr,
+            frontier0,
+            visited0,
+            dist0,
+            ml,
+            backend=self.backend,
         )
 
     def run(
@@ -120,9 +134,11 @@ class BfsEngine:
             raise ValueError(f"source {source} out of range")
         elapsed = None
         if time_it:
-            # warm-up to exclude compilation, as the reference's chrono timings
-            # exclude initCuda2 but not compile (it has no JIT).
-            self.distances(source, max_levels=max_levels)[0].block_until_ready()
+            # One warm-up per engine to exclude compilation from timings (the
+            # jit cache is keyed on shapes, which are fixed per engine).
+            if not self._warmed:
+                self.distances(source, max_levels=max_levels)[0].block_until_ready()
+                self._warmed = True
             import time
 
             t0 = time.perf_counter()
@@ -171,7 +187,7 @@ def bfs(
     graph: Graph,
     source: int,
     *,
-    backend: str = "segment",
+    backend: str = "scan",
     with_parents: bool = True,
     max_levels: int | None = None,
 ) -> BfsResult:

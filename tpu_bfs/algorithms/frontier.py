@@ -38,28 +38,46 @@ INT32_MAX = jnp.iinfo(jnp.int32).max
 _EXPAND_BACKENDS = {}
 
 
-def expand_or(active, dst, vp: int, *, backend: str = "segment"):
-    """hit[v] = OR_{e: dst[e]==v} active[e].  ``dst`` must be non-decreasing
-    for the 'segment' backend (DeviceGraph guarantees this)."""
-    return _EXPAND_BACKENDS[backend](active, dst, vp)
+def expand_or(active, dst, in_row_ptr, vp: int, *, backend: str = "scan"):
+    """hit[v] = OR_{e: dst[e]==v} active[e].
+
+    ``dst`` must be non-decreasing for the 'scan'/'segment' backends
+    (DeviceGraph guarantees this); ``in_row_ptr`` is the [vp+1] CSR-by-dst
+    row pointer ('scan' backend only — pass None otherwise).
+    """
+    return _EXPAND_BACKENDS[backend](active, dst, in_row_ptr, vp)
 
 
-def _expand_scatter(active, dst, vp):
+def _expand_scatter(active, dst, in_row_ptr, vp):
     return jnp.zeros((vp,), jnp.bool_).at[dst].max(active, mode="drop")
 
 
-def _expand_segment(active, dst, vp):
+def _expand_segment(active, dst, in_row_ptr, vp):
     seg = jax.ops.segment_max(
         active.astype(jnp.int32), dst, num_segments=vp, indices_are_sorted=True
     )
     return seg > 0
 
 
+def _expand_scan(active, dst, in_row_ptr, vp):
+    """Scatter-free segment-OR: cumulative sum of active flags differenced at
+    CSR-by-dst row boundaries. hit[v] = csum[rp[v+1]] - csum[rp[v]] > 0.
+
+    This is the TPU-idiomatic revival of the reference's dead scan-BFS
+    pipeline (runCudaScanBfs, bfs.cu:706-781): its block prefix-sums + CPU
+    fix-up become one dense cumsum; no scatter, no atomics (SURVEY.md §3.5).
+    """
+    csum = jnp.cumsum(active.astype(jnp.int32))
+    csum0 = jnp.concatenate([jnp.zeros((1,), jnp.int32), csum])
+    return jnp.diff(csum0[in_row_ptr]) > 0
+
+
 _EXPAND_BACKENDS["scatter"] = _expand_scatter
 _EXPAND_BACKENDS["segment"] = _expand_segment
+_EXPAND_BACKENDS["scan"] = _expand_scan
 
 
-def level_step(src, dst, frontier, visited, *, backend: str = "segment"):
+def level_step(src, dst, in_row_ptr, frontier, visited, *, backend: str = "scan"):
     """One BFS level: returns the next frontier mask.
 
     Semantics of one iteration of the reference's level loop
@@ -67,7 +85,7 @@ def level_step(src, dst, frontier, visited, *, backend: str = "segment"):
     visited test folded in (`& ~visited` replaces the atomicMin claim).
     """
     active = frontier[src]
-    hit = expand_or(active, dst, frontier.shape[0], backend=backend)
+    hit = expand_or(active, dst, in_row_ptr, frontier.shape[0], backend=backend)
     return hit & ~visited
 
 

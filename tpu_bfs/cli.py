@@ -1,0 +1,149 @@
+"""Command-line entrypoint.
+
+The reference CLI is ``./a.out <srcVertex> <graphfile>`` (README.md:13), whose
+main() runs: load graph -> CPU golden BFS -> GPU BFS -> validate -> print
+timings (bfs.cu:783-823). This CLI keeps that exact flow and argument order,
+with runtime (not compile-time) configuration of device count, algorithm
+backend, and exchange — the reference hardwires DeviceNum at compile time
+(bfs.cu:19).
+
+Graph sources: a file path, or generator specs ``rmat:scale=20,ef=16,seed=1``
+/ ``random:n=100000,m=1000000,seed=12345`` (the capability of readGraph's
+generator mode, bfs.cu:892-907).
+
+Usage:
+    python -m tpu_bfs.cli 2 graph.txt
+    python -m tpu_bfs.cli 0 rmat:scale=18 --devices 1 --stats
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _parse_spec(spec: str):
+    kind, _, rest = spec.partition(":")
+    kw = {}
+    if rest:
+        for item in rest.split(","):
+            k, _, v = item.partition("=")
+            kw[k.strip()] = int(v)
+    return kind, kw
+
+
+def load_graph(spec: str):
+    from tpu_bfs.graph import generate, io
+
+    if spec.startswith("rmat:") or spec == "rmat":
+        _, kw = _parse_spec(spec)
+        return generate.rmat_graph(
+            kw.get("scale", 16),
+            kw.get("ef", 16),
+            seed=kw.get("seed", 1),
+        )
+    if spec.startswith("random:"):
+        _, kw = _parse_spec(spec)
+        return generate.random_graph(
+            kw.get("n", 1024), kw.get("m", 8192), seed=kw.get("seed", 12345)
+        )
+    if spec == "-":
+        return io.read_stdin()
+    return io.load_edge_list(spec)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpu_bfs",
+        description="TPU-native distributed BFS (capabilities of Distributed-CUDA-BFS).",
+    )
+    ap.add_argument("source", type=int, help="source vertex (reference argv[1])")
+    ap.add_argument(
+        "graph",
+        help="graph file path, '-' for stdin, or generator spec "
+        "(rmat:scale=20,ef=16 | random:n=...,m=...) (reference argv[2])",
+    )
+    ap.add_argument("--devices", type=int, default=1,
+                    help="device count; >1 uses the distributed engine (default 1)")
+    ap.add_argument("--backend", default="scan", choices=["scan", "segment", "scatter"],
+                    help="single-device frontier-expansion backend")
+    ap.add_argument("--exchange", default="ring", choices=["ring", "allreduce"],
+                    help="multi-device frontier exchange implementation")
+    ap.add_argument("--max-levels", type=int, default=None)
+    ap.add_argument("--skip-cpu", action="store_true",
+                    help="skip the CPU golden run + validation (reference always validates, bfs.cu:798-815)")
+    ap.add_argument("--no-parents", action="store_true")
+    ap.add_argument("--stats", action="store_true", help="print per-level JSON stats")
+    ap.add_argument("--repeat", type=int, default=1, help="timed repetitions")
+    ap.add_argument("--save-dist", default=None, help="save distances to .npy")
+    ap.add_argument("--save-parent", default=None, help="save parents to .npy")
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    from tpu_bfs import validate
+    from tpu_bfs.algorithms.bfs import BfsEngine
+    from tpu_bfs.graph.csr import INF_DIST
+
+    t0 = time.perf_counter()
+    g = load_graph(args.graph)
+    print(f"Number of vertices {g.num_vertices}")  # reference prints these (bfs.cu:789-790)
+    print(f"Number of edges {g.num_edges}")
+    print(f"[load] {time.perf_counter() - t0:.3f}s")
+
+    golden = None
+    if not args.skip_cpu:
+        from tpu_bfs.reference import bfs_golden
+
+        t0 = time.perf_counter()
+        golden = bfs_golden(g, args.source)
+        # Reference prints CPU elapsed ms (runCpu, bfs.cu:211-219).
+        print(f"Elapsed time in milliseconds (CPU): {(time.perf_counter() - t0) * 1e3:.2f}")
+
+    if args.devices > 1:
+        from tpu_bfs.parallel.dist_bfs import DistBfsEngine, make_mesh
+
+        engine = DistBfsEngine(
+            g, make_mesh(args.devices), exchange=args.exchange, backend=args.backend
+        )
+    else:
+        engine = BfsEngine(g, backend=args.backend)
+
+    res = None
+    for _ in range(max(1, args.repeat)):
+        res = engine.run(
+            args.source,
+            max_levels=args.max_levels,
+            with_parents=not args.no_parents,
+            time_it=True,
+        )
+        # Reference prints device elapsed ms (bfs.cu:624-626).
+        print(f"Elapsed time in milliseconds (device): {res.elapsed_s * 1e3:.3f}")
+    if res.teps:
+        print(f"Traversed edges: {res.edges_traversed}  GTEPS: {res.teps / 1e9:.4f}")
+    print(f"Reached {res.reached} vertices in {res.num_levels} levels")
+
+    if args.stats:
+        sizes = res.level_sizes()
+        for lvl, n in enumerate(sizes):
+            print(json.dumps({"level": lvl, "frontier": int(n)}))
+
+    if golden is not None:
+        # checkOutput analog (bfs.cu:374-384) — but also validates parents,
+        # which the reference never does.
+        validate.check_distances(res.distance, golden)
+        if res.parent is not None:
+            validate.check_parents(g, args.source, res.distance, res.parent)
+        print("Output OK")
+
+    if args.save_dist:
+        np.save(args.save_dist, res.distance)
+    if args.save_parent and res.parent is not None:
+        np.save(args.save_parent, res.parent)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

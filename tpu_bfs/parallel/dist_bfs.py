@@ -1,0 +1,242 @@
+"""Multi-chip distributed BFS.
+
+The TPU-native replacement for BOTH reference drivers — single-process
+multi-GPU ``runCudaQueueBfs`` (bfs.cu:542-629) and the MPI fork
+(bfs_mpi.cu:549-643) — as ONE code path: a `lax.while_loop` level loop inside
+`jax.shard_map` over a 1D device mesh. Per level, each chip:
+
+  1. expands its owned frontier over its local (source-sharded) edges into a
+     full-size contribution bitmap (the analog of the per-destination buckets,
+     bfs.cu:148-150),
+  2. reduce-scatters the bitmaps with OR over the mesh axis (replacing
+     cudaMemcpyPeer, bfs.cu:604-606, and MPI_Sendrecv, bfs_mpi.cu:615),
+  3. claims unvisited vertices in its owned slice (replacing the atomicMin
+     claim, bfs.cu:146),
+  4. psums the new-frontier popcount for global termination (replacing
+     MPI_Allreduce, bfs_mpi.cu:621, and the host-side queueSize sum,
+     bfs.cu:569).
+
+No host round-trips during the traversal — the reference crosses host<->device
+four times per level (SURVEY.md §3.1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_bfs.algorithms.bfs import BfsResult
+from tpu_bfs.algorithms.frontier import INT32_MAX, expand_or
+from tpu_bfs.graph.csr import Graph, INF_DIST
+from tpu_bfs.parallel.collectives import reduce_scatter_or, reduce_scatter_min
+from tpu_bfs.parallel.partition import Partition1D, partition_1d
+
+
+def make_mesh(num_devices: int | None = None, devices=None) -> Mesh:
+    """1D device mesh over the vertex-partition axis 'v'.
+
+    Runtime-configurable, unlike the reference's compile-time DeviceNum
+    (bfs.cu:19 — changing device count means recompiling)."""
+    if devices is None:
+        devices = jax.devices()
+        if num_devices is not None:
+            if num_devices > len(devices):
+                raise ValueError(
+                    f"requested {num_devices} devices, only {len(devices)} available"
+                )
+            devices = devices[:num_devices]
+    return Mesh(np.array(devices), ("v",))
+
+
+def _dist_bfs_fn(mesh: Mesh, p: int, vloc: int, exchange: str, backend: str):
+    """Build the shard_map'd BFS level loop for a fixed mesh/partition."""
+
+    def local_loop(src_e, dst_e, rp_e, frontier, visited, dist, max_levels):
+        # Blocks: src_e/dst_e [1, ep], rp_e [1, vp+1], vertex arrays [vloc].
+        src_e = src_e[0]
+        dst_e = dst_e[0]
+        rp_e = rp_e[0]
+        k = lax.axis_index("v")
+        src_local = src_e - k * vloc  # sources are owned: always in [0, vloc)
+        vp = p * vloc
+
+        def cond(state):
+            _, _, _, level, front_count = state
+            return (front_count > 0) & (level < max_levels)
+
+        def body(state):
+            frontier, visited, dist, level, _ = state
+            active = frontier[src_local]
+            contrib = expand_or(active, dst_e, rp_e, vp, backend=backend)
+            hit = reduce_scatter_or(contrib, "v", p, impl=exchange)
+            new = hit & ~visited
+            dist = jnp.where(new, level + 1, dist)
+            visited = visited | new
+            count = lax.psum(jnp.sum(new.astype(jnp.int32)), "v")
+            return new, visited, dist, level + 1, count
+
+        init_count = lax.psum(jnp.sum(frontier.astype(jnp.int32)), "v")
+        _, _, dist, level, _ = lax.while_loop(
+            cond, body, (frontier, visited, dist, jnp.int32(0), init_count)
+        )
+        return dist, level
+
+    return jax.jit(
+        jax.shard_map(
+            local_loop,
+            mesh=mesh,
+            in_specs=(
+                P("v", None),
+                P("v", None),
+                P("v", None),
+                P("v"),
+                P("v"),
+                P("v"),
+                P(),
+            ),
+            out_specs=(P("v"), P()),
+            check_vma=False,
+        )
+    )
+
+
+def _dist_parents_fn(mesh: Mesh, p: int, vloc: int, exchange: str):
+    """Post-loop deterministic parent extraction, distributed.
+
+    Each chip all-gathers the final (padded-id) distance vector once — the
+    analog of the reference's result merge download (finalizeCudaBfs,
+    bfs.cu:424-441) — then scatter-mins parent candidates from its local
+    edges and reduce-scatter-mins back to owners."""
+
+    def local_parents(src_e, dst_e, dist_loc):
+        src_e = src_e[0]
+        dst_e = dst_e[0]
+        vp = p * vloc
+        dist_full = lax.all_gather(dist_loc, "v", tiled=True)  # [vp]
+        du = dist_full[src_e]
+        ok = (du != INT32_MAX) & (du + 1 == dist_full[dst_e])
+        cand = jnp.where(ok, src_e, INT32_MAX)
+        contrib = (
+            jnp.full((vp,), INT32_MAX, jnp.int32).at[dst_e].min(cand, mode="drop")
+        )
+        parent_loc = reduce_scatter_min(contrib, "v", p, impl=exchange)
+        parent_loc = jnp.where(parent_loc == INT32_MAX, -1, parent_loc)
+        return jnp.where(dist_loc == INT32_MAX, -1, parent_loc)
+
+    return jax.jit(
+        jax.shard_map(
+            local_parents,
+            mesh=mesh,
+            in_specs=(P("v", None), P("v", None), P("v")),
+            out_specs=P("v"),
+            check_vma=False,
+        )
+    )
+
+
+class DistBfsEngine:
+    """Multi-chip BFS over a 1D vertex partition.
+
+    Usage mirrors BfsEngine but scales over a mesh; with a 1-device mesh it
+    degrades to the single-chip path (the reference instead forks a whole
+    second file for multi-node, bfs_mpi.cu)."""
+
+    def __init__(
+        self,
+        graph: Graph,
+        mesh: Mesh | None = None,
+        *,
+        num_devices: int | None = None,
+        exchange: str = "ring",
+        backend: str = "scan",
+    ):
+        self.mesh = mesh if mesh is not None else make_mesh(num_devices)
+        self.p = self.mesh.devices.size
+        self.graph_meta = (graph.num_input_edges, graph.undirected)
+        part, src_stacked, dst_stacked, rp_stacked = partition_1d(graph, self.p)
+        self.part = part
+        self._degrees = graph.degrees  # host copy for TEPS accounting
+        edge_sharding = NamedSharding(self.mesh, P("v", None))
+        self.src = jax.device_put(src_stacked, edge_sharding)
+        self.dst = jax.device_put(dst_stacked, edge_sharding)
+        self.rp = jax.device_put(rp_stacked, edge_sharding)
+        self._vec_sharding = NamedSharding(self.mesh, P("v"))
+        self._loop = _dist_bfs_fn(self.mesh, self.p, part.vloc, exchange, backend)
+        self._parents = _dist_parents_fn(self.mesh, self.p, part.vloc, exchange)
+        self._warmed = False
+
+    def _init_state(self, source: int):
+        part = self.part
+        pid = int(part.to_padded(source))
+        frontier0 = np.zeros(part.vp, dtype=bool)
+        frontier0[pid] = True
+        dist0 = np.full(part.vp, INF_DIST, dtype=np.int32)
+        dist0[pid] = 0
+        put = partial(jax.device_put, device=self._vec_sharding)
+        return put(frontier0), put(frontier0.copy()), put(dist0)
+
+    def distances_padded(self, source: int, *, max_levels: int | None = None):
+        """Device (padded-id, sharded) distance vector + level counter."""
+        frontier0, visited0, dist0 = self._init_state(source)
+        ml = jnp.int32(max_levels if max_levels is not None else self.part.vp)
+        return self._loop(self.src, self.dst, self.rp, frontier0, visited0, dist0, ml)
+
+    def run(
+        self,
+        source: int,
+        *,
+        max_levels: int | None = None,
+        with_parents: bool = True,
+        time_it: bool = False,
+    ) -> BfsResult:
+        part = self.part
+        if not (0 <= source < part.num_vertices):
+            raise ValueError(f"source {source} out of range")
+        elapsed = None
+        if time_it:
+            if not self._warmed:
+                self.distances_padded(source, max_levels=max_levels)[0].block_until_ready()
+                self._warmed = True
+            import time
+
+            t0 = time.perf_counter()
+            dist_dev, _ = self.distances_padded(source, max_levels=max_levels)
+            dist_dev.block_until_ready()
+            elapsed = time.perf_counter() - t0
+        else:
+            dist_dev, _ = self.distances_padded(source, max_levels=max_levels)
+
+        parent = None
+        if with_parents:
+            parent_dev = self._parents(self.src, self.dst, dist_dev)
+            parent_pad = part.unshard(np.asarray(parent_dev))
+            # Padded ids -> real ids; -1 passes through; source -> itself.
+            parent = np.where(
+                parent_pad >= 0, part.from_padded(np.abs(parent_pad)), -1
+            ).astype(np.int32)
+            parent[source] = source
+
+        dist = part.unshard(np.asarray(dist_dev))
+        reached_mask = dist != INF_DIST
+        reached = int(reached_mask.sum())
+        num_levels = int(dist[reached_mask].max()) if reached else 0
+        m_in, undirected = self.graph_meta
+        # TEPS numerator from reached degrees: sum of degrees over reached
+        # vertices counts each traversed slot once from its source side.
+        slots = int(self._degrees[reached_mask].sum()) if reached else 0
+        edges = slots // 2 if undirected else slots
+        return BfsResult(
+            source=source,
+            distance=dist,
+            parent=parent,
+            num_levels=num_levels,
+            reached=reached,
+            edges_traversed=edges,
+            elapsed_s=elapsed,
+        )

@@ -1,0 +1,123 @@
+"""1D contiguous vertex partitioning.
+
+The reference's ownership map is ``getDev(v) = v / (numVertices / DeviceNum)``
+(bfs.cu:29-32) — with a known bug: when ``V % DeviceNum != 0`` the tail
+vertices map to an out-of-range device (SURVEY.md §2a row 7). Here the
+partition is ``owner(v) = v // ceil(V / P)``, remainder-correct by
+construction.
+
+Vertex ids are remapped into a *padded id space* so that every chip's local
+range ends with phantom slots: chip k owns real ids [k*cpk, (k+1)*cpk) and
+padded ids [k*vloc, (k+1)*vloc) with vloc > cpk. Phantoms absorb padding edges
+chip-locally (each chip pads with self-loops on its own phantom), and the
+padded-id map is strictly monotone, so min-parent determinism is preserved
+across device counts. Unlike the reference — which replicates the full CSR to
+every device (initCuda2, bfs.cu:346-351) and therefore scales work but not
+memory — edges are sharded by the owner of their source vertex.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from tpu_bfs.graph.csr import Graph, EDGE_PAD, _round_up
+
+
+@dataclasses.dataclass(frozen=True)
+class Partition1D:
+    num_devices: int
+    num_vertices: int  # real V
+    cpk: int  # real vertices per chip (ceil(V / P))
+    vloc: int  # padded local vertex count (> cpk, multiple of lane tile)
+    ep_chip: int  # padded edges per chip (common max, multiple of EDGE_PAD)
+
+    @property
+    def vp(self) -> int:
+        """Total padded vertex-id space."""
+        return self.num_devices * self.vloc
+
+    def owner(self, v):
+        """Owning chip of real vertex v (reference getDev, bfs.cu:29-32,
+        remainder-correct)."""
+        return np.asarray(v) // self.cpk
+
+    def to_padded(self, v):
+        """Real vertex id -> padded id."""
+        v = np.asarray(v)
+        return (v // self.cpk) * self.vloc + v % self.cpk
+
+    def from_padded(self, pid):
+        """Padded id -> real vertex id (phantoms map out of range)."""
+        pid = np.asarray(pid)
+        return (pid // self.vloc) * self.cpk + pid % self.vloc
+
+    def unshard(self, arr_vp: np.ndarray) -> np.ndarray:
+        """[vp] padded-id-space array -> [V] real-id-space array."""
+        per_chip = np.asarray(arr_vp).reshape(self.num_devices, self.vloc)
+        return per_chip[:, : self.cpk].reshape(-1)[: self.num_vertices]
+
+
+def partition_1d(
+    graph: Graph,
+    num_devices: int,
+    *,
+    vertex_pad: int = 1024,
+    edge_pad: int = EDGE_PAD,
+) -> tuple[Partition1D, np.ndarray, np.ndarray, np.ndarray]:
+    """Shard a graph's edges by source owner over ``num_devices`` chips.
+
+    Returns (partition, src_stacked, dst_stacked, rp_stacked): the stacked
+    edge arrays are [P, ep_chip] int32 in *padded* vertex ids, each chip's
+    slice sorted by (dst, src); padding edges run from the chip's own phantom
+    source to the globally-last phantom (vp-1), preserving dst order so the
+    scatter-free scan expansion works per chip. rp_stacked is the per-chip
+    CSR-by-dst row pointer [P, vp+1] int32. This replaces the reference's
+    full-CSR replication (bfs.cu:346-351) with true edge sharding; the
+    per-destination frontier "buckets" (bfs.cu:148-150) are not materialized —
+    destination routing happens in the reduce-scatter exchange.
+    """
+    v, p = graph.num_vertices, num_devices
+    if p < 1:
+        raise ValueError("num_devices must be >= 1")
+    cpk = (v + p - 1) // p
+    vloc = _round_up(cpk + 1, vertex_pad)
+    part_src, part_dst = graph.coo
+    owner = part_src.astype(np.int64) // cpk
+    psrc = (part_src.astype(np.int64) // cpk) * vloc + part_src % cpk
+    pdst = (part_dst.astype(np.int64) // cpk) * vloc + part_dst % cpk
+
+    counts = np.bincount(owner, minlength=p)
+    ep_chip = _round_up(int(counts.max(initial=0)) + 1, edge_pad)
+    if ep_chip >= 2**31 - 1:
+        raise ValueError(
+            f"{ep_chip} edge slots on one chip overflow int32 row pointers; "
+            "increase the device count"
+        )
+    part = Partition1D(
+        num_devices=p, num_vertices=v, cpk=cpk, vloc=vloc, ep_chip=ep_chip
+    )
+    vp = part.vp
+
+    # Order edges by (owner, dst, src); then slice per chip.
+    order = np.lexsort((psrc, pdst, owner))
+    owner_s = owner[order]
+    psrc_s = psrc[order]
+    pdst_s = pdst[order]
+    starts = np.searchsorted(owner_s, np.arange(p))
+    ends = np.searchsorted(owner_s, np.arange(p), side="right")
+    src_stacked = np.empty((p, ep_chip), dtype=np.int32)
+    dst_stacked = np.empty((p, ep_chip), dtype=np.int32)
+    rp_stacked = np.empty((p, vp + 1), dtype=np.int32)
+    for k in range(p):
+        phantom = (k + 1) * vloc - 1  # chip k's own last (phantom) slot
+        n_k = ends[k] - starts[k]
+        src_stacked[k, :n_k] = psrc_s[starts[k] : ends[k]]
+        dst_stacked[k, :n_k] = pdst_s[starts[k] : ends[k]]
+        src_stacked[k, n_k:] = phantom
+        dst_stacked[k, n_k:] = vp - 1  # last phantom: keeps dst non-decreasing
+        cnt = np.bincount(dst_stacked[k].astype(np.int64), minlength=vp)
+        rp_stacked[k, 0] = 0
+        rp_stacked[k, 1:] = np.cumsum(cnt)
+    return part, src_stacked, dst_stacked, rp_stacked

@@ -1,0 +1,45 @@
+"""Batched multi-source BFS vs the single-source engines and golden oracle."""
+
+import numpy as np
+import pytest
+
+from tpu_bfs import validate
+from tpu_bfs.algorithms.bfs import BfsEngine
+from tpu_bfs.algorithms.msbfs import MsBfsEngine
+from tpu_bfs.reference import bfs_python
+
+
+@pytest.mark.parametrize("backend", ["scan", "scatter"])
+def test_msbfs_matches_golden(random_small, backend):
+    eng = MsBfsEngine(random_small, backend=backend)
+    sources = np.array([0, 7, 123, 499])
+    res = eng.run(sources, with_parents=True)
+    for i, s in enumerate(sources):
+        golden, _ = bfs_python(random_small, int(s))
+        validate.check_distances(res.distance[i], golden)
+        validate.check_parents(random_small, int(s), res.distance[i], res.parent[i])
+
+
+def test_msbfs_matches_single_engine(rmat_small):
+    single = BfsEngine(rmat_small)
+    eng = MsBfsEngine(rmat_small)
+    sources = np.array([1, 2, 3])
+    res = eng.run(sources, with_parents=True)
+    for i, s in enumerate(sources):
+        r1 = single.run(int(s))
+        np.testing.assert_array_equal(res.distance[i], r1.distance)
+        np.testing.assert_array_equal(res.parent[i], r1.parent)
+
+
+def test_msbfs_duplicate_sources(toy_graph):
+    eng = MsBfsEngine(toy_graph)
+    res = eng.run(np.array([4, 4]))
+    np.testing.assert_array_equal(res.distance[0], res.distance[1])
+
+
+def test_msbfs_bad_sources(toy_graph):
+    eng = MsBfsEngine(toy_graph)
+    with pytest.raises(ValueError):
+        eng.run(np.array([99]))
+    with pytest.raises(ValueError):
+        eng.run(np.array([], dtype=np.int32))

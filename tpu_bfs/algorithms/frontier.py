@@ -41,15 +41,22 @@ _EXPAND_BACKENDS = {}
 def expand_or(active, dst, in_row_ptr, vp: int, *, backend: str = "scan"):
     """hit[v] = OR_{e: dst[e]==v} active[e].
 
-    ``dst`` must be non-decreasing for the 'scan'/'segment' backends
-    (DeviceGraph guarantees this); ``in_row_ptr`` is the [vp+1] CSR-by-dst
-    row pointer ('scan' backend only — pass None otherwise).
+    ``active`` is [ep] or [ep, K] (batched multi-source); the edge axis is
+    leading either way. ``dst`` must be non-decreasing for the
+    'scan'/'segment' backends (DeviceGraph guarantees this); ``in_row_ptr``
+    is the [vp+1] CSR-by-dst row pointer ('scan' backend only — pass None
+    otherwise).
     """
+    if backend not in _EXPAND_BACKENDS:
+        raise KeyError(
+            f"unknown expansion backend {backend!r}; have {sorted(_EXPAND_BACKENDS)}"
+        )
     return _EXPAND_BACKENDS[backend](active, dst, in_row_ptr, vp)
 
 
 def _expand_scatter(active, dst, in_row_ptr, vp):
-    return jnp.zeros((vp,), jnp.bool_).at[dst].max(active, mode="drop")
+    out_shape = (vp,) + active.shape[1:]
+    return jnp.zeros(out_shape, jnp.bool_).at[dst].max(active, mode="drop")
 
 
 def _expand_segment(active, dst, in_row_ptr, vp):
@@ -67,9 +74,10 @@ def _expand_scan(active, dst, in_row_ptr, vp):
     pipeline (runCudaScanBfs, bfs.cu:706-781): its block prefix-sums + CPU
     fix-up become one dense cumsum; no scatter, no atomics (SURVEY.md §3.5).
     """
-    csum = jnp.cumsum(active.astype(jnp.int32))
-    csum0 = jnp.concatenate([jnp.zeros((1,), jnp.int32), csum])
-    return jnp.diff(csum0[in_row_ptr]) > 0
+    csum = jnp.cumsum(active.astype(jnp.int32), axis=0)
+    zero = jnp.zeros((1,) + active.shape[1:], jnp.int32)
+    csum0 = jnp.concatenate([zero, csum], axis=0)
+    return jnp.diff(csum0[in_row_ptr], axis=0) > 0
 
 
 _EXPAND_BACKENDS["scatter"] = _expand_scatter
@@ -89,16 +97,25 @@ def level_step(src, dst, in_row_ptr, frontier, visited, *, backend: str = "scan"
     return hit & ~visited
 
 
-@partial(jax.jit, static_argnames=("vp",))
-def _extract_parents_impl(src, dst, dist, source, vp: int):
+def min_parent_candidates(src, dst, dist):
+    """Deterministic min-parent from a distance array, without source fixup.
+
+    dist is [vp] or [vp, K]; parent[v] = min{u : (u,v) in E, dist[u] ==
+    dist[v]-1}, -1 where unreached or parentless. The single scatter-min
+    replaces the reference's atomic-race parent claim (bfs.cu:146-147)."""
     du = dist[src]
     dv = dist[dst]
     ok = (du != INT32_MAX) & (du + 1 == dv)
-    cand = jnp.where(ok, src, INT32_MAX)
-    parent = jnp.full((vp,), INT32_MAX, jnp.int32).at[dst].min(cand, mode="drop")
+    src_b = src if dist.ndim == 1 else src[:, None]
+    cand = jnp.where(ok, src_b, INT32_MAX)
+    parent = jnp.full(dist.shape, INT32_MAX, jnp.int32).at[dst].min(cand, mode="drop")
     parent = jnp.where(parent == INT32_MAX, -1, parent)
-    parent = jnp.where(dist == INT32_MAX, -1, parent)
-    return parent.at[source].set(source)
+    return jnp.where(dist == INT32_MAX, -1, parent)
+
+
+@partial(jax.jit, static_argnames=("vp",))
+def _extract_parents_impl(src, dst, dist, source, vp: int):
+    return min_parent_candidates(src, dst, dist).at[source].set(source)
 
 
 def extract_parents(src, dst, dist, source):

@@ -32,6 +32,20 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
+def _lexsort_pairs(major: np.ndarray, minor: np.ndarray, n: int) -> np.ndarray:
+    """Permutation ordering by (major, minor): native O(E) counting sort when
+    built (native/loader.cpp), np.lexsort otherwise."""
+    try:
+        from tpu_bfs.utils.native import lexsort_pairs
+
+        perm = lexsort_pairs(major, minor, n, n)
+        if perm is not None:
+            return perm
+    except Exception:
+        pass
+    return np.lexsort((minor, major))
+
+
 @dataclasses.dataclass(frozen=True)
 class Graph:
     """Host-side CSR graph (0-indexed, directed edge slots).
@@ -116,7 +130,7 @@ def build_csr(
         raise ValueError("dst vertex id out of range")
 
     if sort_neighbors:
-        order = np.lexsort((dst, src))
+        order = _lexsort_pairs(src, dst, num_vertices)
     else:
         order = np.argsort(src, kind="stable")
     src_sorted = src[order]
@@ -165,7 +179,7 @@ class DeviceGraph:
         vp = _round_up(v + 1, vertex_pad)
         ep = _round_up(max(e, 1), edge_pad)
         src, dst = g.coo
-        order = np.lexsort((src, dst))  # dst-major, src-minor
+        order = _lexsort_pairs(dst, src, v)  # dst-major, src-minor
         src_p = np.full(ep, vp - 1, dtype=np.int32)
         dst_p = np.full(ep, vp - 1, dtype=np.int32)
         src_p[:e] = src[order]

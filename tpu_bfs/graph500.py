@@ -1,0 +1,146 @@
+"""Graph500-style BFS benchmark harness.
+
+Beyond-parity capability (SURVEY.md §7 checklist item 8; BASELINE.json
+configs): seeded Kronecker/RMAT generation, 64 random search keys, per-search
+validation (the reference validates only against a CPU rerun of the same
+traversal, bfs.cu:798-815; Graph500 validation checks the BFS-tree properties
+directly), and harmonic-mean TEPS reporting.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import numpy as np
+
+from tpu_bfs import validate
+from tpu_bfs.algorithms.bfs import BfsEngine
+from tpu_bfs.algorithms.msbfs import MsBfsEngine
+from tpu_bfs.graph.csr import Graph, INF_DIST
+from tpu_bfs.graph.generate import rmat_graph
+
+
+@dataclasses.dataclass
+class Graph500Result:
+    scale: int
+    edge_factor: int
+    num_searches: int
+    teps: list[float]  # per-search TEPS
+    validated: bool
+    mode: str  # 'single' | 'batched'
+
+    @property
+    def harmonic_mean_teps(self) -> float:
+        return len(self.teps) / sum(1.0 / t for t in self.teps)
+
+
+def sample_search_keys(g: Graph, n: int, *, seed: int = 2) -> np.ndarray:
+    """Graph500 samples search keys uniformly among vertices with degree > 0."""
+    rng = np.random.default_rng(seed)
+    candidates = np.flatnonzero(g.degrees > 0)
+    return rng.choice(candidates, size=min(n, len(candidates)), replace=False)
+
+
+def traversed_edges(g: Graph, dist: np.ndarray) -> int:
+    """Graph500 TEPS numerator: input edges with both endpoints reached."""
+    reached = dist != INF_DIST
+    slots = int(reached[g.coo[0]].sum())  # dst also reached for a full BFS
+    return slots // 2 if g.undirected else slots
+
+
+def run_graph500(
+    scale: int,
+    edge_factor: int = 16,
+    *,
+    seed: int = 1,
+    num_searches: int = 64,
+    mode: str = "single",
+    validate_searches: int = 4,
+    engine_cls=None,
+    verbose: bool = False,
+) -> Graph500Result:
+    """Generate, run, validate, and score a Graph500-style BFS benchmark.
+
+    mode='single': one traversal at a time (the official kernel-2 shape).
+    mode='batched': all searches in one MsBfs batch; per-search TEPS is then
+    the aggregate time split evenly (reported as such — not comparable with
+    official single-stream numbers, but the right way to use a TPU when the
+    workload has many sources).
+    """
+    g = rmat_graph(scale, edge_factor, seed=seed)
+    keys = sample_search_keys(g, num_searches)
+
+    teps = []
+    if mode == "batched":
+        eng = MsBfsEngine(g) if engine_cls is None else engine_cls(g)
+        res = eng.run(keys, time_it=True)
+        per_search = res.elapsed_s / len(keys)  # equal time share per search
+        for i in range(len(keys)):
+            teps.append(traversed_edges(g, res.distance[i]) / per_search)
+        dists = res.distance
+    else:
+        eng = BfsEngine(g) if engine_cls is None else engine_cls(g)
+        dists = []
+        for s in keys:
+            r = eng.run(int(s), with_parents=False, time_it=True)
+            teps.append(r.edges_traversed / r.elapsed_s)
+            dists.append(r.distance)
+            if verbose:
+                print(
+                    f"  src={int(s)} t={r.elapsed_s * 1e3:.2f}ms "
+                    f"GTEPS={teps[-1] / 1e9:.3f}"
+                )
+        dists = np.stack(dists)
+
+    # Validation: distances against the scipy oracle + parent properties via
+    # the deterministic min-parent tree, on a sample of searches.
+    from tpu_bfs.reference import bfs_scipy
+
+    n_validate = min(validate_searches, len(keys))
+    for i in range(n_validate):
+        s = int(keys[i])
+        validate.check_distances(dists[i], bfs_scipy(g, s))
+        mp = validate.min_parent_from_dist(g, s, dists[i])
+        validate.check_parents(g, s, dists[i], mp)
+    return Graph500Result(
+        scale=scale,
+        edge_factor=edge_factor,
+        num_searches=len(keys),
+        teps=teps,
+        validated=n_validate > 0,  # checks raise on mismatch
+        mode=mode,
+    )
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="tpu_bfs.graph500")
+    ap.add_argument("--scale", type=int, default=16)
+    ap.add_argument("--ef", type=int, default=16)
+    ap.add_argument("--searches", type=int, default=64)
+    ap.add_argument("--mode", choices=["single", "batched"], default="single")
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--validate", type=int, default=4, metavar="N",
+                    help="validate the first N searches (0 to skip)")
+    args = ap.parse_args(argv)
+    res = run_graph500(
+        args.scale,
+        args.ef,
+        seed=args.seed,
+        num_searches=args.searches,
+        mode=args.mode,
+        validate_searches=args.validate,
+        verbose=True,
+    )
+    print(
+        f"graph500 scale={res.scale} ef={res.edge_factor} mode={res.mode} "
+        f"searches={res.num_searches} validated={res.validated} "
+        f"harmonic_mean_GTEPS={res.harmonic_mean_teps / 1e9:.4f}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -39,10 +39,19 @@ def _find_lib():
                     ctypes.POINTER(ctypes.POINTER(ctypes.c_longlong)),  # out v
                 ]
                 lib.tpubfs_free.argtypes = [ctypes.POINTER(ctypes.c_longlong)]
+                lib.tpubfs_lexsort_pairs.restype = ctypes.c_longlong
+                lib.tpubfs_lexsort_pairs.argtypes = [
+                    ctypes.POINTER(ctypes.c_longlong),
+                    ctypes.POINTER(ctypes.c_longlong),
+                    ctypes.c_longlong,
+                    ctypes.c_longlong,
+                    ctypes.c_longlong,
+                    ctypes.POINTER(ctypes.c_longlong),
+                ]
                 _LIB = lib
                 break
-            except OSError:
-                pass
+            except (OSError, AttributeError):
+                pass  # missing lib or stale build without newer symbols
     return _LIB
 
 
@@ -79,3 +88,28 @@ def load_edge_list_native(path: str, *, directed: bool = False, drop_self_loops:
     return from_edges(
         u, v, num_vertices=int(n.value), directed=directed, num_input_edges=int(m.value)
     )
+
+
+def lexsort_pairs(major: np.ndarray, minor: np.ndarray, n_major: int, n_minor: int):
+    """Permutation ordering by (major, minor) ascending — np.lexsort((minor,
+    major)) semantics via an O(E) native counting sort. Returns None if the
+    native library is unavailable (callers fall back to np.lexsort)."""
+    lib = _find_lib()
+    if lib is None:
+        return None
+    major = np.ascontiguousarray(major, dtype=np.int64)
+    minor = np.ascontiguousarray(minor, dtype=np.int64)
+    e = len(major)
+    perm = np.empty(e, dtype=np.int64)
+    ll = ctypes.POINTER(ctypes.c_longlong)
+    rc = lib.tpubfs_lexsort_pairs(
+        major.ctypes.data_as(ll),
+        minor.ctypes.data_as(ll),
+        e,
+        int(n_major),
+        int(n_minor),
+        perm.ctypes.data_as(ll),
+    )
+    if rc != 0:
+        return None
+    return perm

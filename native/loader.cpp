@@ -85,9 +85,15 @@ int64_t tpubfs_parse_edge_list(const char* path, int64_t* out_n,
                                  int64_t** out_v) {
   FILE* f = fopen(path, "rb");
   if (!f) return 1;
-  fseek(f, 0, SEEK_END);
+  if (fseek(f, 0, SEEK_END) != 0) {  // unseekable (FIFO/pipe): refuse cleanly
+    fclose(f);
+    return 1;
+  }
   long size = ftell(f);
-  fseek(f, 0, SEEK_SET);
+  if (size < 0 || fseek(f, 0, SEEK_SET) != 0) {
+    fclose(f);
+    return 1;
+  }
   char* buf = static_cast<char*>(malloc(size + 1));
   if (!buf) {
     fclose(f);

@@ -19,6 +19,7 @@ import numpy as np
 
 from tpu_bfs.graph.csr import Graph, DeviceGraph, INF_DIST
 from tpu_bfs.algorithms.frontier import level_step, extract_parents, INT32_MAX
+from tpu_bfs.utils.timing import run_timed
 
 
 @partial(jax.jit, static_argnames=("backend",), donate_argnums=())
@@ -134,17 +135,11 @@ class BfsEngine:
             raise ValueError(f"source {source} out of range")
         elapsed = None
         if time_it:
-            # One warm-up per engine to exclude compilation from timings (the
-            # jit cache is keyed on shapes, which are fixed per engine).
-            if not self._warmed:
-                self.distances(source, max_levels=max_levels)[0].block_until_ready()
-                self._warmed = True
-            import time
-
-            t0 = time.perf_counter()
-            dist_dev, level = self.distances(source, max_levels=max_levels)
-            dist_dev.block_until_ready()
-            elapsed = time.perf_counter() - t0
+            (dist_dev, level), elapsed = run_timed(
+                lambda: self.distances(source, max_levels=max_levels),
+                warm=not self._warmed,
+            )
+            self._warmed = True
         else:
             dist_dev, level = self.distances(source, max_levels=max_levels)
 

@@ -113,8 +113,8 @@ def min_parent_candidates(src, dst, dist):
     return jnp.where(dist == INT32_MAX, -1, parent)
 
 
-@partial(jax.jit, static_argnames=("vp",))
-def _extract_parents_impl(src, dst, dist, source, vp: int):
+@jax.jit
+def _extract_parents_impl(src, dst, dist, source):
     return min_parent_candidates(src, dst, dist).at[source].set(source)
 
 
@@ -124,4 +124,4 @@ def extract_parents(src, dst, dist, source):
     parent[v] = min{ u : (u,v) in E, dist[u] = dist[v]-1 }; source -> itself;
     unreached -> -1. One O(E) scatter-min, outside the hot loop.
     """
-    return _extract_parents_impl(src, dst, dist, source, dist.shape[0])
+    return _extract_parents_impl(src, dst, dist, source)

@@ -23,6 +23,7 @@ import numpy as np
 
 from tpu_bfs.graph.csr import Graph, DeviceGraph, INF_DIST
 from tpu_bfs.algorithms.frontier import INT32_MAX, expand_or, min_parent_candidates
+from tpu_bfs.utils.timing import run_timed
 
 
 @partial(jax.jit, static_argnames=("backend",))
@@ -119,15 +120,11 @@ class MsBfsEngine:
         elapsed = None
         if time_it:
             k = len(sources)
-            if k not in self._warmed_k:
-                self.distances(sources, max_levels=max_levels)[0].block_until_ready()
-                self._warmed_k.add(k)
-            import time
-
-            t0 = time.perf_counter()
-            dist_dev, _ = self.distances(sources, max_levels=max_levels)
-            dist_dev.block_until_ready()
-            elapsed = time.perf_counter() - t0
+            (dist_dev, _), elapsed = run_timed(
+                lambda: self.distances(sources, max_levels=max_levels),
+                warm=k not in self._warmed_k,
+            )
+            self._warmed_k.add(k)
         else:
             dist_dev, _ = self.distances(sources, max_levels=max_levels)
 

@@ -67,6 +67,9 @@ def main(argv=None) -> int:
     )
     ap.add_argument("--devices", type=int, default=1,
                     help="device count; >1 uses the distributed engine (default 1)")
+    ap.add_argument("--mesh", default=None, metavar="RxC",
+                    help="2D mesh shape (e.g. 2x4): uses the 2D edge partition "
+                    "engine instead of the 1D vertex partition")
     ap.add_argument("--backend", default="scan", choices=["scan", "segment", "scatter"],
                     help="single-device frontier-expansion backend")
     ap.add_argument("--exchange", default="ring", choices=["ring", "allreduce"],
@@ -102,7 +105,20 @@ def main(argv=None) -> int:
         # Reference prints CPU elapsed ms (runCpu, bfs.cu:211-219).
         print(f"Elapsed time in milliseconds (CPU): {(time.perf_counter() - t0) * 1e3:.2f}")
 
-    if args.devices > 1:
+    if args.mesh:
+        from tpu_bfs.parallel.dist_bfs2d import Dist2DBfsEngine, make_mesh_2d
+
+        try:
+            r, c = (int(t) for t in args.mesh.lower().split("x"))
+        except ValueError:
+            ap.error(f"--mesh must look like RxC (e.g. 2x4), got {args.mesh!r}")
+        engine = Dist2DBfsEngine(
+            g,
+            make_mesh_2d(r, c),
+            exchange=args.exchange,
+            backend=args.backend,
+        )
+    elif args.devices > 1:
         from tpu_bfs.parallel.dist_bfs import DistBfsEngine, make_mesh
 
         engine = DistBfsEngine(

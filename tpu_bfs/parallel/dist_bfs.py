@@ -36,6 +36,7 @@ from tpu_bfs.algorithms.frontier import INT32_MAX, expand_or
 from tpu_bfs.graph.csr import Graph, INF_DIST
 from tpu_bfs.parallel.collectives import reduce_scatter_or, reduce_scatter_min
 from tpu_bfs.parallel.partition import Partition1D, partition_1d
+from tpu_bfs.utils.timing import run_timed
 
 
 def make_mesh(num_devices: int | None = None, devices=None) -> Mesh:
@@ -200,15 +201,11 @@ class DistBfsEngine:
             raise ValueError(f"source {source} out of range")
         elapsed = None
         if time_it:
-            if not self._warmed:
-                self.distances_padded(source, max_levels=max_levels)[0].block_until_ready()
-                self._warmed = True
-            import time
-
-            t0 = time.perf_counter()
-            dist_dev, _ = self.distances_padded(source, max_levels=max_levels)
-            dist_dev.block_until_ready()
-            elapsed = time.perf_counter() - t0
+            (dist_dev, _), elapsed = run_timed(
+                lambda: self.distances_padded(source, max_levels=max_levels),
+                warm=not self._warmed,
+            )
+            self._warmed = True
         else:
             dist_dev, _ = self.distances_padded(source, max_levels=max_levels)
 

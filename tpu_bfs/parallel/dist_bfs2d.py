@@ -1,0 +1,233 @@
+"""Multi-chip BFS over a 2D (R x C) edge partition.
+
+The scale-out path the reference lacks (its only distribution mode replicates
+the full CSR per device and partitions ownership 1D, bfs.cu:29-32, 346-351;
+SURVEY.md §2c flags 2D partitioning as the gap to close for Graph500 scales).
+Level structure (see partition2d):
+
+    col all-gather (ICI, 'r' axis)  ->  local expand  ->
+    row OR-reduce-scatter (ICI, 'c' axis)  ->  claim owned slice  ->
+    psum termination over the whole mesh
+
+Both collectives move O(vp/mesh-dimension) bits per chip instead of the 1D
+exchange's O(vp).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_bfs.algorithms.bfs import BfsResult
+from tpu_bfs.algorithms.frontier import INT32_MAX, expand_or
+from tpu_bfs.graph.csr import Graph, INF_DIST
+from tpu_bfs.parallel.collectives import reduce_scatter_or, reduce_scatter_min
+from tpu_bfs.parallel.partition2d import Partition2D, partition_2d
+from tpu_bfs.utils.timing import run_timed
+
+
+def make_mesh_2d(rows: int, cols: int, devices=None) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+    if rows * cols > len(devices):
+        raise ValueError(f"mesh {rows}x{cols} needs {rows * cols} devices")
+    arr = np.array(devices[: rows * cols]).reshape(rows, cols)
+    return Mesh(arr, ("r", "c"))
+
+
+def _dist2d_bfs_fn(mesh: Mesh, rows: int, cols: int, w: int, exchange: str,
+                   backend: str):
+    row_block = cols * w
+
+    def local_loop(src_g, dst_l, rp_l, frontier, visited, dist, max_levels):
+        src_g = src_g[0, 0]
+        dst_l = dst_l[0, 0]
+        rp_l = rp_l[0, 0]
+
+        def cond(state):
+            _, _, _, level, count = state
+            return (count > 0) & (level < max_levels)
+
+        def body(state):
+            frontier, visited, dist, level, _ = state
+            # Column exchange: assemble this mesh column's frontier slices.
+            col_frontier = lax.all_gather(frontier, "r", tiled=True)  # [R*w]
+            active = col_frontier[src_g]
+            contrib = expand_or(active, dst_l, rp_l, row_block, backend=backend)
+            # Row exchange: combine row-block contributions, keep own chunk.
+            hit = reduce_scatter_or(contrib, "c", cols, impl=exchange)
+            new = hit & ~visited
+            dist = jnp.where(new, level + 1, dist)
+            visited = visited | new
+            count = lax.psum(jnp.sum(new.astype(jnp.int32)), ("r", "c"))
+            return new, visited, dist, level + 1, count
+
+        init = lax.psum(jnp.sum(frontier.astype(jnp.int32)), ("r", "c"))
+        _, _, dist, level, _ = lax.while_loop(
+            cond, body, (frontier, visited, dist, jnp.int32(0), init)
+        )
+        return dist, level
+
+    return jax.jit(
+        jax.shard_map(
+            local_loop,
+            mesh=mesh,
+            in_specs=(
+                P("r", "c", None),
+                P("r", "c", None),
+                P("r", "c", None),
+                P(("r", "c")),
+                P(("r", "c")),
+                P(("r", "c")),
+                P(),
+            ),
+            out_specs=(P(("r", "c")), P()),
+            check_vma=False,
+        )
+    )
+
+
+def _dist2d_parents_fn(mesh: Mesh, rows: int, cols: int, w: int, exchange: str):
+    row_block = cols * w
+
+    def local_parents(src_g, dst_l, dist_loc):
+        src_g = src_g[0, 0]
+        dst_l = dst_l[0, 0]
+        i = lax.axis_index("r")
+        j = lax.axis_index("c")
+        dist_full = lax.all_gather(dist_loc, ("r", "c"), tiled=True)  # [vp]
+        # Reconstruct global padded src ids from column-gather-local indices.
+        src_global = ((src_g // w) * cols + j) * w + src_g % w
+        dst_global = i * row_block + dst_l
+        du = dist_full[src_global]
+        ok = (du != INT32_MAX) & (du + 1 == dist_full[dst_global])
+        cand = jnp.where(ok, src_global, INT32_MAX)
+        contrib = (
+            jnp.full((row_block,), INT32_MAX, jnp.int32)
+            .at[dst_l]
+            .min(cand, mode="drop")
+        )
+        parent_loc = reduce_scatter_min(contrib, "c", cols, impl=exchange)
+        parent_loc = jnp.where(parent_loc == INT32_MAX, -1, parent_loc)
+        return jnp.where(dist_loc == INT32_MAX, -1, parent_loc)
+
+    return jax.jit(
+        jax.shard_map(
+            local_parents,
+            mesh=mesh,
+            in_specs=(P("r", "c", None), P("r", "c", None), P(("r", "c"))),
+            out_specs=P(("r", "c")),
+            check_vma=False,
+        )
+    )
+
+
+class Dist2DBfsEngine:
+    """BFS over an R x C mesh with 2D edge partitioning.
+
+    API mirrors DistBfsEngine; use for meshes large enough that the 1D
+    exchange's O(vp) per-chip traffic dominates."""
+
+    def __init__(
+        self,
+        graph: Graph,
+        mesh: Mesh | None = None,
+        *,
+        rows: int | None = None,
+        cols: int | None = None,
+        exchange: str = "ring",
+        backend: str = "scan",
+    ):
+        if mesh is None:
+            mesh = make_mesh_2d(rows or 1, cols or 1)
+        if tuple(mesh.axis_names) != ("r", "c"):
+            raise ValueError("2D engine needs a mesh with axes ('r', 'c')")
+        self.mesh = mesh
+        self.rows, self.cols = (
+            mesh.devices.shape[0],
+            mesh.devices.shape[1],
+        )
+        self.graph_meta = (graph.num_input_edges, graph.undirected)
+        self._degrees = graph.degrees
+        part, src_gidx, dst_stacked, rp_stacked = partition_2d(
+            graph, self.rows, self.cols
+        )
+        self.part = part
+        edge_sharding = NamedSharding(mesh, P("r", "c", None))
+        self.src_g = jax.device_put(src_gidx, edge_sharding)
+        self.dst_l = jax.device_put(dst_stacked, edge_sharding)
+        self.rp = jax.device_put(rp_stacked, edge_sharding)
+        self._vec_sharding = NamedSharding(mesh, P(("r", "c")))
+        self._loop = _dist2d_bfs_fn(
+            mesh, self.rows, self.cols, part.w, exchange, backend
+        )
+        self._parents = _dist2d_parents_fn(mesh, self.rows, self.cols, part.w, exchange)
+        self._warmed = False
+
+    def _init_state(self, source: int):
+        part = self.part
+        pid = int(part.to_padded(source))
+        frontier0 = np.zeros(part.vp, dtype=bool)
+        frontier0[pid] = True
+        dist0 = np.full(part.vp, INF_DIST, dtype=np.int32)
+        dist0[pid] = 0
+        put = partial(jax.device_put, device=self._vec_sharding)
+        return put(frontier0), put(frontier0.copy()), put(dist0)
+
+    def distances_padded(self, source: int, *, max_levels: int | None = None):
+        frontier0, visited0, dist0 = self._init_state(source)
+        ml = jnp.int32(max_levels if max_levels is not None else self.part.vp)
+        return self._loop(
+            self.src_g, self.dst_l, self.rp, frontier0, visited0, dist0, ml
+        )
+
+    def run(
+        self,
+        source: int,
+        *,
+        max_levels: int | None = None,
+        with_parents: bool = True,
+        time_it: bool = False,
+    ) -> BfsResult:
+        part = self.part
+        if not (0 <= source < part.base.num_vertices):
+            raise ValueError(f"source {source} out of range")
+        elapsed = None
+        if time_it:
+            (dist_dev, _), elapsed = run_timed(
+                lambda: self.distances_padded(source, max_levels=max_levels),
+                warm=not self._warmed,
+            )
+            self._warmed = True
+        else:
+            dist_dev, _ = self.distances_padded(source, max_levels=max_levels)
+
+        parent = None
+        if with_parents:
+            parent_dev = self._parents(self.src_g, self.dst_l, dist_dev)
+            parent_pad = part.unshard(np.asarray(parent_dev))
+            parent = np.where(
+                parent_pad >= 0, part.from_padded(np.abs(parent_pad)), -1
+            ).astype(np.int32)
+            parent[source] = source
+
+        dist = part.unshard(np.asarray(dist_dev))
+        reached_mask = dist != INF_DIST
+        reached = int(reached_mask.sum())
+        num_levels = int(dist[reached_mask].max()) if reached else 0
+        _, undirected = self.graph_meta
+        slots = int(self._degrees[reached_mask].sum()) if reached else 0
+        return BfsResult(
+            source=source,
+            distance=dist,
+            parent=parent,
+            num_levels=num_levels,
+            reached=reached,
+            edges_traversed=slots // 2 if undirected else slots,
+            elapsed_s=elapsed,
+        )

@@ -1,0 +1,141 @@
+"""2D edge partitioning over an R x C device mesh.
+
+Absent from the reference (SURVEY.md §2c: 1D vertex partitioning is its only
+sharding axis) but required for the Graph500 scale-26 target (BASELINE.json).
+This is the Buluc-Madduri 2D decomposition expressed TPU-natively:
+
+- Vertices are remapped into the same padded id space as the 1D partition
+  (ceil(V/P) reals + phantoms per slice, strictly monotone map); slice k is
+  owned by mesh chip (i = k // C, j = k % C) — row-major.
+- "Row block i" = vertices owned by mesh row i: the contiguous padded range
+  [i*C*w, (i+1)*C*w).  "Column block j" = vertices owned by mesh column j:
+  the *strided* union of slices {k : k % C == j}.
+- Edge (u, v) lives on chip (row_of(v), col_of(u)): its frontier bit arrives
+  in the column all-gather, its contribution leaves in the row
+  reduce-scatter.
+
+Per level each chip: all-gathers frontier slices over its mesh column
+(receiving vp/C bits), expands local edges into a row-block contribution
+(vp/R bits), and OR-reduce-scatters over its mesh row — so per-chip
+communication is O(vp/R + vp/C) instead of the 1D path's O(vp).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from tpu_bfs.graph.csr import Graph, EDGE_PAD, _round_up
+from tpu_bfs.parallel.partition import Partition1D
+
+
+@dataclasses.dataclass(frozen=True)
+class Partition2D:
+    rows: int  # R
+    cols: int  # C
+    base: Partition1D  # flat-slice ownership (num_devices = R*C)
+
+    @property
+    def num_devices(self) -> int:
+        return self.rows * self.cols
+
+    @property
+    def w(self) -> int:
+        """Padded vertices per slice."""
+        return self.base.vloc
+
+    @property
+    def vp(self) -> int:
+        return self.base.vp
+
+    def to_padded(self, v):
+        return self.base.to_padded(v)
+
+    def from_padded(self, pid):
+        return self.base.from_padded(pid)
+
+    def unshard(self, arr_vp):
+        return self.base.unshard(arr_vp)
+
+    def chip_of_edge(self, psrc, pdst):
+        """(row, col) mesh coordinates owning padded edge (psrc, pdst)."""
+        w = self.w
+        return (pdst // w) // self.cols, (psrc // w) % self.cols
+
+    def src_gather_index(self, psrc):
+        """Index of padded src id within its column's all-gathered [R*w]
+        frontier buffer: strided slices stacked in mesh-row order."""
+        w = self.w
+        return ((psrc // w) // self.cols) * w + psrc % w
+
+
+def partition_2d(
+    graph: Graph,
+    rows: int,
+    cols: int,
+    *,
+    vertex_pad: int = 256,
+    edge_pad: int = EDGE_PAD,
+):
+    """Shard edges over an R x C mesh.
+
+    Returns (part, src_gidx, dst_stacked, rp_stacked):
+      - src_gidx [R, C, ep2] int32: per-chip edge sources, pre-translated into
+        column-gather-local indices (see src_gather_index), sorted by dst.
+      - dst_stacked [R, C, ep2] int32: global padded dst, non-decreasing per
+        chip; padding edges point at the chip's row-block-final phantom.
+      - rp_stacked [R, C, C*w+1] int32: per-chip CSR-by-dst row pointer over
+        the chip's row block (dst made row-block-local).
+    """
+    v = graph.num_vertices
+    p = rows * cols
+    cpk = (v + p - 1) // p
+    w = _round_up(cpk + 1, vertex_pad)
+    base = Partition1D(
+        num_devices=p, num_vertices=v, cpk=cpk, vloc=w, ep_chip=0
+    )
+    part = Partition2D(rows=rows, cols=cols, base=base)
+
+    src, dst = graph.coo
+    psrc = (src.astype(np.int64) // cpk) * w + src % cpk
+    pdst = (dst.astype(np.int64) // cpk) * w + dst % cpk
+    row = (pdst // w) // cols
+    col = (psrc // w) % cols
+    chip = row * cols + col
+
+    counts = np.bincount(chip, minlength=p)
+    ep2 = _round_up(int(counts.max(initial=0)) + 1, edge_pad)
+    if ep2 >= 2**31 - 1:
+        raise ValueError("per-chip edge slots overflow int32; use a larger mesh")
+
+    order = np.lexsort((psrc, pdst, chip))
+    chip_s = chip[order]
+    psrc_s = psrc[order]
+    pdst_s = pdst[order]
+    starts = np.searchsorted(chip_s, np.arange(p))
+    ends = np.searchsorted(chip_s, np.arange(p), side="right")
+
+    row_block = cols * w  # dst-range size per chip
+    src_gidx = np.empty((rows, cols, ep2), dtype=np.int32)
+    dst_stacked = np.empty((rows, cols, ep2), dtype=np.int32)
+    rp_stacked = np.empty((rows, cols, row_block + 1), dtype=np.int32)
+    gather_idx = lambda ps: ((ps // w) // cols) * w + ps % w
+    for i in range(rows):
+        for j in range(cols):
+            k = i * cols + j
+            n_k = ends[k] - starts[k]
+            sg = gather_idx(psrc_s[starts[k] : ends[k]])
+            dl = pdst_s[starts[k] : ends[k]] - i * row_block
+            src_gidx[i, j, :n_k] = sg
+            dst_stacked[i, j, :n_k] = dl
+            # Padding: src = slice (0, j)'s phantom (never in any frontier),
+            # dst = the row block's final phantom (keeps dst non-decreasing).
+            src_gidx[i, j, n_k:] = w - 1  # gather index of slice (0,j) phantom
+            dst_stacked[i, j, n_k:] = row_block - 1
+            cnt = np.bincount(
+                dst_stacked[i, j].astype(np.int64), minlength=row_block
+            )
+            rp_stacked[i, j, 0] = 0
+            rp_stacked[i, j, 1:] = np.cumsum(cnt)
+    return part, src_gidx, dst_stacked, rp_stacked

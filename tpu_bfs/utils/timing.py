@@ -1,0 +1,26 @@
+"""Device-timing helper shared by the BFS engines.
+
+The reference times with std::chrono around each run (bfs.cu:624-626) and has
+no JIT to exclude; here the first execution compiles, so engines warm once per
+compiled shape before timing.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+
+
+def run_timed(call, *, warm: bool):
+    """Execute ``call`` and return (result, elapsed_seconds).
+
+    When ``warm`` is true, one untimed execution runs first (absorbing
+    compilation); the timed execution blocks until device completion.
+    """
+    if warm:
+        jax.block_until_ready(call())
+    t0 = time.perf_counter()
+    out = call()
+    jax.block_until_ready(out)
+    return out, time.perf_counter() - t0

@@ -13,7 +13,7 @@ from tpu_bfs.algorithms.bfs import BfsEngine, bfs
 from tpu_bfs.graph.csr import INF_DIST
 from tpu_bfs.reference import bfs_python
 
-BACKENDS = ["scan", "segment", "scatter"]
+BACKENDS = ["scan", "segment", "scatter", "delta"]
 
 
 @pytest.mark.parametrize("backend", BACKENDS)

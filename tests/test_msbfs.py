@@ -9,7 +9,7 @@ from tpu_bfs.algorithms.msbfs import MsBfsEngine
 from tpu_bfs.reference import bfs_python
 
 
-@pytest.mark.parametrize("backend", ["scan", "scatter"])
+@pytest.mark.parametrize("backend", ["scan", "scatter", "delta"])
 def test_msbfs_matches_golden(random_small, backend):
     eng = MsBfsEngine(random_small, backend=backend)
     sources = np.array([0, 7, 123, 499])

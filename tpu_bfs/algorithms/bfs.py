@@ -18,12 +18,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpu_bfs.graph.csr import Graph, DeviceGraph, INF_DIST
-from tpu_bfs.algorithms.frontier import level_step, extract_parents, INT32_MAX
+from tpu_bfs.algorithms.frontier import EdgeData, level_step, extract_parents, INT32_MAX
 from tpu_bfs.utils.timing import run_timed
 
 
 @partial(jax.jit, static_argnames=("backend",), donate_argnums=())
-def _bfs_core(src, dst, in_row_ptr, frontier0, visited0, dist0, max_levels, *, backend):
+def _bfs_core(edges, frontier0, visited0, dist0, max_levels, *, backend):
     """The compiled level loop. All shapes static; source/max_levels traced."""
 
     def cond(state):
@@ -32,7 +32,7 @@ def _bfs_core(src, dst, in_row_ptr, frontier0, visited0, dist0, max_levels, *, b
 
     def body(state):
         frontier, visited, dist, level = state
-        new = level_step(src, dst, in_row_ptr, frontier, visited, backend=backend)
+        new = level_step(edges, frontier, visited, backend=backend)
         dist = jnp.where(new, level + 1, dist)
         visited = visited | new
         return new, visited, dist, level + 1
@@ -95,6 +95,14 @@ class BfsEngine:
         self.src = put(jnp.asarray(dg.src))
         self.dst = put(jnp.asarray(dg.dst))
         self.in_row_ptr = put(jnp.asarray(dg.in_row_ptr.astype(np.int32)))
+        need_delta = backend == "delta"
+        self.edges = EdgeData(
+            src=self.src,
+            dst=self.dst,
+            in_rp=self.in_row_ptr,
+            out_rp=put(jnp.asarray(dg.out_row_ptr.astype(np.int32))) if need_delta else None,
+            perm_ds=put(jnp.asarray(dg.perm_ds)) if need_delta else None,
+        )
         self._warmed = False
 
     @property
@@ -113,14 +121,7 @@ class BfsEngine:
         frontier0, visited0, dist0 = self._init_state(source)
         ml = jnp.int32(max_levels if max_levels is not None else self.vp)
         return _bfs_core(
-            self.src,
-            self.dst,
-            self.in_row_ptr,
-            frontier0,
-            visited0,
-            dist0,
-            ml,
-            backend=self.backend,
+            self.edges, frontier0, visited0, dist0, ml, backend=self.backend
         )
 
     def run(

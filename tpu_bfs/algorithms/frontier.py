@@ -27,11 +27,24 @@ deterministic min-parent rule replaces the reference's atomic-race winner
 from __future__ import annotations
 
 from functools import partial
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 
 INT32_MAX = jnp.iinfo(jnp.int32).max
+
+
+class EdgeData(NamedTuple):
+    """Device-resident edge arrays for one chip (see DeviceGraph).
+
+    out_rp / perm_ds may be None for backends that don't need them."""
+
+    src: jax.Array  # [ep] dst-major
+    dst: jax.Array  # [ep] non-decreasing
+    in_rp: jax.Array  # [vp+1] CSR-by-dst boundaries
+    out_rp: jax.Array | None = None  # [vp+1] CSR-by-src boundaries (src-major order)
+    perm_ds: jax.Array | None = None  # [ep] src-major position of dst-major edge i
 
 # Registry of frontier-expansion backends; 'pallas' is registered by
 # tpu_bfs.ops when available.
@@ -85,15 +98,42 @@ _EXPAND_BACKENDS["segment"] = _expand_segment
 _EXPAND_BACKENDS["scan"] = _expand_scan
 
 
-def level_step(src, dst, in_row_ptr, frontier, visited, *, backend: str = "scan"):
+def active_bits_delta(frontier, out_rp, ep: int):
+    """Frontier expansion into *src-major* edge space without a per-edge
+    frontier gather.
+
+    Marks +-1 at each frontier vertex's out-row boundaries and prefix-sums:
+    active[e] = 1 iff edge e's source is in the frontier. The two scatters are
+    vp-sized (small); the expansion itself is one dense O(ep) cumsum. (The
+    caller still pays one per-edge permutation gather to reach dst order —
+    see level_step.) frontier may be [vp] or [vp, K].
+    """
+    f = frontier.astype(jnp.int32)
+    zeros = jnp.zeros((ep + 1,) + frontier.shape[1:], jnp.int32)
+    delta = zeros.at[out_rp[:-1]].add(f).at[out_rp[1:]].add(-f)
+    return jnp.cumsum(delta, axis=0)[:ep] > 0
+
+
+def level_step(edges: EdgeData, frontier, visited, *, backend: str = "scan"):
     """One BFS level: returns the next frontier mask.
 
     Semantics of one iteration of the reference's level loop
     (runCudaQueueBfs, bfs.cu:569-621 / multiBfs, bfs.cu:101-130), with the
     visited test folded in (`& ~visited` replaces the atomicMin claim).
+
+    backend='delta' trades the data-dependent frontier[src] gather for a
+    *static* permutation gather (act_src[perm_ds]): same O(ep) element count,
+    but the index vector is fixed at build time and data-independent, which a
+    compiler/kernel can exploit (and which the other backends cannot). Whether
+    it wins over 'scan' is hardware-dependent — benchmark both.
     """
-    active = frontier[src]
-    hit = expand_or(active, dst, in_row_ptr, frontier.shape[0], backend=backend)
+    vp = frontier.shape[0]
+    if backend == "delta":
+        act_src = active_bits_delta(frontier, edges.out_rp, edges.perm_ds.shape[0])
+        active = act_src[edges.perm_ds]
+        return _expand_scan(active, edges.dst, edges.in_rp, vp) & ~visited
+    active = frontier[edges.src]
+    hit = expand_or(active, edges.dst, edges.in_rp, vp, backend=backend)
     return hit & ~visited
 
 

@@ -22,14 +22,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpu_bfs.graph.csr import Graph, DeviceGraph, INF_DIST
-from tpu_bfs.algorithms.frontier import INT32_MAX, expand_or, min_parent_candidates
+from tpu_bfs.algorithms.frontier import EdgeData, INT32_MAX, level_step, min_parent_candidates
 from tpu_bfs.utils.timing import run_timed
 
 
 @partial(jax.jit, static_argnames=("backend",))
-def _msbfs_core(src, dst, in_row_ptr, frontier0, visited0, dist0, max_levels, *, backend):
+def _msbfs_core(edges, frontier0, visited0, dist0, max_levels, *, backend):
     """Batched level loop. frontier/visited: [vp, K] bool; dist: [vp, K] int32."""
-    vp = frontier0.shape[0]
 
     def cond(state):
         frontier, _, _, level = state
@@ -37,9 +36,7 @@ def _msbfs_core(src, dst, in_row_ptr, frontier0, visited0, dist0, max_levels, *,
 
     def body(state):
         frontier, visited, dist, level = state
-        active = frontier[src]  # [ep, K] — one index, K lanes
-        hit = expand_or(active, dst, in_row_ptr, vp, backend=backend)
-        new = hit & ~visited
+        new = level_step(edges, frontier, visited, backend=backend)
         dist = jnp.where(new, level + 1, dist)
         visited = visited | new
         return new, visited, dist, level + 1
@@ -78,6 +75,14 @@ class MsBfsEngine:
         self.src = jnp.asarray(dg.src)
         self.dst = jnp.asarray(dg.dst)
         self.in_row_ptr = jnp.asarray(dg.in_row_ptr.astype(np.int32))
+        need_delta = backend == "delta"
+        self.edges = EdgeData(
+            src=self.src,
+            dst=self.dst,
+            in_rp=self.in_row_ptr,
+            out_rp=jnp.asarray(dg.out_row_ptr.astype(np.int32)) if need_delta else None,
+            perm_ds=jnp.asarray(dg.perm_ds) if need_delta else None,
+        )
         self._warmed_k = set()
 
     def _init_state(self, sources: jnp.ndarray):
@@ -94,14 +99,7 @@ class MsBfsEngine:
         frontier0, visited0, dist0 = self._init_state(sources)
         ml = jnp.int32(max_levels if max_levels is not None else self.dg.vp)
         return _msbfs_core(
-            self.src,
-            self.dst,
-            self.in_row_ptr,
-            frontier0,
-            visited0,
-            dist0,
-            ml,
-            backend=self.backend,
+            self.edges, frontier0, visited0, dist0, ml, backend=self.backend
         )
 
     def run(

@@ -70,7 +70,7 @@ def main(argv=None) -> int:
     ap.add_argument("--mesh", default=None, metavar="RxC",
                     help="2D mesh shape (e.g. 2x4): uses the 2D edge partition "
                     "engine instead of the 1D vertex partition")
-    ap.add_argument("--backend", default="scan", choices=["scan", "segment", "scatter"],
+    ap.add_argument("--backend", default="scan", choices=["scan", "segment", "scatter", "delta"],
                     help="single-device frontier-expansion backend")
     ap.add_argument("--exchange", default="ring", choices=["ring", "allreduce"],
                     help="multi-device frontier exchange implementation")
@@ -105,6 +105,8 @@ def main(argv=None) -> int:
         # Reference prints CPU elapsed ms (runCpu, bfs.cu:211-219).
         print(f"Elapsed time in milliseconds (CPU): {(time.perf_counter() - t0) * 1e3:.2f}")
 
+    if (args.mesh or args.devices > 1) and args.backend == "delta":
+        ap.error("--backend delta is single-device only (for now)")
     if args.mesh:
         from tpu_bfs.parallel.dist_bfs2d import Dist2DBfsEngine, make_mesh_2d
 

@@ -170,6 +170,13 @@ class DeviceGraph:
     # CSR-by-destination over the padded arrays: in_row_ptr[v] is the first
     # padded-edge index with dst == v. Used for segment boundaries.
     in_row_ptr: np.ndarray  # [vp+1] int64
+    # CSR-by-source over the src-major padded edge order (real edges in
+    # (src, dst) order, then phantom padding). Used by the gather-free
+    # 'delta' backend to mark frontier rows in edge space.
+    out_row_ptr: np.ndarray  # [vp+1] int64
+    # perm_ds[i] = src-major position of the i-th dst-major edge; the fixed
+    # permutation routing src-order activity bits to dst-order.
+    perm_ds: np.ndarray  # [ep] int32
 
     @classmethod
     def from_graph(cls, g: Graph, *, vertex_pad: int = VERTEX_PAD,
@@ -178,7 +185,7 @@ class DeviceGraph:
         # Always leave at least one phantom vertex so padding edges have a target.
         vp = _round_up(v + 1, vertex_pad)
         ep = _round_up(max(e, 1), edge_pad)
-        src, dst = g.coo
+        src, dst = g.coo  # src-major (CSR) order
         order = _lexsort_pairs(dst, src, v)  # dst-major, src-minor
         src_p = np.full(ep, vp - 1, dtype=np.int32)
         dst_p = np.full(ep, vp - 1, dtype=np.int32)
@@ -187,6 +194,15 @@ class DeviceGraph:
         counts = np.bincount(dst_p.astype(np.int64), minlength=vp)
         in_row_ptr = np.zeros(vp + 1, dtype=np.int64)
         np.cumsum(counts, out=in_row_ptr[1:])
+        # Src-major structures: real edges occupy [0, e) in g.coo order;
+        # padding rows belong to the final phantom vertex.
+        out_counts = np.bincount(src.astype(np.int64), minlength=vp)
+        out_counts[vp - 1] += ep - e  # padding edges
+        out_row_ptr = np.zeros(vp + 1, dtype=np.int64)
+        np.cumsum(out_counts, out=out_row_ptr[1:])
+        perm_ds = np.empty(ep, dtype=np.int32)
+        perm_ds[:e] = order
+        perm_ds[e:] = np.arange(e, ep)
         return cls(
             src=src_p,
             dst=dst_p,
@@ -197,4 +213,6 @@ class DeviceGraph:
             vp=vp,
             ep=ep,
             in_row_ptr=in_row_ptr,
+            out_row_ptr=out_row_ptr,
+            perm_ds=perm_ds,
         )

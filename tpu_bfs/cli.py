@@ -83,6 +83,8 @@ def main(argv=None) -> int:
     ap.add_argument("--save-dist", default=None, help="save distances to .npy")
     ap.add_argument("--save-parent", default=None, help="save parents to .npy")
     args = ap.parse_args(argv)
+    if (args.mesh or args.devices > 1) and args.backend == "delta":
+        ap.error("--backend delta is single-device only (for now)")
 
     import numpy as np
 
@@ -105,8 +107,6 @@ def main(argv=None) -> int:
         # Reference prints CPU elapsed ms (runCpu, bfs.cu:211-219).
         print(f"Elapsed time in milliseconds (CPU): {(time.perf_counter() - t0) * 1e3:.2f}")
 
-    if (args.mesh or args.devices > 1) and args.backend == "delta":
-        ap.error("--backend delta is single-device only (for now)")
     if args.mesh:
         from tpu_bfs.parallel.dist_bfs2d import Dist2DBfsEngine, make_mesh_2d
 

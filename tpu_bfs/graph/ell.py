@@ -1,0 +1,208 @@
+"""Degree-sorted bucketed ELL representation of in-neighborhoods.
+
+The reference's frontier expansion walks CSR rows with one CUDA thread per
+frontier entry (queueBfs, bfs.cu:134-165) — variable-degree rows are fine
+there because threads diverge independently. On TPU, variable-degree rows are
+the enemy: every op is a fixed-shape vector op, and a random gather costs
+~8ns/index regardless of how few bits it fetches (measured; see
+msbfs_packed.py). The layout here makes the per-level work a short, static
+sequence of *column* gathers over rectangular tiles plus dense folds:
+
+- Vertices are relabeled by descending in-degree ("rank" order), so vertices
+  of similar degree are contiguous and each degree bucket is a contiguous row
+  range — bucket outputs concatenate back into a full vertex vector with no
+  scatter at all.
+- Each light bucket holds rows with in-degree in (k/2, k], padded to k
+  columns with a sentinel vertex whose frontier words are always zero.
+- Vertices with in-degree > kcap ("heavy") are split into ceil(deg/kcap)
+  *virtual rows* of kcap columns each. Virtual-row results are OR-combined
+  per vertex by a dense fold pyramid: rows are replayed into a layout where
+  each vertex owns an aligned power-of-two run (``fold_pad_map``), the whole
+  array is OR-folded pairwise ``fold_steps`` times (dense, gather-free), and
+  each heavy vertex's finished value is picked from the pyramid at a static
+  position (``heavy_pick``). Two bounded stages replace the reference's
+  unbounded per-thread degree loop (bfs.cu:143).
+
+Total padded slots are typically 1.1-1.5x the edge count on power-law graphs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from tpu_bfs.graph.csr import Graph, _lexsort_pairs
+
+
+@dataclasses.dataclass(frozen=True)
+class EllBucket:
+    """Rows [row_start, row_start + n) in rank order, padded to width k."""
+
+    row_start: int
+    n: int
+    k: int
+    idx: np.ndarray  # [n, k] int32 — rank-space neighbor ids, pad = V
+
+
+@dataclasses.dataclass(frozen=True)
+class EllGraph:
+    """Bucketed ELL over in-neighborhoods, in descending-in-degree rank space.
+
+    Rank space: row r corresponds to original vertex ``old_of_new[r]``;
+    ``rank[v]`` is the row of original vertex v. Rows [0, num_heavy) are
+    heavy (in-degree > kcap); rows [num_nonzero, V) have in-degree 0.
+    The neighbor-id sentinel is V: callers gather from a frontier table with
+    V+1 rows whose last row is all-zero. ``fold_pad_map``'s sentinel is
+    ``num_virtual`` (an appended all-zero virtual-result row).
+    """
+
+    num_vertices: int
+    num_edges: int  # directed edge slots represented (== sum of in-degrees)
+    undirected: bool  # carried from Graph for TEPS edge accounting
+    kcap: int
+    old_of_new: np.ndarray  # [V] int32
+    rank: np.ndarray  # [V] int32
+    in_degree: np.ndarray  # [V] int64, original-id order
+    num_heavy: int
+    num_nonzero: int  # rows with in-degree > 0
+    num_virtual: int  # virtual rows (0 when no heavy vertices)
+    virtual: EllBucket | None  # [M, kcap] neighbor ids (rank space)
+    fold_pad_map: np.ndarray | None  # [M2] int32 into virtual results, pad = M
+    heavy_pick: np.ndarray | None  # [H] int32 into the fold pyramid
+    fold_steps: int
+    light: list[EllBucket]  # rows with 0 < deg <= kcap
+
+    @property
+    def total_slots(self) -> int:
+        m = 0 if self.virtual is None else self.virtual.idx.size
+        return m + sum(b.idx.size for b in self.light)
+
+
+def _ell_fill(lens: np.ndarray, flat: np.ndarray, k: int, pad: int) -> np.ndarray:
+    """Pack concatenated variable-length rows (lengths ``lens``, data ``flat``)
+    into a dense [len(lens), k] matrix padded with ``pad``."""
+    n = len(lens)
+    out = np.full((n, k), pad, dtype=np.int32)
+    if n:
+        mask = np.arange(k, dtype=np.int64)[None, :] < lens[:, None]
+        out[mask] = flat
+    return out
+
+
+def _flat_positions(starts: np.ndarray, lens: np.ndarray) -> np.ndarray:
+    """Concatenate ranges [starts[i], starts[i]+lens[i]) into one index array."""
+    total = int(lens.sum())
+    ends = np.cumsum(lens)
+    return (
+        starts.repeat(lens)
+        + np.arange(total, dtype=np.int64)
+        - (ends - lens).repeat(lens)
+    )
+
+
+def build_ell(g: Graph, *, kcap: int = 64) -> EllGraph:
+    """Build the bucketed in-neighbor ELL from a host CSR graph."""
+    v_count = g.num_vertices
+    # In-CSR: neighbors-by-destination. For the undirected double-insert
+    # representation this equals the out-CSR, but build it generally.
+    src, dst = g.coo
+    order_ds = _lexsort_pairs(dst, src, v_count)
+    in_col = src[order_ds]
+    in_deg = np.bincount(dst, minlength=v_count).astype(np.int64)
+
+    rank_order = np.argsort(-in_deg, kind="stable").astype(np.int32)  # new -> old
+    rank = np.empty(v_count, dtype=np.int32)
+    rank[rank_order] = np.arange(v_count, dtype=np.int32)
+
+    # Flatten in-neighbor lists in rank order, neighbor ids mapped to rank space.
+    in_rp = np.zeros(v_count + 1, dtype=np.int64)
+    np.cumsum(in_deg, out=in_rp[1:])
+    lens = in_deg[rank_order]
+    new_rp = np.zeros(v_count + 1, dtype=np.int64)
+    np.cumsum(lens, out=new_rp[1:])
+    e = int(new_rp[-1])
+    nbrs = rank[in_col[_flat_positions(in_rp[rank_order], lens)]]
+
+    num_heavy = int(np.searchsorted(-lens, -kcap, side="left"))
+    num_nonzero = int(np.searchsorted(-lens, 0, side="left"))
+
+    # --- Heavy rows -> virtual rows of exactly kcap columns + fold pyramid. ---
+    virtual = None
+    fold_pad_map = None
+    heavy_pick = None
+    fold_steps = 0
+    num_virtual = 0
+    if num_heavy:
+        hlens = lens[:num_heavy]
+        r_per = -(-hlens // kcap)  # ceil(deg / kcap), sorted non-increasing
+        num_virtual = int(r_per.sum())
+        vlens = np.full(num_virtual, kcap, dtype=np.int64)
+        vr_last = np.cumsum(r_per) - 1  # last virtual row of each heavy vertex
+        vlens[vr_last] = hlens - kcap * (r_per - 1)
+        heavy_flat = nbrs[: int(new_rp[num_heavy])]
+        virtual = EllBucket(
+            row_start=0,
+            n=num_virtual,
+            k=kcap,
+            idx=_ell_fill(vlens, heavy_flat, kcap, v_count),
+        )
+        # Aligned power-of-two layout: vertex h owns rows
+        # [pstart[h], pstart[h] + rp2[h]) with rp2 = next_pow2(r_per).
+        # Descending powers of two keep every start aligned to its own size.
+        rp2 = 1 << np.ceil(np.log2(r_per)).astype(np.int64)
+        fold_steps = int(np.log2(rp2[0]))
+        m2 = int(rp2.sum())
+        m2 = -(-m2 // (1 << fold_steps)) * (1 << fold_steps)
+        pstart = np.concatenate([[0], np.cumsum(rp2)[:-1]])
+        fold_pad_map = np.full(m2, num_virtual, dtype=np.int32)
+        vr_start = vr_last - r_per + 1
+        fold_pad_map[_flat_positions(pstart, r_per)] = _flat_positions(
+            vr_start, r_per
+        ).astype(np.int32)
+        # Pyramid = concat of fold levels s = 1..fold_steps (level s has
+        # m2 >> s rows); vertex h is finished at level log2(rp2[h]).
+        lvl = np.log2(rp2).astype(np.int64)
+        lvl_offset = np.zeros(fold_steps + 1, dtype=np.int64)
+        off = 0
+        for s in range(1, fold_steps + 1):
+            lvl_offset[s] = off
+            off += m2 >> s
+        heavy_pick = (lvl_offset[lvl] + (pstart >> lvl)).astype(np.int32)
+
+    # --- Light buckets: 0 < deg <= kcap, widths kcap, kcap/2, ..., 1. ---
+    light: list[EllBucket] = []
+    row = num_heavy
+    k = kcap
+    while row < num_nonzero and k >= 1:
+        lo_deg = k // 2  # this bucket: lo_deg < deg <= k
+        hi = int(np.searchsorted(-lens, -(lo_deg + 1), side="right"))
+        if hi > row:
+            sl = slice(row, hi)
+            flat = nbrs[int(new_rp[row]) : int(new_rp[hi])]
+            light.append(
+                EllBucket(
+                    row_start=row, n=hi - row, k=k,
+                    idx=_ell_fill(lens[sl], flat, k, v_count),
+                )
+            )
+            row = hi
+        k //= 2
+
+    return EllGraph(
+        num_vertices=v_count,
+        num_edges=e,
+        undirected=g.undirected,
+        kcap=kcap,
+        old_of_new=rank_order,
+        rank=rank,
+        in_degree=in_deg,
+        num_heavy=num_heavy,
+        num_nonzero=num_nonzero,
+        num_virtual=num_virtual,
+        virtual=virtual,
+        fold_pad_map=fold_pad_map,
+        heavy_pick=heavy_pick,
+        fold_steps=fold_steps,
+        light=light,
+    )

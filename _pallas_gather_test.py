@@ -1,0 +1,34 @@
+import time, sys
+import jax, jax.numpy as jnp, numpy as np
+from jax.experimental import pallas as pl
+
+x = jnp.ones((1024, 128), jnp.float32)
+
+def kernel(x_ref, o_ref):
+    o_ref[:] = x_ref[:] * 2.0
+
+@jax.jit
+def double(x):
+    return pl.pallas_call(kernel, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)
+
+t0 = time.perf_counter()
+r = double(x)
+print(f"trivial pallas ok {time.perf_counter()-t0:.1f}s", float(np.asarray(r).sum()))
+sys.stdout.flush()
+
+V, N = 1024, 1024
+rng = np.random.default_rng(0)
+table = jnp.asarray(rng.integers(0, 2**32, V).astype(np.uint32))
+idx = jnp.asarray(rng.integers(0, V, N, dtype=np.int32))
+
+def gkernel(table_ref, idx_ref, out_ref):
+    out_ref[:] = jnp.take(table_ref[:], idx_ref[:], axis=0)
+
+@jax.jit
+def pgather(table, idx):
+    return pl.pallas_call(gkernel, out_shape=jax.ShapeDtypeStruct((N,), jnp.uint32))(table, idx)
+
+t0 = time.perf_counter()
+r = pgather(table, idx)
+chk = np.asarray(r)
+print(f"gather ok {time.perf_counter()-t0:.1f}s", np.array_equal(chk, np.asarray(table)[np.asarray(idx)]))

@@ -1,0 +1,57 @@
+"""Per-level stats recovery and the --stats / --multi-source CLI paths."""
+
+import json
+
+import numpy as np
+
+from tpu_bfs.algorithms.bfs import bfs
+from tpu_bfs.cli import main as cli_main
+from tpu_bfs.graph.csr import INF_DIST
+from tpu_bfs.utils.stats import level_stats
+
+
+def test_level_stats_line_graph(line_graph):
+    res = bfs(line_graph, 0, with_parents=False)
+    st = level_stats(res.distance, line_graph.degrees)
+    assert st.num_levels == 63
+    np.testing.assert_array_equal(st.frontier_size, np.ones(64, np.int64))
+    assert st.reached == 64 and st.unreached == 0
+    # Path graph: endpoints have degree 1, inner vertices 2.
+    assert st.edges_scanned[0] == 1 and st.edges_scanned[1] == 2
+    assert st.frontier_size.sum() == 64
+    assert st.edges_scanned.sum() == line_graph.num_edges
+
+
+def test_level_stats_disconnected(random_disconnected):
+    res = bfs(random_disconnected, 0, with_parents=False)
+    st = level_stats(res.distance, random_disconnected.degrees)
+    assert st.reached + st.unreached == random_disconnected.num_vertices
+    assert st.unreached > 0
+    lines = st.json_lines()
+    assert json.loads(lines[0])["frontier"] == 1
+
+
+def test_level_stats_all_unreached():
+    dist = np.full(10, INF_DIST, np.int32)
+    st = level_stats(dist, np.zeros(10))
+    assert st.reached == 0 and st.unreached == 10
+
+
+def test_cli_stats_flag(tmp_path, capsys):
+    path = tmp_path / "g.txt"
+    path.write_text("4 3\n0 1\n1 2\n2 3\n")
+    rc = cli_main(["0", str(path), "--stats"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "Output OK" in out
+    stat_lines = [l for l in out.splitlines() if l.startswith("{")]
+    assert [json.loads(l)["frontier"] for l in stat_lines] == [1, 1, 1, 1]
+
+
+def test_cli_multi_source(tmp_path, capsys):
+    path = tmp_path / "g.txt"
+    path.write_text("4 3\n0 1\n1 2\n2 3\n")
+    rc = cli_main(["0", str(path), "--multi-source", "3,1", "--stats"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "Output OK" in out
+    assert "3 sources" in out
+    assert out.count("reached 4 vertices") == 3

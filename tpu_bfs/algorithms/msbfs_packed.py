@@ -87,12 +87,12 @@ def _make_core(ell: EllGraph, w: int):
                 acc = acc | fw[vr_t[k]]
             vr_ext = jnp.concatenate([acc, jnp.zeros((1, w), jnp.uint32)])
             cur = vr_ext[arrs["fold_pad_map"]]
-            pyramid = []
+            pyramid = [cur]  # level 0: the padded layout itself
             for _ in range(fold_steps):
                 pairs = cur.reshape(-1, 2, w)
                 cur = pairs[:, 0] | pairs[:, 1]
                 pyramid.append(cur)
-            pyr = jnp.concatenate(pyramid) if pyramid else cur
+            pyr = jnp.concatenate(pyramid) if len(pyramid) > 1 else pyramid[0]
             parts.append(pyr[arrs["heavy_pick"]])
         for i, (n, k) in enumerate(light_meta):
             bt = arrs[f"light{i}_t"]  # [k, n]

@@ -54,6 +54,55 @@ def load_graph(spec: str):
     return io.load_edge_list(spec)
 
 
+def _maybe_profile(profile_dir):
+    """jax.profiler trace context, or a no-op when no dir is given."""
+    import contextlib
+
+    if not profile_dir:
+        return contextlib.nullcontext()
+    import jax
+
+    return jax.profiler.trace(profile_dir)
+
+
+def _run_multi_source(args, g, golden) -> int:
+    """--multi-source path: <source> plus the listed keys, one packed batch."""
+    import numpy as np
+
+    from tpu_bfs import validate
+    from tpu_bfs.algorithms.msbfs_packed import PackedMsBfsEngine
+    from tpu_bfs.utils.stats import level_stats
+
+    try:
+        extra = [int(t) for t in args.multi_source.split(",") if t.strip()]
+    except ValueError:
+        raise SystemExit(f"--multi-source must be comma-separated ints, got "
+                         f"{args.multi_source!r}")
+    sources = np.asarray([args.source] + extra)
+    lanes = max(32, -(-len(sources) // 32) * 32)
+    engine = PackedMsBfsEngine(g, lanes=lanes)
+    with _maybe_profile(args.profile_dir):
+        res = engine.run(sources, time_it=True)
+    print(f"Elapsed time in milliseconds (device): {res.elapsed_s * 1e3:.3f} "
+          f"({len(sources)} sources)")
+    for i, s in enumerate(sources):
+        print(f"source {int(s)}: reached {int(res.reached[i])} vertices, "
+              f"traversed edges {int(res.edges_traversed[i])}")
+    if res.teps:
+        print(f"Harmonic-mean GTEPS/source: {res.teps / 1e9:.4f}")
+    if args.stats:
+        for line in level_stats(res.distances_int32(0), g.degrees).json_lines():
+            print(line)
+    if golden is not None:
+        validate.check_distances(res.distances_int32(0), golden)
+        print("Output OK")
+    if args.save_dist:
+        np.save(args.save_dist, np.stack([
+            res.distances_int32(i) for i in range(len(sources))
+        ]))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="tpu_bfs",
@@ -82,9 +131,16 @@ def main(argv=None) -> int:
     ap.add_argument("--repeat", type=int, default=1, help="timed repetitions")
     ap.add_argument("--save-dist", default=None, help="save distances to .npy")
     ap.add_argument("--save-parent", default=None, help="save parents to .npy")
+    ap.add_argument("--multi-source", default=None, metavar="V1,V2,...",
+                    help="run these sources concurrently with <source> via the "
+                    "bit-packed multi-source engine (single device)")
+    ap.add_argument("--profile-dir", default=None,
+                    help="write a jax.profiler trace of the timed run here")
     args = ap.parse_args(argv)
     if (args.mesh or args.devices > 1) and args.backend == "delta":
         ap.error("--backend delta is single-device only (for now)")
+    if args.multi_source and (args.mesh or args.devices > 1):
+        ap.error("--multi-source is single-device only (for now)")
 
     import numpy as np
 
@@ -106,6 +162,9 @@ def main(argv=None) -> int:
         golden = bfs_golden(g, args.source)
         # Reference prints CPU elapsed ms (runCpu, bfs.cu:211-219).
         print(f"Elapsed time in milliseconds (CPU): {(time.perf_counter() - t0) * 1e3:.2f}")
+
+    if args.multi_source:
+        return _run_multi_source(args, g, golden)
 
     if args.mesh:
         from tpu_bfs.parallel.dist_bfs2d import Dist2DBfsEngine, make_mesh_2d
@@ -131,12 +190,13 @@ def main(argv=None) -> int:
 
     res = None
     for _ in range(max(1, args.repeat)):
-        res = engine.run(
-            args.source,
-            max_levels=args.max_levels,
-            with_parents=not args.no_parents,
-            time_it=True,
-        )
+        with _maybe_profile(args.profile_dir):
+            res = engine.run(
+                args.source,
+                max_levels=args.max_levels,
+                with_parents=not args.no_parents,
+                time_it=True,
+            )
         # Reference prints device elapsed ms (bfs.cu:624-626).
         print(f"Elapsed time in milliseconds (device): {res.elapsed_s * 1e3:.3f}")
     if res.teps:
@@ -144,9 +204,10 @@ def main(argv=None) -> int:
     print(f"Reached {res.reached} vertices in {res.num_levels} levels")
 
     if args.stats:
-        sizes = res.level_sizes()
-        for lvl, n in enumerate(sizes):
-            print(json.dumps({"level": lvl, "frontier": int(n)}))
+        from tpu_bfs.utils.stats import level_stats
+
+        for line in level_stats(res.distance, g.degrees).json_lines():
+            print(line)
 
     if golden is not None:
         # checkOutput analog (bfs.cu:374-384) — but also validates parents,

@@ -101,6 +101,184 @@ def _flat_positions(starts: np.ndarray, lens: np.ndarray) -> np.ndarray:
     )
 
 
+def _heavy_pick(rp2, pstart, m2: int, fold_steps: int) -> np.ndarray:
+    """Pyramid positions of finished heavy rows. The pyramid is the concat of
+    fold levels s = 0..fold_steps (level s has m2 >> s rows; level 0 is the
+    padded layout itself); vertex h is finished at level log2(rp2[h])."""
+    lvl = np.log2(rp2).astype(np.int64)
+    lvl_offset = np.zeros(fold_steps + 1, dtype=np.int64)
+    off = 0
+    for s in range(fold_steps + 1):
+        lvl_offset[s] = off
+        off += m2 >> s
+    return (lvl_offset[lvl] + (pstart >> lvl)).astype(np.int32)
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardedEllGraph:
+    """Per-shard ELL structures with identical shapes, stackable on a mesh.
+
+    Global rank space is padded to ``num_shards * v_loc`` rows; shard p owns
+    rows {r : r % num_shards == p} (round-robin over the degree-sorted order,
+    so every shard sees the same degree distribution — the load-balance the
+    reference's contiguous ``getDev`` split lacks, bfs.cu:29-32). All bucket
+    boundaries are multiples of num_shards, so every shard has the same
+    bucket row counts and one jitted program serves all shards under
+    shard_map. Neighbor ids are *global* ranks (sentinel = v_pad); shards
+    gather from a replicated frontier table of v_pad+1 rows.
+    """
+
+    num_vertices: int
+    num_edges: int
+    undirected: bool
+    kcap: int
+    num_shards: int
+    v_loc: int  # rows per shard; v_pad = num_shards * v_loc
+    old_of_new: np.ndarray  # [V] int32
+    rank: np.ndarray  # [V] int32
+    in_degree: np.ndarray  # [V] int64, original-id order
+    heavy_per_shard: int
+    num_virtual: int  # shared per-shard virtual row count (max, padded)
+    m2: int
+    fold_steps: int
+    virtual: np.ndarray | None  # [P, M, kcap] int32
+    fold_pad_map: np.ndarray | None  # [P, m2] int32
+    heavy_pick: np.ndarray | None  # [P, heavy_per_shard] int32
+    light: list[tuple[int, np.ndarray]]  # (k, [P, n_k, k] int32)
+    tail_rows: int  # zero rows appended per shard
+
+    @property
+    def v_pad(self) -> int:
+        return self.num_shards * self.v_loc
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def build_ell_sharded(g: Graph, num_shards: int, *, kcap: int = 64) -> ShardedEllGraph:
+    """Build per-shard ELL structures for a ``num_shards``-way 1D partition."""
+    p_count = num_shards
+    v_count = g.num_vertices
+    src, dst = g.coo
+    order_ds = _lexsort_pairs(dst, src, v_count)
+    in_col = src[order_ds]
+    in_deg = np.bincount(dst, minlength=v_count).astype(np.int64)
+
+    rank_order = np.argsort(-in_deg, kind="stable").astype(np.int32)
+    rank = np.empty(v_count, dtype=np.int32)
+    rank[rank_order] = np.arange(v_count, dtype=np.int32)
+
+    v_loc = -(-v_count // p_count)
+    v_pad = p_count * v_loc
+
+    in_rp = np.zeros(v_count + 1, dtype=np.int64)
+    np.cumsum(in_deg, out=in_rp[1:])
+    # Rank-space arrays padded with empty rows.
+    lens = np.zeros(v_pad, dtype=np.int64)
+    lens[:v_count] = in_deg[rank_order]
+    starts = np.zeros(v_pad, dtype=np.int64)
+    starts[:v_count] = in_rp[rank_order]
+    new_rp = np.zeros(v_pad + 1, dtype=np.int64)
+    np.cumsum(lens, out=new_rp[1:])
+    e = int(new_rp[-1])
+    nbrs = rank[in_col[_flat_positions(starts, lens)]].astype(np.int32)
+
+    num_heavy = int(np.searchsorted(-lens, -kcap, side="left"))
+    h_bound = min(_round_up(num_heavy, p_count), v_pad)
+
+    def shard_rows(lo: int, hi: int, p: int) -> np.ndarray:
+        return np.arange(lo + p, hi, p_count, dtype=np.int64)
+
+    # --- Heavy section (identical shapes across shards). ---
+    virtual = fold_pad_map = heavy_pick = None
+    num_virtual = m2 = fold_steps = 0
+    heavy_per_shard = h_bound // p_count
+    if h_bound:
+        per_shard = []
+        for p in range(p_count):
+            rows = shard_rows(0, h_bound, p)
+            hlens = lens[rows]
+            r_per = np.maximum(-(-hlens // kcap), 1)
+            per_shard.append((rows, hlens, r_per))
+        num_virtual = max(int(t[2].sum()) for t in per_shard)
+        rp2_all = [
+            (1 << np.ceil(np.log2(r_per)).astype(np.int64)) for _, _, r_per in per_shard
+        ]
+        fold_steps = max(int(np.log2(rp2[0])) if len(rp2) else 0 for rp2 in rp2_all)
+        m2 = _round_up(
+            max(int(rp2.sum()) for rp2 in rp2_all), max(1 << fold_steps, 1)
+        )
+        v_parts, f_parts, h_parts = [], [], []
+        for (rows, hlens, r_per), rp2 in zip(per_shard, rp2_all):
+            m_p = int(r_per.sum())
+            vlens = np.zeros(num_virtual, dtype=np.int64)
+            vlens[:m_p] = kcap
+            vr_last = np.cumsum(r_per) - 1
+            vlens[vr_last] = hlens - kcap * (r_per - 1)
+            flat = nbrs[_flat_positions(starts_of(rows, new_rp), lens[rows])]
+            v_parts.append(_ell_fill(vlens, flat, kcap, v_pad))
+            pstart = np.concatenate([[0], np.cumsum(rp2)[:-1]]).astype(np.int64)
+            fpm = np.full(m2, num_virtual, dtype=np.int32)
+            vr_start = vr_last - r_per + 1
+            fpm[_flat_positions(pstart, r_per)] = _flat_positions(
+                vr_start, r_per
+            ).astype(np.int32)
+            f_parts.append(fpm)
+            h_parts.append(_heavy_pick(rp2, pstart, m2, fold_steps))
+        virtual = np.stack(v_parts)
+        fold_pad_map = np.stack(f_parts)
+        heavy_pick = np.stack(h_parts)
+
+    # --- Light ladder with num_shards-aligned global boundaries. ---
+    light = []
+    prev = h_bound
+    k = kcap
+    while prev < v_pad and k >= 1:
+        lo_deg = k // 2
+        hi = int(np.searchsorted(-lens, -(lo_deg + 1), side="right"))
+        hi = min(max(_round_up(hi, p_count), prev), v_pad)
+        if k == 1:
+            # Final bucket absorbs all remaining nonzero rows.
+            nz = int(np.searchsorted(-lens, 0, side="left"))
+            hi = min(max(_round_up(nz, p_count), prev), v_pad)
+        if hi > prev:
+            blocks = []
+            for p in range(p_count):
+                rows = shard_rows(prev, hi, p)
+                flat = nbrs[_flat_positions(starts_of(rows, new_rp), lens[rows])]
+                blocks.append(_ell_fill(lens[rows], flat, k, v_pad))
+            light.append((k, np.stack(blocks)))
+            prev = hi
+        k //= 2
+
+    return ShardedEllGraph(
+        num_vertices=v_count,
+        num_edges=e,
+        undirected=g.undirected,
+        kcap=kcap,
+        num_shards=p_count,
+        v_loc=v_loc,
+        old_of_new=rank_order,
+        rank=rank,
+        in_degree=in_deg,
+        heavy_per_shard=heavy_per_shard,
+        num_virtual=num_virtual,
+        m2=m2,
+        fold_steps=fold_steps,
+        virtual=virtual,
+        fold_pad_map=fold_pad_map,
+        heavy_pick=heavy_pick,
+        light=light,
+        tail_rows=v_loc - heavy_per_shard - sum(b.shape[1] for _, b in light),
+    )
+
+
+def starts_of(rows: np.ndarray, new_rp: np.ndarray) -> np.ndarray:
+    """Flat-neighbor start offsets for the given rank rows."""
+    return new_rp[rows]
+
+
 def build_ell(g: Graph, *, kcap: int = 64) -> EllGraph:
     """Build the bucketed in-neighbor ELL from a host CSR graph."""
     v_count = g.num_vertices
@@ -160,15 +338,7 @@ def build_ell(g: Graph, *, kcap: int = 64) -> EllGraph:
         fold_pad_map[_flat_positions(pstart, r_per)] = _flat_positions(
             vr_start, r_per
         ).astype(np.int32)
-        # Pyramid = concat of fold levels s = 1..fold_steps (level s has
-        # m2 >> s rows); vertex h is finished at level log2(rp2[h]).
-        lvl = np.log2(rp2).astype(np.int64)
-        lvl_offset = np.zeros(fold_steps + 1, dtype=np.int64)
-        off = 0
-        for s in range(1, fold_steps + 1):
-            lvl_offset[s] = off
-            off += m2 >> s
-        heavy_pick = (lvl_offset[lvl] + (pstart >> lvl)).astype(np.int32)
+        heavy_pick = _heavy_pick(rp2, pstart, m2, fold_steps)
 
     # --- Light buckets: 0 < deg <= kcap, widths kcap, kcap/2, ..., 1. ---
     light: list[EllBucket] = []

@@ -1,0 +1,265 @@
+"""Distributed bit-packed multi-source BFS over a 1D device mesh.
+
+The multi-chip analog of PackedMsBfsEngine. Compared to the reference's
+distribution (full CSR replicated to every device, bfs.cu:346-351; only
+distance *ownership* is split), this shards the expensive thing — the edge
+structure — and replicates the cheap thing — the packed frontier words
+([v_pad, W] uint32, i.e. V * 4W bytes regardless of edge count):
+
+- Vertices (in degree-sorted rank space) are dealt round-robin to shards, so
+  every shard holds the same degree mix — the contiguous ``getDev`` split
+  (bfs.cu:29-32) would give shard 0 all the hubs.
+- Per level, each chip expands only its owned rows through its ELL shard
+  (tpu_bfs/graph/ell.py: build_ell_sharded), claims ``& ~visited`` on owned
+  words, then ``all_gather`` over the mesh rebuilds the replicated frontier —
+  replacing the reference's per-destination bucket exchange
+  (cudaMemcpyPeer, bfs.cu:604-606 / MPI_Sendrecv, bfs_mpi.cu:615).
+- Termination reads the gathered frontier directly — every chip computes the
+  same ``any(frontier)``, so there is no extra Allreduce (bfs_mpi.cu:621) and
+  the whole level loop stays in one ``lax.while_loop`` on device.
+
+The same code path serves intra-slice (ICI) and cross-slice (DCN) meshes —
+XLA routes the all_gather — collapsing the reference's two near-identical
+source files (bfs.cu vs bfs_mpi.cu) into one driver.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_bfs.algorithms.msbfs_packed import MAX_LEVELS, PackedBfsResult
+from tpu_bfs.graph.csr import Graph
+from tpu_bfs.graph.ell import ShardedEllGraph, build_ell_sharded
+from tpu_bfs.parallel.dist_bfs import make_mesh
+
+
+def _make_dist_core(sell: ShardedEllGraph, w: int, mesh: Mesh):
+    p_count = sell.num_shards
+    v_loc = sell.v_loc
+    v_pad = sell.v_pad
+    kcap = sell.kcap
+    fold_steps = sell.fold_steps
+    light_meta = [(k, blocks.shape[1]) for k, blocks in sell.light]
+    heavy = sell.heavy_per_shard > 0
+    num_virtual = sell.num_virtual
+    tail = sell.tail_rows
+
+    def expand(arrs, fw):
+        """Owned-row expansion: fw is the replicated [v_pad+1, W] table; the
+        result is this chip's [v_loc, W] rows in local (rank // P) order."""
+        parts = []
+        if heavy:
+            vr_t = arrs["virtual_t"]  # [kcap, M]
+            acc = jnp.zeros((num_virtual, w), jnp.uint32)
+            for k in range(kcap):
+                acc = acc | fw[vr_t[k]]
+            vr_ext = jnp.concatenate([acc, jnp.zeros((1, w), jnp.uint32)])
+            cur = vr_ext[arrs["fold_pad_map"]]
+            pyramid = [cur]
+            for _ in range(fold_steps):
+                pairs = cur.reshape(-1, 2, w)
+                cur = pairs[:, 0] | pairs[:, 1]
+                pyramid.append(cur)
+            pyr = jnp.concatenate(pyramid) if len(pyramid) > 1 else pyramid[0]
+            parts.append(pyr[arrs["heavy_pick"]])
+        for i, (k, n) in enumerate(light_meta):
+            bt = arrs[f"light{i}_t"]  # [k, n]
+            acc = jnp.zeros((n, w), jnp.uint32)
+            for kk in range(k):
+                acc = acc | fw[bt[kk]]
+            parts.append(acc)
+        if tail:
+            parts.append(jnp.zeros((tail, w), jnp.uint32))
+        return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+
+    def chip_fn(arrs, fw0, max_levels):
+        # Block specs keep a leading axis of size 1; drop it.
+        arrs = {k: a[0] for k, a in arrs.items()}
+        p = jax.lax.axis_index("v")
+        own = lambda full: jax.lax.dynamic_index_in_dim(
+            full[:v_pad].reshape(v_loc, p_count, w), p, axis=1, keepdims=False
+        )
+        vis0 = own(fw0)
+        planes0 = tuple(jnp.zeros((v_loc, w), jnp.uint32) for _ in range(8))
+
+        def cond(carry):
+            _, _, _, level, alive = carry
+            return alive & (level < max_levels)
+
+        def body(carry):
+            fw, vis, planes, level, _ = carry
+            hit = expand(arrs, fw)
+            nxt = hit & ~vis
+            vis2 = vis | nxt
+            carry_bits = ~vis2
+            new_planes = []
+            for pl in planes:
+                new_planes.append(pl ^ carry_bits)
+                carry_bits = pl & carry_bits
+            gathered = jax.lax.all_gather(nxt, "v")  # [P, v_loc, W]
+            fw_flat = gathered.transpose(1, 0, 2).reshape(v_pad, w)
+            fw_next = jnp.concatenate([fw_flat, jnp.zeros((1, w), jnp.uint32)])
+            alive = jnp.any(fw_flat != 0)
+            return fw_next, vis2, tuple(new_planes), level + 1, alive
+
+        fw_f, vis_f, planes_f, levels, _ = jax.lax.while_loop(
+            cond, body, (fw0, vis0, planes0, jnp.int32(0), jnp.bool_(True))
+        )
+        # Emit per-chip results with a leading axis for the P('v') out spec.
+        return (
+            tuple(pl[None] for pl in planes_f),
+            vis_f[None],
+            levels,
+        )
+
+    arr_specs = {
+        "virtual_t": P("v"),
+        "fold_pad_map": P("v"),
+        "heavy_pick": P("v"),
+    }
+    n_arrs = {}
+    if heavy:
+        # Transposed column layout so each unrolled gather reads one row.
+        n_arrs["virtual_t"] = np.ascontiguousarray(sell.virtual.transpose(0, 2, 1))
+        n_arrs["fold_pad_map"] = sell.fold_pad_map
+        n_arrs["heavy_pick"] = sell.heavy_pick
+    for i, (k, blocks) in enumerate(sell.light):
+        n_arrs[f"light{i}_t"] = np.ascontiguousarray(blocks.transpose(0, 2, 1))
+        arr_specs[f"light{i}_t"] = P("v")
+    arr_specs = {k: arr_specs.get(k, P("v")) for k in n_arrs}
+
+    core = jax.jit(
+        jax.shard_map(
+            chip_fn,
+            mesh=mesh,
+            in_specs=(arr_specs, P(), P()),
+            out_specs=(tuple(P("v") for _ in range(8)), P("v"), P()),
+        ),
+        static_argnums=(),
+    )
+    device_arrs = {
+        k: jax.device_put(v, NamedSharding(mesh, arr_specs[k]))
+        for k, v in n_arrs.items()
+    }
+    return core, device_arrs
+
+
+class DistPackedMsBfsEngine:
+    """Multi-chip packed MS-BFS: sharded ELL, replicated frontier words."""
+
+    def __init__(
+        self,
+        graph: Graph | ShardedEllGraph,
+        mesh: Mesh | int | None = None,
+        *,
+        lanes: int = 256,
+        kcap: int = 64,
+    ):
+        if lanes % 32:
+            raise ValueError("lanes must be a multiple of 32")
+        self.w = lanes // 32
+        self.lanes = lanes
+        self.mesh = mesh if isinstance(mesh, Mesh) else make_mesh(mesh)
+        p_count = self.mesh.devices.size
+        if isinstance(graph, Graph):
+            self.sell = build_ell_sharded(graph, p_count, kcap=kcap)
+        else:
+            self.sell = graph
+        if self.sell.num_shards != p_count:
+            raise ValueError(
+                f"ELL built for {self.sell.num_shards} shards, mesh has {p_count}"
+            )
+        self.undirected = self.sell.undirected
+        self._core, self.arrs = _make_dist_core(self.sell, self.w, self.mesh)
+        from tpu_bfs.algorithms.msbfs_packed import _make_core
+
+        # Reuse the single-chip extractor on chip-major concatenated arrays.
+        self._extract = _make_extract(self.sell.v_pad, self.w)
+        self._warmed = False
+
+    def _seed(self, sources: np.ndarray) -> np.ndarray:
+        sell = self.sell
+        fw0 = np.zeros((sell.v_pad + 1, self.w), np.uint32)
+        for i, r in enumerate(sell.rank[sources]):
+            fw0[r, i // 32] |= np.uint32(1 << (i % 32))
+        return fw0
+
+    def run(
+        self, sources, *, max_levels: int = MAX_LEVELS, time_it: bool = False
+    ) -> PackedBfsResult:
+        sell = self.sell
+        sources = np.asarray(sources, dtype=np.int64)
+        if sources.ndim != 1 or len(sources) == 0 or len(sources) > self.lanes:
+            raise ValueError(f"need 1..{self.lanes} sources, got {sources.shape}")
+        if sources.min() < 0 or sources.max() >= sell.num_vertices:
+            raise ValueError("source out of range")
+        max_levels = min(max_levels, MAX_LEVELS)
+
+        fw0 = jnp.asarray(self._seed(sources))
+        if time_it and not self._warmed:
+            int(self._core(self.arrs, fw0, jnp.int32(max_levels))[2])
+        t0 = time.perf_counter()
+        planes, vis, levels = self._core(self.arrs, fw0, jnp.int32(max_levels))
+        levels = int(levels)
+        elapsed = (time.perf_counter() - t0) if time_it else None
+        self._warmed = True
+
+        # planes/vis are chip-major: row p * v_loc + l holds rank l * P + p.
+        p_count, v_loc = sell.num_shards, sell.v_loc
+        src_cm = (
+            fw0[: sell.v_pad]
+            .reshape(v_loc, p_count, self.w)
+            .transpose(1, 0, 2)
+            .reshape(sell.v_pad, self.w)
+        )
+        dist_cm = np.asarray(self._extract(planes, vis, src_cm))
+        ranks = sell.rank.astype(np.int64)
+        row_of_old = (ranks % p_count) * v_loc + ranks // p_count
+        s = len(sources)
+        dist = np.ascontiguousarray(dist_cm[row_of_old][:, :s].T)
+
+        reached_mask = dist != np.uint8(255)
+        if reached_mask.any():
+            levels = int(dist[reached_mask].max())
+        reached = reached_mask.sum(axis=1).astype(np.int64)
+        slot_sum = reached_mask @ sell.in_degree
+        edges = slot_sum // 2 if self.undirected else slot_sum
+        return PackedBfsResult(
+            sources=sources.astype(np.int32),
+            distance_u8=dist,
+            num_levels=levels,
+            reached=reached,
+            edges_traversed=edges.astype(np.int64),
+            elapsed_s=elapsed,
+        )
+
+
+def _make_extract(v: int, w: int):
+    """Unpack bit-sliced counters to per-lane uint8 distances [v, 32w]."""
+    from tpu_bfs.algorithms.msbfs_packed import UNREACHED
+
+    @jax.jit
+    def extract(planes, vis, src_bits):
+        shifts = jnp.arange(32, dtype=jnp.uint32)
+        cols = []
+        for wi in range(w):
+            cnt = jnp.zeros((v, 32), jnp.uint8)
+            for i, p in enumerate(planes):
+                bit = ((p[:, wi, None] >> shifts) & 1).astype(jnp.uint8)
+                cnt = cnt + (bit << i)
+            visw = ((vis[:, wi, None] >> shifts) & 1) != 0
+            srcw = ((src_bits[:, wi, None] >> shifts) & 1) != 0
+            dist_w = jnp.where(
+                srcw,
+                jnp.uint8(0),
+                jnp.where(visw, cnt + jnp.uint8(1), UNREACHED),
+            )
+            cols.append(dist_w)
+        return jnp.concatenate(cols, axis=1)
+
+    return extract

@@ -1,0 +1,75 @@
+"""Per-level BFS statistics.
+
+The reference's only observability is printf: commented-out debug kernel twins
+(bfs.cu:53-96, 168-189), a raised printf FIFO limit (bfs.cu:486-490), and
+wall-clock prints (bfs.cu:624-626). Here the level structure is recovered
+exactly from the final distance array — frontier-size-by-level is its
+histogram, and edges scanned per level is the degree-weighted histogram — so
+stats cost nothing in the device loop and are available for every engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import numpy as np
+
+from tpu_bfs.graph.csr import INF_DIST
+
+
+@dataclasses.dataclass(frozen=True)
+class LevelStats:
+    """Per-level traversal statistics for one BFS run."""
+
+    frontier_size: np.ndarray  # [L+1] vertices discovered at each level
+    edges_scanned: np.ndarray  # [L+1] sum of out-degrees of each level's frontier
+    reached: int
+    unreached: int
+
+    @property
+    def num_levels(self) -> int:
+        return len(self.frontier_size) - 1
+
+    def json_lines(self) -> list[str]:
+        """One JSON object per level (the --stats output format)."""
+        return [
+            json.dumps(
+                {
+                    "level": lvl,
+                    "frontier": int(self.frontier_size[lvl]),
+                    "edges_scanned": int(self.edges_scanned[lvl]),
+                }
+            )
+            for lvl in range(len(self.frontier_size))
+        ]
+
+
+def level_stats(distance: np.ndarray, degrees: np.ndarray) -> LevelStats:
+    """Compute LevelStats from a distance array (int32, INF_DIST = unreached).
+
+    ``edges_scanned[l]`` is the work a level-synchronous sweep performs
+    expanding level l — the degree sum of that level's frontier.
+    """
+    distance = np.asarray(distance)
+    reached_mask = distance != INF_DIST
+    reached = distance[reached_mask]
+    if reached.size == 0:
+        return LevelStats(
+            frontier_size=np.zeros(1, np.int64),
+            edges_scanned=np.zeros(1, np.int64),
+            reached=0,
+            unreached=int((~reached_mask).sum()),
+        )
+    n_levels = int(reached.max())
+    frontier = np.bincount(reached, minlength=n_levels + 1).astype(np.int64)
+    edges = np.bincount(
+        reached, weights=np.asarray(degrees, np.float64)[reached_mask],
+        minlength=n_levels + 1,
+    ).astype(np.int64)
+    return LevelStats(
+        frontier_size=frontier,
+        edges_scanned=edges,
+        reached=int(reached_mask.sum()),
+        unreached=int((~reached_mask).sum()),
+    )

@@ -13,7 +13,7 @@ from tpu_bfs.algorithms.bfs import BfsEngine, bfs
 from tpu_bfs.graph.csr import INF_DIST
 from tpu_bfs.reference import bfs_python
 
-BACKENDS = ["scan", "segment", "scatter", "delta"]
+BACKENDS = ["scan", "segment", "scatter", "delta", "dopt"]
 
 
 @pytest.mark.parametrize("backend", BACKENDS)
@@ -70,6 +70,46 @@ def test_min_parent_determinism(random_small):
     np.testing.assert_array_equal(p1, p2)
     mp = validate.min_parent_from_dist(random_small, 7, eng.run(7).distance)
     np.testing.assert_array_equal(p1, mp)
+
+
+@pytest.mark.parametrize(
+    "caps",
+    [
+        (),  # ladder empty: dense branch every level
+        (8,),  # tiny cap: sparse for 1-vertex levels, dense for the rest
+        (8, 64, 100000),  # full ladder incl. a cap that always fits
+    ],
+)
+def test_dopt_cap_ladder(random_small, caps):
+    # The direction-optimizing switch must be invisible in the results: every
+    # ladder (incl. degenerate ones) yields the golden distances.
+    eng = BfsEngine(random_small, backend="dopt", caps=caps)
+    for src in [0, 321]:
+        golden, _ = bfs_python(random_small, src)
+        res = eng.run(src)
+        validate.check_distances(res.distance, golden)
+        validate.check_parents(random_small, src, res.distance, res.parent)
+
+
+def test_dopt_line_graph_sparse_path(line_graph):
+    # 63 one-vertex frontiers: every level runs the sparse top-down branch.
+    eng = BfsEngine(line_graph, backend="dopt", caps=(8,))
+    res = eng.run(0)
+    np.testing.assert_array_equal(res.distance, np.arange(64))
+
+
+def test_dopt_directed():
+    # Directed graph with an out-degree-0 reachable vertex: the vertex-count
+    # guard (nfront <= vert_cap) must still hold and results stay golden.
+    import io as _io
+
+    from tpu_bfs.graph.io import read_stdin
+
+    g = read_stdin(_io.StringIO("6 6\n0 1\n0 2\n1 3\n2 4\n3 5\n4 5\n"))
+    eng = BfsEngine(g, backend="dopt", caps=(4,))
+    golden, _ = bfs_python(g, 0)
+    res = eng.run(0, with_parents=False)
+    validate.check_distances(res.distance, golden)
 
 
 def test_max_levels_cutoff(line_graph):

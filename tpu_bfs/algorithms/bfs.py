@@ -22,8 +22,8 @@ from tpu_bfs.algorithms.frontier import EdgeData, level_step, extract_parents, I
 from tpu_bfs.utils.timing import run_timed
 
 
-@partial(jax.jit, static_argnames=("backend",), donate_argnums=())
-def _bfs_core(edges, frontier0, visited0, dist0, max_levels, *, backend):
+@partial(jax.jit, static_argnames=("backend", "caps"), donate_argnums=())
+def _bfs_core(edges, frontier0, visited0, dist0, max_levels, *, backend, caps=()):
     """The compiled level loop. All shapes static; source/max_levels traced."""
 
     def cond(state):
@@ -32,7 +32,7 @@ def _bfs_core(edges, frontier0, visited0, dist0, max_levels, *, backend):
 
     def body(state):
         frontier, visited, dist, level = state
-        new = level_step(edges, frontier, visited, backend=backend)
+        new = level_step(edges, frontier, visited, backend=backend, caps=caps)
         dist = jnp.where(new, level + 1, dist)
         visited = visited | new
         return new, visited, dist, level + 1
@@ -82,6 +82,7 @@ class BfsEngine:
         *,
         backend: str = "scan",
         device=None,
+        caps: tuple[int, ...] | None = None,
     ):
         dg = DeviceGraph.from_graph(graph) if isinstance(graph, Graph) else graph
         if dg.ep >= 2**31 - 1:
@@ -96,12 +97,30 @@ class BfsEngine:
         self.dst = put(jnp.asarray(dg.dst))
         self.in_row_ptr = put(jnp.asarray(dg.in_row_ptr.astype(np.int32)))
         need_delta = backend == "delta"
+        need_dopt = backend == "dopt"
+        nbr_sm = None
+        if need_dopt:
+            # Neighbor ids in src-major order: dst_sm[perm_ds[i]] = dst[i].
+            dst_sm = np.empty(dg.ep, dtype=np.int32)
+            dst_sm[dg.perm_ds] = dg.dst
+            nbr_sm = put(jnp.asarray(dst_sm))
+        if caps is None:
+            # Capacity ladder for the sparse branches: ~E/64 and ~E/8, lane-
+            # aligned. Levels whose frontier out-degree sum exceeds the top
+            # rung run the dense step.
+            caps = tuple(
+                max(1024, (dg.ep >> s) // 1024 * 1024) for s in (6, 3)
+            ) if need_dopt else ()
+        self.caps = tuple(sorted(set(caps)))
         self.edges = EdgeData(
             src=self.src,
             dst=self.dst,
             in_rp=self.in_row_ptr,
-            out_rp=put(jnp.asarray(dg.out_row_ptr.astype(np.int32))) if need_delta else None,
+            out_rp=put(jnp.asarray(dg.out_row_ptr.astype(np.int32)))
+            if (need_delta or need_dopt)
+            else None,
             perm_ds=put(jnp.asarray(dg.perm_ds)) if need_delta else None,
+            nbr_sm=nbr_sm,
         )
         self._warmed = False
 
@@ -121,7 +140,8 @@ class BfsEngine:
         frontier0, visited0, dist0 = self._init_state(source)
         ml = jnp.int32(max_levels if max_levels is not None else self.vp)
         return _bfs_core(
-            self.edges, frontier0, visited0, dist0, ml, backend=self.backend
+            self.edges, frontier0, visited0, dist0, ml,
+            backend=self.backend, caps=self.caps,
         )
 
     def run(

@@ -31,6 +31,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 INT32_MAX = jnp.iinfo(jnp.int32).max
 
@@ -38,13 +39,14 @@ INT32_MAX = jnp.iinfo(jnp.int32).max
 class EdgeData(NamedTuple):
     """Device-resident edge arrays for one chip (see DeviceGraph).
 
-    out_rp / perm_ds may be None for backends that don't need them."""
+    out_rp / perm_ds / nbr_sm may be None for backends that don't need them."""
 
     src: jax.Array  # [ep] dst-major
     dst: jax.Array  # [ep] non-decreasing
     in_rp: jax.Array  # [vp+1] CSR-by-dst boundaries
     out_rp: jax.Array | None = None  # [vp+1] CSR-by-src boundaries (src-major order)
     perm_ds: jax.Array | None = None  # [ep] src-major position of dst-major edge i
+    nbr_sm: jax.Array | None = None  # [ep] neighbor (dst) ids in src-major order
 
 # Registry of frontier-expansion backends; 'pallas' is registered by
 # tpu_bfs.ops when available.
@@ -114,7 +116,88 @@ def active_bits_delta(frontier, out_rp, ep: int):
     return jnp.cumsum(delta, axis=0)[:ep] > 0
 
 
-def level_step(edges: EdgeData, frontier, visited, *, backend: str = "scan"):
+def sparse_topdown(edges: EdgeData, frontier, visited, *, edge_cap: int, vert_cap: int):
+    """One top-down level over ONLY the frontier's out-edges, in static shapes.
+
+    The direction-optimizing counterpart of the dense step: compaction
+    (``nonzero`` = cumsum + scatter, the TPU form of the reference's dead
+    scan-BFS queue generation, bfs.cu:706-781) lays the frontier's adjacency
+    lists head-to-head in a fixed ``edge_cap``-slot buffer, one gather
+    fetches the neighbors, one scatter-or marks the hits. Work is
+    O(edge_cap + vert_cap) regardless of E — callers pick this branch only
+    when the frontier's out-degree sum fits (see level_step_dopt).
+    """
+    vp = frontier.shape[0]
+    out_rp = edges.out_rp
+    nfront = jnp.sum(frontier.astype(jnp.int32))
+    (vids,) = jnp.nonzero(frontier, size=vert_cap, fill_value=0)
+    slot_ok = jnp.arange(vert_cap, dtype=jnp.int32) < nfront
+    deg = jnp.where(slot_ok, out_rp[vids + 1] - out_rp[vids], 0)
+    ends = jnp.cumsum(deg)
+    starts = ends - deg
+    total = ends[-1]
+    # owner[j] = which compacted row edge-slot j belongs to: +1 at each row
+    # start, prefix-summed (deg-0 rows collapse harmlessly: they own no slots).
+    delta = (
+        jnp.zeros((edge_cap + 1,), jnp.int32)
+        .at[jnp.minimum(starts, edge_cap)]
+        .add(slot_ok.astype(jnp.int32))
+    )
+    owner = jnp.cumsum(delta[:edge_cap]) - 1
+    eslot = jnp.arange(edge_cap, dtype=jnp.int32)
+    valid = eslot < total
+    owner = jnp.clip(owner, 0, vert_cap - 1)
+    eidx = out_rp[vids[owner]] + (eslot - starts[owner])
+    nbr = edges.nbr_sm[jnp.where(valid, eidx, 0)]
+    hit = (
+        jnp.zeros((vp,), jnp.bool_)
+        .at[jnp.where(valid, nbr, vp - 1)]
+        .max(valid, mode="drop")
+    )
+    # The guard writes at vp-1 may alias a real phantom-free graph's last
+    # vertex only when valid is False there, so the value written is False.
+    return hit & ~visited
+
+
+def level_step_dopt(
+    edges: EdgeData, frontier, visited, *, caps: tuple, dense_backend: str = "scan"
+):
+    """Direction-optimizing level step: Beamer's top-down/bottom-up switch in
+    static-shape form.
+
+    ``caps`` is an ascending ladder of edge capacities; the smallest sparse
+    branch whose capacity covers the frontier's out-degree sum runs top-down
+    (sparse_topdown), otherwise the dense edge-centric step runs — the
+    bottom-up analog, whose cost is frontier-independent. ``lax.cond``
+    executes exactly one branch at runtime, so light levels (BFS start/tail,
+    high-diameter graphs) cost O(cap) instead of O(E).
+    """
+    out_deg = edges.out_rp[1:] - edges.out_rp[:-1]
+    fsum = jnp.sum(jnp.where(frontier, out_deg, 0))
+    nfront = jnp.sum(frontier.astype(jnp.int32))
+
+    def dense_fn():
+        active = frontier[edges.src]
+        return expand_or(
+            active, edges.dst, edges.in_rp, frontier.shape[0], backend=dense_backend
+        ) & ~visited
+
+    def make_sparse(edge_cap, vert_cap):
+        return lambda: sparse_topdown(
+            edges, frontier, visited, edge_cap=edge_cap, vert_cap=vert_cap
+        )
+
+    step = dense_fn
+    for edge_cap in sorted(caps, reverse=True):
+        vert_cap = min(edge_cap, frontier.shape[0])
+        fits = (fsum <= edge_cap) & (nfront <= vert_cap)
+        step = partial(
+            lax.cond, fits, make_sparse(edge_cap, vert_cap), step
+        )
+    return step()
+
+
+def level_step(edges: EdgeData, frontier, visited, *, backend: str = "scan", caps=()):
     """One BFS level: returns the next frontier mask.
 
     Semantics of one iteration of the reference's level loop
@@ -126,8 +209,13 @@ def level_step(edges: EdgeData, frontier, visited, *, backend: str = "scan"):
     but the index vector is fixed at build time and data-independent, which a
     compiler/kernel can exploit (and which the other backends cannot). Whether
     it wins over 'scan' is hardware-dependent — benchmark both.
+
+    backend='dopt' is the direction-optimizing step (level_step_dopt) with
+    the static edge-capacity ladder ``caps``.
     """
     vp = frontier.shape[0]
+    if backend == "dopt":
+        return level_step_dopt(edges, frontier, visited, caps=caps)
     if backend == "delta":
         act_src = active_bits_delta(frontier, edges.out_rp, edges.perm_ds.shape[0])
         active = act_src[edges.perm_ds]

@@ -67,20 +67,21 @@ class PackedBfsResult:
         return np.where(self.distance_u8[s] == UNREACHED, INF_DIST, d)
 
 
-def _make_core(ell: EllGraph, w: int):
-    """Build the jitted level loop for one ELL structure; arrays are passed as
-    a pytree so they live on device once and never get baked into the HLO."""
-    v = ell.num_vertices
-    n_tail = v - ell.num_nonzero
-    kcap = ell.kcap
-    fold_steps = ell.fold_steps
-    light_meta = [(b.n, b.k) for b in ell.light]
-    num_heavy = ell.num_heavy
-    num_virtual = ell.num_virtual
+def make_packed_expand(
+    *, w: int, kcap: int, fold_steps: int, num_virtual: int,
+    light_meta: list[tuple[int, int]], heavy: bool, tail_rows: int,
+):
+    """Build the bucketed-ELL expansion: frontier table ``fw`` [rows+1, w] ->
+    OR of the frontier words of each row's in-neighbors.
+
+    Shared by the single-chip engine (rows = V) and each chip of the
+    distributed engine (rows = its v_loc owned rows); ``light_meta`` is a list
+    of (k, n) bucket shapes, ``tail_rows`` the appended all-zero rows.
+    """
 
     def expand(arrs, fw):
         parts = []
-        if num_heavy:
+        if heavy:
             vr_t = arrs["virtual_t"]  # [kcap, M]
             acc = jnp.zeros((num_virtual, w), jnp.uint32)
             for k in range(kcap):
@@ -94,15 +95,41 @@ def _make_core(ell: EllGraph, w: int):
                 pyramid.append(cur)
             pyr = jnp.concatenate(pyramid) if len(pyramid) > 1 else pyramid[0]
             parts.append(pyr[arrs["heavy_pick"]])
-        for i, (n, k) in enumerate(light_meta):
+        for i, (k, n) in enumerate(light_meta):
             bt = arrs[f"light{i}_t"]  # [k, n]
             acc = jnp.zeros((n, w), jnp.uint32)
             for kk in range(k):
                 acc = acc | fw[bt[kk]]
             parts.append(acc)
-        if n_tail:
-            parts.append(jnp.zeros((n_tail, w), jnp.uint32))
+        if tail_rows:
+            parts.append(jnp.zeros((tail_rows, w), jnp.uint32))
         return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+
+    return expand
+
+
+def ripple_increment(planes, carry_bits):
+    """Bit-sliced ripple-carry: planes + 1 wherever carry_bits is set."""
+    new_planes = []
+    for p in planes:
+        new_planes.append(p ^ carry_bits)
+        carry_bits = p & carry_bits
+    return tuple(new_planes)
+
+
+def _make_core(ell: EllGraph, w: int):
+    """Build the jitted level loop for one ELL structure; arrays are passed as
+    a pytree so they live on device once and never get baked into the HLO."""
+    v = ell.num_vertices
+    expand = make_packed_expand(
+        w=w,
+        kcap=ell.kcap,
+        fold_steps=ell.fold_steps,
+        num_virtual=ell.num_virtual,
+        light_meta=[(b.k, b.n) for b in ell.light],
+        heavy=ell.num_heavy > 0,
+        tail_rows=v - ell.num_nonzero,
+    )
 
     @jax.jit
     def core(arrs, fw0, vis0, max_levels):
@@ -117,16 +144,12 @@ def _make_core(ell: EllGraph, w: int):
             hit = expand(arrs, fw)
             nxt = hit & ~vis
             vis2 = vis | nxt
-            # Ripple-carry increment of the bit-sliced per-lane level counter
-            # wherever the lane is still unvisited after this level.
-            carry_bits = ~vis2
-            new_planes = []
-            for p in planes:
-                new_planes.append(p ^ carry_bits)
-                carry_bits = p & carry_bits
+            # Increment the per-lane level counter wherever the lane is still
+            # unvisited after this level.
+            planes = ripple_increment(planes, ~vis2)
             fw_next = jnp.concatenate([nxt, jnp.zeros((1, w), jnp.uint32)])
             alive = jnp.any(nxt != 0)
-            return fw_next, vis2, tuple(new_planes), level + 1, alive
+            return fw_next, vis2, planes, level + 1, alive
 
         fw_f, vis_f, planes_f, levels, _ = jax.lax.while_loop(
             cond, body, (fw0, vis0, planes0, jnp.int32(0), jnp.bool_(True))

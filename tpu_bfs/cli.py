@@ -79,10 +79,22 @@ def _run_multi_source(args, g, golden) -> int:
         raise SystemExit(f"--multi-source must be comma-separated ints, got "
                          f"{args.multi_source!r}")
     sources = np.asarray([args.source] + extra)
+    bad = sources[(sources < 0) | (sources >= g.num_vertices)]
+    if len(bad):
+        raise SystemExit(
+            f"--multi-source vertices {bad.tolist()} out of range "
+            f"[0, {g.num_vertices})"
+        )
     lanes = max(32, -(-len(sources) // 32) * 32)
     engine = PackedMsBfsEngine(g, lanes=lanes)
-    with _maybe_profile(args.profile_dir):
-        res = engine.run(sources, time_it=True)
+    res = None
+    for _ in range(max(1, args.repeat)):
+        with _maybe_profile(args.profile_dir):
+            res = engine.run(
+                sources,
+                max_levels=args.max_levels if args.max_levels else 254,
+                time_it=True,
+            )
     print(f"Elapsed time in milliseconds (device): {res.elapsed_s * 1e3:.3f} "
           f"({len(sources)} sources)")
     for i, s in enumerate(sources):
@@ -119,8 +131,10 @@ def main(argv=None) -> int:
     ap.add_argument("--mesh", default=None, metavar="RxC",
                     help="2D mesh shape (e.g. 2x4): uses the 2D edge partition "
                     "engine instead of the 1D vertex partition")
-    ap.add_argument("--backend", default="scan", choices=["scan", "segment", "scatter", "delta"],
-                    help="single-device frontier-expansion backend")
+    ap.add_argument("--backend", default="scan",
+                    choices=["scan", "segment", "scatter", "delta", "dopt"],
+                    help="single-device frontier-expansion backend ('dopt' = "
+                    "direction-optimizing top-down/bottom-up switch)")
     ap.add_argument("--exchange", default="ring", choices=["ring", "allreduce"],
                     help="multi-device frontier exchange implementation")
     ap.add_argument("--max-levels", type=int, default=None)
@@ -137,10 +151,13 @@ def main(argv=None) -> int:
     ap.add_argument("--profile-dir", default=None,
                     help="write a jax.profiler trace of the timed run here")
     args = ap.parse_args(argv)
-    if (args.mesh or args.devices > 1) and args.backend == "delta":
-        ap.error("--backend delta is single-device only (for now)")
+    if (args.mesh or args.devices > 1) and args.backend in ("delta", "dopt"):
+        ap.error(f"--backend {args.backend} is single-device only (for now)")
     if args.multi_source and (args.mesh or args.devices > 1):
         ap.error("--multi-source is single-device only (for now)")
+    if args.multi_source and args.save_parent:
+        ap.error("--multi-source computes distances only; --save-parent is "
+                 "unavailable (use single-source mode for the parent tree)")
 
     import numpy as np
 
@@ -153,6 +170,10 @@ def main(argv=None) -> int:
     print(f"Number of vertices {g.num_vertices}")  # reference prints these (bfs.cu:789-790)
     print(f"Number of edges {g.num_edges}")
     print(f"[load] {time.perf_counter() - t0:.3f}s")
+    if not (0 <= args.source < g.num_vertices):
+        raise SystemExit(
+            f"source {args.source} out of range [0, {g.num_vertices})"
+        )
 
     golden = None
     if not args.skip_cpu:

@@ -32,7 +32,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tpu_bfs.algorithms.msbfs_packed import MAX_LEVELS, PackedBfsResult
+from tpu_bfs.algorithms.msbfs_packed import (
+    MAX_LEVELS,
+    PackedBfsResult,
+    make_packed_expand,
+    ripple_increment,
+)
 from tpu_bfs.graph.csr import Graph
 from tpu_bfs.graph.ell import ShardedEllGraph, build_ell_sharded
 from tpu_bfs.parallel.dist_bfs import make_mesh
@@ -42,40 +47,19 @@ def _make_dist_core(sell: ShardedEllGraph, w: int, mesh: Mesh):
     p_count = sell.num_shards
     v_loc = sell.v_loc
     v_pad = sell.v_pad
-    kcap = sell.kcap
-    fold_steps = sell.fold_steps
-    light_meta = [(k, blocks.shape[1]) for k, blocks in sell.light]
+    # Owned-row expansion: fw is the replicated [v_pad+1, W] table; the result
+    # is this chip's [v_loc, W] rows in local (rank // P) order. Same bucketed
+    # kernel as the single-chip engine, instantiated per shard.
+    expand = make_packed_expand(
+        w=w,
+        kcap=sell.kcap,
+        fold_steps=sell.fold_steps,
+        num_virtual=sell.num_virtual,
+        light_meta=[(k, blocks.shape[1]) for k, blocks in sell.light],
+        heavy=sell.heavy_per_shard > 0,
+        tail_rows=sell.tail_rows,
+    )
     heavy = sell.heavy_per_shard > 0
-    num_virtual = sell.num_virtual
-    tail = sell.tail_rows
-
-    def expand(arrs, fw):
-        """Owned-row expansion: fw is the replicated [v_pad+1, W] table; the
-        result is this chip's [v_loc, W] rows in local (rank // P) order."""
-        parts = []
-        if heavy:
-            vr_t = arrs["virtual_t"]  # [kcap, M]
-            acc = jnp.zeros((num_virtual, w), jnp.uint32)
-            for k in range(kcap):
-                acc = acc | fw[vr_t[k]]
-            vr_ext = jnp.concatenate([acc, jnp.zeros((1, w), jnp.uint32)])
-            cur = vr_ext[arrs["fold_pad_map"]]
-            pyramid = [cur]
-            for _ in range(fold_steps):
-                pairs = cur.reshape(-1, 2, w)
-                cur = pairs[:, 0] | pairs[:, 1]
-                pyramid.append(cur)
-            pyr = jnp.concatenate(pyramid) if len(pyramid) > 1 else pyramid[0]
-            parts.append(pyr[arrs["heavy_pick"]])
-        for i, (k, n) in enumerate(light_meta):
-            bt = arrs[f"light{i}_t"]  # [k, n]
-            acc = jnp.zeros((n, w), jnp.uint32)
-            for kk in range(k):
-                acc = acc | fw[bt[kk]]
-            parts.append(acc)
-        if tail:
-            parts.append(jnp.zeros((tail, w), jnp.uint32))
-        return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
 
     def chip_fn(arrs, fw0, max_levels):
         # Block specs keep a leading axis of size 1; drop it.
@@ -96,16 +80,12 @@ def _make_dist_core(sell: ShardedEllGraph, w: int, mesh: Mesh):
             hit = expand(arrs, fw)
             nxt = hit & ~vis
             vis2 = vis | nxt
-            carry_bits = ~vis2
-            new_planes = []
-            for pl in planes:
-                new_planes.append(pl ^ carry_bits)
-                carry_bits = pl & carry_bits
+            planes = ripple_increment(planes, ~vis2)
             gathered = jax.lax.all_gather(nxt, "v")  # [P, v_loc, W]
             fw_flat = gathered.transpose(1, 0, 2).reshape(v_pad, w)
             fw_next = jnp.concatenate([fw_flat, jnp.zeros((1, w), jnp.uint32)])
             alive = jnp.any(fw_flat != 0)
-            return fw_next, vis2, tuple(new_planes), level + 1, alive
+            return fw_next, vis2, planes, level + 1, alive
 
         fw_f, vis_f, planes_f, levels, _ = jax.lax.while_loop(
             cond, body, (fw0, vis0, planes0, jnp.int32(0), jnp.bool_(True))
@@ -139,8 +119,8 @@ def _make_dist_core(sell: ShardedEllGraph, w: int, mesh: Mesh):
             mesh=mesh,
             in_specs=(arr_specs, P(), P()),
             out_specs=(tuple(P("v") for _ in range(8)), P("v"), P()),
-        ),
-        static_argnums=(),
+            check_vma=False,
+        )
     )
     device_arrs = {
         k: jax.device_put(v, NamedSharding(mesh, arr_specs[k]))
@@ -176,9 +156,7 @@ class DistPackedMsBfsEngine:
             )
         self.undirected = self.sell.undirected
         self._core, self.arrs = _make_dist_core(self.sell, self.w, self.mesh)
-        from tpu_bfs.algorithms.msbfs_packed import _make_core
-
-        # Reuse the single-chip extractor on chip-major concatenated arrays.
+        # Unpacks chip-major [v_pad, w] planes (see run() for the row order).
         self._extract = _make_extract(self.sell.v_pad, self.w)
         self._warmed = False
 
@@ -209,8 +187,12 @@ class DistPackedMsBfsEngine:
         elapsed = (time.perf_counter() - t0) if time_it else None
         self._warmed = True
 
-        # planes/vis are chip-major: row p * v_loc + l holds rank l * P + p.
+        # The P('v') out-spec concatenates per-chip [1, v_loc, w] blocks into
+        # [P, v_loc, w]; flatten to chip-major [v_pad, w], where row
+        # p * v_loc + l holds rank l * P + p.
         p_count, v_loc = sell.num_shards, sell.v_loc
+        planes = tuple(pl.reshape(sell.v_pad, self.w) for pl in planes)
+        vis = vis.reshape(sell.v_pad, self.w)
         src_cm = (
             fw0[: sell.v_pad]
             .reshape(v_loc, p_count, self.w)

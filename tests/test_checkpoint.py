@@ -1,0 +1,136 @@
+"""Checkpoint / resume tests.
+
+The reference has no checkpointing (SURVEY.md §5); these tests pin the
+contract of the new subsystem: chunked advancing is bit-identical to one
+uninterrupted run, checkpoints round-trip through disk (single-file and
+per-shard), and a sharded checkpoint resumes on a different mesh size.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_bfs import validate
+from tpu_bfs.algorithms.bfs import BfsEngine
+from tpu_bfs.reference import bfs_python
+from tpu_bfs.utils import checkpoint as ckpt_mod
+
+
+def test_advance_in_chunks_matches_full_run(toy_graph):
+    eng = BfsEngine(toy_graph)
+    full = eng.run(0)
+
+    st = eng.start(0)
+    hops = 0
+    while not st.done:
+        st = eng.advance(st, levels=1)
+        hops += 1
+        assert hops < 64
+    res = eng.finish(st)
+    np.testing.assert_array_equal(res.distance, full.distance)
+    np.testing.assert_array_equal(res.parent, full.parent)
+    # level counter includes the final empty-frontier step
+    assert st.level == full.num_levels + 1
+
+
+def test_partial_state_is_a_prefix(line_graph):
+    # On the 64-path from vertex 0, after k levels exactly k+1 vertices are
+    # labeled; the rest still INF.
+    from tpu_bfs.graph.csr import INF_DIST
+
+    eng = BfsEngine(line_graph)
+    st = eng.start(0)
+    st = eng.advance(st, levels=5)
+    assert st.level == 5 and not st.done
+    labeled = st.distance != INF_DIST
+    assert labeled.sum() == 6
+    np.testing.assert_array_equal(np.flatnonzero(labeled), np.arange(6))
+
+
+def test_checkpoint_roundtrip(tmp_path, random_small):
+    eng = BfsEngine(random_small)
+    st = eng.advance(eng.start(3), levels=2)
+    path = str(tmp_path / "ck.npz")
+    ckpt_mod.save_checkpoint(path, st)
+    st2 = ckpt_mod.load_checkpoint(path)
+    assert st2.source == 3 and st2.level == st.level and st2.done == st.done
+    np.testing.assert_array_equal(st2.frontier, st.frontier)
+    np.testing.assert_array_equal(st2.distance, st.distance)
+
+    # Resume the loaded state to completion; must match golden.
+    while not st2.done:
+        st2 = eng.advance(st2, levels=1)
+    golden, _ = bfs_python(random_small, 3)
+    validate.check_distances(eng.finish(st2).distance, golden)
+
+
+def test_result_roundtrip(tmp_path, random_small):
+    eng = BfsEngine(random_small)
+    res = eng.run(7)
+    path = str(tmp_path / "res.npz")
+    ckpt_mod.save_result(path, res)
+    back = ckpt_mod.load_result(path)
+    assert back.source == 7
+    assert back.num_levels == res.num_levels
+    assert back.reached == res.reached
+    assert back.edges_traversed == res.edges_traversed
+    np.testing.assert_array_equal(back.distance, res.distance)
+    np.testing.assert_array_equal(back.parent, res.parent)
+
+
+class TestDistributed:
+    @pytest.fixture(scope="class")
+    def engines(self, random_small):
+        from tpu_bfs.parallel.dist_bfs import DistBfsEngine, make_mesh
+
+        return (
+            DistBfsEngine(random_small, make_mesh(4)),
+            DistBfsEngine(random_small, make_mesh(2)),
+        )
+
+    def test_dist_advance_matches_full(self, engines, random_small):
+        eng4, _ = engines
+        full = eng4.run(0)
+        st = eng4.start(0)
+        while not st.done:
+            st = eng4.advance(st, levels=2)
+        res = eng4.finish(st)
+        np.testing.assert_array_equal(res.distance, full.distance)
+        np.testing.assert_array_equal(res.parent, full.parent)
+
+    def test_sharded_roundtrip_and_elastic_resume(self, tmp_path, engines, random_small):
+        # Checkpoint mid-traversal on a 4-chip mesh, save per-shard files,
+        # reload, resume on a 2-chip mesh: the reference cannot even change
+        # device count without recompiling (DeviceNum, bfs.cu:19).
+        eng4, eng2 = engines
+        st = eng4.advance(eng4.start(1), levels=2)
+        d = str(tmp_path / "shards")
+        ckpt_mod.save_checkpoint_sharded(d, st, num_shards=4)
+        st2 = ckpt_mod.load_checkpoint_sharded(d)
+        assert st2.level == st.level
+        np.testing.assert_array_equal(st2.distance, st.distance)
+
+        while not st2.done:
+            st2 = eng2.advance(st2, levels=1)
+        golden, _ = bfs_python(random_small, 1)
+        validate.check_distances(
+            eng2.finish(st2, with_parents=False).distance, golden
+        )
+
+    def test_cross_engine_portability(self, engines, random_small):
+        # A checkpoint taken on the single-chip engine resumes on the
+        # distributed engine (and vice versa).
+        eng4, _ = engines
+        single = BfsEngine(random_small)
+        st = single.advance(single.start(2), levels=2)
+        while not st.done:
+            st = eng4.advance(st, levels=1)
+        golden, _ = bfs_python(random_small, 2)
+        validate.check_distances(
+            eng4.finish(st, with_parents=False).distance, golden
+        )
+
+    def test_shard_count_bounds(self, engines):
+        eng4, _ = engines
+        st = eng4.start(0)
+        with pytest.raises(ValueError):
+            ckpt_mod.save_checkpoint_sharded("/tmp/nope", st, num_shards=10**9)

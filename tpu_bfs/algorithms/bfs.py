@@ -23,8 +23,13 @@ from tpu_bfs.utils.timing import run_timed
 
 
 @partial(jax.jit, static_argnames=("backend", "caps"), donate_argnums=())
-def _bfs_core(edges, frontier0, visited0, dist0, max_levels, *, backend, caps=()):
-    """The compiled level loop. All shapes static; source/max_levels traced."""
+def _bfs_core(edges, frontier0, visited0, dist0, level0, max_levels, *, backend, caps=()):
+    """The compiled level loop. All shapes static; source/levels traced.
+
+    ``level0`` is the level counter of the incoming state (0 for a fresh
+    traversal, >0 when resuming from a checkpoint); the loop stops when the
+    frontier empties or the counter reaches ``max_levels``. Returns the full
+    state so callers can checkpoint and resume."""
 
     def cond(state):
         frontier, _, _, level = state
@@ -37,10 +42,10 @@ def _bfs_core(edges, frontier0, visited0, dist0, max_levels, *, backend, caps=()
         visited = visited | new
         return new, visited, dist, level + 1
 
-    _, _, dist, level = jax.lax.while_loop(
-        cond, body, (frontier0, visited0, dist0, jnp.int32(0))
+    frontier, visited, dist, level = jax.lax.while_loop(
+        cond, body, (frontier0, visited0, dist0, jnp.int32(level0))
     )
-    return dist, level
+    return frontier, visited, dist, level
 
 
 @dataclasses.dataclass
@@ -139,10 +144,72 @@ class BfsEngine:
         """Device distance array [vp] + level count; no host transfer."""
         frontier0, visited0, dist0 = self._init_state(source)
         ml = jnp.int32(max_levels if max_levels is not None else self.vp)
-        return _bfs_core(
-            self.edges, frontier0, visited0, dist0, ml,
+        _, _, dist, level = _bfs_core(
+            self.edges, frontier0, visited0, dist0, jnp.int32(0), ml,
             backend=self.backend, caps=self.caps,
         )
+        return dist, level
+
+    # --- checkpoint/resume (SURVEY.md §5: the reference has none) ---
+
+    def start(self, source: int):
+        """Level-0 traversal state as a host checkpoint (no device work).
+
+        Checkpoints hold real-vertex-id arrays [V], so they are portable
+        between engines, backends, and mesh shapes (see
+        tpu_bfs/utils/checkpoint.py)."""
+        from tpu_bfs.utils.checkpoint import initial_checkpoint
+
+        return initial_checkpoint(self.dg.num_vertices, source)
+
+    def _pad_state(self, ckpt):
+        v, vp = self.dg.num_vertices, self.vp
+        f = np.zeros(vp, dtype=bool)
+        f[:v] = ckpt.frontier
+        vis = np.zeros(vp, dtype=bool)
+        vis[:v] = ckpt.visited
+        d = np.full(vp, INF_DIST, dtype=np.int32)
+        d[:v] = ckpt.distance
+        return f, vis, d
+
+    def advance(self, ckpt, levels: int | None = None):
+        """Run at most ``levels`` more BFS levels from a checkpoint.
+
+        Returns a new host-side checkpoint; ``ckpt.done`` is True once the
+        frontier is empty. The device loop is the same compiled `_bfs_core` —
+        resuming N times produces bit-identical distances to one full run."""
+        from tpu_bfs.utils.checkpoint import BfsCheckpoint
+
+        if len(ckpt.frontier) != self.dg.num_vertices:
+            raise ValueError(
+                f"checkpoint has {len(ckpt.frontier)} vertices, graph has "
+                f"{self.dg.num_vertices}"
+            )
+        f0, vis0, d0 = self._pad_state(ckpt)
+        cap = ckpt.level + levels if levels is not None else self.vp
+        frontier, visited, dist, level = _bfs_core(
+            self.edges,
+            jnp.asarray(f0),
+            jnp.asarray(vis0),
+            jnp.asarray(d0),
+            jnp.int32(ckpt.level),
+            jnp.int32(min(cap, self.vp)),
+            backend=self.backend,
+            caps=self.caps,
+        )
+        v = self.dg.num_vertices
+        return BfsCheckpoint(
+            source=ckpt.source,
+            level=int(level),
+            frontier=np.asarray(frontier)[:v],
+            visited=np.asarray(visited)[:v],
+            distance=np.asarray(dist)[:v],
+        )
+
+    def finish(self, ckpt, *, with_parents: bool = True) -> BfsResult:
+        """Convert a (finished or partial) checkpoint into a BfsResult."""
+        _, _, d0 = self._pad_state(ckpt)
+        return self._package(jnp.asarray(d0), ckpt.source, with_parents, None)
 
     def run(
         self,
@@ -163,7 +230,9 @@ class BfsEngine:
             self._warmed = True
         else:
             dist_dev, level = self.distances(source, max_levels=max_levels)
+        return self._package(dist_dev, source, with_parents, elapsed)
 
+    def _package(self, dist_dev, source, with_parents, elapsed) -> BfsResult:
         parent = None
         if with_parents:
             parent_dev = extract_parents(self.src, self.dst, dist_dev, source)
@@ -173,8 +242,8 @@ class BfsEngine:
         dist = np.asarray(dist_dev)[:v]
         reached_mask = dist != INF_DIST
         reached = int(reached_mask.sum())
-        # `level` counts body executions, including the final step that finds
-        # an empty frontier; the source eccentricity is the max distance.
+        # The loop's level counter includes the final step that finds an empty
+        # frontier; the source eccentricity is the max distance.
         num_levels = int(dist[reached_mask].max()) if reached else 0
         edges_traversed = self._count_traversed_edges(reached_mask)
         return BfsResult(

@@ -92,7 +92,7 @@ def _run_multi_source(args, g, golden) -> int:
         with _maybe_profile(args.profile_dir):
             res = engine.run(
                 sources,
-                max_levels=args.max_levels if args.max_levels else 254,
+                max_levels=args.max_levels if args.max_levels is not None else 254,
                 time_it=True,
             )
     print(f"Elapsed time in milliseconds (device): {res.elapsed_s * 1e3:.3f} "

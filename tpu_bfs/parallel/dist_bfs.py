@@ -58,7 +58,7 @@ def make_mesh(num_devices: int | None = None, devices=None) -> Mesh:
 def _dist_bfs_fn(mesh: Mesh, p: int, vloc: int, exchange: str, backend: str):
     """Build the shard_map'd BFS level loop for a fixed mesh/partition."""
 
-    def local_loop(src_e, dst_e, rp_e, frontier, visited, dist, max_levels):
+    def local_loop(src_e, dst_e, rp_e, frontier, visited, dist, level0, max_levels):
         # Blocks: src_e/dst_e [1, ep], rp_e [1, vp+1], vertex arrays [vloc].
         src_e = src_e[0]
         dst_e = dst_e[0]
@@ -83,10 +83,10 @@ def _dist_bfs_fn(mesh: Mesh, p: int, vloc: int, exchange: str, backend: str):
             return new, visited, dist, level + 1, count
 
         init_count = lax.psum(jnp.sum(frontier.astype(jnp.int32)), "v")
-        _, _, dist, level, _ = lax.while_loop(
-            cond, body, (frontier, visited, dist, jnp.int32(0), init_count)
+        frontier, visited, dist, level, _ = lax.while_loop(
+            cond, body, (frontier, visited, dist, jnp.int32(level0), init_count)
         )
-        return dist, level
+        return frontier, visited, dist, level
 
     return jax.jit(
         jax.shard_map(
@@ -100,8 +100,9 @@ def _dist_bfs_fn(mesh: Mesh, p: int, vloc: int, exchange: str, backend: str):
                 P("v"),
                 P("v"),
                 P(),
+                P(),
             ),
-            out_specs=(P("v"), P()),
+            out_specs=(P("v"), P("v"), P("v"), P()),
             check_vma=False,
         )
     )
@@ -186,7 +187,68 @@ class DistBfsEngine:
         """Device (padded-id, sharded) distance vector + level counter."""
         frontier0, visited0, dist0 = self._init_state(source)
         ml = jnp.int32(max_levels if max_levels is not None else self.part.vp)
-        return self._loop(self.src, self.dst, self.rp, frontier0, visited0, dist0, ml)
+        _, _, dist, level = self._loop(
+            self.src, self.dst, self.rp, frontier0, visited0, dist0,
+            jnp.int32(0), ml,
+        )
+        return dist, level
+
+    # --- checkpoint/resume (SURVEY.md §5: the reference has none) ---
+
+    def start(self, source: int):
+        """Level-0 traversal state as a host checkpoint (real vertex ids).
+
+        Checkpoints hold real-id arrays [V], portable across engines and mesh
+        shapes — resuming on a different device count re-pads on entry
+        (elastic restart; the reference's compile-time DeviceNum, bfs.cu:19,
+        and fixed 2-rank world, bfs_mpi.cu:615, have no analog)."""
+        from tpu_bfs.utils.checkpoint import initial_checkpoint
+
+        return initial_checkpoint(self.part.num_vertices, source)
+
+    def _pad_state(self, ckpt):
+        """Real-id [V] checkpoint arrays -> padded-id [vp] arrays."""
+        part = self.part
+        pids = part.to_padded(np.arange(part.num_vertices))
+        f = np.zeros(part.vp, dtype=bool)
+        f[pids] = ckpt.frontier
+        vis = np.zeros(part.vp, dtype=bool)
+        vis[pids] = ckpt.visited
+        d = np.full(part.vp, INF_DIST, dtype=np.int32)
+        d[pids] = ckpt.distance
+        return f, vis, d
+
+    def advance(self, ckpt, levels: int | None = None):
+        """Run at most ``levels`` more levels across the mesh from a checkpoint."""
+        from tpu_bfs.utils.checkpoint import BfsCheckpoint
+
+        part = self.part
+        if len(ckpt.frontier) != part.num_vertices:
+            raise ValueError(
+                f"checkpoint has {len(ckpt.frontier)} vertices, graph has "
+                f"{part.num_vertices}"
+            )
+        f0, vis0, d0 = self._pad_state(ckpt)
+        put = partial(jax.device_put, device=self._vec_sharding)
+        cap = ckpt.level + levels if levels is not None else part.vp
+        frontier, visited, dist, level = self._loop(
+            self.src, self.dst, self.rp,
+            put(f0), put(vis0), put(d0),
+            jnp.int32(ckpt.level), jnp.int32(min(cap, part.vp)),
+        )
+        return BfsCheckpoint(
+            source=ckpt.source,
+            level=int(level),
+            frontier=part.unshard(np.asarray(frontier)),
+            visited=part.unshard(np.asarray(visited)),
+            distance=part.unshard(np.asarray(dist)),
+        )
+
+    def finish(self, ckpt, *, with_parents: bool = True) -> BfsResult:
+        """Convert a (finished or partial) checkpoint into a BfsResult."""
+        _, _, d0 = self._pad_state(ckpt)
+        put = partial(jax.device_put, device=self._vec_sharding)
+        return self._package(put(d0), ckpt.source, with_parents, None)
 
     def run(
         self,
@@ -208,7 +270,10 @@ class DistBfsEngine:
             self._warmed = True
         else:
             dist_dev, _ = self.distances_padded(source, max_levels=max_levels)
+        return self._package(dist_dev, source, with_parents, elapsed)
 
+    def _package(self, dist_dev, source, with_parents, elapsed) -> BfsResult:
+        part = self.part
         parent = None
         if with_parents:
             parent_dev = self._parents(self.src, self.dst, dist_dev)

@@ -1,0 +1,188 @@
+"""Checkpoint / resume of BFS traversal state and results.
+
+The reference has no checkpointing at all (SURVEY.md §5: "BFS state is
+per-run; results live only in process memory") — a failed rank hangs the
+MPI_Allreduce (bfs_mpi.cu:621) and the whole traversal is lost. Here the
+traversal state (frontier / visited / distance + level counter) is an explicit
+value: engines expose ``start`` / ``advance`` / ``finish``, and this module
+persists checkpoints either as one ``.npz`` or as per-shard files (one per
+chip of a 1D partition) that can be re-assembled under a *different* shard
+count — elastic restart, which the reference's compile-time DeviceNum
+(bfs.cu:19) and fixed 2-rank world cannot express.
+
+Results (``BfsResult``) round-trip through ``save_result``/``load_result``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+
+_STATE_VERSION = 1
+
+
+@dataclasses.dataclass
+class BfsCheckpoint:
+    """Host-side snapshot of one traversal, in REAL vertex-id space [V].
+
+    Engines convert to their own padded/sharded layouts on entry, so a
+    checkpoint taken on one engine/mesh resumes on any other over the same
+    graph. ``level`` is the level-loop counter (number of completed level
+    steps); resuming with ``engine.advance`` continues distance labeling
+    bit-identically to an uninterrupted run.
+    """
+
+    source: int
+    level: int
+    frontier: np.ndarray  # [V] bool
+    visited: np.ndarray  # [V] bool
+    distance: np.ndarray  # [V] int32 (INF_DIST where unreached)
+
+    @property
+    def done(self) -> bool:
+        """True once the frontier is empty (the traversal has terminated)."""
+        return not bool(self.frontier.any())
+
+
+def initial_checkpoint(num_vertices: int, source: int) -> BfsCheckpoint:
+    """Level-0 traversal state: frontier = visited = {source}, dist[source]=0.
+
+    Shared by every engine's ``start`` so cross-engine checkpoints cannot
+    drift (the conventions here are load-bearing for portability)."""
+    from tpu_bfs.graph.csr import INF_DIST
+
+    if not (0 <= source < num_vertices):
+        raise ValueError(f"source {source} out of range [0, {num_vertices})")
+    frontier = np.zeros(num_vertices, dtype=bool)
+    frontier[source] = True
+    dist = np.full(num_vertices, INF_DIST, dtype=np.int32)
+    dist[source] = 0
+    return BfsCheckpoint(
+        source=source, level=0, frontier=frontier,
+        visited=frontier.copy(), distance=dist,
+    )
+
+
+def save_checkpoint(path: str, ckpt: BfsCheckpoint) -> None:
+    """Write a checkpoint as one ``.npz`` file."""
+    np.savez_compressed(
+        path,
+        version=_STATE_VERSION,
+        source=ckpt.source,
+        level=ckpt.level,
+        frontier=ckpt.frontier,
+        visited=ckpt.visited,
+        distance=ckpt.distance,
+    )
+
+
+def load_checkpoint(path: str) -> BfsCheckpoint:
+    z = np.load(path)
+    if int(z["version"]) != _STATE_VERSION:
+        raise ValueError(f"unsupported checkpoint version {int(z['version'])}")
+    return BfsCheckpoint(
+        source=int(z["source"]),
+        level=int(z["level"]),
+        frontier=z["frontier"],
+        visited=z["visited"],
+        distance=z["distance"],
+    )
+
+
+def save_checkpoint_sharded(dirpath: str, ckpt: BfsCheckpoint, num_shards: int) -> None:
+    """Write one file per shard of a ``num_shards``-way contiguous 1D split.
+
+    Shard k owns real vertex ids [k*cpk, min((k+1)*cpk, V)) with
+    cpk = ceil(V / num_shards) — the same ownership map as ``partition_1d``.
+    Layout: ``meta.json`` + ``shard_00000.npz`` ... Because shards are in real
+    id space, the re-assembled checkpoint resumes on any mesh size.
+    """
+    if num_shards < 1:
+        raise ValueError("num_shards must be >= 1")
+    v = len(ckpt.frontier)
+    if num_shards > v:
+        raise ValueError(f"num_shards={num_shards} exceeds vertex count {v}")
+    cpk = -(-v // num_shards)
+    os.makedirs(dirpath, exist_ok=True)
+    meta = {
+        "version": _STATE_VERSION,
+        "source": int(ckpt.source),
+        "level": int(ckpt.level),
+        "num_vertices": v,
+        "num_shards": num_shards,
+    }
+    with open(os.path.join(dirpath, "meta.json"), "w") as f:
+        json.dump(meta, f)
+    for k in range(num_shards):
+        sl = slice(k * cpk, min((k + 1) * cpk, v))
+        np.savez_compressed(
+            os.path.join(dirpath, f"shard_{k:05d}.npz"),
+            frontier=ckpt.frontier[sl],
+            visited=ckpt.visited[sl],
+            distance=ckpt.distance[sl],
+        )
+
+
+def load_checkpoint_sharded(dirpath: str) -> BfsCheckpoint:
+    """Re-assemble a sharded checkpoint into one host checkpoint.
+
+    The result is shard-count-agnostic: resume it on any mesh whose engine
+    shares the same padded vertex count.
+    """
+    with open(os.path.join(dirpath, "meta.json")) as f:
+        meta = json.load(f)
+    if int(meta["version"]) != _STATE_VERSION:
+        raise ValueError(f"unsupported checkpoint version {meta['version']}")
+    parts = [
+        np.load(os.path.join(dirpath, f"shard_{k:05d}.npz"))
+        for k in range(int(meta["num_shards"]))
+    ]
+    ckpt = BfsCheckpoint(
+        source=int(meta["source"]),
+        level=int(meta["level"]),
+        frontier=np.concatenate([p["frontier"] for p in parts]),
+        visited=np.concatenate([p["visited"] for p in parts]),
+        distance=np.concatenate([p["distance"] for p in parts]),
+    )
+    if len(ckpt.frontier) != int(meta["num_vertices"]):
+        raise ValueError("shard sizes do not add up to the recorded vertex count")
+    return ckpt
+
+
+def save_result(path: str, res) -> None:
+    """Persist a BfsResult (distance + parent outputs) as ``.npz``.
+
+    The reference prints nothing durable — results die with the process
+    (SURVEY.md §5); this is the ``--save-dist``/``--save-parent`` capability
+    in one artifact with provenance fields.
+    """
+    np.savez_compressed(
+        path,
+        version=_STATE_VERSION,
+        source=res.source,
+        distance=res.distance,
+        parent=res.parent if res.parent is not None else np.empty(0, np.int32),
+        num_levels=res.num_levels,
+        reached=res.reached,
+        edges_traversed=res.edges_traversed,
+    )
+
+
+def load_result(path: str):
+    from tpu_bfs.algorithms.bfs import BfsResult
+
+    z = np.load(path)
+    if int(z["version"]) != _STATE_VERSION:
+        raise ValueError(f"unsupported result version {int(z['version'])}")
+    parent = z["parent"]
+    return BfsResult(
+        source=int(z["source"]),
+        distance=z["distance"],
+        parent=parent if parent.size else None,
+        num_levels=int(z["num_levels"]),
+        reached=int(z["reached"]),
+        edges_traversed=int(z["edges_traversed"]),
+    )

@@ -1,0 +1,111 @@
+"""Wide (4096-lane) packed multi-source BFS vs the golden oracle.
+
+Same golden-differential pattern as test_msbfs_packed.py (the reference's
+runCpu + checkOutput, bfs.cu:798-815), applied per lane of the wide engine,
+plus the wide engine's extra contracts: plane-count level cap, lazy per-word
+distance extraction, device-side lane stats.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_bfs.algorithms.msbfs_wide import LANES, W, WidePackedMsBfsEngine
+from tpu_bfs.algorithms.msbfs_packed import UNREACHED
+from tpu_bfs.graph.csr import INF_DIST
+from tpu_bfs.reference import bfs_python
+
+
+def _check_lanes(graph, engine, sources, res=None):
+    res = engine.run(np.asarray(sources)) if res is None else res
+    for s_idx, src in enumerate(sources):
+        golden, _ = bfs_python(graph, int(src))
+        np.testing.assert_array_equal(
+            res.distances_int32(s_idx), golden,
+            err_msg=f"lane {s_idx} source {src}",
+        )
+    return res
+
+
+def test_wide_matches_oracle_random(random_small):
+    engine = WidePackedMsBfsEngine(random_small)
+    _check_lanes(random_small, engine, [0, 1, 17, 255, 499, 3])
+
+
+def test_wide_heavy_vertices(rmat_small):
+    engine = WidePackedMsBfsEngine(rmat_small, kcap=8)
+    assert engine.ell.num_heavy > 0 and engine.ell.fold_steps > 0
+    sources = np.flatnonzero(engine.ell.in_degree > 0)[:40]
+    _check_lanes(rmat_small, engine, sources)
+
+
+def test_wide_disconnected(random_disconnected):
+    engine = WidePackedMsBfsEngine(random_disconnected)
+    res = _check_lanes(random_disconnected, engine, [0, 5, 9])
+    assert (res.distance_u8_lane(0) == UNREACHED).any()
+
+
+def test_wide_lane_word_boundaries(random_small):
+    # Lanes in different 32-lane words use separate lazy extractions.
+    rng = np.random.default_rng(1)
+    sources = rng.integers(0, random_small.num_vertices, 100)
+    engine = WidePackedMsBfsEngine(random_small)
+    res = engine.run(sources)
+    for s_idx in [0, 31, 32, 63, 64, 99]:
+        golden, _ = bfs_python(random_small, int(sources[s_idx]))
+        np.testing.assert_array_equal(res.distances_int32(s_idx), golden)
+
+
+def test_wide_plane_cap_raises(line_graph):
+    # Diameter-63 path exceeds the 5-plane cap (31 levels) -> explicit error,
+    # not silent mislabeling (the reference's vacuous-check sin,
+    # bfs_mpi.cu:844-846, is the anti-pattern here).
+    engine = WidePackedMsBfsEngine(line_graph, num_planes=5)
+    with pytest.raises(RuntimeError, match="num_planes"):
+        engine.run(np.array([0]))
+
+
+def test_wide_eccentricity_exactly_at_cap(line_graph):
+    # Source 31 on the 64-path: eccentricity 32 == the 5-plane cap. Every
+    # distance is labeled; the claim-free post-check must see there is no
+    # deeper level and NOT raise.
+    engine = WidePackedMsBfsEngine(line_graph, num_planes=5)
+    res = _check_lanes(line_graph, engine, [31])
+    assert res.num_levels == 32
+
+
+def test_wide_more_planes_reach_deeper(line_graph):
+    engine = WidePackedMsBfsEngine(line_graph, num_planes=6)
+    res = _check_lanes(line_graph, engine, [0, 63, 31])
+    assert res.num_levels == 63
+
+
+def test_wide_max_levels_clamp(line_graph):
+    engine = WidePackedMsBfsEngine(line_graph, num_planes=6)
+    res = engine.run(np.array([0]), max_levels=5)
+    d = res.distances_int32(0)
+    assert d[5] == 5 and d[6] == INF_DIST
+
+
+def test_wide_lane_stats(random_small):
+    engine = WidePackedMsBfsEngine(random_small)
+    res = engine.run(np.array([0, 7]), time_it=True)
+    for i in (0, 1):
+        golden, _ = bfs_python(random_small, int(res.sources[i]))
+        reached = golden != INF_DIST
+        assert res.reached[i] == reached.sum()
+        deg = np.bincount(
+            random_small.coo[1], minlength=random_small.num_vertices
+        )
+        assert res.edges_traversed[i] == deg[reached].sum() // 2
+    assert res.elapsed_s is not None and res.teps > 0
+
+
+def test_wide_rejects_bad_input(random_small):
+    engine = WidePackedMsBfsEngine(random_small)
+    with pytest.raises(ValueError):
+        engine.run(np.array([-1]))
+    with pytest.raises(ValueError):
+        engine.run(np.arange(LANES + 1))
+    with pytest.raises(ValueError):
+        WidePackedMsBfsEngine(random_small, num_planes=0)
+    assert LANES == 32 * W == 4096

@@ -1,0 +1,53 @@
+"""Pallas dense-tile expansion kernel vs its NumPy oracle (interpret mode)."""
+
+import numpy as np
+import pytest
+
+from tpu_bfs.ops.tile_spmm import TILE, tile_spmm, tile_spmm_reference
+
+
+def _random_case(rng, nr, vt, w, max_b):
+    per_row = rng.integers(0, max_b + 1, size=nr)
+    row_start = np.zeros(nr + 1, np.int32)
+    row_start[1:] = np.cumsum(per_row)
+    nt = int(row_start[-1])
+    col_tile = rng.integers(0, vt, size=max(nt, 1)).astype(np.int32)
+    a = (rng.random((max(nt, 1), TILE, TILE)) < 0.05).astype(np.int8)
+    fw = rng.integers(0, 2**32, size=(vt * TILE, w), dtype=np.uint64).astype(
+        np.uint32
+    )
+    return row_start, col_tile, a, fw
+
+
+@pytest.mark.parametrize("w", [8, 128])
+def test_tile_spmm_matches_oracle(w):
+    rng = np.random.default_rng(0)
+    nr, vt = 5, 7
+    row_start, col_tile, a, fw = _random_case(rng, nr, vt, w, max_b=4)
+    got = np.asarray(
+        tile_spmm(
+            row_start, col_tile, a, fw, num_row_tiles=nr, w=w, interpret=True
+        )
+    )
+    want = tile_spmm_reference(
+        row_start, col_tile, a, fw, num_row_tiles=nr, w=w
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_tile_spmm_empty_row_tiles():
+    # Row-tiles with zero dense blocks must emit all-zero words.
+    rng = np.random.default_rng(1)
+    w = 8
+    row_start = np.array([0, 0, 2, 2], np.int32)  # row-tiles 0 and 2 empty
+    col_tile = np.array([0, 1], np.int32)
+    a = (rng.random((2, TILE, TILE)) < 0.1).astype(np.int8)
+    fw = rng.integers(0, 2**32, size=(2 * TILE, w), dtype=np.uint64).astype(
+        np.uint32
+    )
+    got = np.asarray(
+        tile_spmm(row_start, col_tile, a, fw, num_row_tiles=3, w=w, interpret=True)
+    )
+    want = tile_spmm_reference(row_start, col_tile, a, fw, num_row_tiles=3, w=w)
+    np.testing.assert_array_equal(got, want)
+    assert not got[:TILE].any() and not got[2 * TILE :].any()

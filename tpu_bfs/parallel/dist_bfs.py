@@ -209,7 +209,9 @@ class DistBfsEngine:
     def _pad_state(self, ckpt):
         """Real-id [V] checkpoint arrays -> padded-id [vp] arrays."""
         part = self.part
-        pids = part.to_padded(np.arange(part.num_vertices))
+        if not hasattr(self, "_pids"):  # constant for the engine's lifetime
+            self._pids = part.to_padded(np.arange(part.num_vertices))
+        pids = self._pids
         f = np.zeros(part.vp, dtype=bool)
         f[pids] = ckpt.frontier
         vis = np.zeros(part.vp, dtype=bool)

@@ -3,15 +3,15 @@
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 Baseline target: 10 GTEPS/chip (BASELINE.json north_star). TEPS follows the
 Graph500 convention: traversed input edges / per-source time, harmonic mean
-over sources. The flagship path is the wide (4096-lane) bit-packed
-multi-source engine (tpu_bfs/algorithms/msbfs_wide.py): one batch run of N
+over sources. The flagship path is the 4096-lane hybrid MXU+gather
+multi-source engine (tpu_bfs/algorithms/msbfs_hybrid.py): one batch run of N
 concurrent sources, per-source time = batch time / N — the metric label says
 so explicitly.
 
 Env overrides: TPU_BFS_BENCH_SCALE (default 21), TPU_BFS_BENCH_EF (16),
-TPU_BFS_BENCH_MODE (wide|msbfs|single), TPU_BFS_BENCH_LANES (msbfs mode, 512),
-TPU_BFS_BENCH_SOURCES (single mode, 8), TPU_BFS_BENCH_VALIDATE (1),
-TPU_BFS_BENCH_CACHE (.bench_cache).
+TPU_BFS_BENCH_MODE (hybrid|wide|msbfs|single), TPU_BFS_BENCH_LANES (msbfs
+mode, 512), TPU_BFS_BENCH_SOURCES (single mode, 8), TPU_BFS_BENCH_VALIDATE
+(1), TPU_BFS_BENCH_CACHE (.bench_cache).
 """
 
 import json
@@ -63,39 +63,34 @@ def load_graph(scale: int, ef: int):
     return g
 
 
-def bench_wide(g, scale: int, ef: int) -> dict:
-    """Flagship: 4096-lane wide packed MS-BFS (msbfs_wide.py)."""
+def _bench_batch_4096(g, scale, ef, engine, in_degree, build_log: str, label: str) -> dict:
+    """Shared protocol of the 4096-lane batch benches: hub pilot (doubles as
+    compile warm-up), search keys from the hub's traversable component
+    (Graph500 samples among degree>=1 vertices), one timed batch, 2-lane
+    SciPy validation."""
     from tpu_bfs.algorithms.msbfs_packed import UNREACHED
-    from tpu_bfs.algorithms.msbfs_wide import LANES, WidePackedMsBfsEngine
 
     do_validate = os.environ.get("TPU_BFS_BENCH_VALIDATE", "1") == "1"
-    t0 = time.perf_counter()
-    engine = WidePackedMsBfsEngine(g)
-    ell = engine.ell
-    log(
-        f"engine build {time.perf_counter()-t0:.1f}s: slots={ell.total_slots} "
-        f"(x{ell.total_slots/max(g.num_edges,1):.2f}) heavy={ell.num_heavy}"
-    )
+    lanes = engine.lanes
+    log(build_log)
 
-    # Graph500 samples search keys among degree>=1 vertices; sample from the
-    # hub's traversable component (pilot run doubles as compile warm-up).
     t0 = time.perf_counter()
-    hub = int(np.argmax(ell.in_degree))
+    hub = int(np.argmax(in_degree))  # original-id order
     pilot = engine.run(np.array([hub]))
     traversable = np.flatnonzero(pilot.distance_u8_lane(0) != UNREACHED)
-    del pilot  # frees ~7.5 GB of device-resident planes before the batch
+    del pilot  # frees device-resident planes before the batch
     log(
         f"pilot+compile {time.perf_counter()-t0:.1f}s: traversable "
         f"{len(traversable)}/{g.num_vertices}"
     )
     rng = np.random.default_rng(7)
-    sources = rng.choice(traversable, size=LANES, replace=len(traversable) < LANES)
+    sources = rng.choice(traversable, size=lanes, replace=len(traversable) < lanes)
 
     res = engine.run(sources, time_it=True)
     gteps = res.teps / 1e9
     log(
-        f"batch {res.elapsed_s*1e3:.1f}ms, {LANES} sources, levels="
-        f"{res.num_levels}, per-src {res.elapsed_s/LANES*1e3:.3f}ms, "
+        f"batch {res.elapsed_s*1e3:.1f}ms, {lanes} sources, levels="
+        f"{res.num_levels}, per-src {res.elapsed_s/lanes*1e3:.3f}ms, "
         f"hmean GTEPS={gteps:.3f}"
     )
 
@@ -103,20 +98,51 @@ def bench_wide(g, scale: int, ef: int) -> dict:
         from tpu_bfs.reference import bfs_scipy
 
         t0 = time.perf_counter()
-        for i in [0, LANES // 2]:
+        for i in [0, lanes // 2]:
             expected = bfs_scipy(g, int(sources[i]))
             np.testing.assert_array_equal(res.distances_int32(i), expected)
         log(f"validated 2 lanes in {time.perf_counter()-t0:.1f}s")
 
     return {
         "metric": (
-            f"BFS harmonic-mean per-source GTEPS ({LANES}-source wide packed "
+            f"BFS harmonic-mean per-source GTEPS ({lanes}-source {label} "
             f"MS-BFS batch), RMAT scale-{scale} ef={ef}, 1 chip"
         ),
         "value": round(gteps, 4),
         "unit": "GTEPS",
         "vs_baseline": round(gteps / 10.0, 4),
     }
+
+
+def bench_hybrid(g, scale: int, ef: int) -> dict:
+    """Flagship: 4096-lane hybrid MXU+gather MS-BFS (msbfs_hybrid.py)."""
+    from tpu_bfs.algorithms.msbfs_hybrid import HybridMsBfsEngine
+
+    t0 = time.perf_counter()
+    engine = HybridMsBfsEngine(g)
+    hg = engine.hg
+    return _bench_batch_4096(
+        g, scale, ef, engine, hg.in_degree,
+        f"engine build {time.perf_counter()-t0:.1f}s: tiles={hg.num_tiles} "
+        f"dense={hg.num_dense_edges/max(g.num_edges,1)*100:.1f}% "
+        f"a_mem={hg.a_tiles.nbytes/2**30:.2f}GiB",
+        "hybrid MXU+gather",
+    )
+
+
+def bench_wide(g, scale: int, ef: int) -> dict:
+    """4096-lane wide packed MS-BFS, gather-only (msbfs_wide.py)."""
+    from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
+
+    t0 = time.perf_counter()
+    engine = WidePackedMsBfsEngine(g)
+    ell = engine.ell
+    return _bench_batch_4096(
+        g, scale, ef, engine, ell.in_degree,
+        f"engine build {time.perf_counter()-t0:.1f}s: slots={ell.total_slots} "
+        f"(x{ell.total_slots/max(g.num_edges,1):.2f}) heavy={ell.num_heavy}",
+        "wide packed",
+    )
 
 
 def bench_msbfs(g, scale: int, ef: int) -> dict:
@@ -214,9 +240,14 @@ def bench_single(g, scale: int, ef: int) -> dict:
 def main() -> int:
     scale = int(os.environ.get("TPU_BFS_BENCH_SCALE", "21"))
     ef = int(os.environ.get("TPU_BFS_BENCH_EF", "16"))
-    mode = os.environ.get("TPU_BFS_BENCH_MODE", "wide")
+    mode = os.environ.get("TPU_BFS_BENCH_MODE", "hybrid")
     g = load_graph(scale, ef)
-    fn = {"wide": bench_wide, "msbfs": bench_msbfs, "single": bench_single}[mode]
+    fn = {
+        "hybrid": bench_hybrid,
+        "wide": bench_wide,
+        "msbfs": bench_msbfs,
+        "single": bench_single,
+    }[mode]
     result = fn(g, scale, ef)
     print(json.dumps(result))
     return 0

@@ -1,0 +1,135 @@
+"""Hybrid dense-MXU + gather MS-BFS vs the golden oracle.
+
+Runs on CPU with the Pallas kernel in interpret mode (the engine autodetects
+the backend). ``tile_thr=1`` forces every occupied tile through the dense
+path so the MXU kernel, the residual path, and their OR-merge are all
+exercised; default thresholds exercise the pure-residual path.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_bfs.algorithms.msbfs_hybrid import (
+    LANES,
+    HybridMsBfsEngine,
+    build_hybrid,
+)
+from tpu_bfs.algorithms.msbfs_packed import UNREACHED
+from tpu_bfs.reference import bfs_python
+
+
+def _check_lanes(graph, engine, sources, res=None):
+    res = engine.run(np.asarray(sources)) if res is None else res
+    for s_idx, src in enumerate(sources):
+        golden, _ = bfs_python(graph, int(src))
+        np.testing.assert_array_equal(
+            res.distances_int32(s_idx), golden,
+            err_msg=f"lane {s_idx} source {src}",
+        )
+    return res
+
+
+def test_split_conserves_edges(random_small):
+    # Every edge slot lands in exactly one of: a dense-tile 1-entry or a
+    # non-sentinel residual ELL slot.
+    hg = build_hybrid(random_small, tile_thr=4)
+    sentinel = hg.vt * 128 - 1
+    light_real = sum(int((b.idx != sentinel).sum()) for b in hg.res_light)
+    virt_real = (
+        int((hg.res_virtual.idx != sentinel).sum())
+        if hg.res_virtual is not None
+        else 0
+    )
+    assert hg.num_dense_edges + light_real + virt_real == random_small.num_edges
+    # Parallel edges collapse to one 1-entry in a dense tile (boolean
+    # semantics — BFS reachability is unaffected); distinct pairs only.
+    src, dst = random_small.coo
+    r, c = hg.rank[dst].astype(np.int64), hg.rank[src].astype(np.int64)
+    tid = (r // 128) * hg.vt + (c // 128)
+    row_tile_of = np.repeat(np.arange(hg.vt), np.diff(hg.row_start))
+    dense_tid = row_tile_of * hg.vt + hg.col_tile.astype(np.int64)
+    in_dense = np.isin(tid, dense_tid)
+    distinct = len({(int(a), int(b)) for a, b in zip(r[in_dense], c[in_dense])})
+    assert int(hg.a_tiles.sum()) == distinct
+
+
+def test_hybrid_pure_residual(random_small):
+    # High threshold -> no dense tiles; engine degrades to the gather path.
+    engine = HybridMsBfsEngine(random_small, tile_thr=10**6)
+    assert engine.hg.num_tiles == 0
+    _check_lanes(random_small, engine, [0, 1, 17, 255, 499])
+
+
+def test_hybrid_all_dense(random_small):
+    # Threshold 1 -> every occupied tile is dense; residual is empty.
+    engine = HybridMsBfsEngine(random_small, tile_thr=1)
+    assert engine.hg.num_tiles > 0
+    assert engine.hg.num_dense_edges == random_small.num_edges
+    _check_lanes(random_small, engine, [0, 3, 499, 17])
+
+
+def test_hybrid_mixed_split(rmat_small):
+    # Mid threshold: both paths active; per-lane results must still agree.
+    engine = HybridMsBfsEngine(rmat_small, tile_thr=8, kcap=8)
+    hg = engine.hg
+    assert hg.num_tiles > 0
+    assert 0 < hg.num_dense_edges < rmat_small.num_edges
+    sources = np.flatnonzero(hg.in_degree > 0)[:40]
+    _check_lanes(rmat_small, engine, sources)
+
+
+def test_hybrid_budget_trims_tiles(rmat_small):
+    full = build_hybrid(rmat_small, tile_thr=1)
+    assert full.num_tiles > 2
+    trimmed = build_hybrid(rmat_small, tile_thr=1, a_budget_bytes=2 * 128 * 128)
+    assert trimmed.num_tiles == 2
+    # Trimming keeps the highest-count tiles.
+    per_tile_full = full.a_tiles.sum(axis=(1, 2))
+    assert trimmed.a_tiles.sum() == np.sort(per_tile_full)[-2:].sum()
+
+
+def test_hybrid_disconnected(random_disconnected):
+    engine = HybridMsBfsEngine(random_disconnected, tile_thr=2)
+    res = _check_lanes(random_disconnected, engine, [0, 5, 9])
+    assert (res.distance_u8_lane(0) == UNREACHED).any()
+
+
+def test_hybrid_lane_word_boundaries(random_small):
+    # Bit-major lanes: entries 0 and 128 share a bit position, 0 and 1 share
+    # a word; check lanes across both boundaries.
+    rng = np.random.default_rng(1)
+    sources = rng.integers(0, random_small.num_vertices, 200)
+    engine = HybridMsBfsEngine(random_small, tile_thr=2)
+    res = engine.run(sources)
+    for s_idx in [0, 1, 127, 128, 129, 199]:
+        golden, _ = bfs_python(random_small, int(sources[s_idx]))
+        np.testing.assert_array_equal(res.distances_int32(s_idx), golden)
+
+
+def test_hybrid_lane_stats(random_small):
+    engine = HybridMsBfsEngine(random_small, tile_thr=2)
+    res = engine.run(np.array([0, 7, 130]), time_it=True)
+    deg = np.bincount(random_small.coo[1], minlength=random_small.num_vertices)
+    for i in range(3):
+        golden, _ = bfs_python(random_small, int(res.sources[i]))
+        reached = golden != np.iinfo(np.int32).max
+        assert res.reached[i] == reached.sum()
+        assert res.edges_traversed[i] == deg[reached].sum() // 2
+    assert res.teps and res.teps > 0
+
+
+def test_hybrid_plane_cap(line_graph):
+    engine = HybridMsBfsEngine(line_graph, num_planes=5, tile_thr=2)
+    with pytest.raises(RuntimeError, match="num_planes"):
+        engine.run(np.array([0]))
+    engine6 = HybridMsBfsEngine(line_graph, num_planes=6, tile_thr=2)
+    res = _check_lanes(line_graph, engine6, [0, 63, 31])
+    assert res.num_levels == 63
+
+
+def test_hybrid_rejects_bad_input(random_small):
+    engine = HybridMsBfsEngine(random_small, tile_thr=2)
+    with pytest.raises(ValueError):
+        engine.run(np.array([-1]))
+    with pytest.raises(ValueError):
+        engine.run(np.arange(LANES + 1))

@@ -1,0 +1,261 @@
+"""Shared machinery of the 4096-lane packed MS-BFS engines.
+
+msbfs_wide.py (gather-only) and msbfs_hybrid.py (MXU dense tiles + gather
+residual) differ in their frontier-table height, lane-to-(word, bit) map, and
+per-level hit computation — everything else (fori-loop bucket expansion,
+seeding, device-side lane stats, lazy per-word distance extraction, the
+generic batch ``run``) lives here once.
+
+Engines plug in via a small protocol: attributes ``arrs``, ``lanes``,
+``max_levels_cap``, ``num_planes``, ``undirected``, ``_in_deg_ranked``,
+``_rank``, ``_warmed``, ``num_vertices``; jitted callables ``_core``
+(returning planes, vis, levels, alive, truncated), ``_seed_dev``,
+``_lane_stats``, ``_extract_word``; and the two lane-map hooks ``_word_col``
+/ ``_lane_order``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_bfs.graph.csr import INF_DIST
+from tpu_bfs.algorithms.msbfs_packed import UNREACHED
+
+
+class ExpandSpec(NamedTuple):
+    """Shape metadata of a bucketed-ELL expansion (see graph/ell.py)."""
+
+    kcap: int
+    heavy: bool
+    num_virtual: int
+    fold_steps: int
+    light_meta: tuple  # ((k, n), ...)
+    tail_rows: int  # all-zero rows appended after the buckets
+
+
+def make_fori_expand(spec: ExpandSpec, w: int):
+    """Bucketed-ELL expansion with fori-loop OR accumulation.
+
+    ``fw`` is the packed frontier table; returns the concatenated bucket
+    outputs (heavy rows, then light buckets, then ``tail_rows`` zeros). Only
+    one gather result is live at a time — the unrolled form kept ~20 padded
+    [n, w] intermediates alive and OOM'd at w >= 64.
+    """
+
+    def expand(arrs, fw):
+        parts = []
+        if spec.heavy:
+            vr_t = arrs["virtual_t"]  # [kcap, M]
+
+            def vbody(kk, acc):
+                return acc | fw[vr_t[kk]]
+
+            acc = jax.lax.fori_loop(
+                0, spec.kcap, vbody,
+                jnp.zeros((spec.num_virtual, w), jnp.uint32),
+            )
+            vr_ext = jnp.concatenate([acc, jnp.zeros((1, w), jnp.uint32)])
+            cur = vr_ext[arrs["fold_pad_map"]]
+            pyramid = [cur]
+            for _ in range(spec.fold_steps):
+                pairs = cur.reshape(-1, 2, w)
+                cur = pairs[:, 0] | pairs[:, 1]
+                pyramid.append(cur)
+            pyr = jnp.concatenate(pyramid) if len(pyramid) > 1 else pyramid[0]
+            parts.append(pyr[arrs["heavy_pick"]])
+        for i, (k, n) in enumerate(spec.light_meta):
+            bt = arrs[f"light{i}_t"]  # [k, n]
+
+            def lbody(kk, acc, bt=bt):
+                return acc | fw[bt[kk]]
+
+            acc = jax.lax.fori_loop(0, k, lbody, jnp.zeros((n, w), jnp.uint32))
+            parts.append(acc)
+        if spec.tail_rows:
+            parts.append(jnp.zeros((spec.tail_rows, w), jnp.uint32))
+        return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+
+    return expand
+
+
+def expand_arrays(ell_like) -> dict:
+    """Device-ready (transposed) bucket index arrays for make_fori_expand.
+
+    ``ell_like`` needs attributes ``virtual`` (EllBucket or None),
+    ``fold_pad_map``, ``heavy_pick``, ``light`` (list of EllBucket)."""
+    arrs = {}
+    if ell_like.virtual is not None:
+        arrs["virtual_t"] = jnp.asarray(
+            np.ascontiguousarray(ell_like.virtual.idx.T)
+        )
+        arrs["fold_pad_map"] = jnp.asarray(ell_like.fold_pad_map)
+        arrs["heavy_pick"] = jnp.asarray(ell_like.heavy_pick)
+    for i, b in enumerate(ell_like.light):
+        arrs[f"light{i}_t"] = jnp.asarray(np.ascontiguousarray(b.idx.T))
+    return arrs
+
+
+def make_state_kernels(v: int, rows: int, w: int, num_planes: int):
+    """Jitted (seed, lane_stats, extract_word) over a [rows, w] packed table
+    whose first ``v`` rows are real vertices (in rank order)."""
+
+    @jax.jit
+    def seed(rws, words, bits):
+        # Distinct lanes own distinct (word, bit) pairs, so scatter-add == OR.
+        fw0 = jnp.zeros((rows, w), jnp.uint32)
+        return fw0.at[rws, words].add(bits)
+
+    @jax.jit
+    def lane_stats(vis, in_deg):
+        """Per-word-column reached count and degree sum, on device.
+
+        Returns (reached [w,32] i32 exact, deg_sum [w,32] f32 — f32 because
+        TPU has no int64 and the per-lane degree sum can exceed int32 at
+        Graph500 scale; pairwise summation keeps ~7 digits)."""
+        shifts = jnp.arange(32, dtype=jnp.uint32)
+
+        def wbody(wi, acc):
+            r_acc, d_acc = acc
+            col = jax.lax.dynamic_slice(vis, (0, wi), (rows, 1))[:v]  # [v,1]
+            bits = (col >> shifts) & 1  # [v, 32] u32
+            rr = jnp.sum(bits.astype(jnp.int32), axis=0)
+            dd = jnp.sum(bits.astype(jnp.float32) * in_deg[:, None], axis=0)
+            return (
+                jax.lax.dynamic_update_slice(r_acc, rr[None], (wi, 0)),
+                jax.lax.dynamic_update_slice(d_acc, dd[None], (wi, 0)),
+            )
+
+        r0 = jnp.zeros((w, 32), jnp.int32)
+        d0 = jnp.zeros((w, 32), jnp.float32)
+        return jax.lax.fori_loop(0, w, wbody, (r0, d0))
+
+    @jax.jit
+    def extract_word(planes, vis, src_bits, wi):
+        """Distances of word-column wi's 32 lanes as [v, 32] uint8."""
+        shifts = jnp.arange(32, dtype=jnp.uint32)
+        cnt = jnp.zeros((v, 32), jnp.uint8)
+        for i, p in enumerate(planes):
+            col = jax.lax.dynamic_slice(p, (0, wi), (rows, 1))[:v]
+            bit = ((col >> shifts) & 1).astype(jnp.uint8)
+            cnt = cnt + (bit << i)
+        visw = ((jax.lax.dynamic_slice(vis, (0, wi), (rows, 1))[:v] >> shifts) & 1) != 0
+        srcw = (
+            (jax.lax.dynamic_slice(src_bits, (0, wi), (rows, 1))[:v] >> shifts) & 1
+        ) != 0
+        return jnp.where(
+            srcw, jnp.uint8(0), jnp.where(visw, cnt + jnp.uint8(1), UNREACHED)
+        )
+
+    return seed, lane_stats, extract_word
+
+
+@dataclasses.dataclass
+class PackedBatchResult:
+    """Batch result with lazy per-word distance extraction.
+
+    Distances stay bit-sliced on device; ``distances_int32(i)`` unpacks the
+    one 32-lane word-column containing lane i (then caches it), so querying a
+    few lanes never materializes the full [S, V] array.
+    """
+
+    sources: np.ndarray  # [S] int32
+    num_levels: int  # max distance over all lanes
+    reached: np.ndarray  # [S] int64
+    edges_traversed: np.ndarray  # [S] int64 (~7-digit exact at huge scale)
+    elapsed_s: float | None
+    _engine: object
+    _planes: tuple
+    _vis: jax.Array
+    _src_bits: jax.Array
+    _word_cache: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def teps(self) -> float | None:
+        """Harmonic-mean per-source TEPS under the batch time share."""
+        if not self.elapsed_s:
+            return None
+        per_source_time = self.elapsed_s / len(self.sources)
+        t = self.edges_traversed / per_source_time
+        return float(len(t) / np.sum(1.0 / np.maximum(t, 1e-9)))
+
+    def distance_u8_lane(self, i: int) -> np.ndarray:
+        """[V] uint8 distances of batch entry i (UNREACHED where unreached)."""
+        if not (0 <= i < len(self.sources)):
+            raise IndexError(i)
+        eng = self._engine
+        wi, col = eng._word_col(i)
+        if wi not in self._word_cache:
+            dr = eng._extract_word(self._planes, self._vis, self._src_bits, wi)
+            self._word_cache[wi] = np.asarray(dr)[eng._rank]  # old-id order
+        return self._word_cache[wi][:, col]
+
+    def distances_int32(self, i: int) -> np.ndarray:
+        d8 = self.distance_u8_lane(i)
+        return np.where(d8 == UNREACHED, INF_DIST, d8.astype(np.int32))
+
+
+def run_packed_batch(
+    engine,
+    sources,
+    *,
+    max_levels: int | None = None,
+    time_it: bool = False,
+    check_cap: bool = True,
+) -> PackedBatchResult:
+    """Generic batch driver shared by the wide and hybrid engines."""
+    sources = np.asarray(sources, dtype=np.int64)
+    if sources.ndim != 1 or len(sources) == 0 or len(sources) > engine.lanes:
+        raise ValueError(f"need 1..{engine.lanes} sources, got {sources.shape}")
+    if sources.min() < 0 or sources.max() >= engine.num_vertices:
+        raise ValueError("source out of range")
+    cap = engine.max_levels_cap
+    max_levels = cap if max_levels is None else min(max_levels, cap)
+
+    fw0 = engine._seed_dev(sources)
+    if time_it and not engine._warmed:
+        int(engine._core(engine.arrs, fw0, jnp.int32(max_levels))[2])
+    t0 = time.perf_counter()
+    planes, vis, levels, alive, truncated = engine._core(
+        engine.arrs, fw0, jnp.int32(max_levels)
+    )
+    levels = int(levels)  # blocks until the loop finishes
+    elapsed = (time.perf_counter() - t0) if time_it else None
+    engine._warmed = True
+    if check_cap and bool(truncated) and max_levels == cap:
+        raise RuntimeError(
+            f"traversal truncated at {levels} levels; "
+            f"num_planes={engine.num_planes} caps at {cap} — construct the "
+            "engine with more planes for this graph"
+        )
+
+    s = len(sources)
+    r, d = engine._lane_stats(vis, engine._in_deg_ranked)
+    reached = engine._lane_order(np.asarray(r))[:s].astype(np.int64)
+    slot_sum = engine._lane_order(np.asarray(d, dtype=np.float64))[:s]
+    edges = (slot_sum / 2 if engine.undirected else slot_sum).astype(np.int64)
+
+    # Engines whose result tables use a different row order than their seed
+    # table (the distributed wide engine) provide a converting view.
+    src_bits = getattr(engine, "_src_bits_view", lambda x: x)(fw0)
+    res = PackedBatchResult(
+        sources=sources.astype(np.int32),
+        num_levels=levels,
+        reached=reached,
+        edges_traversed=edges,
+        elapsed_s=elapsed,
+        _engine=engine,
+        _planes=planes,
+        _vis=vis,
+        _src_bits=src_bits,
+    )
+    # The loop's last body found an empty frontier iff not alive; then the
+    # max eccentricity is one less than the body count.
+    if levels > 0 and not bool(alive):
+        res.num_levels = levels - 1
+    return res

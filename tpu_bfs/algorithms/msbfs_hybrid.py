@@ -1,0 +1,340 @@
+"""Hybrid dense-MXU + sparse-gather wide multi-source BFS.
+
+The wide engine (msbfs_wide.py) pays ~13 ns of random-gather tax per edge
+slot, every level, for every edge. But on a degree-sorted power-law graph the
+edge mass is bimodal: measured on RMAT scale-21, 128x128 adjacency tiles
+holding >= 64 edges cover ~57% of all edges in ~2% of the occupied tiles.
+This engine splits the graph once at build time:
+
+- **dense part**: tiles with >= ``tile_thr`` edges (trimmed to an HBM
+  budget), expanded per level by the Pallas MXU kernel
+  (tpu_bfs/ops/tile_spmm.py) at ~0.5 us/tile — replacing ~128 x 13 ns of
+  gather tax per tile;
+- **residual part**: everything else, expanded by the same bucketed-ELL
+  fori-loop gathers as the wide engine.
+
+Row space is "rank0" order (descending full in-degree) padded to VT*128 rows
+so the dense kernel's frontier DMAs are contiguous slabs. The residual ELL
+buckets rows by *residual* degree, so its outputs come out in a different
+(bucket) order; one static permutation gather per level routes them back to
+rank0 before the claim. Everything else — packed claim ``& ~visited``,
+bit-sliced distance planes, device-side stats, lazy extraction — is the
+shared machinery in _packed_common.py.
+
+Lane convention is bit-major (lane ``l`` at word ``l % W``, bit ``l // W``),
+the layout tile_spmm requires; it only changes the seed/extract index maps.
+
+Reference mapping: this is the capability of the reference's whole kernel
+layer (queueBfs, bfs.cu:134-165; multiBfs, bfs.cu:101-130) re-planned around
+the TPU's MXU/VPU split instead of CUDA thread divergence. Measured flagship:
+38 GTEPS harmonic-mean per-source on RMAT scale-21, 1 v5e chip (bench.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_bfs.graph.csr import Graph
+from tpu_bfs.graph.ell import EllBucket, bucketize_rows
+from tpu_bfs.algorithms.msbfs_packed import ripple_increment
+from tpu_bfs.algorithms._packed_common import (
+    ExpandSpec,
+    expand_arrays,
+    make_fori_expand,
+    make_state_kernels,
+    run_packed_batch,
+)
+from tpu_bfs.ops.tile_spmm import TILE, tile_spmm
+
+W = 128
+LANES = 32 * W
+
+
+@dataclasses.dataclass(frozen=True)
+class HybridGraph:
+    """Build-time split of a graph into dense MXU tiles + residual ELL.
+
+    Rank0 space: row r of the frontier table is vertex ``old_of_new[r]``;
+    rows [V, VT*128) are zero padding (the ELL pad sentinel is VT*128-1).
+    Residual bucket space: output row p of the residual expansion is rank0
+    row ``r_order[p]``; ``inv_perm_ext`` routes rank0 row -> bucket output
+    row (pad/empty rows -> the appended all-zero row).
+    """
+
+    num_vertices: int
+    num_edges: int
+    undirected: bool
+    kcap: int
+    vt: int  # frontier slabs of 128 rows; table height = vt * 128
+    old_of_new: np.ndarray  # [V] int32
+    rank: np.ndarray  # [V] int32
+    in_degree: np.ndarray  # [V] int64, original ids
+    # dense part
+    num_dense_edges: int  # directed slots routed to tiles (duplicates collapse)
+    row_start: np.ndarray  # [vt+1] int32 CSR over row-tiles
+    col_tile: np.ndarray  # [NT] int32
+    a_tiles: np.ndarray  # [NT, TILE, TILE] int8
+    # residual part (build_ell-style buckets over residual degree)
+    res_heavy: int
+    res_num_virtual: int
+    res_fold_steps: int
+    res_virtual: EllBucket | None
+    res_fold_pad_map: np.ndarray | None
+    res_heavy_pick: np.ndarray | None
+    res_light: list[EllBucket]
+    res_tail_rows: int  # zero rows appended after buckets (incl. the map target)
+    inv_perm_ext: np.ndarray  # [vt*128] int32 rank0 row -> bucket output row
+
+    # expand_arrays protocol
+    @property
+    def virtual(self):
+        return self.res_virtual
+
+    @property
+    def fold_pad_map(self):
+        return self.res_fold_pad_map
+
+    @property
+    def heavy_pick(self):
+        return self.res_heavy_pick
+
+    @property
+    def light(self):
+        return self.res_light
+
+    @property
+    def num_tiles(self) -> int:
+        return len(self.col_tile)
+
+
+def build_hybrid(
+    g: Graph,
+    *,
+    kcap: int = 64,
+    tile_thr: int = 64,
+    a_budget_bytes: int = int(1.6e9),
+) -> HybridGraph:
+    """Split ``g`` into dense 128x128 tiles (>= tile_thr edges, trimmed to the
+    int8 storage budget by descending edge count) and a residual ELL."""
+    v = g.num_vertices
+    src, dst = g.coo
+    in_deg = np.bincount(dst, minlength=v).astype(np.int64)
+    rank_order = np.argsort(-in_deg, kind="stable").astype(np.int32)
+    rank = np.empty(v, dtype=np.int32)
+    rank[rank_order] = np.arange(v, dtype=np.int32)
+
+    vt = -(-(v + 1) // TILE)
+    r = rank[dst].astype(np.int64)
+    c = rank[src].astype(np.int64)
+    tid = (r // TILE) * vt + (c // TILE)
+
+    uniq, inv, cnt = np.unique(tid, return_inverse=True, return_counts=True)
+    eligible = np.flatnonzero(cnt >= max(tile_thr, 1))
+    max_tiles = max(a_budget_bytes // (TILE * TILE), 0)
+    if len(eligible) > max_tiles:
+        # Keep the highest-count tiles within budget.
+        order = eligible[np.argsort(-cnt[eligible], kind="stable")][:max_tiles]
+        eligible = np.sort(order)
+    is_dense_tile = np.zeros(len(uniq), dtype=bool)
+    is_dense_tile[eligible] = True
+    dense_edge = is_dense_tile[inv]
+
+    # --- dense arrays ---
+    dense_uniq = uniq[eligible]  # sorted: row-tile-major then col-tile
+    nt = len(dense_uniq)
+    row_tiles = (dense_uniq // vt).astype(np.int64)
+    col_tile = (dense_uniq % vt).astype(np.int32)
+    row_start = np.searchsorted(row_tiles, np.arange(vt + 1)).astype(np.int32)
+    a_tiles = np.zeros((max(nt, 1), TILE, TILE), dtype=np.int8)
+    if nt:
+        # Map each dense edge to its tile slot via searchsorted on dense_uniq.
+        de = np.flatnonzero(dense_edge)
+        slot = np.searchsorted(dense_uniq, tid[de])
+        flat = slot * (TILE * TILE) + (r[de] % TILE) * TILE + (c[de] % TILE)
+        a_tiles.reshape(-1)[flat] = 1
+
+    # --- residual ELL, bucketed by residual in-degree, targets in rank0 ids ---
+    re_mask = ~dense_edge
+    res_dst_rank = r[re_mask]
+    res_src_rank = c[re_mask].astype(np.int32)
+    res_deg_rank = np.bincount(res_dst_rank, minlength=v).astype(np.int64)
+
+    r_order = np.argsort(-res_deg_rank, kind="stable").astype(np.int64)
+    bucket_pos = np.empty(v, dtype=np.int64)
+    bucket_pos[r_order] = np.arange(v)
+
+    # Flatten residual in-neighbors grouped by destination row, in r_order.
+    order_e = np.argsort(bucket_pos[res_dst_rank], kind="stable")
+    nbrs = res_src_rank[order_e]  # rank0-space sources, grouped by bucket row
+    lens = res_deg_rank[r_order]
+    new_rp = np.zeros(v + 1, dtype=np.int64)
+    np.cumsum(lens, out=new_rp[1:])
+
+    sentinel = vt * TILE - 1
+    (
+        num_heavy, num_nonzero, num_virtual, fold_steps,
+        virtual, fold_pad_map, heavy_pick, light,
+    ) = bucketize_rows(lens, nbrs, new_rp, kcap, sentinel)
+
+    # Bucket outputs cover rows 0..num_nonzero in r_order; rows with zero
+    # residual degree and pad rows all map to the appended zero row.
+    inv_perm_ext = np.full(vt * TILE, num_nonzero, dtype=np.int32)
+    real = r_order[:num_nonzero]
+    inv_perm_ext[real] = np.arange(num_nonzero, dtype=np.int32)
+
+    return HybridGraph(
+        num_vertices=v,
+        num_edges=g.num_edges,
+        undirected=g.undirected,
+        kcap=kcap,
+        vt=vt,
+        old_of_new=rank_order,
+        rank=rank,
+        in_degree=in_deg,
+        num_dense_edges=int(dense_edge.sum()),
+        row_start=row_start,
+        col_tile=col_tile,
+        a_tiles=a_tiles if nt else a_tiles[:0],
+        res_heavy=num_heavy,
+        res_num_virtual=num_virtual,
+        res_fold_steps=fold_steps,
+        res_virtual=virtual,
+        res_fold_pad_map=fold_pad_map,
+        res_heavy_pick=heavy_pick,
+        res_light=light,
+        res_tail_rows=1,  # one shared all-zero output row
+        inv_perm_ext=inv_perm_ext,
+    )
+
+
+def _make_core(hg: HybridGraph, w: int, num_planes: int, interpret: bool):
+    rows = hg.vt * TILE
+    spec = ExpandSpec(
+        kcap=hg.kcap,
+        heavy=hg.res_heavy > 0,
+        num_virtual=hg.res_num_virtual,
+        fold_steps=hg.res_fold_steps,
+        light_meta=tuple((b.k, b.n) for b in hg.res_light),
+        tail_rows=hg.res_tail_rows,
+    )
+    expand_residual = make_fori_expand(spec, w)
+    has_dense = hg.num_tiles > 0
+
+    @jax.jit
+    def core(arrs, fw0, max_levels):
+        planes0 = tuple(jnp.zeros((rows, w), jnp.uint32) for _ in range(num_planes))
+
+        def hit_of(fw):
+            hit = expand_residual(arrs, fw)[arrs["inv_perm_ext"]]
+            if has_dense:
+                hit = hit | tile_spmm(
+                    arrs["row_start"], arrs["col_tile"], arrs["a_tiles"], fw,
+                    num_row_tiles=hg.vt, w=w, interpret=interpret,
+                )
+            return hit
+
+        def cond(carry):
+            _, _, _, level, alive = carry
+            return alive & (level < max_levels)
+
+        def body(carry):
+            fw, vis, planes, level, _ = carry
+            nxt = hit_of(fw) & ~vis
+            vis2 = vis | nxt
+            planes = ripple_increment(planes, ~vis2)
+            alive = jnp.any(nxt != 0)
+            return nxt, vis2, planes, level + 1, alive
+
+        fw_f, vis_f, planes_f, levels, alive = jax.lax.while_loop(
+            cond, body, (fw0, fw0, planes0, jnp.int32(0), jnp.bool_(True))
+        )
+
+        def deeper():
+            return jnp.any((hit_of(fw_f) & ~vis_f) != 0)
+
+        truncated = jax.lax.cond(
+            alive & (levels >= max_levels), deeper, lambda: jnp.bool_(False)
+        )
+        return planes_f, vis_f, levels, alive, truncated
+
+    return core
+
+
+class HybridMsBfsEngine:
+    """Up to 4096 concurrent BFS sources; dense tiles on the MXU, residual on
+    gathers. API mirrors WidePackedMsBfsEngine; results are PackedBatchResult."""
+
+    def __init__(
+        self,
+        graph: Graph | HybridGraph,
+        *,
+        kcap: int = 64,
+        tile_thr: int = 64,
+        a_budget_bytes: int = int(1.6e9),
+        num_planes: int = 5,
+        interpret: bool | None = None,
+        undirected: bool | None = None,
+    ):
+        if not (1 <= num_planes <= 8):
+            raise ValueError("num_planes must be in [1, 8]")
+        self.w = W
+        self.lanes = LANES
+        self.num_planes = num_planes
+        self.max_levels_cap = min(1 << num_planes, 254)
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        self.hg = (
+            build_hybrid(
+                graph, kcap=kcap, tile_thr=tile_thr, a_budget_bytes=a_budget_bytes
+            )
+            if isinstance(graph, Graph)
+            else graph
+        )
+        hg = self.hg
+        self.undirected = hg.undirected if undirected is None else undirected
+        arrs = expand_arrays(hg)
+        arrs["inv_perm_ext"] = jnp.asarray(hg.inv_perm_ext)
+        if hg.num_tiles:
+            arrs["row_start"] = jnp.asarray(hg.row_start)
+            arrs["col_tile"] = jnp.asarray(hg.col_tile)
+            arrs["a_tiles"] = jnp.asarray(hg.a_tiles)
+        self.arrs = arrs
+        self._core = _make_core(hg, self.w, num_planes, interpret)
+        self._seed, self._lane_stats, self._extract_word = make_state_kernels(
+            hg.num_vertices, hg.vt * TILE, self.w, num_planes
+        )
+        self._rank = hg.rank
+        self._in_deg_ranked = jnp.asarray(
+            hg.in_degree[hg.old_of_new].astype(np.float32)
+        )
+        self._warmed = False
+
+    @property
+    def num_vertices(self) -> int:
+        return self.hg.num_vertices
+
+    # Bit-major lane map: lane l at word l % W, bit l // W (tile_spmm layout).
+    @staticmethod
+    def _word_col(i: int):
+        return i % W, i // W
+
+    @staticmethod
+    def _lane_order(mat: np.ndarray) -> np.ndarray:
+        return np.ascontiguousarray(mat.T).reshape(-1)
+
+    def _seed_dev(self, sources: np.ndarray):
+        ranks = self.hg.rank[sources].astype(np.int32)
+        lanes = np.arange(len(sources), dtype=np.int32)
+        words = (lanes % W).astype(np.int32)
+        bits = np.uint32(1) << (lanes // W).astype(np.uint32)
+        return self._seed(jnp.asarray(ranks), jnp.asarray(words), jnp.asarray(bits))
+
+    def run(self, sources, *, max_levels=None, time_it=False, check_cap=True):
+        return run_packed_batch(
+            self, sources, max_levels=max_levels, time_it=time_it,
+            check_cap=check_cap,
+        )

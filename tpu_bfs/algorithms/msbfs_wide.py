@@ -10,12 +10,11 @@ over 8x more sources than the 512-lane engine for the same index count.
 
 Differences from PackedMsBfsEngine (tpu_bfs/algorithms/msbfs_packed.py):
 
-- Bucket OR-accumulation runs in ``lax.fori_loop`` instead of an unrolled
-  Python loop, so only one gather result is live at a time (the unrolled form
-  kept ~20 padded [n, w] intermediates alive and OOM'd at w >= 64).
+- Bucket OR-accumulation runs in ``lax.fori_loop`` (one live gather result
+  instead of ~20 padded intermediates — see _packed_common.make_fori_expand).
 - The frontier table keeps its sentinel row inside the loop state ([V+1, w]
-  throughout), removing the reference-style per-level re-upload analog — the
-  1 GiB/level concatenate copy XLA emitted for the old shape dance.
+  throughout), removing the 1 GiB/level concatenate copy XLA emitted for the
+  old shape dance.
 - Bit-sliced distance counters are ``num_planes`` wide (default 5 -> max 32
   levels) instead of a fixed 8, saving 3 GiB of HBM at w=128; the engine
   raises if the traversal outlives the cap instead of mislabeling.
@@ -27,74 +26,47 @@ Replaces the reference's one-source-per-process loop (main, bfs.cu:783-823)
 with the Graph500 many-key pattern in one fused device program; claim protocol
 is ``next = hit & ~visited`` on packed words — the race-free reformulation of
 the atomicMin claim (bfs.cu:146-150), which has no TPU analog.
+
+Lane convention: word-major — lane ``l`` at word ``l // 32``, bit ``l % 32``.
+(The hybrid engine is bit-major instead, as its MXU kernel requires.)
 """
 
 from __future__ import annotations
-
-import dataclasses
-import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpu_bfs.graph.csr import Graph, INF_DIST
+from tpu_bfs.graph.csr import Graph
 from tpu_bfs.graph.ell import EllGraph, build_ell
-from tpu_bfs.algorithms.msbfs_packed import UNREACHED, ripple_increment
+from tpu_bfs.algorithms.msbfs_packed import ripple_increment
+from tpu_bfs.algorithms._packed_common import (
+    ExpandSpec,
+    expand_arrays,
+    make_fori_expand,
+    make_state_kernels,
+    run_packed_batch,
+)
 
 W = 128  # uint32 words per row: the measured v5e sweet spot (no tile padding)
 LANES = 32 * W
 
-
-def make_wide_expand(ell: EllGraph, w: int):
-    """Bucketed-ELL expansion with fori-loop OR accumulation.
-
-    fw is the [V+1, w] frontier table (sentinel last row all-zero, targeted by
-    ELL padding slots); returns the [V+1, w] hit table (sentinel row zero).
-    """
-    v = ell.num_vertices
-    tail_rows = v - ell.num_nonzero + 1  # zero-degree rows + sentinel row
-
-    def expand(arrs, fw):
-        parts = []
-        if ell.num_heavy:
-            vr_t = arrs["virtual_t"]  # [kcap, M]
-
-            def vbody(kk, acc):
-                return acc | fw[vr_t[kk]]
-
-            acc = jax.lax.fori_loop(
-                0, ell.kcap, vbody,
-                jnp.zeros((ell.num_virtual, w), jnp.uint32),
-            )
-            vr_ext = jnp.concatenate([acc, jnp.zeros((1, w), jnp.uint32)])
-            cur = vr_ext[arrs["fold_pad_map"]]
-            pyramid = [cur]
-            for _ in range(ell.fold_steps):
-                pairs = cur.reshape(-1, 2, w)
-                cur = pairs[:, 0] | pairs[:, 1]
-                pyramid.append(cur)
-            pyr = jnp.concatenate(pyramid) if len(pyramid) > 1 else pyramid[0]
-            parts.append(pyr[arrs["heavy_pick"]])
-        for i, b in enumerate(ell.light):
-            bt = arrs[f"light{i}_t"]  # [k, n]
-
-            def lbody(kk, acc, bt=bt):
-                return acc | fw[bt[kk]]
-
-            acc = jax.lax.fori_loop(
-                0, b.k, lbody, jnp.zeros((b.n, w), jnp.uint32)
-            )
-            parts.append(acc)
-        parts.append(jnp.zeros((tail_rows, w), jnp.uint32))
-        return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
-
-    return expand
+# Re-exported for callers that consumed these from here before the
+# _packed_common refactor.
+from tpu_bfs.algorithms._packed_common import PackedBatchResult as WideBfsResult  # noqa: E402
 
 
 def _make_core(ell: EllGraph, w: int, num_planes: int):
     v = ell.num_vertices
-    expand = make_wide_expand(ell, w)
+    spec = ExpandSpec(
+        kcap=ell.kcap,
+        heavy=ell.num_heavy > 0,
+        num_virtual=ell.num_virtual,
+        fold_steps=ell.fold_steps,
+        light_meta=tuple((b.k, b.n) for b in ell.light),
+        tail_rows=v - ell.num_nonzero + 1,  # zero-degree rows + sentinel row
+    )
+    expand = make_fori_expand(spec, w)
 
     @jax.jit
     def core(arrs, fw0, max_levels):
@@ -133,97 +105,7 @@ def _make_core(ell: EllGraph, w: int, num_planes: int):
         )
         return planes_f, vis_f, levels, alive, truncated
 
-    @jax.jit
-    def seed(rows, words, bits):
-        # Distinct lanes own distinct (word, bit) pairs, so scatter-add == OR.
-        fw0 = jnp.zeros((v + 1, w), jnp.uint32)
-        return fw0.at[rows, words].add(bits)
-
-    @jax.jit
-    def lane_stats(vis, in_deg):
-        """Per-lane reached count and degree sum, on device.
-
-        vis [v+1, w] u32; in_deg [v] f32 (rank order). Returns
-        (reached [w,32] i32 exact, deg_sum [w,32] f32 — f32 because TPU has no
-        int64 and the per-lane degree sum can exceed int32 at Graph500 scale;
-        pairwise summation keeps the TEPS numerator accurate to ~7 digits)."""
-        shifts = jnp.arange(32, dtype=jnp.uint32)
-
-        def wbody(wi, acc):
-            r_acc, d_acc = acc
-            col = jax.lax.dynamic_slice(vis, (0, wi), (v + 1, 1))[:v]  # [v,1]
-            bits = (col >> shifts) & 1  # [v, 32] u32
-            r = jnp.sum(bits.astype(jnp.int32), axis=0)
-            d = jnp.sum(bits.astype(jnp.float32) * in_deg[:, None], axis=0)
-            return (
-                jax.lax.dynamic_update_slice(r_acc, r[None], (wi, 0)),
-                jax.lax.dynamic_update_slice(d_acc, d[None], (wi, 0)),
-            )
-
-        r0 = jnp.zeros((w, 32), jnp.int32)
-        d0 = jnp.zeros((w, 32), jnp.float32)
-        return jax.lax.fori_loop(0, w, wbody, (r0, d0))
-
-    @jax.jit
-    def extract_word(planes, vis, src_bits, wi):
-        """Distances of lanes [32*wi, 32*wi+32) as [v, 32] uint8."""
-        shifts = jnp.arange(32, dtype=jnp.uint32)
-        cnt = jnp.zeros((v, 32), jnp.uint8)
-        for i, p in enumerate(planes):
-            col = jax.lax.dynamic_slice(p, (0, wi), (v + 1, 1))[:v]
-            bit = ((col >> shifts) & 1).astype(jnp.uint8)
-            cnt = cnt + (bit << i)
-        visw = ((jax.lax.dynamic_slice(vis, (0, wi), (v + 1, 1))[:v] >> shifts) & 1) != 0
-        srcw = ((jax.lax.dynamic_slice(src_bits, (0, wi), (v + 1, 1))[:v] >> shifts) & 1) != 0
-        return jnp.where(
-            srcw, jnp.uint8(0), jnp.where(visw, cnt + jnp.uint8(1), UNREACHED)
-        )
-
-    return core, seed, lane_stats, extract_word
-
-
-@dataclasses.dataclass
-class WideBfsResult:
-    """Batch result with lazy per-lane distance extraction.
-
-    Distances stay bit-sliced on device; ``distances_int32(i)`` unpacks the
-    one 32-lane word containing lane i (then caches it), so querying a few
-    lanes never materializes the full [S, V] array.
-    """
-
-    sources: np.ndarray  # [S] int32
-    num_levels: int  # max distance over all lanes
-    reached: np.ndarray  # [S] int64
-    edges_traversed: np.ndarray  # [S] int64
-    elapsed_s: float | None
-    _engine: "WidePackedMsBfsEngine"
-    _planes: tuple
-    _vis: jax.Array
-    _src_bits: jax.Array
-    _word_cache: dict = dataclasses.field(default_factory=dict)
-
-    @property
-    def teps(self) -> float | None:
-        if not self.elapsed_s:
-            return None
-        per_source_time = self.elapsed_s / len(self.sources)
-        t = self.edges_traversed / per_source_time
-        return float(len(t) / np.sum(1.0 / np.maximum(t, 1e-9)))
-
-    def distance_u8_lane(self, i: int) -> np.ndarray:
-        """[V] uint8 distances of batch entry i (UNREACHED where not reached)."""
-        if not (0 <= i < len(self.sources)):
-            raise IndexError(i)
-        wi = i // 32
-        if wi not in self._word_cache:
-            eng = self._engine
-            dr = eng._extract_word(self._planes, self._vis, self._src_bits, wi)
-            self._word_cache[wi] = np.asarray(dr)[eng.ell.rank]  # old-id order
-        return self._word_cache[wi][:, i % 32]
-
-    def distances_int32(self, i: int) -> np.ndarray:
-        d8 = self.distance_u8_lane(i)
-        return np.where(d8 == UNREACHED, INF_DIST, d8.astype(np.int32))
+    return core
 
 
 class WidePackedMsBfsEngine:
@@ -256,17 +138,12 @@ class WidePackedMsBfsEngine:
         self.ell = build_ell(graph, kcap=kcap) if isinstance(graph, Graph) else graph
         self.undirected = self.ell.undirected if undirected is None else undirected
         ell = self.ell
-        arrs = {}
-        if ell.num_heavy:
-            arrs["virtual_t"] = jnp.asarray(np.ascontiguousarray(ell.virtual.idx.T))
-            arrs["fold_pad_map"] = jnp.asarray(ell.fold_pad_map)
-            arrs["heavy_pick"] = jnp.asarray(ell.heavy_pick)
-        for i, b in enumerate(ell.light):
-            arrs[f"light{i}_t"] = jnp.asarray(np.ascontiguousarray(b.idx.T))
-        self.arrs = arrs
-        self._core, self._seed, self._lane_stats, self._extract_word = _make_core(
-            ell, self.w, num_planes
+        self.arrs = expand_arrays(ell)
+        self._core = _make_core(ell, self.w, num_planes)
+        self._seed, self._lane_stats, self._extract_word = make_state_kernels(
+            ell.num_vertices, ell.num_vertices + 1, self.w, num_planes
         )
+        self._rank = ell.rank
         self._in_deg_ranked = jnp.asarray(
             ell.in_degree[ell.old_of_new].astype(np.float32)
         )
@@ -276,69 +153,24 @@ class WidePackedMsBfsEngine:
     def num_vertices(self) -> int:
         return self.ell.num_vertices
 
+    # Word-major lane map: lane l at word l // 32, bit l % 32.
+    @staticmethod
+    def _word_col(i: int):
+        return i // 32, i % 32
+
+    @staticmethod
+    def _lane_order(mat: np.ndarray) -> np.ndarray:
+        return mat.reshape(-1)
+
     def _seed_dev(self, sources: np.ndarray):
         ranks = self.ell.rank[sources].astype(np.int32)
         lanes = np.arange(len(sources), dtype=np.int32)
         words = lanes // 32
         bits = np.uint32(1) << (lanes % 32).astype(np.uint32)
-        return self._seed(
-            jnp.asarray(ranks), jnp.asarray(words), jnp.asarray(bits)
+        return self._seed(jnp.asarray(ranks), jnp.asarray(words), jnp.asarray(bits))
+
+    def run(self, sources, *, max_levels=None, time_it=False, check_cap=True):
+        return run_packed_batch(
+            self, sources, max_levels=max_levels, time_it=time_it,
+            check_cap=check_cap,
         )
-
-    def run(
-        self,
-        sources,
-        *,
-        max_levels: int | None = None,
-        time_it: bool = False,
-        check_cap: bool = True,
-    ) -> WideBfsResult:
-        sources = np.asarray(sources, dtype=np.int64)
-        if sources.ndim != 1 or len(sources) == 0 or len(sources) > self.lanes:
-            raise ValueError(f"need 1..{self.lanes} sources, got {sources.shape}")
-        if sources.min() < 0 or sources.max() >= self.ell.num_vertices:
-            raise ValueError("source out of range")
-        cap = self.max_levels_cap
-        max_levels = cap if max_levels is None else min(max_levels, cap)
-
-        fw0 = self._seed_dev(sources)
-        if time_it and not self._warmed:
-            int(self._core(self.arrs, fw0, jnp.int32(max_levels))[2])
-        t0 = time.perf_counter()
-        planes, vis, levels, alive, truncated = self._core(
-            self.arrs, fw0, jnp.int32(max_levels)
-        )
-        levels = int(levels)  # blocks until the loop finishes
-        elapsed = (time.perf_counter() - t0) if time_it else None
-        self._warmed = True
-        if check_cap and bool(truncated) and max_levels == cap:
-            raise RuntimeError(
-                f"traversal truncated at {levels} levels; "
-                f"num_planes={self.num_planes} caps at {cap} — construct the "
-                "engine with more planes for this graph"
-            )
-
-        s = len(sources)
-        r, d = self._lane_stats(vis, self._in_deg_ranked)
-        reached = np.asarray(r).reshape(-1)[:s].astype(np.int64)
-        slot_sum = np.asarray(d, dtype=np.float64).reshape(-1)[:s]
-        edges = (slot_sum / 2 if self.undirected else slot_sum).astype(np.int64)
-
-        res = WideBfsResult(
-            sources=sources.astype(np.int32),
-            num_levels=levels,
-            reached=reached,
-            edges_traversed=edges,
-            elapsed_s=elapsed,
-            _engine=self,
-            _planes=planes,
-            _vis=vis,
-            _src_bits=fw0,
-        )
-        # Report the true max eccentricity over lanes, not loop iterations:
-        # the distance histogram of one lane is cheap; take max over sampled
-        # lanes only when asked — loop count minus 1 is exact when the last
-        # body found an empty frontier.
-        if levels > 0 and not bool(alive):
-            res.num_levels = levels - 1
-        return res

@@ -279,29 +279,18 @@ def starts_of(rows: np.ndarray, new_rp: np.ndarray) -> np.ndarray:
     return new_rp[rows]
 
 
-def build_ell(g: Graph, *, kcap: int = 64) -> EllGraph:
-    """Build the bucketed in-neighbor ELL from a host CSR graph."""
-    v_count = g.num_vertices
-    # In-CSR: neighbors-by-destination. For the undirected double-insert
-    # representation this equals the out-CSR, but build it generally.
-    src, dst = g.coo
-    order_ds = _lexsort_pairs(dst, src, v_count)
-    in_col = src[order_ds]
-    in_deg = np.bincount(dst, minlength=v_count).astype(np.int64)
+def bucketize_rows(lens: np.ndarray, nbrs: np.ndarray, new_rp: np.ndarray,
+                   kcap: int, pad: int):
+    """Split degree-sorted rows into the heavy virtual-row + fold-pyramid
+    section and the light width ladder.
 
-    rank_order = np.argsort(-in_deg, kind="stable").astype(np.int32)  # new -> old
-    rank = np.empty(v_count, dtype=np.int32)
-    rank[rank_order] = np.arange(v_count, dtype=np.int32)
-
-    # Flatten in-neighbor lists in rank order, neighbor ids mapped to rank space.
-    in_rp = np.zeros(v_count + 1, dtype=np.int64)
-    np.cumsum(in_deg, out=in_rp[1:])
-    lens = in_deg[rank_order]
-    new_rp = np.zeros(v_count + 1, dtype=np.int64)
-    np.cumsum(lens, out=new_rp[1:])
-    e = int(new_rp[-1])
-    nbrs = rank[in_col[_flat_positions(in_rp[rank_order], lens)]]
-
+    ``lens`` must be non-increasing; ``nbrs`` is the concatenated neighbor
+    lists in row order with ``new_rp`` boundaries; ``pad`` is the sentinel
+    neighbor id for unused slots. Returns ``(num_heavy, num_nonzero,
+    num_virtual, fold_steps, virtual, fold_pad_map, heavy_pick, light)`` —
+    the bucket structure shared by build_ell, build_ell_sharded's per-shard
+    logic, and the hybrid engine's residual split.
+    """
     num_heavy = int(np.searchsorted(-lens, -kcap, side="left"))
     num_nonzero = int(np.searchsorted(-lens, 0, side="left"))
 
@@ -323,7 +312,7 @@ def build_ell(g: Graph, *, kcap: int = 64) -> EllGraph:
             row_start=0,
             n=num_virtual,
             k=kcap,
-            idx=_ell_fill(vlens, heavy_flat, kcap, v_count),
+            idx=_ell_fill(vlens, heavy_flat, kcap, pad),
         )
         # Aligned power-of-two layout: vertex h owns rows
         # [pstart[h], pstart[h] + rp2[h]) with rp2 = next_pow2(r_per).
@@ -353,11 +342,45 @@ def build_ell(g: Graph, *, kcap: int = 64) -> EllGraph:
             light.append(
                 EllBucket(
                     row_start=row, n=hi - row, k=k,
-                    idx=_ell_fill(lens[sl], flat, k, v_count),
+                    idx=_ell_fill(lens[sl], flat, k, pad),
                 )
             )
             row = hi
         k //= 2
+
+    return (
+        num_heavy, num_nonzero, num_virtual, fold_steps,
+        virtual, fold_pad_map, heavy_pick, light,
+    )
+
+
+def build_ell(g: Graph, *, kcap: int = 64) -> EllGraph:
+    """Build the bucketed in-neighbor ELL from a host CSR graph."""
+    v_count = g.num_vertices
+    # In-CSR: neighbors-by-destination. For the undirected double-insert
+    # representation this equals the out-CSR, but build it generally.
+    src, dst = g.coo
+    order_ds = _lexsort_pairs(dst, src, v_count)
+    in_col = src[order_ds]
+    in_deg = np.bincount(dst, minlength=v_count).astype(np.int64)
+
+    rank_order = np.argsort(-in_deg, kind="stable").astype(np.int32)  # new -> old
+    rank = np.empty(v_count, dtype=np.int32)
+    rank[rank_order] = np.arange(v_count, dtype=np.int32)
+
+    # Flatten in-neighbor lists in rank order, neighbor ids mapped to rank space.
+    in_rp = np.zeros(v_count + 1, dtype=np.int64)
+    np.cumsum(in_deg, out=in_rp[1:])
+    lens = in_deg[rank_order]
+    new_rp = np.zeros(v_count + 1, dtype=np.int64)
+    np.cumsum(lens, out=new_rp[1:])
+    e = int(new_rp[-1])
+    nbrs = rank[in_col[_flat_positions(in_rp[rank_order], lens)]]
+
+    (
+        num_heavy, num_nonzero, num_virtual, fold_steps,
+        virtual, fold_pad_map, heavy_pick, light,
+    ) = bucketize_rows(lens, nbrs, new_rp, kcap, v_count)
 
     return EllGraph(
         num_vertices=v_count,

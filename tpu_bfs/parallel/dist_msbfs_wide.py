@@ -1,0 +1,251 @@
+"""Distributed 4096-lane bit-packed multi-source BFS over a 1D device mesh.
+
+The multi-chip form of the wide engine (tpu_bfs/algorithms/msbfs_wide.py),
+sharing its batch driver and lazy extraction through _packed_common. Compared
+to the reference's distribution — full CSR replicated to every device
+(initCuda2, bfs.cu:346-351), with only distance *ownership* split — this
+shards the expensive thing (the ELL edge structure, dealt round-robin over
+degree-sorted rows so every chip gets the same degree mix) and replicates the
+cheap thing (the packed frontier words, V * 4W bytes regardless of E):
+
+- per level each chip expands only its owned rows through its ELL shard,
+  claims ``& ~visited`` on owned words, and ``all_gather`` over the mesh
+  rebuilds the replicated frontier (replacing cudaMemcpyPeer, bfs.cu:604-606,
+  and MPI_Sendrecv, bfs_mpi.cu:615);
+- termination reads the gathered frontier, so no extra Allreduce
+  (bfs_mpi.cu:621) and zero host round-trips inside the level loop;
+- the same shard_map program serves ICI and DCN meshes, collapsing the
+  reference's two near-identical source files into one driver.
+
+Row layout after the run is chip-major: row ``p * v_loc + l`` of the
+reassembled tables holds global rank ``l * P + p``; ``_rank`` maps original
+vertex ids straight to chip-major rows so the shared lazy extraction works
+unchanged.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_bfs.graph.csr import Graph
+from tpu_bfs.graph.ell import ShardedEllGraph, build_ell_sharded
+from tpu_bfs.algorithms.msbfs_packed import ripple_increment
+from tpu_bfs.algorithms._packed_common import (
+    ExpandSpec,
+    make_fori_expand,
+    make_state_kernels,
+    run_packed_batch,
+)
+from tpu_bfs.parallel.dist_bfs import make_mesh
+
+W = 128
+LANES = 32 * W
+
+
+def _make_dist_core(sell: ShardedEllGraph, w: int, num_planes: int, mesh: Mesh):
+    p_count = sell.num_shards
+    v_loc = sell.v_loc
+    v_pad = sell.v_pad
+    spec = ExpandSpec(
+        kcap=sell.kcap,
+        heavy=sell.heavy_per_shard > 0,
+        num_virtual=sell.num_virtual,
+        fold_steps=sell.fold_steps,
+        light_meta=tuple((k, blocks.shape[1]) for k, blocks in sell.light),
+        tail_rows=sell.tail_rows,
+    )
+    expand = make_fori_expand(spec, w)
+
+    def chip_fn(arrs, fw0, max_levels):
+        # Block specs keep a leading shard axis of size 1; drop it.
+        arrs = {k: a[0] for k, a in arrs.items()}
+        p = lax.axis_index("v")
+        own = lambda full: lax.dynamic_index_in_dim(
+            full[:v_pad].reshape(v_loc, p_count, w), p, axis=1, keepdims=False
+        )
+        planes0 = tuple(jnp.zeros((v_loc, w), jnp.uint32) for _ in range(num_planes))
+
+        def cond(carry):
+            _, _, _, level, alive = carry
+            return alive & (level < max_levels)
+
+        def body(carry):
+            fw, vis, planes, level, _ = carry
+            hit = expand(arrs, fw)
+            nxt = hit & ~vis
+            vis2 = vis | nxt
+            planes = ripple_increment(planes, ~vis2)
+            gathered = lax.all_gather(nxt, "v")  # [P, v_loc, W]
+            fw_flat = gathered.transpose(1, 0, 2).reshape(v_pad, w)
+            fw_next = jnp.concatenate([fw_flat, jnp.zeros((1, w), jnp.uint32)])
+            alive = jnp.any(fw_flat != 0)
+            return fw_next, vis2, planes, level + 1, alive
+
+        fw_f, vis_f, planes_f, levels, alive = lax.while_loop(
+            cond, body, (fw0, own(fw0), planes0, jnp.int32(0), jnp.bool_(True))
+        )
+
+        # Claim-free truncation probe (see msbfs_wide): one more expand, only
+        # when the loop exited at the cap with a live frontier.
+        def deeper():
+            local = jnp.any((expand(arrs, fw_f) & ~vis_f) != 0)
+            return lax.psum(local.astype(jnp.int32), "v") > 0
+
+        truncated = lax.cond(
+            alive & (levels >= max_levels), deeper,
+            lambda: lax.psum(jnp.int32(0), "v") > 0,
+        )
+        return (
+            tuple(pl[None] for pl in planes_f),
+            vis_f[None],
+            levels,
+            alive,
+            truncated,
+        )
+
+    def build(n_arrs):
+        specs = {k: P("v") for k in n_arrs}
+        core = jax.jit(
+            jax.shard_map(
+                chip_fn,
+                mesh=mesh,
+                in_specs=(specs, P(), P()),
+                out_specs=(
+                    tuple(P("v") for _ in range(num_planes)),
+                    P("v"),
+                    P(),
+                    P(),
+                    P(),
+                ),
+                check_vma=False,
+            )
+        )
+        device_arrs = {
+            k: jax.device_put(v, NamedSharding(mesh, P("v")))
+            for k, v in n_arrs.items()
+        }
+        return core, device_arrs
+
+    return build
+
+
+class DistWideMsBfsEngine:
+    """Multi-chip 4096-lane packed MS-BFS: sharded ELL, replicated frontier.
+
+    Per-chip HBM is O(V * W/8 * num_planes) for the packed state plus the
+    chip's edge shard — frontier replication is the scalability ceiling (use
+    fewer lanes or more planes-frugal settings for very large V).
+    """
+
+    def __init__(
+        self,
+        graph: Graph | ShardedEllGraph,
+        mesh: Mesh | int | None = None,
+        *,
+        kcap: int = 64,
+        num_planes: int = 5,
+    ):
+        if not (1 <= num_planes <= 8):
+            raise ValueError("num_planes must be in [1, 8]")
+        self.w = W
+        self.lanes = LANES
+        self.num_planes = num_planes
+        self.max_levels_cap = min(1 << num_planes, 254)
+        self.mesh = mesh if isinstance(mesh, Mesh) else make_mesh(mesh)
+        p_count = self.mesh.devices.size
+        self.sell = (
+            build_ell_sharded(graph, p_count, kcap=kcap)
+            if isinstance(graph, Graph)
+            else graph
+        )
+        if self.sell.num_shards != p_count:
+            raise ValueError(
+                f"ELL built for {self.sell.num_shards} shards, mesh has {p_count}"
+            )
+        sell = self.sell
+        self.undirected = sell.undirected
+
+        n_arrs = {}
+        if sell.heavy_per_shard > 0:
+            n_arrs["virtual_t"] = np.ascontiguousarray(sell.virtual.transpose(0, 2, 1))
+            n_arrs["fold_pad_map"] = sell.fold_pad_map
+            n_arrs["heavy_pick"] = sell.heavy_pick
+        for i, (k, blocks) in enumerate(sell.light):
+            n_arrs[f"light{i}_t"] = np.ascontiguousarray(blocks.transpose(0, 2, 1))
+        build = _make_dist_core(sell, self.w, num_planes, self.mesh)
+        self._dist_core, self.arrs = build(n_arrs)
+
+        # Chip-major row of global rank r is (r % P) * v_loc + r // P.
+        ranks = sell.rank.astype(np.int64)
+        self._rank = ((ranks % p_count) * sell.v_loc + ranks // p_count).astype(
+            np.int64
+        )
+        in_deg_cm = np.zeros(sell.v_pad, dtype=np.float32)
+        in_deg_cm[self._rank] = sell.in_degree.astype(np.float32)
+        self._in_deg_ranked = jnp.asarray(in_deg_cm)
+        # Stats/extraction over the reassembled chip-major tables: every row
+        # participates (pad rows are never visited, so they contribute zero).
+        _, self._lane_stats, self._extract_word = make_state_kernels(
+            sell.v_pad, sell.v_pad, self.w, num_planes
+        )
+        # Seed table is one row taller (the ELL sentinel row at v_pad).
+        rows_seed, w = sell.v_pad + 1, self.w
+        self._seed_k = jax.jit(
+            lambda r, wd, b: jnp.zeros((rows_seed, w), jnp.uint32).at[r, wd].add(b)
+        )
+        self._warmed = False
+
+    @property
+    def num_vertices(self) -> int:
+        return self.sell.num_vertices
+
+    # Word-major lane map (same as the single-chip wide engine).
+    @staticmethod
+    def _word_col(i: int):
+        return i // 32, i % 32
+
+    @staticmethod
+    def _lane_order(mat: np.ndarray) -> np.ndarray:
+        return mat.reshape(-1)
+
+    def _seed_dev(self, sources: np.ndarray):
+        # The loop consumes the replicated [v_pad+1, w] table in RANK order
+        # (the `own` selector and ELL neighbor ids are rank-space). Seed via
+        # the device scatter — a host-built table would be ~1 GiB per run at
+        # bench scale.
+        sell = self.sell
+        ranks = sell.rank[np.asarray(sources, dtype=np.int64)].astype(np.int32)
+        lanes = np.arange(len(sources), dtype=np.int32)
+        words = lanes // 32
+        bits = np.uint32(1) << (lanes % 32).astype(np.uint32)
+        return self._seed_k(
+            jnp.asarray(ranks), jnp.asarray(words), jnp.asarray(bits)
+        )
+
+    def _src_bits_view(self, fw0):
+        """Rank-order seed table -> chip-major view matching planes/vis."""
+        sell = self.sell
+        p = sell.num_shards
+        return (
+            fw0[: sell.v_pad]
+            .reshape(sell.v_loc, p, self.w)
+            .transpose(1, 0, 2)
+            .reshape(sell.v_pad, self.w)
+        )
+
+    def _core(self, arrs, fw0, max_levels):
+        planes, vis, levels, alive, truncated = self._dist_core(arrs, fw0, max_levels)
+        # [P, v_loc, w] blocks -> chip-major [v_pad, w] tables.
+        planes = tuple(pl.reshape(self.sell.v_pad, self.w) for pl in planes)
+        vis = vis.reshape(self.sell.v_pad, self.w)
+        return planes, vis, levels, alive, truncated
+
+    def run(self, sources, *, max_levels=None, time_it=False, check_cap=True):
+        return run_packed_batch(
+            self, sources, max_levels=max_levels, time_it=time_it,
+            check_cap=check_cap,
+        )

@@ -1,0 +1,63 @@
+"""CLI end-to-end tests (the reference's main() flow, bfs.cu:783-823).
+
+Run through cli.main() in-process on CPU with generated graphs; every run
+includes the golden validation step, so a passing exit code means the full
+load -> CPU golden -> device BFS -> checkOutput pipeline agreed.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_bfs import cli
+
+
+def test_cli_single_source_validates(capsys, tmp_path):
+    dist_path = tmp_path / "d.npy"
+    rc = cli.main(
+        ["3", "random:n=300,m=1200,seed=5", "--stats",
+         "--save-dist", str(dist_path)]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "Number of vertices 300" in out
+    assert "Output OK" in out
+    assert '"level"' in out  # --stats JSON lines
+    d = np.load(dist_path)
+    assert d.shape == (300,) and d[3] == 0
+
+
+def test_cli_file_graph(capsys, tmp_path):
+    p = tmp_path / "g.txt"
+    p.write_text("4 3\n0 1\n1 2\n2 3\n")
+    rc = cli.main(["0", str(p), "--no-parents"])
+    assert rc == 0
+    assert "Reached 4 vertices in 3 levels" in capsys.readouterr().out
+
+
+def test_cli_multi_source_engines(capsys):
+    for engine in ("packed", "wide", "hybrid"):
+        rc = cli.main(
+            ["0", "random:n=200,m=900,seed=3",
+             "--multi-source", "5,9", "--engine", engine]
+        )
+        out = capsys.readouterr().out
+        assert rc == 0, engine
+        assert "Output OK" in out, engine
+        assert "source 9:" in out, engine
+
+
+def test_cli_distributed(capsys):
+    rc = cli.main(["1", "random:n=250,m=1000,seed=8", "--devices", "4"])
+    assert rc == 0
+    assert "Output OK" in capsys.readouterr().out
+
+
+def test_cli_rejects_bad_source():
+    with pytest.raises(SystemExit):
+        cli.main(["999", "random:n=100,m=300,seed=1"])
+
+
+def test_cli_rejects_multi_source_multichip():
+    with pytest.raises(SystemExit):
+        cli.main(["0", "random:n=100,m=300,seed=1", "--devices", "2",
+                  "--multi-source", "1"])

@@ -1,8 +1,11 @@
-"""Distributed bit-packed multi-source BFS on a virtual CPU mesh.
+"""Distributed bit-packed multi-source BFS at narrow lane counts.
 
-Exercises DistPackedMsBfsEngine (sharded ELL + all_gather frontier exchange)
-against the sequential golden oracle, per lane — multi-chip testing without
-TPU hardware, the capability the reference lacks (SURVEY.md §4).
+Exercises DistWideMsBfsEngine (sharded ELL + all_gather frontier exchange)
+with lanes=32 — the narrow configuration that superseded the old
+DistPackedMsBfsEngine — against the sequential golden oracle, per lane:
+multi-chip testing without TPU hardware, the capability the reference lacks
+(SURVEY.md §4). Full-width (4096-lane) coverage is in
+tests/test_dist_msbfs_wide.py.
 """
 
 import numpy as np
@@ -11,7 +14,7 @@ import pytest
 from tpu_bfs.algorithms.msbfs_packed import UNREACHED
 from tpu_bfs.graph.ell import build_ell_sharded
 from tpu_bfs.parallel.dist_bfs import make_mesh
-from tpu_bfs.parallel.dist_msbfs import DistPackedMsBfsEngine
+from tpu_bfs.parallel.dist_msbfs_wide import DistWideMsBfsEngine
 from tpu_bfs.reference import bfs_python
 
 
@@ -27,13 +30,13 @@ def _check_lanes(graph, engine, sources):
 
 @pytest.mark.parametrize("num_devices", [2, 4, 8])
 def test_dist_packed_matches_oracle(random_small, num_devices):
-    engine = DistPackedMsBfsEngine(random_small, make_mesh(num_devices), lanes=32)
+    engine = DistWideMsBfsEngine(random_small, make_mesh(num_devices), lanes=32)
     _check_lanes(random_small, engine, [0, 1, 17, 255, 499])
 
 
 def test_dist_packed_heavy_vertices(rmat_small):
     # Heavy-tailed degrees on 4 shards: virtual rows + fold pyramid per shard.
-    engine = DistPackedMsBfsEngine(rmat_small, make_mesh(4), lanes=32, kcap=8)
+    engine = DistWideMsBfsEngine(rmat_small, make_mesh(4), lanes=32, kcap=8)
     assert engine.sell.heavy_per_shard > 0
     sources = np.flatnonzero(engine.sell.in_degree > 0)[:32]
     _check_lanes(rmat_small, engine, sources)
@@ -44,20 +47,27 @@ def test_dist_packed_matches_single_chip(random_small):
 
     sources = [3, 99, 400]
     dist_res = _check_lanes(
-        random_small, DistPackedMsBfsEngine(random_small, make_mesh(4), lanes=32), sources
+        random_small,
+        DistWideMsBfsEngine(random_small, make_mesh(4), lanes=32),
+        sources,
     )
     single_res = PackedMsBfsEngine(random_small, lanes=32).run(np.asarray(sources))
-    np.testing.assert_array_equal(dist_res.distance_u8, single_res.distance_u8)
+    for i in range(len(sources)):
+        np.testing.assert_array_equal(
+            dist_res.distances_int32(i), single_res.distances_int32(i)
+        )
 
 
 def test_dist_packed_disconnected(random_disconnected):
-    engine = DistPackedMsBfsEngine(random_disconnected, make_mesh(4), lanes=32)
+    engine = DistWideMsBfsEngine(random_disconnected, make_mesh(4), lanes=32)
     res = _check_lanes(random_disconnected, engine, [0, 5, 9])
-    assert (res.distance_u8 == UNREACHED).any()
+    assert (res.distance_u8_lane(0) == UNREACHED).any()
 
 
 def test_dist_packed_deep_graph(line_graph):
-    engine = DistPackedMsBfsEngine(line_graph, make_mesh(4), lanes=32)
+    engine = DistWideMsBfsEngine(
+        line_graph, make_mesh(4), lanes=32, num_planes=6
+    )
     res = _check_lanes(line_graph, engine, [0, 63])
     assert res.num_levels == 63
 
@@ -65,4 +75,9 @@ def test_dist_packed_deep_graph(line_graph):
 def test_dist_packed_shard_mesh_mismatch(random_small):
     sell = build_ell_sharded(random_small, 2)
     with pytest.raises(ValueError):
-        DistPackedMsBfsEngine(sell, make_mesh(4))
+        DistWideMsBfsEngine(sell, make_mesh(4))
+
+
+def test_dist_packed_rejects_bad_lanes(random_small):
+    with pytest.raises(ValueError):
+        DistWideMsBfsEngine(random_small, make_mesh(2), lanes=33)

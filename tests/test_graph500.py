@@ -32,3 +32,5 @@ def test_run_graph500_single_and_batched():
     assert r1.harmonic_mean_teps > 0
     r2 = run_graph500(8, 4, num_searches=4, mode="batched", validate_searches=2)
     assert r2.validated and len(r2.teps) == 4
+    r3 = run_graph500(8, 4, num_searches=4, mode="hybrid", validate_searches=2)
+    assert r3.validated and len(r3.teps) == 4 and r3.harmonic_mean_teps > 0

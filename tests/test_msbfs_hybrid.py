@@ -95,8 +95,8 @@ def test_hybrid_disconnected(random_disconnected):
 
 
 def test_hybrid_lane_word_boundaries(random_small):
-    # Bit-major lanes: entries 0 and 128 share a bit position, 0 and 1 share
-    # a word; check lanes across both boundaries.
+    # Word-major lanes: entries 0..31 share word 0; check entries across
+    # several 32-lane word boundaries.
     rng = np.random.default_rng(1)
     sources = rng.integers(0, random_small.num_vertices, 200)
     engine = HybridMsBfsEngine(random_small, tile_thr=2)

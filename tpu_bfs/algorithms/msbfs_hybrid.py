@@ -21,8 +21,10 @@ rank0 before the claim. Everything else — packed claim ``& ~visited``,
 bit-sliced distance planes, device-side stats, lazy extraction — is the
 shared machinery in _packed_common.py.
 
-Lane convention is bit-major (lane ``l`` at word ``l % W``, bit ``l // W``),
-the layout tile_spmm requires; it only changes the seed/extract index maps.
+Batch entries map to (word, bit) coordinates word-major, exactly like the
+wide engine — tile_spmm's internal bit-major unpack/pack preserves every
+(word, bit) position end-to-end, so the kernel imposes no constraint on how
+entries are assigned to lanes.
 
 Reference mapping: this is the capability of the reference's whole kernel
 layer (queueBfs, bfs.cu:134-165; multiBfs, bfs.cu:101-130) re-planned around
@@ -317,20 +319,21 @@ class HybridMsBfsEngine:
     def num_vertices(self) -> int:
         return self.hg.num_vertices
 
-    # Bit-major lane map: lane l at word l % W, bit l // W (tile_spmm layout).
+    # Word-major lane map (same as the wide engine): batch entry i at word
+    # i // 32, bit i % 32 — so 32 consecutive entries share one extraction.
     @staticmethod
     def _word_col(i: int):
-        return i % W, i // W
+        return i // 32, i % 32
 
     @staticmethod
     def _lane_order(mat: np.ndarray) -> np.ndarray:
-        return np.ascontiguousarray(mat.T).reshape(-1)
+        return mat.reshape(-1)
 
     def _seed_dev(self, sources: np.ndarray):
         ranks = self.hg.rank[sources].astype(np.int32)
         lanes = np.arange(len(sources), dtype=np.int32)
-        words = (lanes % W).astype(np.int32)
-        bits = np.uint32(1) << (lanes // W).astype(np.uint32)
+        words = (lanes // 32).astype(np.int32)
+        bits = np.uint32(1) << (lanes % 32).astype(np.uint32)
         return self._seed(jnp.asarray(ranks), jnp.asarray(words), jnp.asarray(bits))
 
     def run(self, sources, *, max_levels=None, time_it=False, check_cap=True):

@@ -65,12 +65,36 @@ def _maybe_profile(profile_dir):
     return jax.profiler.trace(profile_dir)
 
 
+def _make_ms_engine(args, g, n_sources: int):
+    """Select the multi-source engine for --multi-source / --engine.
+
+    Default (no --engine): size to the workload — the 512-lane packed engine
+    for small batches (lane tables scale with lane count; 254-level depth
+    cap), the 4096-lane hybrid flagship once the batch is big enough to fill
+    its 128-word rows."""
+    engine = args.engine
+    if engine is None:
+        engine = "packed" if n_sources <= 512 else "hybrid"
+    if engine == "packed":
+        from tpu_bfs.algorithms.msbfs_packed import PackedMsBfsEngine
+
+        lanes = max(32, -(-n_sources // 32) * 32)
+        return PackedMsBfsEngine(g, lanes=lanes)
+    planes = args.planes if args.planes else 5
+    if engine == "wide":
+        from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
+
+        return WidePackedMsBfsEngine(g, num_planes=planes)
+    from tpu_bfs.algorithms.msbfs_hybrid import HybridMsBfsEngine
+
+    return HybridMsBfsEngine(g, num_planes=planes)
+
+
 def _run_multi_source(args, g, golden) -> int:
     """--multi-source path: <source> plus the listed keys, one packed batch."""
     import numpy as np
 
     from tpu_bfs import validate
-    from tpu_bfs.algorithms.msbfs_packed import PackedMsBfsEngine
     from tpu_bfs.utils.stats import level_stats
 
     try:
@@ -85,16 +109,23 @@ def _run_multi_source(args, g, golden) -> int:
             f"--multi-source vertices {bad.tolist()} out of range "
             f"[0, {g.num_vertices})"
         )
-    lanes = max(32, -(-len(sources) // 32) * 32)
-    engine = PackedMsBfsEngine(g, lanes=lanes)
+    engine = _make_ms_engine(args, g, len(sources))
     res = None
-    for _ in range(max(1, args.repeat)):
-        with _maybe_profile(args.profile_dir):
-            res = engine.run(
-                sources,
-                max_levels=args.max_levels if args.max_levels is not None else 254,
-                time_it=True,
-            )
+    try:
+        for _ in range(max(1, args.repeat)):
+            with _maybe_profile(args.profile_dir):
+                res = engine.run(
+                    sources,
+                    max_levels=args.max_levels if args.max_levels is not None else 254,
+                    time_it=True,
+                )
+    except RuntimeError as exc:
+        if "truncated" not in str(exc):
+            raise
+        raise SystemExit(
+            f"{exc}\nhint: rerun with --planes 8 (depth 254) or "
+            "--engine packed"
+        )
     print(f"Elapsed time in milliseconds (device): {res.elapsed_s * 1e3:.3f} "
           f"({len(sources)} sources)")
     for i, s in enumerate(sources):
@@ -146,8 +177,17 @@ def main(argv=None) -> int:
     ap.add_argument("--save-dist", default=None, help="save distances to .npy")
     ap.add_argument("--save-parent", default=None, help="save parents to .npy")
     ap.add_argument("--multi-source", default=None, metavar="V1,V2,...",
-                    help="run these sources concurrently with <source> via the "
+                    help="run these sources concurrently with <source> via a "
                     "bit-packed multi-source engine (single device)")
+    ap.add_argument("--engine", default=None,
+                    choices=["hybrid", "wide", "packed"],
+                    help="--multi-source engine: 'hybrid' = 4096-lane MXU "
+                    "dense tiles + gathers (flagship), 'wide' = 4096-lane "
+                    "gather-only, 'packed' = 512-lane (254-level depth cap). "
+                    "Default: 'packed' for <=512 sources, else 'hybrid'")
+    ap.add_argument("--planes", type=int, default=None, metavar="P",
+                    help="bit-plane count for the wide/hybrid engines; caps "
+                    "traversal depth at 2**P levels (default 5)")
     ap.add_argument("--profile-dir", default=None,
                     help="write a jax.profiler trace of the timed run here")
     args = ap.parse_args(argv)

@@ -67,12 +67,23 @@ def run_graph500(
     the aggregate time split evenly (reported as such — not comparable with
     official single-stream numbers, but the right way to use a TPU when the
     workload has many sources).
+    mode='hybrid': the 4096-lane MXU+gather flagship engine, same equal-share
+    accounting as 'batched'.
     """
     g = rmat_graph(scale, edge_factor, seed=seed)
     keys = sample_search_keys(g, num_searches)
 
     teps = []
-    if mode == "batched":
+    if mode == "hybrid":
+        from tpu_bfs.algorithms.msbfs_hybrid import HybridMsBfsEngine
+
+        eng = HybridMsBfsEngine(g) if engine_cls is None else engine_cls(g)
+        res = eng.run(keys, time_it=True)
+        per_search = res.elapsed_s / len(keys)
+        dists = np.stack([res.distances_int32(i) for i in range(len(keys))])
+        for i in range(len(keys)):
+            teps.append(traversed_edges(g, dists[i]) / per_search)
+    elif mode == "batched":
         eng = MsBfsEngine(g) if engine_cls is None else engine_cls(g)
         res = eng.run(keys, time_it=True)
         per_search = res.elapsed_s / len(keys)  # equal time share per search
@@ -120,7 +131,9 @@ def main(argv=None) -> int:
     ap.add_argument("--scale", type=int, default=16)
     ap.add_argument("--ef", type=int, default=16)
     ap.add_argument("--searches", type=int, default=64)
-    ap.add_argument("--mode", choices=["single", "batched"], default="single")
+    ap.add_argument(
+        "--mode", choices=["single", "batched", "hybrid"], default="single"
+    )
     ap.add_argument("--seed", type=int, default=1)
     ap.add_argument("--validate", type=int, default=4, metavar="N",
                     help="validate the first N searches (0 to skip)")

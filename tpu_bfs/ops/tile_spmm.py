@@ -18,13 +18,12 @@ VPU path. This kernel fuses, per 128-row output tile:
 so no unpacked [*, lanes] intermediate ever touches HBM (the pure-XLA
 formulation of the same computation materializes them and is ~30x slower).
 
-Lane convention — CALLERS MUST MATCH IT: lane ``l`` of a packed [rows, W]
-u32 table lives at word ``l % W``, bit ``l // W`` ("bit-major"). This is NOT
-the word-major convention of msbfs_wide/msbfs_packed (word ``l // 32``, bit
-``l % 32``); an engine integrating this kernel must seed and extract lanes
-bit-major throughout. The payoff: unpacking a [128, W] slab to int8
-[128, 32*W] is 32 contiguous (frontier >> bit) & 1 slices, and packing is
-the mirror image — no strided or sub-128-lane ops anywhere.
+Internal lane layout: the kernel unpacks a [128, W] slab to int8 [128, 32*W]
+with internal column ``bit * W + word`` — 32 contiguous (frontier >> bit) & 1
+slices — and packs the mirror image, so no strided or sub-128-lane ops occur
+anywhere. Because pack inverts unpack exactly, every (word, bit) position of
+the input table maps to the same (word, bit) of the output: callers may
+assign batch lanes to (word, bit) coordinates however they like.
 
 This is the TPU answer to the reference's edge-walking CUDA kernels
 (queueBfs, bfs.cu:134-165 / multiBfs, bfs.cu:101-130): where CUDA hides

@@ -146,13 +146,16 @@ class DistWideMsBfsEngine:
         graph: Graph | ShardedEllGraph,
         mesh: Mesh | int | None = None,
         *,
+        lanes: int = LANES,
         kcap: int = 64,
         num_planes: int = 5,
     ):
         if not (1 <= num_planes <= 8):
             raise ValueError("num_planes must be in [1, 8]")
-        self.w = W
-        self.lanes = LANES
+        if lanes % 32 or not (32 <= lanes <= LANES):
+            raise ValueError(f"lanes must be a multiple of 32 in [32, {LANES}]")
+        self.w = lanes // 32
+        self.lanes = lanes
         self.num_planes = num_planes
         self.max_levels_cap = min(1 << num_planes, 254)
         self.mesh = mesh if isinstance(mesh, Mesh) else make_mesh(mesh)
@@ -169,6 +172,7 @@ class DistWideMsBfsEngine:
         sell = self.sell
         self.undirected = sell.undirected
 
+        w = self.w
         n_arrs = {}
         if sell.heavy_per_shard > 0:
             n_arrs["virtual_t"] = np.ascontiguousarray(sell.virtual.transpose(0, 2, 1))
@@ -176,7 +180,7 @@ class DistWideMsBfsEngine:
             n_arrs["heavy_pick"] = sell.heavy_pick
         for i, (k, blocks) in enumerate(sell.light):
             n_arrs[f"light{i}_t"] = np.ascontiguousarray(blocks.transpose(0, 2, 1))
-        build = _make_dist_core(sell, self.w, num_planes, self.mesh)
+        build = _make_dist_core(sell, w, num_planes, self.mesh)
         self._dist_core, self.arrs = build(n_arrs)
 
         # Chip-major row of global rank r is (r % P) * v_loc + r // P.

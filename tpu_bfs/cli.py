@@ -80,7 +80,7 @@ def _make_ms_engine(args, g, n_sources: int):
 
         lanes = max(32, -(-n_sources // 32) * 32)
         return PackedMsBfsEngine(g, lanes=lanes)
-    planes = args.planes if args.planes else 5
+    planes = args.planes if args.planes is not None else 5
     if engine == "wide":
         from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
 

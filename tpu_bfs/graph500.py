@@ -57,6 +57,7 @@ def run_graph500(
     num_searches: int = 64,
     mode: str = "single",
     validate_searches: int = 4,
+    num_planes: int = 5,
     engine_cls=None,
     verbose: bool = False,
 ) -> Graph500Result:
@@ -68,7 +69,7 @@ def run_graph500(
     official single-stream numbers, but the right way to use a TPU when the
     workload has many sources).
     mode='hybrid': the 4096-lane MXU+gather flagship engine, same equal-share
-    accounting as 'batched'.
+    accounting as 'batched'; ``num_planes`` caps depth at 2**planes levels.
     """
     g = rmat_graph(scale, edge_factor, seed=seed)
     keys = sample_search_keys(g, num_searches)
@@ -77,12 +78,22 @@ def run_graph500(
     if mode == "hybrid":
         from tpu_bfs.algorithms.msbfs_hybrid import HybridMsBfsEngine
 
-        eng = HybridMsBfsEngine(g) if engine_cls is None else engine_cls(g)
+        eng = (
+            HybridMsBfsEngine(g, num_planes=num_planes)
+            if engine_cls is None
+            else engine_cls(g)
+        )
         res = eng.run(keys, time_it=True)
         per_search = res.elapsed_s / len(keys)
-        dists = np.stack([res.distances_int32(i) for i in range(len(keys))])
+        # One lane at a time — res extracts lazily; only the rows needed for
+        # validation are retained (the full [S, V] matrix would be ~17 GB at
+        # Graph500 scale 26).
+        dists = []
         for i in range(len(keys)):
-            teps.append(traversed_edges(g, dists[i]) / per_search)
+            d = res.distances_int32(i)
+            teps.append(traversed_edges(g, d) / per_search)
+            if i < validate_searches:
+                dists.append(d)
     elif mode == "batched":
         eng = MsBfsEngine(g) if engine_cls is None else engine_cls(g)
         res = eng.run(keys, time_it=True)
@@ -137,6 +148,8 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=1)
     ap.add_argument("--validate", type=int, default=4, metavar="N",
                     help="validate the first N searches (0 to skip)")
+    ap.add_argument("--planes", type=int, default=5, metavar="P",
+                    help="hybrid mode: bit-plane count (depth cap 2**P)")
     args = ap.parse_args(argv)
     res = run_graph500(
         args.scale,
@@ -145,6 +158,7 @@ def main(argv=None) -> int:
         num_searches=args.searches,
         mode=args.mode,
         validate_searches=args.validate,
+        num_planes=args.planes,
         verbose=True,
     )
     print(

@@ -115,11 +115,34 @@ def _bench_batch_4096(g, scale, ef, engine, in_degree, build_log: str, label: st
 
 
 def bench_hybrid(g, scale: int, ef: int) -> dict:
-    """Flagship: 4096-lane hybrid MXU+gather MS-BFS (msbfs_hybrid.py)."""
-    from tpu_bfs.algorithms.msbfs_hybrid import HybridMsBfsEngine
+    """Flagship: 4096-lane hybrid MXU+gather MS-BFS (msbfs_hybrid.py).
+
+    Falls back to the gather-only wide engine when the graph's packed state
+    cannot fit 4096 lanes next to the dense tiles (the Pallas kernel only
+    exists at w=128)."""
+    from tpu_bfs.algorithms._packed_common import auto_lanes
+    from tpu_bfs.algorithms.msbfs_hybrid import (
+        LANES,
+        HybridMsBfsEngine,
+        LanesDontFitError,
+    )
+
+    # Cheap pre-check with conservative fixed-resident estimates, so a graph
+    # that clearly cannot fit 4096 lanes skips the minutes-long hybrid build.
+    rows = (-(-(g.num_vertices + 1) // 128)) * 128
+    est = auto_lanes(
+        rows, 5, fixed_bytes=int(0.2e9) + int(g.num_edges * 4.4)
+    )
+    if est < LANES:
+        log(f"hybrid needs {LANES} lanes, only {est} fit; using wide engine")
+        return bench_wide(g, scale, ef)
 
     t0 = time.perf_counter()
-    engine = HybridMsBfsEngine(g)
+    try:
+        engine = HybridMsBfsEngine(g)
+    except LanesDontFitError as exc:
+        log(f"hybrid unavailable ({exc}); falling back to wide engine")
+        return bench_wide(g, scale, ef)
     hg = engine.hg
     return _bench_batch_4096(
         g, scale, ef, engine, hg.in_degree,

@@ -52,6 +52,21 @@ def test_cli_distributed(capsys):
     assert "Output OK" in capsys.readouterr().out
 
 
+def test_cli_truncation_exits_with_hint(tmp_path):
+    # 64-vertex path exceeds the wide engine's default 32-level cap; the CLI
+    # must exit with the --planes/--engine hint, not a raw traceback.
+    p = tmp_path / "path.txt"
+    lines = ["64 63"] + [f"{i} {i+1}" for i in range(63)]
+    p.write_text("\n".join(lines) + "\n")
+    with pytest.raises(SystemExit, match="--planes 8"):
+        cli.main(["0", str(p), "--multi-source", "1", "--engine", "wide"])
+    # And the suggested remedies work.
+    assert cli.main(["0", str(p), "--multi-source", "1", "--engine", "wide",
+                     "--planes", "8"]) == 0
+    assert cli.main(["0", str(p), "--multi-source", "1",
+                     "--engine", "packed"]) == 0
+
+
 def test_cli_rejects_bad_source():
     with pytest.raises(SystemExit):
         cli.main(["999", "random:n=100,m=300,seed=1"])

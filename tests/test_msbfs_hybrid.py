@@ -50,7 +50,7 @@ def test_split_conserves_edges(random_small):
     dense_tid = row_tile_of * hg.vt + hg.col_tile.astype(np.int64)
     in_dense = np.isin(tid, dense_tid)
     distinct = len({(int(a), int(b)) for a, b in zip(r[in_dense], c[in_dense])})
-    assert int(hg.a_tiles.sum()) == distinct
+    assert int(np.bitwise_count(hg.a_tiles).sum()) == distinct
 
 
 def test_hybrid_pure_residual(random_small):
@@ -81,11 +81,12 @@ def test_hybrid_mixed_split(rmat_small):
 def test_hybrid_budget_trims_tiles(rmat_small):
     full = build_hybrid(rmat_small, tile_thr=1)
     assert full.num_tiles > 2
-    trimmed = build_hybrid(rmat_small, tile_thr=1, a_budget_bytes=2 * 128 * 128)
+    tile_bytes = 128 * (128 // 32) * 4
+    trimmed = build_hybrid(rmat_small, tile_thr=1, a_budget_bytes=2 * tile_bytes)
     assert trimmed.num_tiles == 2
     # Trimming keeps the highest-count tiles.
-    per_tile_full = full.a_tiles.sum(axis=(1, 2))
-    assert trimmed.a_tiles.sum() == np.sort(per_tile_full)[-2:].sum()
+    per_tile_full = np.bitwise_count(full.a_tiles).sum(axis=(1, 2))
+    assert np.bitwise_count(trimmed.a_tiles).sum() == np.sort(per_tile_full)[-2:].sum()
 
 
 def test_hybrid_disconnected(random_disconnected):

@@ -100,6 +100,21 @@ def test_wide_lane_stats(random_small):
     assert res.elapsed_s is not None and res.teps > 0
 
 
+def test_wide_auto_lane_sizing(random_small):
+    # Tiny graphs fit full width; a tight HBM budget halves the lane count
+    # instead of OOMing at runtime.
+    from tpu_bfs.algorithms._packed_common import auto_lanes
+
+    assert WidePackedMsBfsEngine(random_small).lanes == LANES
+    small = WidePackedMsBfsEngine(random_small, hbm_budget_bytes=int(1.5e6))
+    assert 32 <= small.lanes < LANES
+    res = small.run(np.array([0, 7]))
+    golden, _ = bfs_python(random_small, 0)
+    np.testing.assert_array_equal(res.distances_int32(0), golden)
+    # Never sizes below the 32-lane floor even on absurd budgets.
+    assert auto_lanes(10**9, 8, hbm_budget_bytes=1) == 32
+
+
 def test_wide_rejects_bad_input(random_small):
     engine = WidePackedMsBfsEngine(random_small)
     with pytest.raises(ValueError):

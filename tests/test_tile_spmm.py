@@ -3,7 +3,13 @@
 import numpy as np
 import pytest
 
-from tpu_bfs.ops.tile_spmm import TILE, tile_spmm, tile_spmm_reference
+from tpu_bfs.ops.tile_spmm import (
+    TILE,
+    pack_a_tiles,
+    tile_spmm,
+    tile_spmm_reference,
+    unpack_a_tile,
+)
 
 
 def _random_case(rng, nr, vt, w, max_b):
@@ -12,7 +18,7 @@ def _random_case(rng, nr, vt, w, max_b):
     row_start[1:] = np.cumsum(per_row)
     nt = int(row_start[-1])
     col_tile = rng.integers(0, vt, size=max(nt, 1)).astype(np.int32)
-    a = (rng.random((max(nt, 1), TILE, TILE)) < 0.05).astype(np.int8)
+    a = pack_a_tiles((rng.random((max(nt, 1), TILE, TILE)) < 0.05).astype(np.int8))
     fw = rng.integers(0, 2**32, size=(vt * TILE, w), dtype=np.uint64).astype(
         np.uint32
     )
@@ -41,7 +47,7 @@ def test_tile_spmm_empty_row_tiles():
     w = 8
     row_start = np.array([0, 0, 2, 2], np.int32)  # row-tiles 0 and 2 empty
     col_tile = np.array([0, 1], np.int32)
-    a = (rng.random((2, TILE, TILE)) < 0.1).astype(np.int8)
+    a = pack_a_tiles((rng.random((2, TILE, TILE)) < 0.1).astype(np.int8))
     fw = rng.integers(0, 2**32, size=(2 * TILE, w), dtype=np.uint64).astype(
         np.uint32
     )
@@ -51,3 +57,12 @@ def test_tile_spmm_empty_row_tiles():
     want = tile_spmm_reference(row_start, col_tile, a, fw, num_row_tiles=3, w=w)
     np.testing.assert_array_equal(got, want)
     assert not got[:TILE].any() and not got[2 * TILE :].any()
+
+
+def test_pack_unpack_roundtrip():
+    rng = np.random.default_rng(2)
+    dense = (rng.random((3, TILE, TILE)) < 0.2).astype(np.int8)
+    packed = pack_a_tiles(dense)
+    assert packed.shape == (3, TILE // 32, TILE) and packed.dtype == np.uint32
+    for t in range(3):
+        np.testing.assert_array_equal(unpack_a_tile(packed[t]), dense[t])

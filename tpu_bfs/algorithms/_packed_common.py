@@ -28,6 +28,32 @@ from tpu_bfs.graph.csr import INF_DIST
 from tpu_bfs.algorithms.msbfs_packed import UNREACHED
 
 
+def auto_lanes(
+    rows: int,
+    num_planes: int,
+    *,
+    fixed_bytes: int = 0,
+    hbm_budget_bytes: int = int(14.0e9),
+    max_lanes: int = 4096,
+) -> int:
+    """Largest lane count whose packed state fits the HBM budget.
+
+    The level loop keeps ~(num_planes + 6) live [rows, w] uint32 tables
+    (frontier, next, hit(s), visited, planes, expansion transients —
+    calibrated against the scale-21 runs on a 16 GB v5e); ``fixed_bytes``
+    covers lane-independent residents (ELL indices, dense tiles). Returns the
+    largest power-of-two word count times 32 that fits, floored at 32 lanes.
+    """
+    w_max = max(max_lanes // 32, 1)
+    w = 1 << (w_max.bit_length() - 1)  # largest power of two <= w_max
+    while w > 1:
+        need = (num_planes + 6) * rows * w * 4 + fixed_bytes
+        if need <= hbm_budget_bytes:
+            break
+        w //= 2
+    return 32 * w
+
+
 class ExpandSpec(NamedTuple):
     """Shape metadata of a bucketed-ELL expansion (see graph/ell.py)."""
 

@@ -7,7 +7,8 @@ holding >= 64 edges cover ~57% of all edges in ~2% of the occupied tiles.
 This engine splits the graph once at build time:
 
 - **dense part**: tiles with >= ``tile_thr`` edges (trimmed to an HBM
-  budget), expanded per level by the Pallas MXU kernel
+  budget; tiles are bit-packed at 2 KB each), expanded per level by the
+  Pallas MXU kernel
   (tpu_bfs/ops/tile_spmm.py) at ~0.5 us/tile — replacing ~128 x 13 ns of
   gather tax per tile;
 - **residual part**: everything else, expanded by the same bucketed-ELL
@@ -45,15 +46,21 @@ from tpu_bfs.graph.ell import EllBucket, bucketize_rows
 from tpu_bfs.algorithms.msbfs_packed import ripple_increment
 from tpu_bfs.algorithms._packed_common import (
     ExpandSpec,
+    auto_lanes,
     expand_arrays,
     make_fori_expand,
     make_state_kernels,
     run_packed_batch,
 )
-from tpu_bfs.ops.tile_spmm import TILE, tile_spmm
+from tpu_bfs.ops.tile_spmm import AW, TILE, tile_spmm
 
 W = 128
 LANES = 32 * W
+
+
+class LanesDontFitError(ValueError):
+    """The graph's packed state cannot fit the 4096 lanes the dense kernel
+    requires; callers fall back to the gather-only wide engine."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -79,7 +86,7 @@ class HybridGraph:
     num_dense_edges: int  # directed slots routed to tiles (duplicates collapse)
     row_start: np.ndarray  # [vt+1] int32 CSR over row-tiles
     col_tile: np.ndarray  # [NT] int32
-    a_tiles: np.ndarray  # [NT, TILE, TILE] int8
+    a_tiles: np.ndarray  # [NT, AW, TILE] u32 bit-packed, rows-in-bits (tile_spmm layout)
     # residual part (build_ell-style buckets over residual degree)
     res_heavy: int
     res_num_virtual: int
@@ -118,10 +125,14 @@ def build_hybrid(
     *,
     kcap: int = 64,
     tile_thr: int = 64,
-    a_budget_bytes: int = int(1.6e9),
+    a_budget_bytes: int = int(0.2e9),
 ) -> HybridGraph:
     """Split ``g`` into dense 128x128 tiles (>= tile_thr edges, trimmed to the
-    int8 storage budget by descending edge count) and a residual ELL."""
+    bit-packed storage budget of 2 KB/tile by descending edge count) and a
+    residual ELL. Defaults (thr=64, ~98k-tile budget) are the measured v5e
+    optimum on RMAT scale-21: marginal tiles below ~64 edges cost more in
+    kernel time (~2.3 us measured marginal, incl. DMA + grid effects) than
+    their edges cost as gathers."""
     v = g.num_vertices
     src, dst = g.coo
     in_deg = np.bincount(dst, minlength=v).astype(np.int64)
@@ -132,7 +143,7 @@ def build_hybrid(
     vt = -(-(v + 1) // TILE)
     r = rank[dst]  # int32 rank ids
     c = rank[src]
-    max_tiles = max(a_budget_bytes // (TILE * TILE), 0)
+    max_tiles = max(a_budget_bytes // (TILE * AW * 4), 0)
 
     def select_tiles(counts):
         """Indices (into ``counts``) of tiles meeting the threshold, trimmed
@@ -172,13 +183,23 @@ def build_hybrid(
     row_tiles = (dense_uniq // vt).astype(np.int64)
     col_tile = (dense_uniq % vt).astype(np.int32)
     row_start = np.searchsorted(row_tiles, np.arange(vt + 1)).astype(np.int32)
-    a_tiles = np.zeros((max(nt, 1), TILE, TILE), dtype=np.int8)
+    # Bit-packed tiles, rows-in-bits (tile_spmm layout): A[r, c] at
+    # [t, r % AW, c] bit r // AW — 2 KB/tile instead of 16 KB dense int8.
+    a_tiles = np.zeros((max(nt, 1), AW, TILE), dtype=np.uint32)
     if nt:
-        # Map each dense edge to its tile slot via searchsorted on dense_uniq.
+        # Map each dense edge to its tile slot via searchsorted on dense_uniq,
+        # then OR bits per word via sort + reduceat (np.bitwise_or.at is ~40x
+        # slower at Graph500 scale).
         de = np.flatnonzero(dense_edge)
         slot = np.searchsorted(dense_uniq, tid[de])
-        flat = slot * (TILE * TILE) + (r[de] % TILE) * TILE + (c[de] % TILE)
-        a_tiles.reshape(-1)[flat] = 1
+        rin = r[de] % TILE
+        flat = slot * (AW * TILE) + (rin % AW) * TILE + c[de] % TILE
+        comb = (flat << np.int64(5)) | (rin // AW)
+        comb.sort()
+        vals = (np.uint32(1) << (comb & 31).astype(np.uint32))
+        f2 = comb >> np.int64(5)
+        starts = np.flatnonzero(np.r_[True, np.diff(f2) != 0])
+        a_tiles.reshape(-1)[f2[starts]] = np.bitwise_or.reduceat(vals, starts)
 
     # --- residual ELL, bucketed by residual in-degree, targets in rank0 ids ---
     re_mask = ~dense_edge
@@ -299,17 +320,17 @@ class HybridMsBfsEngine:
         self,
         graph: Graph | HybridGraph,
         *,
+        lanes: int | str = "auto",
         kcap: int = 64,
         tile_thr: int = 64,
-        a_budget_bytes: int = int(1.6e9),
+        a_budget_bytes: int = int(0.2e9),
         num_planes: int = 5,
         interpret: bool | None = None,
         undirected: bool | None = None,
+        hbm_budget_bytes: int = int(14.0e9),
     ):
         if not (1 <= num_planes <= 8):
             raise ValueError("num_planes must be in [1, 8]")
-        self.w = W
-        self.lanes = LANES
         self.num_planes = num_planes
         self.max_levels_cap = min(1 << num_planes, 254)
         if interpret is None:
@@ -322,6 +343,30 @@ class HybridMsBfsEngine:
             else graph
         )
         hg = self.hg
+        if lanes == "auto":
+            res_slots = (
+                hg.res_virtual.idx.size if hg.res_virtual is not None else 0
+            ) + sum(b.idx.size for b in hg.res_light)
+            lanes = auto_lanes(
+                hg.vt * TILE,
+                num_planes,
+                fixed_bytes=hg.a_tiles.nbytes + int(res_slots * 4.4),
+                hbm_budget_bytes=hbm_budget_bytes,
+                max_lanes=LANES,
+            )
+        if lanes % 32 or not (32 <= lanes <= LANES):
+            raise ValueError(f"lanes must be a multiple of 32 in [32, {LANES}]")
+        if lanes != LANES and not interpret and hg.num_tiles:
+            # Mosaic requires the frontier-slab DMA's minor dimension to be
+            # 128-aligned, so the dense kernel only exists at w=128.
+            raise LanesDontFitError(
+                f"hybrid dense kernel requires {LANES} lanes (w=128); the "
+                f"packed state for this graph only fits {lanes} lanes — use "
+                "WidePackedMsBfsEngine (gather-only, any width) or shard "
+                "over more chips (DistWideMsBfsEngine)"
+            )
+        self.w = lanes // 32
+        self.lanes = lanes
         self.undirected = hg.undirected if undirected is None else undirected
         arrs = expand_arrays(hg)
         arrs["inv_perm_ext"] = jnp.asarray(hg.inv_perm_ext)

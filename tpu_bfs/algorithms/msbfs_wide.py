@@ -42,6 +42,7 @@ from tpu_bfs.graph.ell import EllGraph, build_ell
 from tpu_bfs.algorithms.msbfs_packed import ripple_increment
 from tpu_bfs.algorithms._packed_common import (
     ExpandSpec,
+    auto_lanes,
     expand_arrays,
     make_fori_expand,
     make_state_kernels,
@@ -122,20 +123,33 @@ class WidePackedMsBfsEngine:
         self,
         graph: Graph | EllGraph,
         *,
+        lanes: int | str = "auto",
         kcap: int = 64,
         num_planes: int = 5,
         undirected: bool | None = None,
+        hbm_budget_bytes: int = int(14.0e9),
     ):
         if not (1 <= num_planes <= 8):
             raise ValueError("num_planes must be in [1, 8]")
-        self.w = W
-        self.lanes = LANES
         self.num_planes = num_planes
         # A vertex claimed in body i carries counter value i (incremented once
         # per body while unvisited) and distance i+1, so p planes label
         # distances up to 2**p; 254 keeps every distance below UNREACHED=255.
         self.max_levels_cap = min(1 << num_planes, 254)
         self.ell = build_ell(graph, kcap=kcap) if isinstance(graph, Graph) else graph
+        if lanes == "auto":
+            # Halve from 4096 until the packed state fits HBM next to the ELL.
+            lanes = auto_lanes(
+                self.ell.num_vertices + 1,
+                num_planes,
+                fixed_bytes=int(self.ell.total_slots * 4.4),
+                hbm_budget_bytes=hbm_budget_bytes,
+                max_lanes=LANES,
+            )
+        if lanes % 32 or not (32 <= lanes <= LANES):
+            raise ValueError(f"lanes must be a multiple of 32 in [32, {LANES}]")
+        self.w = lanes // 32
+        self.lanes = lanes
         self.undirected = self.ell.undirected if undirected is None else undirected
         ell = self.ell
         self.arrs = expand_arrays(ell)

@@ -186,6 +186,7 @@ def main(argv=None) -> int:
                     "gather-only, 'packed' = 512-lane (254-level depth cap). "
                     "Default: 'packed' for <=512 sources, else 'hybrid'")
     ap.add_argument("--planes", type=int, default=None, metavar="P",
+                    choices=range(1, 9),
                     help="bit-plane count for the wide/hybrid engines; caps "
                     "traversal depth at 2**P levels (default 5)")
     ap.add_argument("--profile-dir", default=None,

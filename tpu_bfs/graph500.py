@@ -149,6 +149,7 @@ def main(argv=None) -> int:
     ap.add_argument("--validate", type=int, default=4, metavar="N",
                     help="validate the first N searches (0 to skip)")
     ap.add_argument("--planes", type=int, default=5, metavar="P",
+                    choices=range(1, 9),
                     help="hybrid mode: bit-plane count (depth cap 2**P)")
     args = ap.parse_args(argv)
     res = run_graph500(

@@ -43,6 +43,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 TILE = 128  # tile edge (rows and cols) == MXU systolic dimension
+AW = TILE // 32  # u32 words per packed A-tile row
 
 
 def _unpack_bits(slab_u32, w: int):
@@ -68,12 +69,12 @@ def _tile_spmm_kernel(
     row_start_ref,  # [NR+1] i32: tiles of row-tile j are [row_start[j], row_start[j+1])
     col_tile_ref,  # [NT] i32: column-tile index per dense tile
     # array inputs (stay in HBM; DMA'd manually)
-    a_ref,  # [NT, TILE, TILE] i8
+    a_ref,  # [NT, AW, TILE] u32 — bit-packed: A[r, c] at [t, r % AW, c] bit r // AW
     fw_ref,  # [VT*TILE, w] u32
     # output
     out_ref,  # block [TILE, w] u32 for row-tile j
     # scratch
-    a_buf,  # [2, TILE, TILE] i8
+    a_buf,  # [2, AW, TILE] u32
     fw_buf,  # [2, TILE, w] u32
     acc_ref,  # [TILE, 32*w] i32
     sems,  # DMA sems [2, 2]
@@ -117,8 +118,19 @@ def _tile_spmm_kernel(
             a_dma(slot, start + i).wait()
             fw_dma(slot, start + i).wait()
             f_i8 = _unpack_bits(fw_buf[slot], w)
+            # A rows are bit-packed along the SUBLANE axis ([AW, TILE] with
+            # A[r, c] at word r % AW, bit r // AW): unpacking along axis 0
+            # rebuilds A in standard [row, col] orientation, so the matmul
+            # contracts dim 1 — the MXU-native form (contracting dim 0 of a
+            # transposed operand costs an internal relayout, measured ~2x
+            # slower per tile).
+            a_parts = [
+                ((a_buf[slot] >> jnp.uint32(bit)) & jnp.uint32(1)).astype(jnp.int8)
+                for bit in range(32)
+            ]
+            a_i8 = jnp.concatenate(a_parts, axis=0)  # [TILE(r), TILE(c)]
             acc_ref[:] += jax.lax.dot_general(
-                a_buf[slot],
+                a_i8,
                 f_i8,
                 (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.int32,
@@ -133,7 +145,7 @@ def _tile_spmm_kernel(
 def tile_spmm(
     row_start,  # [NR+1] i32 (host or device)
     col_tile,  # [NT] i32
-    a_tiles,  # [NT, TILE, TILE] i8
+    a_tiles,  # [NT, AW, TILE] u32 bit-packed (see pack_a_tiles)
     fw,  # [VT*TILE, w] u32 — bit-major packed frontier
     *,
     num_row_tiles: int,
@@ -152,7 +164,7 @@ def tile_spmm(
             (TILE, w), lambda j, *_: (j, 0), memory_space=pltpu.VMEM
         ),
         scratch_shapes=[
-            pltpu.VMEM((2, TILE, TILE), jnp.int8),
+            pltpu.VMEM((2, AW, TILE), jnp.uint32),
             pltpu.VMEM((2, TILE, w), jnp.uint32),
             pltpu.VMEM((TILE, 32 * w), jnp.int32),
             pltpu.SemaphoreType.DMA((2, 2)),
@@ -181,9 +193,34 @@ def tile_spmm_reference(row_start, col_tile, a_tiles, fw, *, num_row_tiles, w=12
                 [((slab >> np.uint32(bit)) & 1).astype(np.int64) for bit in range(32)],
                 axis=1,
             )
-            acc += a_tiles[b].astype(np.int64) @ f
+            a = unpack_a_tile(a_tiles[b])
+            acc += a.astype(np.int64) @ f
         words = np.zeros((TILE, w), np.uint32)
         for bit in range(32):
             words |= ((acc[:, bit * w : (bit + 1) * w] > 0).astype(np.uint32)) << np.uint32(bit)
         out[j * TILE : (j + 1) * TILE] = words
     return out
+
+
+def pack_a_tiles(a_dense: np.ndarray) -> np.ndarray:
+    """[NT, TILE, TILE] 0/1 -> bit-packed [NT, AW, TILE] u32, rows-in-bits.
+
+    A[t, r, c] lives at ``out[t, r % AW, c]`` bit ``r // AW``: the minor
+    dimension stays the 128 columns (Mosaic requires DMA slices aligned to
+    the 128-lane tiling) and the kernel's axis-0 unpack rebuilds A in
+    standard row/col orientation."""
+    nt = a_dense.shape[0]
+    out = np.zeros((nt, AW, TILE), np.uint32)
+    for bit in range(32):
+        # rows bit*AW .. bit*AW+AW-1 -> words 0..AW-1 at this bit
+        rows = a_dense[:, bit * AW : (bit + 1) * AW, :].astype(np.uint32)
+        out |= rows << np.uint32(bit)
+    return out
+
+
+def unpack_a_tile(a_bits: np.ndarray) -> np.ndarray:
+    """[AW, TILE] u32 -> [TILE, TILE] 0/1 int8 (inverse of pack_a_tiles)."""
+    parts = [
+        ((a_bits >> np.uint32(bit)) & 1).astype(np.int8) for bit in range(32)
+    ]
+    return np.concatenate(parts, axis=0)

@@ -32,8 +32,14 @@ def load_graph(scale: int, ef: int):
     from tpu_bfs.graph.csr import Graph
     from tpu_bfs.graph.generate import rmat_graph
 
+    from tpu_bfs.utils.native import available as native_available
+
+    impl = "native" if native_available() else "numpy"
     cache_dir = os.environ.get("TPU_BFS_BENCH_CACHE", ".bench_cache")
-    path = os.path.join(cache_dir, f"rmat_s{scale}_ef{ef}_seed1.npz")
+    # The two generator impls are different streams; tag the cache so a
+    # numpy-generated graph is never reused as a "native" one or vice versa.
+    tag = "" if impl == "numpy" else f"_{impl}"
+    path = os.path.join(cache_dir, f"rmat_s{scale}_ef{ef}_seed1{tag}.npz")
     t0 = time.perf_counter()
     if os.path.exists(path):
         z = np.load(path)
@@ -43,12 +49,12 @@ def load_graph(scale: int, ef: int):
             num_input_edges=int(z["num_input_edges"]),
             undirected=True,
         )
-        log(f"rmat scale={scale} ef={ef}: cached load {time.perf_counter()-t0:.1f}s")
+        log(f"rmat scale={scale} ef={ef} [{impl}]: cached load {time.perf_counter()-t0:.1f}s")
         return g
-    g = rmat_graph(scale, ef, seed=1)
+    g = rmat_graph(scale, ef, seed=1, impl=impl)
     log(
-        f"rmat scale={scale} ef={ef}: V={g.num_vertices} slots={g.num_edges} "
-        f"gen={time.perf_counter()-t0:.1f}s"
+        f"rmat scale={scale} ef={ef} [{impl}]: V={g.num_vertices} "
+        f"slots={g.num_edges} gen={time.perf_counter()-t0:.1f}s"
     )
     try:
         os.makedirs(cache_dir, exist_ok=True)

@@ -1,0 +1,73 @@
+"""Degenerate-graph edge cases across all engines.
+
+The reference crashes or reads out of bounds on several of these
+(DeviceNum=1 reads queueSize[1], bfs.cu:569; V % DeviceNum != 0 maps tail
+vertices to a nonexistent device, bfs.cu:29-32 — SURVEY.md §7 'bugs not to
+reproduce'); here they are pinned as supported inputs.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_bfs.algorithms.bfs import BfsEngine
+from tpu_bfs.algorithms.msbfs_hybrid import HybridMsBfsEngine
+from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
+from tpu_bfs.graph.csr import INF_DIST, build_csr
+from tpu_bfs.graph import io as gio
+
+
+@pytest.fixture(scope="module")
+def edgeless():
+    # 10 vertices, no edges at all.
+    return build_csr(np.empty(0, np.int64), np.empty(0, np.int64), 10)
+
+
+@pytest.fixture(scope="module")
+def self_loops():
+    # Self-loops plus one real edge; self-loops must not extend distances.
+    u = np.array([0, 1, 2, 0])
+    v = np.array([0, 1, 2, 1])
+    return gio.from_edges(u, v, num_vertices=3)
+
+
+def test_edgeless_single(edgeless):
+    res = BfsEngine(edgeless).run(4)
+    assert res.reached == 1 and res.num_levels == 0
+    assert res.distance[4] == 0 and (np.delete(res.distance, 4) == INF_DIST).all()
+    assert res.parent[4] == 4 and (np.delete(res.parent, 4) == -1).all()
+    assert res.edges_traversed == 0
+
+
+@pytest.mark.parametrize("cls", [WidePackedMsBfsEngine, HybridMsBfsEngine])
+def test_edgeless_packed(edgeless, cls):
+    eng = cls(edgeless)
+    res = eng.run(np.array([0, 9, 4]))
+    for i, s in enumerate((0, 9, 4)):
+        d = res.distances_int32(i)
+        assert d[s] == 0 and (np.delete(d, s) == INF_DIST).all()
+    np.testing.assert_array_equal(res.reached, [1, 1, 1])
+    np.testing.assert_array_equal(res.edges_traversed, [0, 0, 0])
+    assert res.num_levels == 0
+
+
+def test_single_vertex_graph():
+    g = build_csr(np.empty(0, np.int64), np.empty(0, np.int64), 1)
+    res = BfsEngine(g).run(0)
+    assert res.reached == 1 and res.distance[0] == 0
+    wres = WidePackedMsBfsEngine(g).run(np.array([0]))
+    assert wres.distances_int32(0)[0] == 0 and wres.reached[0] == 1
+
+
+@pytest.mark.parametrize("cls", [BfsEngine])
+def test_self_loops_dont_extend_distances(self_loops, cls):
+    res = cls(self_loops).run(0)
+    np.testing.assert_array_equal(res.distance, [0, 1, INF_DIST])
+
+
+@pytest.mark.parametrize("cls", [WidePackedMsBfsEngine, HybridMsBfsEngine])
+def test_self_loops_packed(self_loops, cls):
+    res = cls(self_loops, **({"tile_thr": 1} if cls is HybridMsBfsEngine else {})).run(
+        np.array([0, 2])
+    )
+    np.testing.assert_array_equal(res.distances_int32(0), [0, 1, INF_DIST])
+    np.testing.assert_array_equal(res.distances_int32(1), [INF_DIST, INF_DIST, 0])

@@ -122,3 +122,25 @@ def test_device_graph_padding(toy_graph):
 def test_build_csr_rejects_bad_ids():
     with pytest.raises(ValueError):
         build_csr(np.array([0, 5]), np.array([1, 1]), num_vertices=3)
+
+
+def test_native_rmat_generator():
+    # Threaded native generator: deterministic in the seed (independent of
+    # thread count) and same quadrant distribution as the NumPy stream.
+    from tpu_bfs.utils import native
+    from tpu_bfs.graph.generate import rmat_edges
+
+    if not native.available():
+        pytest.skip("native library not built")
+    u1, v1 = rmat_edges(10, 8, seed=3, impl="native")
+    u2, v2 = rmat_edges(10, 8, seed=3, impl="native")
+    np.testing.assert_array_equal(u1, u2)
+    np.testing.assert_array_equal(v1, v2)
+    assert len(u1) == 8 << 10
+    assert u1.max() < (1 << 10) and u1.min() >= 0
+    # Heavy-tailed like the numpy impl: hub degree far above the mean.
+    un, vn = rmat_edges(10, 8, seed=3, impl="numpy")
+    deg_nat = np.bincount(v1, minlength=1 << 10)
+    deg_np = np.bincount(vn, minlength=1 << 10)
+    assert deg_nat.max() > 10 * deg_nat.mean()
+    assert 0.5 < deg_nat.max() / deg_np.max() < 2.0

@@ -48,6 +48,17 @@ def _find_lib():
                     ctypes.c_longlong,
                     ctypes.POINTER(ctypes.c_longlong),
                 ]
+                lib.tpubfs_rmat_edges.restype = ctypes.c_longlong
+                lib.tpubfs_rmat_edges.argtypes = [
+                    ctypes.c_longlong,  # scale
+                    ctypes.c_longlong,  # m
+                    ctypes.c_longlong,  # seed
+                    ctypes.c_double,  # a
+                    ctypes.c_double,  # b
+                    ctypes.c_double,  # c
+                    ctypes.POINTER(ctypes.c_longlong),  # out u
+                    ctypes.POINTER(ctypes.c_longlong),  # out v
+                ]
                 _LIB = lib
                 break
             except (OSError, AttributeError):
@@ -88,6 +99,28 @@ def load_edge_list_native(path: str, *, directed: bool = False, drop_self_loops:
     return from_edges(
         u, v, num_vertices=int(n.value), directed=directed, num_input_edges=int(m.value)
     )
+
+
+def rmat_edges_native(scale: int, m: int, seed: int, a: float, b: float, c: float):
+    """Threaded native RMAT endpoints (native/rmat.cpp), or None if the
+    library is unbuilt. Deterministic in (scale, m, seed, a, b, c) —
+    independent of thread count — but a DIFFERENT stream than the NumPy
+    generator's (same distribution, different graphs for the same seed)."""
+    lib = _find_lib()
+    if lib is None:
+        return None
+    u = np.empty(m, dtype=np.int64)
+    v = np.empty(m, dtype=np.int64)
+    ll = ctypes.POINTER(ctypes.c_longlong)
+    rc = lib.tpubfs_rmat_edges(
+        int(scale), int(m), int(seed), float(a), float(b), float(c),
+        u.ctypes.data_as(ll), v.ctypes.data_as(ll),
+    )
+    if rc != 0:
+        raise ValueError(
+            f"native RMAT generator rejected scale={scale}, m={m} (rc={rc})"
+        )
+    return u, v
 
 
 def lexsort_pairs(major: np.ndarray, minor: np.ndarray, n_major: int, n_minor: int):

@@ -70,7 +70,8 @@ int64_t tpubfs_rmat_edges(int64_t scale, int64_t m, int64_t seed, double a,
 
   const int64_t nblocks = (m + kBlock - 1) / kBlock;
   unsigned hw = std::thread::hardware_concurrency();
-  const int nthreads = hw ? static_cast<int>(hw) : 4;
+  int nthreads = hw ? static_cast<int>(hw) : 4;
+  if (nthreads > nblocks) nthreads = static_cast<int>(nblocks ? nblocks : 1);
 
   auto work = [&](int t) {
     for (int64_t blk = t; blk < nblocks; blk += nthreads) {

@@ -20,4 +20,22 @@ idiomatic JAX/XLA/Pallas stack:
 __version__ = "0.1.0"
 
 from tpu_bfs.graph.csr import Graph, DeviceGraph  # noqa: F401
-from tpu_bfs.algorithms.bfs import bfs, BfsResult  # noqa: F401
+from tpu_bfs.algorithms.bfs import bfs, BfsEngine, BfsResult  # noqa: F401
+
+
+def __getattr__(name):
+    # Lazy flagship-engine exports: importing them eagerly would pull in the
+    # Pallas kernel module before callers have a chance to configure JAX.
+    if name == "HybridMsBfsEngine":
+        from tpu_bfs.algorithms.msbfs_hybrid import HybridMsBfsEngine
+
+        return HybridMsBfsEngine
+    if name == "WidePackedMsBfsEngine":
+        from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
+
+        return WidePackedMsBfsEngine
+    if name == "DistWideMsBfsEngine":
+        from tpu_bfs.parallel.dist_msbfs_wide import DistWideMsBfsEngine
+
+        return DistWideMsBfsEngine
+    raise AttributeError(f"module 'tpu_bfs' has no attribute {name!r}")

@@ -102,9 +102,10 @@ def rmat_graph(
     seed: int = 1,
     drop_self_loops: bool = True,
     dedup: bool = False,
+    impl: str = "numpy",
     **quadrants,
 ) -> Graph:
-    u, v = rmat_edges(scale, edge_factor, seed=seed, **quadrants)
+    u, v = rmat_edges(scale, edge_factor, seed=seed, impl=impl, **quadrants)
     m = len(u)
     if drop_self_loops:
         keep = u != v

@@ -48,17 +48,20 @@ def _find_lib():
                     ctypes.c_longlong,
                     ctypes.POINTER(ctypes.c_longlong),
                 ]
-                lib.tpubfs_rmat_edges.restype = ctypes.c_longlong
-                lib.tpubfs_rmat_edges.argtypes = [
-                    ctypes.c_longlong,  # scale
-                    ctypes.c_longlong,  # m
-                    ctypes.c_longlong,  # seed
-                    ctypes.c_double,  # a
-                    ctypes.c_double,  # b
-                    ctypes.c_double,  # c
-                    ctypes.POINTER(ctypes.c_longlong),  # out u
-                    ctypes.POINTER(ctypes.c_longlong),  # out v
-                ]
+                # Newer symbol: a stale build keeps the older fast paths and
+                # only loses the generator (rmat_edges_native checks again).
+                if getattr(lib, "tpubfs_rmat_edges", None) is not None:
+                    lib.tpubfs_rmat_edges.restype = ctypes.c_longlong
+                    lib.tpubfs_rmat_edges.argtypes = [
+                        ctypes.c_longlong,  # scale
+                        ctypes.c_longlong,  # m
+                        ctypes.c_longlong,  # seed
+                        ctypes.c_double,  # a
+                        ctypes.c_double,  # b
+                        ctypes.c_double,  # c
+                        ctypes.POINTER(ctypes.c_longlong),  # out u
+                        ctypes.POINTER(ctypes.c_longlong),  # out v
+                    ]
                 _LIB = lib
                 break
             except (OSError, AttributeError):
@@ -107,8 +110,8 @@ def rmat_edges_native(scale: int, m: int, seed: int, a: float, b: float, c: floa
     independent of thread count — but a DIFFERENT stream than the NumPy
     generator's (same distribution, different graphs for the same seed)."""
     lib = _find_lib()
-    if lib is None:
-        return None
+    if lib is None or getattr(lib, "tpubfs_rmat_edges", None) is None:
+        return None  # library unbuilt, or a stale build without the symbol
     u = np.empty(m, dtype=np.int64)
     v = np.empty(m, dtype=np.int64)
     ll = ctypes.POINTER(ctypes.c_longlong)

@@ -1,0 +1,104 @@
+"""Distributed hybrid (MXU tiles + gather residual) MS-BFS on a CPU mesh.
+
+Golden-differential per lane plus cross-engine equality with the single-chip
+hybrid; the Pallas kernel runs in interpret mode on the virtual devices.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_bfs.algorithms.msbfs_hybrid import HybridMsBfsEngine
+from tpu_bfs.parallel.dist_bfs import make_mesh
+from tpu_bfs.parallel.dist_msbfs_hybrid import (
+    DistHybridMsBfsEngine,
+    build_dist_hybrid,
+)
+from tpu_bfs.reference import bfs_python
+
+
+def _check_lanes(graph, engine, sources, res=None):
+    res = engine.run(np.asarray(sources)) if res is None else res
+    for s_idx, src in enumerate(sources):
+        golden, _ = bfs_python(graph, int(src))
+        np.testing.assert_array_equal(
+            res.distances_int32(s_idx), golden,
+            err_msg=f"lane {s_idx} source {src}",
+        )
+    return res
+
+
+def test_dist_hybrid_split_conserves_edges(random_small):
+    hd = build_dist_hybrid(random_small, 4, tile_thr=4)
+    sentinel = hd["rows"] - 1
+    res_slots = sum(
+        int((a != sentinel).sum())
+        for k, a in hd["res_arrs"].items()
+        if k.startswith(("light", "virtual"))
+    )
+    dense_bits = int(np.bitwise_count(hd["a_tiles_s"]).sum())
+    assert hd["num_dense_edges"] + res_slots == random_small.num_edges
+    assert 0 < dense_bits <= hd["num_dense_edges"]
+
+
+@pytest.mark.parametrize("num_devices", [2, 4])
+def test_dist_hybrid_matches_oracle(random_small, num_devices):
+    engine = DistHybridMsBfsEngine(
+        random_small, make_mesh(num_devices), tile_thr=2
+    )
+    assert engine.hd["num_tiles"] > 0
+    _check_lanes(random_small, engine, [0, 1, 17, 255, 499])
+
+
+def test_dist_hybrid_pure_residual(random_small):
+    engine = DistHybridMsBfsEngine(
+        random_small, make_mesh(4), tile_thr=10**6
+    )
+    assert engine.hd["num_tiles"] == 0
+    _check_lanes(random_small, engine, [0, 3, 400])
+
+
+def test_dist_hybrid_heavy_rows(rmat_small):
+    # Threshold high enough that hub rows keep residual edges above kcap:
+    # exercises the per-shard virtual-row fold alongside the dense tiles.
+    engine = DistHybridMsBfsEngine(
+        rmat_small, make_mesh(4), tile_thr=300, kcap=8
+    )
+    assert engine.hd["num_tiles"] > 0
+    assert engine.hd["sell"].heavy_per_shard > 0
+    sources = np.flatnonzero(engine.hd["in_degree"] > 0)[:40]
+    _check_lanes(rmat_small, engine, sources)
+
+
+def test_dist_hybrid_matches_single_chip(random_small):
+    rng = np.random.default_rng(5)
+    sources = rng.integers(0, random_small.num_vertices, 80)
+    dist_res = DistHybridMsBfsEngine(
+        random_small, make_mesh(8), tile_thr=2
+    ).run(sources, time_it=True)
+    single_res = HybridMsBfsEngine(random_small, tile_thr=2).run(sources)
+    for i in [0, 40, 79]:
+        np.testing.assert_array_equal(
+            dist_res.distances_int32(i), single_res.distances_int32(i)
+        )
+    np.testing.assert_array_equal(dist_res.reached, single_res.reached)
+    np.testing.assert_array_equal(
+        dist_res.edges_traversed, single_res.edges_traversed
+    )
+    assert dist_res.num_levels == single_res.num_levels
+    assert dist_res.teps and dist_res.teps > 0
+
+
+def test_dist_hybrid_disconnected_and_cap(random_disconnected, line_graph):
+    from tpu_bfs.algorithms.msbfs_packed import UNREACHED
+
+    engine = DistHybridMsBfsEngine(
+        random_disconnected, make_mesh(2), tile_thr=2
+    )
+    res = _check_lanes(random_disconnected, engine, [0, 5, 9])
+    assert (res.distance_u8_lane(0) == UNREACHED).any()
+
+    deep = DistHybridMsBfsEngine(
+        line_graph, make_mesh(2), tile_thr=2, num_planes=5
+    )
+    with pytest.raises(RuntimeError, match="num_planes"):
+        deep.run(np.array([0]))

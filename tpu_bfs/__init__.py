@@ -38,4 +38,8 @@ def __getattr__(name):
         from tpu_bfs.parallel.dist_msbfs_wide import DistWideMsBfsEngine
 
         return DistWideMsBfsEngine
+    if name == "DistHybridMsBfsEngine":
+        from tpu_bfs.parallel.dist_msbfs_hybrid import DistHybridMsBfsEngine
+
+        return DistHybridMsBfsEngine
     raise AttributeError(f"module 'tpu_bfs' has no attribute {name!r}")

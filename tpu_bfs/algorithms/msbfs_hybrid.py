@@ -120,6 +120,71 @@ class HybridGraph:
         return len(self.col_tile)
 
 
+
+def select_dense_tiles(r, c, vt, *, tile_thr: int, a_budget_bytes: int):
+    """Pick dense 128x128 tiles over rank-space endpoints (r = dst rank,
+    c = src rank): tiles holding >= tile_thr edges, trimmed to the bit-packed
+    storage budget (2 KB/tile) by descending edge count.
+
+    Returns (dense_edge mask [E], dense_uniq sorted tile ids, tid per edge).
+    Shared by the single-chip and distributed hybrid builders.
+    """
+    max_tiles = max(a_budget_bytes // (TILE * AW * 4), 0)
+
+    def select(counts):
+        eligible = np.flatnonzero(counts >= max(tile_thr, 1))
+        if len(eligible) > max_tiles:
+            order = eligible[
+                np.argsort(-counts[eligible], kind="stable")
+            ][:max_tiles]
+            eligible = np.sort(order)
+        return eligible
+
+    if vt * vt <= 3 * 10**8:
+        # Dense tile-count histogram: one bincount over int32 tile ids beats
+        # np.unique's 67M-element sort by ~20s at scale 21. The vt*vt count
+        # array (~2 GiB at scale 21) only exists on host during the build.
+        tid = (r // TILE).astype(np.int32) * np.int32(vt) + (
+            c // TILE
+        ).astype(np.int32)
+        eligible = select(np.bincount(tid, minlength=vt * vt))
+        dense_tile_mask = np.zeros(vt * vt, dtype=bool)
+        dense_tile_mask[eligible] = True
+        dense_edge = dense_tile_mask[tid]
+        dense_uniq = eligible.astype(np.int64)
+    else:
+        # Graph500-scale vertex counts: vt*vt is too large to histogram.
+        tid = (r.astype(np.int64) // TILE) * vt + (c.astype(np.int64) // TILE)
+        uniq, inv, cnt = np.unique(tid, return_inverse=True, return_counts=True)
+        eligible = select(cnt)
+        is_dense_tile = np.zeros(len(uniq), dtype=bool)
+        is_dense_tile[eligible] = True
+        dense_edge = is_dense_tile[inv]
+        dense_uniq = uniq[eligible]
+    return dense_edge, dense_uniq, tid
+
+
+def fill_a_tiles(dense_edge, dense_uniq, tid, r, c):
+    """Bit-packed tiles, rows-in-bits (tile_spmm layout): A[row, col] at
+    [t, row % AW, col] bit row // AW — 2 KB/tile instead of 16 KB dense int8.
+    Bits OR via sort + reduceat (np.bitwise_or.at is ~40x slower at
+    Graph500 scale)."""
+    nt = len(dense_uniq)
+    a_tiles = np.zeros((max(nt, 1), AW, TILE), dtype=np.uint32)
+    if nt:
+        de = np.flatnonzero(dense_edge)
+        slot = np.searchsorted(dense_uniq, tid[de])
+        rin = (r[de] % TILE).astype(np.int64)
+        flat = slot * (AW * TILE) + (rin % AW) * TILE + c[de] % TILE
+        comb = (flat << np.int64(5)) | (rin // AW)
+        comb.sort()
+        vals = np.uint32(1) << (comb & 31).astype(np.uint32)
+        f2 = comb >> np.int64(5)
+        starts = np.flatnonzero(np.r_[True, np.diff(f2) != 0])
+        a_tiles.reshape(-1)[f2[starts]] = np.bitwise_or.reduceat(vals, starts)
+    return a_tiles
+
+
 def build_hybrid(
     g: Graph,
     *,
@@ -143,63 +208,16 @@ def build_hybrid(
     vt = -(-(v + 1) // TILE)
     r = rank[dst]  # int32 rank ids
     c = rank[src]
-    max_tiles = max(a_budget_bytes // (TILE * AW * 4), 0)
-
-    def select_tiles(counts):
-        """Indices (into ``counts``) of tiles meeting the threshold, trimmed
-        to the budget by descending edge count, ascending id order."""
-        eligible = np.flatnonzero(counts >= max(tile_thr, 1))
-        if len(eligible) > max_tiles:
-            order = eligible[
-                np.argsort(-counts[eligible], kind="stable")
-            ][:max_tiles]
-            eligible = np.sort(order)
-        return eligible
-
-    if vt * vt <= 3 * 10**8:
-        # Dense tile-count histogram: one bincount over int32 tile ids beats
-        # np.unique's 67M-element sort by ~20s at scale 21. The vt*vt count
-        # array (~2 GiB at scale 21) only exists on host during the build.
-        tid = (r // TILE).astype(np.int32) * np.int32(vt) + (
-            c // TILE
-        ).astype(np.int32)
-        eligible = select_tiles(np.bincount(tid, minlength=vt * vt))
-        dense_tile_mask = np.zeros(vt * vt, dtype=bool)
-        dense_tile_mask[eligible] = True
-        dense_edge = dense_tile_mask[tid]
-        dense_uniq = eligible.astype(np.int64)
-    else:
-        # Graph500-scale vertex counts: vt*vt is too large to histogram.
-        tid = (r.astype(np.int64) // TILE) * vt + (c.astype(np.int64) // TILE)
-        uniq, inv, cnt = np.unique(tid, return_inverse=True, return_counts=True)
-        eligible = select_tiles(cnt)
-        is_dense_tile = np.zeros(len(uniq), dtype=bool)
-        is_dense_tile[eligible] = True
-        dense_edge = is_dense_tile[inv]
-        dense_uniq = uniq[eligible]
+    dense_edge, dense_uniq, tid = select_dense_tiles(
+        r, c, vt, tile_thr=tile_thr, a_budget_bytes=a_budget_bytes
+    )
 
     # --- dense arrays (dense_uniq sorted: row-tile-major then col-tile) ---
     nt = len(dense_uniq)
     row_tiles = (dense_uniq // vt).astype(np.int64)
     col_tile = (dense_uniq % vt).astype(np.int32)
     row_start = np.searchsorted(row_tiles, np.arange(vt + 1)).astype(np.int32)
-    # Bit-packed tiles, rows-in-bits (tile_spmm layout): A[r, c] at
-    # [t, r % AW, c] bit r // AW — 2 KB/tile instead of 16 KB dense int8.
-    a_tiles = np.zeros((max(nt, 1), AW, TILE), dtype=np.uint32)
-    if nt:
-        # Map each dense edge to its tile slot via searchsorted on dense_uniq,
-        # then OR bits per word via sort + reduceat (np.bitwise_or.at is ~40x
-        # slower at Graph500 scale).
-        de = np.flatnonzero(dense_edge)
-        slot = np.searchsorted(dense_uniq, tid[de])
-        rin = r[de] % TILE
-        flat = slot * (AW * TILE) + (rin % AW) * TILE + c[de] % TILE
-        comb = (flat << np.int64(5)) | (rin // AW)
-        comb.sort()
-        vals = (np.uint32(1) << (comb & 31).astype(np.uint32))
-        f2 = comb >> np.int64(5)
-        starts = np.flatnonzero(np.r_[True, np.diff(f2) != 0])
-        a_tiles.reshape(-1)[f2[starts]] = np.bitwise_or.reduceat(vals, starts)
+    a_tiles = fill_a_tiles(dense_edge, dense_uniq, tid, r, c)
 
     # --- residual ELL, bucketed by residual in-degree, targets in rank0 ids ---
     re_mask = ~dense_edge

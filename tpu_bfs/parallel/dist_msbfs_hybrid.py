@@ -1,0 +1,379 @@
+"""Distributed hybrid (MXU dense tiles + gather residual) multi-source BFS.
+
+The multi-chip form of the flagship HybridMsBfsEngine. Ownership is split
+per concern, which keeps every piece reusable:
+
+- **dense part**: global 128x128 tile selection (same rule as build_hybrid),
+  row-tiles dealt round-robin to chips (row-tile t -> chip t % P, so the
+  hub-heavy top tiles spread evenly); each chip runs the tile_spmm Pallas
+  kernel over its own tiles against the replicated rank0 frontier table.
+- **residual part**: the leftover edges form their own graph, sharded with
+  build_ell_sharded (round-robin over residual-degree-sorted rows — its own
+  row space); neighbor ids are remapped at build time to point into the
+  rank0 frontier table, and one static permutation per level routes the
+  gathered residual output back to rank0.
+- **state**: the frontier and visited tables are replicated (V * 4W bytes,
+  cheap); the bit-sliced distance planes — the big state — are sharded in
+  contiguous rank0 chunks, so the reassembled planes are already in rank0
+  order and the single-chip lazy extraction applies unchanged.
+
+Per level each chip computes its dense + residual contributions, two
+all_gathers assemble the full hit table, the claim ``& ~visited`` runs
+replicated (identical on every chip, so termination needs no extra
+collective — the reference needs an MPI_Allreduce per level,
+bfs_mpi.cu:621), and each chip ripples only its plane chunk.
+
+Like the single-chip hybrid, the dense kernel fixes the lane count at 4096
+(w=128); unlike it, sharding the planes and edge structure lets that width
+fit graphs a single chip cannot hold.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_bfs.graph.csr import Graph, build_csr
+from tpu_bfs.graph.ell import build_ell_sharded
+from tpu_bfs.algorithms.msbfs_packed import ripple_increment
+from tpu_bfs.algorithms._packed_common import (
+    ExpandSpec,
+    make_fori_expand,
+    make_state_kernels,
+    run_packed_batch,
+)
+from tpu_bfs.algorithms.msbfs_hybrid import fill_a_tiles, select_dense_tiles
+from tpu_bfs.ops.tile_spmm import AW, TILE, tile_spmm
+from tpu_bfs.parallel.dist_bfs import make_mesh
+
+W = 128
+LANES = 32 * W
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def build_dist_hybrid(
+    g: Graph,
+    num_shards: int,
+    *,
+    kcap: int = 64,
+    tile_thr: int = 64,
+    a_budget_bytes: int = int(0.2e9),
+):
+    """Build the sharded dense tiles + sharded residual ELL + glue maps.
+
+    Returns a dict of host arrays (see DistHybridMsBfsEngine for the layout).
+    """
+    p_count = num_shards
+    v = g.num_vertices
+    src, dst = g.coo
+    in_deg = np.bincount(dst, minlength=v).astype(np.int64)
+    rank_order = np.argsort(-in_deg, kind="stable").astype(np.int32)
+    rank = np.empty(v, dtype=np.int32)
+    rank[rank_order] = np.arange(v, dtype=np.int32)
+
+    vt = _round_up(-(-(v + 1) // TILE), p_count)  # row-tiles, multiple of P
+    rows = vt * TILE
+    r = rank[dst]
+    c = rank[src]
+    dense_edge, dense_uniq, tid = select_dense_tiles(
+        r, c, vt, tile_thr=tile_thr, a_budget_bytes=a_budget_bytes
+    )
+
+    # --- per-chip dense arrays (owner of tile = row_tile % P) ---
+    nt = len(dense_uniq)
+    g_row_tile = dense_uniq // vt
+    g_col_tile = (dense_uniq % vt).astype(np.int32)
+    owner = (g_row_tile % p_count).astype(np.int64)
+    nrt = vt // p_count  # local row-tiles per chip
+    nt_max = max(int(np.bincount(owner, minlength=p_count).max(initial=0)), 1)
+    row_start_s = np.zeros((p_count, nrt + 1), np.int32)
+    col_tile_s = np.zeros((p_count, nt_max), np.int32)
+    a_tiles_s = np.zeros((p_count, nt_max, AW, TILE), np.uint32)
+
+    if nt:
+        # Fill A bits globally, then scatter into per-chip slots.
+        a_global = fill_a_tiles(dense_edge, dense_uniq, tid, r, c)
+        for p in range(p_count):
+            mine = np.flatnonzero(owner == p)
+            local_rt = (g_row_tile[mine] // p_count).astype(np.int64)
+            # dense_uniq is (row_tile, col) sorted; the filtered subsequence
+            # is sorted by local row-tile already.
+            row_start_s[p] = np.searchsorted(
+                local_rt, np.arange(nrt + 1)
+            ).astype(np.int32)
+            col_tile_s[p, : len(mine)] = g_col_tile[mine]
+            a_tiles_s[p, : len(mine)] = a_global[mine]
+
+    # --- residual: its own sharded ELL over the leftover edges ---
+    re_mask = ~dense_edge
+    res_g = build_csr(
+        src[re_mask].astype(np.int64),
+        dst[re_mask].astype(np.int64),
+        v,
+        sort_neighbors=False,
+        undirected=False,
+    )
+    sell = build_ell_sharded(res_g, p_count, kcap=kcap)
+
+    # Remap ELL neighbor ids (residual-rank space, sentinel = its v_pad) to
+    # rank0 frontier rows (sentinel = rows - 1, a zero pad row).
+    sentinel0 = rows - 1
+    trans = np.full(sell.v_pad + 1, sentinel0, dtype=np.int32)
+    trans[sell.rank] = rank
+
+    def remap(idx):
+        return trans[idx]
+
+    res_arrs = {}
+    if sell.heavy_per_shard > 0:
+        res_arrs["virtual_t"] = remap(
+            np.ascontiguousarray(sell.virtual.transpose(0, 2, 1))
+        )
+        res_arrs["fold_pad_map"] = sell.fold_pad_map
+        res_arrs["heavy_pick"] = sell.heavy_pick
+    for i, (k, blocks) in enumerate(sell.light):
+        res_arrs[f"light{i}_t"] = remap(np.ascontiguousarray(blocks.transpose(0, 2, 1)))
+
+    # rank0 row -> residual-rank row of the same vertex (the all_gathered
+    # residual output is reassembled in residual-rank order). Pad rank0 rows
+    # point at residual row v_pad-1 — a pad there too unless P divides V
+    # exactly; the level loop masks pad rows regardless (``valid``), which
+    # also keeps the rank0 sentinel row (rows-1) permanently zero.
+    inv_perm = np.full(rows, sell.v_pad - 1, dtype=np.int32)
+    inv_perm[rank] = sell.rank
+    valid = np.zeros((rows, 1), dtype=np.uint32)
+    valid[rank, 0] = np.uint32(0xFFFFFFFF)
+
+    return {
+        "num_vertices": v,
+        "num_edges": g.num_edges,
+        "undirected": g.undirected,
+        "vt": vt,
+        "rows": rows,
+        "rank": rank,
+        "old_of_new": rank_order,
+        "in_degree": in_deg,
+        "num_dense_edges": int(dense_edge.sum()),
+        "num_tiles": nt,
+        "row_start_s": row_start_s,
+        "col_tile_s": col_tile_s,
+        "a_tiles_s": a_tiles_s,
+        "sell": sell,
+        "res_arrs": res_arrs,
+        "inv_perm": inv_perm,
+        "valid": valid,
+    }
+
+
+def _make_dist_core(hd, w: int, num_planes: int, mesh: Mesh, interpret: bool):
+    p_count = mesh.devices.size
+    rows = hd["rows"]
+    rows_loc = rows // p_count
+    nrt = hd["vt"] // p_count
+    sell = hd["sell"]
+    spec = ExpandSpec(
+        kcap=sell.kcap,
+        heavy=sell.heavy_per_shard > 0,
+        num_virtual=sell.num_virtual,
+        fold_steps=sell.fold_steps,
+        light_meta=tuple((k, blocks.shape[1]) for k, blocks in sell.light),
+        tail_rows=sell.tail_rows,
+    )
+    expand = make_fori_expand(spec, w)
+    has_dense = hd["num_tiles"] > 0
+    v_pad_res = sell.v_pad
+
+    replicated = ("inv_perm", "valid")
+
+    def chip_fn(arrs, fw0, max_levels):
+        arrs = {
+            k: (a if k in replicated else a[0]) for k, a in arrs.items()
+        }
+        p = lax.axis_index("v")
+
+        def hit_of(fw):
+            # Residual: this chip's residual-rank rows -> all_gather ->
+            # residual-rank order -> permute to rank0.
+            res_own = expand(arrs, fw)  # [v_loc_res, w]
+            ag_r = lax.all_gather(res_own, "v")  # [P, v_loc, w]
+            res_full = (
+                ag_r.transpose(1, 0, 2).reshape(v_pad_res, w)[arrs["inv_perm"]]
+            )
+            if has_dense:
+                # Dense: this chip's row-tiles -> all_gather -> interleave
+                # back (global row-tile t = local j * P + chip p).
+                hit_d = tile_spmm(
+                    arrs["row_start"], arrs["col_tile"], arrs["a_tiles"], fw,
+                    num_row_tiles=nrt, w=w, interpret=interpret,
+                )  # [nrt*TILE, w]
+                ag_d = lax.all_gather(hit_d.reshape(nrt, TILE, w), "v")
+                res_full = res_full | ag_d.transpose(1, 0, 2, 3).reshape(rows, w)
+            # Pad rank0 rows never hit (keeps the sentinel row zero).
+            return res_full & arrs["valid"]
+
+        def own(full):  # this chip's contiguous plane chunk
+            return lax.dynamic_slice(full, (p * rows_loc, 0), (rows_loc, w))
+
+        planes0 = tuple(
+            jnp.zeros((rows_loc, w), jnp.uint32) for _ in range(num_planes)
+        )
+
+        def cond(carry):
+            _, _, _, level, alive = carry
+            return alive & (level < max_levels)
+
+        def body(carry):
+            fw, vis, planes, level, _ = carry
+            nxt = hit_of(fw) & ~vis  # replicated: identical on every chip
+            vis2 = vis | nxt
+            planes = ripple_increment(planes, ~own(vis2))
+            alive = jnp.any(nxt != 0)
+            return nxt, vis2, planes, level + 1, alive
+
+        fw_f, vis_f, planes_f, levels, alive = lax.while_loop(
+            cond, body, (fw0, fw0, planes0, jnp.int32(0), jnp.bool_(True))
+        )
+
+        def deeper():
+            return jnp.any((hit_of(fw_f) & ~vis_f) != 0)
+
+        truncated = lax.cond(
+            alive & (levels >= max_levels), deeper, lambda: jnp.bool_(False)
+        )
+        return (
+            tuple(pl[None] for pl in planes_f),
+            vis_f,
+            levels,
+            alive,
+            truncated,
+        )
+
+    def build(n_arrs):
+        specs = {
+            k: (P() if k in replicated else P("v")) for k in n_arrs
+        }
+        core = jax.jit(
+            jax.shard_map(
+                chip_fn,
+                mesh=mesh,
+                in_specs=(specs, P(), P()),
+                out_specs=(
+                    tuple(P("v") for _ in range(num_planes)),
+                    P(),
+                    P(),
+                    P(),
+                    P(),
+                ),
+                check_vma=False,
+            )
+        )
+        device_arrs = {}
+        for k, a in n_arrs.items():
+            sh = NamedSharding(mesh, P() if k in replicated else P("v"))
+            device_arrs[k] = jax.device_put(a, sh)
+        return core, device_arrs
+
+    return build
+
+
+class DistHybridMsBfsEngine:
+    """Multi-chip 4096-lane hybrid MS-BFS: dense MXU tiles + gather residual.
+
+    API mirrors HybridMsBfsEngine; the dense kernel's 4096-lane requirement
+    holds, but sharded planes/edges let it fit graphs one chip cannot.
+    """
+
+    def __init__(
+        self,
+        graph: Graph | dict,
+        mesh: Mesh | int | None = None,
+        *,
+        kcap: int = 64,
+        tile_thr: int = 64,
+        a_budget_bytes: int = int(0.2e9),
+        num_planes: int = 5,
+        interpret: bool | None = None,
+    ):
+        if not (1 <= num_planes <= 8):
+            raise ValueError("num_planes must be in [1, 8]")
+        self.w = W
+        self.lanes = LANES
+        self.num_planes = num_planes
+        self.max_levels_cap = min(1 << num_planes, 254)
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        self.mesh = mesh if isinstance(mesh, Mesh) else make_mesh(mesh)
+        p_count = self.mesh.devices.size
+        hd = (
+            build_dist_hybrid(
+                graph, p_count, kcap=kcap, tile_thr=tile_thr,
+                a_budget_bytes=a_budget_bytes,
+            )
+            if isinstance(graph, Graph)
+            else graph
+        )
+        if hd["sell"].num_shards != p_count:
+            raise ValueError(
+                f"built for {hd['sell'].num_shards} shards, mesh has {p_count}"
+            )
+        if hd["rows"] % p_count:
+            raise ValueError("padded rows not divisible by mesh size")
+        self.hd = hd
+        self.undirected = hd["undirected"]
+
+        n_arrs = dict(hd["res_arrs"])
+        n_arrs["inv_perm"] = hd["inv_perm"]
+        n_arrs["valid"] = hd["valid"]
+        if hd["num_tiles"]:
+            n_arrs["row_start"] = hd["row_start_s"]
+            n_arrs["col_tile"] = hd["col_tile_s"]
+            n_arrs["a_tiles"] = hd["a_tiles_s"]
+        build = _make_dist_core(hd, self.w, num_planes, self.mesh, interpret)
+        self._dist_core, self.arrs = build(n_arrs)
+
+        self._rank = hd["rank"].astype(np.int64)
+        in_deg_r = np.zeros(hd["rows"], dtype=np.float32)
+        in_deg_r[self._rank] = hd["in_degree"].astype(np.float32)
+        self._in_deg_ranked = jnp.asarray(in_deg_r)
+        self._seed_k, self._lane_stats, self._extract_word = make_state_kernels(
+            hd["rows"], hd["rows"], self.w, num_planes
+        )
+        self._warmed = False
+
+    @property
+    def num_vertices(self) -> int:
+        return self.hd["num_vertices"]
+
+    # Word-major lane map, same as the single-chip engines.
+    @staticmethod
+    def _word_col(i: int):
+        return i // 32, i % 32
+
+    @staticmethod
+    def _lane_order(mat: np.ndarray) -> np.ndarray:
+        return mat.reshape(-1)
+
+    def _seed_dev(self, sources: np.ndarray):
+        ranks = self.hd["rank"][np.asarray(sources, dtype=np.int64)].astype(np.int32)
+        lanes = np.arange(len(sources), dtype=np.int32)
+        words = (lanes // 32).astype(np.int32)
+        bits = np.uint32(1) << (lanes % 32).astype(np.uint32)
+        return self._seed_k(jnp.asarray(ranks), jnp.asarray(words), jnp.asarray(bits))
+
+    def _core(self, arrs, fw0, max_levels):
+        planes, vis, levels, alive, truncated = self._dist_core(arrs, fw0, max_levels)
+        # Contiguous chunks concatenate back into plain rank0 order.
+        planes = tuple(pl.reshape(self.hd["rows"], self.w) for pl in planes)
+        return planes, vis, levels, alive, truncated
+
+    def run(self, sources, *, max_levels=None, time_it=False, check_cap=True):
+        return run_packed_batch(
+            self, sources, max_levels=max_levels, time_it=time_it,
+            check_cap=check_cap,
+        )

@@ -67,6 +67,24 @@ def test_cli_truncation_exits_with_hint(tmp_path):
                      "--engine", "packed"]) == 0
 
 
+def test_cli_checkpoint_resume_roundtrip(capsys, tmp_path):
+    ck = str(tmp_path / "st.npz")
+    # Checkpointed run: chunked advancing, still golden-validated at the end.
+    rc = cli.main(["2", "random:n=300,m=1200,seed=5", "--ckpt", ck,
+                   "--ckpt-every", "1"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "Output OK" in out and "checkpointed at level" in out
+    # Resuming the FINISHED checkpoint immediately finishes and validates
+    # (source comes from the checkpoint, not argv).
+    rc = cli.main(["0", "random:n=300,m=1200,seed=5", "--resume", ck])
+    out = capsys.readouterr().out
+    assert rc == 0 and "resumed source 2" in out and "Output OK" in out
+    # And on a 4-device mesh (elastic restart).
+    rc = cli.main(["0", "random:n=300,m=1200,seed=5", "--resume", ck,
+                   "--devices", "4"])
+    assert rc == 0 and "Output OK" in capsys.readouterr().out
+
+
 def test_cli_rejects_bad_source():
     with pytest.raises(SystemExit):
         cli.main(["999", "random:n=100,m=300,seed=1"])

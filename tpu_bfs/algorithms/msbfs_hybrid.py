@@ -42,7 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpu_bfs.graph.csr import Graph
-from tpu_bfs.graph.ell import EllBucket, bucketize_rows
+from tpu_bfs.graph.ell import EllBucket, bucketize_rows, rank_by_in_degree
 from tpu_bfs.algorithms.msbfs_packed import ripple_increment
 from tpu_bfs.algorithms._packed_common import (
     ExpandSpec,
@@ -200,10 +200,7 @@ def build_hybrid(
     their edges cost as gathers."""
     v = g.num_vertices
     src, dst = g.coo
-    in_deg = np.bincount(dst, minlength=v).astype(np.int64)
-    rank_order = np.argsort(-in_deg, kind="stable").astype(np.int32)
-    rank = np.empty(v, dtype=np.int32)
-    rank[rank_order] = np.arange(v, dtype=np.int32)
+    in_deg, rank_order, rank = rank_by_in_degree(dst, v)
 
     vt = -(-(v + 1) // TILE)
     r = rank[dst]  # int32 rank ids

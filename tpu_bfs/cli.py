@@ -191,11 +191,24 @@ def main(argv=None) -> int:
                     "traversal depth at 2**P levels (default 5)")
     ap.add_argument("--profile-dir", default=None,
                     help="write a jax.profiler trace of the timed run here")
+    ap.add_argument("--ckpt", default=None, metavar="PATH",
+                    help="checkpoint the traversal state to PATH.npz every "
+                    "--ckpt-every levels (single-source modes)")
+    ap.add_argument("--ckpt-every", type=int, default=4, metavar="N",
+                    help="levels per checkpoint chunk (default 4)")
+    ap.add_argument("--resume", default=None, metavar="PATH",
+                    help="resume a traversal from a checkpoint written by "
+                    "--ckpt (overrides <source> with the saved one)")
     args = ap.parse_args(argv)
     if (args.mesh or args.devices > 1) and args.backend in ("delta", "dopt"):
         ap.error(f"--backend {args.backend} is single-device only (for now)")
     if args.multi_source and (args.mesh or args.devices > 1):
         ap.error("--multi-source is single-device only (for now)")
+    if (args.ckpt or args.resume) and (args.mesh or args.multi_source):
+        ap.error("--ckpt/--resume work with the single-source engines "
+                 "(1D --devices meshes included)")
+    if (args.ckpt or args.resume) and (args.repeat > 1 or args.profile_dir):
+        ap.error("--repeat/--profile-dir do not apply to checkpointed runs")
     if args.multi_source and args.save_parent:
         ap.error("--multi-source computes distances only; --save-parent is "
                  "unavailable (use single-source mode for the parent tree)")
@@ -216,12 +229,23 @@ def main(argv=None) -> int:
             f"source {args.source} out of range [0, {g.num_vertices})"
         )
 
+    # On --resume the traversal's source comes from the checkpoint; load it
+    # before the golden run so the CPU BFS happens once, for the right source.
+    resume_st = None
+    if args.resume:
+        from tpu_bfs.utils import checkpoint as ck
+
+        resume_st = ck.load_checkpoint(args.resume)
+        print(f"resumed source {resume_st.source} at level {resume_st.level}")
+
     golden = None
     if not args.skip_cpu:
         from tpu_bfs.reference import bfs_golden
 
         t0 = time.perf_counter()
-        golden = bfs_golden(g, args.source)
+        golden = bfs_golden(
+            g, resume_st.source if resume_st is not None else args.source
+        )
         # Reference prints CPU elapsed ms (runCpu, bfs.cu:211-219).
         print(f"Elapsed time in milliseconds (CPU): {(time.perf_counter() - t0) * 1e3:.2f}")
 
@@ -250,17 +274,34 @@ def main(argv=None) -> int:
     else:
         engine = BfsEngine(g, backend=args.backend)
 
-    res = None
-    for _ in range(max(1, args.repeat)):
-        with _maybe_profile(args.profile_dir):
-            res = engine.run(
-                args.source,
-                max_levels=args.max_levels,
-                with_parents=not args.no_parents,
-                time_it=True,
-            )
-        # Reference prints device elapsed ms (bfs.cu:624-626).
-        print(f"Elapsed time in milliseconds (device): {res.elapsed_s * 1e3:.3f}")
+    if args.ckpt or args.resume:
+        # Chunked traversal with durable state (tpu_bfs/utils/checkpoint.py):
+        # resume continues bit-identically to an uninterrupted run.
+        from tpu_bfs.utils import checkpoint as ck
+
+        st = resume_st if resume_st is not None else engine.start(args.source)
+        cap = args.max_levels if args.max_levels is not None else float("inf")
+        while not st.done and st.level < cap:
+            chunk = max(1, args.ckpt_every)
+            if cap != float("inf"):
+                chunk = min(chunk, int(cap) - st.level)
+            st = engine.advance(st, levels=chunk)
+            if args.ckpt:
+                ck.save_checkpoint(args.ckpt, st)
+                print(f"checkpointed at level {st.level}")
+        res = engine.finish(st, with_parents=not args.no_parents)
+    else:
+        res = None
+        for _ in range(max(1, args.repeat)):
+            with _maybe_profile(args.profile_dir):
+                res = engine.run(
+                    args.source,
+                    max_levels=args.max_levels,
+                    with_parents=not args.no_parents,
+                    time_it=True,
+                )
+            # Reference prints device elapsed ms (bfs.cu:624-626).
+            print(f"Elapsed time in milliseconds (device): {res.elapsed_s * 1e3:.3f}")
     if res.teps:
         print(f"Traversed edges: {res.edges_traversed}  GTEPS: {res.teps / 1e9:.4f}")
     print(f"Reached {res.reached} vertices in {res.num_levels} levels")
@@ -276,7 +317,7 @@ def main(argv=None) -> int:
         # which the reference never does.
         validate.check_distances(res.distance, golden)
         if res.parent is not None:
-            validate.check_parents(g, args.source, res.distance, res.parent)
+            validate.check_parents(g, res.source, res.distance, res.parent)
         print("Output OK")
 
     if args.save_dist:

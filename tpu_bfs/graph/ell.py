@@ -163,11 +163,7 @@ def build_ell_sharded(g: Graph, num_shards: int, *, kcap: int = 64) -> ShardedEl
     src, dst = g.coo
     order_ds = _lexsort_pairs(dst, src, v_count)
     in_col = src[order_ds]
-    in_deg = np.bincount(dst, minlength=v_count).astype(np.int64)
-
-    rank_order = np.argsort(-in_deg, kind="stable").astype(np.int32)
-    rank = np.empty(v_count, dtype=np.int32)
-    rank[rank_order] = np.arange(v_count, dtype=np.int32)
+    in_deg, rank_order, rank = rank_by_in_degree(dst, v_count)
 
     v_loc = -(-v_count // p_count)
     v_pad = p_count * v_loc
@@ -279,6 +275,20 @@ def starts_of(rows: np.ndarray, new_rp: np.ndarray) -> np.ndarray:
     return new_rp[rows]
 
 
+
+def rank_by_in_degree(dst: np.ndarray, v_count: int):
+    """(in_degree, rank_order, rank) for descending-in-degree relabeling.
+
+    ``kind="stable"`` is load-bearing: every builder must produce the same
+    tie-break so cross-engine results stay bit-identical.
+    """
+    in_deg = np.bincount(dst, minlength=v_count).astype(np.int64)
+    rank_order = np.argsort(-in_deg, kind="stable").astype(np.int32)  # new -> old
+    rank = np.empty(v_count, dtype=np.int32)
+    rank[rank_order] = np.arange(v_count, dtype=np.int32)
+    return in_deg, rank_order, rank
+
+
 def bucketize_rows(lens: np.ndarray, nbrs: np.ndarray, new_rp: np.ndarray,
                    kcap: int, pad: int):
     """Split degree-sorted rows into the heavy virtual-row + fold-pyramid
@@ -362,11 +372,7 @@ def build_ell(g: Graph, *, kcap: int = 64) -> EllGraph:
     src, dst = g.coo
     order_ds = _lexsort_pairs(dst, src, v_count)
     in_col = src[order_ds]
-    in_deg = np.bincount(dst, minlength=v_count).astype(np.int64)
-
-    rank_order = np.argsort(-in_deg, kind="stable").astype(np.int32)  # new -> old
-    rank = np.empty(v_count, dtype=np.int32)
-    rank[rank_order] = np.arange(v_count, dtype=np.int32)
+    in_deg, rank_order, rank = rank_by_in_degree(dst, v_count)
 
     # Flatten in-neighbor lists in rank order, neighbor ids mapped to rank space.
     in_rp = np.zeros(v_count + 1, dtype=np.int64)

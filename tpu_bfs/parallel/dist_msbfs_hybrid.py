@@ -37,7 +37,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_bfs.graph.csr import Graph, build_csr
-from tpu_bfs.graph.ell import build_ell_sharded
+from tpu_bfs.graph.ell import build_ell_sharded, rank_by_in_degree
 from tpu_bfs.algorithms.msbfs_packed import ripple_increment
 from tpu_bfs.algorithms._packed_common import (
     ExpandSpec,
@@ -72,10 +72,7 @@ def build_dist_hybrid(
     p_count = num_shards
     v = g.num_vertices
     src, dst = g.coo
-    in_deg = np.bincount(dst, minlength=v).astype(np.int64)
-    rank_order = np.argsort(-in_deg, kind="stable").astype(np.int32)
-    rank = np.empty(v, dtype=np.int32)
-    rank[rank_order] = np.arange(v, dtype=np.int32)
+    in_deg, rank_order, rank = rank_by_in_degree(dst, v)
 
     vt = _round_up(-(-(v + 1) // TILE), p_count)  # row-tiles, multiple of P
     rows = vt * TILE
@@ -338,11 +335,13 @@ class DistHybridMsBfsEngine:
         self._dist_core, self.arrs = build(n_arrs)
 
         self._rank = hd["rank"].astype(np.int64)
+        # Ranks are < V, so the first V entries carry every real vertex —
+        # exactly the rows lane_stats scans (make_state_kernels v=V).
         in_deg_r = np.zeros(hd["rows"], dtype=np.float32)
         in_deg_r[self._rank] = hd["in_degree"].astype(np.float32)
-        self._in_deg_ranked = jnp.asarray(in_deg_r)
+        self._in_deg_ranked = jnp.asarray(in_deg_r[: hd["num_vertices"]])
         self._seed_k, self._lane_stats, self._extract_word = make_state_kernels(
-            hd["rows"], hd["rows"], self.w, num_planes
+            hd["num_vertices"], hd["rows"], self.w, num_planes
         )
         self._warmed = False
 

@@ -144,3 +144,32 @@ def test_native_rmat_generator():
     deg_np = np.bincount(vn, minlength=1 << 10)
     assert deg_nat.max() > 10 * deg_nat.mean()
     assert 0.5 < deg_nat.max() / deg_np.max() < 2.0
+
+
+@pytest.mark.parametrize(
+    "name,text",
+    [
+        ("plain.txt", "4 3\n0 1\n1 2\n2 3\n"),
+        ("mtx.mtx", "%%MatrixMarket matrix coordinate pattern symmetric\n"
+                    "% c\n4 4 3\n1 2\n2 3\n3 4\n"),
+        ("weighted.mtx", "%%MatrixMarket matrix coordinate real general\n"
+                         "3 3 2\n1 2 0.5\n2 3 1.5e2\n"),
+    ],
+)
+def test_native_loader_matches_python(tmp_path, name, text):
+    # The C++ loader (native/loader.cpp) and the pure-Python parser must
+    # produce identical graphs for the reference format, .mtx headers,
+    # comments, and weight columns.
+    from tpu_bfs.utils import native
+
+    if not native.available():
+        pytest.skip("native library not built")
+    p = tmp_path / name
+    p.write_text(text)
+    g_native = native.load_edge_list_native(str(p))
+    with open(p) as f:
+        g_py = gio.read_edge_list_text(f.read())
+    assert g_native is not None
+    np.testing.assert_array_equal(g_native.row_ptr, g_py.row_ptr)
+    np.testing.assert_array_equal(g_native.col_idx, g_py.col_idx)
+    assert g_native.num_input_edges == g_py.num_input_edges

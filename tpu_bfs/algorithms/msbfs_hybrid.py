@@ -30,8 +30,8 @@ entries are assigned to lanes.
 Reference mapping: this is the capability of the reference's whole kernel
 layer (queueBfs, bfs.cu:134-165; multiBfs, bfs.cu:101-130) re-planned around
 the TPU's MXU/VPU split instead of CUDA thread divergence. Measured flagship:
-42 GTEPS harmonic-mean per-source on RMAT scale-21, 1 v5e chip (bench.py;
-see BENCHMARKS.md).
+38-42 GTEPS harmonic-mean per-source on RMAT scale-21 (the range spans the
+two generator streams' graph instances), 1 v5e chip — see BENCHMARKS.md.
 """
 
 from __future__ import annotations

@@ -4,27 +4,20 @@ Tests run on CPU with 8 virtual XLA devices so multi-chip sharding is
 exercised without TPU hardware — the capability the reference lacks entirely
 (it cannot test its 2-node MPI path without two real nodes; SURVEY.md §4).
 
-NOTE: this environment boots a TPU PJRT plugin from sitecustomize at
-interpreter start, and that registration overrides the JAX_PLATFORMS env var.
-``jax.config.update("jax_platforms", "cpu")`` after import (but before first
-backend use) reliably forces CPU; XLA_FLAGS must be set before first use too.
-A session-scoped guard asserts the 8 virtual devices actually materialized —
-without it the distributed tests silently collapse to 1-device meshes and
-pass vacuously (the reference's own validation sin, bfs_mpi.cu:844-846).
+The bootstrap mechanics (XLA_FLAGS timing, forcing CPU past the
+sitecustomize TPU plugin, backend-cache clearing) live in
+``tpu_bfs.utils.virtual_mesh.ensure_virtual_devices`` — shared with
+``__graft_entry__.dryrun_multichip``. A session-scoped guard additionally
+asserts the 8 virtual devices actually materialized — without it the
+distributed tests silently collapse to 1-device meshes and pass vacuously
+(the reference's own validation sin, bfs_mpi.cu:844-846).
 """
 
-import os
+from tpu_bfs.utils.virtual_mesh import ensure_virtual_devices
 
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+ensure_virtual_devices(8)
 
 import jax
-
-jax.config.update("jax_platforms", "cpu")
-
 import numpy as np
 import pytest
 

@@ -26,39 +26,20 @@ def log(msg: str) -> None:
     print(f"# {msg}", file=sys.stderr, flush=True)
 
 
-def _ensure_native() -> None:
-    """Best-effort `make -C native` so a fresh (or stale) checkout gets the
-    fast paths. make itself is the up-to-date check (~ms when current, and it
-    rebuilds when sources are newer than an old .so). Must run before the
-    first tpu_bfs.utils.native use in this process (the library handle is
-    cached on first lookup)."""
-    root = os.path.dirname(os.path.abspath(__file__))
-    import subprocess
-
-    try:
-        proc = subprocess.run(
-            ["make", "-C", os.path.join(root, "native")],
-            capture_output=True, timeout=120, check=False, text=True,
-        )
-        if proc.returncode != 0:
-            log(
-                f"native build failed (rc={proc.returncode}); falling back "
-                f"to numpy paths: {proc.stderr.strip()[-300:]}"
-            )
-    except (OSError, subprocess.TimeoutExpired) as exc:
-        log(f"native build skipped: {exc}")
-
-
 def load_graph(scale: int, ef: int):
     """Seeded RMAT graph, cached as npz so repeated bench runs skip the
     ~1 min/2^20-vertex generation cost."""
     from tpu_bfs.graph.csr import Graph
     from tpu_bfs.graph.generate import rmat_graph
 
-    _ensure_native()
-    from tpu_bfs.utils.native import available as native_available
+    from tpu_bfs.utils.native import ensure_built, has_rmat
 
-    impl = "native" if native_available() else "numpy"
+    ensure_built(log=log)
+
+    # Probe the generator symbol itself, not just that the library loads: a
+    # stale prebuilt .so plus a failed make would otherwise crash the bench
+    # inside rmat_graph(impl='native') instead of falling back.
+    impl = "native" if has_rmat() else "numpy"
     cache_dir = os.environ.get("TPU_BFS_BENCH_CACHE", ".bench_cache")
     # The two generator impls are different streams; tag the cache so a
     # numpy-generated graph is never reused as a "native" one or vice versa.

@@ -64,6 +64,10 @@ extern "C" {
 int64_t tpubfs_rmat_edges(int64_t scale, int64_t m, int64_t seed, double a,
                           double b, double c, int64_t* out_u, int64_t* out_v) {
   if (scale < 1 || scale > 40 || m < 0) return 2;
+  // Quadrant probabilities must leave room for d = 1-a-b-c > 0; a+b >= 1
+  // would divide by zero (or flip sign) in c_norm below and emit silently
+  // wrong edges with rc=0. Phrased positively so NaNs fail too.
+  if (!(a > 0.0 && b >= 0.0 && c >= 0.0 && a + b + c < 1.0)) return 3;
   const double ab = a + b;
   const double a_norm = a / ab;
   const double c_norm = c / (1.0 - ab);

@@ -26,6 +26,21 @@ from tpu_bfs.graph.generate import random_graph, rmat_graph
 
 
 @pytest.fixture(scope="session", autouse=True)
+def _fresh_native_lib():
+    """Rebuild the native library before any test body runs, so the
+    native-path tests exercise the current sources rather than a stale
+    prebuilt .so. A build failure is surfaced as a warning: with no
+    prebuilt library the native tests then skip via ``available()``, but a
+    stale .so would still load — the warning is the pointer when its
+    behavior diverges from the current sources."""
+    import warnings
+
+    from tpu_bfs.utils.native import ensure_built
+
+    ensure_built(log=lambda msg: warnings.warn(msg, stacklevel=2))
+
+
+@pytest.fixture(scope="session", autouse=True)
 def _require_virtual_devices():
     devs = jax.devices()
     assert len(devs) >= 8 and devs[0].platform == "cpu", (

@@ -6,6 +6,8 @@ uninterrupted run, checkpoints round-trip through disk (single-file and
 per-shard), and a sharded checkpoint resumes on a different mesh size.
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -63,6 +65,18 @@ def test_checkpoint_roundtrip(tmp_path, random_small):
     validate.check_distances(eng.finish(st2).distance, golden)
 
 
+def test_checkpoint_extensionless_path(tmp_path, random_small):
+    # np.savez_compressed appends '.npz' to bare string paths; the save path
+    # must match what load opens, or `--ckpt state` + `--resume state` fails.
+    eng = BfsEngine(random_small)
+    st = eng.advance(eng.start(3), levels=1)
+    path = str(tmp_path / "state")
+    ckpt_mod.save_checkpoint(path, st)
+    assert os.path.exists(path)
+    st2 = ckpt_mod.load_checkpoint(path)
+    np.testing.assert_array_equal(st2.distance, st.distance)
+
+
 def test_result_roundtrip(tmp_path, random_small):
     eng = BfsEngine(random_small)
     res = eng.run(7)
@@ -115,6 +129,58 @@ class TestDistributed:
         validate.check_distances(
             eng2.finish(st2, with_parents=False).distance, golden
         )
+
+    def test_interrupted_sharded_save_preserves_previous(
+        self, tmp_path, engines, random_small
+    ):
+        # A crash mid-save must leave the previous checkpoint loadable: new
+        # shards go to the inactive generation subdir and meta.json flips
+        # only after the set is complete.
+        eng, _ = engines
+        st = eng.advance(eng.start(1), levels=1)
+        d = str(tmp_path / "gen")
+        ckpt_mod.save_checkpoint_sharded(d, st, num_shards=2)
+        st2 = eng.advance(st, levels=1)
+        # Simulate the crash: the second save wrote one shard into the
+        # other generation and died before flipping meta.json.
+        v = len(st2.frontier)
+        cpk = -(-v // 2)
+        os.makedirs(os.path.join(d, "gen_b"), exist_ok=True)
+        ckpt_mod._atomic_savez(
+            os.path.join(d, "gen_b", "shard_00000.npz"),
+            level=st2.level,
+            frontier=st2.frontier[:cpk],
+            visited=st2.visited[:cpk],
+            distance=st2.distance[:cpk],
+        )
+        back = ckpt_mod.load_checkpoint_sharded(d)
+        assert back.level == st.level
+        np.testing.assert_array_equal(back.distance, st.distance)
+        # And a completed re-save then flips cleanly to the new state.
+        ckpt_mod.save_checkpoint_sharded(d, st2, num_shards=2)
+        back2 = ckpt_mod.load_checkpoint_sharded(d)
+        assert back2.level == st2.level
+        np.testing.assert_array_equal(back2.distance, st2.distance)
+
+    def test_torn_sharded_checkpoint_detected(self, tmp_path, engines, random_small):
+        # Defense in depth: if a generation dir somehow mixes levels (e.g.
+        # hand-copied files), the per-shard level tag catches it.
+        eng, _ = engines
+        st = eng.advance(eng.start(1), levels=1)
+        d = str(tmp_path / "torn")
+        ckpt_mod.save_checkpoint_sharded(d, st, num_shards=2)
+        st2 = eng.advance(st, levels=1)
+        v = len(st2.frontier)
+        cpk = -(-v // 2)
+        ckpt_mod._atomic_savez(
+            os.path.join(d, "gen_a", "shard_00001.npz"),
+            level=st2.level,
+            frontier=st2.frontier[cpk:],
+            visited=st2.visited[cpk:],
+            distance=st2.distance[cpk:],
+        )
+        with pytest.raises(ValueError, match="torn"):
+            ckpt_mod.load_checkpoint_sharded(d)
 
     def test_cross_engine_portability(self, engines, random_small):
         # A checkpoint taken on the single-chip engine resumes on the

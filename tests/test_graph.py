@@ -91,6 +91,27 @@ def test_rmat_skew():
     assert g.degrees.max() > 8 * g.degrees.mean()
 
 
+def test_rmat_rejects_bad_quadrants():
+    # d = 1-a-b-c must stay positive or c_norm divides by zero; both impls
+    # (and native/rmat.cpp rc=3) share this guard.
+    nan = float("nan")
+    for bad in (
+        {"a": 0.0}, {"b": -0.1}, {"c": -0.1}, {"a": 0.6, "b": 0.4},
+        {"a": nan}, {"b": nan}, {"c": nan},
+    ):
+        with pytest.raises(ValueError):
+            rmat_edges(6, 2, seed=1, **bad)
+
+
+def test_native_rmat_rejects_bad_quadrants():
+    from tpu_bfs.utils import native
+
+    if not native.has_rmat():
+        pytest.skip("native library not built")
+    with pytest.raises(ValueError, match="rc=3"):
+        native.rmat_edges_native(6, 2 << 6, 1, 0.6, 0.4, 0.0)
+
+
 def test_npz_roundtrip(tmp_path, toy_graph):
     p = str(tmp_path / "g.npz")
     gio.save_npz(p, toy_graph)

@@ -192,8 +192,8 @@ def main(argv=None) -> int:
     ap.add_argument("--profile-dir", default=None,
                     help="write a jax.profiler trace of the timed run here")
     ap.add_argument("--ckpt", default=None, metavar="PATH",
-                    help="checkpoint the traversal state to PATH.npz every "
-                    "--ckpt-every levels (single-source modes)")
+                    help="checkpoint the traversal state to PATH (npz "
+                    "format) every --ckpt-every levels (single-source modes)")
     ap.add_argument("--ckpt-every", type=int, default=4, metavar="N",
                     help="levels per checkpoint chunk (default 4)")
     ap.add_argument("--resume", default=None, metavar="PATH",

@@ -68,6 +68,11 @@ def rmat_edges(
     rng = np.random.default_rng(seed)
     if impl not in ("auto", "numpy", "native"):
         raise ValueError(f"unknown impl {impl!r}")
+    if not (a > 0 and b >= 0 and c >= 0 and a + b + c < 1):
+        # d = 1-a-b-c must stay positive; a+b >= 1 makes c_norm a division
+        # by zero. Phrased positively so NaN quadrants fail too (NaN makes
+        # every comparison False). Same guard as native/rmat.cpp rc=3.
+        raise ValueError(f"invalid RMAT quadrants a={a} b={b} c={c}")
     uv = None
     if impl in ("auto", "native"):
         from tpu_bfs.utils.native import rmat_edges_native

@@ -66,9 +66,28 @@ def initial_checkpoint(num_vertices: int, source: int) -> BfsCheckpoint:
     )
 
 
+def _atomic_savez(path: str, **arrays) -> None:
+    """savez_compressed to exactly ``path``, atomically.
+
+    A file handle (not a bare path) stops ``np.savez_compressed`` from
+    appending ``.npz`` — which would make ``--ckpt state`` save ``state.npz``
+    while ``--resume state`` opens ``state`` and fails. Writing to a sibling
+    temp file and ``os.replace``-ing keeps the previous good checkpoint
+    intact if the process dies mid-save — the exact failure checkpointing
+    exists to survive."""
+    tmp = f"{path}.tmp"
+    try:
+        with open(tmp, "wb") as f:
+            np.savez_compressed(f, **arrays)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
 def save_checkpoint(path: str, ckpt: BfsCheckpoint) -> None:
-    """Write a checkpoint as one ``.npz`` file."""
-    np.savez_compressed(
+    """Write a checkpoint as one ``.npz`` file, at exactly ``path``."""
+    _atomic_savez(
         path,
         version=_STATE_VERSION,
         source=ckpt.source,
@@ -107,23 +126,45 @@ def save_checkpoint_sharded(dirpath: str, ckpt: BfsCheckpoint, num_shards: int) 
         raise ValueError(f"num_shards={num_shards} exceeds vertex count {v}")
     cpk = -(-v // num_shards)
     os.makedirs(dirpath, exist_ok=True)
+    # Two-generation layout: the complete new shard set is written into the
+    # inactive generation subdir, and only then does meta.json (written
+    # atomically, last) flip to point at it. A crash anywhere mid-save
+    # leaves the previous generation untouched and still referenced — the
+    # prior checkpoint survives, which is the whole point of checkpointing.
+    # Every shard also embeds its level; load cross-checks it against meta
+    # so any inconsistency fails loudly instead of mixing levels' slices.
+    meta_path = os.path.join(dirpath, "meta.json")
+    prev_gen = None
+    if os.path.exists(meta_path):
+        try:
+            with open(meta_path) as f:
+                prev_gen = json.load(f).get("generation")
+        except (OSError, json.JSONDecodeError):
+            prev_gen = None
+    gen = "gen_b" if prev_gen == "gen_a" else "gen_a"
+    gen_dir = os.path.join(dirpath, gen)
+    os.makedirs(gen_dir, exist_ok=True)
     meta = {
         "version": _STATE_VERSION,
         "source": int(ckpt.source),
         "level": int(ckpt.level),
         "num_vertices": v,
         "num_shards": num_shards,
+        "generation": gen,
     }
-    with open(os.path.join(dirpath, "meta.json"), "w") as f:
-        json.dump(meta, f)
     for k in range(num_shards):
         sl = slice(k * cpk, min((k + 1) * cpk, v))
-        np.savez_compressed(
-            os.path.join(dirpath, f"shard_{k:05d}.npz"),
+        _atomic_savez(
+            os.path.join(gen_dir, f"shard_{k:05d}.npz"),
+            level=ckpt.level,
             frontier=ckpt.frontier[sl],
             visited=ckpt.visited[sl],
             distance=ckpt.distance[sl],
         )
+    tmp = meta_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+    os.replace(tmp, meta_path)
 
 
 def load_checkpoint_sharded(dirpath: str) -> BfsCheckpoint:
@@ -136,10 +177,21 @@ def load_checkpoint_sharded(dirpath: str) -> BfsCheckpoint:
         meta = json.load(f)
     if int(meta["version"]) != _STATE_VERSION:
         raise ValueError(f"unsupported checkpoint version {meta['version']}")
+    # Generation layout; checkpoints written before it load from the flat dir.
+    shard_dir = os.path.join(dirpath, meta["generation"]) if "generation" in meta else dirpath
     parts = [
-        np.load(os.path.join(dirpath, f"shard_{k:05d}.npz"))
+        np.load(os.path.join(shard_dir, f"shard_{k:05d}.npz"))
         for k in range(int(meta["num_shards"]))
     ]
+    for k, p in enumerate(parts):
+        # Shards written before this field existed load as level-consistent.
+        lvl = int(p["level"]) if "level" in p.files else int(meta["level"])
+        if lvl != int(meta["level"]):
+            raise ValueError(
+                f"torn sharded checkpoint: shard {k} is from level {lvl} "
+                f"but meta.json records level {meta['level']} — the save "
+                f"was interrupted; re-checkpoint before resuming"
+            )
     ckpt = BfsCheckpoint(
         source=int(meta["source"]),
         level=int(meta["level"]),
@@ -159,7 +211,7 @@ def save_result(path: str, res) -> None:
     (SURVEY.md §5); this is the ``--save-dist``/``--save-parent`` capability
     in one artifact with provenance fields.
     """
-    np.savez_compressed(
+    _atomic_savez(
         path,
         version=_STATE_VERSION,
         source=res.source,

@@ -17,15 +17,22 @@ _LIB = None
 _TRIED = False
 
 
+def _native_dir() -> str:
+    """``native/`` at the repo root (three levels up from this file)."""
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        "native",
+    )
+
+
 def _find_lib():
     global _LIB, _TRIED
     if _TRIED:
         return _LIB
     _TRIED = True
-    here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     for cand in (
-        os.path.join(here, "native", "build", "libtpubfs.so"),
-        os.path.join(here, "native", "libtpubfs.so"),
+        os.path.join(_native_dir(), "build", "libtpubfs.so"),
+        os.path.join(_native_dir(), "libtpubfs.so"),
     ):
         if os.path.exists(cand):
             try:
@@ -69,8 +76,43 @@ def _find_lib():
     return _LIB
 
 
+def ensure_built(log=None) -> None:
+    """Best-effort ``make -C native`` so a fresh (or stale) checkout gets the
+    fast paths. make itself is the up-to-date check (~ms when current).
+
+    Must run before the first library lookup in the process: the ctypes
+    handle is cached on first use and a replaced .so does not affect an
+    already-loaded image. ``log`` (a callable taking one string) receives a
+    diagnostic when the build fails; callers then fall back to NumPy paths
+    via ``available()``/``has_rmat()``.
+    """
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            ["make", "-C", _native_dir()],
+            capture_output=True, timeout=120, check=False, text=True,
+        )
+        if proc.returncode != 0 and log is not None:
+            log(
+                f"native build failed (rc={proc.returncode}); falling back "
+                f"to numpy paths: {proc.stderr.strip()[-300:]}"
+            )
+    except (OSError, subprocess.TimeoutExpired) as exc:
+        if log is not None:
+            log(f"native build skipped: {exc}")
+
+
 def available() -> bool:
     return _find_lib() is not None
+
+
+def has_rmat() -> bool:
+    """True iff the loaded library exports the RMAT generator — a stale
+    prebuilt .so can load fine yet predate tpubfs_rmat_edges, in which case
+    ``rmat_graph(impl='native')`` would raise instead of generating."""
+    lib = _find_lib()
+    return lib is not None and getattr(lib, "tpubfs_rmat_edges", None) is not None
 
 
 def load_edge_list_native(path: str, *, directed: bool = False, drop_self_loops: bool = False):

@@ -131,19 +131,24 @@ def bench_hybrid(g, scale: int, ef: int) -> dict:
     Falls back to the gather-only wide engine when the graph's packed state
     cannot fit 4096 lanes next to the dense tiles (the Pallas kernel only
     exists at w=128)."""
-    from tpu_bfs.algorithms._packed_common import auto_lanes
+    from tpu_bfs.algorithms._packed_common import auto_lanes, auto_planes
     from tpu_bfs.algorithms.msbfs_hybrid import (
         LANES,
         HybridMsBfsEngine,
         LanesDontFitError,
     )
+    from tpu_bfs.graph.ell import rank_vertices
 
     # Cheap pre-check with conservative fixed-resident estimates, so a graph
     # that clearly cannot fit 4096 lanes skips the minutes-long hybrid build.
-    rows = (-(-(g.num_vertices + 1) // 128)) * 128
-    est = auto_lanes(
-        rows, 5, fixed_bytes=int(0.2e9) + int(g.num_edges * 4.4)
-    )
+    # Mirrors the engine's own sizing: tables cover only non-isolated rows,
+    # and the plane count adapts (5 preferred, 4 buys one more scale step).
+    src, dst = g.coo
+    _, num_active, _, _ = rank_vertices(src, dst, g.num_vertices)
+    rows = (-(-(num_active + 1) // 128)) * 128
+    fixed = int(0.2e9) + int(g.num_edges * 4.4)
+    planes = auto_planes(rows, fixed_bytes=fixed)
+    est = auto_lanes(rows, planes, fixed_bytes=fixed)
     if est < LANES:
         log(f"hybrid needs {LANES} lanes, only {est} fit; using wide engine")
         return bench_wide(g, scale, ef)

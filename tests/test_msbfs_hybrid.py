@@ -89,6 +89,18 @@ def test_hybrid_budget_trims_tiles(rmat_small):
     assert np.bitwise_count(trimmed.a_tiles).sum() == np.sort(per_tile_full)[-2:].sum()
 
 
+def test_hybrid_isolated_source(random_disconnected):
+    # Tables trim to non-isolated rows; an isolated source has no device
+    # row and its lane is patched host-side: component == {source}.
+    g = random_disconnected
+    iso = np.flatnonzero(g.degrees == 0)
+    assert len(iso) >= 2
+    engine = HybridMsBfsEngine(g, tile_thr=2)
+    assert engine._act < g.num_vertices
+    res = _check_lanes(g, engine, [int(iso[0]), 0, int(iso[1])])
+    assert res.reached[0] == 1 and res.edges_traversed[0] == 0
+
+
 def test_hybrid_disconnected(random_disconnected):
     engine = HybridMsBfsEngine(random_disconnected, tile_thr=2)
     res = _check_lanes(random_disconnected, engine, [0, 5, 9])

@@ -59,6 +59,18 @@ def test_packed_disconnected(random_disconnected):
     assert (res.distance_u8 == UNREACHED).any()  # isolated vertices exist
 
 
+def test_packed_isolated_source(random_disconnected):
+    # Tables are trimmed to non-isolated rows; an isolated source has no
+    # device row and its lane is patched host-side: component == {source}.
+    g = random_disconnected
+    iso = np.flatnonzero(g.degrees == 0)
+    assert len(iso) >= 2
+    engine = PackedMsBfsEngine(g, lanes=32)
+    assert engine.ell.num_active < g.num_vertices
+    res = _check_lanes(g, engine, [int(iso[0]), 0, int(iso[1])])
+    assert res.reached[0] == 1 and res.edges_traversed[0] == 0
+
+
 def test_packed_deep_graph(line_graph):
     # 64-vertex path: one-vertex frontiers, max level depth per lane.
     engine = PackedMsBfsEngine(line_graph, lanes=32)

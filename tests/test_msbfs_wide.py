@@ -44,6 +44,30 @@ def test_wide_disconnected(random_disconnected):
     assert (res.distance_u8_lane(0) == UNREACHED).any()
 
 
+def test_wide_isolated_source(random_disconnected):
+    # Tables trim to non-isolated rows; an isolated source has no device
+    # row and its lane is patched host-side: component == {source}.
+    g = random_disconnected
+    iso = np.flatnonzero(g.degrees == 0)
+    assert len(iso) >= 2
+    engine = WidePackedMsBfsEngine(g)
+    assert engine._act < g.num_vertices
+    res = _check_lanes(g, engine, [int(iso[0]), 0, int(iso[1])])
+    assert res.reached[0] == 1 and res.edges_traversed[0] == 0
+
+
+def test_auto_planes_selection():
+    # At scale-22-like active row counts, 5 planes no longer fit 4096 lanes
+    # in the 14 GB model but 4 do; at scale-21-like counts 5 fit; when
+    # nothing fits at full width, prefer depth (the engine lowers lanes or
+    # falls back instead).
+    from tpu_bfs.algorithms._packed_common import auto_planes
+
+    assert auto_planes(2_400_000, fixed_bytes=int(0.5e9)) == 4
+    assert auto_planes(1_250_000, fixed_bytes=int(0.5e9)) == 5
+    assert auto_planes(10**9) == 5
+
+
 def test_wide_lane_word_boundaries(random_small):
     # Lanes in different 32-lane words use separate lazy extractions.
     rng = np.random.default_rng(1)

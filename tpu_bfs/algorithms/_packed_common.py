@@ -54,6 +54,40 @@ def auto_lanes(
     return 32 * w
 
 
+def auto_planes(
+    rows: int,
+    *,
+    fixed_bytes: int = 0,
+    hbm_budget_bytes: int = int(14.0e9),
+    preferred: int = 5,
+    min_planes: int = 4,
+    max_lanes: int = 4096,
+) -> int:
+    """Largest plane count <= ``preferred`` whose packed state still fits
+    ``max_lanes`` lanes in the HBM budget (same memory model as
+    :func:`auto_lanes`).
+
+    Each plane halves-or-doubles nothing about correctness — it bounds the
+    traversal depth at 2**planes levels — so trading planes for lanes is the
+    right call on low-diameter (power-law) graphs: 4 planes still label 16
+    levels, ample for RMAT/social graphs, while keeping the full 4096-lane
+    batch at one scale step larger than ``preferred`` planes would allow.
+    When even ``min_planes`` cannot reach ``max_lanes``, returns
+    ``preferred`` — depth capacity is worth more than lanes once the width
+    has to shrink anyway (the engine then lowers lanes or falls back).
+    """
+    for p in range(preferred, min_planes - 1, -1):
+        if (
+            auto_lanes(
+                rows, p, fixed_bytes=fixed_bytes,
+                hbm_budget_bytes=hbm_budget_bytes, max_lanes=max_lanes,
+            )
+            == max_lanes
+        ):
+            return p
+    return preferred
+
+
 class ExpandSpec(NamedTuple):
     """Shape metadata of a bucketed-ELL expansion (see graph/ell.py)."""
 
@@ -127,9 +161,36 @@ def expand_arrays(ell_like) -> dict:
     return arrs
 
 
-def make_state_kernels(v: int, rows: int, w: int, num_planes: int):
+def seed_scatter_args(rows_of_sources: np.ndarray, act: int):
+    """(rows, words, bits) device args for word-major lane seeding.
+
+    ``rows_of_sources`` maps each batch entry to its table row; entries with
+    no row (>= ``act`` — isolated sources) get their bit zeroed (a 0-OR is a
+    no-op) and the row clamped, and run_packed_batch patches their lane
+    results host-side. One copy of the protocol for every packed engine.
+    """
+    ranks = rows_of_sources.astype(np.int64)
+    lanes = np.arange(len(ranks), dtype=np.int32)
+    words = (lanes // 32).astype(np.int32)
+    bits = np.uint32(1) << (lanes % 32).astype(np.uint32)
+    keep = ranks < act
+    return (
+        jnp.asarray(np.where(keep, ranks, 0).astype(np.int32)),
+        jnp.asarray(words),
+        jnp.asarray(np.where(keep, bits, np.uint32(0))),
+    )
+
+
+def make_state_kernels(
+    v: int, rows: int, w: int, num_planes: int, *, active: int | None = None
+):
     """Jitted (seed, lane_stats, extract_word) over a [rows, w] packed table
-    whose first ``v`` rows are real vertices (in rank order)."""
+    whose first ``act`` rows are real vertices (in rank order).
+
+    ``active`` (default: v) is the number of real rows when the table is
+    trimmed to non-isolated vertices; stats and extraction scan only those.
+    """
+    act = v if active is None else min(active, v)
 
     @jax.jit
     def seed(rws, words, bits):
@@ -148,10 +209,10 @@ def make_state_kernels(v: int, rows: int, w: int, num_planes: int):
 
         def wbody(wi, acc):
             r_acc, d_acc = acc
-            col = jax.lax.dynamic_slice(vis, (0, wi), (rows, 1))[:v]  # [v,1]
-            bits = (col >> shifts) & 1  # [v, 32] u32
+            col = jax.lax.dynamic_slice(vis, (0, wi), (rows, 1))[:act]  # [act,1]
+            bits = (col >> shifts) & 1  # [act, 32] u32
             rr = jnp.sum(bits.astype(jnp.int32), axis=0)
-            dd = jnp.sum(bits.astype(jnp.float32) * in_deg[:, None], axis=0)
+            dd = jnp.sum(bits.astype(jnp.float32) * in_deg[:act, None], axis=0)
             return (
                 jax.lax.dynamic_update_slice(r_acc, rr[None], (wi, 0)),
                 jax.lax.dynamic_update_slice(d_acc, dd[None], (wi, 0)),
@@ -163,16 +224,16 @@ def make_state_kernels(v: int, rows: int, w: int, num_planes: int):
 
     @jax.jit
     def extract_word(planes, vis, src_bits, wi):
-        """Distances of word-column wi's 32 lanes as [v, 32] uint8."""
+        """Distances of word-column wi's 32 lanes as [act, 32] uint8."""
         shifts = jnp.arange(32, dtype=jnp.uint32)
-        cnt = jnp.zeros((v, 32), jnp.uint8)
+        cnt = jnp.zeros((act, 32), jnp.uint8)
         for i, p in enumerate(planes):
-            col = jax.lax.dynamic_slice(p, (0, wi), (rows, 1))[:v]
+            col = jax.lax.dynamic_slice(p, (0, wi), (rows, 1))[:act]
             bit = ((col >> shifts) & 1).astype(jnp.uint8)
             cnt = cnt + (bit << i)
-        visw = ((jax.lax.dynamic_slice(vis, (0, wi), (rows, 1))[:v] >> shifts) & 1) != 0
+        visw = ((jax.lax.dynamic_slice(vis, (0, wi), (rows, 1))[:act] >> shifts) & 1) != 0
         srcw = (
-            (jax.lax.dynamic_slice(src_bits, (0, wi), (rows, 1))[:v] >> shifts) & 1
+            (jax.lax.dynamic_slice(src_bits, (0, wi), (rows, 1))[:act] >> shifts) & 1
         ) != 0
         return jnp.where(
             srcw, jnp.uint8(0), jnp.where(visw, cnt + jnp.uint8(1), UNREACHED)
@@ -199,6 +260,9 @@ class PackedBatchResult:
     _planes: tuple
     _vis: jax.Array
     _src_bits: jax.Array
+    # Lanes whose source is an isolated vertex (no table row; traversal is
+    # trivially {source}); None when the engine's tables cover all vertices.
+    _iso: np.ndarray | None = None
     _word_cache: dict = dataclasses.field(default_factory=dict)
 
     @property
@@ -215,10 +279,26 @@ class PackedBatchResult:
         if not (0 <= i < len(self.sources)):
             raise IndexError(i)
         eng = self._engine
+        if self._iso is not None and self._iso[i]:
+            # Isolated source: never seeded on device; its component is {src}.
+            d = np.full(eng.num_vertices, UNREACHED, np.uint8)
+            d[self.sources[i]] = 0
+            return d
         wi, col = eng._word_col(i)
         if wi not in self._word_cache:
-            dr = eng._extract_word(self._planes, self._vis, self._src_bits, wi)
-            self._word_cache[wi] = np.asarray(dr)[eng._rank]  # old-id order
+            dr = np.asarray(
+                eng._extract_word(self._planes, self._vis, self._src_bits, wi)
+            )
+            act = getattr(eng, "_act", None)
+            if act is not None:
+                # Trimmed tables: a vertex has a row iff _rank[v] < _act;
+                # isolated vertices map past the end and stay UNREACHED.
+                full = np.full((eng.num_vertices, 32), UNREACHED, np.uint8)
+                m = eng._rank < act
+                full[m] = dr[eng._rank[m]]
+            else:
+                full = dr[eng._rank]  # old-id order
+            self._word_cache[wi] = full
         return self._word_cache[wi][:, col]
 
     def distances_int32(self, i: int) -> np.ndarray:
@@ -266,6 +346,15 @@ def run_packed_batch(
     slot_sum = engine._lane_order(np.asarray(d, dtype=np.float64))[:s]
     edges = (slot_sum / 2 if engine.undirected else slot_sum).astype(np.int64)
 
+    # Lanes seeded at isolated sources have no device row: the table scan
+    # sees nothing, but the source itself is trivially reached.
+    iso = getattr(engine, "_iso_of", lambda s: None)(sources)
+    if iso is not None and iso.any():
+        reached[iso] = 1
+        edges[iso] = 0
+    else:
+        iso = None
+
     # Engines whose result tables use a different row order than their seed
     # table (the distributed wide engine) provide a converting view.
     src_bits = getattr(engine, "_src_bits_view", lambda x: x)(fw0)
@@ -279,6 +368,7 @@ def run_packed_batch(
         _planes=planes,
         _vis=vis,
         _src_bits=src_bits,
+        _iso=iso,
     )
     # The loop's last body found an empty frontier iff not alive; then the
     # max eccentricity is one less than the body count.

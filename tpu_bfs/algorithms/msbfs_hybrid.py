@@ -14,7 +14,8 @@ This engine splits the graph once at build time:
 - **residual part**: everything else, expanded by the same bucketed-ELL
   fori-loop gathers as the wide engine.
 
-Row space is "rank0" order (descending full in-degree) padded to VT*128 rows
+Row space is "rank0" order (active vertices first, by descending full
+in-degree; isolated vertices get no row at all) padded to VT*128 rows
 so the dense kernel's frontier DMAs are contiguous slabs. The residual ELL
 buckets rows by *residual* degree, so its outputs come out in a different
 (bucket) order; one static permutation gather per level routes them back to
@@ -30,8 +31,8 @@ entries are assigned to lanes.
 Reference mapping: this is the capability of the reference's whole kernel
 layer (queueBfs, bfs.cu:134-165; multiBfs, bfs.cu:101-130) re-planned around
 the TPU's MXU/VPU split instead of CUDA thread divergence. Measured flagship:
-38-42 GTEPS harmonic-mean per-source on RMAT scale-21 (the range spans the
-two generator streams' graph instances), 1 v5e chip — see BENCHMARKS.md.
+45.3 GTEPS harmonic-mean per-source on RMAT scale-21 (37.0 at scale 22 with
+auto-traded planes), 1 v5e chip — see BENCHMARKS.md.
 """
 
 from __future__ import annotations
@@ -43,15 +44,17 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpu_bfs.graph.csr import Graph
-from tpu_bfs.graph.ell import EllBucket, bucketize_rows, rank_by_in_degree
+from tpu_bfs.graph.ell import EllBucket, bucketize_rows, rank_vertices
 from tpu_bfs.algorithms.msbfs_packed import ripple_increment
 from tpu_bfs.algorithms._packed_common import (
     ExpandSpec,
     auto_lanes,
+    auto_planes,
     expand_arrays,
     make_fori_expand,
     make_state_kernels,
     run_packed_batch,
+    seed_scatter_args,
 )
 from tpu_bfs.ops.tile_spmm import AW, TILE, tile_spmm
 
@@ -79,6 +82,7 @@ class HybridGraph:
     num_edges: int
     undirected: bool
     kcap: int
+    num_active: int  # non-isolated vertices; ranks >= num_active have no row
     vt: int  # frontier slabs of 128 rows; table height = vt * 128
     old_of_new: np.ndarray  # [V] int32
     rank: np.ndarray  # [V] int32
@@ -201,9 +205,13 @@ def build_hybrid(
     their edges cost as gathers."""
     v = g.num_vertices
     src, dst = g.coo
-    in_deg, rank_order, rank = rank_by_in_degree(dst, v)
+    in_deg, num_active, rank_order, rank = rank_vertices(src, dst, v)
 
-    vt = -(-(v + 1) // TILE)
+    # Table height covers only active (non-isolated) rows + the sentinel:
+    # on RMAT graphs ~40% of vertices are isolated, and every [rows, w]
+    # state table was paying for them. All edge endpoints rank < num_active
+    # by construction, so tiles and residual gathers are unaffected.
+    vt = -(-(num_active + 1) // TILE)
     r = rank[dst]  # int32 rank ids
     c = rank[src]
     dense_edge, dense_uniq, tid = select_dense_tiles(
@@ -255,6 +263,7 @@ def build_hybrid(
         num_edges=g.num_edges,
         undirected=g.undirected,
         kcap=kcap,
+        num_active=num_active,
         vt=vt,
         old_of_new=rank_order,
         rank=rank,
@@ -340,15 +349,14 @@ class HybridMsBfsEngine:
         kcap: int = 64,
         tile_thr: int = 64,
         a_budget_bytes: int = int(0.2e9),
-        num_planes: int = 5,
+        num_planes: int | str = "auto",
         interpret: bool | None = None,
         undirected: bool | None = None,
         hbm_budget_bytes: int = int(14.0e9),
     ):
-        if not (1 <= num_planes <= 8):
+        if num_planes != "auto" and not (1 <= num_planes <= 8):
+            # Validate the explicit case before the minutes-long build.
             raise ValueError("num_planes must be in [1, 8]")
-        self.num_planes = num_planes
-        self.max_levels_cap = min(1 << num_planes, 254)
         if interpret is None:
             interpret = jax.default_backend() != "tpu"
         self.hg = (
@@ -359,14 +367,30 @@ class HybridMsBfsEngine:
             else graph
         )
         hg = self.hg
+        res_slots = (
+            hg.res_virtual.idx.size if hg.res_virtual is not None else 0
+        ) + sum(b.idx.size for b in hg.res_light)
+        fixed_bytes = hg.a_tiles.nbytes + int(res_slots * 4.4)
+        if num_planes == "auto":
+            # Trade depth capacity (2**planes levels) for batch width: on a
+            # graph one scale step too big for 5 planes at 4096 lanes, 4
+            # planes (16 levels — ample for power-law graphs) keeps the
+            # dense MXU path instead of falling off to the gather engine.
+            num_planes = auto_planes(
+                hg.vt * TILE,
+                fixed_bytes=fixed_bytes,
+                hbm_budget_bytes=hbm_budget_bytes,
+                max_lanes=LANES,
+            )
+        if not (1 <= num_planes <= 8):
+            raise ValueError("num_planes must be in [1, 8]")
+        self.num_planes = num_planes
+        self.max_levels_cap = min(1 << num_planes, 254)
         if lanes == "auto":
-            res_slots = (
-                hg.res_virtual.idx.size if hg.res_virtual is not None else 0
-            ) + sum(b.idx.size for b in hg.res_light)
             lanes = auto_lanes(
                 hg.vt * TILE,
                 num_planes,
-                fixed_bytes=hg.a_tiles.nbytes + int(res_slots * 4.4),
+                fixed_bytes=fixed_bytes,
                 hbm_budget_bytes=hbm_budget_bytes,
                 max_lanes=LANES,
             )
@@ -391,9 +415,11 @@ class HybridMsBfsEngine:
             arrs["col_tile"] = jnp.asarray(hg.col_tile)
             arrs["a_tiles"] = jnp.asarray(hg.a_tiles)
         self.arrs = arrs
+        self._act = hg.num_active
         self._core = _make_core(hg, self.w, num_planes, interpret)
         self._seed, self._lane_stats, self._extract_word = make_state_kernels(
-            hg.num_vertices, hg.vt * TILE, self.w, num_planes
+            hg.num_vertices, hg.vt * TILE, self.w, num_planes,
+            active=self._act,
         )
         self._rank = hg.rank
         self._in_deg_ranked = jnp.asarray(
@@ -415,12 +441,11 @@ class HybridMsBfsEngine:
     def _lane_order(mat: np.ndarray) -> np.ndarray:
         return mat.reshape(-1)
 
+    def _iso_of(self, sources: np.ndarray):
+        return self.hg.rank[sources] >= self._act
+
     def _seed_dev(self, sources: np.ndarray):
-        ranks = self.hg.rank[sources].astype(np.int32)
-        lanes = np.arange(len(sources), dtype=np.int32)
-        words = (lanes // 32).astype(np.int32)
-        bits = np.uint32(1) << (lanes % 32).astype(np.uint32)
-        return self._seed(jnp.asarray(ranks), jnp.asarray(words), jnp.asarray(bits))
+        return self._seed(*seed_scatter_args(self.hg.rank[sources], self._act))
 
     def run(self, sources, *, max_levels=None, time_it=False, check_cap=True):
         return run_packed_batch(

@@ -120,7 +120,9 @@ def ripple_increment(planes, carry_bits):
 def _make_core(ell: EllGraph, w: int):
     """Build the jitted level loop for one ELL structure; arrays are passed as
     a pytree so they live on device once and never get baked into the HLO."""
-    v = ell.num_vertices
+    # Tables cover active rows only; isolated vertices (rank >= num_active)
+    # have no row — the engine patches their lanes host-side.
+    act = ell.num_active
     expand = make_packed_expand(
         w=w,
         kcap=ell.kcap,
@@ -128,12 +130,12 @@ def _make_core(ell: EllGraph, w: int):
         num_virtual=ell.num_virtual,
         light_meta=[(b.k, b.n) for b in ell.light],
         heavy=ell.num_heavy > 0,
-        tail_rows=v - ell.num_nonzero,
+        tail_rows=act - ell.num_nonzero,
     )
 
     @jax.jit
     def core(arrs, fw0, vis0, max_levels):
-        planes0 = tuple(jnp.zeros((v, w), jnp.uint32) for _ in range(8))
+        planes0 = tuple(jnp.zeros((act, w), jnp.uint32) for _ in range(8))
 
         def cond(carry):
             _, _, _, level, alive = carry
@@ -158,11 +160,11 @@ def _make_core(ell: EllGraph, w: int):
 
     @jax.jit
     def extract(planes, vis, src_bits):
-        """Unpack bit-sliced counters to per-lane uint8 distances [V, 32w]."""
+        """Unpack bit-sliced counters to per-lane uint8 distances [act, 32w]."""
         shifts = jnp.arange(32, dtype=jnp.uint32)
         cols = []
         for wi in range(w):
-            cnt = jnp.zeros((v, 32), jnp.uint8)
+            cnt = jnp.zeros((act, 32), jnp.uint8)
             for i, p in enumerate(planes):
                 bit = ((p[:, wi, None] >> shifts) & 1).astype(jnp.uint8)
                 cnt = cnt + (bit << i)
@@ -220,11 +222,12 @@ class PackedMsBfsEngine:
         return self.ell.num_vertices
 
     def _seed(self, sources: np.ndarray):
-        v = self.ell.num_vertices
-        fw0 = np.zeros((v + 1, self.w), np.uint32)
+        act = self.ell.num_active
+        fw0 = np.zeros((act + 1, self.w), np.uint32)
         ranks = self.ell.rank[sources]
         for i, r in enumerate(ranks):
-            fw0[r, i // 32] |= np.uint32(1 << (i % 32))
+            if r < act:  # isolated sources have no row; patched in run()
+                fw0[r, i // 32] |= np.uint32(1 << (i % 32))
         return fw0
 
     def run(
@@ -252,9 +255,21 @@ class PackedMsBfsEngine:
         self._warmed = True
 
         dist_rank = self._extract(planes, vis, vis0)
-        dn = np.asarray(dist_rank)  # [V, lanes], rank space
+        dn = np.asarray(dist_rank)  # [act, lanes], rank space
         s = len(sources)
-        dist = np.ascontiguousarray(dn[self.ell.rank][:, :s].T)  # [S, V], old ids
+        act = self.ell.num_active
+        v = self.ell.num_vertices
+        ranks = self.ell.rank
+        if act < v:
+            full = np.full((v, dn.shape[1]), UNREACHED, np.uint8)
+            m = ranks < act
+            full[m] = dn[ranks[m]]
+        else:
+            full = dn[ranks]
+        dist = np.ascontiguousarray(full[:, :s].T)  # [S, V], old ids
+        # Isolated sources were never seeded; their component is {source}.
+        for i in np.flatnonzero(ranks[sources] >= act):
+            dist[i, sources[i]] = 0
 
         reached_mask = dist != UNREACHED
         # Loop iterations include the final empty-frontier step; report the

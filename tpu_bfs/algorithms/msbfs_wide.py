@@ -47,6 +47,7 @@ from tpu_bfs.algorithms._packed_common import (
     make_fori_expand,
     make_state_kernels,
     run_packed_batch,
+    seed_scatter_args,
 )
 
 W = 128  # uint32 words per row: the measured v5e sweet spot (no tile padding)
@@ -58,22 +59,24 @@ from tpu_bfs.algorithms._packed_common import PackedBatchResult as WideBfsResult
 
 
 def _make_core(ell: EllGraph, w: int, num_planes: int):
-    v = ell.num_vertices
+    act = ell.num_active
     spec = ExpandSpec(
         kcap=ell.kcap,
         heavy=ell.num_heavy > 0,
         num_virtual=ell.num_virtual,
         fold_steps=ell.fold_steps,
         light_meta=tuple((b.k, b.n) for b in ell.light),
-        tail_rows=v - ell.num_nonzero + 1,  # zero-degree rows + sentinel row
+        # Zero-in-degree active rows + sentinel row. Isolated vertices get
+        # no row at all (rank space is active-first, graph/ell.py).
+        tail_rows=act - ell.num_nonzero + 1,
     )
     expand = make_fori_expand(spec, w)
 
     @jax.jit
     def core(arrs, fw0, max_levels):
-        # fw0 [v+1, w]: frontier bits; sentinel row v is all-zero and is never
-        # written (expand emits zero there, and `& ~vis` keeps it zero).
-        planes0 = tuple(jnp.zeros((v + 1, w), jnp.uint32) for _ in range(num_planes))
+        # fw0 [act+1, w]: frontier bits; sentinel row act is all-zero and is
+        # never written (expand emits zero there, and `& ~vis` keeps it zero).
+        planes0 = tuple(jnp.zeros((act + 1, w), jnp.uint32) for _ in range(num_planes))
 
         def cond(carry):
             _, _, _, level, alive = carry
@@ -137,10 +140,11 @@ class WidePackedMsBfsEngine:
         # distances up to 2**p; 254 keeps every distance below UNREACHED=255.
         self.max_levels_cap = min(1 << num_planes, 254)
         self.ell = build_ell(graph, kcap=kcap) if isinstance(graph, Graph) else graph
+        self._act = self.ell.num_active
         if lanes == "auto":
             # Halve from 4096 until the packed state fits HBM next to the ELL.
             lanes = auto_lanes(
-                self.ell.num_vertices + 1,
+                self._act + 1,
                 num_planes,
                 fixed_bytes=int(self.ell.total_slots * 4.4),
                 hbm_budget_bytes=hbm_budget_bytes,
@@ -155,7 +159,8 @@ class WidePackedMsBfsEngine:
         self.arrs = expand_arrays(ell)
         self._core = _make_core(ell, self.w, num_planes)
         self._seed, self._lane_stats, self._extract_word = make_state_kernels(
-            ell.num_vertices, ell.num_vertices + 1, self.w, num_planes
+            ell.num_vertices, self._act + 1, self.w, num_planes,
+            active=self._act,
         )
         self._rank = ell.rank
         self._in_deg_ranked = jnp.asarray(
@@ -176,12 +181,11 @@ class WidePackedMsBfsEngine:
     def _lane_order(mat: np.ndarray) -> np.ndarray:
         return mat.reshape(-1)
 
+    def _iso_of(self, sources: np.ndarray):
+        return self.ell.rank[sources] >= self._act
+
     def _seed_dev(self, sources: np.ndarray):
-        ranks = self.ell.rank[sources].astype(np.int32)
-        lanes = np.arange(len(sources), dtype=np.int32)
-        words = lanes // 32
-        bits = np.uint32(1) << (lanes % 32).astype(np.uint32)
-        return self._seed(jnp.asarray(ranks), jnp.asarray(words), jnp.asarray(bits))
+        return self._seed(*seed_scatter_args(self.ell.rank[sources], self._act))
 
     def run(self, sources, *, max_levels=None, time_it=False, check_cap=True):
         return run_packed_batch(

@@ -32,13 +32,17 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
-def _lexsort_pairs(major: np.ndarray, minor: np.ndarray, n: int) -> np.ndarray:
+def _lexsort_pairs(
+    major: np.ndarray, minor: np.ndarray, n: int, n_minor: int | None = None
+) -> np.ndarray:
     """Permutation ordering by (major, minor): native O(E) counting sort when
-    built (native/loader.cpp), np.lexsort otherwise."""
+    built (native/loader.cpp), np.lexsort otherwise. ``n``/``n_minor`` bound
+    the key value ranges (both default n); undersized bounds make the native
+    path reject and silently fall back to the O(E log E) sort."""
     try:
         from tpu_bfs.utils.native import lexsort_pairs
 
-        perm = lexsort_pairs(major, minor, n, n)
+        perm = lexsort_pairs(major, minor, n, n if n_minor is None else n_minor)
         if perm is not None:
             return perm
     except Exception:

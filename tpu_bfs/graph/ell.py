@@ -51,16 +51,19 @@ class EllGraph:
 
     Rank space: row r corresponds to original vertex ``old_of_new[r]``;
     ``rank[v]`` is the row of original vertex v. Rows [0, num_heavy) are
-    heavy (in-degree > kcap); rows [num_nonzero, V) have in-degree 0.
-    The neighbor-id sentinel is V: callers gather from a frontier table with
-    V+1 rows whose last row is all-zero. ``fold_pad_map``'s sentinel is
-    ``num_virtual`` (an appended all-zero virtual-result row).
+    heavy (in-degree > kcap); rows [num_nonzero, num_active) have in-degree
+    0 but appear as edge sources; rows >= num_active are isolated and get no
+    table row at all. The neighbor-id sentinel is ``num_active``: callers
+    gather from a frontier table of num_active+1 rows whose last row is
+    all-zero. ``fold_pad_map``'s sentinel is ``num_virtual`` (an appended
+    all-zero virtual-result row).
     """
 
     num_vertices: int
     num_edges: int  # directed edge slots represented (== sum of in-degrees)
     undirected: bool  # carried from Graph for TEPS edge accounting
     kcap: int
+    num_active: int  # rows 0..num_active are non-isolated; tables stop there
     old_of_new: np.ndarray  # [V] int32
     rank: np.ndarray  # [V] int32
     in_degree: np.ndarray  # [V] int64, original-id order
@@ -112,6 +115,62 @@ def _heavy_pick(rp2, pstart, m2: int, fold_steps: int) -> np.ndarray:
         lvl_offset[s] = off
         off += m2 >> s
     return (lvl_offset[lvl] + (pstart >> lvl)).astype(np.int32)
+
+
+def pad_heavy_shards(hlens_list, flat_list, kcap: int, sentinel: int):
+    """Common-shape heavy sections across shards.
+
+    Each shard's heavy rows (``hlens_list[p]``, non-increasing, with
+    concatenated neighbor lists ``flat_list[p]``) split into kcap-wide
+    virtual rows plus an aligned-power-of-two fold pyramid — the same layout
+    as :func:`bucketize_rows` — but every shape is padded to the maximum
+    across shards so one jitted program serves all shards under shard_map.
+    ``m2`` always includes a padded level-0 slot, so shards with fewer heavy
+    rows can pad ``heavy_pick`` safely (a padded pick lands on an all-zero
+    pyramid slot; padded output rows are never selected downstream anyway).
+
+    Returns ``(nh, num_virtual, fold_steps, m2, virtual [P, M, kcap],
+    fold_pad_map [P, m2], heavy_pick [P, nh])``, or all-zeros/None shapes
+    when no shard has heavy rows (``nh == 0``).
+    """
+    nh = max((len(h) for h in hlens_list), default=0)
+    if nh == 0:
+        return 0, 0, 0, 0, None, None, None
+    r_per_all = [np.maximum(-(-h // kcap), 1) for h in hlens_list]
+    num_virtual = max(max((int(r.sum()) for r in r_per_all), default=1), 1)
+    rp2_all = [
+        1 << np.ceil(np.log2(r)).astype(np.int64)
+        if len(r)
+        else np.zeros(0, np.int64)
+        for r in r_per_all
+    ]
+    fold_steps = max((int(np.log2(r[0])) for r in rp2_all if len(r)), default=0)
+    block = 1 << fold_steps
+    m2 = _round_up(max((int(r.sum()) for r in rp2_all), default=0) + 1, block)
+    v_parts, f_parts, h_parts = [], [], []
+    for hlens, flat, r_per, rp2 in zip(hlens_list, flat_list, r_per_all, rp2_all):
+        n_h = len(hlens)
+        vlens = np.zeros(num_virtual, dtype=np.int64)
+        fpm = np.full(m2, num_virtual, dtype=np.int32)
+        hpick = np.zeros(nh, dtype=np.int32)
+        if n_h:
+            m_p = int(r_per.sum())
+            vlens[:m_p] = kcap
+            vr_last = np.cumsum(r_per) - 1
+            vlens[vr_last] = hlens - kcap * (r_per - 1)
+            pstart = np.concatenate([[0], np.cumsum(rp2)[:-1]]).astype(np.int64)
+            vr_start = vr_last - r_per + 1
+            fpm[_flat_positions(pstart, r_per)] = _flat_positions(
+                vr_start, r_per
+            ).astype(np.int32)
+            hpick[:n_h] = _heavy_pick(rp2, pstart, m2, fold_steps)
+        v_parts.append(_ell_fill(vlens, flat, kcap, sentinel))
+        f_parts.append(fpm)
+        h_parts.append(hpick)
+    return (
+        nh, num_virtual, fold_steps, m2,
+        np.stack(v_parts), np.stack(f_parts), np.stack(h_parts),
+    )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -191,40 +250,17 @@ def build_ell_sharded(g: Graph, num_shards: int, *, kcap: int = 64) -> ShardedEl
     num_virtual = m2 = fold_steps = 0
     heavy_per_shard = h_bound // p_count
     if h_bound:
-        per_shard = []
+        hlens_list, flat_list = [], []
         for p in range(p_count):
             rows = shard_rows(0, h_bound, p)
-            hlens = lens[rows]
-            r_per = np.maximum(-(-hlens // kcap), 1)
-            per_shard.append((rows, hlens, r_per))
-        num_virtual = max(int(t[2].sum()) for t in per_shard)
-        rp2_all = [
-            (1 << np.ceil(np.log2(r_per)).astype(np.int64)) for _, _, r_per in per_shard
-        ]
-        fold_steps = max(int(np.log2(rp2[0])) if len(rp2) else 0 for rp2 in rp2_all)
-        m2 = _round_up(
-            max(int(rp2.sum()) for rp2 in rp2_all), max(1 << fold_steps, 1)
-        )
-        v_parts, f_parts, h_parts = [], [], []
-        for (rows, hlens, r_per), rp2 in zip(per_shard, rp2_all):
-            m_p = int(r_per.sum())
-            vlens = np.zeros(num_virtual, dtype=np.int64)
-            vlens[:m_p] = kcap
-            vr_last = np.cumsum(r_per) - 1
-            vlens[vr_last] = hlens - kcap * (r_per - 1)
-            flat = nbrs[_flat_positions(starts_of(rows, new_rp), lens[rows])]
-            v_parts.append(_ell_fill(vlens, flat, kcap, v_pad))
-            pstart = np.concatenate([[0], np.cumsum(rp2)[:-1]]).astype(np.int64)
-            fpm = np.full(m2, num_virtual, dtype=np.int32)
-            vr_start = vr_last - r_per + 1
-            fpm[_flat_positions(pstart, r_per)] = _flat_positions(
-                vr_start, r_per
-            ).astype(np.int32)
-            f_parts.append(fpm)
-            h_parts.append(_heavy_pick(rp2, pstart, m2, fold_steps))
-        virtual = np.stack(v_parts)
-        fold_pad_map = np.stack(f_parts)
-        heavy_pick = np.stack(h_parts)
+            hlens_list.append(lens[rows])
+            flat_list.append(
+                nbrs[_flat_positions(starts_of(rows, new_rp), lens[rows])]
+            )
+        (
+            _, num_virtual, fold_steps, m2,
+            virtual, fold_pad_map, heavy_pick,
+        ) = pad_heavy_shards(hlens_list, flat_list, kcap, v_pad)
 
     # --- Light ladder with num_shards-aligned global boundaries. ---
     light = []
@@ -287,6 +323,33 @@ def rank_by_in_degree(dst: np.ndarray, v_count: int):
     rank = np.empty(v_count, dtype=np.int32)
     rank[rank_order] = np.arange(v_count, dtype=np.int32)
     return in_deg, rank_order, rank
+
+
+def rank_vertices(src: np.ndarray, dst: np.ndarray, v_count: int):
+    """(in_degree, num_active, rank_order, rank): active-first relabeling.
+
+    Like :func:`rank_by_in_degree` (descending in-degree, stable), but every
+    *active* vertex — one touching any edge as source or destination — ranks
+    before every isolated one. Packed engines then allocate frontier /
+    visited / plane tables of only ``num_active`` rows: on RMAT graphs
+    ~40% of vertices are isolated (measured 40.6% at scale 21, 42.9% at
+    scale 22), pure dead weight in every O(V)-row table. For the undirected
+    double-insert representation in-degree == degree, so this order equals
+    rank_by_in_degree's exactly; it only differs for directed graphs with
+    out-only vertices (which must keep a row: their frontier bits are
+    gathered as in-neighbors of other rows).
+    """
+    in_deg = np.bincount(dst, minlength=v_count).astype(np.int64)
+    inactive = in_deg == 0
+    if len(src):
+        inactive &= np.bincount(src, minlength=v_count) == 0
+    num_active = v_count - int(inactive.sum())
+    # lexsort: primary key last — inactive ascending (actives first), then
+    # in-degree descending; stable on ties like rank_by_in_degree.
+    rank_order = np.lexsort((-in_deg, inactive)).astype(np.int32)
+    rank = np.empty(v_count, dtype=np.int32)
+    rank[rank_order] = np.arange(v_count, dtype=np.int32)
+    return in_deg, num_active, rank_order, rank
 
 
 def bucketize_rows(lens: np.ndarray, nbrs: np.ndarray, new_rp: np.ndarray,
@@ -365,14 +428,18 @@ def bucketize_rows(lens: np.ndarray, nbrs: np.ndarray, new_rp: np.ndarray,
 
 
 def build_ell(g: Graph, *, kcap: int = 64) -> EllGraph:
-    """Build the bucketed in-neighbor ELL from a host CSR graph."""
+    """Build the bucketed in-neighbor ELL from a host CSR graph.
+
+    Rank space is active-first (``rank_vertices``), so the engines' packed
+    tables need only ``num_active + 1`` rows (actives + the all-zero
+    sentinel row, which doubles as the pad gather target)."""
     v_count = g.num_vertices
     # In-CSR: neighbors-by-destination. For the undirected double-insert
     # representation this equals the out-CSR, but build it generally.
     src, dst = g.coo
     order_ds = _lexsort_pairs(dst, src, v_count)
     in_col = src[order_ds]
-    in_deg, rank_order, rank = rank_by_in_degree(dst, v_count)
+    in_deg, num_active, rank_order, rank = rank_vertices(src, dst, v_count)
 
     # Flatten in-neighbor lists in rank order, neighbor ids mapped to rank space.
     in_rp = np.zeros(v_count + 1, dtype=np.int64)
@@ -386,13 +453,14 @@ def build_ell(g: Graph, *, kcap: int = 64) -> EllGraph:
     (
         num_heavy, num_nonzero, num_virtual, fold_steps,
         virtual, fold_pad_map, heavy_pick, light,
-    ) = bucketize_rows(lens, nbrs, new_rp, kcap, v_count)
+    ) = bucketize_rows(lens, nbrs, new_rp, kcap, num_active)
 
     return EllGraph(
         num_vertices=v_count,
         num_edges=e,
         undirected=g.undirected,
         kcap=kcap,
+        num_active=num_active,
         old_of_new=rank_order,
         rank=rank,
         in_degree=in_deg,

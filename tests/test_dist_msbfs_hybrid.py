@@ -64,7 +64,7 @@ def test_dist_hybrid_heavy_rows(rmat_small):
         rmat_small, make_mesh(4), tile_thr=300, kcap=8
     )
     assert engine.hd["num_tiles"] > 0
-    assert engine.hd["sell"].heavy_per_shard > 0
+    assert engine.hd["res_spec"].heavy
     sources = np.flatnonzero(engine.hd["in_degree"] > 0)[:40]
     _check_lanes(rmat_small, engine, sources)
 
@@ -86,6 +86,37 @@ def test_dist_hybrid_matches_single_chip(random_small):
     )
     assert dist_res.num_levels == single_res.num_levels
     assert dist_res.teps and dist_res.teps > 0
+
+
+def test_dist_hybrid_state_is_sharded(random_small):
+    # The traversal state (frontier, visited, planes) must be sharded over
+    # the mesh, not replicated — the reference's full-per-device allocation
+    # (bfs.cu:339-351) is the anti-pattern; per-chip bytes must fall as 1/P.
+    from jax.sharding import PartitionSpec
+
+    mesh = make_mesh(8)
+    engine = DistHybridMsBfsEngine(random_small, mesh, tile_thr=2)
+    rows = engine.hd["rows"]
+    fw0 = engine._seed_dev(np.array([0, 7]))
+    assert fw0.shape == (rows, engine.w)
+    assert fw0.sharding.spec == PartitionSpec("v")
+    shard_rows = {s.data.shape[0] for s in fw0.addressable_shards}
+    assert shard_rows == {rows // 8}
+
+    res = engine.run(np.array([0, 7]))
+    assert res._vis.sharding.spec == PartitionSpec("v")
+    for pl in res._planes:
+        assert pl.sharding.spec == PartitionSpec("v")
+        assert {s.data.shape[0] for s in pl.addressable_shards} == {rows // 8}
+
+
+def test_dist_hybrid_isolated_source(random_disconnected):
+    g = random_disconnected
+    iso = np.flatnonzero(g.degrees == 0)
+    engine = DistHybridMsBfsEngine(g, make_mesh(2), tile_thr=2)
+    assert engine.hd["num_active"] < g.num_vertices
+    res = _check_lanes(g, engine, [int(iso[0]), 0])
+    assert res.reached[0] == 1 and res.edges_traversed[0] == 0
 
 
 def test_dist_hybrid_disconnected_and_cap(random_disconnected, line_graph):

@@ -1,34 +1,47 @@
 """Distributed hybrid (MXU dense tiles + gather residual) multi-source BFS.
 
-The multi-chip form of the flagship HybridMsBfsEngine. Ownership is split
-per concern, which keeps every piece reusable:
+The multi-chip form of the flagship HybridMsBfsEngine, with **fully sharded
+traversal state** — the design the reference could not express: it replicates
+the whole graph per device and allocates full-size distance/frontier arrays
+per device (bfs.cu:346-351, 339-344), so adding GPUs never adds capacity.
+Here every O(V)-row table is sharded, and per-chip memory shrinks as the
+mesh grows:
 
-- **dense part**: global 128x128 tile selection (same rule as build_hybrid),
-  row-tiles dealt round-robin to chips (row-tile t -> chip t % P, so the
-  hub-heavy top tiles spread evenly); each chip runs the tile_spmm Pallas
-  kernel over its own tiles against the replicated rank0 frontier table.
-- **residual part**: the leftover edges form their own graph, sharded with
-  build_ell_sharded (round-robin over residual-degree-sorted rows — its own
-  row space); neighbor ids are remapped at build time to point into the
-  rank0 frontier table, and one static permutation per level routes the
-  gathered residual output back to rank0.
-- **state**: the frontier and visited tables are replicated (V * 4W bytes,
-  cheap); the bit-sliced distance planes — the big state — are sharded in
-  contiguous rank0 chunks, so the reassembled planes are already in rank0
-  order and the single-chip lazy extraction applies unchanged.
+- **Ownership**: row-tiles (128 rank0 rows each) are dealt round-robin to
+  chips (tile t -> chip t % P), so the hub-heavy top tiles spread evenly —
+  the load balance the reference's contiguous getDev split lacks
+  (bfs.cu:29-32). One ownership map covers the dense tiles, the residual
+  rows, the frontier/visited shards, and the plane shards.
+- **dense part**: global 128x128 tile selection (same rule as build_hybrid);
+  each chip runs the tile_spmm Pallas kernel over its own row-tiles against
+  the transient all-gathered frontier, producing hits for exactly the rows
+  it owns.
+- **residual part**: each chip gets a bucketed ELL over the residual
+  in-edges of its own rows, with bucket shapes padded to a common maximum
+  across chips so one jitted program serves every chip under shard_map; a
+  per-chip static permutation routes bucket outputs to local row order.
+- **state**: frontier, visited, and the bit-sliced distance planes are all
+  sharded [rows/P, w] per chip. Per level, one all_gather materializes the
+  full frontier transiently (discarded after expansion); claim, visited
+  update, and plane ripple run on owned rows only. Termination is a psum of
+  local claim popcounts — one collective per level, like the reference's
+  MPI_Allreduce (bfs_mpi.cu:621) but compiled into the on-device loop.
 
-Per level each chip computes its dense + residual contributions, two
-all_gathers assemble the full hit table, the claim ``& ~visited`` runs
-replicated (identical on every chip, so termination needs no extra
-collective — the reference needs an MPI_Allreduce per level,
-bfs_mpi.cu:621), and each chip ripples only its plane chunk.
+Per-chip memory (w=128 words = 4096 lanes, A = active rows):
+  persistent: (num_planes + 2) * A/P * 512 B     (planes + visited + frontier)
+  transient:  A * 512 B (gathered frontier) + A/P * 512 B (own hits)
+  structures: dense tiles (2 KB each) + residual ELL slots / P
+so the dominant term falls as 1/P; only the one transient gathered frontier
+is O(A) — see BENCHMARKS.md for the Graph500 scale-26 budget on v5p.
 
 Like the single-chip hybrid, the dense kernel fixes the lane count at 4096
-(w=128); unlike it, sharding the planes and edge structure lets that width
-fit graphs a single chip cannot hold.
+(w=128); unlike it, sharding lets that width fit graphs one chip cannot
+hold.
 """
 
 from __future__ import annotations
+
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -36,14 +49,15 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tpu_bfs.graph.csr import Graph, build_csr
-from tpu_bfs.graph.ell import build_ell_sharded, rank_by_in_degree
+from tpu_bfs.graph.csr import Graph
+from tpu_bfs.graph.ell import _ell_fill, pad_heavy_shards, rank_vertices
 from tpu_bfs.algorithms.msbfs_packed import ripple_increment
 from tpu_bfs.algorithms._packed_common import (
     ExpandSpec,
     make_fori_expand,
     make_state_kernels,
     run_packed_batch,
+    seed_scatter_args,
 )
 from tpu_bfs.algorithms.msbfs_hybrid import fill_a_tiles, select_dense_tiles
 from tpu_bfs.ops.tile_spmm import AW, TILE, tile_spmm
@@ -57,6 +71,144 @@ def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
+def _build_residual_shards(
+    res_dst: np.ndarray,
+    res_src_rank: np.ndarray,
+    p_count: int,
+    nrt: int,
+    rows: int,
+    kcap: int,
+):
+    """Per-chip bucketed ELL over each chip's own residual in-edges.
+
+    ``res_dst``/``res_src_rank`` are rank0-space endpoints of the residual
+    edges. Chip p owns local rows of the row-tiles {t : t % P == p}; its
+    rows sort by residual degree and bucket exactly like the single-chip
+    hybrid, but bucket shapes are padded to the maximum across chips so one
+    jitted program serves every chip. Neighbor ids stay global rank0 rows
+    (sentinel ``rows - 1``, a pad row kept all-zero by the valid mask).
+    Returns (spec_parts, res_arrs [P,...] stacks, perm [P, nrt*128]) where
+    perm routes each chip's bucket-output rows back to local row order.
+    """
+    rows_loc = nrt * TILE
+    sentinel = rows - 1
+    from tpu_bfs.graph.csr import _lexsort_pairs
+
+    # Global row -> (owner chip, local row).
+    g_tile = res_dst // TILE
+    owner = g_tile % p_count
+    local_row = (g_tile // p_count) * TILE + res_dst % TILE
+
+    per_chip = []
+    for p in range(p_count):
+        sel = np.flatnonzero(owner == p)
+        ldst = local_row[sel]
+        lens_local = np.bincount(ldst, minlength=rows_loc).astype(np.int64)
+        order_rows = np.argsort(-lens_local, kind="stable").astype(np.int64)
+        pos_of_row = np.empty(rows_loc, dtype=np.int64)
+        pos_of_row[order_rows] = np.arange(rows_loc)
+        # Neighbors grouped by (sorted row, src) for determinism. Minor-key
+        # values are global rank0 rows, hence the separate n_minor bound
+        # (rows_loc alone would make the native sort reject every call).
+        order_e = _lexsort_pairs(
+            pos_of_row[ldst], res_src_rank[sel].astype(np.int64), rows_loc,
+            rows,
+        )
+        nbrs = res_src_rank[sel][order_e].astype(np.int32)
+        lens = lens_local[order_rows]
+        rp = np.zeros(rows_loc + 1, dtype=np.int64)
+        np.cumsum(lens, out=rp[1:])
+        per_chip.append((lens, nbrs, rp, order_rows))
+
+    # --- Common heavy-section shapes (shared pyramid-padding helper). ---
+    nh_p = [int(np.searchsorted(-t[0], -kcap, side="left")) for t in per_chip]
+    (
+        nh, num_virtual, fold_steps, _m2,
+        virtual_s, fold_pad_map_s, heavy_pick_s,
+    ) = pad_heavy_shards(
+        [t[0][:n] for t, n in zip(per_chip, nh_p)],
+        [t[1][: int(t[2][n])] for t, n in zip(per_chip, nh_p)],
+        kcap,
+        sentinel,
+    )
+    heavy = nh > 0
+
+    # --- Common light ladder: union of buckets, counts padded to max. ---
+    nz_p = [int(np.searchsorted(-t[0], 0, side="left")) for t in per_chip]
+    bounds_p = []  # per chip: list of (k, lo, hi) sorted-row ranges
+    for (lens, _, _, _), n_h, nz in zip(per_chip, nh_p, nz_p):
+        row = n_h
+        k = kcap
+        b = {}
+        while row < nz and k >= 1:
+            hi = int(np.searchsorted(-lens, -(k // 2 + 1), side="right"))
+            if k == 1:
+                hi = nz
+            if hi > row:
+                b[k] = (row, hi)
+                row = hi
+            k //= 2
+        bounds_p.append(b)
+    ks = [
+        k
+        for k in (kcap >> i for i in range(kcap.bit_length()))
+        if k >= 1 and any(k in b for b in bounds_p)
+    ]
+    n_of_k = {
+        k: max(b[k][1] - b[k][0] if k in b else 0 for b in bounds_p) for k in ks
+    }
+    light_s = []
+    for k in ks:
+        blocks = []
+        for (lens, nbrs, rp, _), b in zip(per_chip, bounds_p):
+            lo, hi = b.get(k, (0, 0))
+            flat = nbrs[int(rp[lo]) : int(rp[hi])]
+            filled = _ell_fill(lens[lo:hi], flat, k, sentinel)
+            pad = np.full((n_of_k[k] - (hi - lo), k), sentinel, np.int32)
+            blocks.append(np.concatenate([filled, pad]) if len(pad) else filled)
+        light_s.append((k, np.stack(blocks)))
+
+    # --- Per-chip permutation: local row -> bucket-output position. ---
+    out_height = nh + sum(n_of_k[k] for k in ks) + 1  # +1 zero row
+    zero_pos = out_height - 1
+    perms = []
+    for (lens, _, _, order_rows), n_h, nz, b in zip(
+        per_chip, nh_p, nz_p, bounds_p
+    ):
+        pos_of_sorted = np.full(rows_loc, zero_pos, dtype=np.int32)
+        pos_of_sorted[:n_h] = np.arange(n_h, dtype=np.int32)
+        off = nh
+        for k in ks:
+            lo, hi = b.get(k, (0, 0))
+            pos_of_sorted[lo:hi] = off + np.arange(hi - lo, dtype=np.int32)
+            off += n_of_k[k]
+        perm = np.empty(rows_loc, dtype=np.int32)
+        perm[order_rows] = pos_of_sorted  # rows with deg 0 -> zero_pos
+        perms.append(perm)
+
+    spec = ExpandSpec(
+        kcap=kcap,
+        heavy=heavy,
+        num_virtual=num_virtual,
+        fold_steps=fold_steps,
+        light_meta=tuple((k, n_of_k[k]) for k in ks),
+        tail_rows=1,
+    )
+    res_arrs = {}
+    if heavy:
+        res_arrs["virtual_t"] = np.ascontiguousarray(
+            virtual_s.transpose(0, 2, 1)
+        )
+        res_arrs["fold_pad_map"] = fold_pad_map_s
+        res_arrs["heavy_pick"] = heavy_pick_s
+    for i, (k, blocks) in enumerate(light_s):
+        res_arrs[f"light{i}_t"] = np.ascontiguousarray(
+            blocks.transpose(0, 2, 1)
+        )
+    res_slots = (num_virtual * kcap + sum(k * n_of_k[k] for k in ks)) * p_count
+    return spec, res_arrs, np.stack(perms), res_slots
+
+
 def build_dist_hybrid(
     g: Graph,
     num_shards: int,
@@ -65,17 +217,20 @@ def build_dist_hybrid(
     tile_thr: int = 64,
     a_budget_bytes: int = int(0.2e9),
 ):
-    """Build the sharded dense tiles + sharded residual ELL + glue maps.
+    """Build sharded dense tiles + per-chip residual ELL + glue maps.
 
     Returns a dict of host arrays (see DistHybridMsBfsEngine for the layout).
     """
     p_count = num_shards
     v = g.num_vertices
     src, dst = g.coo
-    in_deg, rank_order, rank = rank_by_in_degree(dst, v)
+    in_deg, num_active, rank_order, rank = rank_vertices(src, dst, v)
 
-    vt = _round_up(-(-(v + 1) // TILE), p_count)  # row-tiles, multiple of P
+    # Row-tiles over active rows only (isolated vertices get no row), padded
+    # to a multiple of P so every chip owns the same tile count.
+    vt = _round_up(-(-(num_active + 1) // TILE), p_count)
     rows = vt * TILE
+    nrt = vt // p_count
     r = rank[dst]
     c = rank[src]
     dense_edge, dense_uniq, tid = select_dense_tiles(
@@ -87,7 +242,6 @@ def build_dist_hybrid(
     g_row_tile = dense_uniq // vt
     g_col_tile = (dense_uniq % vt).astype(np.int32)
     owner = (g_row_tile % p_count).astype(np.int64)
-    nrt = vt // p_count  # local row-tiles per chip
     nt_max = max(int(np.bincount(owner, minlength=p_count).max(initial=0)), 1)
     row_start_s = np.zeros((p_count, nrt + 1), np.int32)
     col_tile_s = np.zeros((p_count, nt_max), np.int32)
@@ -107,119 +261,94 @@ def build_dist_hybrid(
             col_tile_s[p, : len(mine)] = g_col_tile[mine]
             a_tiles_s[p, : len(mine)] = a_global[mine]
 
-    # --- residual: its own sharded ELL over the leftover edges ---
+    # --- residual: per-chip ELL over each chip's own rows ---
     re_mask = ~dense_edge
-    res_g = build_csr(
-        src[re_mask].astype(np.int64),
-        dst[re_mask].astype(np.int64),
-        v,
-        sort_neighbors=False,
-        undirected=False,
+    spec, res_arrs, perm_s, res_slots = _build_residual_shards(
+        r[re_mask].astype(np.int64),
+        c[re_mask].astype(np.int32),
+        p_count,
+        nrt,
+        rows,
+        kcap,
     )
-    sell = build_ell_sharded(res_g, p_count, kcap=kcap)
 
-    # Remap ELL neighbor ids (residual-rank space, sentinel = its v_pad) to
-    # rank0 frontier rows (sentinel = rows - 1, a zero pad row).
-    sentinel0 = rows - 1
-    trans = np.full(sell.v_pad + 1, sentinel0, dtype=np.int32)
-    trans[sell.rank] = rank
+    # Valid mask: real active rows of each chip (global rank0 row < active).
+    rows_loc = nrt * TILE
+    j = np.arange(rows_loc) // TILE  # local tile
+    i = np.arange(rows_loc) % TILE
+    g_rows = (j[None, :] * p_count + np.arange(p_count)[:, None]) * TILE + i
+    valid_s = ((g_rows < num_active).astype(np.uint32) * np.uint32(0xFFFFFFFF))[
+        :, :, None
+    ]
 
-    def remap(idx):
-        return trans[idx]
-
-    res_arrs = {}
-    if sell.heavy_per_shard > 0:
-        res_arrs["virtual_t"] = remap(
-            np.ascontiguousarray(sell.virtual.transpose(0, 2, 1))
-        )
-        res_arrs["fold_pad_map"] = sell.fold_pad_map
-        res_arrs["heavy_pick"] = sell.heavy_pick
-    for i, (k, blocks) in enumerate(sell.light):
-        res_arrs[f"light{i}_t"] = remap(np.ascontiguousarray(blocks.transpose(0, 2, 1)))
-
-    # rank0 row -> residual-rank row of the same vertex (the all_gathered
-    # residual output is reassembled in residual-rank order). Pad rank0 rows
-    # point at residual row v_pad-1 — a pad there too unless P divides V
-    # exactly; the level loop masks pad rows regardless (``valid``), which
-    # also keeps the rank0 sentinel row (rows-1) permanently zero.
-    inv_perm = np.full(rows, sell.v_pad - 1, dtype=np.int32)
-    inv_perm[rank] = sell.rank
-    valid = np.zeros((rows, 1), dtype=np.uint32)
-    valid[rank, 0] = np.uint32(0xFFFFFFFF)
+    # Vertex -> tau row (the sharded tables' global order: chip-major, then
+    # local rows). Isolated vertices (rank >= active) -> rows (no row).
+    g_tile_of = rank // TILE
+    tau = (
+        (g_tile_of % p_count).astype(np.int64) * rows_loc
+        + (g_tile_of // p_count).astype(np.int64) * TILE
+        + rank % TILE
+    )
+    tau_of_vertex = np.where(rank < num_active, tau, rows).astype(np.int64)
 
     return {
         "num_vertices": v,
+        "num_active": num_active,
         "num_edges": g.num_edges,
         "undirected": g.undirected,
+        "num_shards": p_count,
         "vt": vt,
         "rows": rows,
         "rank": rank,
         "old_of_new": rank_order,
         "in_degree": in_deg,
+        "tau_of_vertex": tau_of_vertex,
         "num_dense_edges": int(dense_edge.sum()),
         "num_tiles": nt,
         "row_start_s": row_start_s,
         "col_tile_s": col_tile_s,
         "a_tiles_s": a_tiles_s,
-        "sell": sell,
+        "res_spec": spec,
         "res_arrs": res_arrs,
-        "inv_perm": inv_perm,
-        "valid": valid,
+        "res_slots": res_slots,
+        "perm_s": perm_s,
+        "valid_s": valid_s,
     }
 
 
 def _make_dist_core(hd, w: int, num_planes: int, mesh: Mesh, interpret: bool):
     p_count = mesh.devices.size
     rows = hd["rows"]
-    rows_loc = rows // p_count
     nrt = hd["vt"] // p_count
-    sell = hd["sell"]
-    spec = ExpandSpec(
-        kcap=sell.kcap,
-        heavy=sell.heavy_per_shard > 0,
-        num_virtual=sell.num_virtual,
-        fold_steps=sell.fold_steps,
-        light_meta=tuple((k, blocks.shape[1]) for k, blocks in sell.light),
-        tail_rows=sell.tail_rows,
-    )
-    expand = make_fori_expand(spec, w)
+    rows_loc = nrt * TILE
+    expand = make_fori_expand(hd["res_spec"], w)
     has_dense = hd["num_tiles"] > 0
-    v_pad_res = sell.v_pad
-
-    replicated = ("inv_perm", "valid")
 
     def chip_fn(arrs, fw0, max_levels):
-        arrs = {
-            k: (a if k in replicated else a[0]) for k, a in arrs.items()
-        }
-        p = lax.axis_index("v")
+        arrs = {k: a[0] for k, a in arrs.items()}  # strip this chip's P axis
 
-        def hit_of(fw):
-            # Residual: this chip's residual-rank rows -> all_gather ->
-            # residual-rank order -> permute to rank0.
-            res_own = expand(arrs, fw)  # [v_loc_res, w]
-            ag_r = lax.all_gather(res_own, "v")  # [P, v_loc, w]
-            res_full = (
-                ag_r.transpose(1, 0, 2).reshape(v_pad_res, w)[arrs["inv_perm"]]
-            )
+        def gather_frontier(fw_own):
+            # Transient full frontier in global rank0 order: global tile
+            # t = local j * P + chip p, so the transpose interleaves.
+            ag = lax.all_gather(fw_own.reshape(nrt, TILE, w), "v")
+            return ag.transpose(1, 0, 2, 3).reshape(rows, w)
+
+        def hit_own_of(fw_own):
+            fw_g = gather_frontier(fw_own)
+            hit = expand(arrs, fw_g)[arrs["perm"]]  # own rows, local order
             if has_dense:
-                # Dense: this chip's row-tiles -> all_gather -> interleave
-                # back (global row-tile t = local j * P + chip p).
-                hit_d = tile_spmm(
-                    arrs["row_start"], arrs["col_tile"], arrs["a_tiles"], fw,
+                hit = hit | tile_spmm(
+                    arrs["row_start"], arrs["col_tile"], arrs["a_tiles"], fw_g,
                     num_row_tiles=nrt, w=w, interpret=interpret,
-                )  # [nrt*TILE, w]
-                ag_d = lax.all_gather(hit_d.reshape(nrt, TILE, w), "v")
-                res_full = res_full | ag_d.transpose(1, 0, 2, 3).reshape(rows, w)
-            # Pad rank0 rows never hit (keeps the sentinel row zero).
-            return res_full & arrs["valid"]
-
-        def own(full):  # this chip's contiguous plane chunk
-            return lax.dynamic_slice(full, (p * rows_loc, 0), (rows_loc, w))
+                )
+            return hit & arrs["valid"]
 
         planes0 = tuple(
             jnp.zeros((rows_loc, w), jnp.uint32) for _ in range(num_planes)
         )
+
+        def global_any(x):
+            return lax.psum(jnp.any(x != 0).astype(jnp.int32), "v") > 0
 
         def cond(carry):
             _, _, _, level, alive = carry
@@ -227,10 +356,12 @@ def _make_dist_core(hd, w: int, num_planes: int, mesh: Mesh, interpret: bool):
 
         def body(carry):
             fw, vis, planes, level, _ = carry
-            nxt = hit_of(fw) & ~vis  # replicated: identical on every chip
+            nxt = hit_own_of(fw) & ~vis  # own rows only
             vis2 = vis | nxt
-            planes = ripple_increment(planes, ~own(vis2))
-            alive = jnp.any(nxt != 0)
+            planes = ripple_increment(planes, ~vis2)
+            # One psum per level is the whole termination protocol (the
+            # reference needs a host-visible MPI_Allreduce, bfs_mpi.cu:621).
+            alive = global_any(nxt)
             return nxt, vis2, planes, level + 1, alive
 
         fw_f, vis_f, planes_f, levels, alive = lax.while_loop(
@@ -238,31 +369,22 @@ def _make_dist_core(hd, w: int, num_planes: int, mesh: Mesh, interpret: bool):
         )
 
         def deeper():
-            return jnp.any((hit_of(fw_f) & ~vis_f) != 0)
+            return global_any(hit_own_of(fw_f) & ~vis_f)
 
         truncated = lax.cond(
             alive & (levels >= max_levels), deeper, lambda: jnp.bool_(False)
         )
-        return (
-            tuple(pl[None] for pl in planes_f),
-            vis_f,
-            levels,
-            alive,
-            truncated,
-        )
+        return planes_f, vis_f, levels, alive, truncated
 
     def build(n_arrs):
-        specs = {
-            k: (P() if k in replicated else P("v")) for k in n_arrs
-        }
         core = jax.jit(
             jax.shard_map(
                 chip_fn,
                 mesh=mesh,
-                in_specs=(specs, P(), P()),
+                in_specs=({k: P("v") for k in n_arrs}, P("v"), P()),
                 out_specs=(
                     tuple(P("v") for _ in range(num_planes)),
-                    P(),
+                    P("v"),
                     P(),
                     P(),
                     P(),
@@ -270,10 +392,10 @@ def _make_dist_core(hd, w: int, num_planes: int, mesh: Mesh, interpret: bool):
                 check_vma=False,
             )
         )
-        device_arrs = {}
-        for k, a in n_arrs.items():
-            sh = NamedSharding(mesh, P() if k in replicated else P("v"))
-            device_arrs[k] = jax.device_put(a, sh)
+        device_arrs = {
+            k: jax.device_put(a, NamedSharding(mesh, P("v")))
+            for k, a in n_arrs.items()
+        }
         return core, device_arrs
 
     return build
@@ -282,8 +404,11 @@ def _make_dist_core(hd, w: int, num_planes: int, mesh: Mesh, interpret: bool):
 class DistHybridMsBfsEngine:
     """Multi-chip 4096-lane hybrid MS-BFS: dense MXU tiles + gather residual.
 
-    API mirrors HybridMsBfsEngine; the dense kernel's 4096-lane requirement
-    holds, but sharded planes/edges let it fit graphs one chip cannot.
+    API mirrors HybridMsBfsEngine; frontier/visited/planes are all sharded
+    [rows/P, w] per chip (tau order: chip-major, then each chip's local
+    row-tiles), so per-chip state memory falls as the mesh grows — the
+    scaling the reference's full-replication design forecloses
+    (bfs.cu:346-351).
     """
 
     def __init__(
@@ -315,18 +440,17 @@ class DistHybridMsBfsEngine:
             if isinstance(graph, Graph)
             else graph
         )
-        if hd["sell"].num_shards != p_count:
+        if hd["num_shards"] != p_count:
             raise ValueError(
-                f"built for {hd['sell'].num_shards} shards, mesh has {p_count}"
+                f"built for {hd['num_shards']} shards, mesh has {p_count}"
             )
-        if hd["rows"] % p_count:
-            raise ValueError("padded rows not divisible by mesh size")
         self.hd = hd
         self.undirected = hd["undirected"]
+        rows = hd["rows"]
 
         n_arrs = dict(hd["res_arrs"])
-        n_arrs["inv_perm"] = hd["inv_perm"]
-        n_arrs["valid"] = hd["valid"]
+        n_arrs["perm"] = hd["perm_s"]
+        n_arrs["valid"] = hd["valid_s"]
         if hd["num_tiles"]:
             n_arrs["row_start"] = hd["row_start_s"]
             n_arrs["col_tile"] = hd["col_tile_s"]
@@ -334,15 +458,28 @@ class DistHybridMsBfsEngine:
         build = _make_dist_core(hd, self.w, num_planes, self.mesh, interpret)
         self._dist_core, self.arrs = build(n_arrs)
 
-        self._rank = hd["rank"].astype(np.int64)
-        # Ranks are < V, so the first V entries carry every real vertex —
-        # exactly the rows lane_stats scans (make_state_kernels v=V).
-        in_deg_r = np.zeros(hd["rows"], dtype=np.float32)
-        in_deg_r[self._rank] = hd["in_degree"].astype(np.float32)
-        self._in_deg_ranked = jnp.asarray(in_deg_r[: hd["num_vertices"]])
-        self._seed_k, self._lane_stats, self._extract_word = make_state_kernels(
-            hd["num_vertices"], hd["rows"], self.w, num_planes
+        # Extraction maps vertices through tau (vertex -> sharded-table row);
+        # isolated vertices map to `rows` and are masked host-side (_act).
+        self._rank = hd["tau_of_vertex"]
+        self._act = rows
+        in_deg_tau = np.zeros(rows, dtype=np.float32)
+        valid_v = hd["tau_of_vertex"] < rows
+        in_deg_tau[hd["tau_of_vertex"][valid_v]] = hd["in_degree"][
+            valid_v
+        ].astype(np.float32)
+        self._in_deg_ranked = jnp.asarray(in_deg_tau)
+        _, self._lane_stats, self._extract_word = make_state_kernels(
+            rows, rows, self.w, num_planes
         )
+        sharded = NamedSharding(self.mesh, P("v"))
+        w_ = self.w
+
+        @partial(jax.jit, out_shardings=sharded)
+        def seed(rws, words, bits):
+            fw0 = jnp.zeros((rows, w_), jnp.uint32)
+            return fw0.at[rws, words].add(bits)
+
+        self._seed_k = seed
         self._warmed = False
 
     @property
@@ -358,18 +495,17 @@ class DistHybridMsBfsEngine:
     def _lane_order(mat: np.ndarray) -> np.ndarray:
         return mat.reshape(-1)
 
+    def _iso_of(self, sources: np.ndarray):
+        return self.hd["rank"][np.asarray(sources, np.int64)] >= self.hd[
+            "num_active"
+        ]
+
     def _seed_dev(self, sources: np.ndarray):
-        ranks = self.hd["rank"][np.asarray(sources, dtype=np.int64)].astype(np.int32)
-        lanes = np.arange(len(sources), dtype=np.int32)
-        words = (lanes // 32).astype(np.int32)
-        bits = np.uint32(1) << (lanes % 32).astype(np.uint32)
-        return self._seed_k(jnp.asarray(ranks), jnp.asarray(words), jnp.asarray(bits))
+        tau = self.hd["tau_of_vertex"][np.asarray(sources, np.int64)]
+        return self._seed_k(*seed_scatter_args(tau, self._act))
 
     def _core(self, arrs, fw0, max_levels):
-        planes, vis, levels, alive, truncated = self._dist_core(arrs, fw0, max_levels)
-        # Contiguous chunks concatenate back into plain rank0 order.
-        planes = tuple(pl.reshape(self.hd["rows"], self.w) for pl in planes)
-        return planes, vis, levels, alive, truncated
+        return self._dist_core(arrs, fw0, max_levels)
 
     def run(self, sources, *, max_levels=None, time_it=False, check_cap=True):
         return run_packed_batch(

@@ -53,7 +53,7 @@ def test_partition_roundtrip(random_small):
 
 
 @pytest.mark.parametrize("p", MESH_SIZES)
-@pytest.mark.parametrize("exchange", ["ring", "allreduce"])
+@pytest.mark.parametrize("exchange", ["ring", "allreduce", "sparse"])
 def test_dist_matches_golden(toy_graph, p, exchange):
     eng = DistBfsEngine(toy_graph, make_mesh(p), exchange=exchange)
     for src in [0, 5, 15]:
@@ -63,7 +63,7 @@ def test_dist_matches_golden(toy_graph, p, exchange):
         validate.check_parents(toy_graph, src, res.distance, res.parent)
 
 
-@pytest.mark.parametrize("exchange", ["ring", "allreduce"])
+@pytest.mark.parametrize("exchange", ["ring", "allreduce", "sparse"])
 def test_dist_random_graph(random_small, exchange):
     eng = DistBfsEngine(random_small, make_mesh(8), exchange=exchange)
     golden, _ = bfs_python(random_small, 3)
@@ -102,6 +102,55 @@ def test_dist_rmat(rmat_small):
     res = eng.run(1)
     validate.check_distances(res.distance, golden)
     validate.check_parents(rmat_small, 1, res.distance, res.parent)
+
+
+def test_sparse_exchange_wins_on_line_graph(line_graph):
+    # High-diameter, 1-vertex frontiers: the queue-style exchange moves the
+    # frontier's ids instead of a full bitmap every level — the scenario the
+    # reference's per-destination buckets (bfs.cu:148-150) optimize for.
+    sparse = DistBfsEngine(line_graph, make_mesh(8), exchange="sparse")
+    rs = sparse.run(0)
+    np.testing.assert_array_equal(rs.distance, np.arange(64))
+    dense = DistBfsEngine(line_graph, make_mesh(8), exchange="ring")
+    dense.run(0)
+    assert sparse.last_exchange_bytes < dense.last_exchange_bytes / 10
+
+
+def test_sparse_exchange_dense_fallback(random_small):
+    # A 1-entry cap overflows on any level whose largest per-destination
+    # bucket holds >= 2 vertices, forcing the dense bitmap branch — results
+    # must be identical either way, and the per-branch level counters must
+    # show the fallback actually ran and account for every level.
+    eng = DistBfsEngine(
+        random_small, make_mesh(8), exchange="sparse", sparse_caps=1
+    )
+    golden, _ = bfs_python(random_small, 3)
+    res = eng.run(3)
+    validate.check_distances(res.distance, golden)
+    counts = eng.last_exchange_level_counts
+    assert counts.shape == (2,)  # (cap-1 branch, dense fallback)
+    assert counts.sum() == res.num_levels + 1  # every level counted once
+    # random_small's mid-BFS levels put hundreds of vertices into 8 buckets:
+    # some level must overflow a 1-entry cap.
+    assert counts[-1] >= 1
+
+
+def test_exchange_bytes_counter_populated(random_small):
+    for exchange in ["ring", "allreduce", "sparse"]:
+        eng = DistBfsEngine(random_small, make_mesh(4), exchange=exchange)
+        assert eng.last_exchange_bytes is None
+        res = eng.run(3)
+        assert eng.last_exchange_bytes > 0
+        assert eng.last_exchange_level_counts.sum() == res.num_levels + 1
+
+
+def test_unknown_exchange_rejected(random_small):
+    from tpu_bfs.parallel.dist_bfs2d import Dist2DBfsEngine, make_mesh_2d
+
+    with pytest.raises(ValueError, match="unknown exchange"):
+        DistBfsEngine(random_small, make_mesh(2), exchange="sprase")
+    with pytest.raises(ValueError, match="unknown exchange"):
+        Dist2DBfsEngine(random_small, make_mesh_2d(2, 2), exchange="sparse")
 
 
 def test_dist_stats_match_single(toy_graph):

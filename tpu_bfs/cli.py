@@ -166,8 +166,11 @@ def main(argv=None) -> int:
                     choices=["scan", "segment", "scatter", "delta", "dopt"],
                     help="single-device frontier-expansion backend ('dopt' = "
                     "direction-optimizing top-down/bottom-up switch)")
-    ap.add_argument("--exchange", default="ring", choices=["ring", "allreduce"],
-                    help="multi-device frontier exchange implementation")
+    ap.add_argument("--exchange", default="ring",
+                    choices=["ring", "allreduce", "sparse"],
+                    help="multi-device frontier exchange implementation "
+                    "('sparse' = two-phase queue-style id exchange with "
+                    "dense-bitmap fallback; 1D --devices meshes)")
     ap.add_argument("--max-levels", type=int, default=None)
     ap.add_argument("--skip-cpu", action="store_true",
                     help="skip the CPU golden run + validation (reference always validates, bfs.cu:798-815)")
@@ -202,6 +205,9 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if (args.mesh or args.devices > 1) and args.backend in ("delta", "dopt"):
         ap.error(f"--backend {args.backend} is single-device only (for now)")
+    if args.mesh and args.exchange == "sparse":
+        ap.error("--exchange sparse pairs with 1D --devices meshes; the 2D "
+                 "engine's row/column collectives already move O(vp/dim) bits")
     if args.multi_source and (args.mesh or args.devices > 1):
         ap.error("--multi-source is single-device only (for now)")
     if (args.ckpt or args.resume) and (args.mesh or args.multi_source):

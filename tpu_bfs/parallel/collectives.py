@@ -15,6 +15,10 @@ Two implementations, selectable and cross-checked in tests:
   frontier combine is OR / parent combine is MIN.
 - ``allreduce``: whole-buffer `lax.psum`/`pmin` + local slice. Simpler,
   ~2x the bytes on the wire.
+- ``sparse`` (`sparse_exchange_or`): two-phase queue-style exchange — the
+  TPU form of the reference's per-destination frontier buckets. Moves only
+  actual frontier ids when every bucket fits a static cap; falls back to
+  the dense ring bitmap level-by-level otherwise.
 """
 
 from __future__ import annotations
@@ -53,8 +57,20 @@ def ring_reduce_scatter(x_full, axis_name: str, num_devices: int, op):
     return lax.fori_loop(1, p, step, acc, unroll=True)
 
 
+def _check_impl(impl: str) -> None:
+    # Loud rejection: an unknown impl (typo, or 'sparse' reaching an engine
+    # that only does dense reduce-scatter) must not silently run allreduce.
+    if impl not in ("ring", "allreduce"):
+        raise ValueError(
+            f"unknown reduce-scatter impl {impl!r}; have 'ring', 'allreduce' "
+            "(the queue-style exchange is sparse_exchange_or, wired only "
+            "through engines that accept exchange='sparse')"
+        )
+
+
 def reduce_scatter_or(x_full, axis_name: str, num_devices: int, *, impl: str = "ring"):
     """OR-reduce-scatter of a boolean contribution buffer (frontier exchange)."""
+    _check_impl(impl)
     if impl == "ring":
         return ring_reduce_scatter(x_full, axis_name, num_devices, jnp.logical_or)
     n = x_full.shape[0] // num_devices
@@ -65,8 +81,119 @@ def reduce_scatter_or(x_full, axis_name: str, num_devices: int, *, impl: str = "
 def reduce_scatter_min(x_full, axis_name: str, num_devices: int, *, impl: str = "ring"):
     """MIN-reduce-scatter of an int32 contribution buffer (parent merge —
     the analog of the reference's elementwise min result merge, bfs.cu:426-438)."""
+    _check_impl(impl)
     if impl == "ring":
         return ring_reduce_scatter(x_full, axis_name, num_devices, jnp.minimum)
     n = x_full.shape[0] // num_devices
     m = lax.pmin(x_full, axis_name)
     return _chunk(m, lax.axis_index(axis_name), n)
+
+
+def dense_or_wire_bytes(p: int, n: int, impl: str) -> float:
+    """Off-chip bytes one chip moves per level for the dense bitmap exchange.
+
+    ``ring`` sends P-1 chunks of n bools (1 byte each on the wire);
+    ``allreduce`` psums an int32 [P*n] buffer — bandwidth-optimal allreduce
+    moves 2*(P-1)*n int32 per chip."""
+    if p == 1:
+        return 0.0
+    return float(2 * (p - 1) * n * 4 if impl == "allreduce" else (p - 1) * n)
+
+
+def default_sparse_caps(vloc: int) -> tuple[int, ...]:
+    """Two-tier cap ladder: a tight cap for trickle levels (BFS start/tail,
+    high-diameter graphs) and a wide one that still undercuts the bitmap's
+    vloc wire bytes by ~2x (ids cost 4 bytes each)."""
+    return tuple(sorted({max(16, vloc // 64), max(16, vloc // 8)}))
+
+
+def sparse_exchange_or(
+    x_full, axis_name: str, num_devices: int, *, caps: tuple[int, ...]
+):
+    """Two-phase sparse (queue-style) frontier exchange.
+
+    The TPU-native form of the reference's per-destination frontier buckets:
+    `queueBfs` appends claimed vertices into per-destination-device buckets
+    (bfs.cu:148-150), the driver peer-copies `nextQueueSize[j][i]` entries
+    per pair (bfs.cu:604-606), and the MPI fork discovers variable receive
+    sizes with `MPI_Sendrecv` + `MPI_Get_count` (bfs_mpi.cu:615-617). XLA has
+    no variable-size messages (SURVEY.md §7.4), so sizes go first:
+
+    - phase 1: `pmax` of the largest per-destination chunk popcount — one
+      scalar — picks, level by level, the smallest cap in the static
+      ascending ``caps`` ladder that covers every bucket;
+    - phase 2a (some cap fits): compact each destination chunk's set bits
+      into a static ``[P, cap]`` id buffer (cumsum compaction — the
+      reference's dead scan-BFS queue generation, bfs.cu:706-781, as one
+      XLA program), `all_to_all` it, and scatter-OR the received ids into
+      the local chunk;
+    - phase 2b (every cap overflows): dense ring bitmap reduce-scatter —
+      on heavy mid-BFS levels of power-law graphs the bitmap IS the compact
+      encoding.
+
+    `lax.cond` executes exactly one branch at runtime (the pmax scalar is
+    mesh-uniform, so every chip takes the same branch and the collectives
+    stay matched). Returns ``(hit [n] bool, branch int32)`` — ``branch`` is
+    the index of the cap that ran (ascending ladder order) or ``len(caps)``
+    for the dense fallback; callers accumulate exact int32 per-branch level
+    counts and convert to wire bytes on the host
+    (``sparse_wire_bytes_per_level``), so the traffic accounting never
+    loses small sparse levels to float rounding.
+    """
+    p = num_devices
+    n = x_full.shape[0] // p
+    ladder = sorted(caps)
+    if p == 1:
+        return x_full, jnp.int32(len(ladder))
+    i = lax.axis_index(axis_name)
+    chunks = x_full.reshape(p, n)
+    # The self-destination bucket never crosses the wire: it ORs in locally
+    # below and is excluded from cap selection, so partition-aligned frontier
+    # growth (community/grid graphs expanding within one chip's range) stays
+    # on the cheap sparse path instead of tripping the dense fallback.
+    self_row = jnp.arange(p, dtype=jnp.int32)[:, None] == i  # [p, 1]
+    remote = chunks & ~self_row
+    counts = jnp.sum(remote.astype(jnp.int32), axis=1)
+    biggest = lax.pmax(jnp.max(counts), axis_name)
+    rows = jnp.broadcast_to(jnp.arange(p, dtype=jnp.int32)[:, None], (p, n))
+    local_ids = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[None, :], (p, n))
+
+    def make_sparse(cap, idx):
+        def sparse_path(_):
+            pos = jnp.cumsum(remote.astype(jnp.int32), axis=1)
+            slot = jnp.where(remote, pos - 1, cap)  # unset/self -> dropped
+            buf = jnp.full((p, cap), n, jnp.int32)  # n = "no entry" sentinel
+            buf = buf.at[rows, slot].set(local_ids, mode="drop")
+            recv = lax.all_to_all(buf, axis_name, 0, 0, tiled=True)  # [p, cap]
+            hit = (
+                jnp.zeros((n,), jnp.bool_)
+                .at[recv.reshape(-1)]
+                .set(True, mode="drop")
+            )
+            return hit | jnp.take(chunks, i, axis=0), jnp.int32(idx)
+
+        return sparse_path
+
+    def dense_path(_):
+        hit = ring_reduce_scatter(x_full, axis_name, p, jnp.logical_or)
+        return hit, jnp.int32(len(ladder))
+
+    step = dense_path
+    for idx in range(len(ladder) - 1, -1, -1):
+        step = partial(
+            lax.cond, biggest <= ladder[idx], make_sparse(ladder[idx], idx), step
+        )
+    return step(None)
+
+
+def sparse_wire_bytes_per_level(
+    p: int, n: int, caps: tuple[int, ...]
+) -> list[float]:
+    """Host-side off-chip bytes per level for each sparse_exchange_or branch,
+    in branch-index order (ascending caps, then the dense ring fallback).
+    Each branch pays 4 bytes for the phase-1 pmax scalar."""
+    if p == 1:
+        return [0.0] * (len(caps) + 1)
+    return [float((p - 1) * c * 4 + 4) for c in sorted(caps)] + [
+        dense_or_wire_bytes(p, n, "ring") + 4.0
+    ]

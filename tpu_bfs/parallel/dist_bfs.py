@@ -34,7 +34,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tpu_bfs.algorithms.bfs import BfsResult
 from tpu_bfs.algorithms.frontier import INT32_MAX, expand_or
 from tpu_bfs.graph.csr import Graph, INF_DIST
-from tpu_bfs.parallel.collectives import reduce_scatter_or, reduce_scatter_min
+from tpu_bfs.parallel.collectives import (
+    default_sparse_caps,
+    dense_or_wire_bytes,
+    reduce_scatter_or,
+    reduce_scatter_min,
+    sparse_exchange_or,
+    sparse_wire_bytes_per_level,
+)
 from tpu_bfs.parallel.partition import Partition1D, partition_1d
 from tpu_bfs.utils.timing import run_timed
 
@@ -55,8 +62,19 @@ def make_mesh(num_devices: int | None = None, devices=None) -> Mesh:
     return Mesh(np.array(devices), ("v",))
 
 
-def _dist_bfs_fn(mesh: Mesh, p: int, vloc: int, exchange: str, backend: str):
-    """Build the shard_map'd BFS level loop for a fixed mesh/partition."""
+def _dist_bfs_fn(
+    mesh: Mesh, p: int, vloc: int, exchange: str, backend: str,
+    sparse_caps: tuple[int, ...],
+):
+    """Build the shard_map'd BFS level loop for a fixed mesh/partition.
+
+    ``exchange='sparse'`` swaps the dense bitmap reduce-scatter for the
+    two-phase queue-style exchange (collectives.sparse_exchange_or — the
+    analog of the reference's per-destination buckets, bfs.cu:148-150).
+    The loop carry counts, per exchange branch, how many levels ran it
+    (exact int32 — wire bytes are reconstructed on the host, immune to the
+    float rounding a byte accumulator would hit at scale)."""
+    nb = len(sparse_caps) + 1 if exchange == "sparse" else 1
 
     def local_loop(src_e, dst_e, rp_e, frontier, visited, dist, level0, max_levels):
         # Blocks: src_e/dst_e [1, ep], rp_e [1, vp+1], vertex arrays [vloc].
@@ -68,25 +86,35 @@ def _dist_bfs_fn(mesh: Mesh, p: int, vloc: int, exchange: str, backend: str):
         vp = p * vloc
 
         def cond(state):
-            _, _, _, level, front_count = state
+            _, _, _, level, front_count, _ = state
             return (front_count > 0) & (level < max_levels)
 
         def body(state):
-            frontier, visited, dist, level, _ = state
+            frontier, visited, dist, level, _, branch_counts = state
             active = frontier[src_local]
             contrib = expand_or(active, dst_e, rp_e, vp, backend=backend)
-            hit = reduce_scatter_or(contrib, "v", p, impl=exchange)
+            if exchange == "sparse":
+                hit, branch = sparse_exchange_or(contrib, "v", p, caps=sparse_caps)
+            else:
+                hit = reduce_scatter_or(contrib, "v", p, impl=exchange)
+                branch = jnp.int32(0)
+            branch_counts = branch_counts + (
+                jnp.arange(nb, dtype=jnp.int32) == branch
+            )
             new = hit & ~visited
             dist = jnp.where(new, level + 1, dist)
             visited = visited | new
             count = lax.psum(jnp.sum(new.astype(jnp.int32)), "v")
-            return new, visited, dist, level + 1, count
+            return new, visited, dist, level + 1, count, branch_counts
 
         init_count = lax.psum(jnp.sum(frontier.astype(jnp.int32)), "v")
-        frontier, visited, dist, level, _ = lax.while_loop(
-            cond, body, (frontier, visited, dist, jnp.int32(level0), init_count)
+        frontier, visited, dist, level, _, branch_counts = lax.while_loop(
+            cond,
+            body,
+            (frontier, visited, dist, jnp.int32(level0), init_count,
+             jnp.zeros(nb, jnp.int32)),
         )
-        return frontier, visited, dist, level
+        return frontier, visited, dist, level, branch_counts
 
     return jax.jit(
         jax.shard_map(
@@ -102,7 +130,7 @@ def _dist_bfs_fn(mesh: Mesh, p: int, vloc: int, exchange: str, backend: str):
                 P(),
                 P(),
             ),
-            out_specs=(P("v"), P("v"), P("v"), P()),
+            out_specs=(P("v"), P("v"), P("v"), P(), P()),
             check_vma=False,
         )
     )
@@ -157,7 +185,14 @@ class DistBfsEngine:
         num_devices: int | None = None,
         exchange: str = "ring",
         backend: str = "scan",
+        sparse_caps: int | tuple[int, ...] | None = None,
     ):
+        if exchange not in ("ring", "allreduce", "sparse"):
+            # Before the partition/device_put work, so a typo fails instantly.
+            raise ValueError(
+                f"unknown exchange {exchange!r}; have 'ring', 'allreduce', 'sparse'"
+            )
+        self._exchange = exchange
         self.mesh = mesh if mesh is not None else make_mesh(num_devices)
         self.p = self.mesh.devices.size
         self.graph_meta = (graph.num_input_edges, graph.undirected)
@@ -169,9 +204,33 @@ class DistBfsEngine:
         self.dst = jax.device_put(dst_stacked, edge_sharding)
         self.rp = jax.device_put(rp_stacked, edge_sharding)
         self._vec_sharding = NamedSharding(self.mesh, P("v"))
-        self._loop = _dist_bfs_fn(self.mesh, self.p, part.vloc, exchange, backend)
-        self._parents = _dist_parents_fn(self.mesh, self.p, part.vloc, exchange)
+        if sparse_caps is None:
+            sparse_caps = default_sparse_caps(part.vloc)
+        elif isinstance(sparse_caps, int):
+            sparse_caps = (sparse_caps,)
+        self.sparse_caps = tuple(sorted(sparse_caps))
+        self._loop = _dist_bfs_fn(
+            self.mesh, self.p, part.vloc, exchange, backend, self.sparse_caps
+        )
+        # Parent merge is a one-shot int32 MIN reduce-scatter — queue-style
+        # exchange does not apply; 'sparse' rides the ring there.
+        parent_impl = "ring" if exchange == "sparse" else exchange
+        self._parents = _dist_parents_fn(self.mesh, self.p, part.vloc, parent_impl)
+        #: per-branch level counts of the last traversal (ascending sparse
+        #: caps then dense fallback; dense impls have the single entry) and
+        #: the off-chip bytes one chip moved — set by distances_padded/advance.
+        self.last_exchange_level_counts: np.ndarray | None = None
+        self.last_exchange_bytes: float | None = None
         self._warmed = False
+
+    def _record_exchange(self, branch_counts) -> None:
+        counts = np.asarray(branch_counts)
+        if self._exchange == "sparse":
+            per = sparse_wire_bytes_per_level(self.p, self.part.vloc, self.sparse_caps)
+        else:
+            per = [dense_or_wire_bytes(self.p, self.part.vloc, self._exchange)]
+        self.last_exchange_level_counts = counts
+        self.last_exchange_bytes = float(np.dot(counts, per))
 
     def _init_state(self, source: int):
         part = self.part
@@ -187,10 +246,11 @@ class DistBfsEngine:
         """Device (padded-id, sharded) distance vector + level counter."""
         frontier0, visited0, dist0 = self._init_state(source)
         ml = jnp.int32(max_levels if max_levels is not None else self.part.vp)
-        _, _, dist, level = self._loop(
+        _, _, dist, level, branch_counts = self._loop(
             self.src, self.dst, self.rp, frontier0, visited0, dist0,
             jnp.int32(0), ml,
         )
+        self._record_exchange(branch_counts)
         return dist, level
 
     # --- checkpoint/resume (SURVEY.md §5: the reference has none) ---
@@ -233,11 +293,12 @@ class DistBfsEngine:
         f0, vis0, d0 = self._pad_state(ckpt)
         put = partial(jax.device_put, device=self._vec_sharding)
         cap = ckpt.level + levels if levels is not None else part.vp
-        frontier, visited, dist, level = self._loop(
+        frontier, visited, dist, level, branch_counts = self._loop(
             self.src, self.dst, self.rp,
             put(f0), put(vis0), put(d0),
             jnp.int32(ckpt.level), jnp.int32(min(cap, part.vp)),
         )
+        self._record_exchange(branch_counts)
         return BfsCheckpoint(
             source=ckpt.source,
             level=int(level),

@@ -147,6 +147,14 @@ class Dist2DBfsEngine:
             mesh = make_mesh_2d(rows or 1, cols or 1)
         if tuple(mesh.axis_names) != ("r", "c"):
             raise ValueError("2D engine needs a mesh with axes ('r', 'c')")
+        if exchange not in ("ring", "allreduce"):
+            # Reject loudly at build time (not deep inside shard_map tracing):
+            # in particular 'sparse' is a 1D-engine feature — the 2D row/col
+            # collectives already move O(vp/dim) bits per chip.
+            raise ValueError(
+                f"unknown exchange {exchange!r} for the 2D engine; "
+                "have 'ring', 'allreduce'"
+            )
         self.mesh = mesh
         self.rows, self.cols = (
             mesh.devices.shape[0],

@@ -205,8 +205,7 @@ def _build_residual_shards(
         res_arrs[f"light{i}_t"] = np.ascontiguousarray(
             blocks.transpose(0, 2, 1)
         )
-    res_slots = (num_virtual * kcap + sum(k * n_of_k[k] for k in ks)) * p_count
-    return spec, res_arrs, np.stack(perms), res_slots
+    return spec, res_arrs, np.stack(perms)
 
 
 def build_dist_hybrid(
@@ -263,7 +262,7 @@ def build_dist_hybrid(
 
     # --- residual: per-chip ELL over each chip's own rows ---
     re_mask = ~dense_edge
-    spec, res_arrs, perm_s, res_slots = _build_residual_shards(
+    spec, res_arrs, perm_s = _build_residual_shards(
         r[re_mask].astype(np.int64),
         c[re_mask].astype(np.int32),
         p_count,
@@ -310,7 +309,6 @@ def build_dist_hybrid(
         "a_tiles_s": a_tiles_s,
         "res_spec": spec,
         "res_arrs": res_arrs,
-        "res_slots": res_slots,
         "perm_s": perm_s,
         "valid_s": valid_s,
     }

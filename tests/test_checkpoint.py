@@ -200,3 +200,123 @@ class TestDistributed:
         st = eng4.start(0)
         with pytest.raises(ValueError):
             ckpt_mod.save_checkpoint_sharded("/tmp/nope", st, num_shards=10**9)
+
+
+class TestPacked:
+    """Checkpoint/resume of the 4096-lane packed batch engines — the
+    expensive state worth persisting at scale (planes + visited + frontier
+    + lane map, utils/checkpoint.py::PackedCheckpoint)."""
+
+    SOURCES = np.array([1, 5, 9, 33])
+
+    @pytest.fixture(scope="class")
+    def hybrid(self, rmat_small):
+        from tpu_bfs.algorithms.msbfs_hybrid import HybridMsBfsEngine
+
+        return HybridMsBfsEngine(rmat_small, lanes=64, tile_thr=4)
+
+    @pytest.fixture(scope="class")
+    def wide(self, rmat_small):
+        from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
+
+        return WidePackedMsBfsEngine(rmat_small, lanes=64)
+
+    def _roundtrip(self, eng, tmp_path):
+        full = eng.run(self.SOURCES)
+        st = eng.start(self.SOURCES)
+        path = str(tmp_path / "packed.npz")
+        hops = 0
+        while not st.done:
+            st = eng.advance(st, levels=2)
+            ckpt_mod.save_packed_checkpoint(path, st)
+            st = ckpt_mod.load_packed_checkpoint(path)
+            hops += 1
+            assert hops < 64
+        res = eng.finish(st)
+        assert res.num_levels == full.num_levels
+        np.testing.assert_array_equal(res.reached, full.reached)
+        np.testing.assert_array_equal(res.edges_traversed, full.edges_traversed)
+        for i in range(len(self.SOURCES)):
+            np.testing.assert_array_equal(
+                res.distances_int32(i), full.distances_int32(i)
+            )
+
+    def test_hybrid_roundtrip_bit_identical(self, hybrid, tmp_path):
+        self._roundtrip(hybrid, tmp_path)
+
+    def test_wide_roundtrip_bit_identical(self, wide, tmp_path):
+        self._roundtrip(wide, tmp_path)
+
+    def test_cross_engine_resume(self, hybrid, wide, tmp_path):
+        # Checkpoints live in real-vertex-id row order, so a batch started
+        # on the gather-only wide engine resumes on the MXU hybrid engine.
+        full = hybrid.run(self.SOURCES)
+        st = wide.advance(wide.start(self.SOURCES), levels=2)
+        while not st.done:
+            st = hybrid.advance(st, levels=2)
+        res = hybrid.finish(st)
+        for i in range(len(self.SOURCES)):
+            np.testing.assert_array_equal(
+                res.distances_int32(i), full.distances_int32(i)
+            )
+
+    def test_advance_after_done_is_noop(self, wide):
+        st = wide.start(self.SOURCES)
+        while not st.done:
+            st = wide.advance(st)
+        st2 = wide.advance(st, levels=3)
+        assert st2 is st
+
+    def test_isolated_source_lane(self, wide, rmat_small):
+        # Isolated sources have no table row; finish patches their lanes.
+        iso = int(np.flatnonzero(rmat_small.degrees == 0)[0])
+        st = wide.start(np.array([1, iso]))
+        while not st.done:
+            st = wide.advance(st)
+        res = wide.finish(st)
+        assert res.reached[1] == 1
+        d = res.distances_int32(1)
+        assert d[iso] == 0
+
+    def test_packed_vs_single_source_loader_rejection(self, wide, tmp_path):
+        st = wide.advance(wide.start(self.SOURCES), levels=1)
+        path = str(tmp_path / "pk.npz")
+        ckpt_mod.save_packed_checkpoint(path, st)
+        with pytest.raises(ValueError, match="packed-batch checkpoint"):
+            ckpt_mod.load_checkpoint(path)
+
+    def test_advance_raises_at_plane_cap_truncation(self, line_graph):
+        # 64-vertex path, eccentricity 63 > the 4-plane cap of 16: the
+        # chunked advance loop must raise (like run's check_cap) instead of
+        # pinning at the cap forever with done=False (a silent infinite
+        # checkpoint loop in the CLI).
+        from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
+
+        eng = WidePackedMsBfsEngine(line_graph, lanes=32, num_planes=4)
+        st = eng.start(np.array([0]))
+        with pytest.raises(RuntimeError, match="truncated"):
+            for _ in range(64):
+                st = eng.advance(st, levels=8)
+                if st.done:
+                    break
+
+    def test_advance_completes_exactly_at_cap(self, line_graph):
+        # Source 47 on the 64-path: eccentricity 47 -> 16 levels reach
+        # vertices 31..63; from the middle (31) eccentricity is 32 == the
+        # 5-plane cap. Landing exactly on the cap is completion, not
+        # truncation, and num_levels must match the uninterrupted run.
+        from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
+
+        eng = WidePackedMsBfsEngine(line_graph, lanes=32, num_planes=5)
+        full = eng.run(np.array([31]))
+        assert full.num_levels == 32  # sits exactly on the cap
+        st = eng.start(np.array([31]))
+        for _ in range(64):
+            st = eng.advance(st, levels=8)
+            if st.done:
+                break
+        res = eng.finish(st)
+        assert res.num_levels == full.num_levels
+        np.testing.assert_array_equal(
+            res.distances_int32(0), full.distances_int32(0)
+        )

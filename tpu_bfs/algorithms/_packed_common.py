@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpu_bfs.graph.csr import INF_DIST
-from tpu_bfs.algorithms.msbfs_packed import UNREACHED
+from tpu_bfs.algorithms.msbfs_packed import UNREACHED, ripple_increment
 
 
 def auto_lanes(
@@ -86,6 +86,72 @@ def auto_planes(
         ):
             return p
     return preferred
+
+
+def make_packed_loop(hit_of, num_planes: int):
+    """The level loop shared by the wide and hybrid engines, as two jitted
+    entry points over one body:
+
+    - ``core(arrs, fw0, max_levels)`` — a fresh traversal (the historical
+      signature): visited starts as the seed table, planes at zero;
+    - ``core_from(arrs, fw, vis, planes, level0, max_levels)`` — resume from
+      mid-traversal state, the checkpoint/restart entry (the reference has
+      no checkpointing at all, SURVEY.md §5). Because the while-loop carry
+      IS the traversal state, resuming from a saved carry is bit-identical
+      to never having stopped.
+
+    ``hit_of(arrs, fw)`` is the engine's one-level frontier expansion
+    (gather-only for the wide engine; MXU tiles + gather residual +
+    permutation for the hybrid).
+    """
+
+    def _run(arrs, fw, vis, planes, level0, max_levels):
+        def cond(carry):
+            _, _, _, level, alive = carry
+            return alive & (level < max_levels)
+
+        def body(carry):
+            fw, vis, planes, level, _ = carry
+            nxt = hit_of(arrs, fw) & ~vis
+            vis2 = vis | nxt
+            # Pad/sentinel rows count up harmlessly (never visited, sliced
+            # off at extraction).
+            planes = ripple_increment(planes, ~vis2)
+            alive = jnp.any(nxt != 0)
+            return nxt, vis2, planes, level + 1, alive
+
+        return jax.lax.while_loop(
+            cond, body, (fw, vis, planes, level0, jnp.bool_(True))
+        )
+
+    def _truncated(arrs, fw_f, vis_f, levels, alive, max_levels):
+        # `alive` only says the last body claimed something. When the loop
+        # exits at the cap, distances <= max_levels are all labeled
+        # correctly; the traversal is incomplete only if one MORE level
+        # would claim vertices. Decide that with a single claim-free
+        # expand, so a traversal whose eccentricity lands exactly on the
+        # cap does not falsely report truncation.
+        def deeper():
+            return jnp.any((hit_of(arrs, fw_f) & ~vis_f) != 0)
+
+        return jax.lax.cond(
+            alive & (levels >= max_levels), deeper, lambda: jnp.bool_(False)
+        )
+
+    @jax.jit
+    def core(arrs, fw0, max_levels):
+        planes0 = tuple(jnp.zeros_like(fw0) for _ in range(num_planes))
+        fw_f, vis_f, planes_f, levels, alive = _run(
+            arrs, fw0, fw0, planes0, jnp.int32(0), max_levels
+        )
+        truncated = _truncated(arrs, fw_f, vis_f, levels, alive, max_levels)
+        return planes_f, vis_f, levels, alive, truncated
+
+    @jax.jit
+    def core_from(arrs, fw, vis, planes, level0, max_levels):
+        return _run(arrs, fw, vis, planes, level0, max_levels)
+
+    return core, core_from
 
 
 class ExpandSpec(NamedTuple):
@@ -306,6 +372,172 @@ class PackedBatchResult:
         return np.where(d8 == UNREACHED, INF_DIST, d8.astype(np.int32))
 
 
+def _check_batch_sources(engine, sources) -> np.ndarray:
+    sources = np.asarray(sources, dtype=np.int64)
+    if sources.ndim != 1 or len(sources) == 0 or len(sources) > engine.lanes:
+        raise ValueError(f"need 1..{engine.lanes} sources, got {sources.shape}")
+    if sources.min() < 0 or sources.max() >= engine.num_vertices:
+        raise ValueError("source out of range")
+    return sources
+
+
+def packed_table_to_real(engine, table) -> np.ndarray:
+    """Engine-layout [rows, w] packed table -> real-vertex-id [V, w] host
+    array. Rows of isolated vertices (no table row) and the engine's
+    pad/sentinel rows come out all-zero — exactly their live information
+    content. The real-id layout is what checkpoints store, so a checkpoint
+    taken on one packed engine resumes on any other over the same graph."""
+    t = np.asarray(table)
+    real = np.zeros((engine.num_vertices, engine.w), np.uint32)
+    m = engine._rank < engine._act
+    real[m] = t[engine._rank[m]]
+    return real
+
+
+def packed_real_to_table(engine, real):
+    """Real-vertex-id [V, w] checkpoint array -> engine-layout [rows, w]."""
+    if real.shape != (engine.num_vertices, engine.w):
+        raise ValueError(
+            f"checkpoint table is {real.shape}, engine expects "
+            f"({engine.num_vertices}, {engine.w}) — lane count and graph "
+            "must match the engine the checkpoint resumes on"
+        )
+    t = np.zeros((engine._table_rows, engine.w), np.uint32)
+    m = engine._rank < engine._act
+    t[engine._rank[m]] = real[m]
+    return jnp.asarray(t)
+
+
+def start_packed_batch(engine, sources):
+    """Level-0 packed traversal state as a host checkpoint.
+
+    The packed analog of the single-source engines' ``start`` (SURVEY.md §5:
+    the reference has no checkpointing; a failed rank loses the whole
+    traversal). State = frontier/visited tables + ``num_planes`` bit-sliced
+    distance planes, all in real-vertex-id row order."""
+    from tpu_bfs.utils.checkpoint import PackedCheckpoint
+
+    sources = _check_batch_sources(engine, sources)
+    seed_real = packed_table_to_real(engine, engine._seed_dev(sources))
+    planes = np.zeros(
+        (engine.num_planes, engine.num_vertices, engine.w), np.uint32
+    )
+    return PackedCheckpoint(
+        sources=sources,
+        level=0,
+        alive=True,
+        frontier=seed_real,
+        visited=seed_real.copy(),
+        planes=planes,
+    )
+
+
+def advance_packed_batch(engine, ckpt, levels: int | None = None):
+    """Run at most ``levels`` more level-steps from a packed checkpoint.
+
+    The while-loop carry is restored exactly, so chunked advancing labels
+    the same distances bit-for-bit as one uninterrupted run."""
+    from tpu_bfs.utils.checkpoint import PackedCheckpoint
+
+    if ckpt.planes.shape[0] != engine.num_planes:
+        raise ValueError(
+            f"checkpoint has {ckpt.planes.shape[0]} planes, engine has "
+            f"{engine.num_planes}"
+        )
+    if not ckpt.alive:
+        return ckpt
+    cap = engine.max_levels_cap
+    ml = min(ckpt.level + levels, cap) if levels is not None else cap
+    fw = packed_real_to_table(engine, ckpt.frontier)
+    vis = packed_real_to_table(engine, ckpt.visited)
+    planes = tuple(packed_real_to_table(engine, p) for p in ckpt.planes)
+    fw_f, vis_f, planes_f, level, alive = engine._core_from(
+        engine.arrs, fw, vis, planes, jnp.int32(ckpt.level), jnp.int32(ml)
+    )
+    if bool(alive) and int(level) >= cap:
+        # At the plane cap with the last body still claiming: run the one
+        # boundary body. An eccentricity that lands exactly on the cap
+        # claims nothing more and terminates cleanly (matching the
+        # uninterrupted num_levels accounting); anything else is a genuine
+        # truncation and must raise rather than let callers' advance loops
+        # spin forever on a level counter that can no longer move.
+        fw_f, vis_f, planes_f, level, alive = engine._core_from(
+            engine.arrs, fw_f, vis_f, planes_f,
+            jnp.int32(int(level)), jnp.int32(int(level) + 1),
+        )
+        if bool(alive):
+            raise RuntimeError(
+                f"traversal truncated at {cap} levels; "
+                f"num_planes={engine.num_planes} caps at {cap} — construct "
+                "the engine with more planes for this graph"
+            )
+    return PackedCheckpoint(
+        sources=ckpt.sources,
+        level=int(level),
+        alive=bool(alive),
+        frontier=packed_table_to_real(engine, fw_f),
+        visited=packed_table_to_real(engine, vis_f),
+        planes=np.stack(
+            [packed_table_to_real(engine, p) for p in planes_f]
+        ),
+    )
+
+
+def _assemble_packed_result(
+    engine, sources, planes, vis, src_bits_raw, levels, alive, elapsed
+) -> PackedBatchResult:
+    """Result assembly shared by run_packed_batch and finish_packed_batch:
+    device-side lane stats, isolated-lane patching, sentinel-row src-bits
+    view, and the final-empty-frontier level adjustment."""
+    s = len(sources)
+    r, d = engine._lane_stats(vis, engine._in_deg_ranked)
+    reached = engine._lane_order(np.asarray(r))[:s].astype(np.int64)
+    slot_sum = engine._lane_order(np.asarray(d, dtype=np.float64))[:s]
+    edges = (slot_sum / 2 if engine.undirected else slot_sum).astype(np.int64)
+
+    # Lanes seeded at isolated sources have no device row: the table scan
+    # sees nothing, but the source itself is trivially reached.
+    iso = getattr(engine, "_iso_of", lambda s: None)(sources)
+    if iso is not None and iso.any():
+        reached[iso] = 1
+        edges[iso] = 0
+    else:
+        iso = None
+
+    # Engines whose result tables use a different row order than their seed
+    # table (the distributed wide engine) provide a converting view.
+    src_bits = getattr(engine, "_src_bits_view", lambda x: x)(src_bits_raw)
+    res = PackedBatchResult(
+        sources=sources.astype(np.int32),
+        num_levels=levels,
+        reached=reached,
+        edges_traversed=edges,
+        elapsed_s=elapsed,
+        _engine=engine,
+        _planes=planes,
+        _vis=vis,
+        _src_bits=src_bits,
+        _iso=iso,
+    )
+    # The loop's last body found an empty frontier iff not alive; then the
+    # max eccentricity is one less than the body count.
+    if levels > 0 and not alive:
+        res.num_levels = levels - 1
+    return res
+
+
+def finish_packed_batch(engine, ckpt) -> PackedBatchResult:
+    """Package a (finished or partial) packed checkpoint as a batch result,
+    with the same lazy per-word distance extraction as a direct run."""
+    sources = _check_batch_sources(engine, ckpt.sources)
+    vis = packed_real_to_table(engine, ckpt.visited)
+    planes = tuple(packed_real_to_table(engine, p) for p in ckpt.planes)
+    return _assemble_packed_result(
+        engine, sources, planes, vis, engine._seed_dev(sources),
+        ckpt.level, ckpt.alive, None,
+    )
+
+
 def run_packed_batch(
     engine,
     sources,
@@ -315,11 +547,7 @@ def run_packed_batch(
     check_cap: bool = True,
 ) -> PackedBatchResult:
     """Generic batch driver shared by the wide and hybrid engines."""
-    sources = np.asarray(sources, dtype=np.int64)
-    if sources.ndim != 1 or len(sources) == 0 or len(sources) > engine.lanes:
-        raise ValueError(f"need 1..{engine.lanes} sources, got {sources.shape}")
-    if sources.min() < 0 or sources.max() >= engine.num_vertices:
-        raise ValueError("source out of range")
+    sources = _check_batch_sources(engine, sources)
     cap = engine.max_levels_cap
     max_levels = cap if max_levels is None else min(max_levels, cap)
 
@@ -340,38 +568,6 @@ def run_packed_batch(
             "engine with more planes for this graph"
         )
 
-    s = len(sources)
-    r, d = engine._lane_stats(vis, engine._in_deg_ranked)
-    reached = engine._lane_order(np.asarray(r))[:s].astype(np.int64)
-    slot_sum = engine._lane_order(np.asarray(d, dtype=np.float64))[:s]
-    edges = (slot_sum / 2 if engine.undirected else slot_sum).astype(np.int64)
-
-    # Lanes seeded at isolated sources have no device row: the table scan
-    # sees nothing, but the source itself is trivially reached.
-    iso = getattr(engine, "_iso_of", lambda s: None)(sources)
-    if iso is not None and iso.any():
-        reached[iso] = 1
-        edges[iso] = 0
-    else:
-        iso = None
-
-    # Engines whose result tables use a different row order than their seed
-    # table (the distributed wide engine) provide a converting view.
-    src_bits = getattr(engine, "_src_bits_view", lambda x: x)(fw0)
-    res = PackedBatchResult(
-        sources=sources.astype(np.int32),
-        num_levels=levels,
-        reached=reached,
-        edges_traversed=edges,
-        elapsed_s=elapsed,
-        _engine=engine,
-        _planes=planes,
-        _vis=vis,
-        _src_bits=src_bits,
-        _iso=iso,
+    return _assemble_packed_result(
+        engine, sources, planes, vis, fw0, levels, bool(alive), elapsed
     )
-    # The loop's last body found an empty frontier iff not alive; then the
-    # max eccentricity is one less than the body count.
-    if levels > 0 and not bool(alive):
-        res.num_levels = levels - 1
-    return res

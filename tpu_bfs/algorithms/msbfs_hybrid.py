@@ -45,16 +45,19 @@ import numpy as np
 
 from tpu_bfs.graph.csr import Graph
 from tpu_bfs.graph.ell import EllBucket, bucketize_rows, rank_vertices
-from tpu_bfs.algorithms.msbfs_packed import ripple_increment
 from tpu_bfs.algorithms._packed_common import (
     ExpandSpec,
+    advance_packed_batch,
     auto_lanes,
     auto_planes,
     expand_arrays,
+    finish_packed_batch,
     make_fori_expand,
+    make_packed_loop,
     make_state_kernels,
     run_packed_batch,
     seed_scatter_args,
+    start_packed_batch,
 )
 from tpu_bfs.ops.tile_spmm import AW, TILE, tile_spmm
 
@@ -285,7 +288,6 @@ def build_hybrid(
 
 
 def _make_core(hg: HybridGraph, w: int, num_planes: int, interpret: bool):
-    rows = hg.vt * TILE
     spec = ExpandSpec(
         kcap=hg.kcap,
         heavy=hg.res_heavy > 0,
@@ -297,44 +299,16 @@ def _make_core(hg: HybridGraph, w: int, num_planes: int, interpret: bool):
     expand_residual = make_fori_expand(spec, w)
     has_dense = hg.num_tiles > 0
 
-    @jax.jit
-    def core(arrs, fw0, max_levels):
-        planes0 = tuple(jnp.zeros((rows, w), jnp.uint32) for _ in range(num_planes))
+    def hit_of(arrs, fw):
+        hit = expand_residual(arrs, fw)[arrs["inv_perm_ext"]]
+        if has_dense:
+            hit = hit | tile_spmm(
+                arrs["row_start"], arrs["col_tile"], arrs["a_tiles"], fw,
+                num_row_tiles=hg.vt, w=w, interpret=interpret,
+            )
+        return hit
 
-        def hit_of(fw):
-            hit = expand_residual(arrs, fw)[arrs["inv_perm_ext"]]
-            if has_dense:
-                hit = hit | tile_spmm(
-                    arrs["row_start"], arrs["col_tile"], arrs["a_tiles"], fw,
-                    num_row_tiles=hg.vt, w=w, interpret=interpret,
-                )
-            return hit
-
-        def cond(carry):
-            _, _, _, level, alive = carry
-            return alive & (level < max_levels)
-
-        def body(carry):
-            fw, vis, planes, level, _ = carry
-            nxt = hit_of(fw) & ~vis
-            vis2 = vis | nxt
-            planes = ripple_increment(planes, ~vis2)
-            alive = jnp.any(nxt != 0)
-            return nxt, vis2, planes, level + 1, alive
-
-        fw_f, vis_f, planes_f, levels, alive = jax.lax.while_loop(
-            cond, body, (fw0, fw0, planes0, jnp.int32(0), jnp.bool_(True))
-        )
-
-        def deeper():
-            return jnp.any((hit_of(fw_f) & ~vis_f) != 0)
-
-        truncated = jax.lax.cond(
-            alive & (levels >= max_levels), deeper, lambda: jnp.bool_(False)
-        )
-        return planes_f, vis_f, levels, alive, truncated
-
-    return core
+    return make_packed_loop(hit_of, num_planes)
 
 
 class HybridMsBfsEngine:
@@ -416,7 +390,8 @@ class HybridMsBfsEngine:
             arrs["a_tiles"] = jnp.asarray(hg.a_tiles)
         self.arrs = arrs
         self._act = hg.num_active
-        self._core = _make_core(hg, self.w, num_planes, interpret)
+        self._table_rows = hg.vt * TILE
+        self._core, self._core_from = _make_core(hg, self.w, num_planes, interpret)
         self._seed, self._lane_stats, self._extract_word = make_state_kernels(
             hg.num_vertices, hg.vt * TILE, self.w, num_planes,
             active=self._act,
@@ -452,3 +427,17 @@ class HybridMsBfsEngine:
             self, sources, max_levels=max_levels, time_it=time_it,
             check_cap=check_cap,
         )
+
+    # --- checkpoint/resume (_packed_common; SURVEY.md §5: reference has none) ---
+
+    def start(self, sources):
+        """Level-0 packed batch state as a host checkpoint (real-id rows)."""
+        return start_packed_batch(self, sources)
+
+    def advance(self, ckpt, levels: int | None = None):
+        """Run at most ``levels`` more levels; bit-identical to no stop."""
+        return advance_packed_batch(self, ckpt, levels)
+
+    def finish(self, ckpt):
+        """Package a (finished or partial) checkpoint as a batch result."""
+        return finish_packed_batch(self, ckpt)

@@ -39,15 +39,18 @@ import numpy as np
 
 from tpu_bfs.graph.csr import Graph
 from tpu_bfs.graph.ell import EllGraph, build_ell
-from tpu_bfs.algorithms.msbfs_packed import ripple_increment
 from tpu_bfs.algorithms._packed_common import (
     ExpandSpec,
+    advance_packed_batch,
     auto_lanes,
     expand_arrays,
+    finish_packed_batch,
     make_fori_expand,
+    make_packed_loop,
     make_state_kernels,
     run_packed_batch,
     seed_scatter_args,
+    start_packed_batch,
 )
 
 W = 128  # uint32 words per row: the measured v5e sweet spot (no tile padding)
@@ -70,46 +73,10 @@ def _make_core(ell: EllGraph, w: int, num_planes: int):
         # no row at all (rank space is active-first, graph/ell.py).
         tail_rows=act - ell.num_nonzero + 1,
     )
+    # fw is [act+1, w]: frontier bits; sentinel row act is all-zero and is
+    # never written (expand emits zero there, and `& ~vis` keeps it zero).
     expand = make_fori_expand(spec, w)
-
-    @jax.jit
-    def core(arrs, fw0, max_levels):
-        # fw0 [act+1, w]: frontier bits; sentinel row act is all-zero and is
-        # never written (expand emits zero there, and `& ~vis` keeps it zero).
-        planes0 = tuple(jnp.zeros((act + 1, w), jnp.uint32) for _ in range(num_planes))
-
-        def cond(carry):
-            _, _, _, level, alive = carry
-            return alive & (level < max_levels)
-
-        def body(carry):
-            fw, vis, planes, level, _ = carry
-            hit = expand(arrs, fw)
-            nxt = hit & ~vis
-            vis2 = vis | nxt
-            # Sentinel row counts up harmlessly (never visited, sliced off).
-            planes = ripple_increment(planes, ~vis2)
-            alive = jnp.any(nxt != 0)
-            return nxt, vis2, planes, level + 1, alive
-
-        fw_f, vis_f, planes_f, levels, alive = jax.lax.while_loop(
-            cond, body, (fw0, fw0, planes0, jnp.int32(0), jnp.bool_(True))
-        )
-        # `alive` only says the last body claimed something. When the loop
-        # exits at the cap, distances <= max_levels are all labeled correctly;
-        # the traversal is incomplete only if one MORE level would claim
-        # vertices. Decide that with a single claim-free expand, so a
-        # traversal whose eccentricity lands exactly on the cap does not
-        # falsely report truncation.
-        def deeper():
-            return jnp.any((expand(arrs, fw_f) & ~vis_f) != 0)
-
-        truncated = jax.lax.cond(
-            alive & (levels >= max_levels), deeper, lambda: jnp.bool_(False)
-        )
-        return planes_f, vis_f, levels, alive, truncated
-
-    return core
+    return make_packed_loop(expand, num_planes)
 
 
 class WidePackedMsBfsEngine:
@@ -157,7 +124,8 @@ class WidePackedMsBfsEngine:
         self.undirected = self.ell.undirected if undirected is None else undirected
         ell = self.ell
         self.arrs = expand_arrays(ell)
-        self._core = _make_core(ell, self.w, num_planes)
+        self._table_rows = self._act + 1  # + the all-zero sentinel row
+        self._core, self._core_from = _make_core(ell, self.w, num_planes)
         self._seed, self._lane_stats, self._extract_word = make_state_kernels(
             ell.num_vertices, self._act + 1, self.w, num_planes,
             active=self._act,
@@ -192,3 +160,17 @@ class WidePackedMsBfsEngine:
             self, sources, max_levels=max_levels, time_it=time_it,
             check_cap=check_cap,
         )
+
+    # --- checkpoint/resume (_packed_common; SURVEY.md §5: reference has none) ---
+
+    def start(self, sources):
+        """Level-0 packed batch state as a host checkpoint (real-id rows)."""
+        return start_packed_batch(self, sources)
+
+    def advance(self, ckpt, levels: int | None = None):
+        """Run at most ``levels`` more levels; bit-identical to no stop."""
+        return advance_packed_batch(self, ckpt, levels)
+
+    def finish(self, ckpt):
+        """Package a (finished or partial) checkpoint as a batch result."""
+        return finish_packed_batch(self, ckpt)

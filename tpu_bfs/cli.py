@@ -75,6 +75,9 @@ def _make_ms_engine(args, g, n_sources: int):
     engine = args.engine
     if engine is None:
         engine = "packed" if n_sources <= 512 else "hybrid"
+        if engine == "packed" and (args.ckpt or args.resume):
+            # Checkpointing needs resumable packed state (wide/hybrid).
+            engine = "wide"
     if engine == "packed":
         from tpu_bfs.algorithms.msbfs_packed import PackedMsBfsEngine
 
@@ -103,6 +106,19 @@ def _run_multi_source(args, g, golden) -> int:
         raise SystemExit(f"--multi-source must be comma-separated ints, got "
                          f"{args.multi_source!r}")
     sources = np.asarray([args.source] + extra)
+    resume_st = None
+    if args.resume:
+        # Packed-batch resume: the checkpoint carries the whole batch's
+        # sources; the command-line list is ignored in its favor.
+        from tpu_bfs.utils import checkpoint as ck
+
+        resume_st = ck.load_packed_checkpoint(args.resume)
+        sources = resume_st.sources
+        print(f"resumed {len(sources)} sources at level {resume_st.level}")
+        if golden is None and not args.skip_cpu:
+            from tpu_bfs.reference import bfs_golden
+
+            golden = bfs_golden(g, int(sources[0]))
     bad = sources[(sources < 0) | (sources >= g.num_vertices)]
     if len(bad):
         raise SystemExit(
@@ -111,23 +127,50 @@ def _run_multi_source(args, g, golden) -> int:
         )
     engine = _make_ms_engine(args, g, len(sources))
     res = None
-    try:
-        for _ in range(max(1, args.repeat)):
-            with _maybe_profile(args.profile_dir):
-                res = engine.run(
-                    sources,
-                    max_levels=args.max_levels if args.max_levels is not None else 254,
-                    time_it=True,
-                )
-    except RuntimeError as exc:
-        if "truncated" not in str(exc):
-            raise
-        raise SystemExit(
-            f"{exc}\nhint: rerun with --planes 8 (depth 254) or "
-            "--engine packed"
-        )
-    print(f"Elapsed time in milliseconds (device): {res.elapsed_s * 1e3:.3f} "
-          f"({len(sources)} sources)")
+    if args.ckpt or args.resume:
+        # Chunked batch traversal with durable packed state
+        # (tpu_bfs/utils/checkpoint.py::PackedCheckpoint): resume continues
+        # bit-identically to an uninterrupted batch run.
+        from tpu_bfs.utils import checkpoint as ck
+
+        st = resume_st if resume_st is not None else engine.start(sources)
+        cap = args.max_levels if args.max_levels is not None else float("inf")
+        try:
+            while not st.done and st.level < cap:
+                chunk = max(1, args.ckpt_every)
+                st = engine.advance(st, levels=min(chunk, int(cap) - st.level)
+                                    if cap != float("inf") else chunk)
+                if args.ckpt:
+                    ck.save_packed_checkpoint(args.ckpt, st)
+                    print(f"checkpoint @ level {st.level} -> {args.ckpt}")
+        except RuntimeError as exc:
+            if "truncated" not in str(exc):
+                raise
+            raise SystemExit(
+                f"{exc}\nhint: restart with --planes 8 (depth 254); a "
+                "checkpoint's plane count is fixed at start, so existing "
+                "checkpoints from this run cannot be resumed deeper"
+            )
+        res = engine.finish(st)
+    else:
+        try:
+            for _ in range(max(1, args.repeat)):
+                with _maybe_profile(args.profile_dir):
+                    res = engine.run(
+                        sources,
+                        max_levels=args.max_levels if args.max_levels is not None else 254,
+                        time_it=True,
+                    )
+        except RuntimeError as exc:
+            if "truncated" not in str(exc):
+                raise
+            raise SystemExit(
+                f"{exc}\nhint: rerun with --planes 8 (depth 254) or "
+                "--engine packed"
+            )
+    if res.elapsed_s is not None:
+        print(f"Elapsed time in milliseconds (device): "
+              f"{res.elapsed_s * 1e3:.3f} ({len(sources)} sources)")
     for i, s in enumerate(sources):
         print(f"source {int(s)}: reached {int(res.reached[i])} vertices, "
               f"traversed edges {int(res.edges_traversed[i])}")
@@ -196,7 +239,8 @@ def main(argv=None) -> int:
                     help="write a jax.profiler trace of the timed run here")
     ap.add_argument("--ckpt", default=None, metavar="PATH",
                     help="checkpoint the traversal state to PATH (npz "
-                    "format) every --ckpt-every levels (single-source modes)")
+                    "format) every --ckpt-every levels (single-source "
+                    "modes and single-device --multi-source batches)")
     ap.add_argument("--ckpt-every", type=int, default=4, metavar="N",
                     help="levels per checkpoint chunk (default 4)")
     ap.add_argument("--resume", default=None, metavar="PATH",
@@ -210,9 +254,14 @@ def main(argv=None) -> int:
                  "engine's row/column collectives already move O(vp/dim) bits")
     if args.multi_source and (args.mesh or args.devices > 1):
         ap.error("--multi-source is single-device only (for now)")
-    if (args.ckpt or args.resume) and (args.mesh or args.multi_source):
+    if (args.ckpt or args.resume) and args.mesh:
         ap.error("--ckpt/--resume work with the single-source engines "
-                 "(1D --devices meshes included)")
+                 "(1D --devices meshes included) and single-device "
+                 "--multi-source batches")
+    if (args.ckpt or args.resume) and args.multi_source and args.engine == "packed":
+        ap.error("--ckpt/--resume with --multi-source needs the wide or "
+                 "hybrid engine (the 512-lane packed engine keeps no "
+                 "resumable state)")
     if (args.ckpt or args.resume) and (args.repeat > 1 or args.profile_dir):
         ap.error("--repeat/--profile-dir do not apply to checkpointed runs")
     if args.multi_source and args.save_parent:
@@ -237,15 +286,19 @@ def main(argv=None) -> int:
 
     # On --resume the traversal's source comes from the checkpoint; load it
     # before the golden run so the CPU BFS happens once, for the right source.
+    # (Multi-source batches resume from a packed checkpoint inside
+    # _run_multi_source instead — their golden is computed there.)
     resume_st = None
-    if args.resume:
+    if args.resume and not args.multi_source:
         from tpu_bfs.utils import checkpoint as ck
 
         resume_st = ck.load_checkpoint(args.resume)
         print(f"resumed source {resume_st.source} at level {resume_st.level}")
 
     golden = None
-    if not args.skip_cpu:
+    # A resumed multi-source batch learns its sources from the packed
+    # checkpoint; _run_multi_source computes the golden itself.
+    if not args.skip_cpu and not (args.multi_source and args.resume):
         from tpu_bfs.reference import bfs_golden
 
         t0 = time.perf_counter()

@@ -223,8 +223,12 @@ class DistBfsEngine:
         self.last_exchange_bytes: float | None = None
         self._warmed = False
 
-    def _record_exchange(self, branch_counts) -> None:
+    def _record_exchange(self, branch_counts, *, accumulate: bool = False) -> None:
         counts = np.asarray(branch_counts)
+        if accumulate and self.last_exchange_level_counts is not None:
+            # Chunked (checkpointed) traversals: the counters cover the
+            # whole traversal, not just the last advance chunk.
+            counts = counts + self.last_exchange_level_counts
         if self._exchange == "sparse":
             per = sparse_wire_bytes_per_level(self.p, self.part.vloc, self.sparse_caps)
         else:
@@ -298,7 +302,7 @@ class DistBfsEngine:
             put(f0), put(vis0), put(d0),
             jnp.int32(ckpt.level), jnp.int32(min(cap, part.vp)),
         )
-        self._record_exchange(branch_counts)
+        self._record_exchange(branch_counts, accumulate=ckpt.level > 0)
         return BfsCheckpoint(
             source=ckpt.source,
             level=int(level),

@@ -102,12 +102,81 @@ def load_checkpoint(path: str) -> BfsCheckpoint:
     z = np.load(path)
     if int(z["version"]) != _STATE_VERSION:
         raise ValueError(f"unsupported checkpoint version {int(z['version'])}")
+    if "kind" in z.files and str(z["kind"]) == "packed":
+        raise ValueError(
+            f"{path} is a packed-batch checkpoint (use load_packed_checkpoint"
+            " / resume it with a multi-source engine)"
+        )
     return BfsCheckpoint(
         source=int(z["source"]),
         level=int(z["level"]),
         frontier=z["frontier"],
         visited=z["visited"],
         distance=z["distance"],
+    )
+
+
+@dataclasses.dataclass
+class PackedCheckpoint:
+    """Host-side snapshot of one packed multi-source batch traversal.
+
+    All tables are in REAL vertex-id row order ([V, w] uint32; lane ``l``
+    of batch entry order at word ``l // 32``, bit ``l % 32`` — the packed
+    engines' shared word-major lane map), so a checkpoint taken on one
+    packed engine resumes on any other over the same graph and lane count
+    (wide gather-only or hybrid MXU+gather). ``planes`` are the bit-sliced
+    distance counters ([P, V, w]); ``level`` is the completed level-step
+    count; ``alive`` is False once a step claimed nothing (terminated).
+
+    The reference checkpoints nothing (SURVEY.md §5) — and its per-source
+    process loop (bfs.cu:783-823) has no batch state to save in the first
+    place; this persists the expensive thing at scale: the whole 4096-lane
+    traversal's packed state.
+    """
+
+    sources: np.ndarray  # [S] int64
+    level: int
+    alive: bool
+    frontier: np.ndarray  # [V, w] uint32
+    visited: np.ndarray  # [V, w] uint32
+    planes: np.ndarray  # [P, V, w] uint32
+
+    @property
+    def done(self) -> bool:
+        return not self.alive
+
+
+def save_packed_checkpoint(path: str, ckpt: PackedCheckpoint) -> None:
+    """Write a packed-batch checkpoint as one ``.npz``, at exactly ``path``."""
+    _atomic_savez(
+        path,
+        version=_STATE_VERSION,
+        kind="packed",
+        sources=ckpt.sources,
+        level=ckpt.level,
+        alive=int(ckpt.alive),
+        frontier=ckpt.frontier,
+        visited=ckpt.visited,
+        planes=ckpt.planes,
+    )
+
+
+def load_packed_checkpoint(path: str) -> PackedCheckpoint:
+    z = np.load(path)
+    if int(z["version"]) != _STATE_VERSION:
+        raise ValueError(f"unsupported checkpoint version {int(z['version'])}")
+    if "kind" not in z.files or str(z["kind"]) != "packed":
+        raise ValueError(
+            f"{path} is not a packed-batch checkpoint (use load_checkpoint "
+            "for single-source state)"
+        )
+    return PackedCheckpoint(
+        sources=z["sources"].astype(np.int64),
+        level=int(z["level"]),
+        alive=bool(int(z["alive"])),
+        frontier=z["frontier"],
+        visited=z["visited"],
+        planes=z["planes"],
     )
 
 

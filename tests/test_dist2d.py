@@ -73,3 +73,32 @@ def test_dist2d_deep(line_graph):
     eng = Dist2DBfsEngine(line_graph, make_mesh_2d(2, 4))
     res = eng.run(0)
     np.testing.assert_array_equal(res.distance, np.arange(64))
+
+
+@pytest.mark.parametrize("shape", [(2, 4), (4, 2)])
+def test_dist2d_dopt_matches_golden(random_small, shape):
+    # The BASELINE scale-26 config shape: 2D edge partition x direction-
+    # optimizing expansion, rehearsed on the virtual CPU mesh.
+    eng = Dist2DBfsEngine(random_small, make_mesh_2d(*shape), backend="dopt")
+    golden, _ = bfs_python(random_small, 42)
+    res = eng.run(42)
+    validate.check_distances(res.distance, golden)
+    validate.check_parents(random_small, 42, res.distance, res.parent)
+
+
+def test_dist2d_dopt_deep_sparse_branch(line_graph):
+    # 1-vertex frontiers keep every level in the sparse top-down branch
+    # (caps well above any level's out-degree sum); distances must still be
+    # exact through the column-gather/row-scatter index spaces.
+    eng = Dist2DBfsEngine(
+        line_graph, make_mesh_2d(2, 4), backend="dopt", dopt_caps=(64, 1024)
+    )
+    res = eng.run(0)
+    np.testing.assert_array_equal(res.distance, np.arange(64))
+
+
+def test_dist2d_dopt_matches_dense_backend(rmat_small):
+    dense = Dist2DBfsEngine(rmat_small, make_mesh_2d(2, 2)).run(1)
+    dopt = Dist2DBfsEngine(rmat_small, make_mesh_2d(2, 2), backend="dopt").run(1)
+    np.testing.assert_array_equal(dense.distance, dopt.distance)
+    np.testing.assert_array_equal(dense.parent, dopt.parent)

@@ -161,3 +161,24 @@ def test_dist_stats_match_single(toy_graph):
         d.edges_traversed,
         d.num_levels,
     )
+
+
+@pytest.mark.parametrize("exchange", ["ring", "sparse"])
+def test_dist_dopt_matches_golden(random_small, exchange):
+    # Direction-optimizing expansion per chip: the sparse top-down branch is
+    # collective-free, so chips diverge safely; exchange stays outside.
+    eng = DistBfsEngine(
+        random_small, make_mesh(8), exchange=exchange, backend="dopt"
+    )
+    golden, _ = bfs_python(random_small, 3)
+    res = eng.run(3)
+    validate.check_distances(res.distance, golden)
+    validate.check_parents(random_small, 3, res.distance, res.parent)
+
+
+def test_dist_dopt_deep_sparse_branch(line_graph):
+    eng = DistBfsEngine(
+        line_graph, make_mesh(8), backend="dopt", dopt_caps=(64, 1024)
+    )
+    res = eng.run(0)
+    np.testing.assert_array_equal(res.distance, np.arange(64))

@@ -34,3 +34,18 @@ def test_run_graph500_single_and_batched():
     assert r2.validated and len(r2.teps) == 4
     r3 = run_graph500(8, 4, num_searches=4, mode="hybrid", validate_searches=2)
     assert r3.validated and len(r3.teps) == 4 and r3.harmonic_mean_teps > 0
+
+
+def test_run_graph500_distributed():
+    # Distributed single-stream over the 2D mesh with direction-optimizing
+    # expansion (the scale-26 target config at rehearsal scale), and the
+    # sharded-state hybrid engine over a 1D mesh.
+    r = run_graph500(
+        8, 4, num_searches=2, mode="single", validate_searches=2,
+        mesh2d=(2, 4), backend="dopt",
+    )
+    assert r.validated and len(r.teps) == 2
+    r2 = run_graph500(
+        8, 4, num_searches=8, mode="hybrid", validate_searches=2, devices=8,
+    )
+    assert r2.validated and len(r2.teps) == 8

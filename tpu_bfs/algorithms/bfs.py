@@ -18,7 +18,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpu_bfs.graph.csr import Graph, DeviceGraph, INF_DIST
-from tpu_bfs.algorithms.frontier import EdgeData, level_step, extract_parents, INT32_MAX
+from tpu_bfs.algorithms.frontier import (
+    EdgeData,
+    INT32_MAX,
+    default_dopt_caps,
+    extract_parents,
+    level_step,
+)
 from tpu_bfs.utils.timing import run_timed
 
 
@@ -110,12 +116,7 @@ class BfsEngine:
             dst_sm[dg.perm_ds] = dg.dst
             nbr_sm = put(jnp.asarray(dst_sm))
         if caps is None:
-            # Capacity ladder for the sparse branches: ~E/64 and ~E/8, lane-
-            # aligned. Levels whose frontier out-degree sum exceeds the top
-            # rung run the dense step.
-            caps = tuple(
-                max(1024, (dg.ep >> s) // 1024 * 1024) for s in (6, 3)
-            ) if need_dopt else ()
+            caps = default_dopt_caps(dg.ep) if need_dopt else ()
         self.caps = tuple(sorted(set(caps)))
         self.edges = EdgeData(
             src=self.src,

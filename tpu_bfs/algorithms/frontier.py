@@ -116,7 +116,10 @@ def active_bits_delta(frontier, out_rp, ep: int):
     return jnp.cumsum(delta, axis=0)[:ep] > 0
 
 
-def sparse_topdown(edges: EdgeData, frontier, visited, *, edge_cap: int, vert_cap: int):
+def sparse_topdown(
+    edges: EdgeData, frontier, visited=None, *, edge_cap: int, vert_cap: int,
+    out_size: int | None = None,
+):
     """One top-down level over ONLY the frontier's out-edges, in static shapes.
 
     The direction-optimizing counterpart of the dense step: compaction
@@ -126,8 +129,14 @@ def sparse_topdown(edges: EdgeData, frontier, visited, *, edge_cap: int, vert_ca
     fetches the neighbors, one scatter-or marks the hits. Work is
     O(edge_cap + vert_cap) regardless of E — callers pick this branch only
     when the frontier's out-degree sum fits (see level_step_dopt).
+
+    ``out_size`` sets the hit-vector length when neighbor ids live in a
+    different index space than the frontier (the distributed engines:
+    frontier is the owned/column-gathered slice, neighbors are global padded
+    or row-block-local ids); ``visited=None`` skips the claim — distributed
+    callers claim after the exchange collective instead.
     """
-    vp = frontier.shape[0]
+    vp = out_size if out_size is not None else frontier.shape[0]
     out_rp = edges.out_rp
     nfront = jnp.sum(frontier.astype(jnp.int32))
     (vids,) = jnp.nonzero(frontier, size=vert_cap, fill_value=0)
@@ -156,7 +165,50 @@ def sparse_topdown(edges: EdgeData, frontier, visited, *, edge_cap: int, vert_ca
     )
     # The guard writes at vp-1 may alias a real phantom-free graph's last
     # vertex only when valid is False there, so the value written is False.
-    return hit & ~visited
+    return hit if visited is None else hit & ~visited
+
+
+def default_dopt_caps(ep: int) -> tuple[int, ...]:
+    """Capacity ladder for the sparse top-down branches: ~E/64 and ~E/8,
+    lane-aligned. Levels whose frontier out-degree sum exceeds the top rung
+    run the dense step. Shared by the single-device and distributed engines
+    (``ep`` = the edge count the ladder scales against — per chip for the
+    distributed engines)."""
+    return tuple(max(1024, (ep >> s) // 1024 * 1024) for s in (6, 3))
+
+
+def make_dopt_expand(edata: EdgeData, caps, *, vert_limit: int, out_size: int,
+                     dense_fn):
+    """Claim-free direction-optimizing expansion for the distributed engines.
+
+    Returns ``expand(frontier) -> hit [out_size]``: the smallest ``caps``
+    rung covering the frontier's local out-degree sum runs sparse_topdown,
+    otherwise ``dense_fn(frontier)``. All branches are collective-free, so
+    distributed callers may let chips diverge per level — the exchange and
+    termination collectives sit outside the `lax.cond`. (The single-device
+    engine uses level_step_dopt instead, which folds the visited claim in.)
+    """
+    out_deg = edata.out_rp[1:] - edata.out_rp[:-1]
+
+    def expand(frontier):
+        fsum = jnp.sum(jnp.where(frontier, out_deg, 0))
+        nfront = jnp.sum(frontier.astype(jnp.int32))
+        step = lambda: dense_fn(frontier)
+        for edge_cap in sorted(caps, reverse=True):
+            vert_cap = min(edge_cap, vert_limit)
+            fits = (fsum <= edge_cap) & (nfront <= vert_cap)
+            step = partial(
+                lax.cond,
+                fits,
+                (lambda ec=edge_cap, vc=vert_cap: sparse_topdown(
+                    edata, frontier, None,
+                    edge_cap=ec, vert_cap=vc, out_size=out_size,
+                )),
+                step,
+            )
+        return step()
+
+    return expand
 
 
 def level_step_dopt(

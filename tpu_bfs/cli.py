@@ -112,7 +112,11 @@ def _run_multi_source(args, g, golden) -> int:
         # sources; the command-line list is ignored in its favor.
         from tpu_bfs.utils import checkpoint as ck
 
-        resume_st = ck.load_packed_checkpoint(args.resume)
+        try:
+            resume_st = ck.load_packed_checkpoint(args.resume)
+        except ValueError as exc:
+            # e.g. a single-source checkpoint resumed with --multi-source.
+            raise SystemExit(f"--resume: {exc}")
         sources = resume_st.sources
         print(f"resumed {len(sources)} sources at level {resume_st.level}")
         if golden is None and not args.skip_cpu:
@@ -136,13 +140,21 @@ def _run_multi_source(args, g, golden) -> int:
         st = resume_st if resume_st is not None else engine.start(sources)
         cap = args.max_levels if args.max_levels is not None else float("inf")
         try:
-            while not st.done and st.level < cap:
+            if not args.ckpt:
+                # Pure resume: run the remainder in one device pass — the
+                # per-chunk host<->device state roundtrips only pay off when
+                # a checkpoint is actually written between chunks.
+                if not st.done and st.level < cap:
+                    st = engine.advance(
+                        st,
+                        None if cap == float("inf") else int(cap) - st.level,
+                    )
+            while args.ckpt and not st.done and st.level < cap:
                 chunk = max(1, args.ckpt_every)
                 st = engine.advance(st, levels=min(chunk, int(cap) - st.level)
                                     if cap != float("inf") else chunk)
-                if args.ckpt:
-                    ck.save_packed_checkpoint(args.ckpt, st)
-                    print(f"checkpoint @ level {st.level} -> {args.ckpt}")
+                ck.save_packed_checkpoint(args.ckpt, st)
+                print(f"checkpoint @ level {st.level} -> {args.ckpt}")
         except RuntimeError as exc:
             if "truncated" not in str(exc):
                 raise
@@ -207,8 +219,10 @@ def main(argv=None) -> int:
                     "engine instead of the 1D vertex partition")
     ap.add_argument("--backend", default="scan",
                     choices=["scan", "segment", "scatter", "delta", "dopt"],
-                    help="single-device frontier-expansion backend ('dopt' = "
-                    "direction-optimizing top-down/bottom-up switch)")
+                    help="frontier-expansion backend ('dopt' = direction-"
+                    "optimizing top-down/bottom-up switch; works single-"
+                    "device, --devices N, and --mesh RxC; 'delta' is "
+                    "single-device only)")
     ap.add_argument("--exchange", default="ring",
                     choices=["ring", "allreduce", "sparse"],
                     help="multi-device frontier exchange implementation "
@@ -247,8 +261,9 @@ def main(argv=None) -> int:
                     help="resume a traversal from a checkpoint written by "
                     "--ckpt (overrides <source> with the saved one)")
     args = ap.parse_args(argv)
-    if (args.mesh or args.devices > 1) and args.backend in ("delta", "dopt"):
-        ap.error(f"--backend {args.backend} is single-device only (for now)")
+    if (args.mesh or args.devices > 1) and args.backend == "delta":
+        ap.error("--backend delta is single-device only (its static "
+                 "permutation is built over the unsharded edge array)")
     if args.mesh and args.exchange == "sparse":
         ap.error("--exchange sparse pairs with 1D --devices meshes; the 2D "
                  "engine's row/column collectives already move O(vp/dim) bits")
@@ -292,7 +307,11 @@ def main(argv=None) -> int:
     if args.resume and not args.multi_source:
         from tpu_bfs.utils import checkpoint as ck
 
-        resume_st = ck.load_checkpoint(args.resume)
+        try:
+            resume_st = ck.load_checkpoint(args.resume)
+        except ValueError as exc:
+            # e.g. a packed-batch checkpoint resumed without --multi-source.
+            raise SystemExit(f"--resume: {exc}")
         print(f"resumed source {resume_st.source} at level {resume_st.level}")
 
     golden = None
@@ -340,14 +359,19 @@ def main(argv=None) -> int:
 
         st = resume_st if resume_st is not None else engine.start(args.source)
         cap = args.max_levels if args.max_levels is not None else float("inf")
-        while not st.done and st.level < cap:
+        if not args.ckpt and not st.done and st.level < cap:
+            # Pure resume: one device pass — chunking only pays off when a
+            # checkpoint is actually written between chunks.
+            st = engine.advance(
+                st, None if cap == float("inf") else int(cap) - st.level
+            )
+        while args.ckpt and not st.done and st.level < cap:
             chunk = max(1, args.ckpt_every)
             if cap != float("inf"):
                 chunk = min(chunk, int(cap) - st.level)
             st = engine.advance(st, levels=chunk)
-            if args.ckpt:
-                ck.save_checkpoint(args.ckpt, st)
-                print(f"checkpointed at level {st.level}")
+            ck.save_checkpoint(args.ckpt, st)
+            print(f"checkpointed at level {st.level}")
         res = engine.finish(st, with_parents=not args.no_parents)
     else:
         res = None

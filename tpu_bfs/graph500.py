@@ -60,6 +60,9 @@ def run_graph500(
     num_planes: int = 5,
     engine_cls=None,
     verbose: bool = False,
+    devices: int = 1,
+    mesh2d: tuple[int, int] | None = None,
+    backend: str = "scan",
 ) -> Graph500Result:
     """Generate, run, validate, and score a Graph500-style BFS benchmark.
 
@@ -70,19 +73,39 @@ def run_graph500(
     workload has many sources).
     mode='hybrid': the 4096-lane MXU+gather flagship engine, same equal-share
     accounting as 'batched'; ``num_planes`` caps depth at 2**planes levels.
+
+    ``devices`` / ``mesh2d`` distribute the run: single mode shards over a
+    1D mesh (or the 2D edge partition with ``mesh2d``; ``backend='dopt'`` on
+    a 2D mesh is the BASELINE scale-26 config, rehearsable at reduced scale
+    on the virtual CPU mesh), hybrid mode uses the sharded-state
+    DistHybridMsBfsEngine.
     """
     g = rmat_graph(scale, edge_factor, seed=seed)
     keys = sample_search_keys(g, num_searches)
+    distributed = devices > 1 or mesh2d is not None
+    if distributed and mode == "batched":
+        raise ValueError(
+            "mode='batched' is single-device; use mode='hybrid' (sharded "
+            "DistHybridMsBfsEngine) or mode='single' on a mesh"
+        )
 
     teps = []
     if mode == "hybrid":
-        from tpu_bfs.algorithms.msbfs_hybrid import HybridMsBfsEngine
+        if engine_cls is not None:
+            eng = engine_cls(g)
+        elif distributed:
+            if mesh2d is not None:
+                raise ValueError(
+                    "hybrid mode shards 1D (row-tile round-robin); pass "
+                    "devices=N instead of a 2D mesh"
+                )
+            from tpu_bfs.parallel.dist_msbfs_hybrid import DistHybridMsBfsEngine
 
-        eng = (
-            HybridMsBfsEngine(g, num_planes=num_planes)
-            if engine_cls is None
-            else engine_cls(g)
-        )
+            eng = DistHybridMsBfsEngine(g, devices, num_planes=num_planes)
+        else:
+            from tpu_bfs.algorithms.msbfs_hybrid import HybridMsBfsEngine
+
+            eng = HybridMsBfsEngine(g, num_planes=num_planes)
         res = eng.run(keys, time_it=True)
         per_search = res.elapsed_s / len(keys)
         # One lane at a time — res extracts lazily; only the rows needed for
@@ -102,7 +125,20 @@ def run_graph500(
             teps.append(traversed_edges(g, res.distance[i]) / per_search)
         dists = res.distance
     else:
-        eng = BfsEngine(g) if engine_cls is None else engine_cls(g)
+        if engine_cls is not None:
+            eng = engine_cls(g)
+        elif mesh2d is not None:
+            from tpu_bfs.parallel.dist_bfs2d import Dist2DBfsEngine, make_mesh_2d
+
+            eng = Dist2DBfsEngine(
+                g, make_mesh_2d(*mesh2d), backend=backend
+            )
+        elif devices > 1:
+            from tpu_bfs.parallel.dist_bfs import DistBfsEngine, make_mesh
+
+            eng = DistBfsEngine(g, make_mesh(devices), backend=backend)
+        else:
+            eng = BfsEngine(g, backend=backend)
         dists = []
         for s in keys:
             r = eng.run(int(s), with_parents=False, time_it=True)
@@ -151,7 +187,23 @@ def main(argv=None) -> int:
     ap.add_argument("--planes", type=int, default=5, metavar="P",
                     choices=range(1, 9),
                     help="hybrid mode: bit-plane count (depth cap 2**P)")
+    ap.add_argument("--devices", type=int, default=1,
+                    help="shard over N devices (single: 1D vertex "
+                    "partition; hybrid: sharded-state engine)")
+    ap.add_argument("--mesh", default=None, metavar="RxC",
+                    help="single mode: 2D edge partition over an RxC mesh "
+                    "(with --backend dopt = the scale-26 target config)")
+    ap.add_argument("--backend", default="scan",
+                    choices=["scan", "segment", "scatter", "dopt"],
+                    help="single mode: frontier-expansion backend")
     args = ap.parse_args(argv)
+    mesh2d = None
+    if args.mesh:
+        try:
+            mesh2d = tuple(int(t) for t in args.mesh.lower().split("x"))
+            assert len(mesh2d) == 2
+        except (ValueError, AssertionError):
+            ap.error(f"--mesh must look like RxC (e.g. 2x4), got {args.mesh!r}")
     res = run_graph500(
         args.scale,
         args.ef,
@@ -161,6 +213,9 @@ def main(argv=None) -> int:
         validate_searches=args.validate,
         num_planes=args.planes,
         verbose=True,
+        devices=args.devices,
+        mesh2d=mesh2d,
+        backend=args.backend,
     )
     print(
         f"graph500 scale={res.scale} ef={res.edge_factor} mode={res.mode} "

@@ -32,7 +32,13 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_bfs.algorithms.bfs import BfsResult
-from tpu_bfs.algorithms.frontier import INT32_MAX, expand_or
+from tpu_bfs.algorithms.frontier import (
+    INT32_MAX,
+    EdgeData,
+    default_dopt_caps,
+    expand_or,
+    make_dopt_expand,
+)
 from tpu_bfs.graph.csr import Graph, INF_DIST
 from tpu_bfs.parallel.collectives import (
     default_sparse_caps,
@@ -42,7 +48,7 @@ from tpu_bfs.parallel.collectives import (
     sparse_exchange_or,
     sparse_wire_bytes_per_level,
 )
-from tpu_bfs.parallel.partition import Partition1D, partition_1d
+from tpu_bfs.parallel.partition import Partition1D, out_csr_1d, partition_1d
 from tpu_bfs.utils.timing import run_timed
 
 
@@ -64,7 +70,7 @@ def make_mesh(num_devices: int | None = None, devices=None) -> Mesh:
 
 def _dist_bfs_fn(
     mesh: Mesh, p: int, vloc: int, exchange: str, backend: str,
-    sparse_caps: tuple[int, ...],
+    sparse_caps: tuple[int, ...], dopt_caps: tuple[int, ...] = (),
 ):
     """Build the shard_map'd BFS level loop for a fixed mesh/partition.
 
@@ -73,10 +79,19 @@ def _dist_bfs_fn(
     analog of the reference's per-destination buckets, bfs.cu:148-150).
     The loop carry counts, per exchange branch, how many levels ran it
     (exact int32 — wire bytes are reconstructed on the host, immune to the
-    float rounding a byte accumulator would hit at scale)."""
-    nb = len(sparse_caps) + 1 if exchange == "sparse" else 1
+    float rounding a byte accumulator would hit at scale).
 
-    def local_loop(src_e, dst_e, rp_e, frontier, visited, dist, level0, max_levels):
+    ``backend='dopt'`` runs the direction-optimizing expansion per chip:
+    each chip independently picks the sparse top-down branch when its OWN
+    frontier's local out-degree sum fits a ``dopt_caps`` rung (the branch
+    is collective-free, so per-chip divergence is safe — exchange and
+    termination collectives sit outside the `lax.cond`)."""
+    nb = len(sparse_caps) + 1 if exchange == "sparse" else 1
+    dopt = backend == "dopt"
+
+    def local_loop(
+        src_e, dst_e, rp_e, aux, frontier, visited, dist, level0, max_levels
+    ):
         # Blocks: src_e/dst_e [1, ep], rp_e [1, vp+1], vertex arrays [vloc].
         src_e = src_e[0]
         dst_e = dst_e[0]
@@ -85,14 +100,32 @@ def _dist_bfs_fn(
         src_local = src_e - k * vloc  # sources are owned: always in [0, vloc)
         vp = p * vloc
 
+        def dense_fn(frontier):
+            active = frontier[src_local]
+            return expand_or(
+                active, dst_e, rp_e, vp, backend="scan" if dopt else backend
+            )
+
+        if dopt:
+            edata = EdgeData(
+                src=src_e, dst=dst_e, in_rp=rp_e,
+                out_rp=aux[0][0],  # [vloc+1] CSR-by-local-src
+                nbr_sm=aux[1][0],  # [ep] global padded dst, src-major
+            )
+            expand_local = make_dopt_expand(
+                edata, dopt_caps, vert_limit=vloc, out_size=vp,
+                dense_fn=dense_fn,
+            )
+        else:
+            expand_local = dense_fn
+
         def cond(state):
             _, _, _, level, front_count, _ = state
             return (front_count > 0) & (level < max_levels)
 
         def body(state):
             frontier, visited, dist, level, _, branch_counts = state
-            active = frontier[src_local]
-            contrib = expand_or(active, dst_e, rp_e, vp, backend=backend)
+            contrib = expand_local(frontier)
             if exchange == "sparse":
                 hit, branch = sparse_exchange_or(contrib, "v", p, caps=sparse_caps)
             else:
@@ -116,6 +149,7 @@ def _dist_bfs_fn(
         )
         return frontier, visited, dist, level, branch_counts
 
+    aux_specs = (P("v", None), P("v", None)) if dopt else ()
     return jax.jit(
         jax.shard_map(
             local_loop,
@@ -124,6 +158,7 @@ def _dist_bfs_fn(
                 P("v", None),
                 P("v", None),
                 P("v", None),
+                aux_specs,
                 P("v"),
                 P("v"),
                 P("v"),
@@ -186,6 +221,7 @@ class DistBfsEngine:
         exchange: str = "ring",
         backend: str = "scan",
         sparse_caps: int | tuple[int, ...] | None = None,
+        dopt_caps: tuple[int, ...] | None = None,
     ):
         if exchange not in ("ring", "allreduce", "sparse"):
             # Before the partition/device_put work, so a typo fails instantly.
@@ -204,13 +240,26 @@ class DistBfsEngine:
         self.dst = jax.device_put(dst_stacked, edge_sharding)
         self.rp = jax.device_put(rp_stacked, edge_sharding)
         self._vec_sharding = NamedSharding(self.mesh, P("v"))
+        self._aux = ()
+        if backend == "dopt":
+            # Src-major per-chip view + caps ladder for the top-down branch
+            # (same rungs as BfsEngine's, scaled to the per-chip shard).
+            out_rp, nbr = out_csr_1d(part, src_stacked, dst_stacked)
+            self._aux = (
+                jax.device_put(out_rp, edge_sharding),
+                jax.device_put(nbr, edge_sharding),
+            )
+            if dopt_caps is None:
+                dopt_caps = default_dopt_caps(part.ep_chip)
+        self.dopt_caps = tuple(sorted(set(dopt_caps))) if dopt_caps else ()
         if sparse_caps is None:
             sparse_caps = default_sparse_caps(part.vloc)
         elif isinstance(sparse_caps, int):
             sparse_caps = (sparse_caps,)
         self.sparse_caps = tuple(sorted(sparse_caps))
         self._loop = _dist_bfs_fn(
-            self.mesh, self.p, part.vloc, exchange, backend, self.sparse_caps
+            self.mesh, self.p, part.vloc, exchange, backend, self.sparse_caps,
+            self.dopt_caps,
         )
         # Parent merge is a one-shot int32 MIN reduce-scatter — queue-style
         # exchange does not apply; 'sparse' rides the ring there.
@@ -223,12 +272,17 @@ class DistBfsEngine:
         self.last_exchange_bytes: float | None = None
         self._warmed = False
 
-    def _record_exchange(self, branch_counts, *, accumulate: bool = False) -> None:
+    def _record_exchange(self, branch_counts, *, resumed_level: int = 0) -> None:
+        prev = self.last_exchange_level_counts
         counts = np.asarray(branch_counts)
-        if accumulate and self.last_exchange_level_counts is not None:
-            # Chunked (checkpointed) traversals: the counters cover the
-            # whole traversal, not just the last advance chunk.
-            counts = counts + self.last_exchange_level_counts
+        if resumed_level > 0 and prev is not None and prev.sum() == resumed_level:
+            # Chunked (checkpointed) traversal continuing the chain this
+            # engine instance recorded: accumulate so the counters cover the
+            # whole traversal. The prev.sum() == level check rejects counts
+            # left over from an unrelated traversal (a different source's
+            # run, or a chain whose earlier chunks ran in another process —
+            # then the counters cover only the levels run here).
+            counts = counts + prev
         if self._exchange == "sparse":
             per = sparse_wire_bytes_per_level(self.p, self.part.vloc, self.sparse_caps)
         else:
@@ -251,7 +305,7 @@ class DistBfsEngine:
         frontier0, visited0, dist0 = self._init_state(source)
         ml = jnp.int32(max_levels if max_levels is not None else self.part.vp)
         _, _, dist, level, branch_counts = self._loop(
-            self.src, self.dst, self.rp, frontier0, visited0, dist0,
+            self.src, self.dst, self.rp, self._aux, frontier0, visited0, dist0,
             jnp.int32(0), ml,
         )
         self._record_exchange(branch_counts)
@@ -298,11 +352,11 @@ class DistBfsEngine:
         put = partial(jax.device_put, device=self._vec_sharding)
         cap = ckpt.level + levels if levels is not None else part.vp
         frontier, visited, dist, level, branch_counts = self._loop(
-            self.src, self.dst, self.rp,
+            self.src, self.dst, self.rp, self._aux,
             put(f0), put(vis0), put(d0),
             jnp.int32(ckpt.level), jnp.int32(min(cap, part.vp)),
         )
-        self._record_exchange(branch_counts, accumulate=ckpt.level > 0)
+        self._record_exchange(branch_counts, resumed_level=ckpt.level)
         return BfsCheckpoint(
             source=ckpt.source,
             level=int(level),

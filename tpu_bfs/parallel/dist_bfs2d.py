@@ -24,10 +24,16 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_bfs.algorithms.bfs import BfsResult
-from tpu_bfs.algorithms.frontier import INT32_MAX, expand_or
+from tpu_bfs.algorithms.frontier import (
+    INT32_MAX,
+    EdgeData,
+    default_dopt_caps,
+    expand_or,
+    make_dopt_expand,
+)
 from tpu_bfs.graph.csr import Graph, INF_DIST
 from tpu_bfs.parallel.collectives import reduce_scatter_or, reduce_scatter_min
-from tpu_bfs.parallel.partition2d import Partition2D, partition_2d
+from tpu_bfs.parallel.partition2d import Partition2D, out_csr_2d, partition_2d
 from tpu_bfs.utils.timing import run_timed
 
 
@@ -41,13 +47,41 @@ def make_mesh_2d(rows: int, cols: int, devices=None) -> Mesh:
 
 
 def _dist2d_bfs_fn(mesh: Mesh, rows: int, cols: int, w: int, exchange: str,
-                   backend: str):
+                   backend: str, dopt_caps: tuple[int, ...] = ()):
+    """2D level loop. ``backend='dopt'`` = the BASELINE scale-26 config
+    ("2D edge partition + direction-optimizing BFS"): after the column
+    all-gather, each chip independently runs the sparse top-down branch
+    when its column frontier's local out-degree sum fits a ``dopt_caps``
+    rung — the branch is collective-free (both collectives sit outside the
+    `lax.cond`), so per-chip divergence is safe."""
     row_block = cols * w
+    col_block = rows * w
+    dopt = backend == "dopt"
 
-    def local_loop(src_g, dst_l, rp_l, frontier, visited, dist, max_levels):
+    def local_loop(src_g, dst_l, rp_l, aux, frontier, visited, dist, max_levels):
         src_g = src_g[0, 0]
         dst_l = dst_l[0, 0]
         rp_l = rp_l[0, 0]
+
+        def dense_fn(col_frontier):
+            active = col_frontier[src_g]
+            return expand_or(
+                active, dst_l, rp_l, row_block,
+                backend="scan" if dopt else backend,
+            )
+
+        if dopt:
+            edata = EdgeData(
+                src=src_g, dst=dst_l, in_rp=rp_l,
+                out_rp=aux[0][0, 0],  # [R*w+1] CSR by col-gather-local src
+                nbr_sm=aux[1][0, 0],  # [ep2] row-block-local dst, src-major
+            )
+            expand_local = make_dopt_expand(
+                edata, dopt_caps, vert_limit=col_block, out_size=row_block,
+                dense_fn=dense_fn,
+            )
+        else:
+            expand_local = dense_fn
 
         def cond(state):
             _, _, _, level, count = state
@@ -57,8 +91,7 @@ def _dist2d_bfs_fn(mesh: Mesh, rows: int, cols: int, w: int, exchange: str,
             frontier, visited, dist, level, _ = state
             # Column exchange: assemble this mesh column's frontier slices.
             col_frontier = lax.all_gather(frontier, "r", tiled=True)  # [R*w]
-            active = col_frontier[src_g]
-            contrib = expand_or(active, dst_l, rp_l, row_block, backend=backend)
+            contrib = expand_local(col_frontier)
             # Row exchange: combine row-block contributions, keep own chunk.
             hit = reduce_scatter_or(contrib, "c", cols, impl=exchange)
             new = hit & ~visited
@@ -73,6 +106,7 @@ def _dist2d_bfs_fn(mesh: Mesh, rows: int, cols: int, w: int, exchange: str,
         )
         return dist, level
 
+    aux_specs = (P("r", "c", None), P("r", "c", None)) if dopt else ()
     return jax.jit(
         jax.shard_map(
             local_loop,
@@ -81,6 +115,7 @@ def _dist2d_bfs_fn(mesh: Mesh, rows: int, cols: int, w: int, exchange: str,
                 P("r", "c", None),
                 P("r", "c", None),
                 P("r", "c", None),
+                aux_specs,
                 P(("r", "c")),
                 P(("r", "c")),
                 P(("r", "c")),
@@ -142,6 +177,7 @@ class Dist2DBfsEngine:
         cols: int | None = None,
         exchange: str = "ring",
         backend: str = "scan",
+        dopt_caps: tuple[int, ...] | None = None,
     ):
         if mesh is None:
             mesh = make_mesh_2d(rows or 1, cols or 1)
@@ -171,8 +207,19 @@ class Dist2DBfsEngine:
         self.dst_l = jax.device_put(dst_stacked, edge_sharding)
         self.rp = jax.device_put(rp_stacked, edge_sharding)
         self._vec_sharding = NamedSharding(mesh, P(("r", "c")))
+        self._aux = ()
+        if backend == "dopt":
+            out_rp, nbr = out_csr_2d(part, src_gidx, dst_stacked)
+            self._aux = (
+                jax.device_put(out_rp, edge_sharding),
+                jax.device_put(nbr, edge_sharding),
+            )
+            if dopt_caps is None:
+                dopt_caps = default_dopt_caps(src_gidx.shape[2])
+        self.dopt_caps = tuple(sorted(set(dopt_caps))) if dopt_caps else ()
         self._loop = _dist2d_bfs_fn(
-            mesh, self.rows, self.cols, part.w, exchange, backend
+            mesh, self.rows, self.cols, part.w, exchange, backend,
+            self.dopt_caps,
         )
         self._parents = _dist2d_parents_fn(mesh, self.rows, self.cols, part.w, exchange)
         self._warmed = False
@@ -191,7 +238,8 @@ class Dist2DBfsEngine:
         frontier0, visited0, dist0 = self._init_state(source)
         ml = jnp.int32(max_levels if max_levels is not None else self.part.vp)
         return self._loop(
-            self.src_g, self.dst_l, self.rp, frontier0, visited0, dist0, ml
+            self.src_g, self.dst_l, self.rp, self._aux,
+            frontier0, visited0, dist0, ml,
         )
 
     def run(

@@ -121,3 +121,27 @@ def partition_1d(
         rp_stacked[k, 0] = 0
         rp_stacked[k, 1:] = np.cumsum(cnt)
     return part, src_stacked, dst_stacked, rp_stacked
+
+
+def out_csr_1d(part: Partition1D, src_stacked, dst_stacked):
+    """Per-chip CSR-by-LOCAL-source view of the 1D edge shards, for the
+    direction-optimizing top-down branch (frontier.sparse_topdown): chip k's
+    sources all lie in its own padded range, so rows are local ids
+    [0, vloc); neighbor ids stay global padded (the sparse branch scatters
+    into the full [vp] contribution buffer).
+
+    Returns (out_rp [P, vloc+1] int32, nbr [P, ep_chip] int32). Padding
+    edges sit on the chip's own phantom row (vloc-1), which is never in a
+    frontier."""
+    p, vloc = part.num_devices, part.vloc
+    ep = src_stacked.shape[1]
+    out_rp = np.empty((p, vloc + 1), dtype=np.int32)
+    nbr = np.empty((p, ep), dtype=np.int32)
+    for k in range(p):
+        src_local = src_stacked[k].astype(np.int64) - k * vloc
+        order = np.argsort(src_local, kind="stable")
+        nbr[k] = dst_stacked[k][order]
+        cnt = np.bincount(src_local, minlength=vloc)
+        out_rp[k, 0] = 0
+        out_rp[k, 1:] = np.cumsum(cnt)
+    return out_rp, nbr

@@ -139,3 +139,29 @@ def partition_2d(
             rp_stacked[i, j, 0] = 0
             rp_stacked[i, j, 1:] = np.cumsum(cnt)
     return part, src_gidx, dst_stacked, rp_stacked
+
+
+def out_csr_2d(part: Partition2D, src_gidx, dst_stacked):
+    """Per-chip CSR-by-source view of the 2D edge shards, for the
+    direction-optimizing top-down branch: rows are column-gather-local
+    source indices [0, R*w) (the space of the per-level column all-gather),
+    neighbors are row-block-local dst ids [0, C*w) (the space of the row
+    reduce-scatter's contribution buffer).
+
+    Returns (out_rp [R, C, R*w+1] int32, nbr [R, C, ep2] int32). Padding
+    edges sit on gather row w-1 — the phantom slot of mesh-row-0's slice in
+    each column, never in a frontier."""
+    rows, cols, w = part.rows, part.cols, part.w
+    col_block = rows * w
+    ep = src_gidx.shape[2]
+    out_rp = np.empty((rows, cols, col_block + 1), dtype=np.int32)
+    nbr = np.empty((rows, cols, ep), dtype=np.int32)
+    for i in range(rows):
+        for j in range(cols):
+            sg = src_gidx[i, j].astype(np.int64)
+            order = np.argsort(sg, kind="stable")
+            nbr[i, j] = dst_stacked[i, j][order]
+            cnt = np.bincount(sg, minlength=col_block)
+            out_rp[i, j, 0] = 0
+            out_rp[i, j, 1:] = np.cumsum(cnt)
+    return out_rp, nbr

@@ -9,9 +9,10 @@ concurrent sources, per-source time = batch time / N — the metric label says
 so explicitly.
 
 Env overrides: TPU_BFS_BENCH_SCALE (default 21), TPU_BFS_BENCH_EF (16),
-TPU_BFS_BENCH_MODE (hybrid|wide|msbfs|single), TPU_BFS_BENCH_LANES (msbfs
-mode, 512), TPU_BFS_BENCH_SOURCES (single mode, 8), TPU_BFS_BENCH_VALIDATE
-(1), TPU_BFS_BENCH_CACHE (.bench_cache).
+TPU_BFS_BENCH_MODE (hybrid|wide|msbfs|single|single-dopt),
+TPU_BFS_BENCH_LANES (msbfs mode, 512), TPU_BFS_BENCH_SOURCES (single modes,
+8), TPU_BFS_BENCH_VALIDATE (1), TPU_BFS_BENCH_VALIDATE_LANES (4),
+TPU_BFS_BENCH_CACHE (.bench_cache).
 """
 
 import json
@@ -74,11 +75,53 @@ def load_graph(scale: int, ef: int):
     return g
 
 
+def _validate_tile_spmm_compiled(engine) -> None:
+    """Compiled-vs-interpret cross-check of the Pallas MXU kernel on the
+    REAL graph's bit-packed tiles (a random frontier over the first 2048
+    row-tiles' worth of the production operands). CI only ever runs
+    tile_spmm in interpret mode on CPU (tests/test_tile_spmm.py); this is
+    the on-hardware guard against Mosaic layout divergence, run on every
+    TPU bench alongside the end-to-end lane validation."""
+    import jax
+    import numpy as np
+
+    from tpu_bfs.ops.tile_spmm import tile_spmm
+
+    if jax.default_backend() != "tpu" or not getattr(engine.hg, "num_tiles", 0):
+        return
+    hg = engine.hg
+    t0 = time.perf_counter()
+    # Row-tile prefix: rank order puts the densest rows first, so even the
+    # default covers the bulk of the tile population (at scale 21, 2048
+    # row-tiles cover 96k of 98k tiles but cost ~2 min in interpret mode;
+    # 256 keeps the per-round bench fast — raise for a deep audit).
+    nrt = min(int(os.environ.get("TPU_BFS_BENCH_SPMM_TILES", "256")), hg.vt)
+    end = int(hg.row_start[nrt])
+    if end == 0:
+        return
+    row_start = np.minimum(hg.row_start[: nrt + 1], end)
+    rng = np.random.default_rng(11)
+    fw = rng.integers(0, 2**32, size=(hg.vt * 128, engine.w), dtype=np.uint32)
+    args = (row_start, hg.col_tile[:end], hg.a_tiles[:end], fw)
+    out_c = np.asarray(
+        tile_spmm(*args, num_row_tiles=nrt, w=engine.w, interpret=False)
+    )
+    out_i = np.asarray(
+        tile_spmm(*args, num_row_tiles=nrt, w=engine.w, interpret=True)
+    )
+    np.testing.assert_array_equal(out_c, out_i)
+    log(
+        f"tile_spmm compiled==interpret on {end} production tiles "
+        f"({nrt} row-tiles) in {time.perf_counter()-t0:.1f}s"
+    )
+
+
 def _bench_batch_4096(g, scale, ef, engine, in_degree, build_log: str, label: str) -> dict:
     """Shared protocol of the 4096-lane batch benches: hub pilot (doubles as
     compile warm-up), search keys from the hub's traversable component
-    (Graph500 samples among degree>=1 vertices), one timed batch, 2-lane
-    SciPy validation."""
+    (Graph500 samples among degree>=1 vertices), one timed batch, N-lane
+    SciPy validation (TPU_BFS_BENCH_VALIDATE_LANES, default 4, spread
+    across the word/bit lane space) + compiled-vs-interpret Pallas check."""
     from tpu_bfs.algorithms.msbfs_packed import UNREACHED
 
     do_validate = os.environ.get("TPU_BFS_BENCH_VALIDATE", "1") == "1"
@@ -109,10 +152,25 @@ def _bench_batch_4096(g, scale, ef, engine, in_degree, build_log: str, label: st
         from tpu_bfs.reference import bfs_scipy
 
         t0 = time.perf_counter()
-        for i in [0, lanes // 2]:
+        nv = int(os.environ.get("TPU_BFS_BENCH_VALIDATE_LANES", "4"))
+        # Spread checked lanes across word columns AND bit positions (first
+        # word, mid word, last word, odd bits) so a lane-map or Mosaic
+        # layout bug in any region of the packed tables gets a chance to
+        # show, rather than only words 0 and lanes//64.
+        # First/mid/last lanes always checked (first word, mid word, last
+        # word high-bit region), plus nv evenly spread picks — never
+        # truncated, so a Mosaic layout bug confined to any word column
+        # region has a checked lane in it.
+        picks = sorted(
+            {0, lanes // 2, lanes - 1}
+            | {int(x) for x in np.linspace(0, lanes - 1, nv).round()}
+        )
+        for i in picks:
             expected = bfs_scipy(g, int(sources[i]))
             np.testing.assert_array_equal(res.distances_int32(i), expected)
-        log(f"validated 2 lanes in {time.perf_counter()-t0:.1f}s")
+        log(f"validated {len(picks)} lanes {picks} in {time.perf_counter()-t0:.1f}s")
+        if hasattr(engine, "hg"):
+            _validate_tile_spmm_compiled(engine)
 
     return {
         "metric": (
@@ -242,13 +300,18 @@ def bench_msbfs(g, scale: int, ef: int) -> dict:
     }
 
 
-def bench_single(g, scale: int, ef: int) -> dict:
-    """Previous flagship: one-source-at-a-time BfsEngine (kept comparable)."""
+def bench_single(g, scale: int, ef: int, backend: str = "scan") -> dict:
+    """Single-stream one-source-at-a-time BfsEngine — the shape of the
+    reference's live path (queueBfs, bfs.cu:134-165). 'single-dopt' runs
+    the direction-optimizing backend. NB: single-stream BFS on TPU is
+    gather-bound (~13 ns/edge -> ~0.9 s per O(E) level at scale 21); the
+    batched engines are the TPU-idiomatic execution model (BENCHMARKS.md
+    "Single-stream" section)."""
     from tpu_bfs.algorithms.bfs import BfsEngine
 
     n_sources = int(os.environ.get("TPU_BFS_BENCH_SOURCES", "8"))
     do_validate = os.environ.get("TPU_BFS_BENCH_VALIDATE", "1") == "1"
-    engine = BfsEngine(g)
+    engine = BfsEngine(g, backend=backend)
     rng = np.random.default_rng(7)
     candidates = np.flatnonzero(g.degrees > 0)
     sources = rng.choice(candidates, size=n_sources, replace=False)
@@ -269,7 +332,10 @@ def bench_single(g, scale: int, ef: int) -> dict:
         )
     gteps = len(teps) / sum(1.0 / t for t in teps) / 1e9
     return {
-        "metric": f"BFS harmonic-mean GTEPS, RMAT scale-{scale} ef={ef}, 1 chip",
+        "metric": (
+            f"BFS harmonic-mean GTEPS (single-stream, {backend} backend), "
+            f"RMAT scale-{scale} ef={ef}, 1 chip"
+        ),
         "value": round(gteps, 4),
         "unit": "GTEPS",
         "vs_baseline": round(gteps / 10.0, 4),
@@ -281,11 +347,14 @@ def main() -> int:
     ef = int(os.environ.get("TPU_BFS_BENCH_EF", "16"))
     mode = os.environ.get("TPU_BFS_BENCH_MODE", "hybrid")
     g = load_graph(scale, ef)
+    from functools import partial
+
     fn = {
         "hybrid": bench_hybrid,
         "wide": bench_wide,
         "msbfs": bench_msbfs,
         "single": bench_single,
+        "single-dopt": partial(bench_single, backend="dopt"),
     }[mode]
     result = fn(g, scale, ef)
     print(json.dumps(result))

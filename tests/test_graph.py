@@ -194,3 +194,33 @@ def test_native_loader_matches_python(tmp_path, name, text):
     np.testing.assert_array_equal(g_native.row_ptr, g_py.row_ptr)
     np.testing.assert_array_equal(g_native.col_idx, g_py.col_idx)
     assert g_native.num_input_edges == g_py.num_input_edges
+
+
+def test_rmat_edges_m_exact_count():
+    # _rmat_edges_m draws exactly m edges (rmat_edges sizes by edge_factor);
+    # deterministic in the seed, ids within the 2^scale grid.
+    from tpu_bfs.graph.generate import _rmat_edges_m
+
+    u, v = _rmat_edges_m(10, 5000, seed=3, impl="numpy")
+    u2, v2 = _rmat_edges_m(10, 5000, seed=3, impl="numpy")
+    assert len(u) == len(v) == 5000
+    assert u.max() < 1024 and v.max() < 1024 and u.min() >= 0
+    np.testing.assert_array_equal(u, u2)
+    np.testing.assert_array_equal(v, v2)
+
+
+def test_write_mtx_roundtrip(tmp_path):
+    # write_mtx emits the 1-indexed MatrixMarket form of the reference's
+    # named workload (soc-LiveJournal1.mtx, README.md:22); the loader's
+    # .mtx path must read it back exactly (comments, header, 1-indexing).
+    from tpu_bfs.graph.generate import _rmat_edges_m, write_mtx
+    from tpu_bfs.graph.io import from_edges, load_edge_list
+
+    u, v = _rmat_edges_m(8, 400, seed=5, impl="numpy")
+    path = str(tmp_path / "standin.mtx")
+    write_mtx(path, u, v, 256, comment="stand-in fixture")
+    g = load_edge_list(path)
+    expect = from_edges(u, v, num_vertices=256, num_input_edges=400)
+    assert g.num_vertices == 256 and g.num_input_edges == 400
+    np.testing.assert_array_equal(g.row_ptr, expect.row_ptr)
+    np.testing.assert_array_equal(g.col_idx, expect.col_idx)

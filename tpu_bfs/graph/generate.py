@@ -63,9 +63,64 @@ def rmat_edges(
     streams: the same seed yields a different (equally distributed) graph per
     impl — callers that persist or compare results should pin one.
     """
-    n = 1 << scale
-    m = edge_factor << scale
-    rng = np.random.default_rng(seed)
+    return _rmat_edges_m(
+        scale, edge_factor << scale, seed=seed, impl=impl, a=a, b=b, c=c
+    )
+
+
+# Published soc-LiveJournal1 shape (SNAP): the reference's one named
+# real-world workload (README.md:22). The benchmark environment has no
+# network route to fetch the real file (see NONETWORK.md), so lj_standin_*
+# generate a clearly-labeled synthetic stand-in with the exact V/E counts.
+LJ_V = 4_847_571
+LJ_E = 68_993_773
+
+
+def lj_standin_edges(
+    *, seed: int = 1, impl: str = "auto"
+) -> tuple[np.ndarray, np.ndarray]:
+    """Directed power-law edge list with soc-LiveJournal1's exact shape.
+
+    NOT the real graph — a deterministic stand-in: Graph500-parameter RMAT
+    drawn on the enclosing 2^23 grid, restricted to ids < LJ_V by rejection
+    (keeps the RMAT degree structure intact — no modulo folding artifacts),
+    trimmed/topped-up to exactly LJ_E directed edges. Self-loops stay, as in
+    the real SNAP file's reference treatment (bfs.cu:860-861 inserts
+    whatever it reads).
+    """
+    scale = 23  # smallest power of two covering LJ_V
+    p_keep = (LJ_V / (1 << scale)) ** 2
+    # ONE vertex permutation shared by every top-up batch: raw recursion ids
+    # from all batches refer to the same underlying RMAT node, so hubs keep
+    # one identity across draws and the degree structure stays intact.
+    perm = np.random.default_rng(seed).permutation(1 << scale)
+    u_parts, v_parts, total = [], [], 0
+    s = seed
+    while total < LJ_E:
+        want = LJ_E - total
+        draw = int(want / p_keep * 1.02) + 1024
+        u, v = _rmat_edges_m(scale, draw, seed=s, impl=impl, permute=False)
+        u, v = perm[u], perm[v]
+        keep = (u < LJ_V) & (v < LJ_V)
+        u, v = u[keep], v[keep]
+        u_parts.append(u)
+        v_parts.append(v)
+        total += len(u)
+        s += 1
+    u = np.concatenate(u_parts)[:LJ_E]
+    v = np.concatenate(v_parts)[:LJ_E]
+    return u, v
+
+
+def _rmat_edges_m(
+    scale: int, m: int, *, seed: int, impl: str,
+    a: float = 0.57, b: float = 0.19, c: float = 0.19,
+    permute: bool = True,
+):
+    """RMAT draw of exactly ``m`` edges — the core behind ``rmat_edges``
+    (which sizes by edge_factor). ``permute=False`` returns raw recursion
+    ids so callers drawing multiple batches can apply ONE shared vertex
+    permutation over all of them (lj_standin_edges)."""
     if impl not in ("auto", "numpy", "native"):
         raise ValueError(f"unknown impl {impl!r}")
     if not (a > 0 and b >= 0 and c >= 0 and a + b + c < 1):
@@ -73,6 +128,7 @@ def rmat_edges(
         # by zero. Phrased positively so NaN quadrants fail too (NaN makes
         # every comparison False). Same guard as native/rmat.cpp rc=3.
         raise ValueError(f"invalid RMAT quadrants a={a} b={b} c={c}")
+    rng = np.random.default_rng(seed)
     uv = None
     if impl in ("auto", "native"):
         from tpu_bfs.utils.native import rmat_edges_native
@@ -96,8 +152,32 @@ def rmat_edges(
             u |= u_bit
             v |= v_bit
         uv = u, v
-    perm = rng.permutation(n)
+    if not permute:
+        return uv
+    perm = rng.permutation(1 << scale)
     return perm[uv[0]], perm[uv[1]]
+
+
+def write_mtx(path: str, u: np.ndarray, v: np.ndarray, n: int,
+              comment: str = "") -> None:
+    """Write a 1-indexed MatrixMarket coordinate-pattern file — the format
+    of the reference's named workload (soc-LiveJournal1.mtx, README.md:22),
+    consumed here by the native loader's .mtx path."""
+    with open(path, "w") as f:
+        f.write("%%MatrixMarket matrix coordinate pattern general\n")
+        if comment:
+            f.write(f"% {comment}\n")
+        f.write(f"{n} {n} {len(u)}\n")
+        # Chunked vectorized int->text: ~50x faster than np.savetxt.
+        chunk = 4_000_000
+        for i in range(0, len(u), chunk):
+            a = (u[i : i + chunk] + 1).astype(np.int64)
+            b = (v[i : i + chunk] + 1).astype(np.int64)
+            pairs = np.char.add(
+                np.char.add(a.astype("U10"), " "), b.astype("U10")
+            )
+            f.write("\n".join(pairs))
+            f.write("\n")
 
 
 def rmat_graph(

@@ -201,8 +201,9 @@ def main(argv=None) -> int:
     if args.mesh:
         try:
             mesh2d = tuple(int(t) for t in args.mesh.lower().split("x"))
-            assert len(mesh2d) == 2
-        except (ValueError, AssertionError):
+            if len(mesh2d) != 2:
+                raise ValueError(mesh2d)
+        except ValueError:
             ap.error(f"--mesh must look like RxC (e.g. 2x4), got {args.mesh!r}")
     res = run_graph500(
         args.scale,

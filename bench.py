@@ -9,7 +9,9 @@ concurrent sources, per-source time = batch time / N — the metric label says
 so explicitly.
 
 Env overrides: TPU_BFS_BENCH_SCALE (default 21), TPU_BFS_BENCH_EF (16),
-TPU_BFS_BENCH_MODE (hybrid|wide|msbfs|single|single-dopt),
+TPU_BFS_BENCH_MODE (hybrid|wide|msbfs|single|single-dopt|lj-hybrid|
+lj-single-dopt — the lj-* modes bench the LiveJournal-shaped stand-in,
+NONETWORK.md),
 TPU_BFS_BENCH_LANES (msbfs mode, 512), TPU_BFS_BENCH_SOURCES (single modes,
 8), TPU_BFS_BENCH_VALIDATE (1), TPU_BFS_BENCH_VALIDATE_LANES (4),
 TPU_BFS_BENCH_CACHE (.bench_cache).
@@ -77,11 +79,12 @@ def load_graph(scale: int, ef: int):
 
 def _validate_tile_spmm_compiled(engine) -> None:
     """Compiled-vs-interpret cross-check of the Pallas MXU kernel on the
-    REAL graph's bit-packed tiles (a random frontier over the first 2048
-    row-tiles' worth of the production operands). CI only ever runs
-    tile_spmm in interpret mode on CPU (tests/test_tile_spmm.py); this is
-    the on-hardware guard against Mosaic layout divergence, run on every
-    TPU bench alongside the end-to-end lane validation."""
+    REAL graph's bit-packed tiles (a random frontier over the densest
+    row-tiles' production operands; prefix size TPU_BFS_BENCH_SPMM_TILES,
+    default 64 row-tiles). CI only ever runs tile_spmm in interpret mode
+    on CPU (tests/test_tile_spmm.py); this is the on-hardware guard
+    against Mosaic layout divergence, run on every TPU bench alongside the
+    end-to-end lane validation."""
     import jax
     import numpy as np
 
@@ -91,15 +94,16 @@ def _validate_tile_spmm_compiled(engine) -> None:
         return
     hg = engine.hg
     t0 = time.perf_counter()
-    # Row-tile prefix: rank order puts the densest rows first, so even the
-    # default covers the bulk of the tile population (at scale 21, 2048
-    # row-tiles cover 96k of 98k tiles but cost ~2 min in interpret mode;
-    # 256 keeps the per-round bench fast — raise for a deep audit).
-    nrt = min(int(os.environ.get("TPU_BFS_BENCH_SPMM_TILES", "256")), hg.vt)
+    # Row-tile prefix (TPU_BFS_BENCH_SPMM_TILES, default 64): rank order
+    # puts the densest rows first, so even a small prefix covers a big
+    # slice of the tile population (256 row-tiles held 70k of the LJ
+    # stand-in's 98k tiles but cost ~3 min in interpret mode; 64 keeps the
+    # per-round bench fast) — raise it for a deep audit.
+    nrt = min(int(os.environ.get("TPU_BFS_BENCH_SPMM_TILES", "64")), hg.vt)
     end = int(hg.row_start[nrt])
     if end == 0:
         return
-    row_start = np.minimum(hg.row_start[: nrt + 1], end)
+    row_start = hg.row_start[: nrt + 1]
     rng = np.random.default_rng(11)
     fw = rng.integers(0, 2**32, size=(hg.vt * 128, engine.w), dtype=np.uint32)
     args = (row_start, hg.col_tile[:end], hg.a_tiles[:end], fw)
@@ -116,7 +120,49 @@ def _validate_tile_spmm_compiled(engine) -> None:
     )
 
 
-def _bench_batch_4096(g, scale, ef, engine, in_degree, build_log: str, label: str) -> dict:
+def load_graph_lj():
+    """The LiveJournal-shaped stand-in (NONETWORK.md): generate once, write
+    the 1.0 GiB .mtx, ingest through the native loader path, cache the CSR.
+    This is the reproducible entry point behind BENCHMARKS.md's
+    "LiveJournal-shaped stand-in" table (TPU_BFS_BENCH_MODE=lj-hybrid /
+    lj-single-dopt)."""
+    from tpu_bfs.graph.generate import LJ_E, LJ_V, lj_standin_edges, write_mtx
+    from tpu_bfs.graph.io import load_edge_list, load_npz, save_npz
+    from tpu_bfs.utils.native import ensure_built
+
+    ensure_built(log=log)
+    cache_dir = os.environ.get("TPU_BFS_BENCH_CACHE", ".bench_cache")
+    os.makedirs(cache_dir, exist_ok=True)
+    mtx = os.path.join(cache_dir, "soc-LiveJournal1-standin.mtx")
+    npz = os.path.join(cache_dir, "lj_standin_csr.npz")
+    if os.path.exists(npz):
+        t0 = time.perf_counter()
+        g = load_npz(npz)
+        log(f"LJ stand-in: cached CSR load {time.perf_counter()-t0:.1f}s")
+        return g
+    if not os.path.exists(mtx):
+        t0 = time.perf_counter()
+        u, v = lj_standin_edges(seed=1, impl="auto")
+        log(f"LJ stand-in gen {time.perf_counter()-t0:.1f}s: {len(u)} directed edges")
+        t0 = time.perf_counter()
+        write_mtx(mtx, u, v, LJ_V,
+                  comment="synthetic soc-LiveJournal1 stand-in (see NONETWORK.md)")
+        log(f"write {mtx} {time.perf_counter()-t0:.1f}s "
+            f"({os.path.getsize(mtx)/2**30:.2f} GiB)")
+        del u, v
+    t0 = time.perf_counter()
+    g = load_edge_list(mtx)
+    log(f"ingest via native .mtx path {time.perf_counter()-t0:.1f}s: "
+        f"V={g.num_vertices} slots={g.num_edges} input={g.num_input_edges}")
+    assert g.num_vertices == LJ_V and g.num_input_edges == LJ_E
+    try:
+        save_npz(npz, g)
+    except OSError as exc:
+        log(f"CSR cache write skipped: {exc}")
+    return g
+
+
+def _bench_batch_4096(g, graph_desc, engine, in_degree, build_log: str, label: str) -> dict:
     """Shared protocol of the 4096-lane batch benches: hub pilot (doubles as
     compile warm-up), search keys from the hub's traversable component
     (Graph500 samples among degree>=1 vertices), one timed batch, N-lane
@@ -153,14 +199,10 @@ def _bench_batch_4096(g, scale, ef, engine, in_degree, build_log: str, label: st
 
         t0 = time.perf_counter()
         nv = int(os.environ.get("TPU_BFS_BENCH_VALIDATE_LANES", "4"))
-        # Spread checked lanes across word columns AND bit positions (first
-        # word, mid word, last word, odd bits) so a lane-map or Mosaic
-        # layout bug in any region of the packed tables gets a chance to
-        # show, rather than only words 0 and lanes//64.
-        # First/mid/last lanes always checked (first word, mid word, last
-        # word high-bit region), plus nv evenly spread picks — never
-        # truncated, so a Mosaic layout bug confined to any word column
-        # region has a checked lane in it.
+        # First/mid/last lanes always checked, plus nv evenly spread picks
+        # (deduplicated, never truncated): every word-column region of the
+        # packed tables — including the last word's high bits — contains a
+        # validated lane, so a localized lane-map/Mosaic layout bug shows.
         picks = sorted(
             {0, lanes // 2, lanes - 1}
             | {int(x) for x in np.linspace(0, lanes - 1, nv).round()}
@@ -175,7 +217,7 @@ def _bench_batch_4096(g, scale, ef, engine, in_degree, build_log: str, label: st
     return {
         "metric": (
             f"BFS harmonic-mean per-source GTEPS ({lanes}-source {label} "
-            f"MS-BFS batch), RMAT scale-{scale} ef={ef}, 1 chip"
+            f"MS-BFS batch), {graph_desc}, 1 chip"
         ),
         "value": round(gteps, 4),
         "unit": "GTEPS",
@@ -183,7 +225,7 @@ def _bench_batch_4096(g, scale, ef, engine, in_degree, build_log: str, label: st
     }
 
 
-def bench_hybrid(g, scale: int, ef: int) -> dict:
+def bench_hybrid(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
     """Flagship: 4096-lane hybrid MXU+gather MS-BFS (msbfs_hybrid.py).
 
     Falls back to the gather-only wide engine when the graph's packed state
@@ -204,22 +246,26 @@ def bench_hybrid(g, scale: int, ef: int) -> dict:
     src, dst = g.coo
     _, num_active, _, _ = rank_vertices(src, dst, g.num_vertices)
     rows = (-(-(num_active + 1) // 128)) * 128
-    fixed = int(0.2e9) + int(g.num_edges * 4.4)
+    # Residual-slot estimate: the dense tiles absorb roughly half the edge
+    # mass on power-law graphs (53% measured at scale 21), and the engine's
+    # own sizing counts only residual slots — an all-edges estimate here
+    # wrongly forced the wide fallback on graphs that fit (the LJ stand-in).
+    fixed = int(0.2e9) + int(g.num_edges * 4.4 * 0.5)
     planes = auto_planes(rows, fixed_bytes=fixed)
     est = auto_lanes(rows, planes, fixed_bytes=fixed)
     if est < LANES:
         log(f"hybrid needs {LANES} lanes, only {est} fit; using wide engine")
-        return bench_wide(g, scale, ef)
+        return bench_wide(g, scale, ef, graph_desc)
 
     t0 = time.perf_counter()
     try:
         engine = HybridMsBfsEngine(g)
     except LanesDontFitError as exc:
         log(f"hybrid unavailable ({exc}); falling back to wide engine")
-        return bench_wide(g, scale, ef)
+        return bench_wide(g, scale, ef, graph_desc)
     hg = engine.hg
     return _bench_batch_4096(
-        g, scale, ef, engine, hg.in_degree,
+        g, graph_desc or f"RMAT scale-{scale} ef={ef}", engine, hg.in_degree,
         f"engine build {time.perf_counter()-t0:.1f}s: tiles={hg.num_tiles} "
         f"dense={hg.num_dense_edges/max(g.num_edges,1)*100:.1f}% "
         f"a_mem={hg.a_tiles.nbytes/2**30:.2f}GiB",
@@ -227,7 +273,7 @@ def bench_hybrid(g, scale: int, ef: int) -> dict:
     )
 
 
-def bench_wide(g, scale: int, ef: int) -> dict:
+def bench_wide(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
     """4096-lane wide packed MS-BFS, gather-only (msbfs_wide.py)."""
     from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
 
@@ -235,7 +281,7 @@ def bench_wide(g, scale: int, ef: int) -> dict:
     engine = WidePackedMsBfsEngine(g)
     ell = engine.ell
     return _bench_batch_4096(
-        g, scale, ef, engine, ell.in_degree,
+        g, graph_desc or f"RMAT scale-{scale} ef={ef}", engine, ell.in_degree,
         f"engine build {time.perf_counter()-t0:.1f}s: slots={ell.total_slots} "
         f"(x{ell.total_slots/max(g.num_edges,1):.2f}) heavy={ell.num_heavy}",
         "wide packed",
@@ -300,7 +346,8 @@ def bench_msbfs(g, scale: int, ef: int) -> dict:
     }
 
 
-def bench_single(g, scale: int, ef: int, backend: str = "scan") -> dict:
+def bench_single(g, scale: int, ef: int, backend: str = "scan",
+                 graph_desc: str | None = None) -> dict:
     """Single-stream one-source-at-a-time BfsEngine — the shape of the
     reference's live path (queueBfs, bfs.cu:134-165). 'single-dopt' runs
     the direction-optimizing backend. NB: single-stream BFS on TPU is
@@ -334,7 +381,7 @@ def bench_single(g, scale: int, ef: int, backend: str = "scan") -> dict:
     return {
         "metric": (
             f"BFS harmonic-mean GTEPS (single-stream, {backend} backend), "
-            f"RMAT scale-{scale} ef={ef}, 1 chip"
+            f"{graph_desc or f'RMAT scale-{scale} ef={ef}'}, 1 chip"
         ),
         "value": round(gteps, 4),
         "unit": "GTEPS",
@@ -346,15 +393,18 @@ def main() -> int:
     scale = int(os.environ.get("TPU_BFS_BENCH_SCALE", "21"))
     ef = int(os.environ.get("TPU_BFS_BENCH_EF", "16"))
     mode = os.environ.get("TPU_BFS_BENCH_MODE", "hybrid")
-    g = load_graph(scale, ef)
+    g = load_graph_lj() if mode.startswith("lj-") else load_graph(scale, ef)
     from functools import partial
 
+    lj_desc = "soc-LiveJournal1-shaped stand-in (NONETWORK.md)"
     fn = {
         "hybrid": bench_hybrid,
         "wide": bench_wide,
         "msbfs": bench_msbfs,
         "single": bench_single,
         "single-dopt": partial(bench_single, backend="dopt"),
+        "lj-hybrid": partial(bench_hybrid, graph_desc=lj_desc),
+        "lj-single-dopt": partial(bench_single, backend="dopt", graph_desc=lj_desc),
     }[mode]
     result = fn(g, scale, ef)
     print(json.dumps(result))

@@ -92,8 +92,11 @@ def lj_standin_edges(
     p_keep = (LJ_V / (1 << scale)) ** 2
     # ONE vertex permutation shared by every top-up batch: raw recursion ids
     # from all batches refer to the same underlying RMAT node, so hubs keep
-    # one identity across draws and the degree structure stays intact.
-    perm = np.random.default_rng(seed).permutation(1 << scale)
+    # one identity across draws and the degree structure stays intact. The
+    # permutation comes from a DISTINCT rng stream (seed sequence spawn key)
+    # so the relabeling is independent of batch 1's quadrant draws — both
+    # would otherwise replay the same PCG64 stream.
+    perm = np.random.default_rng((seed, 0x4C4A)).permutation(1 << scale)
     u_parts, v_parts, total = [], [], 0
     s = seed
     while total < LJ_E:

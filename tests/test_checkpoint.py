@@ -320,3 +320,88 @@ class TestPacked:
         np.testing.assert_array_equal(
             res.distances_int32(0), full.distances_int32(0)
         )
+
+
+class TestDistPacked:
+    """Checkpoint/resume of the DISTRIBUTED packed batch engines: real-id
+    checkpoints make restarts elastic — resume on another mesh size or on
+    the single-chip engines (the reference's fixed 2-rank world,
+    bfs_mpi.cu:615, cannot even change device count without recompiling)."""
+
+    SOURCES = np.array([1, 5, 9, 33])
+
+    def _roundtrip(self, eng, full, tmp_path):
+        st = eng.start(self.SOURCES)
+        path = str(tmp_path / "dp.npz")
+        while not st.done:
+            st = eng.advance(st, levels=2)
+            ckpt_mod.save_packed_checkpoint(path, st)
+            st = ckpt_mod.load_packed_checkpoint(path)
+        res = eng.finish(st)
+        assert res.num_levels == full.num_levels
+        np.testing.assert_array_equal(res.reached, full.reached)
+        for i in range(len(self.SOURCES)):
+            np.testing.assert_array_equal(
+                res.distances_int32(i), full.distances_int32(i)
+            )
+
+    def test_dist_wide_roundtrip(self, rmat_small, tmp_path):
+        from tpu_bfs.parallel.dist_bfs import make_mesh
+        from tpu_bfs.parallel.dist_msbfs_wide import DistWideMsBfsEngine
+
+        eng = DistWideMsBfsEngine(rmat_small, make_mesh(8), lanes=64)
+        self._roundtrip(eng, eng.run(self.SOURCES), tmp_path)
+
+    def test_dist_hybrid_roundtrip(self, rmat_small, tmp_path):
+        from tpu_bfs.parallel.dist_bfs import make_mesh
+        from tpu_bfs.parallel.dist_msbfs_hybrid import DistHybridMsBfsEngine
+
+        eng = DistHybridMsBfsEngine(rmat_small, make_mesh(8), tile_thr=4)
+        self._roundtrip(eng, eng.run(self.SOURCES), tmp_path)
+
+    def test_elastic_mesh_and_engine_resume(self, rmat_small):
+        # Start on an 8-chip distributed wide engine, continue on a 2-chip
+        # one, finish on the single-chip hybrid engine — one traversal,
+        # three execution configurations, identical distances.
+        from tpu_bfs.algorithms.msbfs_hybrid import HybridMsBfsEngine
+        from tpu_bfs.parallel.dist_bfs import make_mesh
+        from tpu_bfs.parallel.dist_msbfs_wide import DistWideMsBfsEngine
+
+        eng8 = DistWideMsBfsEngine(rmat_small, make_mesh(8), lanes=64)
+        full = eng8.run(self.SOURCES)
+        st = eng8.advance(eng8.start(self.SOURCES), levels=1)
+        eng2 = DistWideMsBfsEngine(rmat_small, make_mesh(2), lanes=64)
+        st = eng2.advance(st, levels=1)
+        single = HybridMsBfsEngine(rmat_small, lanes=64, tile_thr=4)
+        while not st.done:
+            st = single.advance(st, levels=2)
+        res = single.finish(st)
+        for i in range(len(self.SOURCES)):
+            np.testing.assert_array_equal(
+                res.distances_int32(i), full.distances_int32(i)
+            )
+
+    def test_isolated_source_lane_cross_engine(self, rmat_small):
+        # A checkpoint started on a TRIMMED engine stores no bits for an
+        # isolated source (it has no table row there); the finishing
+        # engine's iso patch must fire even when that engine is the
+        # distributed wide one (every vertex has a row there, so its own
+        # runs never needed the patch — cross-engine finishes do).
+        from tpu_bfs.algorithms.msbfs_hybrid import HybridMsBfsEngine
+        from tpu_bfs.parallel.dist_bfs import make_mesh
+        from tpu_bfs.parallel.dist_msbfs_wide import DistWideMsBfsEngine
+
+        iso = int(np.flatnonzero(rmat_small.degrees == 0)[0])
+        srcs = np.array([iso, 1])
+        single = HybridMsBfsEngine(rmat_small, lanes=64, tile_thr=4)
+        st = single.start(srcs)
+        while not st.done:
+            st = single.advance(st, levels=2)
+        dw = DistWideMsBfsEngine(rmat_small, make_mesh(8), lanes=64)
+        res = dw.finish(st)
+        assert res.reached[0] == 1
+        d = res.distances_int32(0)
+        assert d[iso] == 0
+        np.testing.assert_array_equal(
+            res.distances_int32(1), single.finish(st).distances_int32(1)
+        )

@@ -408,6 +408,21 @@ def packed_real_to_table(engine, real):
     return jnp.asarray(t)
 
 
+def _fw_hooks(engine):
+    """Frontier layout-conversion hooks: engines whose loop carries the
+    frontier in a different layout than their visited/plane tables (the
+    distributed wide engine: replicated rank-order + sentinel row vs
+    chip-major shards) provide ``_fw_table_from_real``/``_fw_real_from_table``;
+    everyone else uses the shared real<->table conversion."""
+    to_fw = getattr(
+        engine, "_fw_table_from_real", None
+    ) or (lambda real: packed_real_to_table(engine, real))
+    from_fw = getattr(
+        engine, "_fw_real_from_table", None
+    ) or (lambda table: packed_table_to_real(engine, table))
+    return to_fw, from_fw
+
+
 def start_packed_batch(engine, sources):
     """Level-0 packed traversal state as a host checkpoint.
 
@@ -418,7 +433,10 @@ def start_packed_batch(engine, sources):
     from tpu_bfs.utils.checkpoint import PackedCheckpoint
 
     sources = _check_batch_sources(engine, sources)
-    seed_real = packed_table_to_real(engine, engine._seed_dev(sources))
+    # The seed table may use a different row order than the result tables
+    # (the distributed wide engine); the _src_bits_view hook converts.
+    seed_view = getattr(engine, "_src_bits_view", lambda x: x)
+    seed_real = packed_table_to_real(engine, seed_view(engine._seed_dev(sources)))
     planes = np.zeros(
         (engine.num_planes, engine.num_vertices, engine.w), np.uint32
     )
@@ -448,9 +466,13 @@ def advance_packed_batch(engine, ckpt, levels: int | None = None):
         return ckpt
     cap = engine.max_levels_cap
     ml = min(ckpt.level + levels, cap) if levels is not None else cap
-    fw = packed_real_to_table(engine, ckpt.frontier)
+    to_fw, from_fw = _fw_hooks(engine)
+    # visited converts first: packed_real_to_table raises the descriptive
+    # lane-count/graph mismatch error before any custom frontier hook can
+    # hit a raw broadcast failure.
     vis = packed_real_to_table(engine, ckpt.visited)
     planes = tuple(packed_real_to_table(engine, p) for p in ckpt.planes)
+    fw = to_fw(ckpt.frontier)
     fw_f, vis_f, planes_f, level, alive = engine._core_from(
         engine.arrs, fw, vis, planes, jnp.int32(ckpt.level), jnp.int32(ml)
     )
@@ -475,7 +497,7 @@ def advance_packed_batch(engine, ckpt, levels: int | None = None):
         sources=ckpt.sources,
         level=int(level),
         alive=bool(alive),
-        frontier=packed_table_to_real(engine, fw_f),
+        frontier=from_fw(fw_f),
         visited=packed_table_to_real(engine, vis_f),
         planes=np.stack(
             [packed_table_to_real(engine, p) for p in planes_f]

@@ -322,8 +322,12 @@ def _make_dist_core(hd, w: int, num_planes: int, mesh: Mesh, interpret: bool):
     expand = make_fori_expand(hd["res_spec"], w)
     has_dense = hd["num_tiles"] > 0
 
-    def chip_fn(arrs, fw0, max_levels):
-        arrs = {k: a[0] for k, a in arrs.items()}  # strip this chip's P axis
+    def _global_any(x):
+        return lax.psum(jnp.any(x != 0).astype(jnp.int32), "v") > 0
+
+    def _make_loop(arrs, max_levels):
+        """This chip's level machinery over its stripped arrays: returns
+        (run_from, hit_own_of) — shared by the fresh and resume entries."""
 
         def gather_frontier(fw_own):
             # Transient full frontier in global rank0 order: global tile
@@ -341,13 +345,6 @@ def _make_dist_core(hd, w: int, num_planes: int, mesh: Mesh, interpret: bool):
                 )
             return hit & arrs["valid"]
 
-        planes0 = tuple(
-            jnp.zeros((rows_loc, w), jnp.uint32) for _ in range(num_planes)
-        )
-
-        def global_any(x):
-            return lax.psum(jnp.any(x != 0).astype(jnp.int32), "v") > 0
-
         def cond(carry):
             _, _, _, level, alive = carry
             return alive & (level < max_levels)
@@ -359,20 +356,41 @@ def _make_dist_core(hd, w: int, num_planes: int, mesh: Mesh, interpret: bool):
             planes = ripple_increment(planes, ~vis2)
             # One psum per level is the whole termination protocol (the
             # reference needs a host-visible MPI_Allreduce, bfs_mpi.cu:621).
-            alive = global_any(nxt)
+            alive = _global_any(nxt)
             return nxt, vis2, planes, level + 1, alive
 
-        fw_f, vis_f, planes_f, levels, alive = lax.while_loop(
-            cond, body, (fw0, fw0, planes0, jnp.int32(0), jnp.bool_(True))
+        def run_from(fw, vis, planes, level0):
+            return lax.while_loop(
+                cond, body, (fw, vis, planes, level0, jnp.bool_(True))
+            )
+
+        return run_from, hit_own_of
+
+    def chip_fn(arrs, fw0, max_levels):
+        arrs = {k: a[0] for k, a in arrs.items()}  # strip this chip's P axis
+        run_from, hit_own_of = _make_loop(arrs, max_levels)
+        planes0 = tuple(
+            jnp.zeros((rows_loc, w), jnp.uint32) for _ in range(num_planes)
+        )
+        fw_f, vis_f, planes_f, levels, alive = run_from(
+            fw0, fw0, planes0, jnp.int32(0)
         )
 
         def deeper():
-            return global_any(hit_own_of(fw_f) & ~vis_f)
+            return _global_any(hit_own_of(fw_f) & ~vis_f)
 
         truncated = lax.cond(
             alive & (levels >= max_levels), deeper, lambda: jnp.bool_(False)
         )
         return planes_f, vis_f, levels, alive, truncated
+
+    def chip_fn_from(arrs, fw, vis, planes, level0, max_levels):
+        # Checkpoint-resume entry: the while-loop carry (all in the same
+        # sharded tau row space) restored mid-traversal — bit-identical to
+        # never having stopped (_packed_common.advance_packed_batch).
+        arrs = {k: a[0] for k, a in arrs.items()}
+        run_from, _ = _make_loop(arrs, max_levels)
+        return run_from(fw, vis, planes, level0)
 
     def build(n_arrs):
         core = jax.jit(
@@ -390,11 +408,33 @@ def _make_dist_core(hd, w: int, num_planes: int, mesh: Mesh, interpret: bool):
                 check_vma=False,
             )
         )
+        core_from = jax.jit(
+            jax.shard_map(
+                chip_fn_from,
+                mesh=mesh,
+                in_specs=(
+                    {k: P("v") for k in n_arrs},
+                    P("v"),
+                    P("v"),
+                    tuple(P("v") for _ in range(num_planes)),
+                    P(),
+                    P(),
+                ),
+                out_specs=(
+                    P("v"),
+                    P("v"),
+                    tuple(P("v") for _ in range(num_planes)),
+                    P(),
+                    P(),
+                ),
+                check_vma=False,
+            )
+        )
         device_arrs = {
             k: jax.device_put(a, NamedSharding(mesh, P("v")))
             for k, a in n_arrs.items()
         }
-        return core, device_arrs
+        return core, core_from, device_arrs
 
     return build
 
@@ -454,7 +494,8 @@ class DistHybridMsBfsEngine:
             n_arrs["col_tile"] = hd["col_tile_s"]
             n_arrs["a_tiles"] = hd["a_tiles_s"]
         build = _make_dist_core(hd, self.w, num_planes, self.mesh, interpret)
-        self._dist_core, self.arrs = build(n_arrs)
+        self._dist_core, self._core_from, self.arrs = build(n_arrs)
+        self._table_rows = hd["rows"]
 
         # Extraction maps vertices through tau (vertex -> sharded-table row);
         # isolated vertices map to `rows` and are masked host-side (_act).
@@ -510,3 +551,23 @@ class DistHybridMsBfsEngine:
             self, sources, max_levels=max_levels, time_it=time_it,
             check_cap=check_cap,
         )
+
+    # --- checkpoint/resume: every table lives in one (tau, sharded) row
+    # space, so the generic real-id protocol applies unchanged — and since
+    # checkpoints are real-id, a batch checkpointed here resumes on the
+    # single-chip engines (or a different mesh size: elastic restart).
+
+    def start(self, sources):
+        from tpu_bfs.algorithms._packed_common import start_packed_batch
+
+        return start_packed_batch(self, sources)
+
+    def advance(self, ckpt, levels: int | None = None):
+        from tpu_bfs.algorithms._packed_common import advance_packed_batch
+
+        return advance_packed_batch(self, ckpt, levels)
+
+    def finish(self, ckpt):
+        from tpu_bfs.algorithms._packed_common import finish_packed_batch
+
+        return finish_packed_batch(self, ckpt)

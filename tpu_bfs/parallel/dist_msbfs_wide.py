@@ -60,14 +60,9 @@ def _make_dist_core(sell: ShardedEllGraph, w: int, num_planes: int, mesh: Mesh):
     )
     expand = make_fori_expand(spec, w)
 
-    def chip_fn(arrs, fw0, max_levels):
-        # Block specs keep a leading shard axis of size 1; drop it.
-        arrs = {k: a[0] for k, a in arrs.items()}
-        p = lax.axis_index("v")
-        own = lambda full: lax.dynamic_index_in_dim(
-            full[:v_pad].reshape(v_loc, p_count, w), p, axis=1, keepdims=False
-        )
-        planes0 = tuple(jnp.zeros((v_loc, w), jnp.uint32) for _ in range(num_planes))
+    def _make_loop(arrs, max_levels):
+        """This chip's level machinery (run_from + deeper probe pieces),
+        shared by the fresh and checkpoint-resume entries."""
 
         def cond(carry):
             _, _, _, level, alive = carry
@@ -85,8 +80,24 @@ def _make_dist_core(sell: ShardedEllGraph, w: int, num_planes: int, mesh: Mesh):
             alive = jnp.any(fw_flat != 0)
             return fw_next, vis2, planes, level + 1, alive
 
-        fw_f, vis_f, planes_f, levels, alive = lax.while_loop(
-            cond, body, (fw0, own(fw0), planes0, jnp.int32(0), jnp.bool_(True))
+        def run_from(fw, vis, planes, level0):
+            return lax.while_loop(
+                cond, body, (fw, vis, planes, level0, jnp.bool_(True))
+            )
+
+        return run_from
+
+    def chip_fn(arrs, fw0, max_levels):
+        # Block specs keep a leading shard axis of size 1; drop it.
+        arrs = {k: a[0] for k, a in arrs.items()}
+        p = lax.axis_index("v")
+        own = lambda full: lax.dynamic_index_in_dim(
+            full[:v_pad].reshape(v_loc, p_count, w), p, axis=1, keepdims=False
+        )
+        planes0 = tuple(jnp.zeros((v_loc, w), jnp.uint32) for _ in range(num_planes))
+        run_from = _make_loop(arrs, max_levels)
+        fw_f, vis_f, planes_f, levels, alive = run_from(
+            fw0, own(fw0), planes0, jnp.int32(0)
         )
 
         # Claim-free truncation probe (see msbfs_wide): one more expand, only
@@ -107,6 +118,16 @@ def _make_dist_core(sell: ShardedEllGraph, w: int, num_planes: int, mesh: Mesh):
             truncated,
         )
 
+    def chip_fn_from(arrs, fw, vis, planes, level0, max_levels):
+        # Checkpoint-resume entry. Layouts match the loop carry: ``fw`` is
+        # the replicated rank-order [v_pad+1, w] table (+ the ELL sentinel
+        # row), ``vis``/``planes`` are this chip's [v_loc, w] blocks of the
+        # chip-major tables (chip-major row p*v_loc+l IS shard p's row l,
+        # so P('v') over the chip-major axis hands each chip its block).
+        arrs = {k: a[0] for k, a in arrs.items()}
+        run_from = _make_loop(arrs, max_levels)
+        return run_from(fw, vis, planes, level0)
+
     def build(n_arrs):
         specs = {k: P("v") for k in n_arrs}
         core = jax.jit(
@@ -124,11 +145,33 @@ def _make_dist_core(sell: ShardedEllGraph, w: int, num_planes: int, mesh: Mesh):
                 check_vma=False,
             )
         )
+        core_from = jax.jit(
+            jax.shard_map(
+                chip_fn_from,
+                mesh=mesh,
+                in_specs=(
+                    specs,
+                    P(),
+                    P("v"),
+                    tuple(P("v") for _ in range(num_planes)),
+                    P(),
+                    P(),
+                ),
+                out_specs=(
+                    P(),
+                    P("v"),
+                    tuple(P("v") for _ in range(num_planes)),
+                    P(),
+                    P(),
+                ),
+                check_vma=False,
+            )
+        )
         device_arrs = {
             k: jax.device_put(v, NamedSharding(mesh, P("v")))
             for k, v in n_arrs.items()
         }
-        return core, device_arrs
+        return core, core_from, device_arrs
 
     return build
 
@@ -171,6 +214,25 @@ class DistWideMsBfsEngine:
             )
         sell = self.sell
         self.undirected = sell.undirected
+        # Isolated-source convention (cross-engine checkpoints): real-id
+        # checkpoints store no bits for sources that appear in NO edge (the
+        # trimmed engines have no row for them), and the finishing engine
+        # patches those lanes (reached=1). Every vertex has a row HERE, so
+        # this engine's own runs don't need the patch — but finishing a
+        # checkpoint started on a trimmed engine does. Exact from a Graph;
+        # for a prebuilt undirected shard set in_degree==0 is equivalent; a
+        # prebuilt directed one cannot distinguish out-only vertices, so the
+        # patch is skipped (None).
+        if isinstance(graph, Graph):
+            src, dst = graph.coo
+            seen = np.zeros(graph.num_vertices, dtype=bool)
+            seen[src] = True
+            seen[dst] = True
+            self._iso_mask = ~seen
+        elif sell.undirected:
+            self._iso_mask = sell.in_degree == 0
+        else:
+            self._iso_mask = None
 
         w = self.w
         n_arrs = {}
@@ -181,7 +243,11 @@ class DistWideMsBfsEngine:
         for i, (k, blocks) in enumerate(sell.light):
             n_arrs[f"light{i}_t"] = np.ascontiguousarray(blocks.transpose(0, 2, 1))
         build = _make_dist_core(sell, w, num_planes, self.mesh)
-        self._dist_core, self.arrs = build(n_arrs)
+        self._dist_core, self._core_from, self.arrs = build(n_arrs)
+        # Checkpoint-conversion metadata: _rank (below) is the chip-major
+        # vertex->row map the result tables use; every vertex has a row.
+        self._table_rows = sell.v_pad
+        self._act = sell.v_pad
 
         # Chip-major row of global rank r is (r % P) * v_loc + r // P.
         ranks = sell.rank.astype(np.int64)
@@ -215,6 +281,11 @@ class DistWideMsBfsEngine:
     @staticmethod
     def _lane_order(mat: np.ndarray) -> np.ndarray:
         return mat.reshape(-1)
+
+    def _iso_of(self, sources: np.ndarray):
+        if self._iso_mask is None:
+            return None
+        return self._iso_mask[np.asarray(sources, np.int64)]
 
     def _seed_dev(self, sources: np.ndarray):
         # The loop consumes the replicated [v_pad+1, w] table in RANK order
@@ -253,3 +324,39 @@ class DistWideMsBfsEngine:
             self, sources, max_levels=max_levels, time_it=time_it,
             check_cap=check_cap,
         )
+
+    # --- checkpoint/resume. Checkpoints are real-vertex-id (portable to the
+    # single-chip engines and other mesh sizes — elastic restart); the only
+    # engine-specific pieces are the frontier layout hooks consumed by
+    # _packed_common (the loop carries the frontier replicated in rank
+    # order + ELL sentinel row, unlike the chip-major visited/planes).
+
+    def _fw_table_from_real(self, real):
+        sell = self.sell
+        if real.shape != (self.num_vertices, self.w):
+            raise ValueError(
+                f"checkpoint table is {real.shape}, engine expects "
+                f"({self.num_vertices}, {self.w}) — lane count and graph "
+                "must match the engine the checkpoint resumes on"
+            )
+        t = np.zeros((sell.v_pad + 1, self.w), np.uint32)  # + sentinel row
+        t[sell.rank] = real
+        return jnp.asarray(t)
+
+    def _fw_real_from_table(self, fw_rank):
+        return np.asarray(fw_rank)[self.sell.rank]
+
+    def start(self, sources):
+        from tpu_bfs.algorithms._packed_common import start_packed_batch
+
+        return start_packed_batch(self, sources)
+
+    def advance(self, ckpt, levels: int | None = None):
+        from tpu_bfs.algorithms._packed_common import advance_packed_batch
+
+        return advance_packed_batch(self, ckpt, levels)
+
+    def finish(self, ckpt):
+        from tpu_bfs.algorithms._packed_common import finish_packed_batch
+
+        return finish_packed_batch(self, ckpt)

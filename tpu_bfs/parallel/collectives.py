@@ -186,6 +186,23 @@ def sparse_exchange_or(
     return step(None)
 
 
+def merge_exchange_counts(prev, counts, resumed_level: int):
+    """Accumulate per-branch exchange level counts across the chunks of one
+    checkpointed traversal. The chain test is ``prev.sum() == resumed_level``
+    — the previous counters cover exactly levels [0, resumed_level) iff they
+    belong to this chain; counters left by an UNRELATED traversal that
+    happened to run resumed_level levels would merge wrongly (rare
+    coincidence, documented caveat), and chains whose earlier chunks ran in
+    another process simply restart the count (covering the levels run
+    here). Shared by every engine with exchange accounting."""
+    import numpy as np
+
+    counts = np.asarray(counts)
+    if resumed_level > 0 and prev is not None and prev.sum() == resumed_level:
+        return counts + prev
+    return counts
+
+
 def sparse_wire_bytes_per_level(
     p: int, n: int, caps: tuple[int, ...]
 ) -> list[float]:

@@ -43,6 +43,7 @@ from tpu_bfs.graph.csr import Graph, INF_DIST
 from tpu_bfs.parallel.collectives import (
     default_sparse_caps,
     dense_or_wire_bytes,
+    merge_exchange_counts,
     reduce_scatter_or,
     reduce_scatter_min,
     sparse_exchange_or,
@@ -273,16 +274,9 @@ class DistBfsEngine:
         self._warmed = False
 
     def _record_exchange(self, branch_counts, *, resumed_level: int = 0) -> None:
-        prev = self.last_exchange_level_counts
-        counts = np.asarray(branch_counts)
-        if resumed_level > 0 and prev is not None and prev.sum() == resumed_level:
-            # Chunked (checkpointed) traversal continuing the chain this
-            # engine instance recorded: accumulate so the counters cover the
-            # whole traversal. The prev.sum() == level check rejects counts
-            # left over from an unrelated traversal (a different source's
-            # run, or a chain whose earlier chunks ran in another process —
-            # then the counters cover only the levels run here).
-            counts = counts + prev
+        counts = merge_exchange_counts(
+            self.last_exchange_level_counts, branch_counts, resumed_level
+        )
         if self._exchange == "sparse":
             per = sparse_wire_bytes_per_level(self.p, self.part.vloc, self.sparse_caps)
         else:

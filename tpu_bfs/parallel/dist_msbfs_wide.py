@@ -25,6 +25,8 @@ unchanged.
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -46,10 +48,14 @@ W = 128
 LANES = 32 * W
 
 
-def _make_dist_core(sell: ShardedEllGraph, w: int, num_planes: int, mesh: Mesh):
+def _make_dist_core(
+    sell: ShardedEllGraph, w: int, num_planes: int, mesh: Mesh,
+    exchange: str = "dense", sparse_caps: tuple[int, ...] = (),
+):
     p_count = sell.num_shards
     v_loc = sell.v_loc
     v_pad = sell.v_pad
+    nb = len(sparse_caps) + 1 if exchange == "sparse" else 1
     spec = ExpandSpec(
         kcap=sell.kcap,
         heavy=sell.heavy_per_shard > 0,
@@ -60,29 +66,88 @@ def _make_dist_core(sell: ShardedEllGraph, w: int, num_planes: int, mesh: Mesh):
     )
     expand = make_fori_expand(spec, w)
 
+    def _dense_gather(nxt):
+        gathered = lax.all_gather(nxt, "v")  # [P, v_loc, W]
+        return gathered.transpose(1, 0, 2).reshape(v_pad, w)
+
+    def _sparse_gather(nxt):
+        """Queue-style frontier exchange for the packed engine: when every
+        chip's new frontier fits a ``sparse_caps`` rung, gather (row id,
+        lane words) pairs instead of the full [v_loc, w] table — the
+        MS-engine form of the reference's per-destination buckets
+        (bfs.cu:148-150), with the same ascending cap-ladder shape as the
+        single-source sparse exchange (collectives.sparse_exchange_or).
+        Early/late levels of wide batches and high-diameter graphs touch a
+        handful of rows; mid-BFS levels of power-law graphs are dense and
+        take the bitmap branch (it IS the compact encoding there). Every
+        branch is entered uniformly (pmax predicate), so the collectives
+        stay matched; returns (fw_flat [v_pad, w], branch int32) — branch
+        indexes the taken rung (ascending) or len(caps) for dense."""
+        p = lax.axis_index("v")
+        any_row = jnp.any(nxt != 0, axis=1)  # [v_loc]
+        count = jnp.sum(any_row.astype(jnp.int32))
+        biggest = lax.pmax(count, "v")
+
+        def make_sparse(cap, idx):
+            def sparse_fn(_):
+                (ids,) = jnp.nonzero(any_row, size=cap, fill_value=v_loc)
+                rows = nxt[jnp.where(ids < v_loc, ids, 0)]  # [cap, w]
+                rows = jnp.where((ids < v_loc)[:, None], rows, 0)
+                # Local row l on chip q holds global rank l*P + q.
+                gids = jnp.where(ids < v_loc, ids * p_count + p, v_pad)
+                ag_ids = lax.all_gather(gids, "v").reshape(-1)  # [P*cap]
+                ag_rows = lax.all_gather(rows, "v").reshape(-1, w)
+                fw_flat = (
+                    jnp.zeros((v_pad, w), jnp.uint32)
+                    .at[ag_ids]
+                    .set(ag_rows, mode="drop")  # sentinel v_pad drops
+                )
+                return fw_flat, jnp.int32(idx)
+
+            return sparse_fn
+
+        def dense_fn(_):
+            return _dense_gather(nxt), jnp.int32(len(sparse_caps))
+
+        step = dense_fn
+        ladder = sorted(sparse_caps)
+        for idx in range(len(ladder) - 1, -1, -1):
+            step = partial(
+                lax.cond, biggest <= ladder[idx],
+                make_sparse(ladder[idx], idx), step,
+            )
+        return step(None)
+
     def _make_loop(arrs, max_levels):
         """This chip's level machinery (run_from + deeper probe pieces),
         shared by the fresh and checkpoint-resume entries."""
 
         def cond(carry):
-            _, _, _, level, alive = carry
+            _, _, _, level, alive, _ = carry
             return alive & (level < max_levels)
 
         def body(carry):
-            fw, vis, planes, level, _ = carry
+            fw, vis, planes, level, _, branch_counts = carry
             hit = expand(arrs, fw)
             nxt = hit & ~vis
             vis2 = vis | nxt
             planes = ripple_increment(planes, ~vis2)
-            gathered = lax.all_gather(nxt, "v")  # [P, v_loc, W]
-            fw_flat = gathered.transpose(1, 0, 2).reshape(v_pad, w)
+            if exchange == "sparse":
+                fw_flat, branch = _sparse_gather(nxt)
+            else:
+                fw_flat, branch = _dense_gather(nxt), jnp.int32(0)
+            branch_counts = branch_counts + (
+                jnp.arange(nb, dtype=jnp.int32) == branch
+            )
             fw_next = jnp.concatenate([fw_flat, jnp.zeros((1, w), jnp.uint32)])
             alive = jnp.any(fw_flat != 0)
-            return fw_next, vis2, planes, level + 1, alive
+            return fw_next, vis2, planes, level + 1, alive, branch_counts
 
         def run_from(fw, vis, planes, level0):
             return lax.while_loop(
-                cond, body, (fw, vis, planes, level0, jnp.bool_(True))
+                cond, body,
+                (fw, vis, planes, level0, jnp.bool_(True),
+                 jnp.zeros(nb, jnp.int32)),
             )
 
         return run_from
@@ -96,7 +161,7 @@ def _make_dist_core(sell: ShardedEllGraph, w: int, num_planes: int, mesh: Mesh):
         )
         planes0 = tuple(jnp.zeros((v_loc, w), jnp.uint32) for _ in range(num_planes))
         run_from = _make_loop(arrs, max_levels)
-        fw_f, vis_f, planes_f, levels, alive = run_from(
+        fw_f, vis_f, planes_f, levels, alive, branch_counts = run_from(
             fw0, own(fw0), planes0, jnp.int32(0)
         )
 
@@ -116,6 +181,7 @@ def _make_dist_core(sell: ShardedEllGraph, w: int, num_planes: int, mesh: Mesh):
             levels,
             alive,
             truncated,
+            branch_counts,
         )
 
     def chip_fn_from(arrs, fw, vis, planes, level0, max_levels):
@@ -141,6 +207,7 @@ def _make_dist_core(sell: ShardedEllGraph, w: int, num_planes: int, mesh: Mesh):
                     P(),
                     P(),
                     P(),
+                    P(),
                 ),
                 check_vma=False,
             )
@@ -161,6 +228,7 @@ def _make_dist_core(sell: ShardedEllGraph, w: int, num_planes: int, mesh: Mesh):
                     P(),
                     P("v"),
                     tuple(P("v") for _ in range(num_planes)),
+                    P(),
                     P(),
                     P(),
                 ),
@@ -192,9 +260,15 @@ class DistWideMsBfsEngine:
         lanes: int = LANES,
         kcap: int = 64,
         num_planes: int = 5,
+        exchange: str = "dense",
+        sparse_caps: int | tuple[int, ...] | None = None,
     ):
         if not (1 <= num_planes <= 8):
             raise ValueError("num_planes must be in [1, 8]")
+        if exchange not in ("dense", "sparse"):
+            raise ValueError(
+                f"unknown exchange {exchange!r}; have 'dense', 'sparse'"
+            )
         if lanes % 32 or not (32 <= lanes <= LANES):
             raise ValueError(f"lanes must be a multiple of 32 in [32, {LANES}]")
         self.w = lanes // 32
@@ -242,8 +316,27 @@ class DistWideMsBfsEngine:
             n_arrs["heavy_pick"] = sell.heavy_pick
         for i, (k, blocks) in enumerate(sell.light):
             n_arrs[f"light{i}_t"] = np.ascontiguousarray(blocks.transpose(0, 2, 1))
-        build = _make_dist_core(sell, w, num_planes, self.mesh)
-        self._dist_core, self._core_from, self.arrs = build(n_arrs)
+        if sparse_caps is None:
+            # Width-aware break-even: a gathered row costs 4 id + 4w payload
+            # bytes vs the bitmap's 4w, so sparse wins only below
+            # be = v_loc * w / (w + 1) rows. Two-tier ladder (tight rung for
+            # trickle levels, wide rung at half break-even) — the same shape
+            # as collectives.default_sparse_caps.
+            be = (sell.v_loc * self.w) // (self.w + 1)
+            sparse_caps = tuple(sorted({max(1, be // 16), max(1, be // 2)}))
+        elif isinstance(sparse_caps, int):
+            sparse_caps = (sparse_caps,)
+        self._exchange = exchange
+        self.sparse_caps = tuple(sorted(sparse_caps))
+        #: per-branch level counts of the last traversal (ascending sparse
+        #: rungs then dense fallback; the dense impl has a single entry)
+        #: and the modeled off-chip bytes one chip moved — _record_exchange.
+        self.last_exchange_level_counts: np.ndarray | None = None
+        self.last_exchange_bytes: float | None = None
+        build = _make_dist_core(
+            sell, w, num_planes, self.mesh, exchange, self.sparse_caps
+        )
+        self._dist_core, self._core_from_jit, self.arrs = build(n_arrs)
         # Checkpoint-conversion metadata: _rank (below) is the chip-major
         # vertex->row map the result tables use; every vertex has a row.
         self._table_rows = sell.v_pad
@@ -312,12 +405,48 @@ class DistWideMsBfsEngine:
             .reshape(sell.v_pad, self.w)
         )
 
+    def _record_exchange(self, branch_counts, resumed_level: int) -> None:
+        """Exact per-branch level counts -> modeled off-chip bytes per chip
+        (same accounting discipline as DistBfsEngine: a rung of cap c moves
+        (P-1)*c*(4+4w) id+word bytes + the 4-byte pmax scalar; the dense
+        bitmap branch (P-1)*v_loc*4w — plus the pmax scalar when the sparse
+        machinery ran the predicate that level). A 1-device mesh moves
+        nothing, like collectives.sparse_wire_bytes_per_level."""
+        from tpu_bfs.parallel.collectives import merge_exchange_counts
+
+        counts = merge_exchange_counts(
+            self.last_exchange_level_counts, branch_counts, resumed_level
+        )
+        p, v_loc, w = self.sell.num_shards, self.sell.v_loc, self.w
+        self.last_exchange_level_counts = counts
+        if p == 1:
+            self.last_exchange_bytes = 0.0
+            return
+        dense = float((p - 1) * v_loc * 4 * w)
+        if self._exchange == "sparse":
+            per = [
+                float((p - 1) * c * (4 + 4 * w) + 4) for c in self.sparse_caps
+            ] + [dense + 4]
+        else:
+            per = [dense]
+        self.last_exchange_bytes = float(np.dot(counts, per))
+
     def _core(self, arrs, fw0, max_levels):
-        planes, vis, levels, alive, truncated = self._dist_core(arrs, fw0, max_levels)
+        planes, vis, levels, alive, truncated, bc = self._dist_core(
+            arrs, fw0, max_levels
+        )
+        self._record_exchange(bc, 0)
         # [P, v_loc, w] blocks -> chip-major [v_pad, w] tables.
         planes = tuple(pl.reshape(self.sell.v_pad, self.w) for pl in planes)
         vis = vis.reshape(self.sell.v_pad, self.w)
         return planes, vis, levels, alive, truncated
+
+    def _core_from(self, arrs, fw, vis, planes, level0, max_levels):
+        fw_f, vis_f, planes_f, level, alive, bc = self._core_from_jit(
+            arrs, fw, vis, planes, level0, max_levels
+        )
+        self._record_exchange(bc, int(level0))
+        return fw_f, vis_f, planes_f, level, alive
 
     def run(self, sources, *, max_levels=None, time_it=False, check_cap=True):
         return run_packed_batch(

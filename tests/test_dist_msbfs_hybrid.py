@@ -133,3 +133,26 @@ def test_dist_hybrid_disconnected_and_cap(random_disconnected, line_graph):
     )
     with pytest.raises(RuntimeError, match="num_planes"):
         deep.run(np.array([0]))
+
+
+def test_sparse_frontier_gather_matches_dense(rmat_small):
+    # Queue-style (rank0 row id + lane words) gather vs the dense slab:
+    # identical distances, counters cover every level, fewer modeled bytes.
+    srcs = np.array([1, 5, 9, 33])
+    mesh = make_mesh(8)
+    dense = DistHybridMsBfsEngine(rmat_small, mesh, tile_thr=4)
+    sparse = DistHybridMsBfsEngine(
+        rmat_small, mesh, tile_thr=4, exchange="sparse"
+    )
+    rd = dense.run(srcs)
+    rs = sparse.run(srcs)
+    for i in range(len(srcs)):
+        np.testing.assert_array_equal(
+            rs.distances_int32(i), rd.distances_int32(i)
+        )
+    assert sparse.last_exchange_level_counts[:-1].sum() >= 1
+    assert sparse.last_exchange_bytes < dense.last_exchange_bytes
+    assert (
+        sparse.last_exchange_level_counts.sum()
+        == dense.last_exchange_level_counts.sum()
+    )

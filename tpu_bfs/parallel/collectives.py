@@ -27,6 +27,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 
@@ -195,12 +196,103 @@ def merge_exchange_counts(prev, counts, resumed_level: int):
     coincidence, documented caveat), and chains whose earlier chunks ran in
     another process simply restart the count (covering the levels run
     here). Shared by every engine with exchange accounting."""
-    import numpy as np
-
     counts = np.asarray(counts)
     if resumed_level > 0 and prev is not None and prev.sum() == resumed_level:
         return counts + prev
     return counts
+
+
+def sparse_rows_gather(
+    nxt, axis_name: str, *, caps: tuple[int, ...],
+    out_rows: int, gid_of, dense_fn,
+):
+    """Queue-style frontier gather for the packed MS engines, shared by the
+    distributed wide and hybrid engines (which differ only in their
+    local-row -> global-row maps and dense slab layouts).
+
+    When every chip's new-frontier row count fits a ``caps`` rung (decided
+    by one mesh-uniform `pmax`, so every chip takes the same `lax.cond`
+    branch and the collectives stay matched), the level gathers
+    (global row id + lane words) pairs and rebuilds the full [out_rows, w]
+    table with one drop-mode scatter; otherwise ``dense_fn()`` gathers the
+    full packed slab — on dense mid-BFS levels the slab IS the compact
+    encoding. ``gid_of(local_ids)`` maps this chip's local row ids to
+    global table rows (invalid entries already filtered by the caller's
+    closure returning ``out_rows``, the drop sentinel).
+
+    Returns ``(table [out_rows, w], branch int32)`` — branch indexes the
+    taken rung (ascending caps order) or ``len(caps)`` for dense.
+    """
+    rows_loc, w = nxt.shape
+    any_row = jnp.any(nxt != 0, axis=1)  # [rows_loc]
+    biggest = lax.pmax(jnp.sum(any_row.astype(jnp.int32)), axis_name)
+
+    def make_sparse(cap, idx):
+        def sparse_fn(_):
+            (ids,) = jnp.nonzero(any_row, size=cap, fill_value=rows_loc)
+            ok = ids < rows_loc
+            vals = jnp.where(ok[:, None], nxt[jnp.where(ok, ids, 0)], 0)
+            gids = jnp.where(ok, gid_of(ids), out_rows)
+            ag_ids = lax.all_gather(gids, axis_name).reshape(-1)
+            ag_vals = lax.all_gather(vals, axis_name).reshape(-1, w)
+            table = (
+                jnp.zeros((out_rows, w), jnp.uint32)
+                .at[ag_ids]
+                .set(ag_vals, mode="drop")  # sentinel out_rows drops
+            )
+            return table, jnp.int32(idx)
+
+        return sparse_fn
+
+    def dense_branch(_):
+        return dense_fn(), jnp.int32(len(caps))
+
+    step = dense_branch
+    ladder = sorted(caps)
+    for idx in range(len(ladder) - 1, -1, -1):
+        step = partial(
+            lax.cond, biggest <= ladder[idx], make_sparse(ladder[idx], idx), step
+        )
+    return step(None)
+
+
+def default_row_gather_caps(rows_loc: int, w: int) -> tuple[int, ...]:
+    """Width-aware cap ladder for sparse_rows_gather: each gathered row
+    costs 4 id + 4w payload bytes vs the dense slab's 4w per row, so the
+    byte win holds below rows_loc*w/(w+1) rows; two tiers as in
+    default_sparse_caps (tight rung for trickle levels, half break-even)."""
+    be = (rows_loc * w) // (w + 1)
+    return tuple(sorted({max(1, be // 16), max(1, be // 2)}))
+
+
+def sparse_rows_wire_bytes_per_level(
+    p: int, rows_loc: int, w: int, caps: tuple[int, ...]
+) -> list[float]:
+    """Modeled off-chip bytes per level per sparse_rows_gather branch
+    (ascending caps, then the dense slab); every branch pays the 4-byte
+    pmax scalar. A 1-device mesh moves nothing."""
+    if p == 1:
+        return [0.0] * (len(caps) + 1)
+    dense = float((p - 1) * rows_loc * 4 * w)
+    return [float((p - 1) * c * (4 + 4 * w) + 4) for c in sorted(caps)] + [
+        dense + 4.0
+    ]
+
+
+def record_row_gather_exchange(
+    prev, branch_counts, resumed_level: int, *, exchange: str, p: int,
+    rows_loc: int, w: int, caps: tuple[int, ...],
+):
+    """The packed MS engines' complete exchange accounting step: merge the
+    per-branch level counts into the chunked-traversal chain, then price
+    them with the row-gather byte model (dense impls have the single slab
+    entry). Returns (counts, bytes) for the engine to store."""
+    counts = merge_exchange_counts(prev, branch_counts, resumed_level)
+    if exchange == "sparse":
+        per = sparse_rows_wire_bytes_per_level(p, rows_loc, w, caps)
+    else:
+        per = [0.0 if p == 1 else float((p - 1) * rows_loc * 4 * w)]
+    return counts, float(np.dot(counts, per))
 
 
 def sparse_wire_bytes_per_level(

@@ -61,6 +61,11 @@ from tpu_bfs.algorithms._packed_common import (
 )
 from tpu_bfs.algorithms.msbfs_hybrid import fill_a_tiles, select_dense_tiles
 from tpu_bfs.ops.tile_spmm import AW, TILE, tile_spmm
+from tpu_bfs.parallel.collectives import (
+    default_row_gather_caps,
+    record_row_gather_exchange,
+    sparse_rows_gather,
+)
 from tpu_bfs.parallel.dist_bfs import make_mesh
 
 W = 128
@@ -314,13 +319,17 @@ def build_dist_hybrid(
     }
 
 
-def _make_dist_core(hd, w: int, num_planes: int, mesh: Mesh, interpret: bool):
+def _make_dist_core(
+    hd, w: int, num_planes: int, mesh: Mesh, interpret: bool,
+    exchange: str = "dense", sparse_caps: tuple[int, ...] = (),
+):
     p_count = mesh.devices.size
     rows = hd["rows"]
     nrt = hd["vt"] // p_count
     rows_loc = nrt * TILE
     expand = make_fori_expand(hd["res_spec"], w)
     has_dense = hd["num_tiles"] > 0
+    nb = len(sparse_caps) + 1 if exchange == "sparse" else 1
 
     def _global_any(x):
         return lax.psum(jnp.any(x != 0).astype(jnp.int32), "v") > 0
@@ -329,14 +338,33 @@ def _make_dist_core(hd, w: int, num_planes: int, mesh: Mesh, interpret: bool):
         """This chip's level machinery over its stripped arrays: returns
         (run_from, hit_own_of) — shared by the fresh and resume entries."""
 
-        def gather_frontier(fw_own):
+        def dense_gather(fw_own):
             # Transient full frontier in global rank0 order: global tile
             # t = local j * P + chip p, so the transpose interleaves.
             ag = lax.all_gather(fw_own.reshape(nrt, TILE, w), "v")
             return ag.transpose(1, 0, 2, 3).reshape(rows, w)
 
-        def hit_own_of(fw_own):
-            fw_g = gather_frontier(fw_own)
+        def sparse_gather(fw_own):
+            # collectives.sparse_rows_gather with this engine's tau row map:
+            # local row l = tile j*TILE + r is global rank0 row
+            # (j * P + chip) * TILE + r. The gathered table feeds the MXU
+            # tiles and residual gathers exactly like the dense slab.
+            p = lax.axis_index("v")
+            return sparse_rows_gather(
+                fw_own, "v",
+                caps=sparse_caps,
+                out_rows=rows,
+                gid_of=lambda ids: ((ids // TILE) * p_count + p) * TILE
+                + ids % TILE,
+                dense_fn=lambda: dense_gather(fw_own),
+            )
+
+        def gather_frontier(fw_own):
+            if exchange == "sparse":
+                return sparse_gather(fw_own)
+            return dense_gather(fw_own), jnp.int32(0)
+
+        def hit_of_gathered(fw_g):
             hit = expand(arrs, fw_g)[arrs["perm"]]  # own rows, local order
             if has_dense:
                 hit = hit | tile_spmm(
@@ -345,23 +373,32 @@ def _make_dist_core(hd, w: int, num_planes: int, mesh: Mesh, interpret: bool):
                 )
             return hit & arrs["valid"]
 
+        def hit_own_of(fw_own):
+            return hit_of_gathered(gather_frontier(fw_own)[0])
+
         def cond(carry):
-            _, _, _, level, alive = carry
+            _, _, _, level, alive, _ = carry
             return alive & (level < max_levels)
 
         def body(carry):
-            fw, vis, planes, level, _ = carry
-            nxt = hit_own_of(fw) & ~vis  # own rows only
+            fw, vis, planes, level, _, branch_counts = carry
+            fw_g, branch = gather_frontier(fw)
+            nxt = hit_of_gathered(fw_g) & ~vis  # own rows only
             vis2 = vis | nxt
             planes = ripple_increment(planes, ~vis2)
+            branch_counts = branch_counts + (
+                jnp.arange(nb, dtype=jnp.int32) == branch
+            )
             # One psum per level is the whole termination protocol (the
             # reference needs a host-visible MPI_Allreduce, bfs_mpi.cu:621).
             alive = _global_any(nxt)
-            return nxt, vis2, planes, level + 1, alive
+            return nxt, vis2, planes, level + 1, alive, branch_counts
 
         def run_from(fw, vis, planes, level0):
             return lax.while_loop(
-                cond, body, (fw, vis, planes, level0, jnp.bool_(True))
+                cond, body,
+                (fw, vis, planes, level0, jnp.bool_(True),
+                 jnp.zeros(nb, jnp.int32)),
             )
 
         return run_from, hit_own_of
@@ -372,7 +409,7 @@ def _make_dist_core(hd, w: int, num_planes: int, mesh: Mesh, interpret: bool):
         planes0 = tuple(
             jnp.zeros((rows_loc, w), jnp.uint32) for _ in range(num_planes)
         )
-        fw_f, vis_f, planes_f, levels, alive = run_from(
+        fw_f, vis_f, planes_f, levels, alive, branch_counts = run_from(
             fw0, fw0, planes0, jnp.int32(0)
         )
 
@@ -382,7 +419,7 @@ def _make_dist_core(hd, w: int, num_planes: int, mesh: Mesh, interpret: bool):
         truncated = lax.cond(
             alive & (levels >= max_levels), deeper, lambda: jnp.bool_(False)
         )
-        return planes_f, vis_f, levels, alive, truncated
+        return planes_f, vis_f, levels, alive, truncated, branch_counts
 
     def chip_fn_from(arrs, fw, vis, planes, level0, max_levels):
         # Checkpoint-resume entry: the while-loop carry (all in the same
@@ -401,6 +438,7 @@ def _make_dist_core(hd, w: int, num_planes: int, mesh: Mesh, interpret: bool):
                 out_specs=(
                     tuple(P("v") for _ in range(num_planes)),
                     P("v"),
+                    P(),
                     P(),
                     P(),
                     P(),
@@ -424,6 +462,7 @@ def _make_dist_core(hd, w: int, num_planes: int, mesh: Mesh, interpret: bool):
                     P("v"),
                     P("v"),
                     tuple(P("v") for _ in range(num_planes)),
+                    P(),
                     P(),
                     P(),
                 ),
@@ -459,9 +498,15 @@ class DistHybridMsBfsEngine:
         a_budget_bytes: int = int(0.2e9),
         num_planes: int = 5,
         interpret: bool | None = None,
+        exchange: str = "dense",
+        sparse_caps: int | tuple[int, ...] | None = None,
     ):
         if not (1 <= num_planes <= 8):
             raise ValueError("num_planes must be in [1, 8]")
+        if exchange not in ("dense", "sparse"):
+            raise ValueError(
+                f"unknown exchange {exchange!r}; have 'dense', 'sparse'"
+            )
         self.w = W
         self.lanes = LANES
         self.num_planes = num_planes
@@ -493,8 +538,24 @@ class DistHybridMsBfsEngine:
             n_arrs["row_start"] = hd["row_start_s"]
             n_arrs["col_tile"] = hd["col_tile_s"]
             n_arrs["a_tiles"] = hd["a_tiles_s"]
-        build = _make_dist_core(hd, self.w, num_planes, self.mesh, interpret)
-        self._dist_core, self._core_from, self.arrs = build(n_arrs)
+        rows_loc = (hd["vt"] // hd["num_shards"]) * TILE
+        if sparse_caps is None:
+            sparse_caps = default_row_gather_caps(rows_loc, self.w)
+        elif isinstance(sparse_caps, int):
+            sparse_caps = (sparse_caps,)
+        self._exchange = exchange
+        self.sparse_caps = tuple(sorted(sparse_caps))
+        self._rows_loc = rows_loc
+        #: per-branch level counts / modeled off-chip bytes of the last
+        #: traversal (ascending sparse rungs then dense; dense impl has the
+        #: single entry) — see _record_exchange.
+        self.last_exchange_level_counts: np.ndarray | None = None
+        self.last_exchange_bytes: float | None = None
+        build = _make_dist_core(
+            hd, self.w, num_planes, self.mesh, interpret, exchange,
+            self.sparse_caps,
+        )
+        self._dist_core, self._core_from_jit, self.arrs = build(n_arrs)
         self._table_rows = hd["rows"]
 
         # Extraction maps vertices through tau (vertex -> sharded-table row);
@@ -543,8 +604,28 @@ class DistHybridMsBfsEngine:
         tau = self.hd["tau_of_vertex"][np.asarray(sources, np.int64)]
         return self._seed_k(*seed_scatter_args(tau, self._act))
 
+    def _record_exchange(self, branch_counts, resumed_level: int) -> None:
+        self.last_exchange_level_counts, self.last_exchange_bytes = (
+            record_row_gather_exchange(
+                self.last_exchange_level_counts, branch_counts, resumed_level,
+                exchange=self._exchange, p=self.hd["num_shards"],
+                rows_loc=self._rows_loc, w=self.w, caps=self.sparse_caps,
+            )
+        )
+
     def _core(self, arrs, fw0, max_levels):
-        return self._dist_core(arrs, fw0, max_levels)
+        planes, vis, levels, alive, truncated, bc = self._dist_core(
+            arrs, fw0, max_levels
+        )
+        self._record_exchange(bc, 0)
+        return planes, vis, levels, alive, truncated
+
+    def _core_from(self, arrs, fw, vis, planes, level0, max_levels):
+        fw_f, vis_f, planes_f, level, alive, bc = self._core_from_jit(
+            arrs, fw, vis, planes, level0, max_levels
+        )
+        self._record_exchange(bc, int(level0))
+        return fw_f, vis_f, planes_f, level, alive
 
     def run(self, sources, *, max_levels=None, time_it=False, check_cap=True):
         return run_packed_batch(

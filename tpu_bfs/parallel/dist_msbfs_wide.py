@@ -42,6 +42,11 @@ from tpu_bfs.algorithms._packed_common import (
     make_state_kernels,
     run_packed_batch,
 )
+from tpu_bfs.parallel.collectives import (
+    default_row_gather_caps,
+    record_row_gather_exchange,
+    sparse_rows_gather,
+)
 from tpu_bfs.parallel.dist_bfs import make_mesh
 
 W = 128
@@ -71,52 +76,18 @@ def _make_dist_core(
         return gathered.transpose(1, 0, 2).reshape(v_pad, w)
 
     def _sparse_gather(nxt):
-        """Queue-style frontier exchange for the packed engine: when every
-        chip's new frontier fits a ``sparse_caps`` rung, gather (row id,
-        lane words) pairs instead of the full [v_loc, w] table — the
-        MS-engine form of the reference's per-destination buckets
-        (bfs.cu:148-150), with the same ascending cap-ladder shape as the
-        single-source sparse exchange (collectives.sparse_exchange_or).
-        Early/late levels of wide batches and high-diameter graphs touch a
-        handful of rows; mid-BFS levels of power-law graphs are dense and
-        take the bitmap branch (it IS the compact encoding there). Every
-        branch is entered uniformly (pmax predicate), so the collectives
-        stay matched; returns (fw_flat [v_pad, w], branch int32) — branch
-        indexes the taken rung (ascending) or len(caps) for dense."""
+        # The MS-engine form of the reference's per-destination buckets
+        # (bfs.cu:148-150): collectives.sparse_rows_gather with this
+        # engine's round-robin row map (local row l on chip q holds global
+        # rank l*P + q).
         p = lax.axis_index("v")
-        any_row = jnp.any(nxt != 0, axis=1)  # [v_loc]
-        count = jnp.sum(any_row.astype(jnp.int32))
-        biggest = lax.pmax(count, "v")
-
-        def make_sparse(cap, idx):
-            def sparse_fn(_):
-                (ids,) = jnp.nonzero(any_row, size=cap, fill_value=v_loc)
-                rows = nxt[jnp.where(ids < v_loc, ids, 0)]  # [cap, w]
-                rows = jnp.where((ids < v_loc)[:, None], rows, 0)
-                # Local row l on chip q holds global rank l*P + q.
-                gids = jnp.where(ids < v_loc, ids * p_count + p, v_pad)
-                ag_ids = lax.all_gather(gids, "v").reshape(-1)  # [P*cap]
-                ag_rows = lax.all_gather(rows, "v").reshape(-1, w)
-                fw_flat = (
-                    jnp.zeros((v_pad, w), jnp.uint32)
-                    .at[ag_ids]
-                    .set(ag_rows, mode="drop")  # sentinel v_pad drops
-                )
-                return fw_flat, jnp.int32(idx)
-
-            return sparse_fn
-
-        def dense_fn(_):
-            return _dense_gather(nxt), jnp.int32(len(sparse_caps))
-
-        step = dense_fn
-        ladder = sorted(sparse_caps)
-        for idx in range(len(ladder) - 1, -1, -1):
-            step = partial(
-                lax.cond, biggest <= ladder[idx],
-                make_sparse(ladder[idx], idx), step,
-            )
-        return step(None)
+        return sparse_rows_gather(
+            nxt, "v",
+            caps=sparse_caps,
+            out_rows=v_pad,
+            gid_of=lambda ids: ids * p_count + p,
+            dense_fn=lambda: _dense_gather(nxt),
+        )
 
     def _make_loop(arrs, max_levels):
         """This chip's level machinery (run_from + deeper probe pieces),
@@ -317,13 +288,7 @@ class DistWideMsBfsEngine:
         for i, (k, blocks) in enumerate(sell.light):
             n_arrs[f"light{i}_t"] = np.ascontiguousarray(blocks.transpose(0, 2, 1))
         if sparse_caps is None:
-            # Width-aware break-even: a gathered row costs 4 id + 4w payload
-            # bytes vs the bitmap's 4w, so sparse wins only below
-            # be = v_loc * w / (w + 1) rows. Two-tier ladder (tight rung for
-            # trickle levels, wide rung at half break-even) — the same shape
-            # as collectives.default_sparse_caps.
-            be = (sell.v_loc * self.w) // (self.w + 1)
-            sparse_caps = tuple(sorted({max(1, be // 16), max(1, be // 2)}))
+            sparse_caps = default_row_gather_caps(sell.v_loc, self.w)
         elif isinstance(sparse_caps, int):
             sparse_caps = (sparse_caps,)
         self._exchange = exchange
@@ -406,30 +371,13 @@ class DistWideMsBfsEngine:
         )
 
     def _record_exchange(self, branch_counts, resumed_level: int) -> None:
-        """Exact per-branch level counts -> modeled off-chip bytes per chip
-        (same accounting discipline as DistBfsEngine: a rung of cap c moves
-        (P-1)*c*(4+4w) id+word bytes + the 4-byte pmax scalar; the dense
-        bitmap branch (P-1)*v_loc*4w — plus the pmax scalar when the sparse
-        machinery ran the predicate that level). A 1-device mesh moves
-        nothing, like collectives.sparse_wire_bytes_per_level."""
-        from tpu_bfs.parallel.collectives import merge_exchange_counts
-
-        counts = merge_exchange_counts(
-            self.last_exchange_level_counts, branch_counts, resumed_level
+        self.last_exchange_level_counts, self.last_exchange_bytes = (
+            record_row_gather_exchange(
+                self.last_exchange_level_counts, branch_counts, resumed_level,
+                exchange=self._exchange, p=self.sell.num_shards,
+                rows_loc=self.sell.v_loc, w=self.w, caps=self.sparse_caps,
+            )
         )
-        p, v_loc, w = self.sell.num_shards, self.sell.v_loc, self.w
-        self.last_exchange_level_counts = counts
-        if p == 1:
-            self.last_exchange_bytes = 0.0
-            return
-        dense = float((p - 1) * v_loc * 4 * w)
-        if self._exchange == "sparse":
-            per = [
-                float((p - 1) * c * (4 + 4 * w) + 4) for c in self.sparse_caps
-            ] + [dense + 4]
-        else:
-            per = [dense]
-        self.last_exchange_bytes = float(np.dot(counts, per))
 
     def _core(self, arrs, fw0, max_levels):
         planes, vis, levels, alive, truncated, bc = self._dist_core(

@@ -94,12 +94,13 @@ def _validate_tile_spmm_compiled(engine) -> None:
         return
     hg = engine.hg
     t0 = time.perf_counter()
-    # Row-tile prefix (TPU_BFS_BENCH_SPMM_TILES, default 64): rank order
+    # Row-tile prefix (TPU_BFS_BENCH_SPMM_TILES, default 16): rank order
     # puts the densest rows first, so even a small prefix covers a big
-    # slice of the tile population (256 row-tiles held 70k of the LJ
-    # stand-in's 98k tiles but cost ~3 min in interpret mode; 64 keeps the
-    # per-round bench fast) — raise it for a deep audit.
-    nrt = min(int(os.environ.get("TPU_BFS_BENCH_SPMM_TILES", "64")), hg.vt)
+    # slice of the tile population (64 row-tiles still hold 43k of
+    # scale-21's 98k tiles — but interpret mode prices them at 2-5 min
+    # under chip contention, too slow for every bench run) — raise it for
+    # a deep audit.
+    nrt = min(int(os.environ.get("TPU_BFS_BENCH_SPMM_TILES", "16")), hg.vt)
     end = int(hg.row_start[nrt])
     if end == 0:
         return

@@ -217,8 +217,10 @@ def sparse_rows_gather(
     table with one drop-mode scatter; otherwise ``dense_fn()`` gathers the
     full packed slab — on dense mid-BFS levels the slab IS the compact
     encoding. ``gid_of(local_ids)`` maps this chip's local row ids to
-    global table rows (invalid entries already filtered by the caller's
-    closure returning ``out_rows``, the drop sentinel).
+    global table rows; it IS called on the nonzero-fill ids (= the local
+    row count) too — this function masks those to the ``out_rows`` drop
+    sentinel afterwards, so the map must merely not crash on them (pure
+    arithmetic maps are fine).
 
     Returns ``(table [out_rows, w], branch int32)`` — branch indexes the
     taken rung (ascending caps order) or ``len(caps)`` for dense.
@@ -286,13 +288,43 @@ def record_row_gather_exchange(
     """The packed MS engines' complete exchange accounting step: merge the
     per-branch level counts into the chunked-traversal chain, then price
     them with the row-gather byte model (dense impls have the single slab
-    entry). Returns (counts, bytes) for the engine to store."""
+    entry). Returns (counts, bytes) for the engine to store.
+
+    Known modeling gap: an engine whose cap-boundary truncation probe
+    itself gathers a frontier (the distributed hybrid's claim-free
+    ``deeper`` check) moves one extra uncounted gather on truncated runs —
+    at most once per traversal, only when the plane cap was hit."""
     counts = merge_exchange_counts(prev, branch_counts, resumed_level)
     if exchange == "sparse":
         per = sparse_rows_wire_bytes_per_level(p, rows_loc, w, caps)
     else:
         per = [0.0 if p == 1 else float((p - 1) * rows_loc * 4 * w)]
     return counts, float(np.dot(counts, per))
+
+
+class RowGatherExchangeAccounting:
+    """Mixin for the distributed packed MS engines: the per-branch counter
+    bookkeeping shared by both (record + the checkpoint-resume core
+    wrapper). Hosts set ``_exchange``, ``sparse_caps``, ``w``,
+    ``_gather_p``, ``_gather_rows_loc``, ``_core_from_jit``, and the two
+    ``last_exchange_*`` attributes."""
+
+    def _record_exchange(self, branch_counts, resumed_level: int) -> None:
+        self.last_exchange_level_counts, self.last_exchange_bytes = (
+            record_row_gather_exchange(
+                self.last_exchange_level_counts, branch_counts, resumed_level,
+                exchange=self._exchange, p=self._gather_p,
+                rows_loc=self._gather_rows_loc, w=self.w,
+                caps=self.sparse_caps,
+            )
+        )
+
+    def _core_from(self, arrs, fw, vis, planes, level0, max_levels):
+        fw_f, vis_f, planes_f, level, alive, bc = self._core_from_jit(
+            arrs, fw, vis, planes, level0, max_levels
+        )
+        self._record_exchange(bc, int(level0))
+        return fw_f, vis_f, planes_f, level, alive
 
 
 def sparse_wire_bytes_per_level(

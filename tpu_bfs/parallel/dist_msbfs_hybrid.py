@@ -62,8 +62,8 @@ from tpu_bfs.algorithms._packed_common import (
 from tpu_bfs.algorithms.msbfs_hybrid import fill_a_tiles, select_dense_tiles
 from tpu_bfs.ops.tile_spmm import AW, TILE, tile_spmm
 from tpu_bfs.parallel.collectives import (
+    RowGatherExchangeAccounting,
     default_row_gather_caps,
-    record_row_gather_exchange,
     sparse_rows_gather,
 )
 from tpu_bfs.parallel.dist_bfs import make_mesh
@@ -478,7 +478,7 @@ def _make_dist_core(
     return build
 
 
-class DistHybridMsBfsEngine:
+class DistHybridMsBfsEngine(RowGatherExchangeAccounting):
     """Multi-chip 4096-lane hybrid MS-BFS: dense MXU tiles + gather residual.
 
     API mirrors HybridMsBfsEngine; frontier/visited/planes are all sharded
@@ -545,10 +545,9 @@ class DistHybridMsBfsEngine:
             sparse_caps = (sparse_caps,)
         self._exchange = exchange
         self.sparse_caps = tuple(sorted(sparse_caps))
-        self._rows_loc = rows_loc
-        #: per-branch level counts / modeled off-chip bytes of the last
-        #: traversal (ascending sparse rungs then dense; dense impl has the
-        #: single entry) — see _record_exchange.
+        # RowGatherExchangeAccounting host attributes (see collectives.py).
+        self._gather_p = hd["num_shards"]
+        self._gather_rows_loc = rows_loc
         self.last_exchange_level_counts: np.ndarray | None = None
         self.last_exchange_bytes: float | None = None
         build = _make_dist_core(
@@ -604,28 +603,12 @@ class DistHybridMsBfsEngine:
         tau = self.hd["tau_of_vertex"][np.asarray(sources, np.int64)]
         return self._seed_k(*seed_scatter_args(tau, self._act))
 
-    def _record_exchange(self, branch_counts, resumed_level: int) -> None:
-        self.last_exchange_level_counts, self.last_exchange_bytes = (
-            record_row_gather_exchange(
-                self.last_exchange_level_counts, branch_counts, resumed_level,
-                exchange=self._exchange, p=self.hd["num_shards"],
-                rows_loc=self._rows_loc, w=self.w, caps=self.sparse_caps,
-            )
-        )
-
     def _core(self, arrs, fw0, max_levels):
         planes, vis, levels, alive, truncated, bc = self._dist_core(
             arrs, fw0, max_levels
         )
         self._record_exchange(bc, 0)
         return planes, vis, levels, alive, truncated
-
-    def _core_from(self, arrs, fw, vis, planes, level0, max_levels):
-        fw_f, vis_f, planes_f, level, alive, bc = self._core_from_jit(
-            arrs, fw, vis, planes, level0, max_levels
-        )
-        self._record_exchange(bc, int(level0))
-        return fw_f, vis_f, planes_f, level, alive
 
     def run(self, sources, *, max_levels=None, time_it=False, check_cap=True):
         return run_packed_batch(

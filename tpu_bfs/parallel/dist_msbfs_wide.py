@@ -43,8 +43,8 @@ from tpu_bfs.algorithms._packed_common import (
     run_packed_batch,
 )
 from tpu_bfs.parallel.collectives import (
+    RowGatherExchangeAccounting,
     default_row_gather_caps,
-    record_row_gather_exchange,
     sparse_rows_gather,
 )
 from tpu_bfs.parallel.dist_bfs import make_mesh
@@ -215,7 +215,7 @@ def _make_dist_core(
     return build
 
 
-class DistWideMsBfsEngine:
+class DistWideMsBfsEngine(RowGatherExchangeAccounting):
     """Multi-chip 4096-lane packed MS-BFS: sharded ELL, replicated frontier.
 
     Per-chip HBM is O(V * W/8 * num_planes) for the packed state plus the
@@ -293,9 +293,9 @@ class DistWideMsBfsEngine:
             sparse_caps = (sparse_caps,)
         self._exchange = exchange
         self.sparse_caps = tuple(sorted(sparse_caps))
-        #: per-branch level counts of the last traversal (ascending sparse
-        #: rungs then dense fallback; the dense impl has a single entry)
-        #: and the modeled off-chip bytes one chip moved — _record_exchange.
+        # RowGatherExchangeAccounting host attributes (see collectives.py).
+        self._gather_p = sell.num_shards
+        self._gather_rows_loc = sell.v_loc
         self.last_exchange_level_counts: np.ndarray | None = None
         self.last_exchange_bytes: float | None = None
         build = _make_dist_core(
@@ -370,15 +370,6 @@ class DistWideMsBfsEngine:
             .reshape(sell.v_pad, self.w)
         )
 
-    def _record_exchange(self, branch_counts, resumed_level: int) -> None:
-        self.last_exchange_level_counts, self.last_exchange_bytes = (
-            record_row_gather_exchange(
-                self.last_exchange_level_counts, branch_counts, resumed_level,
-                exchange=self._exchange, p=self.sell.num_shards,
-                rows_loc=self.sell.v_loc, w=self.w, caps=self.sparse_caps,
-            )
-        )
-
     def _core(self, arrs, fw0, max_levels):
         planes, vis, levels, alive, truncated, bc = self._dist_core(
             arrs, fw0, max_levels
@@ -388,13 +379,6 @@ class DistWideMsBfsEngine:
         planes = tuple(pl.reshape(self.sell.v_pad, self.w) for pl in planes)
         vis = vis.reshape(self.sell.v_pad, self.w)
         return planes, vis, levels, alive, truncated
-
-    def _core_from(self, arrs, fw, vis, planes, level0, max_levels):
-        fw_f, vis_f, planes_f, level, alive, bc = self._core_from_jit(
-            arrs, fw, vis, planes, level0, max_levels
-        )
-        self._record_exchange(bc, int(level0))
-        return fw_f, vis_f, planes_f, level, alive
 
     def run(self, sources, *, max_levels=None, time_it=False, check_cap=True):
         return run_packed_batch(

@@ -63,6 +63,7 @@ def run_graph500(
     devices: int = 1,
     mesh2d: tuple[int, int] | None = None,
     backend: str = "scan",
+    exchange: str | None = None,
 ) -> Graph500Result:
     """Generate, run, validate, and score a Graph500-style BFS benchmark.
 
@@ -101,7 +102,10 @@ def run_graph500(
                 )
             from tpu_bfs.parallel.dist_msbfs_hybrid import DistHybridMsBfsEngine
 
-            eng = DistHybridMsBfsEngine(g, devices, num_planes=num_planes)
+            eng = DistHybridMsBfsEngine(
+                g, devices, num_planes=num_planes,
+                exchange=exchange or "dense",
+            )
         else:
             from tpu_bfs.algorithms.msbfs_hybrid import HybridMsBfsEngine
 
@@ -131,12 +135,16 @@ def run_graph500(
             from tpu_bfs.parallel.dist_bfs2d import Dist2DBfsEngine, make_mesh_2d
 
             eng = Dist2DBfsEngine(
-                g, make_mesh_2d(*mesh2d), backend=backend
+                g, make_mesh_2d(*mesh2d), backend=backend,
+                **({"exchange": exchange} if exchange else {}),
             )
         elif devices > 1:
             from tpu_bfs.parallel.dist_bfs import DistBfsEngine, make_mesh
 
-            eng = DistBfsEngine(g, make_mesh(devices), backend=backend)
+            eng = DistBfsEngine(
+                g, make_mesh(devices), backend=backend,
+                **({"exchange": exchange} if exchange else {}),
+            )
         else:
             eng = BfsEngine(g, backend=backend)
         dists = []
@@ -196,6 +204,10 @@ def main(argv=None) -> int:
     ap.add_argument("--backend", default="scan",
                     choices=["scan", "segment", "scatter", "dopt"],
                     help="single mode: frontier-expansion backend")
+    ap.add_argument("--exchange", default=None,
+                    choices=["ring", "allreduce", "sparse", "dense"],
+                    help="distributed frontier exchange (single mode: "
+                    "ring/allreduce/sparse; hybrid mode: dense/sparse)")
     args = ap.parse_args(argv)
     mesh2d = None
     if args.mesh:
@@ -217,6 +229,7 @@ def main(argv=None) -> int:
         devices=args.devices,
         mesh2d=mesh2d,
         backend=args.backend,
+        exchange=args.exchange,
     )
     print(
         f"graph500 scale={res.scale} ef={res.edge_factor} mode={res.mode} "

@@ -330,6 +330,7 @@ class PackedBatchResult:
     # trivially {source}); None when the engine's tables cover all vertices.
     _iso: np.ndarray | None = None
     _word_cache: dict = dataclasses.field(default_factory=dict)
+    _parent_cache: dict = dataclasses.field(default_factory=dict)
 
     @property
     def teps(self) -> float | None:
@@ -370,6 +371,44 @@ class PackedBatchResult:
     def distances_int32(self, i: int) -> np.ndarray:
         d8 = self.distance_u8_lane(i)
         return np.where(d8 == UNREACHED, INF_DIST, d8.astype(np.int32))
+
+    def parents_int32(self, i: int) -> np.ndarray:
+        """BFS tree of batch entry i: [V] int32 parents (source maps to
+        itself, unreached to NO_PARENT).
+
+        The packed level loop labels distances only (bit-sliced planes);
+        the tree is extracted post-loop as one O(E) scatter-min per
+        REQUESTED lane — lazy and cached like distance_u8_lane, so
+        querying a few lanes never pays for the whole batch. The result
+        is the deterministic min-parent tree (the same definition every
+        single-source engine emits, validate.min_parent_from_dist),
+        replacing the reference's nondeterministic atomic-race parent
+        which it could never validate (bfs.cu:146-147, 940)."""
+        if not (0 <= i < len(self.sources)):
+            raise IndexError(i)
+        if i not in self._parent_cache:
+            self._parent_cache[i] = min_parents_lane(
+                getattr(self._engine, "host_graph", None),
+                int(self.sources[i]),
+                self.distances_int32(i),
+            )
+        return self._parent_cache[i]
+
+
+def min_parents_lane(graph, source: int, dist: np.ndarray) -> np.ndarray:
+    """One lane's deterministic min-parent tree from its distances — the
+    shared core of PackedBatchResult.parents_int32 and
+    PackedBfsResult.parents_int32 (msbfs_packed.py). ``graph`` is the
+    engine's ``host_graph``; None means the engine was built from a
+    prebuilt ELL/sharded graph that no longer has the edge list."""
+    if graph is None:
+        raise ValueError(
+            "parent extraction needs the edge list: construct the engine "
+            "from a Graph (a prebuilt ELL/sharded graph does not retain it)"
+        )
+    from tpu_bfs import validate
+
+    return validate.min_parent_from_dist(graph, source, dist)
 
 
 def _check_batch_sources(engine, sources) -> np.ndarray:

@@ -340,6 +340,9 @@ class HybridMsBfsEngine:
             if isinstance(graph, Graph)
             else graph
         )
+        # Host-side edge list for post-loop parent extraction
+        # (PackedBatchResult.parents_int32); a prebuilt HybridGraph dropped it.
+        self.host_graph = graph if isinstance(graph, Graph) else None
         hg = self.hg
         res_slots = (
             hg.res_virtual.idx.size if hg.res_virtual is not None else 0

@@ -50,6 +50,9 @@ class PackedBfsResult:
     reached: np.ndarray  # [S] int64
     edges_traversed: np.ndarray  # [S] int64 (Graph500 TEPS numerator per source)
     elapsed_s: float | None = None  # wall time for the whole batch
+    # Host edge list for parents_int32; None when built from a prebuilt ELL.
+    _graph: object = None
+    _parent_cache: dict = dataclasses.field(default_factory=dict)
 
     @property
     def teps(self) -> float | None:
@@ -65,6 +68,23 @@ class PackedBfsResult:
         """Distance row for batch entry s, INF_DIST where unreached."""
         d = self.distance_u8[s].astype(np.int32)
         return np.where(self.distance_u8[s] == UNREACHED, INF_DIST, d)
+
+    def parents_int32(self, s: int) -> np.ndarray:
+        """BFS tree of batch entry s: [V] int32 deterministic min-parents
+        (source maps to itself, unreached to NO_PARENT). One O(E)
+        scatter-min per requested lane, cached — see
+        PackedBatchResult.parents_int32 (_packed_common.py) for the
+        protocol rationale vs the reference's unvalidatable atomic-race
+        parent (bfs.cu:146-147, 940)."""
+        if not (0 <= s < len(self.sources)):
+            raise IndexError(s)
+        if s not in self._parent_cache:
+            from tpu_bfs.algorithms._packed_common import min_parents_lane
+
+            self._parent_cache[s] = min_parents_lane(
+                self._graph, int(self.sources[s]), self.distances_int32(s)
+            )
+        return self._parent_cache[s]
 
 
 def make_packed_expand(
@@ -204,6 +224,9 @@ class PackedMsBfsEngine:
             self.ell = build_ell(graph, kcap=kcap)
         else:
             self.ell = graph
+        # Host-side edge list for post-loop parent extraction
+        # (PackedBfsResult.parents_int32); a prebuilt ELL has dropped it.
+        self.host_graph = graph if isinstance(graph, Graph) else None
         self.undirected = self.ell.undirected if undirected is None else undirected
         ell = self.ell
         arrs = {}
@@ -286,4 +309,5 @@ class PackedMsBfsEngine:
             reached=reached,
             edges_traversed=edges.astype(np.int64),
             elapsed_s=elapsed,
+            _graph=self.host_graph,
         )

@@ -107,6 +107,9 @@ class WidePackedMsBfsEngine:
         # distances up to 2**p; 254 keeps every distance below UNREACHED=255.
         self.max_levels_cap = min(1 << num_planes, 254)
         self.ell = build_ell(graph, kcap=kcap) if isinstance(graph, Graph) else graph
+        # Host-side edge list for post-loop parent extraction
+        # (PackedBatchResult.parents_int32); a prebuilt ELL has dropped it.
+        self.host_graph = graph if isinstance(graph, Graph) else None
         self._act = self.ell.num_active
         if lanes == "auto":
             # Halve from 4096 until the packed state fits HBM next to the ELL.

@@ -193,10 +193,28 @@ def _run_multi_source(args, g, golden) -> int:
             print(line)
     if golden is not None:
         validate.check_distances(res.distances_int32(0), golden)
+        # Also validate the engine-emitted BFS tree for the primary lane —
+        # the check the reference could never run on its parent output
+        # (bfs.cu:940; its checkOutput compares distances only).
+        validate.check_parents(
+            g, int(sources[0]), res.distances_int32(0), res.parents_int32(0)
+        )
         print("Output OK")
     if args.save_dist:
         np.save(args.save_dist, np.stack([
             res.distances_int32(i) for i in range(len(sources))
+        ]))
+    if args.save_parent:
+        # One O(E) scatter-min per lane, bypassing the result's per-lane
+        # cache so peak host memory is the one stacked [S, V] copy rather
+        # than two (cache + stack) on large batches.
+        from tpu_bfs.algorithms._packed_common import min_parents_lane
+
+        np.save(args.save_parent, np.stack([
+            min_parents_lane(
+                engine.host_graph, int(sources[i]), res.distances_int32(i)
+            )
+            for i in range(len(sources))
         ]))
     return 0
 
@@ -279,9 +297,6 @@ def main(argv=None) -> int:
                  "resumable state)")
     if (args.ckpt or args.resume) and (args.repeat > 1 or args.profile_dir):
         ap.error("--repeat/--profile-dir do not apply to checkpointed runs")
-    if args.multi_source and args.save_parent:
-        ap.error("--multi-source computes distances only; --save-parent is "
-                 "unavailable (use single-source mode for the parent tree)")
 
     import numpy as np
 

@@ -528,6 +528,9 @@ class DistHybridMsBfsEngine(RowGatherExchangeAccounting):
                 f"built for {hd['num_shards']} shards, mesh has {p_count}"
             )
         self.hd = hd
+        # Host-side edge list for post-loop parent extraction
+        # (PackedBatchResult.parents_int32); a prebuilt shard dict dropped it.
+        self.host_graph = graph if isinstance(graph, Graph) else None
         self.undirected = hd["undirected"]
         rows = hd["rows"]
 

@@ -258,6 +258,9 @@ class DistWideMsBfsEngine(RowGatherExchangeAccounting):
                 f"ELL built for {self.sell.num_shards} shards, mesh has {p_count}"
             )
         sell = self.sell
+        # Host-side edge list for post-loop parent extraction
+        # (PackedBatchResult.parents_int32); a prebuilt shard set dropped it.
+        self.host_graph = graph if isinstance(graph, Graph) else None
         self.undirected = sell.undirected
         # Isolated-source convention (cross-engine checkpoints): real-id
         # checkpoints store no bits for sources that appear in NO edge (the

@@ -90,7 +90,51 @@ def test_cli_rejects_bad_source():
         cli.main(["999", "random:n=100,m=300,seed=1"])
 
 
-def test_cli_rejects_multi_source_multichip():
+def test_cli_multi_source_distributed(capsys, tmp_path):
+    # One binary reaches the distributed MS engines (the reference reaches
+    # every capability from its single binary, README.md:13,22).
+    out = tmp_path / "p.npy"
+    for engine, exchange in (("hybrid", "ring"), ("wide", "sparse")):
+        rc = cli.main(
+            ["0", "random:n=200,m=900,seed=3", "--devices", "4",
+             "--multi-source", "7,19", "--engine", engine,
+             "--exchange", exchange, "--save-parent", str(out)]
+        )
+        assert rc == 0
+        assert "Output OK" in capsys.readouterr().out
+        assert np.load(out).shape == (3, 200)
+
+
+def test_cli_multi_source_distributed_ckpt(capsys, tmp_path):
+    ck = tmp_path / "ck.npz"
+    rc = cli.main(
+        ["0", "random:n=200,m=900,seed=3", "--devices", "2",
+         "--multi-source", "7", "--ckpt", str(ck), "--ckpt-every", "1"]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "checkpoint @ level" in out and "Output OK" in out
+    rc = cli.main(
+        ["0", "random:n=200,m=900,seed=3", "--devices", "2",
+         "--multi-source", "7", "--resume", str(ck)]
+    )
+    assert rc == 0
+    assert "Output OK" in capsys.readouterr().out
+
+
+def test_cli_rejects_multi_source_2d_mesh():
+    with pytest.raises(SystemExit):
+        cli.main(["0", "random:n=100,m=300,seed=1", "--mesh", "2x2",
+                  "--multi-source", "1"])
+
+
+def test_cli_rejects_packed_engine_multichip():
     with pytest.raises(SystemExit):
         cli.main(["0", "random:n=100,m=300,seed=1", "--devices", "2",
-                  "--multi-source", "1"])
+                  "--multi-source", "1", "--engine", "packed"])
+
+
+def test_cli_rejects_allreduce_multi_source_multichip():
+    with pytest.raises(SystemExit):
+        cli.main(["0", "random:n=100,m=300,seed=1", "--devices", "2",
+                  "--multi-source", "1", "--exchange", "allreduce"])

@@ -71,8 +71,44 @@ def _make_ms_engine(args, g, n_sources: int):
     Default (no --engine): size to the workload — the 512-lane packed engine
     for small batches (lane tables scale with lane count; 254-level depth
     cap), the 4096-lane hybrid flagship once the batch is big enough to fill
-    its 128-word rows."""
+    its 128-word rows. With --devices N the sharded-state distributed
+    engines run instead (hybrid flagship by default, '--engine wide' for
+    gather-only) — the reference reaches every capability from its one
+    binary (README.md:13,22); so does this one.
+    """
     engine = args.engine
+    planes = args.planes if args.planes is not None else 5
+    if args.devices > 1:
+        if engine == "packed":
+            raise SystemExit(
+                "--engine packed is single-device; use --engine hybrid or "
+                "wide with --devices"
+            )
+        # The distributed MS engines exchange frontier words by ring
+        # collectives: 'dense' (always-full bitmap) or 'sparse' (two-phase
+        # queue-style). The single-source-only exchanges map: ring (the
+        # default) -> dense; allreduce has no packed analog.
+        if args.exchange == "allreduce":
+            raise SystemExit(
+                "--exchange allreduce applies to single-source --devices "
+                "runs; the packed engines exchange 'ring' (dense) or "
+                "'sparse'"
+            )
+        exchange = "sparse" if args.exchange == "sparse" else "dense"
+        from tpu_bfs.parallel.dist_bfs import make_mesh
+
+        mesh = make_mesh(args.devices)
+        if engine == "wide":
+            from tpu_bfs.parallel.dist_msbfs_wide import DistWideMsBfsEngine
+
+            return DistWideMsBfsEngine(
+                g, mesh, num_planes=planes, exchange=exchange
+            )
+        from tpu_bfs.parallel.dist_msbfs_hybrid import DistHybridMsBfsEngine
+
+        return DistHybridMsBfsEngine(
+            g, mesh, num_planes=planes, exchange=exchange
+        )
     if engine is None:
         engine = "packed" if n_sources <= 512 else "hybrid"
         if engine == "packed" and (args.ckpt or args.resume):
@@ -83,7 +119,6 @@ def _make_ms_engine(args, g, n_sources: int):
 
         lanes = max(32, -(-n_sources // 32) * 32)
         return PackedMsBfsEngine(g, lanes=lanes)
-    planes = args.planes if args.planes is not None else 5
     if engine == "wide":
         from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
 
@@ -176,9 +211,9 @@ def _run_multi_source(args, g, golden) -> int:
         except RuntimeError as exc:
             if "truncated" not in str(exc):
                 raise
+            alt = "" if args.devices > 1 else " or --engine packed"
             raise SystemExit(
-                f"{exc}\nhint: rerun with --planes 8 (depth 254) or "
-                "--engine packed"
+                f"{exc}\nhint: rerun with --planes 8 (depth 254){alt}"
             )
     if res.elapsed_s is not None:
         print(f"Elapsed time in milliseconds (device): "
@@ -193,29 +228,29 @@ def _run_multi_source(args, g, golden) -> int:
             print(line)
     if golden is not None:
         validate.check_distances(res.distances_int32(0), golden)
-        # Also validate the engine-emitted BFS tree for the primary lane —
-        # the check the reference could never run on its parent output
-        # (bfs.cu:940; its checkOutput compares distances only).
-        validate.check_parents(
-            g, int(sources[0]), res.distances_int32(0), res.parents_int32(0)
-        )
+        if not args.no_parents:
+            # Also validate the engine-emitted BFS tree for the primary
+            # lane — the check the reference could never run on its parent
+            # output (bfs.cu:940; checkOutput compares distances only).
+            validate.check_parents(
+                g, int(sources[0]), res.distances_int32(0),
+                res.parents_int32(0),
+            )
         print("Output OK")
     if args.save_dist:
         np.save(args.save_dist, np.stack([
             res.distances_int32(i) for i in range(len(sources))
         ]))
     if args.save_parent:
-        # One O(E) scatter-min per lane, bypassing the result's per-lane
-        # cache so peak host memory is the one stacked [S, V] copy rather
-        # than two (cache + stack) on large batches.
-        from tpu_bfs.algorithms._packed_common import min_parents_lane
-
-        np.save(args.save_parent, np.stack([
-            min_parents_lane(
-                engine.host_graph, int(sources[i]), res.distances_int32(i)
-            )
-            for i in range(len(sources))
-        ]))
+        # One O(E) scatter-min per lane (lane 0 reuses the validation
+        # pass's cached tree), filling a preallocated [S, V] array and
+        # dropping each lane from the result cache as it lands — peak host
+        # memory stays at the one output copy plus a single lane.
+        out = np.empty((len(sources), g.num_vertices), np.int32)
+        for i in range(len(sources)):
+            out[i] = res.parents_int32(i)
+            res._parent_cache.pop(i, None)
+        np.save(args.save_parent, out)
     return 0
 
 
@@ -245,7 +280,9 @@ def main(argv=None) -> int:
                     choices=["ring", "allreduce", "sparse"],
                     help="multi-device frontier exchange implementation "
                     "('sparse' = two-phase queue-style id exchange with "
-                    "dense-bitmap fallback; 1D --devices meshes)")
+                    "dense-bitmap fallback; 1D --devices meshes). With "
+                    "--multi-source, 'ring' maps to the packed engines' "
+                    "dense word exchange")
     ap.add_argument("--max-levels", type=int, default=None)
     ap.add_argument("--skip-cpu", action="store_true",
                     help="skip the CPU golden run + validation (reference always validates, bfs.cu:798-815)")
@@ -256,13 +293,16 @@ def main(argv=None) -> int:
     ap.add_argument("--save-parent", default=None, help="save parents to .npy")
     ap.add_argument("--multi-source", default=None, metavar="V1,V2,...",
                     help="run these sources concurrently with <source> via a "
-                    "bit-packed multi-source engine (single device)")
+                    "bit-packed multi-source engine; --devices N shards "
+                    "state over the mesh (DistHybrid/DistWide engines)")
     ap.add_argument("--engine", default=None,
                     choices=["hybrid", "wide", "packed"],
                     help="--multi-source engine: 'hybrid' = 4096-lane MXU "
                     "dense tiles + gathers (flagship), 'wide' = 4096-lane "
-                    "gather-only, 'packed' = 512-lane (254-level depth cap). "
-                    "Default: 'packed' for <=512 sources, else 'hybrid'")
+                    "gather-only, 'packed' = 512-lane (254-level depth cap; "
+                    "single-device). Default: 'packed' for <=512 sources, "
+                    "else 'hybrid'; with --devices N always the sharded "
+                    "hybrid unless 'wide' is chosen")
     ap.add_argument("--planes", type=int, default=None, metavar="P",
                     choices=range(1, 9),
                     help="bit-plane count for the wide/hybrid engines; caps "
@@ -285,12 +325,13 @@ def main(argv=None) -> int:
     if args.mesh and args.exchange == "sparse":
         ap.error("--exchange sparse pairs with 1D --devices meshes; the 2D "
                  "engine's row/column collectives already move O(vp/dim) bits")
-    if args.multi_source and (args.mesh or args.devices > 1):
-        ap.error("--multi-source is single-device only (for now)")
+    if args.multi_source and args.mesh:
+        ap.error("--multi-source shards 1D (row-tile round-robin); pass "
+                 "--devices N instead of a 2D mesh")
     if (args.ckpt or args.resume) and args.mesh:
         ap.error("--ckpt/--resume work with the single-source engines "
-                 "(1D --devices meshes included) and single-device "
-                 "--multi-source batches")
+                 "(1D --devices meshes included) and --multi-source "
+                 "batches (single-device or --devices N)")
     if (args.ckpt or args.resume) and args.multi_source and args.engine == "packed":
         ap.error("--ckpt/--resume with --multi-source needs the wide or "
                  "hybrid engine (the 512-lane packed engine keeps no "

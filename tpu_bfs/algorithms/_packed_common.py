@@ -7,11 +7,11 @@ seeding, device-side lane stats, lazy per-word distance extraction, the
 generic batch ``run``) lives here once.
 
 Engines plug in via a small protocol: attributes ``arrs``, ``lanes``,
-``max_levels_cap``, ``num_planes``, ``undirected``, ``_in_deg_ranked``,
-``_rank``, ``_warmed``, ``num_vertices``; jitted callables ``_core``
-(returning planes, vis, levels, alive, truncated), ``_seed_dev``,
-``_lane_stats``, ``_extract_word``; and the two lane-map hooks ``_word_col``
-/ ``_lane_order``.
+``max_levels_cap``, ``num_planes``, ``undirected``, ``_rank``, ``_warmed``,
+``num_vertices``; jitted callables ``_core`` (returning planes, vis, levels,
+alive, truncated), ``_seed_dev``, ``_lane_stats`` (degree data captured at
+build, make_state_kernels), ``_extract_word``; and the two lane-map hooks
+``_word_col`` / ``_lane_order``.
 """
 
 from __future__ import annotations
@@ -247,16 +247,55 @@ def seed_scatter_args(rows_of_sources: np.ndarray, act: int):
     )
 
 
+def degree_sum_blocks(
+    in_deg_host: np.ndarray, act: int, *, cap: int = 1 << 30
+) -> tuple:
+    """Static row-block boundaries for exact int32 degree summation.
+
+    Greedy split of rows [0, act) so each block's total degree stays under
+    ``cap`` (< 2**31): a per-block int32 sum of (visited_bit * degree) can
+    then never overflow, making the TEPS numerator exact at any scale —
+    the block partials are summed in int64 on host. A single vertex's
+    degree is < V < 2**31, so a one-row block is always safe."""
+    deg = np.asarray(in_deg_host[:act], dtype=np.int64)
+    csum = np.cumsum(deg)  # one O(act) pass; blocks then binary-search it
+    blocks = []
+    s = 0
+    while s < act:
+        base = csum[s - 1] if s else 0
+        e = int(np.searchsorted(csum, base + cap, side="left"))
+        e = min(max(e, s + 1), act)  # at least one row per block
+        blocks.append((s, e))
+        s = e
+    return tuple(blocks) if blocks else ((0, 0),)
+
+
 def make_state_kernels(
-    v: int, rows: int, w: int, num_planes: int, *, active: int | None = None
+    v: int,
+    rows: int,
+    w: int,
+    num_planes: int,
+    *,
+    active: int | None = None,
+    in_deg_host: np.ndarray | None = None,
 ):
     """Jitted (seed, lane_stats, extract_word) over a [rows, w] packed table
     whose first ``act`` rows are real vertices (in rank order).
 
     ``active`` (default: v) is the number of real rows when the table is
     trimmed to non-isolated vertices; stats and extraction scan only those.
+    ``in_deg_host`` (table row order, length >= act) is captured by
+    lane_stats — it both sizes the static degree-sum blocks and provides
+    the summed values, so the overflow-safety analysis and the data can
+    never diverge. Required for lane_stats; seed/extract_word work
+    without it.
     """
     act = v if active is None else min(active, v)
+    if in_deg_host is not None:
+        blocks = degree_sum_blocks(in_deg_host, act)
+        in_deg = jnp.asarray(np.asarray(in_deg_host, dtype=np.int32))
+    else:
+        blocks, in_deg = ((0, act),), None
 
     @jax.jit
     def seed(rws, words, bits):
@@ -265,12 +304,18 @@ def make_state_kernels(
         return fw0.at[rws, words].add(bits)
 
     @jax.jit
-    def lane_stats(vis, in_deg):
+    def lane_stats(vis):
         """Per-word-column reached count and degree sum, on device.
 
-        Returns (reached [w,32] i32 exact, deg_sum [w,32] f32 — f32 because
-        TPU has no int64 and the per-lane degree sum can exceed int32 at
-        Graph500 scale; pairwise summation keeps ~7 digits)."""
+        Returns (reached [w,32] i32, deg_sum [w, nblocks, 32] i32) — both
+        EXACT: TPU has no int64, so the degree sum accumulates per static
+        row-block (each bounded under 2**31 by degree_sum_blocks) and the
+        caller reduces the block axis in int64 on host. Replaces the old
+        f32 pairwise sum whose ~7 digits went inexact past ~10^7 edges
+        per lane. The degree array is the captured ``in_deg_host`` — the
+        same array the blocks were sized from, by construction."""
+        if in_deg is None:
+            raise ValueError("make_state_kernels needs in_deg_host for lane_stats")
         shifts = jnp.arange(32, dtype=jnp.uint32)
 
         def wbody(wi, acc):
@@ -278,14 +323,19 @@ def make_state_kernels(
             col = jax.lax.dynamic_slice(vis, (0, wi), (rows, 1))[:act]  # [act,1]
             bits = (col >> shifts) & 1  # [act, 32] u32
             rr = jnp.sum(bits.astype(jnp.int32), axis=0)
-            dd = jnp.sum(bits.astype(jnp.float32) * in_deg[:act, None], axis=0)
+            dd = jnp.stack([
+                jnp.sum(
+                    bits[s:e].astype(jnp.int32) * in_deg[s:e, None], axis=0
+                )
+                for s, e in blocks
+            ])  # [nblocks, 32] i32, each block exact
             return (
                 jax.lax.dynamic_update_slice(r_acc, rr[None], (wi, 0)),
-                jax.lax.dynamic_update_slice(d_acc, dd[None], (wi, 0)),
+                jax.lax.dynamic_update_slice(d_acc, dd[None], (wi, 0, 0)),
             )
 
         r0 = jnp.zeros((w, 32), jnp.int32)
-        d0 = jnp.zeros((w, 32), jnp.float32)
+        d0 = jnp.zeros((w, len(blocks), 32), jnp.int32)
         return jax.lax.fori_loop(0, w, wbody, (r0, d0))
 
     @jax.jit
@@ -320,7 +370,7 @@ class PackedBatchResult:
     sources: np.ndarray  # [S] int32
     num_levels: int  # max distance over all lanes
     reached: np.ndarray  # [S] int64
-    edges_traversed: np.ndarray  # [S] int64 (~7-digit exact at huge scale)
+    edges_traversed: np.ndarray  # [S] int64, exact (block-summed on device)
     elapsed_s: float | None
     _engine: object
     _planes: tuple
@@ -393,6 +443,29 @@ class PackedBatchResult:
                 self.distances_int32(i),
             )
         return self._parent_cache[i]
+
+    def parents_into(self, out: np.ndarray) -> np.ndarray:
+        """Fill ``out[i]`` with every lane's parent tree, evicting the
+        per-lane parent cache and each 32-lane distance word column once
+        its lanes are done — the bulk-export path (CLI --save-parent)
+        whose peak host memory is ``out`` plus one word column, not a
+        second cached [S, V] copy."""
+        n = len(self.sources)
+        if out.shape != (n, self._engine.num_vertices):
+            raise ValueError(
+                f"out is {out.shape}, need ({n}, {self._engine.num_vertices})"
+            )
+        prev_word = None
+        for i in range(n):
+            out[i] = self.parents_int32(i)
+            self._parent_cache.pop(i, None)
+            wi = self._engine._word_col(i)[0]
+            if prev_word is not None and wi != prev_word:
+                self._word_cache.pop(prev_word, None)
+            prev_word = wi
+        if prev_word is not None:
+            self._word_cache.pop(prev_word, None)
+        return out
 
 
 def min_parents_lane(graph, source: int, dist: np.ndarray) -> np.ndarray:
@@ -551,10 +624,12 @@ def _assemble_packed_result(
     device-side lane stats, isolated-lane patching, sentinel-row src-bits
     view, and the final-empty-frontier level adjustment."""
     s = len(sources)
-    r, d = engine._lane_stats(vis, engine._in_deg_ranked)
+    r, d = engine._lane_stats(vis)
     reached = engine._lane_order(np.asarray(r))[:s].astype(np.int64)
-    slot_sum = engine._lane_order(np.asarray(d, dtype=np.float64))[:s]
-    edges = (slot_sum / 2 if engine.undirected else slot_sum).astype(np.int64)
+    # d is [w, nblocks, 32] int32 block partials; the int64 block reduction
+    # happens here on host, so edges_traversed is exact at any scale.
+    slot_sum = engine._lane_order(np.asarray(d).astype(np.int64).sum(axis=1))[:s]
+    edges = slot_sum // 2 if engine.undirected else slot_sum
 
     # Lanes seeded at isolated sources have no device row: the table scan
     # sees nothing, but the source itself is trivially reached.

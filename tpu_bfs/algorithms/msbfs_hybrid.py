@@ -395,14 +395,12 @@ class HybridMsBfsEngine:
         self._act = hg.num_active
         self._table_rows = hg.vt * TILE
         self._core, self._core_from = _make_core(hg, self.w, num_planes, interpret)
+        in_deg_ranked = hg.in_degree[hg.old_of_new].astype(np.int32)
         self._seed, self._lane_stats, self._extract_word = make_state_kernels(
             hg.num_vertices, hg.vt * TILE, self.w, num_planes,
-            active=self._act,
+            active=self._act, in_deg_host=in_deg_ranked,
         )
         self._rank = hg.rank
-        self._in_deg_ranked = jnp.asarray(
-            hg.in_degree[hg.old_of_new].astype(np.float32)
-        )
         self._warmed = False
 
     @property

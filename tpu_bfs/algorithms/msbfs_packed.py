@@ -86,6 +86,20 @@ class PackedBfsResult:
             )
         return self._parent_cache[s]
 
+    def parents_into(self, out: np.ndarray) -> np.ndarray:
+        """Fill ``out[s]`` with every lane's parent tree, evicting the
+        per-lane cache as it goes (bulk-export path; distances here are
+        already materialized so there is no word cache to manage)."""
+        n = len(self.sources)
+        if out.shape != (n, self.distance_u8.shape[1]):
+            raise ValueError(
+                f"out is {out.shape}, need ({n}, {self.distance_u8.shape[1]})"
+            )
+        for s in range(n):
+            out[s] = self.parents_int32(s)
+            self._parent_cache.pop(s, None)
+        return out
+
 
 def make_packed_expand(
     *, w: int, kcap: int, fold_steps: int, num_virtual: int,

@@ -129,14 +129,12 @@ class WidePackedMsBfsEngine:
         self.arrs = expand_arrays(ell)
         self._table_rows = self._act + 1  # + the all-zero sentinel row
         self._core, self._core_from = _make_core(ell, self.w, num_planes)
+        in_deg_ranked = ell.in_degree[ell.old_of_new].astype(np.int32)
         self._seed, self._lane_stats, self._extract_word = make_state_kernels(
             ell.num_vertices, self._act + 1, self.w, num_planes,
-            active=self._act,
+            active=self._act, in_deg_host=in_deg_ranked,
         )
         self._rank = ell.rank
-        self._in_deg_ranked = jnp.asarray(
-            ell.in_degree[ell.old_of_new].astype(np.float32)
-        )
         self._warmed = False
 
     @property

@@ -242,15 +242,11 @@ def _run_multi_source(args, g, golden) -> int:
             res.distances_int32(i) for i in range(len(sources))
         ]))
     if args.save_parent:
-        # One O(E) scatter-min per lane (lane 0 reuses the validation
-        # pass's cached tree), filling a preallocated [S, V] array and
-        # dropping each lane from the result cache as it lands — peak host
-        # memory stays at the one output copy plus a single lane.
+        # Bulk export: one O(E) scatter-min per lane (lane 0 reuses the
+        # validation pass's cached tree), cache-evicting as it fills so
+        # peak host memory stays near the one output array.
         out = np.empty((len(sources), g.num_vertices), np.int32)
-        for i in range(len(sources)):
-            out[i] = res.parents_int32(i)
-            res._parent_cache.pop(i, None)
-        np.save(args.save_parent, out)
+        np.save(args.save_parent, res.parents_into(out))
     return 0
 
 

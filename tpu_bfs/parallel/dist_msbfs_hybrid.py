@@ -564,14 +564,13 @@ class DistHybridMsBfsEngine(RowGatherExchangeAccounting):
         # isolated vertices map to `rows` and are masked host-side (_act).
         self._rank = hd["tau_of_vertex"]
         self._act = rows
-        in_deg_tau = np.zeros(rows, dtype=np.float32)
+        in_deg_tau = np.zeros(rows, dtype=np.int32)
         valid_v = hd["tau_of_vertex"] < rows
         in_deg_tau[hd["tau_of_vertex"][valid_v]] = hd["in_degree"][
             valid_v
-        ].astype(np.float32)
-        self._in_deg_ranked = jnp.asarray(in_deg_tau)
+        ].astype(np.int32)
         _, self._lane_stats, self._extract_word = make_state_kernels(
-            rows, rows, self.w, num_planes
+            rows, rows, self.w, num_planes, in_deg_host=in_deg_tau
         )
         sharded = NamedSharding(self.mesh, P("v"))
         w_ = self.w

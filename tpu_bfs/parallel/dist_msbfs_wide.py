@@ -315,13 +315,12 @@ class DistWideMsBfsEngine(RowGatherExchangeAccounting):
         self._rank = ((ranks % p_count) * sell.v_loc + ranks // p_count).astype(
             np.int64
         )
-        in_deg_cm = np.zeros(sell.v_pad, dtype=np.float32)
-        in_deg_cm[self._rank] = sell.in_degree.astype(np.float32)
-        self._in_deg_ranked = jnp.asarray(in_deg_cm)
+        in_deg_cm = np.zeros(sell.v_pad, dtype=np.int32)
+        in_deg_cm[self._rank] = sell.in_degree.astype(np.int32)
         # Stats/extraction over the reassembled chip-major tables: every row
         # participates (pad rows are never visited, so they contribute zero).
         _, self._lane_stats, self._extract_word = make_state_kernels(
-            sell.v_pad, sell.v_pad, self.w, num_planes
+            sell.v_pad, sell.v_pad, self.w, num_planes, in_deg_host=in_deg_cm
         )
         # Seed table is one row taller (the ELL sentinel row at v_pad).
         rows_seed, w = sell.v_pad + 1, self.w

@@ -102,3 +102,89 @@ def test_dist2d_dopt_matches_dense_backend(rmat_small):
     dopt = Dist2DBfsEngine(rmat_small, make_mesh_2d(2, 2), backend="dopt").run(1)
     np.testing.assert_array_equal(dense.distance, dopt.distance)
     np.testing.assert_array_equal(dense.parent, dopt.parent)
+
+
+# --- checkpoint/resume + exchange accounting (1D-engine parity) ---
+
+
+def test_dist2d_checkpoint_resume_bit_identical(random_small):
+    eng = Dist2DBfsEngine(random_small, make_mesh_2d(2, 4), backend="dopt")
+    full = eng.run(42)
+    st = eng.start(42)
+    while not st.done:
+        st = eng.advance(st, levels=1)
+    res = eng.finish(st)
+    np.testing.assert_array_equal(res.distance, full.distance)
+    np.testing.assert_array_equal(res.parent, full.parent)
+    assert res.edges_traversed == full.edges_traversed
+
+
+def test_dist2d_exchange_accounting(random_small):
+    from tpu_bfs.parallel.collectives import dense_2d_wire_bytes
+
+    eng = Dist2DBfsEngine(random_small, make_mesh_2d(2, 4))
+    assert eng.last_exchange_bytes is None
+    res = eng.run(42)
+    counts = eng.last_exchange_level_counts
+    # One branch (no cap ladder); bodies = final level counter, which is
+    # num_levels + 1 when the loop discovers the empty frontier itself.
+    assert counts.shape == (1,) and counts[0] == res.num_levels + 1
+    per = dense_2d_wire_bytes(2, 4, eng.part.w, "ring")
+    assert eng.last_exchange_bytes == counts[0] * per > 0
+
+
+def test_dist2d_chunked_accounting_matches_uninterrupted(random_small):
+    eng = Dist2DBfsEngine(random_small, make_mesh_2d(2, 4))
+    eng.run(42)
+    full_counts = eng.last_exchange_level_counts.copy()
+    full_bytes = eng.last_exchange_bytes
+
+    eng2 = Dist2DBfsEngine(random_small, make_mesh_2d(2, 4))
+    st = eng2.start(42)
+    while not st.done:
+        st = eng2.advance(st, levels=2)
+    np.testing.assert_array_equal(eng2.last_exchange_level_counts, full_counts)
+    assert eng2.last_exchange_bytes == full_bytes
+
+
+def test_dist2d_cross_topology_resume(random_small):
+    # Checkpoints are real-id [V] arrays: a traversal started under the 1D
+    # vertex partition resumes under the 2D edge partition mid-flight —
+    # elastic restart across mesh topologies, which the reference's
+    # compile-time DeviceNum (bfs.cu:19) forecloses entirely.
+    from tpu_bfs.parallel.dist_bfs import DistBfsEngine, make_mesh
+
+    golden, _ = bfs_python(random_small, 42)
+    e1 = DistBfsEngine(random_small, make_mesh(4))
+    st = e1.advance(e1.start(42), levels=2)
+    e2 = Dist2DBfsEngine(random_small, make_mesh_2d(2, 4), backend="dopt")
+    res = e2.finish(e2.advance(st))
+    validate.check_distances(res.distance, golden)
+    validate.check_parents(random_small, 42, res.distance, res.parent)
+
+
+def test_dist2d_checkpoint_wrong_graph_rejected(random_small, toy_graph):
+    eng = Dist2DBfsEngine(random_small, make_mesh_2d(2, 2))
+    other = Dist2DBfsEngine(toy_graph, make_mesh_2d(2, 2))
+    st = other.start(0)
+    with pytest.raises(ValueError, match="vertices"):
+        eng.advance(st)
+
+
+def test_cli_2d_mesh_checkpoint_roundtrip(capsys, tmp_path):
+    from tpu_bfs import cli
+
+    ck = tmp_path / "ck2d.npz"
+    rc = cli.main(
+        ["42", "random:n=500,m=2000,seed=12345", "--mesh", "2x4",
+         "--backend", "dopt", "--ckpt", str(ck), "--ckpt-every", "2"]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "checkpointed at level" in out and "Output OK" in out
+    rc = cli.main(
+        ["42", "random:n=500,m=2000,seed=12345", "--mesh", "2x4",
+         "--backend", "dopt", "--resume", str(ck)]
+    )
+    assert rc == 0
+    assert "Output OK" in capsys.readouterr().out

@@ -324,10 +324,6 @@ def main(argv=None) -> int:
     if args.multi_source and args.mesh:
         ap.error("--multi-source shards 1D (row-tile round-robin); pass "
                  "--devices N instead of a 2D mesh")
-    if (args.ckpt or args.resume) and args.mesh:
-        ap.error("--ckpt/--resume work with the single-source engines "
-                 "(1D --devices meshes included) and --multi-source "
-                 "batches (single-device or --devices N)")
     if (args.ckpt or args.resume) and args.multi_source and args.engine == "packed":
         ap.error("--ckpt/--resume with --multi-source needs the wide or "
                  "hybrid engine (the 512-lane packed engine keeps no "

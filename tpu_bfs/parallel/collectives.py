@@ -101,6 +101,16 @@ def dense_or_wire_bytes(p: int, n: int, impl: str) -> float:
     return float(2 * (p - 1) * n * 4 if impl == "allreduce" else (p - 1) * n)
 
 
+def dense_2d_wire_bytes(rows: int, cols: int, w: int, impl: str) -> float:
+    """Off-chip bytes one chip moves per level in the 2D engine's level
+    loop: the column all-gather over the 'r' axis (ring: each chip sends
+    its [w] bool slice rows-1 times) plus the row reduce-scatter over 'c'
+    (same shapes as the 1D dense exchange, dense_or_wire_bytes). Modeled,
+    like every wire-byte figure here."""
+    ag = float((rows - 1) * w) if rows > 1 else 0.0
+    return ag + dense_or_wire_bytes(cols, w, impl)
+
+
 def default_sparse_caps(vloc: int) -> tuple[int, ...]:
     """Two-tier cap ladder: a tight cap for trickle levels (BFS start/tail,
     high-diameter graphs) and a wide one that still undercuts the bitmap's

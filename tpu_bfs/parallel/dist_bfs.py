@@ -206,7 +206,73 @@ def _dist_parents_fn(mesh: Mesh, p: int, vloc: int, exchange: str):
     )
 
 
-class DistBfsEngine:
+class VertexCheckpointMixin:
+    """Checkpoint/resume shared by the distributed single-source engines
+    (1D vertex partition and 2D edge partition; SURVEY.md §5: the
+    reference has none).
+
+    Checkpoints hold real-id [V] arrays, portable across engines, mesh
+    shapes AND partition topologies — a traversal checkpointed under the
+    1D partition resumes under the 2D edge partition mid-flight (elastic
+    restart; the reference's compile-time DeviceNum, bfs.cu:19, and fixed
+    2-rank world, bfs_mpi.cu:615, have no analog). Engines provide
+    ``part`` (to_padded/unshard/vp), ``_num_real_vertices``,
+    ``_vec_sharding``, ``_package``, and ``_advance_loop(f, vis, d,
+    level0, cap)`` — the engine-specific jitted loop invocation plus its
+    exchange accounting, returning (frontier, visited, dist, level)."""
+
+    def start(self, source: int):
+        """Level-0 traversal state as a host checkpoint (real vertex ids)."""
+        from tpu_bfs.utils.checkpoint import initial_checkpoint
+
+        return initial_checkpoint(self._num_real_vertices, source)
+
+    def _pad_state(self, ckpt):
+        """Real-id [V] checkpoint arrays -> padded-id [vp] arrays."""
+        part = self.part
+        if not hasattr(self, "_pids"):  # constant for the engine's lifetime
+            self._pids = part.to_padded(np.arange(self._num_real_vertices))
+        pids = self._pids
+        f = np.zeros(part.vp, dtype=bool)
+        f[pids] = ckpt.frontier
+        vis = np.zeros(part.vp, dtype=bool)
+        vis[pids] = ckpt.visited
+        d = np.full(part.vp, INF_DIST, dtype=np.int32)
+        d[pids] = ckpt.distance
+        return f, vis, d
+
+    def advance(self, ckpt, levels: int | None = None):
+        """Run at most ``levels`` more levels across the mesh from a checkpoint."""
+        from tpu_bfs.utils.checkpoint import BfsCheckpoint
+
+        part = self.part
+        if len(ckpt.frontier) != self._num_real_vertices:
+            raise ValueError(
+                f"checkpoint has {len(ckpt.frontier)} vertices, graph has "
+                f"{self._num_real_vertices}"
+            )
+        f0, vis0, d0 = self._pad_state(ckpt)
+        put = partial(jax.device_put, device=self._vec_sharding)
+        cap = ckpt.level + levels if levels is not None else part.vp
+        frontier, visited, dist, level = self._advance_loop(
+            put(f0), put(vis0), put(d0), ckpt.level, min(cap, part.vp)
+        )
+        return BfsCheckpoint(
+            source=ckpt.source,
+            level=int(level),
+            frontier=part.unshard(np.asarray(frontier)),
+            visited=part.unshard(np.asarray(visited)),
+            distance=part.unshard(np.asarray(dist)),
+        )
+
+    def finish(self, ckpt, *, with_parents: bool = True):
+        """Convert a (finished or partial) checkpoint into a BfsResult."""
+        _, _, d0 = self._pad_state(ckpt)
+        put = partial(jax.device_put, device=self._vec_sharding)
+        return self._package(put(d0), ckpt.source, with_parents, None)
+
+
+class DistBfsEngine(VertexCheckpointMixin):
     """Multi-chip BFS over a 1D vertex partition.
 
     Usage mirrors BfsEngine but scales over a mesh; with a 1-device mesh it
@@ -305,65 +371,20 @@ class DistBfsEngine:
         self._record_exchange(branch_counts)
         return dist, level
 
-    # --- checkpoint/resume (SURVEY.md §5: the reference has none) ---
+    # --- checkpoint/resume: VertexCheckpointMixin provides
+    # start/advance/finish over this hook. ---
 
-    def start(self, source: int):
-        """Level-0 traversal state as a host checkpoint (real vertex ids).
+    @property
+    def _num_real_vertices(self) -> int:
+        return self.part.num_vertices
 
-        Checkpoints hold real-id arrays [V], portable across engines and mesh
-        shapes — resuming on a different device count re-pads on entry
-        (elastic restart; the reference's compile-time DeviceNum, bfs.cu:19,
-        and fixed 2-rank world, bfs_mpi.cu:615, have no analog)."""
-        from tpu_bfs.utils.checkpoint import initial_checkpoint
-
-        return initial_checkpoint(self.part.num_vertices, source)
-
-    def _pad_state(self, ckpt):
-        """Real-id [V] checkpoint arrays -> padded-id [vp] arrays."""
-        part = self.part
-        if not hasattr(self, "_pids"):  # constant for the engine's lifetime
-            self._pids = part.to_padded(np.arange(part.num_vertices))
-        pids = self._pids
-        f = np.zeros(part.vp, dtype=bool)
-        f[pids] = ckpt.frontier
-        vis = np.zeros(part.vp, dtype=bool)
-        vis[pids] = ckpt.visited
-        d = np.full(part.vp, INF_DIST, dtype=np.int32)
-        d[pids] = ckpt.distance
-        return f, vis, d
-
-    def advance(self, ckpt, levels: int | None = None):
-        """Run at most ``levels`` more levels across the mesh from a checkpoint."""
-        from tpu_bfs.utils.checkpoint import BfsCheckpoint
-
-        part = self.part
-        if len(ckpt.frontier) != part.num_vertices:
-            raise ValueError(
-                f"checkpoint has {len(ckpt.frontier)} vertices, graph has "
-                f"{part.num_vertices}"
-            )
-        f0, vis0, d0 = self._pad_state(ckpt)
-        put = partial(jax.device_put, device=self._vec_sharding)
-        cap = ckpt.level + levels if levels is not None else part.vp
+    def _advance_loop(self, f0, vis0, d0, level0: int, cap: int):
         frontier, visited, dist, level, branch_counts = self._loop(
-            self.src, self.dst, self.rp, self._aux,
-            put(f0), put(vis0), put(d0),
-            jnp.int32(ckpt.level), jnp.int32(min(cap, part.vp)),
+            self.src, self.dst, self.rp, self._aux, f0, vis0, d0,
+            jnp.int32(level0), jnp.int32(cap),
         )
-        self._record_exchange(branch_counts, resumed_level=ckpt.level)
-        return BfsCheckpoint(
-            source=ckpt.source,
-            level=int(level),
-            frontier=part.unshard(np.asarray(frontier)),
-            visited=part.unshard(np.asarray(visited)),
-            distance=part.unshard(np.asarray(dist)),
-        )
-
-    def finish(self, ckpt, *, with_parents: bool = True) -> BfsResult:
-        """Convert a (finished or partial) checkpoint into a BfsResult."""
-        _, _, d0 = self._pad_state(ckpt)
-        put = partial(jax.device_put, device=self._vec_sharding)
-        return self._package(put(d0), ckpt.source, with_parents, None)
+        self._record_exchange(branch_counts, resumed_level=level0)
+        return frontier, visited, dist, level
 
     def run(
         self,

@@ -32,7 +32,13 @@ from tpu_bfs.algorithms.frontier import (
     make_dopt_expand,
 )
 from tpu_bfs.graph.csr import Graph, INF_DIST
-from tpu_bfs.parallel.collectives import reduce_scatter_or, reduce_scatter_min
+from tpu_bfs.parallel.collectives import (
+    dense_2d_wire_bytes,
+    merge_exchange_counts,
+    reduce_scatter_min,
+    reduce_scatter_or,
+)
+from tpu_bfs.parallel.dist_bfs import VertexCheckpointMixin
 from tpu_bfs.parallel.partition2d import Partition2D, out_csr_2d, partition_2d
 from tpu_bfs.utils.timing import run_timed
 
@@ -58,7 +64,9 @@ def _dist2d_bfs_fn(mesh: Mesh, rows: int, cols: int, w: int, exchange: str,
     col_block = rows * w
     dopt = backend == "dopt"
 
-    def local_loop(src_g, dst_l, rp_l, aux, frontier, visited, dist, max_levels):
+    def local_loop(
+        src_g, dst_l, rp_l, aux, frontier, visited, dist, level0, max_levels
+    ):
         src_g = src_g[0, 0]
         dst_l = dst_l[0, 0]
         rp_l = rp_l[0, 0]
@@ -101,10 +109,10 @@ def _dist2d_bfs_fn(mesh: Mesh, rows: int, cols: int, w: int, exchange: str,
             return new, visited, dist, level + 1, count
 
         init = lax.psum(jnp.sum(frontier.astype(jnp.int32)), ("r", "c"))
-        _, _, dist, level, _ = lax.while_loop(
-            cond, body, (frontier, visited, dist, jnp.int32(0), init)
+        frontier, visited, dist, level, _ = lax.while_loop(
+            cond, body, (frontier, visited, dist, jnp.int32(level0), init)
         )
-        return dist, level
+        return frontier, visited, dist, level
 
     aux_specs = (P("r", "c", None), P("r", "c", None)) if dopt else ()
     return jax.jit(
@@ -120,8 +128,9 @@ def _dist2d_bfs_fn(mesh: Mesh, rows: int, cols: int, w: int, exchange: str,
                 P(("r", "c")),
                 P(("r", "c")),
                 P(),
+                P(),
             ),
-            out_specs=(P(("r", "c")), P()),
+            out_specs=(P(("r", "c")), P(("r", "c")), P(("r", "c")), P()),
             check_vma=False,
         )
     )
@@ -162,7 +171,7 @@ def _dist2d_parents_fn(mesh: Mesh, rows: int, cols: int, w: int, exchange: str):
     )
 
 
-class Dist2DBfsEngine:
+class Dist2DBfsEngine(VertexCheckpointMixin):
     """BFS over an R x C mesh with 2D edge partitioning.
 
     API mirrors DistBfsEngine; use for meshes large enough that the 1D
@@ -217,12 +226,29 @@ class Dist2DBfsEngine:
             if dopt_caps is None:
                 dopt_caps = default_dopt_caps(src_gidx.shape[2])
         self.dopt_caps = tuple(sorted(set(dopt_caps))) if dopt_caps else ()
+        self._exchange = exchange
         self._loop = _dist2d_bfs_fn(
             mesh, self.rows, self.cols, part.w, exchange, backend,
             self.dopt_caps,
         )
         self._parents = _dist2d_parents_fn(mesh, self.rows, self.cols, part.w, exchange)
+        #: level count of the last traversal (one branch — the 2D loop has
+        #: no cap ladder) and the modeled off-chip bytes one chip moved in
+        #: it (column all-gather + row reduce-scatter per level) — the 2D
+        #: analog of DistBfsEngine's exchange accounting.
+        self.last_exchange_level_counts: np.ndarray | None = None
+        self.last_exchange_bytes: float | None = None
         self._warmed = False
+
+    def _record_exchange(self, levels_run: int, *, resumed_level: int = 0) -> None:
+        counts = merge_exchange_counts(
+            self.last_exchange_level_counts,
+            np.array([levels_run], dtype=np.int64),
+            resumed_level,
+        )
+        per = dense_2d_wire_bytes(self.rows, self.cols, self.part.w, self._exchange)
+        self.last_exchange_level_counts = counts
+        self.last_exchange_bytes = float(counts[0] * per)
 
     def _init_state(self, source: int):
         part = self.part
@@ -237,10 +263,28 @@ class Dist2DBfsEngine:
     def distances_padded(self, source: int, *, max_levels: int | None = None):
         frontier0, visited0, dist0 = self._init_state(source)
         ml = jnp.int32(max_levels if max_levels is not None else self.part.vp)
-        return self._loop(
+        _, _, dist, level = self._loop(
             self.src_g, self.dst_l, self.rp, self._aux,
-            frontier0, visited0, dist0, ml,
+            frontier0, visited0, dist0, jnp.int32(0), ml,
         )
+        self._record_exchange(int(level))
+        return dist, level
+
+    # --- checkpoint/resume: VertexCheckpointMixin (dist_bfs.py) provides
+    # start/advance/finish; checkpoints are real-id [V] arrays shared with
+    # the 1D engine, so traversals resume across partition topologies. ---
+
+    @property
+    def _num_real_vertices(self) -> int:
+        return self.part.base.num_vertices
+
+    def _advance_loop(self, f0, vis0, d0, level0: int, cap: int):
+        frontier, visited, dist, level = self._loop(
+            self.src_g, self.dst_l, self.rp, self._aux, f0, vis0, d0,
+            jnp.int32(level0), jnp.int32(cap),
+        )
+        self._record_exchange(int(level) - level0, resumed_level=level0)
+        return frontier, visited, dist, level
 
     def run(
         self,
@@ -262,7 +306,10 @@ class Dist2DBfsEngine:
             self._warmed = True
         else:
             dist_dev, _ = self.distances_padded(source, max_levels=max_levels)
+        return self._package(dist_dev, source, with_parents, elapsed)
 
+    def _package(self, dist_dev, source, with_parents, elapsed) -> BfsResult:
+        part = self.part
         parent = None
         if with_parents:
             parent_dev = self._parents(self.src_g, self.dst_l, dist_dev)

@@ -209,6 +209,18 @@ def _validate_tile_spmm_compiled(engine) -> None:
     )
 
 
+def lj_impl() -> str:
+    """Which edge-stream generator the LJ stand-in uses on this machine.
+
+    The native and numpy RMAT builders are different deterministic streams;
+    pinning the choice per-machine and RECORDING it (cache filenames, .mtx
+    comment, metric description) keeps the lj-* numbers attributable —
+    cross-machine runs compare like with like or say why not."""
+    from tpu_bfs.utils.native import has_rmat
+
+    return "native" if has_rmat() else "numpy"
+
+
 def load_graph_lj():
     """The LiveJournal-shaped stand-in (NONETWORK.md): generate once, write
     the 1.0 GiB .mtx, ingest through the native loader path, cache the CSR.
@@ -220,22 +232,25 @@ def load_graph_lj():
     from tpu_bfs.utils.native import ensure_built
 
     ensure_built(log=log)
+    impl = lj_impl()
     cache_dir = os.environ.get("TPU_BFS_BENCH_CACHE", ".bench_cache")
     os.makedirs(cache_dir, exist_ok=True)
-    mtx = os.path.join(cache_dir, "soc-LiveJournal1-standin.mtx")
-    npz = os.path.join(cache_dir, "lj_standin_csr.npz")
+    mtx = os.path.join(cache_dir, f"soc-LiveJournal1-standin-{impl}.mtx")
+    npz = os.path.join(cache_dir, f"lj_standin_csr_{impl}.npz")
     if os.path.exists(npz):
         t0 = time.perf_counter()
         g = load_npz(npz)
-        log(f"LJ stand-in: cached CSR load {time.perf_counter()-t0:.1f}s")
+        log(f"LJ stand-in [{impl}]: cached CSR load {time.perf_counter()-t0:.1f}s")
         return g
     if not os.path.exists(mtx):
         t0 = time.perf_counter()
-        u, v = lj_standin_edges(seed=1, impl="auto")
-        log(f"LJ stand-in gen {time.perf_counter()-t0:.1f}s: {len(u)} directed edges")
+        u, v = lj_standin_edges(seed=1, impl=impl)
+        log(f"LJ stand-in gen [{impl}] {time.perf_counter()-t0:.1f}s: "
+            f"{len(u)} directed edges")
         t0 = time.perf_counter()
         write_mtx(mtx, u, v, LJ_V,
-                  comment="synthetic soc-LiveJournal1 stand-in (see NONETWORK.md)")
+                  comment="synthetic soc-LiveJournal1 stand-in (see "
+                          f"NONETWORK.md; {impl} edge stream, seed=1)")
         log(f"write {mtx} {time.perf_counter()-t0:.1f}s "
             f"({os.path.getsize(mtx)/2**30:.2f} GiB)")
         del u, v
@@ -490,6 +505,10 @@ def main() -> int:
     from functools import partial
 
     lj_desc = "soc-LiveJournal1-shaped stand-in (NONETWORK.md)"
+    if mode.startswith("lj-"):
+        # Attribute the edge stream: native and numpy RMAT are different
+        # deterministic streams (ADVICE r2), so the metric says which one.
+        lj_desc = f"{lj_desc[:-1]}; {lj_impl()} stream)"
     fn = {
         "hybrid": bench_hybrid,
         "wide": bench_wide,

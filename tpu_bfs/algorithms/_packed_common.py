@@ -542,7 +542,7 @@ def start_packed_batch(engine, sources):
     the reference has no checkpointing; a failed rank loses the whole
     traversal). State = frontier/visited tables + ``num_planes`` bit-sliced
     distance planes, all in real-vertex-id row order."""
-    from tpu_bfs.utils.checkpoint import PackedCheckpoint
+    from tpu_bfs.utils.checkpoint import PackedCheckpoint, _new_nonce
 
     sources = _check_batch_sources(engine, sources)
     # The seed table may use a different row order than the result tables
@@ -552,6 +552,10 @@ def start_packed_batch(engine, sources):
     planes = np.zeros(
         (engine.num_planes, engine.num_vertices, engine.w), np.uint32
     )
+    # The starting engine knows its isolated lanes exactly; persist the
+    # mask so ANY finishing engine applies the patch — including one whose
+    # own _iso_mask is unknowable (prebuilt directed shard sets).
+    iso = getattr(engine, "_iso_of", lambda s: None)(sources)
     return PackedCheckpoint(
         sources=sources,
         level=0,
@@ -559,6 +563,8 @@ def start_packed_batch(engine, sources):
         frontier=seed_real,
         visited=seed_real.copy(),
         planes=planes,
+        iso=None if iso is None else np.asarray(iso, dtype=bool),
+        nonce=_new_nonce(),
     )
 
 
@@ -579,6 +585,10 @@ def advance_packed_batch(engine, ckpt, levels: int | None = None):
     cap = engine.max_levels_cap
     ml = min(ckpt.level + levels, cap) if levels is not None else cap
     to_fw, from_fw = _fw_hooks(engine)
+    # Chain identity for the distributed engines' exchange accounting
+    # (read by RowGatherExchangeAccounting._core_from; a plain attribute
+    # because the single-chip engines' _core_from is the raw jitted loop).
+    engine._pending_chain_nonce = getattr(ckpt, "nonce", None)
     # visited converts first: packed_real_to_table raises the descriptive
     # lane-count/graph mismatch error before any custom frontier hook can
     # hit a raw broadcast failure.
@@ -589,22 +599,29 @@ def advance_packed_batch(engine, ckpt, levels: int | None = None):
         engine.arrs, fw, vis, planes, jnp.int32(ckpt.level), jnp.int32(ml)
     )
     if bool(alive) and int(level) >= cap:
-        # At the plane cap with the last body still claiming: run the one
-        # boundary body. An eccentricity that lands exactly on the cap
-        # claims nothing more and terminates cleanly (matching the
-        # uninterrupted num_levels accounting); anything else is a genuine
-        # truncation and must raise rather than let callers' advance loops
-        # spin forever on a level counter that can no longer move.
-        fw_f, vis_f, planes_f, level, alive = engine._core_from(
+        # At the plane cap with the last body still claiming: run ONE
+        # boundary body purely as a probe. An eccentricity that lands
+        # exactly on the cap claims nothing more and terminates cleanly;
+        # anything else is a genuine truncation and must raise rather than
+        # let callers' advance loops spin forever on a level counter that
+        # can no longer move. The probe's table mutations are DISCARDED:
+        # its ripple_increment would bump still-unvisited rows' planes
+        # past what an uninterrupted run (which stops at the cap) holds,
+        # so keeping the pre-probe tables preserves bit-identical
+        # checkpoints; only the probe's level/alive bookkeeping is kept
+        # (level cap+1, alive False — matching the uninterrupted
+        # num_levels accounting in _assemble_packed_result).
+        _, _, _, p_level, p_alive = engine._core_from(
             engine.arrs, fw_f, vis_f, planes_f,
             jnp.int32(int(level)), jnp.int32(int(level) + 1),
         )
-        if bool(alive):
+        if bool(p_alive):
             raise RuntimeError(
                 f"traversal truncated at {cap} levels; "
                 f"num_planes={engine.num_planes} caps at {cap} — construct "
                 "the engine with more planes for this graph"
             )
+        level, alive = p_level, p_alive
     return PackedCheckpoint(
         sources=ckpt.sources,
         level=int(level),
@@ -614,15 +631,21 @@ def advance_packed_batch(engine, ckpt, levels: int | None = None):
         planes=np.stack(
             [packed_table_to_real(engine, p) for p in planes_f]
         ),
+        iso=ckpt.iso,
+        nonce=getattr(ckpt, "nonce", None),
     )
 
 
 def _assemble_packed_result(
-    engine, sources, planes, vis, src_bits_raw, levels, alive, elapsed
+    engine, sources, planes, vis, src_bits_raw, levels, alive, elapsed,
+    iso_override=None,
 ) -> PackedBatchResult:
     """Result assembly shared by run_packed_batch and finish_packed_batch:
     device-side lane stats, isolated-lane patching, sentinel-row src-bits
-    view, and the final-empty-frontier level adjustment."""
+    view, and the final-empty-frontier level adjustment. ``iso_override``
+    (from a checkpoint's persisted mask) wins over the engine's own
+    isolated-lane reckoning — the finishing engine may not be able to
+    reconstruct it (prebuilt directed shard sets)."""
     s = len(sources)
     r, d = engine._lane_stats(vis)
     reached = engine._lane_order(np.asarray(r))[:s].astype(np.int64)
@@ -633,7 +656,11 @@ def _assemble_packed_result(
 
     # Lanes seeded at isolated sources have no device row: the table scan
     # sees nothing, but the source itself is trivially reached.
-    iso = getattr(engine, "_iso_of", lambda s: None)(sources)
+    iso = (
+        iso_override
+        if iso_override is not None
+        else getattr(engine, "_iso_of", lambda s: None)(sources)
+    )
     if iso is not None and iso.any():
         reached[iso] = 1
         edges[iso] = 0
@@ -664,13 +691,17 @@ def _assemble_packed_result(
 
 def finish_packed_batch(engine, ckpt) -> PackedBatchResult:
     """Package a (finished or partial) packed checkpoint as a batch result,
-    with the same lazy per-word distance extraction as a direct run."""
+    with the same lazy per-word distance extraction as a direct run. The
+    checkpoint's persisted isolated-lane mask (stamped at start) is used
+    when present, so lanes at isolated sources report reached=1 even on a
+    finishing engine that cannot reconstruct the mask itself."""
     sources = _check_batch_sources(engine, ckpt.sources)
     vis = packed_real_to_table(engine, ckpt.visited)
     planes = tuple(packed_real_to_table(engine, p) for p in ckpt.planes)
     return _assemble_packed_result(
         engine, sources, planes, vis, engine._seed_dev(sources),
         ckpt.level, ckpt.alive, None,
+        iso_override=getattr(ckpt, "iso", None),
     )
 
 

@@ -205,6 +205,7 @@ class BfsEngine:
             frontier=np.asarray(frontier)[:v],
             visited=np.asarray(visited)[:v],
             distance=np.asarray(dist)[:v],
+            nonce=getattr(ckpt, "nonce", None),  # chain identity survives chunks
         )
 
     def finish(self, ckpt, *, with_parents: bool = True) -> BfsResult:

@@ -199,17 +199,44 @@ def sparse_exchange_or(
 
 def merge_exchange_counts(prev, counts, resumed_level: int):
     """Accumulate per-branch exchange level counts across the chunks of one
-    checkpointed traversal. The chain test is ``prev.sum() == resumed_level``
-    — the previous counters cover exactly levels [0, resumed_level) iff they
-    belong to this chain; counters left by an UNRELATED traversal that
-    happened to run resumed_level levels would merge wrongly (rare
-    coincidence, documented caveat), and chains whose earlier chunks ran in
-    another process simply restart the count (covering the levels run
-    here). Shared by every engine with exchange accounting."""
+    checkpointed traversal. The consistency test is ``prev.sum() ==
+    resumed_level`` — the previous counters cover exactly levels
+    [0, resumed_level) iff they belong to this chain. Callers gate ``prev``
+    through :func:`chained_prev_counts` first, which keys the chain on the
+    checkpoint's identity nonce, so counters left by an UNRELATED traversal
+    can no longer merge by level-count coincidence; chains whose earlier
+    chunks ran in another process simply restart the count (covering the
+    levels run here). Shared by every engine with exchange accounting."""
     counts = np.asarray(counts)
     if resumed_level > 0 and prev is not None and prev.sum() == resumed_level:
         return counts + prev
     return counts
+
+
+def chained_prev_counts(prev, resumed_level: int, prev_nonce, nonce):
+    """Identity gate for chunked-traversal exchange accounting.
+
+    The previous counters belong to the chain being resumed only if the
+    engine last recorded under the SAME chain nonce (stamped into the
+    checkpoint at start(), utils/checkpoint.py). A None nonce (old
+    checkpoint format, or a fresh non-checkpointed run) never chains —
+    the count restarts, covering the levels run here."""
+    if resumed_level > 0 and (nonce is None or prev_nonce != nonce):
+        return None
+    return prev
+
+
+def gate_and_stamp_chain(engine, resumed_level: int, chain_nonce):
+    """The gate-and-stamp step every ``_record_exchange`` shares: gate the
+    engine's previous counters through :func:`chained_prev_counts` and
+    stamp the engine with the new chain nonce. Returns the gated ``prev``
+    for the caller's merge + pricing."""
+    prev = chained_prev_counts(
+        engine.last_exchange_level_counts, resumed_level,
+        getattr(engine, "_exchange_chain_nonce", None), chain_nonce,
+    )
+    engine._exchange_chain_nonce = chain_nonce
+    return prev
 
 
 def sparse_rows_gather(
@@ -294,7 +321,7 @@ def sparse_rows_wire_bytes_per_level(
 def record_row_gather_exchange(
     prev, branch_counts, resumed_level: int, *, exchange: str, p: int,
     rows_loc: int, w: int, caps: tuple[int, ...],
-):
+):  # ``prev`` is pre-gated by chained_prev_counts in the engine mixin.
     """The packed MS engines' complete exchange accounting step: merge the
     per-branch level counts into the chunked-traversal chain, then price
     them with the row-gather byte model (dense impls have the single slab
@@ -319,10 +346,13 @@ class RowGatherExchangeAccounting:
     ``_gather_p``, ``_gather_rows_loc``, ``_core_from_jit``, and the two
     ``last_exchange_*`` attributes."""
 
-    def _record_exchange(self, branch_counts, resumed_level: int) -> None:
+    def _record_exchange(
+        self, branch_counts, resumed_level: int, chain_nonce=None
+    ) -> None:
+        prev = gate_and_stamp_chain(self, resumed_level, chain_nonce)
         self.last_exchange_level_counts, self.last_exchange_bytes = (
             record_row_gather_exchange(
-                self.last_exchange_level_counts, branch_counts, resumed_level,
+                prev, branch_counts, resumed_level,
                 exchange=self._exchange, p=self._gather_p,
                 rows_loc=self._gather_rows_loc, w=self.w,
                 caps=self.sparse_caps,
@@ -333,7 +363,12 @@ class RowGatherExchangeAccounting:
         fw_f, vis_f, planes_f, level, alive, bc = self._core_from_jit(
             arrs, fw, vis, planes, level0, max_levels
         )
-        self._record_exchange(bc, int(level0))
+        # advance_packed_batch stamps the resumed checkpoint's chain nonce
+        # here before calling (read, not popped: the cap-boundary probe is
+        # a second _core_from of the same advance and must chain too).
+        self._record_exchange(
+            bc, int(level0), getattr(self, "_pending_chain_nonce", None)
+        )
         return fw_f, vis_f, planes_f, level, alive
 
 

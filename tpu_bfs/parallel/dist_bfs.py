@@ -43,6 +43,7 @@ from tpu_bfs.graph.csr import Graph, INF_DIST
 from tpu_bfs.parallel.collectives import (
     default_sparse_caps,
     dense_or_wire_bytes,
+    gate_and_stamp_chain,
     merge_exchange_counts,
     reduce_scatter_or,
     reduce_scatter_min,
@@ -255,7 +256,8 @@ class VertexCheckpointMixin:
         put = partial(jax.device_put, device=self._vec_sharding)
         cap = ckpt.level + levels if levels is not None else part.vp
         frontier, visited, dist, level = self._advance_loop(
-            put(f0), put(vis0), put(d0), ckpt.level, min(cap, part.vp)
+            put(f0), put(vis0), put(d0), ckpt.level, min(cap, part.vp),
+            chain_nonce=getattr(ckpt, "nonce", None),
         )
         return BfsCheckpoint(
             source=ckpt.source,
@@ -263,6 +265,7 @@ class VertexCheckpointMixin:
             frontier=part.unshard(np.asarray(frontier)),
             visited=part.unshard(np.asarray(visited)),
             distance=part.unshard(np.asarray(dist)),
+            nonce=getattr(ckpt, "nonce", None),  # chain identity survives chunks
         )
 
     def finish(self, ckpt, *, with_parents: bool = True):
@@ -339,10 +342,11 @@ class DistBfsEngine(VertexCheckpointMixin):
         self.last_exchange_bytes: float | None = None
         self._warmed = False
 
-    def _record_exchange(self, branch_counts, *, resumed_level: int = 0) -> None:
-        counts = merge_exchange_counts(
-            self.last_exchange_level_counts, branch_counts, resumed_level
-        )
+    def _record_exchange(
+        self, branch_counts, *, resumed_level: int = 0, chain_nonce=None
+    ) -> None:
+        prev = gate_and_stamp_chain(self, resumed_level, chain_nonce)
+        counts = merge_exchange_counts(prev, branch_counts, resumed_level)
         if self._exchange == "sparse":
             per = sparse_wire_bytes_per_level(self.p, self.part.vloc, self.sparse_caps)
         else:
@@ -378,12 +382,14 @@ class DistBfsEngine(VertexCheckpointMixin):
     def _num_real_vertices(self) -> int:
         return self.part.num_vertices
 
-    def _advance_loop(self, f0, vis0, d0, level0: int, cap: int):
+    def _advance_loop(self, f0, vis0, d0, level0: int, cap: int, *, chain_nonce=None):
         frontier, visited, dist, level, branch_counts = self._loop(
             self.src, self.dst, self.rp, self._aux, f0, vis0, d0,
             jnp.int32(level0), jnp.int32(cap),
         )
-        self._record_exchange(branch_counts, resumed_level=level0)
+        self._record_exchange(
+            branch_counts, resumed_level=level0, chain_nonce=chain_nonce
+        )
         return frontier, visited, dist, level
 
     def run(

@@ -34,6 +34,7 @@ from tpu_bfs.algorithms.frontier import (
 from tpu_bfs.graph.csr import Graph, INF_DIST
 from tpu_bfs.parallel.collectives import (
     dense_2d_wire_bytes,
+    gate_and_stamp_chain,
     merge_exchange_counts,
     reduce_scatter_min,
     reduce_scatter_or,
@@ -240,11 +241,12 @@ class Dist2DBfsEngine(VertexCheckpointMixin):
         self.last_exchange_bytes: float | None = None
         self._warmed = False
 
-    def _record_exchange(self, levels_run: int, *, resumed_level: int = 0) -> None:
+    def _record_exchange(
+        self, levels_run: int, *, resumed_level: int = 0, chain_nonce=None
+    ) -> None:
+        prev = gate_and_stamp_chain(self, resumed_level, chain_nonce)
         counts = merge_exchange_counts(
-            self.last_exchange_level_counts,
-            np.array([levels_run], dtype=np.int64),
-            resumed_level,
+            prev, np.array([levels_run], dtype=np.int64), resumed_level
         )
         per = dense_2d_wire_bytes(self.rows, self.cols, self.part.w, self._exchange)
         self.last_exchange_level_counts = counts
@@ -278,12 +280,14 @@ class Dist2DBfsEngine(VertexCheckpointMixin):
     def _num_real_vertices(self) -> int:
         return self.part.base.num_vertices
 
-    def _advance_loop(self, f0, vis0, d0, level0: int, cap: int):
+    def _advance_loop(self, f0, vis0, d0, level0: int, cap: int, *, chain_nonce=None):
         frontier, visited, dist, level = self._loop(
             self.src_g, self.dst_l, self.rp, self._aux, f0, vis0, d0,
             jnp.int32(level0), jnp.int32(cap),
         )
-        self._record_exchange(int(level) - level0, resumed_level=level0)
+        self._record_exchange(
+            int(level) - level0, resumed_level=level0, chain_nonce=chain_nonce
+        )
         return frontier, visited, dist, level
 
     def run(

@@ -269,8 +269,11 @@ class DistWideMsBfsEngine(RowGatherExchangeAccounting):
         # this engine's own runs don't need the patch — but finishing a
         # checkpoint started on a trimmed engine does. Exact from a Graph;
         # for a prebuilt undirected shard set in_degree==0 is equivalent; a
-        # prebuilt directed one cannot distinguish out-only vertices, so the
-        # patch is skipped (None).
+        # prebuilt directed one cannot distinguish out-only vertices (None
+        # here) — but checkpoints persist the starting engine's exact mask
+        # (PackedCheckpoint.iso), which finish_packed_batch prefers, so
+        # even this engine patches resumed lanes correctly; None only
+        # degrades its own fresh runs' iso reckoning.
         if isinstance(graph, Graph):
             src, dst = graph.coo
             seen = np.zeros(graph.num_vertices, dtype=bool)

@@ -24,6 +24,11 @@ import numpy as np
 _STATE_VERSION = 1
 
 
+def _new_nonce() -> int:
+    """Random chain id for exchange-accounting identity (see BfsCheckpoint)."""
+    return int.from_bytes(os.urandom(8), "little") >> 1  # fits int64
+
+
 @dataclasses.dataclass
 class BfsCheckpoint:
     """Host-side snapshot of one traversal, in REAL vertex-id space [V].
@@ -40,6 +45,13 @@ class BfsCheckpoint:
     frontier: np.ndarray  # [V] bool
     visited: np.ndarray  # [V] bool
     distance: np.ndarray  # [V] int32 (INF_DIST where unreached)
+    # Chain identity for exchange-byte accounting: generated once per
+    # start(), carried through every chunk, so an engine merges resumed
+    # level counters only into the traversal they belong to (never into
+    # counters left by an unrelated run that happened to reach the same
+    # level — the coincidence the old sum-check alone allowed). None on
+    # checkpoints written before the field existed.
+    nonce: int | None = None
 
     @property
     def done(self) -> bool:
@@ -62,7 +74,7 @@ def initial_checkpoint(num_vertices: int, source: int) -> BfsCheckpoint:
     dist[source] = 0
     return BfsCheckpoint(
         source=source, level=0, frontier=frontier,
-        visited=frontier.copy(), distance=dist,
+        visited=frontier.copy(), distance=dist, nonce=_new_nonce(),
     )
 
 
@@ -95,6 +107,7 @@ def save_checkpoint(path: str, ckpt: BfsCheckpoint) -> None:
         frontier=ckpt.frontier,
         visited=ckpt.visited,
         distance=ckpt.distance,
+        nonce=-1 if ckpt.nonce is None else ckpt.nonce,
     )
 
 
@@ -107,12 +120,14 @@ def load_checkpoint(path: str) -> BfsCheckpoint:
             f"{path} is a packed-batch checkpoint (use load_packed_checkpoint"
             " / resume it with a multi-source engine)"
         )
+    nonce = int(z["nonce"]) if "nonce" in z.files else -1
     return BfsCheckpoint(
         source=int(z["source"]),
         level=int(z["level"]),
         frontier=z["frontier"],
         visited=z["visited"],
         distance=z["distance"],
+        nonce=None if nonce < 0 else nonce,
     )
 
 
@@ -140,6 +155,15 @@ class PackedCheckpoint:
     frontier: np.ndarray  # [V, w] uint32
     visited: np.ndarray  # [V, w] uint32
     planes: np.ndarray  # [P, V, w] uint32
+    # [S] bool: lanes whose source is isolated (no row in trimmed engine
+    # tables; the component is trivially {source}). Recorded at start()
+    # from the starting engine — which knows it exactly — so ANY finishing
+    # engine can patch those lanes, including one built from a prebuilt
+    # directed shard set that cannot reconstruct the mask itself
+    # (dist_msbfs_wide._iso_mask = None). None on old checkpoints.
+    iso: np.ndarray | None = None
+    # Chain identity for exchange accounting (see BfsCheckpoint.nonce).
+    nonce: int | None = None
 
     @property
     def done(self) -> bool:
@@ -158,6 +182,8 @@ def save_packed_checkpoint(path: str, ckpt: PackedCheckpoint) -> None:
         frontier=ckpt.frontier,
         visited=ckpt.visited,
         planes=ckpt.planes,
+        iso=np.empty(0, bool) if ckpt.iso is None else ckpt.iso.astype(bool),
+        nonce=-1 if ckpt.nonce is None else ckpt.nonce,
     )
 
 
@@ -170,6 +196,8 @@ def load_packed_checkpoint(path: str) -> PackedCheckpoint:
             f"{path} is not a packed-batch checkpoint (use load_checkpoint "
             "for single-source state)"
         )
+    iso = z["iso"] if "iso" in z.files else np.empty(0, bool)
+    nonce = int(z["nonce"]) if "nonce" in z.files else -1
     return PackedCheckpoint(
         sources=z["sources"].astype(np.int64),
         level=int(z["level"]),
@@ -177,6 +205,8 @@ def load_packed_checkpoint(path: str) -> PackedCheckpoint:
         frontier=z["frontier"],
         visited=z["visited"],
         planes=z["planes"],
+        iso=iso.astype(bool) if iso.size else None,
+        nonce=None if nonce < 0 else nonce,
     )
 
 
@@ -220,6 +250,7 @@ def save_checkpoint_sharded(dirpath: str, ckpt: BfsCheckpoint, num_shards: int) 
         "num_vertices": v,
         "num_shards": num_shards,
         "generation": gen,
+        "nonce": ckpt.nonce,  # chain identity (None on old checkpoints)
     }
     for k in range(num_shards):
         sl = slice(k * cpk, min((k + 1) * cpk, v))
@@ -267,6 +298,7 @@ def load_checkpoint_sharded(dirpath: str) -> BfsCheckpoint:
         frontier=np.concatenate([p["frontier"] for p in parts]),
         visited=np.concatenate([p["visited"] for p in parts]),
         distance=np.concatenate([p["distance"] for p in parts]),
+        nonce=meta.get("nonce"),
     )
     if len(ckpt.frontier) != int(meta["num_vertices"]):
         raise ValueError("shard sizes do not add up to the recorded vertex count")

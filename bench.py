@@ -237,6 +237,10 @@ def load_graph_lj():
     os.makedirs(cache_dir, exist_ok=True)
     mtx = os.path.join(cache_dir, f"soc-LiveJournal1-standin-{impl}.mtx")
     npz = os.path.join(cache_dir, f"lj_standin_csr_{impl}.npz")
+    # Pre-suffix caches (impl="auto" era) are NOT adopted: auto resolved
+    # per-run, so a legacy file's stream is unattributable (a then-broken
+    # native build would have silently produced numpy data). One
+    # regeneration buys correctly-labeled numbers.
     if os.path.exists(npz):
         t0 = time.perf_counter()
         g = load_npz(npz)

@@ -121,6 +121,27 @@ def test_lj_impl_recorded():
     assert bench.lj_impl() in ("native", "numpy")
 
 
+def test_cap_boundary_probe_keeps_restarted_chain_counters():
+    # Fresh-process shape: an engine with NO prior counters resumes a
+    # packed checkpoint at level L>0 and the traversal lands exactly on
+    # the plane cap. The boundary probe must not re-record (its
+    # resumed_level=cap cannot pass the sum-consistency test of a chain
+    # that only covers cap-L levels) — the counters must keep pricing the
+    # 22 levels this chunk ran, not collapse to the probe's one.
+    from tpu_bfs.parallel.dist_msbfs_wide import DistWideMsBfsEngine
+
+    n = 33
+    u = np.arange(n - 1)
+    g = gio.from_edges(u, u + 1, num_vertices=n)
+    a = DistWideMsBfsEngine(g, make_mesh(2), num_planes=5)
+    st = a.advance(a.start(np.asarray([0])), levels=10)
+
+    b = DistWideMsBfsEngine(g, make_mesh(2), num_planes=5)
+    st = b.advance(st)  # runs to the cap; the probe fires unaccounted
+    assert st.done and st.level == 33
+    assert b.last_exchange_level_counts.sum() == 22  # levels 10..32
+
+
 def test_packed_cap_boundary_checkpoint_bit_identical():
     # Path graph of 33 vertices: eccentricity 32 == the 5-plane cap, so the
     # chunked advance hits the cap with the last body still claiming and

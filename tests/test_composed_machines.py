@@ -1,0 +1,70 @@
+"""Composed-machine stress: sparse exchange x dopt x checkpoint-mid-run.
+
+The distributed engines stack three `lax.cond` state machines per level —
+the direction-optimizing top-down/dense switch (frontier.make_dopt_expand),
+the sparse-exchange bucket-cap ladder (collectives.sparse_exchange_or), and
+the resume boundary's while-loop carry restore. Their composition across a
+checkpoint cut is the likeliest residual bug surface (VERDICT r2 #9): a
+branch index or carry component that survives one machine but not the
+stack. Distances must be bit-identical to an uninterrupted dense-ring run
+on the full 8-device mesh.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_bfs.parallel.dist_bfs import DistBfsEngine, make_mesh
+
+
+@pytest.mark.parametrize("graph_fixture", ["random_small", "rmat_small", "line_graph"])
+def test_sparse_dopt_ckpt_matches_dense_ring(graph_fixture, request):
+    g = request.getfixturevalue(graph_fixture)
+    baseline = DistBfsEngine(g, make_mesh(8), exchange="ring").run(
+        0, with_parents=True
+    )
+
+    eng = DistBfsEngine(g, make_mesh(8), exchange="sparse", backend="dopt")
+    st = eng.start(0)
+    while not st.done:
+        st = eng.advance(st, levels=1)  # cut at EVERY level boundary
+    res = eng.finish(st, with_parents=True)
+
+    np.testing.assert_array_equal(res.distance, baseline.distance)
+    np.testing.assert_array_equal(res.parent, baseline.parent)
+    assert res.edges_traversed == baseline.edges_traversed
+    # The cap-ladder counters survived the chunking: branch counts cover
+    # every level exactly once.
+    assert eng.last_exchange_level_counts.sum() == st.level
+
+
+def test_sparse_dopt_ckpt_disk_roundtrip_every_chunk(random_small, tmp_path):
+    # Same stack, but the state passes through the .npz serialization at
+    # every cut (what a real failure/restart sequence would do).
+    from tpu_bfs.utils import checkpoint as ck
+
+    g = random_small
+    baseline = DistBfsEngine(g, make_mesh(8), exchange="ring").run(7)
+
+    eng = DistBfsEngine(g, make_mesh(8), exchange="sparse", backend="dopt")
+    st = eng.start(7)
+    p = str(tmp_path / "st.npz")
+    while not st.done:
+        st = eng.advance(st, levels=2)
+        ck.save_checkpoint(p, st)
+        st = ck.load_checkpoint(p)
+    res = eng.finish(st)
+    np.testing.assert_array_equal(res.distance, baseline.distance)
+
+
+def test_sparse_dopt_ckpt_cross_mesh_resume(random_small):
+    # Chunk 1 on a 2-device mesh, chunk 2 on the full 8-device mesh: the
+    # cap ladders are sized per-mesh (vloc differs), so the two engines
+    # compile different branch machines over the same real-id state.
+    g = random_small
+    baseline = DistBfsEngine(g, make_mesh(8), exchange="ring").run(7)
+
+    e2 = DistBfsEngine(g, make_mesh(2), exchange="sparse", backend="dopt")
+    st = e2.advance(e2.start(7), levels=2)
+    e8 = DistBfsEngine(g, make_mesh(8), exchange="sparse", backend="dopt")
+    res = e8.finish(e8.advance(st))
+    np.testing.assert_array_equal(res.distance, baseline.distance)

@@ -610,11 +610,19 @@ def advance_packed_batch(engine, ckpt, levels: int | None = None):
         # so keeping the pre-probe tables preserves bit-identical
         # checkpoints; only the probe's level/alive bookkeeping is kept
         # (level cap+1, alive False — matching the uninterrupted
-        # num_levels accounting in _assemble_packed_result).
-        _, _, _, p_level, p_alive = engine._core_from(
+        # num_levels accounting in _assemble_packed_result). The raw
+        # jitted loop is used where the engine wraps it with exchange
+        # accounting (_core_from_jit): re-recording at the probe's level
+        # would collapse a restarted chain's counters, and the probe's one
+        # extra gather is the same documented modeling gap as the
+        # distributed hybrid's claim-free check
+        # (collectives.record_row_gather_exchange).
+        probe_fn = getattr(engine, "_core_from_jit", None) or engine._core_from
+        out = probe_fn(
             engine.arrs, fw_f, vis_f, planes_f,
             jnp.int32(int(level)), jnp.int32(int(level) + 1),
         )
+        p_level, p_alive = out[3], out[4]
         if bool(p_alive):
             raise RuntimeError(
                 f"traversal truncated at {cap} levels; "

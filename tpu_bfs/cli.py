@@ -91,14 +91,22 @@ def _make_ms_engine(args, g, n_sources: int):
         if args.exchange == "allreduce":
             raise SystemExit(
                 "--exchange allreduce applies to single-source --devices "
-                "runs; the packed engines exchange 'ring' (dense) or "
-                "'sparse'"
+                "runs; the packed engines exchange 'ring' (dense), "
+                "'sparse', or 'sliced' (hybrid)"
             )
-        exchange = "sparse" if args.exchange == "sparse" else "dense"
+        exchange = (
+            args.exchange if args.exchange in ("sparse", "sliced") else "dense"
+        )
         from tpu_bfs.parallel.dist_bfs import make_mesh
 
         mesh = make_mesh(args.devices)
         if engine == "wide":
+            if exchange == "sliced":
+                raise SystemExit(
+                    "--exchange sliced is a hybrid-engine layout (ring-"
+                    "rotated expansion over dense tiles + pair ELL); use "
+                    "--engine hybrid"
+                )
             from tpu_bfs.parallel.dist_msbfs_wide import DistWideMsBfsEngine
 
             return DistWideMsBfsEngine(
@@ -273,12 +281,13 @@ def main(argv=None) -> int:
                     "device, --devices N, and --mesh RxC; 'delta' is "
                     "single-device only)")
     ap.add_argument("--exchange", default="ring",
-                    choices=["ring", "allreduce", "sparse"],
+                    choices=["ring", "allreduce", "sparse", "sliced"],
                     help="multi-device frontier exchange implementation "
                     "('sparse' = two-phase queue-style id exchange with "
                     "dense-bitmap fallback; 1D --devices meshes). With "
                     "--multi-source, 'ring' maps to the packed engines' "
-                    "dense word exchange")
+                    "dense word exchange; 'sliced' (hybrid engine only) is "
+                    "the ring-rotation expansion with O(A/P) transients")
     ap.add_argument("--max-levels", type=int, default=None)
     ap.add_argument("--skip-cpu", action="store_true",
                     help="skip the CPU golden run + validation (reference always validates, bfs.cu:798-815)")
@@ -321,6 +330,9 @@ def main(argv=None) -> int:
     if args.mesh and args.exchange == "sparse":
         ap.error("--exchange sparse pairs with 1D --devices meshes; the 2D "
                  "engine's row/column collectives already move O(vp/dim) bits")
+    if args.exchange == "sliced" and not (args.multi_source and args.devices > 1):
+        ap.error("--exchange sliced is the packed hybrid engine's ring-"
+                 "rotation layout; use it with --multi-source --devices N")
     if args.multi_source and args.mesh:
         ap.error("--multi-source shards 1D (row-tile round-robin); pass "
                  "--devices N instead of a 2D mesh")

@@ -219,9 +219,11 @@ def main(argv=None) -> int:
                     choices=["scan", "segment", "scatter", "dopt"],
                     help="single mode: frontier-expansion backend")
     ap.add_argument("--exchange", default=None,
-                    choices=["ring", "allreduce", "sparse", "dense"],
+                    choices=["ring", "allreduce", "sparse", "dense", "sliced"],
                     help="distributed frontier exchange (single mode: "
-                    "ring/allreduce/sparse; hybrid mode: dense/sparse)")
+                    "ring/allreduce/sparse; hybrid mode: dense/sparse/"
+                    "sliced — 'sliced' is the ring-rotation expansion with "
+                    "O(A/P) transients)")
     args = ap.parse_args(argv)
     mesh2d = None
     if args.mesh:

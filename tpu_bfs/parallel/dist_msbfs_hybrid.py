@@ -21,18 +21,27 @@ mesh grows:
   across chips so one jitted program serves every chip under shard_map; a
   per-chip static permutation routes bucket outputs to local row order.
 - **state**: frontier, visited, and the bit-sliced distance planes are all
-  sharded [rows/P, w] per chip. Per level, one all_gather materializes the
-  full frontier transiently (discarded after expansion); claim, visited
-  update, and plane ripple run on owned rows only. Termination is a psum of
-  local claim popcounts — one collective per level, like the reference's
-  MPI_Allreduce (bfs_mpi.cu:621) but compiled into the on-device loop.
+  sharded [rows/P, w] per chip. Per level, the GATHER layout (default) runs
+  one all_gather that materializes the full frontier transiently (discarded
+  after expansion); claim, visited update, and plane ripple run on owned
+  rows only. Termination is a psum of local claim popcounts — one
+  collective per level, like the reference's MPI_Allreduce (bfs_mpi.cu:621)
+  but compiled into the on-device loop.
+- **sliced layout** (``exchange='sliced'``): the graph-world ring-attention
+  move (SURVEY.md §5). Edges regroup by (source chip, ring step); each chip
+  expands against its RESIDENT frontier shard while an [A/P, w] accumulator
+  rotates the ring, landing home after P partial accumulations — no
+  gathered frontier ever exists, every edge still processed once per level,
+  and the wire bytes equal the ring all-gather's. The O(A) transient below
+  becomes O(A/P): adding chips then genuinely reaches bigger graphs.
 
 Per-chip memory (w=128 words = 4096 lanes, A = active rows):
   persistent: (num_planes + 2) * A/P * 512 B     (planes + visited + frontier)
-  transient:  A * 512 B (gathered frontier) + A/P * 512 B (own hits)
+  transient:  gather layout: A * 512 B (gathered frontier) + A/P * 512 B
+              sliced layout: 2 * A/P * 512 B (rotating accumulator + hits)
   structures: dense tiles (2 KB each) + residual ELL slots / P
-so the dominant term falls as 1/P; only the one transient gathered frontier
-is O(A) — see BENCHMARKS.md for the Graph500 scale-26 budget on v5p.
+so with the sliced layout EVERY term falls as 1/P — see BENCHMARKS.md for
+the Graph500 scale-26 budget on v5p.
 
 Like the single-chip hybrid, the dense kernel fixes the lane count at 4096
 (w=128); unlike it, sharding lets that width fit graphs one chip cannot
@@ -76,50 +85,40 @@ def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
-def _build_residual_shards(
-    res_dst: np.ndarray,
-    res_src_rank: np.ndarray,
-    p_count: int,
-    nrt: int,
-    rows: int,
+def _build_residual_groups(
+    groups,
+    rows_loc: int,
+    n_minor: int,
+    sentinel: int,
     kcap: int,
 ):
-    """Per-chip bucketed ELL over each chip's own residual in-edges.
+    """Common-shape bucketed ELL over an explicit list of edge groups.
 
-    ``res_dst``/``res_src_rank`` are rank0-space endpoints of the residual
-    edges. Chip p owns local rows of the row-tiles {t : t % P == p}; its
-    rows sort by residual degree and bucket exactly like the single-chip
-    hybrid, but bucket shapes are padded to the maximum across chips so one
-    jitted program serves every chip. Neighbor ids stay global rank0 rows
-    (sentinel ``rows - 1``, a pad row kept all-zero by the valid mask).
-    Returns (spec_parts, res_arrs [P,...] stacks, perm [P, nrt*128]) where
-    perm routes each chip's bucket-output rows back to local row order.
+    ``groups`` is a list of ``(ldst, nbr)`` pairs — per-group local
+    destination rows (in [0, rows_loc)) and neighbor ids (any id space;
+    ``n_minor`` bounds them for the sort, ``sentinel`` pads ELL slots).
+    Every bucket shape is padded to the maximum across groups so one jitted
+    program serves all groups under shard_map/scan. This is the group-
+    generic core of both the per-chip residual shards (P groups, neighbor
+    ids global rank0) and the ring-sliced pair shards (P*P groups, neighbor
+    ids local to the source chip's frontier shard).
+    Returns (spec, res_arrs stacks [G, ...], perm [G, rows_loc]).
     """
-    rows_loc = nrt * TILE
-    sentinel = rows - 1
     from tpu_bfs.graph.csr import _lexsort_pairs
 
-    # Global row -> (owner chip, local row).
-    g_tile = res_dst // TILE
-    owner = g_tile % p_count
-    local_row = (g_tile // p_count) * TILE + res_dst % TILE
-
     per_chip = []
-    for p in range(p_count):
-        sel = np.flatnonzero(owner == p)
-        ldst = local_row[sel]
+    for ldst, nbr in groups:
         lens_local = np.bincount(ldst, minlength=rows_loc).astype(np.int64)
         order_rows = np.argsort(-lens_local, kind="stable").astype(np.int64)
         pos_of_row = np.empty(rows_loc, dtype=np.int64)
         pos_of_row[order_rows] = np.arange(rows_loc)
         # Neighbors grouped by (sorted row, src) for determinism. Minor-key
-        # values are global rank0 rows, hence the separate n_minor bound
-        # (rows_loc alone would make the native sort reject every call).
+        # values live in the caller's id space, hence the separate n_minor
+        # bound (rows_loc alone could make the native sort reject calls).
         order_e = _lexsort_pairs(
-            pos_of_row[ldst], res_src_rank[sel].astype(np.int64), rows_loc,
-            rows,
+            pos_of_row[ldst], nbr.astype(np.int64), rows_loc, n_minor
         )
-        nbrs = res_src_rank[sel][order_e].astype(np.int32)
+        nbrs = nbr[order_e].astype(np.int32)
         lens = lens_local[order_rows]
         rp = np.zeros(rows_loc + 1, dtype=np.int64)
         np.cumsum(lens, out=rp[1:])
@@ -213,6 +212,84 @@ def _build_residual_shards(
     return spec, res_arrs, np.stack(perms)
 
 
+def _build_residual_shards(
+    res_dst: np.ndarray,
+    res_src_rank: np.ndarray,
+    p_count: int,
+    nrt: int,
+    rows: int,
+    kcap: int,
+):
+    """Per-chip bucketed ELL over each chip's own residual in-edges.
+
+    ``res_dst``/``res_src_rank`` are rank0-space endpoints of the residual
+    edges. Chip p owns local rows of the row-tiles {t : t % P == p}; its
+    rows sort by residual degree and bucket exactly like the single-chip
+    hybrid, but bucket shapes are padded to the maximum across chips so one
+    jitted program serves every chip. Neighbor ids stay global rank0 rows
+    (sentinel ``rows - 1``, a pad row kept all-zero by the valid mask).
+    Returns (spec, res_arrs [P,...] stacks, perm [P, nrt*128]) where perm
+    routes each chip's bucket-output rows back to local row order.
+    """
+    rows_loc = nrt * TILE
+
+    # Global row -> (owner chip, local row).
+    g_tile = res_dst // TILE
+    owner = g_tile % p_count
+    local_row = (g_tile // p_count) * TILE + res_dst % TILE
+    groups = []
+    for p in range(p_count):
+        sel = np.flatnonzero(owner == p)
+        groups.append((local_row[sel], res_src_rank[sel]))
+    return _build_residual_groups(groups, rows_loc, rows, rows - 1, kcap)
+
+
+def _build_residual_pair_shards(
+    res_dst: np.ndarray,
+    res_src_rank: np.ndarray,
+    p_count: int,
+    nrt: int,
+    kcap: int,
+):
+    """Ring-sliced residual layout: P*P edge groups, one per (source chip,
+    ring step).
+
+    Group (p, s) holds the residual edges whose SOURCE row lives in chip
+    p's frontier shard and whose DESTINATION row is owned by chip
+    d = (p - s - 1) mod P — the accumulator-rotation schedule: at step s
+    chip p ORs its contribution into the accumulator destined for shard d,
+    then passes it along the ring; after P steps each accumulator lands on
+    its home chip. Neighbor ids are LOCAL to the source chip's frontier
+    shard (sentinel ``rows_loc`` -> the appended all-zero row), so the
+    expansion reads only the chip-resident frontier — no gathered table
+    exists at any point, which is the whole memory win (O(A/P) transients,
+    VERDICT r2 #4).
+    Returns (spec, res_arrs [P, P, ...], perm [P, P, rows_loc]).
+    """
+    rows_loc = nrt * TILE
+
+    d_tile = res_dst // TILE
+    dst_owner = d_tile % p_count
+    dst_local = (d_tile // p_count) * TILE + res_dst % TILE
+    s_tile = res_src_rank // TILE
+    src_owner = s_tile % p_count
+    src_local = (s_tile // p_count) * TILE + res_src_rank % TILE
+
+    groups = []
+    for p in range(p_count):
+        for s in range(p_count):
+            d = (p - s - 1) % p_count
+            sel = np.flatnonzero((src_owner == p) & (dst_owner == d))
+            groups.append((dst_local[sel], src_local[sel]))
+    spec, res_arrs, perm = _build_residual_groups(
+        groups, rows_loc, rows_loc + 1, rows_loc, kcap
+    )
+    res_arrs = {
+        k: a.reshape((p_count, p_count) + a.shape[1:]) for k, a in res_arrs.items()
+    }
+    return spec, res_arrs, perm.reshape(p_count, p_count, rows_loc)
+
+
 def build_dist_hybrid(
     g: Graph,
     num_shards: int,
@@ -220,11 +297,21 @@ def build_dist_hybrid(
     kcap: int = 64,
     tile_thr: int = 64,
     a_budget_bytes: int = int(0.2e9),
+    layout: str = "gather",
 ):
     """Build sharded dense tiles + per-chip residual ELL + glue maps.
 
-    Returns a dict of host arrays (see DistHybridMsBfsEngine for the layout).
+    ``layout='gather'`` (default): destination-sharded structures expanded
+    against a transiently gathered full frontier (O(A) transient/level).
+    ``layout='sliced'``: ring-sliced pair structures — each chip's edges
+    grouped by (source chip, ring step), expanded against the chip-resident
+    frontier shard while an O(A/P) accumulator rotates (the graph-world
+    ring-attention move, SURVEY.md §5; every edge still processed exactly
+    once per level).
+    Returns a dict of host arrays (see DistHybridMsBfsEngine).
     """
+    if layout not in ("gather", "sliced"):
+        raise ValueError(f"unknown layout {layout!r}; have 'gather', 'sliced'")
     p_count = num_shards
     v = g.num_vertices
     src, dst = g.coo
@@ -241,40 +328,81 @@ def build_dist_hybrid(
         r, c, vt, tile_thr=tile_thr, a_budget_bytes=a_budget_bytes
     )
 
-    # --- per-chip dense arrays (owner of tile = row_tile % P) ---
+    # --- dense tile grouping ---
     nt = len(dense_uniq)
     g_row_tile = dense_uniq // vt
     g_col_tile = (dense_uniq % vt).astype(np.int32)
-    owner = (g_row_tile % p_count).astype(np.int64)
-    nt_max = max(int(np.bincount(owner, minlength=p_count).max(initial=0)), 1)
-    row_start_s = np.zeros((p_count, nrt + 1), np.int32)
-    col_tile_s = np.zeros((p_count, nt_max), np.int32)
-    a_tiles_s = np.zeros((p_count, nt_max, AW, TILE), np.uint32)
-
-    if nt:
-        # Fill A bits globally, then scatter into per-chip slots.
-        a_global = fill_a_tiles(dense_edge, dense_uniq, tid, r, c)
-        for p in range(p_count):
-            mine = np.flatnonzero(owner == p)
-            local_rt = (g_row_tile[mine] // p_count).astype(np.int64)
-            # dense_uniq is (row_tile, col) sorted; the filtered subsequence
-            # is sorted by local row-tile already.
-            row_start_s[p] = np.searchsorted(
-                local_rt, np.arange(nrt + 1)
-            ).astype(np.int32)
-            col_tile_s[p, : len(mine)] = g_col_tile[mine]
-            a_tiles_s[p, : len(mine)] = a_global[mine]
-
-    # --- residual: per-chip ELL over each chip's own rows ---
-    re_mask = ~dense_edge
-    spec, res_arrs, perm_s = _build_residual_shards(
-        r[re_mask].astype(np.int64),
-        c[re_mask].astype(np.int32),
-        p_count,
-        nrt,
-        rows,
-        kcap,
+    a_global = (
+        fill_a_tiles(dense_edge, dense_uniq, tid, r, c)
+        if nt
+        else np.zeros((1, AW, TILE), np.uint32)
     )
+    if layout == "gather":
+        # Per-chip: owner of tile = row_tile % P; columns index the
+        # gathered full frontier.
+        owner = (g_row_tile % p_count).astype(np.int64)
+        nt_max = max(int(np.bincount(owner, minlength=p_count).max(initial=0)), 1)
+        row_start_s = np.zeros((p_count, nrt + 1), np.int32)
+        col_tile_s = np.zeros((p_count, nt_max), np.int32)
+        a_tiles_s = np.zeros((p_count, nt_max, AW, TILE), np.uint32)
+        if nt:
+            for p in range(p_count):
+                mine = np.flatnonzero(owner == p)
+                local_rt = (g_row_tile[mine] // p_count).astype(np.int64)
+                # dense_uniq is (row_tile, col) sorted; the filtered
+                # subsequence is sorted by local row-tile already.
+                row_start_s[p] = np.searchsorted(
+                    local_rt, np.arange(nrt + 1)
+                ).astype(np.int32)
+                col_tile_s[p, : len(mine)] = g_col_tile[mine]
+                a_tiles_s[p, : len(mine)] = a_global[mine]
+    else:
+        # Sliced: tile lives with its SOURCE columns (owner = col_tile % P),
+        # grouped by ring step s = (p - d - 1) mod P toward the accumulator
+        # of destination shard d = row_tile % P; columns index the
+        # chip-RESIDENT frontier shard (local col tile = col_tile // P).
+        src_own = (g_col_tile % p_count).astype(np.int64)
+        dst_own = (g_row_tile % p_count).astype(np.int64)
+        step = (src_own - dst_own - 1) % p_count
+        pair = src_own * p_count + step
+        nt_max = max(
+            int(np.bincount(pair, minlength=p_count * p_count).max(initial=0)), 1
+        )
+        row_start_s = np.zeros((p_count, p_count, nrt + 1), np.int32)
+        col_tile_s = np.zeros((p_count, p_count, nt_max), np.int32)
+        a_tiles_s = np.zeros((p_count, p_count, nt_max, AW, TILE), np.uint32)
+        if nt:
+            for p in range(p_count):
+                for s in range(p_count):
+                    mine = np.flatnonzero(pair == p * p_count + s)
+                    local_rt = (g_row_tile[mine] // p_count).astype(np.int64)
+                    order = np.argsort(local_rt, kind="stable")
+                    mine, local_rt = mine[order], local_rt[order]
+                    row_start_s[p, s] = np.searchsorted(
+                        local_rt, np.arange(nrt + 1)
+                    ).astype(np.int32)
+                    col_tile_s[p, s, : len(mine)] = g_col_tile[mine] // p_count
+                    a_tiles_s[p, s, : len(mine)] = a_global[mine]
+
+    # --- residual ELL ---
+    re_mask = ~dense_edge
+    if layout == "gather":
+        spec, res_arrs, perm_s = _build_residual_shards(
+            r[re_mask].astype(np.int64),
+            c[re_mask].astype(np.int32),
+            p_count,
+            nrt,
+            rows,
+            kcap,
+        )
+    else:
+        spec, res_arrs, perm_s = _build_residual_pair_shards(
+            r[re_mask].astype(np.int64),
+            c[re_mask].astype(np.int64),
+            p_count,
+            nrt,
+            kcap,
+        )
 
     # Valid mask: real active rows of each chip (global rank0 row < active).
     rows_loc = nrt * TILE
@@ -296,6 +424,7 @@ def build_dist_hybrid(
     tau_of_vertex = np.where(rank < num_active, tau, rows).astype(np.int64)
 
     return {
+        "layout": layout,
         "num_vertices": v,
         "num_active": num_active,
         "num_edges": g.num_edges,
@@ -330,13 +459,82 @@ def _make_dist_core(
     expand = make_fori_expand(hd["res_spec"], w)
     has_dense = hd["num_tiles"] > 0
     nb = len(sparse_caps) + 1 if exchange == "sparse" else 1
+    sliced = hd.get("layout", "gather") == "sliced"
 
     def _global_any(x):
         return lax.psum(jnp.any(x != 0).astype(jnp.int32), "v") > 0
 
+    def _make_loop_sliced(arrs, max_levels):
+        """Ring-sliced level machinery: no gathered frontier ever exists.
+
+        Each chip expands its (source-resident) edge groups against its own
+        frontier shard while an [rows_loc, w] accumulator rotates around
+        the ring — after P partial accumulations the accumulator for shard
+        p lands on chip p (schedule: at step s chip p feeds the accumulator
+        of shard (p - s - 1) mod P; see _build_residual_pair_shards). The
+        per-level transient is O(A/P) instead of the gather layout's O(A);
+        wire bytes match the ring all-gather exactly ((P-1) rotations of
+        one shard) — the win is memory, not traffic, and every edge is
+        still processed exactly once per level."""
+        res_keys = [
+            k for k in arrs
+            if k.startswith("light") or k in ("virtual_t", "fold_pad_map", "heavy_pick")
+        ]
+        step_keys = res_keys + ["perm"] + (
+            ["row_start", "col_tile", "a_tiles"] if has_dense else []
+        )
+        ring = [(i, (i + 1) % p_count) for i in range(p_count)]
+
+        def contrib(fw, fw_ext, s_arrs):
+            out = expand({k: s_arrs[k] for k in res_keys}, fw_ext)[s_arrs["perm"]]
+            if has_dense:
+                out = out | tile_spmm(
+                    s_arrs["row_start"], s_arrs["col_tile"], s_arrs["a_tiles"],
+                    fw, num_row_tiles=nrt, w=w, interpret=interpret,
+                )
+            return out
+
+        def hit_own_of(fw):
+            fw_ext = jnp.concatenate([fw, jnp.zeros((1, w), jnp.uint32)])
+            acc = contrib(fw, fw_ext, {k: arrs[k][0] for k in step_keys})
+
+            def sbody(acc, xs):
+                acc = lax.ppermute(acc, "v", ring)
+                return acc | contrib(fw, fw_ext, xs), None
+
+            if p_count > 1:
+                acc, _ = lax.scan(
+                    sbody, acc, {k: arrs[k][1:] for k in step_keys}
+                )
+            return acc & arrs["valid"]
+
+        def cond(carry):
+            _, _, _, level, alive, _ = carry
+            return alive & (level < max_levels)
+
+        def body(carry):
+            fw, vis, planes, level, _, bc = carry
+            nxt = hit_own_of(fw) & ~vis
+            vis2 = vis | nxt
+            planes = ripple_increment(planes, ~vis2)
+            bc = bc + (jnp.arange(nb, dtype=jnp.int32) == 0)
+            alive = _global_any(nxt)
+            return nxt, vis2, planes, level + 1, alive, bc
+
+        def run_from(fw, vis, planes, level0):
+            return lax.while_loop(
+                cond, body,
+                (fw, vis, planes, level0, jnp.bool_(True),
+                 jnp.zeros(nb, jnp.int32)),
+            )
+
+        return run_from, hit_own_of
+
     def _make_loop(arrs, max_levels):
         """This chip's level machinery over its stripped arrays: returns
         (run_from, hit_own_of) — shared by the fresh and resume entries."""
+        if sliced:
+            return _make_loop_sliced(arrs, max_levels)
 
         def dense_gather(fw_own):
             # Transient full frontier in global rank0 order: global tile
@@ -503,9 +701,10 @@ class DistHybridMsBfsEngine(RowGatherExchangeAccounting):
     ):
         if not (1 <= num_planes <= 8):
             raise ValueError("num_planes must be in [1, 8]")
-        if exchange not in ("dense", "sparse"):
+        if exchange not in ("dense", "sparse", "sliced"):
             raise ValueError(
-                f"unknown exchange {exchange!r}; have 'dense', 'sparse'"
+                f"unknown exchange {exchange!r}; have 'dense', 'sparse', "
+                "'sliced'"
             )
         self.w = W
         self.lanes = LANES
@@ -515,10 +714,11 @@ class DistHybridMsBfsEngine(RowGatherExchangeAccounting):
             interpret = jax.default_backend() != "tpu"
         self.mesh = mesh if isinstance(mesh, Mesh) else make_mesh(mesh)
         p_count = self.mesh.devices.size
+        layout = "sliced" if exchange == "sliced" else "gather"
         hd = (
             build_dist_hybrid(
                 graph, p_count, kcap=kcap, tile_thr=tile_thr,
-                a_budget_bytes=a_budget_bytes,
+                a_budget_bytes=a_budget_bytes, layout=layout,
             )
             if isinstance(graph, Graph)
             else graph
@@ -526,6 +726,11 @@ class DistHybridMsBfsEngine(RowGatherExchangeAccounting):
         if hd["num_shards"] != p_count:
             raise ValueError(
                 f"built for {hd['num_shards']} shards, mesh has {p_count}"
+            )
+        if hd.get("layout", "gather") != layout:
+            raise ValueError(
+                f"prebuilt shard dict has layout {hd.get('layout', 'gather')!r} "
+                f"but exchange {exchange!r} needs {layout!r}"
             )
         self.hd = hd
         # Host-side edge list for post-loop parent extraction

@@ -9,9 +9,9 @@ concurrent sources, per-source time = batch time / N — the metric label says
 so explicitly.
 
 Env overrides: TPU_BFS_BENCH_SCALE (default 21), TPU_BFS_BENCH_EF (16),
-TPU_BFS_BENCH_MODE (hybrid|wide|msbfs|single|single-dopt|lj-hybrid|
-lj-single-dopt — the lj-* modes bench the LiveJournal-shaped stand-in,
-NONETWORK.md),
+TPU_BFS_BENCH_MODE (hybrid|wide|msbfs|single|single-dopt|single-tiled|
+lj-hybrid|lj-single-dopt — the lj-* modes bench the LiveJournal-shaped
+stand-in, NONETWORK.md),
 TPU_BFS_BENCH_LANES (msbfs mode, 512), TPU_BFS_BENCH_SOURCES (single modes,
 8), TPU_BFS_BENCH_VALIDATE (1), TPU_BFS_BENCH_VALIDATE_LANES (4),
 TPU_BFS_BENCH_CACHE (.bench_cache).
@@ -457,18 +457,25 @@ def bench_msbfs(g, scale: int, ef: int) -> dict:
 
 def bench_single(g, scale: int, ef: int, backend: str = "scan",
                  graph_desc: str | None = None) -> dict:
-    """Single-stream one-source-at-a-time BfsEngine — the shape of the
+    """Single-stream one-source-at-a-time BFS — the shape of the
     reference's live path (queueBfs, bfs.cu:134-165). 'single-dopt' runs
-    the direction-optimizing backend. NB: single-stream BFS on TPU is
-    gather-bound (~13 ns/edge -> ~0.9 s per O(E) level at scale 21); the
-    batched engines are the TPU-idiomatic execution model (BENCHMARKS.md
-    "Single-stream" section)."""
-    from tpu_bfs.algorithms.bfs import BfsEngine
-
+    the direction-optimizing backend; 'single-tiled' the dense-tile bitset
+    engine (bfs_tiled.py, the best measured single-stream). NB:
+    single-stream BFS on TPU is gather-bound (~13 ns/edge -> ~0.9 s per
+    O(E) level at scale 21); the batched engines are the TPU-idiomatic
+    execution model (BENCHMARKS.md "Single-stream" section)."""
     n_sources = int(os.environ.get("TPU_BFS_BENCH_SOURCES", "8"))
     do_validate = os.environ.get("TPU_BFS_BENCH_VALIDATE", "1") == "1"
-    engine = retry_transient(BfsEngine, g, backend=backend,
-                             label="single engine build")
+    if backend == "tiled":
+        from tpu_bfs.algorithms.bfs_tiled import TiledBfsEngine
+
+        engine = retry_transient(TiledBfsEngine, g,
+                                 label="tiled engine build")
+    else:
+        from tpu_bfs.algorithms.bfs import BfsEngine
+
+        engine = retry_transient(BfsEngine, g, backend=backend,
+                                 label="single engine build")
     rng = np.random.default_rng(7)
     candidates = np.flatnonzero(g.degrees > 0)
     sources = rng.choice(candidates, size=n_sources, replace=False)
@@ -519,6 +526,7 @@ def main() -> int:
         "msbfs": bench_msbfs,
         "single": bench_single,
         "single-dopt": partial(bench_single, backend="dopt"),
+        "single-tiled": partial(bench_single, backend="tiled"),
         "lj-hybrid": partial(bench_hybrid, graph_desc=lj_desc),
         "lj-single-dopt": partial(bench_single, backend="dopt", graph_desc=lj_desc),
     }[mode]

@@ -1,0 +1,133 @@
+"""TiledBfsEngine: single-stream BFS with the dense-tile bitset pass.
+
+The round-3 single-stream attack (VERDICT r2 #2): heavy levels expand the
+bit-packed dense tiles with contiguous u32 AND/OR-reduce (no gathers,
+measured ~0.2-1.3 ns per dense edge on v5e) plus an edge-centric scan over
+only the residual edges; light levels ride the dopt rung ladder over the
+full adjacency. Golden-differential tests per the reference's own pattern
+(runCpu + checkOutput, bfs.cu:798-815).
+"""
+
+import numpy as np
+import pytest
+
+from tpu_bfs import validate
+from tpu_bfs.algorithms.bfs import BfsEngine
+from tpu_bfs.algorithms.bfs_tiled import TiledBfsEngine, make_tiles_expand
+from tpu_bfs.graph import io as gio
+from tpu_bfs.reference import bfs_scipy
+
+
+def _check(g, eng, sources):
+    for s in sources:
+        res = eng.run(int(s))
+        validate.check_distances(res.distance, bfs_scipy(g, int(s)))
+        validate.check_parents(g, int(s), res.distance, res.parent)
+
+
+def test_tiled_matches_oracle(random_small):
+    eng = TiledBfsEngine(random_small, tile_thr=4)
+    assert eng.num_tiles > 0
+    _check(random_small, eng, [0, 17, 499])
+
+
+def test_tiled_rmat(rmat_small):
+    eng = TiledBfsEngine(rmat_small, tile_thr=4)
+    _check(rmat_small, eng, np.flatnonzero(rmat_small.degrees > 0)[:6])
+
+
+def test_tiled_no_tiles_fallback(random_small):
+    # Budget of zero: every edge residual; the engine degrades to the dopt
+    # ladder + residual scan and must stay correct.
+    eng = TiledBfsEngine(random_small, a_budget_bytes=0)
+    assert eng.num_tiles == 0
+    _check(random_small, eng, [0, 250])
+
+
+def test_tiled_matches_dopt_engine(rmat_small):
+    tiled = TiledBfsEngine(rmat_small, tile_thr=4).run(1)
+    dopt = BfsEngine(rmat_small, backend="dopt").run(1)
+    np.testing.assert_array_equal(tiled.distance, dopt.distance)
+    assert tiled.edges_traversed == dopt.edges_traversed
+    assert tiled.reached == dopt.reached
+
+
+def test_tiled_disconnected_and_isolated(random_disconnected):
+    g = random_disconnected
+    eng = TiledBfsEngine(g, tile_thr=4)
+    _check(g, eng, [0])
+    iso = int(np.flatnonzero(g.degrees == 0)[0])
+    res = eng.run(iso)
+    assert res.reached == 1 and res.distance[iso] == 0
+    assert res.parent[iso] == iso
+
+
+def test_tiled_deep_line(line_graph):
+    res = TiledBfsEngine(line_graph, tile_thr=2).run(0)
+    np.testing.assert_array_equal(res.distance, np.arange(64))
+    assert res.num_levels == 63
+
+
+def test_tiled_max_levels(random_small):
+    res = TiledBfsEngine(random_small, tile_thr=4).run(0, max_levels=1)
+    assert res.num_levels <= 1
+
+
+def test_tiled_rejects_bad_source(random_small):
+    with pytest.raises(ValueError):
+        TiledBfsEngine(random_small).run(10**9)
+
+
+def test_tiles_expand_oracle():
+    # The bitset pass against a brute-force oracle on a handcrafted tile
+    # set (2 row-tiles, 3 tiles, adversarial bit positions).
+    from tpu_bfs.ops.tile_spmm import AW, TILE
+
+    rng = np.random.default_rng(5)
+    vt = 2
+    uniq = np.array([0 * vt + 1, 1 * vt + 0, 1 * vt + 1])  # (rt, ct) pairs
+    a = np.zeros((3, AW, TILE), np.uint32)
+    edges = []  # (tile_idx, r, c)
+    for t in range(3):
+        for _ in range(200):
+            r, c = rng.integers(0, TILE, 2)
+            a[t, r % AW, c] |= np.uint32(1) << np.uint32(r // AW)
+            edges.append((t, int(r), int(c)))
+    fb = rng.random((vt, TILE)) < 0.3
+
+    import jax.numpy as jnp
+
+    fn = make_tiles_expand(vt)
+    got = np.asarray(
+        fn(
+            jnp.asarray(a),
+            jnp.asarray((uniq % vt).astype(np.int32)),
+            jnp.asarray((uniq // vt).astype(np.int32)),
+            jnp.asarray(fb),
+        )
+    )
+    exp = np.zeros(vt * TILE, bool)
+    for t, r, c in edges:
+        rt, ct = uniq[t] // vt, uniq[t] % vt
+        if fb[ct, c]:
+            exp[rt * TILE + r] = True
+    np.testing.assert_array_equal(got, exp)
+
+
+def test_cli_backend_tiled(capsys):
+    from tpu_bfs import cli
+
+    rc = cli.main(["3", "random:n=300,m=1200,seed=5", "--backend", "tiled"])
+    assert rc == 0
+    assert "Output OK" in capsys.readouterr().out
+
+
+def test_cli_tiled_guards():
+    from tpu_bfs import cli
+
+    with pytest.raises(SystemExit):
+        cli.main(["0", "random:n=100,m=300,seed=1", "--backend", "tiled",
+                  "--devices", "2"])
+    with pytest.raises(SystemExit):
+        cli.main(["0", "random:n=100,m=300,seed=1", "--backend", "tiled",
+                  "--ckpt", "/tmp/x.npz"])

@@ -126,8 +126,6 @@ def test_sliced_cross_layout_resume(random_small):
 
 
 def test_sliced_exchange_accounting(random_small):
-    from tpu_bfs.parallel.collectives import sparse_rows_wire_bytes_per_level
-
     p = 8
     eng = DistHybridMsBfsEngine(
         random_small, make_mesh(p), tile_thr=4, exchange="sliced"
@@ -144,9 +142,7 @@ def test_sliced_exchange_accounting(random_small):
 def test_sliced_prebuilt_layout_mismatch_rejected(random_small):
     hd = build_dist_hybrid(random_small, 2, tile_thr=4, layout="sliced")
     with pytest.raises(ValueError, match="layout"):
-        DistHybridMsBfsEngine(random_small, make_mesh(2), exchange="dense").__class__(
-            hd, make_mesh(2), exchange="dense"
-        )
+        DistHybridMsBfsEngine(hd, make_mesh(2), exchange="dense")
 
 
 def test_sliced_parents(random_small):

@@ -1,0 +1,285 @@
+"""Single-stream BFS with the dense-tile bitset expansion (backend='tiled').
+
+The reference's live path is a single-source traversal (queueBfs,
+bfs.cu:134-165). On TPU a single stream cannot batch the random gather
+away (BENCHMARKS.md "Single-stream": ~13 ns per gathered edge regardless
+of fetch width), so the heavy mid-BFS levels were the wall: dopt's best
+was 0.0126 GTEPS at scale 21, with the one giant level costing ~0.9 s.
+
+This engine attacks the dense PART of that level without gathers and
+without the MXU: the hybrid engines' bit-packed 128x128 adjacency tiles
+(2 KB each, ops/tile_spmm.py layout) admit a pure-VPU formulation of
+boolean frontier expansion
+
+    hit_bits[tile] = OR over columns c with frontier[c] of A_tile[:, c]
+
+as u32 AND + OR-reduce over contiguous words — measured ~1.3 ns per dense
+edge on v5e (10x the gather path) because the only indexed access is one
+[TILE]-row lookup per tile. (The Pallas MXU kernel itself is w=128-only:
+Mosaic rejects narrower frontier slabs, measured round 3 — so the narrow-
+batch MXU variant VERDICT r2 #2 proposed is closed off at the compiler,
+and this bitset pass is the working replacement on the same tiles.)
+
+Level structure = direction-optimizing ladder (frontier.level_step_dopt's
+shape): light levels run sparse_topdown over the FULL adjacency; heavy
+levels run the tile bitset pass plus an edge-centric scan over the
+RESIDUAL (non-tiled) edges only. The residual scan still pays the gather
+tax — the measured floor that keeps single-stream short of the batched
+engines; see BENCHMARKS.md for the honest accounting.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from tpu_bfs.algorithms.bfs import BfsResult
+from tpu_bfs.algorithms.frontier import (
+    EdgeData,
+    INT32_MAX,
+    default_dopt_caps,
+    level_step_dopt,
+)
+from tpu_bfs.graph.csr import Graph, INF_DIST, NO_PARENT, _lexsort_pairs
+from tpu_bfs.graph.ell import rank_vertices
+from tpu_bfs.algorithms.msbfs_hybrid import fill_a_tiles, select_dense_tiles
+from tpu_bfs.ops.tile_spmm import AW, TILE
+from tpu_bfs.utils.timing import run_timed
+
+
+def make_tiles_expand(vt: int):
+    """Gather-free boolean expansion over bit-packed dense tiles.
+
+    ``a_tiles`` [NT, AW, TILE] u32 (A[r, c] at word r % AW, bit r // AW),
+    ``col_t`` [NT] column-tile ids, ``seg`` [NT] row-tile ids
+    (non-decreasing), ``fb`` [vt, TILE] bool frontier. Returns [vt*TILE]
+    bool hits. One [TILE]-row lookup per tile is the only indexed access;
+    everything else is contiguous u32 AND / OR-reduce / shift — VPU
+    bandwidth, not gather latency."""
+
+    def tiles_expand(a_tiles, col_t, seg, fb):
+        shifts = jnp.arange(32, dtype=jnp.uint32)
+        fm = fb[col_t]  # [NT, TILE] bool
+        sel = a_tiles & jnp.where(
+            fm[:, None, :], jnp.uint32(0xFFFFFFFF), jnp.uint32(0)
+        )
+        # OR-reduce the 128 columns by tree halving (7 strided ORs — XLA
+        # lowers these better than a rank-3 lax.reduce with a custom
+        # combiner).
+        red = sel
+        while red.shape[-1] > 1:
+            half = red.shape[-1] // 2
+            red = red[..., :half] | red[..., half:]
+        red = red[..., 0]  # [NT, AW]
+        bits = ((red[:, None, :] >> shifts[None, :, None]) & 1).astype(
+            jnp.int32
+        )
+        # Row r of a tile lives at word r % AW, bit r // AW: the [32, AW]
+        # C-order reshape lands index bit*AW + word = r.
+        contrib = bits.reshape(-1, TILE)  # [NT, TILE]
+        hit = jax.ops.segment_sum(
+            contrib, seg, num_segments=vt, indices_are_sorted=True
+        )
+        return (hit > 0).reshape(-1)  # [vt*TILE]
+
+    return tiles_expand
+
+
+class TiledBfsEngine:
+    """Single-source BFS: dopt ladder + dense-tile bitset heavy levels.
+
+    API mirrors BfsEngine (run -> BfsResult). State lives in rank-row
+    space (descending-degree rank, padded to 128-row tiles); distances
+    map back to vertex ids at extraction.
+    """
+
+    def __init__(
+        self,
+        graph: Graph,
+        *,
+        tile_thr: int = 32,
+        a_budget_bytes: int = int(0.8e9),
+        dopt_caps: tuple[int, ...] | None = None,
+    ):
+        # Defaults are the measured scale-21 knee (BENCHMARKS.md): thr=32 /
+        # 0.8 GB reaches 67% dense coverage at hmean 0.030 GTEPS; doubling
+        # the budget again (thr=16 / 2 GB, 73%) is flat — the tile pass
+        # grows with NT as fast as the residual shrinks.
+        g = graph
+        self.host_graph = g  # parent extraction (min_parent_from_dist)
+        self.graph_meta = (g.num_input_edges, g.undirected)
+        self._degrees = g.degrees
+        src, dst = g.coo
+        in_deg, act, order, rank = rank_vertices(src, dst, g.num_vertices)
+        self._rank = rank
+        self._act = act
+        self.num_vertices = g.num_vertices
+        vt = -(-(act + 1) // TILE)
+        rows = vt * TILE
+        self.vt, self.rows = vt, rows
+        r = rank[dst]
+        c = rank[src]
+
+        dense_edge, uniq, tid = select_dense_tiles(
+            r, c, vt, tile_thr=tile_thr, a_budget_bytes=a_budget_bytes
+        )
+        self.num_tiles = len(uniq)
+        self.num_dense_edges = int(dense_edge.sum())
+        a_tiles = fill_a_tiles(dense_edge, uniq, tid, r, c)
+        self._a = jnp.asarray(a_tiles)
+        self._col_t = jnp.asarray((uniq % vt).astype(np.int32))
+        self._seg = jnp.asarray((uniq // vt).astype(np.int32))
+        self._tiles_expand = make_tiles_expand(vt)
+
+        # Full adjacency, src-major: the sparse top-down branches.
+        order_sm = _lexsort_pairs(c, r, rows, rows)
+        out_rp = np.zeros(rows + 1, dtype=np.int32)
+        np.cumsum(np.bincount(c, minlength=rows), out=out_rp[1:])
+        nbr_sm = r[order_sm].astype(np.int32)
+
+        # Residual edges, dst-major: the heavy levels' scan complement.
+        re = np.flatnonzero(~dense_edge)
+        rr, cc = r[re], c[re]
+        order_dm = _lexsort_pairs(rr, cc, rows, rows)
+        res_rp = np.zeros(rows + 1, dtype=np.int32)
+        np.cumsum(np.bincount(rr, minlength=rows), out=res_rp[1:])
+        self._edges = EdgeData(
+            src=jnp.asarray(cc[order_dm].astype(np.int32)),
+            dst=jnp.asarray(rr[order_dm].astype(np.int32)),
+            in_rp=jnp.asarray(res_rp),
+            out_rp=jnp.asarray(out_rp),
+            nbr_sm=jnp.asarray(nbr_sm),
+        )
+        if dopt_caps is None:
+            dopt_caps = default_dopt_caps(g.num_edges)
+        self.dopt_caps = tuple(sorted(set(dopt_caps)))
+        self._loop = self._make_loop()
+        self._warmed = False
+
+    def _make_loop(self):
+        rows, vt = self.rows, self.vt
+        tiles_expand = self._tiles_expand
+        caps = self.dopt_caps
+        has_tiles = self.num_tiles > 0
+
+        def level(edges, tiles, frontier, visited):
+            # The shared dopt rung ladder (frontier.level_step_dopt): sparse
+            # rungs cover ALL edges via the full out-CSR; the dense fallback
+            # is the edge-centric scan over the RESIDUAL in-CSR only (this
+            # engine's edges.src/dst/in_rp hold just the residual edges).
+            hit = level_step_dopt(edges, frontier, visited, caps=caps)
+            if has_tiles:
+                # The tile pass sits in its own single cond, firing exactly
+                # when the dense fallback fires (no rung fits — fits() is
+                # monotone in cap, so testing the TOP rung suffices): its
+                # hits are always valid frontier neighbors, and on rung
+                # levels the rung already found them. Skipping it on light
+                # levels is what makes large tile budgets affordable.
+                out_deg = edges.out_rp[1:] - edges.out_rp[:-1]
+                fsum = jnp.sum(jnp.where(frontier, out_deg, 0))
+                nfront = jnp.sum(frontier.astype(jnp.int32))
+                top = max(caps)
+                dense_level = ~(
+                    (fsum <= top) & (nfront <= min(top, rows))
+                )
+                a, col_t, seg = tiles
+                hit = lax.cond(
+                    dense_level,
+                    lambda: hit
+                    | (
+                        tiles_expand(a, col_t, seg, frontier.reshape(vt, TILE))
+                        & ~visited
+                    ),
+                    lambda: hit,
+                )
+            return hit
+
+        # Edge/tile arrays are jit ARGUMENTS, not closure constants: baked-in
+        # constants get serialized into the compile request (hundreds of MB
+        # here — the remote compile service rejects them outright).
+        @jax.jit
+        def loop(edges, tiles, frontier0, visited0, dist0, max_levels):
+            def cond(state):
+                _, _, _, lvl, count = state
+                return (count > 0) & (lvl < max_levels)
+
+            def body(state):
+                frontier, visited, dist, lvl, _ = state
+                nxt = level(edges, tiles, frontier, visited)
+                dist = jnp.where(nxt, lvl + 1, dist)
+                visited = visited | nxt
+                return nxt, visited, dist, lvl + 1, jnp.sum(nxt.astype(jnp.int32))
+
+            init = jnp.sum(frontier0.astype(jnp.int32))
+            _, _, dist, lvl, _ = lax.while_loop(
+                cond, body, (frontier0, visited0, dist0, jnp.int32(0), init)
+            )
+            return dist, lvl
+
+        return loop
+
+    def run(
+        self,
+        source: int,
+        *,
+        max_levels: int | None = None,
+        with_parents: bool = True,
+        time_it: bool = False,
+    ) -> BfsResult:
+        if not (0 <= source < self.num_vertices):
+            raise ValueError(f"source {source} out of range")
+        rs = int(self._rank[source])
+        dist_v = np.full(self.num_vertices, INF_DIST, np.int32)
+        dist_v[source] = 0
+        if rs >= self._act:  # isolated source: component == {source}
+            parent = None
+            if with_parents:
+                parent = np.full(self.num_vertices, NO_PARENT, np.int32)
+                parent[source] = source
+            return BfsResult(
+                source=source, distance=dist_v, parent=parent, num_levels=0,
+                reached=1, edges_traversed=0, elapsed_s=None,
+            )
+
+        def go():
+            f0 = jnp.zeros((self.rows,), jnp.bool_).at[rs].set(True)
+            d0 = jnp.full((self.rows,), INT32_MAX, jnp.int32).at[rs].set(0)
+            ml = jnp.int32(max_levels if max_levels is not None else self.rows)
+            return self._loop(
+                self._edges, (self._a, self._col_t, self._seg), f0, f0, d0, ml
+            )
+
+        elapsed = None
+        if time_it:
+            (dist_dev, _), elapsed = run_timed(go, warm=not self._warmed)
+            self._warmed = True
+        else:
+            dist_dev, _ = go()
+
+        dr = np.asarray(dist_dev)
+        live = self._rank < self._act
+        dist_v[live] = dr[self._rank[live]]
+        dist_v = np.where(dist_v == INT32_MAX, INF_DIST, dist_v)
+        reached_mask = dist_v != INF_DIST
+        reached = int(reached_mask.sum())
+        num_levels = int(dist_v[reached_mask].max()) if reached else 0
+        _, undirected = self.graph_meta
+        slots = int(self._degrees[reached_mask].sum()) if reached else 0
+        parent = None
+        if with_parents:
+            # One O(E) host scatter-min (outside the timed loop), the same
+            # deterministic tree every engine emits.
+            from tpu_bfs import validate
+
+            parent = validate.min_parent_from_dist(self.host_graph, source, dist_v)
+        return BfsResult(
+            source=source,
+            distance=dist_v,
+            parent=parent,
+            num_levels=num_levels,
+            reached=reached,
+            edges_traversed=slots // 2 if undirected else slots,
+            elapsed_s=elapsed,
+        )

@@ -42,55 +42,16 @@ def log(msg: str) -> None:
 # occurrence, always).
 # ---------------------------------------------------------------------------
 
-# Substrings that mark an error as plausibly-transient infrastructure
-# trouble: compile-service/transport failures and XLA's INTERNAL/UNAVAILABLE
-# status codes. Bare "INTERNAL:" is included because infra errors don't
-# always name their transport — the deny-list below catches the known
-# deterministic INTERNAL shapes (Mosaic lowering bugs) so those surface on
-# the first attempt.
-_TRANSIENT_PATTERNS = (
-    "remote_compile",
-    "read body closed",
-    "Socket closed",
-    "Connection reset",
-    "Broken pipe",
-    "INTERNAL:",
-    "UNAVAILABLE:",
-    "DEADLINE_EXCEEDED:",
-)
-
-# Deterministic failures that can carry an INTERNAL: status but are bugs,
-# not infra blips — retrying them burns minutes (3 inner + 2 outer engine
-# builds) before surfacing the real error. OOM and shape/lowering errors
-# are never transient.
-_NON_TRANSIENT_MARKERS = (
-    "Mosaic",
-    "RESOURCE_EXHAUSTED",
-    "out of memory",
-    "Invalid argument",
-)
-
-# Exception type names eligible for retry. Matched by name so the check
-# works without importing jax at module import time (load_graph defers jax
-# imports deliberately). Validation failures (AssertionError, ValueError
-# from check_distances) are structurally excluded by this list.
-_TRANSIENT_TYPE_NAMES = (
-    "JaxRuntimeError",
-    "XlaRuntimeError",
-    "InternalError",
-    "UnavailableError",
-    "DeadlineExceededError",
-)
-
-
 def _is_transient(exc: BaseException) -> bool:
-    names = {t.__name__ for t in type(exc).__mro__}
-    if not names.intersection(_TRANSIENT_TYPE_NAMES):
-        return False
-    msg = str(exc)
-    if any(p in msg for p in _NON_TRANSIENT_MARKERS):
-        return False
-    return any(p in msg for p in _TRANSIENT_PATTERNS)
+    # The transient/deterministic classifier is shared with the in-run
+    # failure-recovery machinery (tpu_bfs/utils/recovery.py) — one
+    # definition of "worth retrying" for both the bench and checkpointed
+    # traversals. Imported lazily: importing tpu_bfs pulls in jax, and
+    # bench.py must stay importable (e.g. for cache regeneration) on hosts
+    # where the accelerator stack is broken.
+    from tpu_bfs.utils.recovery import is_transient_failure
+
+    return is_transient_failure(exc)
 
 
 def retry_transient(fn, *args, attempts: int = 3, backoff_s: float = 5.0,

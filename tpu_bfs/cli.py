@@ -177,27 +177,27 @@ def _run_multi_source(args, g, golden) -> int:
     if args.ckpt or args.resume:
         # Chunked batch traversal with durable packed state
         # (tpu_bfs/utils/checkpoint.py::PackedCheckpoint): resume continues
-        # bit-identically to an uninterrupted batch run.
+        # bit-identically to an uninterrupted batch run, and transient
+        # device/compile failures mid-run rebuild the engine and resume
+        # from the last chunk (utils/recovery.py).
         from tpu_bfs.utils import checkpoint as ck
+        from tpu_bfs.utils.recovery import advance_with_recovery
 
         st = resume_st if resume_st is not None else engine.start(sources)
-        cap = args.max_levels if args.max_levels is not None else float("inf")
+        save = None
+        if args.ckpt:
+            def save(c):
+                ck.save_packed_checkpoint(args.ckpt, c)
+                print(f"checkpoint @ level {c.level} -> {args.ckpt}")
         try:
-            if not args.ckpt:
-                # Pure resume: run the remainder in one device pass — the
-                # per-chunk host<->device state roundtrips only pay off when
-                # a checkpoint is actually written between chunks.
-                if not st.done and st.level < cap:
-                    st = engine.advance(
-                        st,
-                        None if cap == float("inf") else int(cap) - st.level,
-                    )
-            while args.ckpt and not st.done and st.level < cap:
-                chunk = max(1, args.ckpt_every)
-                st = engine.advance(st, levels=min(chunk, int(cap) - st.level)
-                                    if cap != float("inf") else chunk)
-                ck.save_packed_checkpoint(args.ckpt, st)
-                print(f"checkpoint @ level {st.level} -> {args.ckpt}")
+            engine, st, _ = advance_with_recovery(
+                lambda: _make_ms_engine(args, g, len(sources)), st,
+                engine=engine,
+                levels_per_chunk=max(1, args.ckpt_every) if args.ckpt else None,
+                max_level=args.max_levels,
+                save=save,
+                log=lambda m: print(f"[recovery] {m}"),
+            )
         except RuntimeError as exc:
             if "truncated" not in str(exc):
                 raise
@@ -395,52 +395,55 @@ def main(argv=None) -> int:
     if args.multi_source:
         return _run_multi_source(args, g, golden)
 
-    if args.mesh:
-        from tpu_bfs.parallel.dist_bfs2d import Dist2DBfsEngine, make_mesh_2d
+    def make_engine():
+        if args.mesh:
+            from tpu_bfs.parallel.dist_bfs2d import Dist2DBfsEngine, make_mesh_2d
 
-        try:
-            r, c = (int(t) for t in args.mesh.lower().split("x"))
-        except ValueError:
-            ap.error(f"--mesh must look like RxC (e.g. 2x4), got {args.mesh!r}")
-        engine = Dist2DBfsEngine(
-            g,
-            make_mesh_2d(r, c),
-            exchange=args.exchange,
-            backend=args.backend,
-        )
-    elif args.devices > 1:
-        from tpu_bfs.parallel.dist_bfs import DistBfsEngine, make_mesh
+            try:
+                r, c = (int(t) for t in args.mesh.lower().split("x"))
+            except ValueError:
+                ap.error(f"--mesh must look like RxC (e.g. 2x4), got {args.mesh!r}")
+            return Dist2DBfsEngine(
+                g, make_mesh_2d(r, c), exchange=args.exchange,
+                backend=args.backend,
+            )
+        if args.devices > 1:
+            from tpu_bfs.parallel.dist_bfs import DistBfsEngine, make_mesh
 
-        engine = DistBfsEngine(
-            g, make_mesh(args.devices), exchange=args.exchange, backend=args.backend
-        )
-    elif args.backend == "tiled":
-        from tpu_bfs.algorithms.bfs_tiled import TiledBfsEngine
+            return DistBfsEngine(
+                g, make_mesh(args.devices), exchange=args.exchange,
+                backend=args.backend,
+            )
+        if args.backend == "tiled":
+            from tpu_bfs.algorithms.bfs_tiled import TiledBfsEngine
 
-        engine = TiledBfsEngine(g)
-    else:
-        engine = BfsEngine(g, backend=args.backend)
+            return TiledBfsEngine(g)
+        return BfsEngine(g, backend=args.backend)
+
+    engine = make_engine()
 
     if args.ckpt or args.resume:
         # Chunked traversal with durable state (tpu_bfs/utils/checkpoint.py):
-        # resume continues bit-identically to an uninterrupted run.
+        # resume continues bit-identically to an uninterrupted run, and a
+        # transient device/compile failure mid-run rebuilds the engine and
+        # resumes from the last chunk (utils/recovery.py — the reference's
+        # failed rank instead hangs the MPI_Allreduce, bfs_mpi.cu:621).
         from tpu_bfs.utils import checkpoint as ck
+        from tpu_bfs.utils.recovery import advance_with_recovery
 
         st = resume_st if resume_st is not None else engine.start(args.source)
-        cap = args.max_levels if args.max_levels is not None else float("inf")
-        if not args.ckpt and not st.done and st.level < cap:
-            # Pure resume: one device pass — chunking only pays off when a
-            # checkpoint is actually written between chunks.
-            st = engine.advance(
-                st, None if cap == float("inf") else int(cap) - st.level
-            )
-        while args.ckpt and not st.done and st.level < cap:
-            chunk = max(1, args.ckpt_every)
-            if cap != float("inf"):
-                chunk = min(chunk, int(cap) - st.level)
-            st = engine.advance(st, levels=chunk)
-            ck.save_checkpoint(args.ckpt, st)
-            print(f"checkpointed at level {st.level}")
+        save = None
+        if args.ckpt:
+            def save(c):
+                ck.save_checkpoint(args.ckpt, c)
+                print(f"checkpointed at level {c.level}")
+        engine, st, _ = advance_with_recovery(
+            make_engine, st, engine=engine,
+            levels_per_chunk=max(1, args.ckpt_every) if args.ckpt else None,
+            max_level=args.max_levels,
+            save=save,
+            log=lambda m: print(f"[recovery] {m}"),
+        )
         res = engine.finish(st, with_parents=not args.no_parents)
     else:
         res = None

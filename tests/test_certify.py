@@ -1,0 +1,121 @@
+"""Oracle-free BFS certification (validate.certify_bfs / check_edge_levels).
+
+The Graph500 validation design: certify kernel output by properties
+(parent chains prove dist >= true; edge-level relaxation proves
+dist <= true) so no sequential golden run is needed — the reference can
+only validate graphs small enough to rerun on the CPU (bfs.cu:798-815).
+These tests prove the certificate accepts every engine's real output and
+REJECTS each class of forged output it is supposed to catch.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_bfs import validate
+from tpu_bfs.graph.csr import INF_DIST
+from tpu_bfs.reference import bfs_scipy
+
+
+def _certified(g, source, dist):
+    parent = validate.min_parent_from_dist(g, source, dist)
+    validate.certify_bfs(g, source, dist, parent)
+    return parent
+
+
+def test_certifies_real_outputs(random_small, random_disconnected, rmat_small):
+    from tpu_bfs.algorithms.bfs import BfsEngine
+
+    for g in (random_small, random_disconnected, rmat_small):
+        res = BfsEngine(g).run(0)
+        validate.certify_bfs(g, 0, res.distance, res.parent)
+
+
+def test_certify_equals_oracle_semantics(random_small):
+    # Anything the certificate accepts must BE the BFS distances: perturb
+    # nothing, assert certificate passes exactly on the oracle's answer.
+    d = bfs_scipy(random_small, 17)
+    _certified(random_small, 17, d)
+
+
+def test_rejects_skipped_level(random_small):
+    # dist too LARGE somewhere (claims a vertex is farther than it is):
+    # some edge then skips a level.
+    d = bfs_scipy(random_small, 17).copy()
+    v = int(np.flatnonzero(d == 2)[0])
+    d[v] = 5
+    with pytest.raises(validate.ValidationError):
+        _certified(random_small, 17, d)
+
+
+def test_rejects_too_small_distance(random_small):
+    # dist too SMALL somewhere (claims a shortcut that does not exist):
+    # the vertex's min-parent candidates sit at the wrong level, so the
+    # parent-chain check fails.
+    d = bfs_scipy(random_small, 17).copy()
+    v = int(np.flatnonzero(d == 3)[0])
+    d[v] = 1
+    with pytest.raises(validate.ValidationError):
+        _certified(random_small, 17, d)
+
+
+def test_rejects_unreached_neighbor_of_reached(random_small):
+    # Mark a genuinely-reached vertex unreached: one of its reached
+    # neighbors now has an INF out-neighbor -> level check fires.
+    d = bfs_scipy(random_small, 17).copy()
+    v = int(np.flatnonzero(d == 2)[0])
+    d[v] = INF_DIST
+    with pytest.raises(validate.ValidationError):
+        _certified(random_small, 17, d)
+
+
+def test_rejects_phantom_component(random_disconnected):
+    # Label an unreachable vertex as reached: its parent chain cannot
+    # anchor at the source.
+    g = random_disconnected
+    d = bfs_scipy(g, 0).copy()
+    others = np.flatnonzero((d == INF_DIST) & (g.degrees > 0))
+    assert len(others)
+    d[others[0]] = 1
+    with pytest.raises(validate.ValidationError):
+        _certified(g, 0, d)
+
+
+def test_rejects_forged_parent_edge(random_small):
+    # Correct distances but a parent edge that is not in the graph.
+    d = bfs_scipy(random_small, 17)
+    p = validate.min_parent_from_dist(random_small, 17, d)
+    v = int(np.flatnonzero(d == 2)[0])
+    # Find a non-neighbor at level 1 to forge as parent.
+    src, dst = random_small.coo
+    nbrs = set(src[dst == v].tolist())
+    forged = next(
+        int(u) for u in np.flatnonzero(d == 1) if int(u) not in nbrs
+    )
+    p = p.copy()
+    p[v] = forged
+    with pytest.raises(validate.ValidationError):
+        validate.certify_bfs(random_small, 17, d, p)
+
+
+def test_graph500_certify_mode():
+    # The oracle-free path is selectable end-to-end: no SciPy rerun at all.
+    from unittest import mock
+
+    from tpu_bfs import graph500
+
+    # run_graph500 imports the oracle lazily from tpu_bfs.reference; patch
+    # it there to prove certify mode never touches it.
+    with mock.patch(
+        "tpu_bfs.reference.bfs_scipy", side_effect=AssertionError("oracle ran")
+    ):
+        res = graph500.run_graph500(
+            8, 8, num_searches=4, mode="single", validate_searches=2,
+            validate_mode="certify",
+        )
+    assert res.validated
+
+
+def test_certificate_is_diameter_independent(line_graph):
+    # Deep graph: two O(E) passes, no per-level work.
+    d = bfs_scipy(line_graph, 0)
+    _certified(line_graph, 0, d)

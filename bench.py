@@ -490,6 +490,7 @@ def main() -> int:
         "single-tiled": partial(bench_single, backend="tiled"),
         "lj-hybrid": partial(bench_hybrid, graph_desc=lj_desc),
         "lj-single-dopt": partial(bench_single, backend="dopt", graph_desc=lj_desc),
+        "lj-single-tiled": partial(bench_single, backend="tiled", graph_desc=lj_desc),
     }[mode]
     # Outer safety net: if a transient error escapes the per-stage retries
     # (e.g. fired while materializing results between stages), one full

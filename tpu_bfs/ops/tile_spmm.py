@@ -157,8 +157,8 @@ def tile_spmm(
         num_scalar_prefetch=2,
         grid=(num_row_tiles,),
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec(
             (TILE, w), lambda j, *_: (j, 0), memory_space=pltpu.VMEM

@@ -115,6 +115,34 @@ def test_graph500_certify_mode():
     assert res.validated
 
 
+def test_cli_certify_flag(capsys):
+    from unittest import mock
+
+    from tpu_bfs import cli
+
+    # --certify must validate without EVER running the CPU golden oracle.
+    with mock.patch(
+        "tpu_bfs.reference.bfs_golden", side_effect=AssertionError("oracle ran")
+    ):
+        rc = cli.main(["3", "random:n=300,m=1200,seed=5", "--certify"])
+    assert rc == 0
+    assert "Output certified (oracle-free)" in capsys.readouterr().out
+
+
+def test_cli_certify_multi_source(capsys):
+    from unittest import mock
+
+    from tpu_bfs import cli
+
+    with mock.patch(
+        "tpu_bfs.reference.bfs_golden", side_effect=AssertionError("oracle ran")
+    ):
+        rc = cli.main(["3", "random:n=300,m=1200,seed=5", "--certify",
+                       "--multi-source", "9,17", "--engine", "wide"])
+    assert rc == 0
+    assert "Output certified (oracle-free, lane 0 of 3)" in capsys.readouterr().out
+
+
 def test_certificate_is_diameter_independent(line_graph):
     # Deep graph: two O(E) passes, no per-level work.
     d = bfs_scipy(line_graph, 0)

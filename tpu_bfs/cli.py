@@ -234,7 +234,15 @@ def _run_multi_source(args, g, golden) -> int:
     if args.stats:
         for line in level_stats(res.distances_int32(0), g.degrees).json_lines():
             print(line)
-    if golden is not None:
+    if args.certify:
+        # Oracle-free certificate for the primary lane (see the
+        # single-source path); no CPU golden run at any scale. The message
+        # is qualified: like the golden path, only lane 0 is checked.
+        validate.certify_bfs(
+            g, int(sources[0]), res.distances_int32(0), res.parents_int32(0)
+        )
+        print(f"Output certified (oracle-free, lane 0 of {len(sources)})")
+    elif golden is not None:
         validate.check_distances(res.distances_int32(0), golden)
         if not args.no_parents:
             # Also validate the engine-emitted BFS tree for the primary
@@ -294,6 +302,11 @@ def main(argv=None) -> int:
     ap.add_argument("--max-levels", type=int, default=None)
     ap.add_argument("--skip-cpu", action="store_true",
                     help="skip the CPU golden run + validation (reference always validates, bfs.cu:798-815)")
+    ap.add_argument("--certify", action="store_true",
+                    help="validate with the oracle-free BFS certificate "
+                    "(two O(E) host passes, validate.certify_bfs) instead "
+                    "of the CPU golden rerun — feasible at scales where "
+                    "the sequential run is not; implies --skip-cpu")
     ap.add_argument("--no-parents", action="store_true")
     ap.add_argument("--stats", action="store_true", help="print per-level JSON stats")
     ap.add_argument("--repeat", type=int, default=1, help="timed repetitions")
@@ -382,6 +395,8 @@ def main(argv=None) -> int:
     golden = None
     # A resumed multi-source batch learns its sources from the packed
     # checkpoint; _run_multi_source computes the golden itself.
+    if args.certify:
+        args.skip_cpu = True  # the certificate replaces the golden rerun
     if not args.skip_cpu and not (args.multi_source and args.resume):
         from tpu_bfs.reference import bfs_golden
 
@@ -467,7 +482,19 @@ def main(argv=None) -> int:
         for line in level_stats(res.distance, g.degrees).json_lines():
             print(line)
 
-    if golden is not None:
+    if args.certify:
+        # Oracle-free certificate: parent chains + edge-level property
+        # prove the distances exactly (validate.certify_bfs) with two
+        # O(E) passes — no sequential rerun, so it works at scales the
+        # reference's self-validation (bfs.cu:798-815) can never reach.
+        parent = (
+            res.parent
+            if res.parent is not None
+            else validate.min_parent_from_dist(g, res.source, res.distance)
+        )
+        validate.certify_bfs(g, res.source, res.distance, parent)
+        print("Output certified (oracle-free)")
+    elif golden is not None:
         # checkOutput analog (bfs.cu:374-384) — but also validates parents,
         # which the reference never does.
         validate.check_distances(res.distance, golden)

@@ -14,7 +14,6 @@ import pytest
 from tpu_bfs import validate
 from tpu_bfs.algorithms.bfs import BfsEngine
 from tpu_bfs.algorithms.bfs_tiled import TiledBfsEngine, make_tiles_expand
-from tpu_bfs.graph import io as gio
 from tpu_bfs.reference import bfs_scipy
 
 

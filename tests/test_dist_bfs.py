@@ -8,13 +8,12 @@ merge — against the CPU golden oracle and the single-device engine.
 import numpy as np
 import pytest
 
-import jax
 
 from tpu_bfs import validate
 from tpu_bfs.algorithms.bfs import BfsEngine
 from tpu_bfs.graph.csr import INF_DIST
 from tpu_bfs.parallel.dist_bfs import DistBfsEngine, make_mesh
-from tpu_bfs.parallel.partition import Partition1D, partition_1d
+from tpu_bfs.parallel.partition import partition_1d
 from tpu_bfs.reference import bfs_python
 
 MESH_SIZES = [1, 2, 4, 8]

@@ -45,7 +45,7 @@ from tpu_bfs.algorithms.frontier import (
 from tpu_bfs.graph.csr import Graph, INF_DIST, NO_PARENT, _lexsort_pairs
 from tpu_bfs.graph.ell import rank_vertices
 from tpu_bfs.algorithms.msbfs_hybrid import fill_a_tiles, select_dense_tiles
-from tpu_bfs.ops.tile_spmm import AW, TILE
+from tpu_bfs.ops.tile_spmm import TILE
 from tpu_bfs.utils.timing import run_timed
 
 

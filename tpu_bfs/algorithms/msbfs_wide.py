@@ -33,8 +33,6 @@ Lane convention: word-major — lane ``l`` at word ``l // 32``, bit ``l % 32``.
 
 from __future__ import annotations
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 from tpu_bfs.graph.csr import Graph

@@ -19,7 +19,6 @@ Usage:
 from __future__ import annotations
 
 import argparse
-import json
 import sys
 import time
 
@@ -365,7 +364,6 @@ def main(argv=None) -> int:
 
     from tpu_bfs import validate
     from tpu_bfs.algorithms.bfs import BfsEngine
-    from tpu_bfs.graph.csr import INF_DIST
 
     t0 = time.perf_counter()
     g = load_graph(args.graph)

@@ -15,7 +15,6 @@ Reproduces the reference's two loaders with stricter parsing:
 
 from __future__ import annotations
 
-import io as _io
 import sys
 
 import numpy as np

@@ -10,7 +10,6 @@ directly), and harmonic-mean TEPS reporting.
 from __future__ import annotations
 
 import dataclasses
-import time
 
 import numpy as np
 

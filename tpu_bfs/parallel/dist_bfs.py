@@ -22,7 +22,6 @@ four times per level (SURVEY.md §3.1).
 
 from __future__ import annotations
 
-import dataclasses
 from functools import partial
 
 import jax
@@ -50,7 +49,7 @@ from tpu_bfs.parallel.collectives import (
     sparse_exchange_or,
     sparse_wire_bytes_per_level,
 )
-from tpu_bfs.parallel.partition import Partition1D, out_csr_1d, partition_1d
+from tpu_bfs.parallel.partition import out_csr_1d, partition_1d
 from tpu_bfs.utils.timing import run_timed
 
 

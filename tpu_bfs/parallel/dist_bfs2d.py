@@ -40,7 +40,7 @@ from tpu_bfs.parallel.collectives import (
     reduce_scatter_or,
 )
 from tpu_bfs.parallel.dist_bfs import VertexCheckpointMixin
-from tpu_bfs.parallel.partition2d import Partition2D, out_csr_2d, partition_2d
+from tpu_bfs.parallel.partition2d import out_csr_2d, partition_2d
 from tpu_bfs.utils.timing import run_timed
 
 

@@ -12,8 +12,9 @@ Env overrides: TPU_BFS_BENCH_SCALE (default 21), TPU_BFS_BENCH_EF (16),
 TPU_BFS_BENCH_MODE (hybrid|wide|msbfs|single|single-dopt|single-tiled|
 lj-hybrid|lj-single-dopt — the lj-* modes bench the LiveJournal-shaped
 stand-in, NONETWORK.md),
-TPU_BFS_BENCH_LANES (msbfs mode, 512), TPU_BFS_BENCH_SOURCES (single modes,
-8), TPU_BFS_BENCH_VALIDATE (1), TPU_BFS_BENCH_VALIDATE_LANES (4),
+TPU_BFS_BENCH_LANES (msbfs mode, 512), TPU_BFS_BENCH_MAX_LANES (hybrid/wide
+modes, 4096 — set 8192 to sweep w=256 rows), TPU_BFS_BENCH_SOURCES (single
+modes, 8), TPU_BFS_BENCH_VALIDATE (1), TPU_BFS_BENCH_VALIDATE_LANES (4),
 TPU_BFS_BENCH_CACHE (.bench_cache).
 """
 
@@ -73,6 +74,26 @@ def retry_transient(fn, *args, attempts: int = 3, backoff_s: float = 5.0,
                 f"{str(exc)[:300]} -- retrying in {wait:.0f}s"
             )
             time.sleep(wait)
+
+
+def _env_max_lanes(*, default: int) -> int:
+    """TPU_BFS_BENCH_MAX_LANES, clamped into the engines' legal range so a
+    typo'd env var degrades to a logged clamp instead of crashing the bench
+    after a minutes-long engine build (the constructors also validate
+    early, but the bench's job is to always emit its one JSON line)."""
+    from tpu_bfs.algorithms.msbfs_wide import MAX_LANES
+
+    val = os.environ.get("TPU_BFS_BENCH_MAX_LANES", str(default))
+    try:
+        raw = int(val)
+    except ValueError:
+        log(f"TPU_BFS_BENCH_MAX_LANES={val!r} is not an integer; "
+            f"using {default}")
+        return default
+    clamped = min(max(raw - raw % 32, 32), MAX_LANES)
+    if clamped != raw:
+        log(f"TPU_BFS_BENCH_MAX_LANES={raw} out of range; clamped to {clamped}")
+    return clamped
 
 
 def load_graph(scale: int, ef: int):
@@ -298,8 +319,9 @@ def bench_hybrid(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
     """Flagship: 4096-lane hybrid MXU+gather MS-BFS (msbfs_hybrid.py).
 
     Falls back to the gather-only wide engine when the graph's packed state
-    cannot fit 4096 lanes next to the dense tiles (the Pallas kernel only
-    exists at w=128)."""
+    cannot fit 4096 lanes next to the dense tiles (the Pallas kernel needs
+    w % 128 == 0, so 4096 lanes is its minimum width; wider multiples are
+    the TPU_BFS_BENCH_MAX_LANES sweep)."""
     from tpu_bfs.algorithms._packed_common import auto_lanes, auto_planes
     from tpu_bfs.algorithms.msbfs_hybrid import (
         LANES,
@@ -327,8 +349,15 @@ def bench_hybrid(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
         return bench_wide(g, scale, ef, graph_desc)
 
     t0 = time.perf_counter()
+    # TPU_BFS_BENCH_MAX_LANES (default 4096): opt-in width sweep. The
+    # engines accept wider rows (w=256 -> 8192 lanes, msbfs_hybrid.MAX_LANES
+    # cap) but auto sizing may still settle at 4096 when the wider state
+    # does not fit next to the tiles; whatever width is chosen appears in
+    # the metric label via engine.lanes.
+    max_lanes = _env_max_lanes(default=LANES)
     try:
-        engine = retry_transient(HybridMsBfsEngine, g, label="hybrid engine build")
+        engine = retry_transient(HybridMsBfsEngine, g, max_lanes=max_lanes,
+                                 label="hybrid engine build")
     except LanesDontFitError as exc:
         log(f"hybrid unavailable ({exc}); falling back to wide engine")
         return bench_wide(g, scale, ef, graph_desc)
@@ -344,10 +373,15 @@ def bench_hybrid(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
 
 def bench_wide(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
     """4096-lane wide packed MS-BFS, gather-only (msbfs_wide.py)."""
-    from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
+    from tpu_bfs.algorithms.msbfs_wide import (
+        LANES as WIDE_LANES,
+        WidePackedMsBfsEngine,
+    )
 
     t0 = time.perf_counter()
-    engine = retry_transient(WidePackedMsBfsEngine, g, label="wide engine build")
+    max_lanes = _env_max_lanes(default=WIDE_LANES)
+    engine = retry_transient(WidePackedMsBfsEngine, g, max_lanes=max_lanes,
+                             label="wide engine build")
     ell = engine.ell
     return _bench_batch_4096(
         g, graph_desc or f"RMAT scale-{scale} ef={ef}", engine, ell.in_degree,

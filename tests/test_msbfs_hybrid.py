@@ -146,3 +146,22 @@ def test_hybrid_rejects_bad_input(random_small):
         engine.run(np.array([-1]))
     with pytest.raises(ValueError):
         engine.run(np.arange(LANES + 1))
+
+
+def test_hybrid_w256_dense_tiles(random_small):
+    # w=256 (8192 lanes) through the FULL hybrid path: the Pallas kernel's
+    # block shapes, unpack/pack, and the residual OR-merge are all
+    # width-parametric; Mosaic only requires w % 128 == 0, which 256
+    # satisfies. Interpret mode on CPU; the compiled kernel at w=256 is
+    # covered by the on-hardware bench cross-check when that width is
+    # benched (TPU_BFS_BENCH_MAX_LANES).
+    engine = HybridMsBfsEngine(random_small, tile_thr=1, lanes=8192)
+    assert engine.w == 256 and engine.hg.num_tiles > 0
+    rng = np.random.default_rng(3)
+    sources = rng.integers(0, random_small.num_vertices, size=8192)
+    res = engine.run(sources)
+    for i in [0, 4095, 4100, 8191]:
+        golden, _ = bfs_python(random_small, int(sources[i]))
+        np.testing.assert_array_equal(
+            res.distances_int32(i), golden, err_msg=f"lane {i}"
+        )

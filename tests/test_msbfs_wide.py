@@ -148,3 +148,25 @@ def test_wide_rejects_bad_input(random_small):
     with pytest.raises(ValueError):
         WidePackedMsBfsEngine(random_small, num_planes=0)
     assert LANES == 32 * W == 4096
+
+
+def test_wide_w256_lanes_past_4096(random_small):
+    # Width-generalized rows (w=256 -> 8192 lanes): the shared machinery in
+    # _packed_common is width-generic; lanes seeded past the 4096 default
+    # (word columns 128..255) must label identically to the oracle. Opt-in
+    # only — default "auto" sizing stays at 4096 until the wider gather is
+    # measured on hardware (bench.py TPU_BFS_BENCH_MAX_LANES).
+    from tpu_bfs.algorithms.msbfs_wide import MAX_LANES
+
+    rng = np.random.default_rng(3)
+    sources = rng.integers(0, random_small.num_vertices, size=8192)
+    engine = WidePackedMsBfsEngine(random_small, lanes=8192)
+    assert engine.w == 256 and engine.lanes == 8192 <= MAX_LANES
+    res = engine.run(sources)
+    for i in [0, 31, 4095, 4096, 6000, 8191]:
+        golden, _ = bfs_python(random_small, int(sources[i]))
+        np.testing.assert_array_equal(
+            res.distances_int32(i), golden, err_msg=f"lane {i}"
+        )
+    with pytest.raises(ValueError):
+        WidePackedMsBfsEngine(random_small, lanes=MAX_LANES + 32)

@@ -63,6 +63,12 @@ from tpu_bfs.ops.tile_spmm import AW, TILE, tile_spmm
 
 W = 128
 LANES = 32 * W
+# The dense kernel needs w to be a MULTIPLE of 128 (Mosaic: the frontier
+# slab's minor dim must be 128-aligned), so wider batches come in steps of
+# 4096 lanes up to MAX_LANES. Default sizing stays at LANES — wider rows
+# double state HBM per step and the gather amortization must be measured
+# (bench.py TPU_BFS_BENCH_MAX_LANES), not assumed.
+MAX_LANES = 4 * LANES
 
 
 class LanesDontFitError(ValueError):
@@ -312,8 +318,10 @@ def _make_core(hg: HybridGraph, w: int, num_planes: int, interpret: bool):
 
 
 class HybridMsBfsEngine:
-    """Up to 4096 concurrent BFS sources; dense tiles on the MXU, residual on
-    gathers. API mirrors WidePackedMsBfsEngine; results are PackedBatchResult."""
+    """Up to 4096 concurrent BFS sources by default (``max_lanes`` raises
+    the cap in 4096-lane steps to MAX_LANES); dense tiles on the MXU,
+    residual on gathers. API mirrors WidePackedMsBfsEngine; results are
+    PackedBatchResult."""
 
     def __init__(
         self,
@@ -327,10 +335,18 @@ class HybridMsBfsEngine:
         interpret: bool | None = None,
         undirected: bool | None = None,
         hbm_budget_bytes: int = int(14.0e9),
+        max_lanes: int = LANES,
     ):
         if num_planes != "auto" and not (1 <= num_planes <= 8):
             # Validate the explicit case before the minutes-long build.
             raise ValueError("num_planes must be in [1, 8]")
+        if max_lanes % 32 or not (32 <= max_lanes <= MAX_LANES):
+            # Same early-validation rule: a bad width cap must fail in
+            # seconds, not after the build (and auto_lanes would otherwise
+            # happily return an out-of-range width).
+            raise ValueError(
+                f"max_lanes must be a multiple of 32 in [32, {MAX_LANES}]"
+            )
         if interpret is None:
             interpret = jax.default_backend() != "tpu"
         self.hg = (
@@ -357,7 +373,7 @@ class HybridMsBfsEngine:
                 hg.vt * TILE,
                 fixed_bytes=fixed_bytes,
                 hbm_budget_bytes=hbm_budget_bytes,
-                max_lanes=LANES,
+                max_lanes=max_lanes,
             )
         if not (1 <= num_planes <= 8):
             raise ValueError("num_planes must be in [1, 8]")
@@ -369,18 +385,21 @@ class HybridMsBfsEngine:
                 num_planes,
                 fixed_bytes=fixed_bytes,
                 hbm_budget_bytes=hbm_budget_bytes,
-                max_lanes=LANES,
+                max_lanes=max_lanes,
             )
-        if lanes % 32 or not (32 <= lanes <= LANES):
-            raise ValueError(f"lanes must be a multiple of 32 in [32, {LANES}]")
-        if lanes != LANES and not interpret and hg.num_tiles:
+        if lanes % 32 or not (32 <= lanes <= MAX_LANES):
+            raise ValueError(
+                f"lanes must be a multiple of 32 in [32, {MAX_LANES}]"
+            )
+        if lanes % LANES and not interpret and hg.num_tiles:
             # Mosaic requires the frontier-slab DMA's minor dimension to be
-            # 128-aligned, so the dense kernel only exists at w=128.
+            # 128-aligned, so the dense kernel exists only at w multiples
+            # of 128 (4096-lane steps).
             raise LanesDontFitError(
-                f"hybrid dense kernel requires {LANES} lanes (w=128); the "
-                f"packed state for this graph only fits {lanes} lanes — use "
-                "WidePackedMsBfsEngine (gather-only, any width) or shard "
-                "over more chips (DistWideMsBfsEngine)"
+                f"hybrid dense kernel requires a multiple of {LANES} lanes "
+                f"(w % 128 == 0); the packed state for this graph only fits "
+                f"{lanes} lanes — use WidePackedMsBfsEngine (gather-only, "
+                "any width) or shard over more chips (DistWideMsBfsEngine)"
             )
         self.w = lanes // 32
         self.lanes = lanes

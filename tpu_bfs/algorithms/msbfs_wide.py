@@ -53,6 +53,12 @@ from tpu_bfs.algorithms._packed_common import (
 
 W = 128  # uint32 words per row: the measured v5e sweet spot (no tile padding)
 LANES = 32 * W
+# Wider rows are legal (any multiple of 32 lanes up to MAX_LANES; the shared
+# machinery in _packed_common is width-generic) but default "auto" sizing
+# stays at LANES: beyond w=128 the per-index gather cost is no longer
+# amortized for free — measure before adopting (bench.py
+# TPU_BFS_BENCH_MAX_LANES sweeps it on real hardware).
+MAX_LANES = 4 * LANES
 
 # Re-exported for callers that consumed these from here before the
 # _packed_common refactor.
@@ -96,9 +102,15 @@ class WidePackedMsBfsEngine:
         num_planes: int = 5,
         undirected: bool | None = None,
         hbm_budget_bytes: int = int(14.0e9),
+        max_lanes: int = LANES,
     ):
         if not (1 <= num_planes <= 8):
             raise ValueError("num_planes must be in [1, 8]")
+        if max_lanes % 32 or not (32 <= max_lanes <= MAX_LANES):
+            # Fail before the ELL build, like the num_planes check above.
+            raise ValueError(
+                f"max_lanes must be a multiple of 32 in [32, {MAX_LANES}]"
+            )
         self.num_planes = num_planes
         # A vertex claimed in body i carries counter value i (incremented once
         # per body while unvisited) and distance i+1, so p planes label
@@ -110,16 +122,19 @@ class WidePackedMsBfsEngine:
         self.host_graph = graph if isinstance(graph, Graph) else None
         self._act = self.ell.num_active
         if lanes == "auto":
-            # Halve from 4096 until the packed state fits HBM next to the ELL.
+            # Halve from max_lanes until the packed state fits HBM next to
+            # the ELL.
             lanes = auto_lanes(
                 self._act + 1,
                 num_planes,
                 fixed_bytes=int(self.ell.total_slots * 4.4),
                 hbm_budget_bytes=hbm_budget_bytes,
-                max_lanes=LANES,
+                max_lanes=max_lanes,
             )
-        if lanes % 32 or not (32 <= lanes <= LANES):
-            raise ValueError(f"lanes must be a multiple of 32 in [32, {LANES}]")
+        if lanes % 32 or not (32 <= lanes <= MAX_LANES):
+            raise ValueError(
+                f"lanes must be a multiple of 32 in [32, {MAX_LANES}]"
+            )
         self.w = lanes // 32
         self.lanes = lanes
         self.undirected = self.ell.undirected if undirected is None else undirected

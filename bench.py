@@ -55,12 +55,25 @@ def _is_transient(exc: BaseException) -> bool:
     return is_transient_failure(exc)
 
 
+def _reset_failed_backend_init(exc: BaseException) -> bool:
+    """Backend-init failure handling, shared with the in-run recovery
+    machinery (tpu_bfs/utils/recovery.py — one definition for both retry
+    paths): clears jax's cached failed-init state so the retry re-probes
+    the chip. Lazy import, like _is_transient."""
+    from tpu_bfs.utils.recovery import reset_failed_backend_init
+
+    return reset_failed_backend_init(exc, log=log)
+
+
 def retry_transient(fn, *args, attempts: int = 3, backoff_s: float = 5.0,
                     label: str = "", **kwargs):
     """Call ``fn(*args, **kwargs)``; on a transient infra error retry up to
     ``attempts`` total tries with linear backoff, logging each retry to
     stderr. Non-transient exceptions (validation failures above all)
-    propagate immediately."""
+    propagate immediately. Backend-init failures (chip held by another
+    tenant) additionally reset jax's backend caches and wait at least
+    60 s — the client's own polling window then gives each retry a long
+    effective wait for the chip to come free."""
     for attempt in range(1, attempts + 1):
         try:
             return fn(*args, **kwargs)
@@ -68,6 +81,10 @@ def retry_transient(fn, *args, attempts: int = 3, backoff_s: float = 5.0,
             if attempt >= attempts or not _is_transient(exc):
                 raise
             wait = backoff_s * attempt
+            if _reset_failed_backend_init(exc):
+                from tpu_bfs.utils.recovery import BACKEND_INIT_RETRY_FLOOR_S
+
+                wait = max(wait, BACKEND_INIT_RETRY_FLOOR_S)
             log(
                 f"transient failure in {label or getattr(fn, '__name__', 'stage')} "
                 f"(attempt {attempt}/{attempts}): {type(exc).__name__}: "
@@ -80,8 +97,15 @@ def _env_max_lanes(*, default: int) -> int:
     """TPU_BFS_BENCH_MAX_LANES, clamped into the engines' legal range so a
     typo'd env var degrades to a logged clamp instead of crashing the bench
     after a minutes-long engine build (the constructors also validate
-    early, but the bench's job is to always emit its one JSON line)."""
-    from tpu_bfs.algorithms.msbfs_wide import MAX_LANES
+    early, but the bench's job is to always emit its one JSON line).
+
+    Clamps to a power-of-two word count: auto sizing can only ever pick
+    those, so e.g. 12288 would silently bench at 8192 — better to say so
+    up front. Bounded by the stricter of the two engines' caps (both are
+    4 * LANES today; min() keeps the bench safe if they ever diverge)."""
+    from tpu_bfs.algorithms._packed_common import floor_lanes
+    from tpu_bfs.algorithms.msbfs_hybrid import MAX_LANES as HYB_MAX
+    from tpu_bfs.algorithms.msbfs_wide import MAX_LANES as WIDE_MAX
 
     val = os.environ.get("TPU_BFS_BENCH_MAX_LANES", str(default))
     try:
@@ -90,9 +114,10 @@ def _env_max_lanes(*, default: int) -> int:
         log(f"TPU_BFS_BENCH_MAX_LANES={val!r} is not an integer; "
             f"using {default}")
         return default
-    clamped = min(max(raw - raw % 32, 32), MAX_LANES)
+    clamped = floor_lanes(min(max(raw, 32), min(HYB_MAX, WIDE_MAX)))
     if clamped != raw:
-        log(f"TPU_BFS_BENCH_MAX_LANES={raw} out of range; clamped to {clamped}")
+        log(f"TPU_BFS_BENCH_MAX_LANES={raw} not a reachable width; "
+            f"clamped to {clamped}")
     return clamped
 
 

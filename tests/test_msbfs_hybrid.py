@@ -165,3 +165,22 @@ def test_hybrid_w256_dense_tiles(random_small):
         np.testing.assert_array_equal(
             res.distances_int32(i), golden, err_msg=f"lane {i}"
         )
+
+
+def test_hybrid_max_lanes_never_degrades_default(random_small):
+    # Memory-edge regression: with rows=512 the 14 GB model gives
+    # 5 planes @ w=128 = 2.88 MB (does not fit a 2.75 MB budget) but
+    # 4 planes @ w=128 = 2.62 MB (fits). A raised max_lanes that cannot
+    # be reached must walk the width ladder down to EXACTLY the default
+    # cap's sizing (4 planes, 4096 lanes) — not leave planes at 5 and
+    # fall to 2048 lanes (which would cost the dense kernel on TPU).
+    budget = 2_750_000
+    e_def = HybridMsBfsEngine(
+        random_small, tile_thr=10**6, hbm_budget_bytes=budget
+    )
+    e_wide = HybridMsBfsEngine(
+        random_small, tile_thr=10**6, hbm_budget_bytes=budget,
+        max_lanes=8192,
+    )
+    assert (e_def.lanes, e_def.num_planes) == (4096, 4)
+    assert (e_wide.lanes, e_wide.num_planes) == (4096, 4)

@@ -195,3 +195,47 @@ def test_cli_multi_source_recovers(capsys, monkeypatch):
     out = capsys.readouterr().out
     assert rc == 0
     assert "[recovery]" in out and "Output OK" in out
+
+
+def test_recovery_backend_init_failure_resets_and_waits(
+    random_small, monkeypatch
+):
+    # A backend-init failure ("Unable to initialize backend": the chip was
+    # held by another tenant through the client's whole polling window —
+    # observed live, round 3) must (a) classify transient, (b) clear jax's
+    # cached failed-init state, and (c) wait the 60 s floor before the
+    # rebuild, so the restart budget buys real re-probes, not millisecond
+    # re-raises of the cached failure. clear_backends is stubbed: the real
+    # call would wipe this pytest process's live backend caches.
+    import jax.extend.backend as jax_backend
+
+    from tpu_bfs.utils import recovery as rec
+
+    waits, cleared = [], []
+    monkeypatch.setattr(rec.time, "sleep", waits.append)
+    monkeypatch.setattr(
+        jax_backend, "clear_backends", lambda: cleared.append(1)
+    )
+    g = random_small
+    init_msg = (
+        "Unable to initialize backend 'axon': UNAVAILABLE: TPU backend "
+        "setup/compile error (Unavailable)."
+    )
+    fail = [1]
+
+    def make():
+        if fail:
+            fail.pop()
+            raise RuntimeError(init_msg)
+        return _flaky_engine_factory(g, fail_times=[])()
+
+    first = _flaky_engine_factory(g, fail_times=[1])()
+    # First advance blips (remote-compile flavor), triggering a rebuild;
+    # the rebuild then hits the init failure once before succeeding.
+    engine, st, restarts = advance_with_recovery(
+        make, first.start(42), engine=first, levels_per_chunk=1,
+        max_restarts=3,
+    )
+    assert st.done and restarts == 2
+    assert cleared == [1]
+    assert rec.BACKEND_INIT_RETRY_FLOOR_S in waits

@@ -28,6 +28,15 @@ from tpu_bfs.graph.csr import INF_DIST
 from tpu_bfs.algorithms.msbfs_packed import UNREACHED, ripple_increment
 
 
+def floor_lanes(lanes: int) -> int:
+    """Largest REACHABLE lane count <= ``lanes``: a power-of-two uint32
+    word count times 32 (all auto sizing can ever select). The one
+    definition of "reachable width" shared by auto_lanes, the hybrid
+    engine's width ladder, and the bench's env clamp."""
+    w = max(lanes // 32, 1)
+    return 32 << (w.bit_length() - 1)
+
+
 def auto_lanes(
     rows: int,
     num_planes: int,
@@ -44,8 +53,7 @@ def auto_lanes(
     covers lane-independent residents (ELL indices, dense tiles). Returns the
     largest power-of-two word count times 32 that fits, floored at 32 lanes.
     """
-    w_max = max(max_lanes // 32, 1)
-    w = 1 << (w_max.bit_length() - 1)  # largest power of two <= w_max
+    w = floor_lanes(max_lanes) // 32
     while w > 1:
         need = (num_planes + 6) * rows * w * 4 + fixed_bytes
         if need <= hbm_budget_bytes:

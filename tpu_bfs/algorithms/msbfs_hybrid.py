@@ -52,6 +52,7 @@ from tpu_bfs.algorithms._packed_common import (
     auto_planes,
     expand_arrays,
     finish_packed_batch,
+    floor_lanes,
     make_fori_expand,
     make_packed_loop,
     make_state_kernels,
@@ -347,6 +348,10 @@ class HybridMsBfsEngine:
             raise ValueError(
                 f"max_lanes must be a multiple of 32 in [32, {MAX_LANES}]"
             )
+        # Floor once to a reachable width (power-of-two word count — all
+        # auto sizing can ever select): a non-pow2 cap would otherwise make
+        # auto_planes' full-width check unsatisfiable in EVERY auto branch.
+        max_lanes = floor_lanes(max_lanes)
         if interpret is None:
             interpret = jax.default_backend() != "tpu"
         self.hg = (
@@ -364,11 +369,35 @@ class HybridMsBfsEngine:
             hg.res_virtual.idx.size if hg.res_virtual is not None else 0
         ) + sum(b.idx.size for b in hg.res_light)
         fixed_bytes = hg.a_tiles.nbytes + int(res_slots * 4.4)
-        if num_planes == "auto":
+        if num_planes == "auto" and lanes == "auto":
             # Trade depth capacity (2**planes levels) for batch width: on a
             # graph one scale step too big for 5 planes at 4096 lanes, 4
             # planes (16 levels — ample for power-law graphs) keeps the
             # dense MXU path instead of falling off to the gather engine.
+            # With a raised max_lanes, walk the width ladder DOWN: a wider
+            # cap that doesn't fit must degrade to exactly the default
+            # 4096-lane sizing, never to a narrower width than the default
+            # cap would have chosen (auto_planes only trades planes when
+            # the full target width is reachable).
+            cand = max_lanes  # already floored to a reachable width above
+            while True:
+                num_planes = auto_planes(
+                    hg.vt * TILE,
+                    fixed_bytes=fixed_bytes,
+                    hbm_budget_bytes=hbm_budget_bytes,
+                    max_lanes=cand,
+                )
+                lanes = auto_lanes(
+                    hg.vt * TILE,
+                    num_planes,
+                    fixed_bytes=fixed_bytes,
+                    hbm_budget_bytes=hbm_budget_bytes,
+                    max_lanes=cand,
+                )
+                if lanes == cand or cand <= LANES:
+                    break
+                cand //= 2
+        elif num_planes == "auto":
             num_planes = auto_planes(
                 hg.vt * TILE,
                 fixed_bytes=fixed_bytes,

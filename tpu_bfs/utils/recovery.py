@@ -12,6 +12,8 @@ classifier guards the benchmark's compile-heavy stages (bench.py).
 
 from __future__ import annotations
 
+import time
+
 # Substrings that mark an error as plausibly-transient infrastructure
 # trouble: compile-service/transport failures and XLA's INTERNAL/UNAVAILABLE
 # status codes. Bare "INTERNAL:" is included because infra errors don't
@@ -27,6 +29,13 @@ TRANSIENT_PATTERNS = (
     "INTERNAL:",
     "UNAVAILABLE:",
     "DEADLINE_EXCEEDED:",
+    # jax raises a plain RuntimeError when no backend comes up at all —
+    # observed live as "Unable to initialize backend 'axon': UNAVAILABLE:
+    # TPU backend setup/compile error" after another tenant held the chip
+    # through the client's whole polling window. The chip coming free later
+    # is the common case, so this must be retryable (it killed a bench run
+    # that round-2's retry machinery was specifically built to save).
+    "Unable to initialize backend",
 )
 
 # Deterministic failures that can carry an INTERNAL: status but are bugs,
@@ -42,12 +51,18 @@ NON_TRANSIENT_MARKERS = (
 # Exception type names eligible for retry. Matched by name so the check
 # works without importing jax at module import time. Validation failures
 # (AssertionError, ValueError) are structurally excluded by this list.
+# Plain RuntimeError is eligible because backend-init failures arrive as
+# one (see TRANSIENT_PATTERNS) — but it still must carry a transient
+# pattern in its message, so this framework's own RuntimeErrors (e.g. the
+# plane-cap truncation raise, which signals a wrong configuration, not
+# infrastructure) are never retried.
 TRANSIENT_TYPE_NAMES = (
     "JaxRuntimeError",
     "XlaRuntimeError",
     "InternalError",
     "UnavailableError",
     "DeadlineExceededError",
+    "RuntimeError",
 )
 
 
@@ -61,6 +76,38 @@ def is_transient_failure(exc: BaseException) -> bool:
     if any(p in msg for p in NON_TRANSIENT_MARKERS):
         return False
     return any(p in msg for p in TRANSIENT_PATTERNS)
+
+
+# Backend-init failures need a longer wait than ordinary transients: the
+# jax client already polled for the chip for its whole window before
+# giving up, so the chip is likely held by another tenant for a while yet.
+BACKEND_INIT_RETRY_FLOOR_S = 60.0
+
+
+def reset_failed_backend_init(exc: BaseException, *, log=None) -> bool:
+    """If ``exc`` is a backend-initialization failure ("Unable to
+    initialize backend ...": no device ever came up, typically because
+    another tenant held the chip through the client's whole polling
+    window), clear jax's backend caches so the next attempt genuinely
+    re-probes the hardware instead of re-raising the cached failure in
+    milliseconds. Returns True when it fired — callers should then floor
+    their backoff at BACKEND_INIT_RETRY_FLOOR_S.
+
+    Only fires for init failures — at that point no device arrays exist
+    anywhere, so clearing is safe. (After a mid-run failure the engines'
+    device-resident arrays must survive the retry; never clear then.)"""
+    if "Unable to initialize backend" not in str(exc):
+        return False
+    try:
+        # jax.extend is a lazy submodule: must be imported explicitly
+        # (plain `jax.extend.backend` AttributeErrors on jax 0.9).
+        import jax.extend.backend as jax_backend
+
+        jax_backend.clear_backends()
+    except Exception as clear_exc:  # noqa: BLE001 — best-effort
+        if log is not None:
+            log(f"backend cache clear failed ({clear_exc!r}); retrying anyway")
+    return True
 
 
 def advance_with_recovery(
@@ -106,6 +153,8 @@ def advance_with_recovery(
                     f"({type(exc).__name__}: {str(exc)[:200]}); rebuilding "
                     f"engine and resuming (restart {restarts}/{max_restarts})"
                 )
+            if reset_failed_backend_init(exc, log=log):
+                time.sleep(BACKEND_INIT_RETRY_FLOOR_S)
             # Engine builds are compile-heavy too — the rebuild itself may
             # hit the same blip; keep it inside the restart budget.
             while True:
@@ -122,6 +171,8 @@ def advance_with_recovery(
                             f"({type(exc2).__name__}); retrying "
                             f"(restart {restarts}/{max_restarts})"
                         )
+                    if reset_failed_backend_init(exc2, log=log):
+                        time.sleep(BACKEND_INIT_RETRY_FLOOR_S)
             continue
         ckpt = nxt
         if save is not None:

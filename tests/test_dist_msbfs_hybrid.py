@@ -156,3 +156,30 @@ def test_sparse_frontier_gather_matches_dense(rmat_small):
         sparse.last_exchange_level_counts.sum()
         == dense.last_exchange_level_counts.sum()
     )
+
+
+def test_dist_hybrid_w256_lanes_past_4096(random_small):
+    # Width generalization on the sharded engine: w=256 (8192 lanes)
+    # through dense tiles + residual + the ring exchange on a 4-device
+    # mesh, lanes seeded past word column 128 validated against the
+    # oracle. Also covers the sliced (O(A/P)-transient) layout: its
+    # rotating accumulator is [rows_loc, w] — width-agnostic by
+    # construction, but only a run proves it.
+    rng = np.random.default_rng(9)
+    sources = rng.integers(0, random_small.num_vertices, size=8192)
+    picks = [0, 4095, 4096, 8191]
+    for exchange in ("dense", "sliced"):
+        engine = DistHybridMsBfsEngine(
+            random_small, make_mesh(4), tile_thr=2, lanes=8192,
+            exchange=exchange,
+        )
+        assert engine.w == 256
+        res = engine.run(sources)
+        for i in picks:
+            golden, _ = bfs_python(random_small, int(sources[i]))
+            np.testing.assert_array_equal(
+                res.distances_int32(i), golden,
+                err_msg=f"{exchange} lane {i}",
+            )
+    with pytest.raises(ValueError):
+        DistHybridMsBfsEngine(random_small, make_mesh(4), lanes=6144)

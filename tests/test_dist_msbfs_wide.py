@@ -121,3 +121,19 @@ def test_sparse_gather_checkpoint_roundtrip(rmat_small):
         )
     # Chunked counters cover the whole traversal chain.
     assert eng.last_exchange_level_counts.sum() == st.level
+
+
+def test_dist_wide_w256_lanes_past_4096(random_small):
+    # Width generalization on the sharded wide engine: the [rows_loc, w]
+    # blocks are width-agnostic; 8192 lanes (w=256, word columns past 128)
+    # must label identically to the oracle.
+    rng = np.random.default_rng(9)
+    sources = rng.integers(0, random_small.num_vertices, size=8192)
+    engine = DistWideMsBfsEngine(random_small, make_mesh(4), lanes=8192)
+    assert engine.w == 256
+    res = engine.run(sources)
+    for i in [0, 4096, 8191]:
+        golden, _ = bfs_python(random_small, int(sources[i]))
+        np.testing.assert_array_equal(
+            res.distances_int32(i), golden, err_msg=f"lane {i}"
+        )

@@ -35,7 +35,8 @@ mesh grows:
   and the wire bytes equal the ring all-gather's. The O(A) transient below
   becomes O(A/P): adding chips then genuinely reaches bigger graphs.
 
-Per-chip memory (w=128 words = 4096 lanes, A = active rows):
+Per-chip memory (at the default w=128 words = 4096 lanes, row bytes 4w =
+512 B; A = active rows — scale row bytes linearly for wider ``lanes``):
   persistent: (num_planes + 2) * A/P * 512 B     (planes + visited + frontier)
   transient:  gather layout: A * 512 B (gathered frontier) + A/P * 512 B
               sliced layout: 2 * A/P * 512 B (rotating accumulator + hits)
@@ -43,9 +44,9 @@ Per-chip memory (w=128 words = 4096 lanes, A = active rows):
 so with the sliced layout EVERY term falls as 1/P — see BENCHMARKS.md for
 the Graph500 scale-26 budget on v5p.
 
-Like the single-chip hybrid, the dense kernel fixes the lane count at 4096
-(w=128); unlike it, sharding lets that width fit graphs one chip cannot
-hold.
+Like the single-chip hybrid, the dense kernel constrains the lane count to
+multiples of 4096 (w % 128 == 0; default 4096, ``lanes`` raises it); unlike
+it, sharding lets that width fit graphs one chip cannot hold.
 """
 
 from __future__ import annotations
@@ -79,6 +80,9 @@ from tpu_bfs.parallel.dist_bfs import make_mesh
 
 W = 128
 LANES = 32 * W
+# Same width generalization as the single-chip engines (msbfs_hybrid):
+# wider rows in 4096-lane steps, opt-in via ``lanes``, default unchanged.
+from tpu_bfs.algorithms.msbfs_hybrid import MAX_LANES  # noqa: E402
 
 
 def _round_up(x: int, m: int) -> int:
@@ -698,6 +702,7 @@ class DistHybridMsBfsEngine(RowGatherExchangeAccounting):
         interpret: bool | None = None,
         exchange: str = "dense",
         sparse_caps: int | tuple[int, ...] | None = None,
+        lanes: int = LANES,
     ):
         if not (1 <= num_planes <= 8):
             raise ValueError("num_planes must be in [1, 8]")
@@ -706,8 +711,17 @@ class DistHybridMsBfsEngine(RowGatherExchangeAccounting):
                 f"unknown exchange {exchange!r}; have 'dense', 'sparse', "
                 "'sliced'"
             )
-        self.w = W
-        self.lanes = LANES
+        if lanes % LANES or not (LANES <= lanes <= MAX_LANES):
+            # The dense kernel runs on every shard, so the distributed
+            # engine takes whole 4096-lane steps only (no narrow fallback
+            # here — per-chip state already scales 1/P; shard wider
+            # instead of narrowing).
+            raise ValueError(
+                f"lanes must be a multiple of {LANES} in [{LANES}, "
+                f"{MAX_LANES}]"
+            )
+        self.w = lanes // 32
+        self.lanes = lanes
         self.num_planes = num_planes
         self.max_levels_cap = min(1 << num_planes, 254)
         if interpret is None:

@@ -50,6 +50,10 @@ from tpu_bfs.parallel.dist_bfs import make_mesh
 
 W = 128
 LANES = 32 * W
+# Width generalization mirrors the single-chip wide engine: any multiple
+# of 32 lanes up to MAX_LANES is legal (the sharded tables are [rows_loc,
+# w] blocks — width-agnostic); the default stays at the measured 4096.
+from tpu_bfs.algorithms.msbfs_wide import MAX_LANES  # noqa: E402
 
 
 def _make_dist_core(
@@ -239,8 +243,10 @@ class DistWideMsBfsEngine(RowGatherExchangeAccounting):
             raise ValueError(
                 f"unknown exchange {exchange!r}; have 'dense', 'sparse'"
             )
-        if lanes % 32 or not (32 <= lanes <= LANES):
-            raise ValueError(f"lanes must be a multiple of 32 in [32, {LANES}]")
+        if lanes % 32 or not (32 <= lanes <= MAX_LANES):
+            raise ValueError(
+                f"lanes must be a multiple of 32 in [32, {MAX_LANES}]"
+            )
         self.w = lanes // 32
         self.lanes = lanes
         self.num_planes = num_planes

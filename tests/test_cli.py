@@ -138,3 +138,20 @@ def test_cli_rejects_allreduce_multi_source_multichip():
     with pytest.raises(SystemExit):
         cli.main(["0", "random:n=100,m=300,seed=1", "--devices", "2",
                   "--multi-source", "1", "--exchange", "allreduce"])
+
+
+def test_cli_multi_source_lanes_flag(capsys):
+    # --lanes reaches every packed engine (single-chip and distributed)
+    # from the one binary; 8192 selects the wider (w=256) rows.
+    for extra in (
+        ["--engine", "wide", "--lanes", "8192"],
+        ["--engine", "hybrid", "--lanes", "8192"],
+        ["--engine", "wide", "--lanes", "8192", "--devices", "2"],
+    ):
+        rc = cli.main(
+            ["0", "random:n=200,m=900,seed=3", "--multi-source", "5,9"]
+            + extra
+        )
+        out = capsys.readouterr().out
+        assert rc == 0, extra
+        assert "Output OK" in out, extra

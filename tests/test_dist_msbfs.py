@@ -81,4 +81,3 @@ def test_dist_packed_shard_mesh_mismatch(random_small):
 def test_dist_packed_rejects_bad_lanes(random_small):
     with pytest.raises(ValueError):
         DistWideMsBfsEngine(random_small, make_mesh(2), lanes=33)
-

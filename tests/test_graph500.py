@@ -49,3 +49,16 @@ def test_run_graph500_distributed():
         8, 4, num_searches=8, mode="hybrid", validate_searches=2, devices=8,
     )
     assert r2.validated and len(r2.teps) == 8
+
+
+def test_graph500_hybrid_lanes_flag(capsys):
+    # --lanes threads through to the hybrid engines; width past the
+    # default still validates (oracle + tree certificate on 2 searches).
+    from tpu_bfs import graph500
+
+    rc = graph500.main(
+        ["--scale", "9", "--ef", "8", "--searches", "8", "--mode", "hybrid",
+         "--lanes", "8192", "--validate", "2"]
+    )
+    assert rc == 0
+    assert "harmonic_mean_GTEPS" in capsys.readouterr().out

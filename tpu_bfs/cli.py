@@ -77,6 +77,21 @@ def _make_ms_engine(args, g, n_sources: int):
     """
     engine = args.engine
     planes = args.planes if args.planes is not None else 5
+    # --lanes: explicit batch width (w = lanes/32 packed words per row).
+    # None -> each engine's own default/auto sizing; widths past 4096 are
+    # the opt-in wider rows (msbfs_wide/msbfs_hybrid MAX_LANES). Validated
+    # here so flag misuse gets the CLI's clean SystemExit, not an engine
+    # traceback (engines apply their own stricter constraints on top, e.g.
+    # whole 4096-lane steps for the dense kernel on TPU).
+    if args.lanes is not None:
+        from tpu_bfs.algorithms.msbfs_wide import MAX_LANES
+
+        if args.lanes % 32 or not (32 <= args.lanes <= MAX_LANES):
+            raise SystemExit(
+                f"--lanes must be a multiple of 32 in [32, {MAX_LANES}], "
+                f"got {args.lanes}"
+            )
+    lanes_kw = {} if args.lanes is None else {"lanes": args.lanes}
     if args.devices > 1:
         if engine == "packed":
             raise SystemExit(
@@ -109,12 +124,12 @@ def _make_ms_engine(args, g, n_sources: int):
             from tpu_bfs.parallel.dist_msbfs_wide import DistWideMsBfsEngine
 
             return DistWideMsBfsEngine(
-                g, mesh, num_planes=planes, exchange=exchange
+                g, mesh, num_planes=planes, exchange=exchange, **lanes_kw
             )
         from tpu_bfs.parallel.dist_msbfs_hybrid import DistHybridMsBfsEngine
 
         return DistHybridMsBfsEngine(
-            g, mesh, num_planes=planes, exchange=exchange
+            g, mesh, num_planes=planes, exchange=exchange, **lanes_kw
         )
     if engine is None:
         engine = "packed" if n_sources <= 512 else "hybrid"
@@ -124,15 +139,19 @@ def _make_ms_engine(args, g, n_sources: int):
     if engine == "packed":
         from tpu_bfs.algorithms.msbfs_packed import PackedMsBfsEngine
 
-        lanes = max(32, -(-n_sources // 32) * 32)
+        lanes = (
+            args.lanes
+            if args.lanes is not None
+            else max(32, -(-n_sources // 32) * 32)
+        )
         return PackedMsBfsEngine(g, lanes=lanes)
     if engine == "wide":
         from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
 
-        return WidePackedMsBfsEngine(g, num_planes=planes)
+        return WidePackedMsBfsEngine(g, num_planes=planes, **lanes_kw)
     from tpu_bfs.algorithms.msbfs_hybrid import HybridMsBfsEngine
 
-    return HybridMsBfsEngine(g, num_planes=planes)
+    return HybridMsBfsEngine(g, num_planes=planes, **lanes_kw)
 
 
 def _run_multi_source(args, g, golden) -> int:
@@ -327,6 +346,12 @@ def main(argv=None) -> int:
                     choices=range(1, 9),
                     help="bit-plane count for the wide/hybrid engines; caps "
                     "traversal depth at 2**P levels (default 5)")
+    ap.add_argument("--lanes", type=int, default=None, metavar="N",
+                    help="packed batch width for --multi-source engines "
+                    "(default: engine auto sizing, 4096 max; larger "
+                    "multiples of 4096 opt into wider rows — more "
+                    "concurrent sources per batch at proportionally more "
+                    "HBM)")
     ap.add_argument("--profile-dir", default=None,
                     help="write a jax.profiler trace of the timed run here")
     ap.add_argument("--ckpt", default=None, metavar="PATH",

@@ -58,6 +58,7 @@ def run_graph500(
     validate_searches: int = 4,
     validate_mode: str = "oracle",
     num_planes: int = 5,
+    lanes: int | None = None,
     engine_cls=None,
     verbose: bool = False,
     devices: int = 1,
@@ -91,6 +92,9 @@ def run_graph500(
         )
 
     teps = []
+    # lanes=None -> engine auto sizing; multiples of 4096 past the default
+    # opt into wider rows (more searches per batch; see msbfs_hybrid).
+    lanes_kw = {} if lanes is None else {"lanes": lanes}
     if mode == "hybrid":
         if engine_cls is not None:
             eng = engine_cls(g)
@@ -104,12 +108,12 @@ def run_graph500(
 
             eng = DistHybridMsBfsEngine(
                 g, devices, num_planes=num_planes,
-                exchange=exchange or "dense",
+                exchange=exchange or "dense", **lanes_kw,
             )
         else:
             from tpu_bfs.algorithms.msbfs_hybrid import HybridMsBfsEngine
 
-            eng = HybridMsBfsEngine(g, num_planes=num_planes)
+            eng = HybridMsBfsEngine(g, num_planes=num_planes, **lanes_kw)
         res = eng.run(keys, time_it=True)
         per_search = res.elapsed_s / len(keys)
         # One lane at a time — res extracts lazily; only the rows needed for
@@ -226,6 +230,10 @@ def main(argv=None) -> int:
     ap.add_argument("--planes", type=int, default=5, metavar="P",
                     choices=range(1, 9),
                     help="hybrid mode: bit-plane count (depth cap 2**P)")
+    ap.add_argument("--lanes", type=int, default=None, metavar="N",
+                    help="hybrid mode: packed batch width (default: engine "
+                    "auto sizing, 4096; multiples of 4096 opt into wider "
+                    "rows — raise --searches to fill them)")
     ap.add_argument("--devices", type=int, default=1,
                     help="shard over N devices (single: 1D vertex "
                     "partition; hybrid: sharded-state engine)")
@@ -259,6 +267,7 @@ def main(argv=None) -> int:
         validate_searches=args.validate,
         validate_mode=args.validate_mode,
         num_planes=args.planes,
+        lanes=args.lanes,
         verbose=True,
         devices=args.devices,
         mesh2d=mesh2d,

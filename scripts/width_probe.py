@@ -1,0 +1,144 @@
+"""Row-width microbenchmark: is the packed-row gather still latency-bound
+past 128 words?
+
+The packed engines' flagship width (4096 lanes = 128 uint32 words/row) came
+from a v5e measurement: a chained random row-gather + OR costs ~13 ns/index
+at 64- AND 128-word rows (flat — latency-bound), but more at narrower rows
+(tile padding). This probe extends that sweep upward (w in 64..512) to
+answer the one question the width generalization (msbfs_wide/msbfs_hybrid
+``max_lanes``) leaves open: if ~flat through 256 words, doubling the batch
+to 8192 lanes nearly doubles aggregate TEPS for the same index count; if
+the cost doubles (bandwidth-bound), the wider rows are a wash.
+
+Also times the tile_spmm Pallas kernel per-tile at each legal width
+(w % 128 == 0), checks a small prefix against the NumPy reference, and —
+when running compiled on a TPU — additionally compares that prefix
+compiled-vs-interpret (the bench's Mosaic-divergence guard, at each
+probed width).
+
+Usage (real chip): python scripts/width_probe.py
+Prints one JSON line per (op, w). Safe to re-run; ~1 min total.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def probe_gather(rows: int = 1_250_000, n_idx: int = 1_000_000,
+                 chain: int = 8, widths=(64, 128, 256, 512)) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(1)
+    # An INDEPENDENT random permutation per chain step: step k's rows have
+    # no relation to step k-1's (a (ix + k) % rows scheme would read the
+    # row adjacent to the one just fetched — prefetch/warm-granule effects
+    # then bias ns/index by an amount that varies with w, exactly the
+    # slope this probe exists to measure). Steps couple only through the
+    # OR accumulator — the same dependence structure as the engines' own
+    # fori-loop bucket expansion (_packed_common.make_fori_expand).
+    idx = jnp.asarray(rng.integers(0, rows, size=(chain, n_idx), dtype=np.int32))
+    for w in widths:
+        table = jnp.asarray(
+            rng.integers(0, 2**32, size=(rows, w), dtype=np.uint32)
+        )
+
+        @jax.jit
+        def chained(t, ix):
+            acc = jnp.zeros((n_idx, t.shape[1]), jnp.uint32)
+
+            def body(k, acc):
+                return acc | t[ix[k]]
+
+            return jax.lax.fori_loop(0, chain, body, acc)
+
+        chained(table, idx).block_until_ready()  # compile + warm
+        t0 = time.perf_counter()
+        reps = 3
+        for _ in range(reps):
+            out = chained(table, idx)
+        out.block_until_ready()
+        dt = (time.perf_counter() - t0) / reps
+        ns_per_index = dt / (n_idx * chain) * 1e9
+        print(json.dumps({
+            "op": "chained_row_gather_or", "w_words": w, "lanes": 32 * w,
+            "rows": rows, "indices": n_idx * chain,
+            "ns_per_index": round(ns_per_index, 2),
+            "effective_GBps": round(n_idx * chain * w * 4 / dt / 1e9, 1),
+        }))
+        del table
+
+
+def probe_tile_spmm(num_row_tiles: int = 256, tiles_per_row: int = 16,
+                    widths=(128, 256), interpret: bool | None = None) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    from tpu_bfs.ops.tile_spmm import (
+        TILE,
+        pack_a_tiles,
+        tile_spmm,
+        tile_spmm_reference,
+    )
+
+    rng = np.random.default_rng(2)
+    nt = num_row_tiles * tiles_per_row
+    a_dense = (rng.random((nt, TILE, TILE)) < 0.05).astype(np.int8)
+    a_tiles = pack_a_tiles(a_dense)
+    row_start = np.arange(num_row_tiles + 1, dtype=np.int32) * tiles_per_row
+    col_tile = rng.integers(0, num_row_tiles, size=nt).astype(np.int32)
+    for w in widths:
+        fw = rng.integers(
+            0, 2**32, size=(num_row_tiles * TILE, w), dtype=np.uint32
+        )
+        args = (jnp.asarray(row_start), jnp.asarray(col_tile),
+                jnp.asarray(a_tiles), jnp.asarray(fw))
+        kw = dict(num_row_tiles=num_row_tiles, w=w, interpret=interpret)
+        out = tile_spmm(*args, **kw)
+        out.block_until_ready()  # compile + warm
+        t0 = time.perf_counter()
+        reps = 5
+        for _ in range(reps):
+            out = tile_spmm(*args, **kw)
+        out.block_until_ready()
+        dt = (time.perf_counter() - t0) / reps
+        # Small-prefix correctness: vs the NumPy reference always, and vs
+        # interpret mode too when the timed run was compiled (TPU).
+        small = 4
+        ns = int(row_start[small])
+        small_args = (args[0][: small + 1], args[1][:ns], args[2][:ns],
+                      args[3])
+        ref = tile_spmm_reference(
+            row_start[: small + 1], col_tile[:ns], a_tiles[:ns], fw,
+            num_row_tiles=small, w=w,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out)[: small * TILE], ref
+        )
+        if not interpret:
+            out_i = tile_spmm(
+                *small_args, num_row_tiles=small, w=w, interpret=True
+            )
+            np.testing.assert_array_equal(np.asarray(out_i), ref)
+        print(json.dumps({
+            "op": "tile_spmm", "w_words": w, "lanes": 32 * w,
+            "tiles": nt, "us_per_tile": round(dt / nt * 1e6, 3),
+            "checked_vs_reference_tiles": ns,
+            "compiled_vs_interpret": not interpret,
+        }))
+
+
+if __name__ == "__main__":
+    import jax
+
+    print(json.dumps({"backend": jax.default_backend(),
+                      "devices": len(jax.devices())}))
+    probe_gather()
+    probe_tile_spmm()

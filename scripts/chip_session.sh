@@ -1,0 +1,32 @@
+#!/bin/bash
+# One opportunistic TPU session: whenever the chip comes back, take the
+# round's measurements in priority order and stop. Each stage's stdout is
+# preserved under .bench_cache/chip_session/. Retries the whole sequence
+# until the flagship number lands or the attempt budget runs out (the
+# bench's own retry ladder handles intra-run blips; this loop handles
+# multi-hour outages).
+set -u
+out=.bench_cache/chip_session
+mkdir -p "$out"
+for i in $(seq 1 "${CHIP_SESSION_ATTEMPTS:-12}"); do
+  echo "=== attempt $i: flagship bench $(date -u +%H:%M:%S) ==="
+  if python bench.py >"$out/flagship.json" 2>"$out/flagship.log"; then
+    echo "flagship OK: $(cat "$out/flagship.json")"
+    echo "=== width probe ==="
+    python scripts/width_probe.py >"$out/width_probe.jsonl" 2>"$out/width_probe.log" \
+      && echo "width probe OK" || echo "width probe FAILED (see $out/width_probe.log)"
+    cat "$out/width_probe.jsonl" 2>/dev/null
+    echo "=== 8192-lane flagship sweep ==="
+    TPU_BFS_BENCH_MAX_LANES=8192 python bench.py >"$out/flagship_8k.json" 2>"$out/flagship_8k.log" \
+      && echo "8k sweep OK: $(cat "$out/flagship_8k.json")" \
+      || echo "8k sweep FAILED (see $out/flagship_8k.log)"
+    exit 0
+  else
+    rc=$?  # captured at else-entry, before any other command clobbers it
+  fi
+  echo "flagship attempt $i failed (rc=$rc); tail of log:"
+  tail -2 "$out/flagship.log"
+  [ "$i" -lt "${CHIP_SESSION_ATTEMPTS:-12}" ] && sleep "${CHIP_SESSION_SLEEP:-300}"
+done
+echo "chip never came back within the attempt budget"
+exit 1

@@ -7,8 +7,9 @@
 # multi-hour outages).
 set -u
 out=.bench_cache/chip_session
+attempts="${CHIP_SESSION_ATTEMPTS:-12}"
 mkdir -p "$out"
-for i in $(seq 1 "${CHIP_SESSION_ATTEMPTS:-12}"); do
+for i in $(seq 1 "$attempts"); do
   echo "=== attempt $i: flagship bench $(date -u +%H:%M:%S) ==="
   if python bench.py >"$out/flagship.json" 2>"$out/flagship.log"; then
     echo "flagship OK: $(cat "$out/flagship.json")"
@@ -26,7 +27,7 @@ for i in $(seq 1 "${CHIP_SESSION_ATTEMPTS:-12}"); do
   fi
   echo "flagship attempt $i failed (rc=$rc); tail of log:"
   tail -2 "$out/flagship.log"
-  [ "$i" -lt "${CHIP_SESSION_ATTEMPTS:-12}" ] && sleep "${CHIP_SESSION_SLEEP:-300}"
+  [ "$i" -lt "$attempts" ] && sleep "${CHIP_SESSION_SLEEP:-300}"
 done
 echo "chip never came back within the attempt budget"
 exit 1

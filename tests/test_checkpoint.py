@@ -285,6 +285,19 @@ class TestPacked:
         with pytest.raises(ValueError, match="packed-batch checkpoint"):
             ckpt_mod.load_checkpoint(path)
 
+    def test_cross_width_resume_rejected(self, wide, rmat_small):
+        # A checkpoint's packed tables are [V, w]; resuming on an engine of
+        # a different row width (here 64 -> 96 lanes) must fail with the
+        # descriptive lane-count message, not a shape broadcast error —
+        # width is part of the state layout, unlike engine/topology/mesh,
+        # which checkpoints deliberately roam across.
+        from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
+
+        st = wide.advance(wide.start(self.SOURCES), levels=1)
+        other = WidePackedMsBfsEngine(rmat_small, lanes=96)
+        with pytest.raises(ValueError, match="lane count"):
+            other.advance(st)
+
     def test_advance_raises_at_plane_cap_truncation(self, line_graph):
         # 64-vertex path, eccentricity 63 > the 4-plane cap of 16: the
         # chunked advance loop must raise (like run's check_cap) instead of

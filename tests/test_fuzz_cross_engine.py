@@ -86,3 +86,27 @@ def test_distributed_engines_agree(name, make):
     res = hyb.run(np.asarray(sources))
     for i, s in enumerate(sources):
         validate.check_distances(res.distances_int32(i), golden[s])
+
+
+@pytest.mark.parametrize("name,make", [CASES[2]], ids=[CASES[2][0]])
+def test_widths_agree(name, make):
+    # Cross-WIDTH determinism on ONE engine: the same batch on the same
+    # engine at w=64 (2048 lanes) and w=256 (8192 lanes) labels
+    # bit-identical distances — width is a packing choice, never a
+    # semantic one. Same-engine isolation means a failure here is a
+    # width-packing bug, not a cross-engine disagreement (that axis is
+    # test_single_chip_engines_agree's). One RMAT case keeps the sweep's
+    # runtime in check; the width machinery is shared by every case above.
+    from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
+
+    g = make()
+    rng = np.random.default_rng(13)
+    sources = _sources(g, rng, n=4)
+    golden = {s: bfs_scipy(g, s) for s in sources}
+    narrow = WidePackedMsBfsEngine(g, lanes=2048).run(np.asarray(sources))
+    wide = WidePackedMsBfsEngine(g, lanes=8192).run(np.asarray(sources))
+    for i, s in enumerate(sources):
+        validate.check_distances(narrow.distances_int32(i), golden[s])
+        np.testing.assert_array_equal(
+            narrow.distances_int32(i), wide.distances_int32(i)
+        )

@@ -30,6 +30,14 @@ def __getattr__(name):
         from tpu_bfs.algorithms.msbfs_hybrid import HybridMsBfsEngine
 
         return HybridMsBfsEngine
+    if name == "TiledBfsEngine":
+        from tpu_bfs.algorithms.bfs_tiled import TiledBfsEngine
+
+        return TiledBfsEngine
+    if name == "PackedMsBfsEngine":
+        from tpu_bfs.algorithms.msbfs_packed import PackedMsBfsEngine
+
+        return PackedMsBfsEngine
     if name == "WidePackedMsBfsEngine":
         from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
 

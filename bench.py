@@ -15,12 +15,15 @@ stand-in, NONETWORK.md),
 TPU_BFS_BENCH_LANES (msbfs mode, 512), TPU_BFS_BENCH_MAX_LANES (hybrid/wide
 modes, 4096 — set 8192 to sweep w=256 rows), TPU_BFS_BENCH_SOURCES (single
 modes, 8), TPU_BFS_BENCH_VALIDATE (1), TPU_BFS_BENCH_VALIDATE_LANES (4),
-TPU_BFS_BENCH_CACHE (.bench_cache).
+TPU_BFS_BENCH_CACHE (.bench_cache), TPU_BFS_BENCH_BUDGET_S (2400 — the
+outage envelope's wall-clock budget; 0 disables; on exhaustion the one JSON
+line carries value=null and a machine-readable "error").
 """
 
 import json
 import os
 import sys
+import threading
 import time
 
 import numpy as np
@@ -28,6 +31,99 @@ import numpy as np
 
 def log(msg: str) -> None:
     print(f"# {msg}", file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# Outage envelope.
+#
+# Round 3's official number was lost to a 5-hour chip outage: the retry
+# ladder below did its job in-process, but the driver's window closed around
+# it and the rc=124 kill left NOTHING attributable — no JSON, no structured
+# "chip unavailable" line (VERDICT r3 weak #2). The bench's record must
+# never depend on outliving its supervisor, so every run now carries a
+# wall-clock budget (TPU_BFS_BENCH_BUDGET_S, default 2400 s — two
+# backend-init polling windows ~= 52 min already exceed any plausible driver
+# window, so the budget binds only during a genuine outage):
+#
+# - Cooperative path: retry waits derate to the remaining budget, and when
+#   a retry cannot fit, BudgetExhausted propagates to main(), which prints
+#   the one JSON line with value=null and a machine-readable "error" and
+#   exits 0 — a parsed verdict instead of a kill.
+# - Hard path: jax's backend init itself blocks ~26 min inside a single
+#   attempt during an outage (no cooperative check can run). A daemon
+#   watchdog timer fires at the deadline, prints the same failure JSON,
+#   and exits the process.
+#
+# Reference analog: the reference's record is its own timing print
+# (bfs.cu:624-626) — it can never lose a run; after this, neither can we.
+# ---------------------------------------------------------------------------
+
+_DEADLINE: float | None = None  # time.monotonic() deadline, set by main()
+_FIRST_TRANSIENT: float | None = None  # when the current outage began
+
+
+class BudgetExhausted(RuntimeError):
+    """The wall-clock budget cannot fit another retry; carries the last
+    transient error and how long the resource has been unavailable."""
+
+    def __init__(self, cause: BaseException, unavailable_s: float):
+        self.cause = cause
+        self.unavailable_s = unavailable_s
+        super().__init__(
+            f"bench budget exhausted after {unavailable_s:.0f}s of "
+            f"transient failures; last: {type(cause).__name__}: "
+            f"{str(cause)[:300]}"
+        )
+
+
+def _budget_remaining() -> float:
+    return float("inf") if _DEADLINE is None else _DEADLINE - time.monotonic()
+
+
+def _failure_payload(mode: str, error: str) -> dict:
+    return {
+        "metric": f"BFS harmonic-mean GTEPS (mode={mode}) — run lost",
+        "value": None,
+        "unit": "GTEPS",
+        "vs_baseline": None,
+        "error": error,
+    }
+
+
+def _arm_budget(mode: str) -> threading.Timer | None:
+    """Set the cooperative deadline and arm the hard watchdog. Returns the
+    timer (cancel on success) or None when the budget is disabled."""
+    global _DEADLINE
+    _DEADLINE = None
+    raw = os.environ.get("TPU_BFS_BENCH_BUDGET_S", "2400")
+    try:
+        budget = float(raw)
+    except ValueError:
+        log(f"TPU_BFS_BENCH_BUDGET_S={raw!r} is not a number; using 2400")
+        budget = 2400.0
+    if budget <= 0:  # 0 disables the envelope (e.g. interactive debugging)
+        return None
+    _DEADLINE = time.monotonic() + budget
+
+    def fire() -> None:
+        # Last resort: a single attempt (typically backend init polling for
+        # a held chip) blocked through the whole budget. stdout may hold a
+        # partial line from the main thread; start fresh on our own line.
+        sys.stdout.write(
+            "\n" + json.dumps(_failure_payload(
+                mode,
+                f"wall-clock budget {budget:.0f}s exhausted inside a "
+                f"blocking attempt; TPU unavailable",
+            )) + "\n"
+        )
+        sys.stdout.flush()
+        os._exit(0)
+
+    timer = threading.Timer(budget, fire)
+    timer.daemon = True
+    timer.start()
+    log(f"outage envelope armed: {budget:.0f}s wall-clock budget")
+    return timer
 
 
 # ---------------------------------------------------------------------------
@@ -74,17 +170,44 @@ def retry_transient(fn, *args, attempts: int = 3, backoff_s: float = 5.0,
     tenant) additionally reset jax's backend caches and wait at least
     60 s — the client's own polling window then gives each retry a long
     effective wait for the chip to come free."""
+    global _FIRST_TRANSIENT
     for attempt in range(1, attempts + 1):
         try:
-            return fn(*args, **kwargs)
+            result = fn(*args, **kwargs)
+            _FIRST_TRANSIENT = None  # the resource recovered
+            return result
+        except BudgetExhausted:
+            # From a nested retry ladder: the budget verdict is final —
+            # re-classifying it as transient would loop on a spent budget.
+            raise
         except Exception as exc:  # noqa: BLE001 — filtered by _is_transient
             if attempt >= attempts or not _is_transient(exc):
                 raise
+            if _FIRST_TRANSIENT is None:
+                _FIRST_TRANSIENT = time.monotonic()
             wait = backoff_s * attempt
             if _reset_failed_backend_init(exc):
                 from tpu_bfs.utils.recovery import BACKEND_INIT_RETRY_FLOOR_S
 
                 wait = max(wait, BACKEND_INIT_RETRY_FLOOR_S)
+            # Outage envelope: a retry only makes sense if the wait AND a
+            # meaningful attempt still fit the wall-clock budget. Derate
+            # the wait toward the deadline; below the floor, fail fast
+            # with the structured verdict instead of being timeout-killed
+            # mid-sleep (round 3's rc=124).
+            remaining = _budget_remaining()
+            min_attempt_s = 10.0  # below this the retry cannot do real work
+            if wait + min_attempt_s > remaining:
+                derated = remaining - min_attempt_s
+                if derated < 1.0:
+                    raise BudgetExhausted(
+                        exc, time.monotonic() - _FIRST_TRANSIENT
+                    ) from exc
+                log(
+                    f"derating retry wait {wait:.0f}s -> {derated:.0f}s to "
+                    f"fit the remaining {remaining:.0f}s budget"
+                )
+                wait = derated
             log(
                 f"transient failure in {label or getattr(fn, '__name__', 'stage')} "
                 f"(attempt {attempt}/{attempts}): {type(exc).__name__}: "
@@ -532,33 +655,63 @@ def main() -> int:
     scale = int(os.environ.get("TPU_BFS_BENCH_SCALE", "21"))
     ef = int(os.environ.get("TPU_BFS_BENCH_EF", "16"))
     mode = os.environ.get("TPU_BFS_BENCH_MODE", "hybrid")
-    g = load_graph_lj() if mode.startswith("lj-") else load_graph(scale, ef)
-    from functools import partial
+    watchdog = _arm_budget(mode)
+    try:
+        g = load_graph_lj() if mode.startswith("lj-") else load_graph(scale, ef)
+        from functools import partial
 
-    lj_desc = "soc-LiveJournal1-shaped stand-in (NONETWORK.md)"
-    if mode.startswith("lj-"):
-        # Attribute the edge stream: native and numpy RMAT are different
-        # deterministic streams (ADVICE r2), so the metric says which one.
-        lj_desc = f"{lj_desc[:-1]}; {lj_impl()} stream)"
-    fn = {
-        "hybrid": bench_hybrid,
-        "wide": bench_wide,
-        "msbfs": bench_msbfs,
-        "single": bench_single,
-        "single-dopt": partial(bench_single, backend="dopt"),
-        "single-tiled": partial(bench_single, backend="tiled"),
-        "lj-hybrid": partial(bench_hybrid, graph_desc=lj_desc),
-        "lj-single-dopt": partial(bench_single, backend="dopt", graph_desc=lj_desc),
-        "lj-single-tiled": partial(bench_single, backend="tiled", graph_desc=lj_desc),
-    }[mode]
-    # Outer safety net: if a transient error escapes the per-stage retries
-    # (e.g. fired while materializing results between stages), one full
-    # re-run is still cheaper than losing the round's number. Validation
-    # failures are not retryable and propagate from the first attempt.
-    result = retry_transient(fn, g, scale, ef, attempts=2, backoff_s=15.0,
-                             label=f"bench mode={mode}")
-    print(json.dumps(result))
-    return 0
+        lj_desc = "soc-LiveJournal1-shaped stand-in (NONETWORK.md)"
+        if mode.startswith("lj-"):
+            # Attribute the edge stream: native and numpy RMAT are different
+            # deterministic streams (ADVICE r2), so the metric says which one.
+            lj_desc = f"{lj_desc[:-1]}; {lj_impl()} stream)"
+        fn = {
+            "hybrid": bench_hybrid,
+            "wide": bench_wide,
+            "msbfs": bench_msbfs,
+            "single": bench_single,
+            "single-dopt": partial(bench_single, backend="dopt"),
+            "single-tiled": partial(bench_single, backend="tiled"),
+            "lj-hybrid": partial(bench_hybrid, graph_desc=lj_desc),
+            "lj-single-dopt": partial(bench_single, backend="dopt", graph_desc=lj_desc),
+            "lj-single-tiled": partial(bench_single, backend="tiled", graph_desc=lj_desc),
+        }[mode]
+        # Outer safety net: if a transient error escapes the per-stage
+        # retries (e.g. fired while materializing results between stages),
+        # one full re-run is still cheaper than losing the round's number.
+        # Validation failures are not retryable and propagate immediately.
+        try:
+            result = retry_transient(fn, g, scale, ef, attempts=2,
+                                     backoff_s=15.0, label=f"bench mode={mode}")
+        except BudgetExhausted as exc:
+            # The structured verdict the driver window can always capture:
+            # value=null + an attributable error, exit 0 — never rc=124.
+            # Disarm the watchdog BEFORE printing: the cooperative verdict
+            # fires with seconds left on the budget, and a stalled stdout
+            # pipe must not let fire() corrupt the half-written JSON line.
+            if watchdog is not None:
+                watchdog.cancel()
+            log(str(exc))
+            print(json.dumps(_failure_payload(
+                mode,
+                f"TPU unavailable for {exc.unavailable_s:.0f}s "
+                f"(last: {type(exc.cause).__name__}: {str(exc.cause)[:200]})",
+            )))
+            return 0
+        if watchdog is not None:
+            watchdog.cancel()
+        print(json.dumps(result))
+        return 0
+    finally:
+        # Always disarm, whatever raised — a leaked timer would os._exit a
+        # later run in the same process (e.g. the pytest session driving
+        # bench.main()), a stale deadline would make later retries
+        # spuriously exhaust, and a stale outage start would inflate the
+        # next run's reported unavailable_s.
+        if watchdog is not None:
+            watchdog.cancel()
+        globals()["_DEADLINE"] = None
+        globals()["_FIRST_TRANSIENT"] = None
 
 
 if __name__ == "__main__":

@@ -186,6 +186,93 @@ def test_is_transient_still_rejects_framework_runtime_errors():
     )
 
 
+def test_outage_envelope_fails_fast_with_structured_json(
+    monkeypatch, capsys, toy_graph
+):
+    """Round 3's rc=124: the chip stayed UNAVAILABLE for 5+ hours and the
+    driver killed the bench mid-retry, leaving nothing attributable. With
+    the outage envelope, an always-UNAVAILABLE run must exit 0 within the
+    wall-clock budget and print the one JSON line with value=null and a
+    machine-readable error. Simulated time: the fake clock advances on
+    every sleep, so the whole outage plays out instantly."""
+    import jax.extend.backend as jax_backend
+
+    from tpu_bfs.algorithms.bfs import BfsEngine
+
+    monkeypatch.setenv("TPU_BFS_BENCH_MODE", "single")
+    monkeypatch.setenv("TPU_BFS_BENCH_BUDGET_S", "120")
+    monkeypatch.setattr(bench, "load_graph", lambda scale, ef: toy_graph)
+    monkeypatch.setattr(jax_backend, "clear_backends", lambda: None)
+
+    clock = {"t": 0.0}
+    monkeypatch.setattr(bench.time, "monotonic", lambda: clock["t"])
+    monkeypatch.setattr(
+        bench.time, "sleep",
+        lambda s: clock.__setitem__("t", clock["t"] + s),
+    )
+
+    def chip_held(self, *args, **kwargs):
+        raise RuntimeError(BACKEND_INIT_MSG)
+
+    monkeypatch.setattr(BfsEngine, "__init__", chip_held)
+
+    assert bench.main() == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    result = json.loads(out[-1])
+    assert result["value"] is None
+    assert result["vs_baseline"] is None
+    assert "TPU unavailable for" in result["error"]
+    # The envelope must conclude within the budget, not after it.
+    assert clock["t"] <= 120.0
+
+
+def test_outage_envelope_derates_waits_to_fit_budget(monkeypatch):
+    """A retry whose standard wait would overshoot the deadline gets a
+    shorter wait instead of being skipped, as long as a meaningful attempt
+    still fits; below that floor, BudgetExhausted carries the cause."""
+    clock = {"t": 0.0}
+    monkeypatch.setattr(bench.time, "monotonic", lambda: clock["t"])
+    waits = []
+
+    def fake_sleep(s):
+        waits.append(s)
+        clock["t"] += s
+
+    monkeypatch.setattr(bench.time, "sleep", fake_sleep)
+    monkeypatch.setattr(bench, "_DEADLINE", 40.0)
+
+    calls = []
+
+    def always_down():
+        calls.append(1)
+        raise FakeJaxRuntimeError(REMOTE_COMPILE_MSG)
+
+    with pytest.raises(bench.BudgetExhausted) as ei:
+        bench.retry_transient(always_down, attempts=10, backoff_s=20.0, label="t")
+    # Attempt 1 fails at t=0: wait 20 fits (20+10 <= 40). Attempt 2 fails
+    # at t=20: wait 40 would overshoot, derated to 40-20-10=10. Attempt 3
+    # fails at t=30: remaining 10, no room -> exhausted, cause preserved.
+    assert waits == [20.0, 10.0]
+    assert len(calls) == 3
+    assert isinstance(ei.value.cause, FakeJaxRuntimeError)
+    assert ei.value.unavailable_s == pytest.approx(30.0)
+
+
+def test_budget_exhausted_is_not_retried_by_outer_ladders(monkeypatch):
+    """Nested retry ladders must treat the budget verdict as final even
+    though its message quotes a transient-looking cause string."""
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    calls = []
+
+    def inner():
+        calls.append(1)
+        raise bench.BudgetExhausted(FakeJaxRuntimeError(REMOTE_COMPILE_MSG), 99.0)
+
+    with pytest.raises(bench.BudgetExhausted):
+        bench.retry_transient(inner, attempts=3, label="outer")
+    assert len(calls) == 1
+
+
 def test_backend_init_retry_waits_and_resets(monkeypatch):
     # Stub the real clear_backends: calling it for real would wipe the
     # whole pytest process's live backend/jit caches (conftest's virtual

@@ -59,7 +59,6 @@ def log(msg: str) -> None:
 # ---------------------------------------------------------------------------
 
 _DEADLINE: float | None = None  # time.monotonic() deadline, set by main()
-_FIRST_TRANSIENT: float | None = None  # when the current outage began
 
 
 class BudgetExhausted(RuntimeError):
@@ -170,12 +169,15 @@ def retry_transient(fn, *args, attempts: int = 3, backoff_s: float = 5.0,
     tenant) additionally reset jax's backend caches and wait at least
     60 s — the client's own polling window then gives each retry a long
     effective wait for the chip to come free."""
-    global _FIRST_TRANSIENT
+    # Per-ladder outage clock: unavailable_s spans this ladder's failures.
+    # (A nested ladder that exhausts its attempts raises the raw error; the
+    # outer ladder then starts its own clock, slightly undercounting the
+    # inner ladder's time — an informational loss, never a stale or
+    # negative duration across unrelated runs in one process.)
+    first_transient = None
     for attempt in range(1, attempts + 1):
         try:
-            result = fn(*args, **kwargs)
-            _FIRST_TRANSIENT = None  # the resource recovered
-            return result
+            return fn(*args, **kwargs)
         except BudgetExhausted:
             # From a nested retry ladder: the budget verdict is final —
             # re-classifying it as transient would loop on a spent budget.
@@ -183,8 +185,8 @@ def retry_transient(fn, *args, attempts: int = 3, backoff_s: float = 5.0,
         except Exception as exc:  # noqa: BLE001 — filtered by _is_transient
             if attempt >= attempts or not _is_transient(exc):
                 raise
-            if _FIRST_TRANSIENT is None:
-                _FIRST_TRANSIENT = time.monotonic()
+            if first_transient is None:
+                first_transient = time.monotonic()
             wait = backoff_s * attempt
             if _reset_failed_backend_init(exc):
                 from tpu_bfs.utils.recovery import BACKEND_INIT_RETRY_FLOOR_S
@@ -201,7 +203,7 @@ def retry_transient(fn, *args, attempts: int = 3, backoff_s: float = 5.0,
                 derated = remaining - min_attempt_s
                 if derated < 1.0:
                     raise BudgetExhausted(
-                        exc, time.monotonic() - _FIRST_TRANSIENT
+                        exc, time.monotonic() - first_transient
                     ) from exc
                 log(
                     f"derating retry wait {wait:.0f}s -> {derated:.0f}s to "
@@ -705,13 +707,11 @@ def main() -> int:
     finally:
         # Always disarm, whatever raised — a leaked timer would os._exit a
         # later run in the same process (e.g. the pytest session driving
-        # bench.main()), a stale deadline would make later retries
-        # spuriously exhaust, and a stale outage start would inflate the
-        # next run's reported unavailable_s.
+        # bench.main()), and a stale deadline would make later retries
+        # spuriously exhaust.
         if watchdog is not None:
             watchdog.cancel()
         globals()["_DEADLINE"] = None
-        globals()["_FIRST_TRANSIENT"] = None
 
 
 if __name__ == "__main__":

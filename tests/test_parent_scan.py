@@ -1,0 +1,160 @@
+"""Device-side batched parent extraction (algorithms/parent_scan.py).
+
+The packed engines' bulk BFS-tree export used to be one host O(E)
+scatter-min per lane — ~an hour for the 4096-lane flagship batch. The
+device scan replaces it with one bucketed min-key expansion per 128 lanes
+(min over in-neighbors of ``(dist << idbits) | id`` — valid because BFS
+guarantees every in-neighbor sits at distance >= dist-1). These tests pin
+the scan bit-equal to the host oracle (validate.min_parent_from_dist) on
+every engine and edge case, and pin the availability/fallback contract.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_bfs import validate
+from tpu_bfs.algorithms.msbfs_hybrid import HybridMsBfsEngine
+from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
+from tpu_bfs.algorithms.parent_scan import ParentScanner, ParentScanUnavailable
+from tpu_bfs.graph import io as gio
+from tpu_bfs.graph.csr import NO_PARENT
+from tpu_bfs.graph.ell import build_ell
+
+
+def _oracle(g, sources, res):
+    out = np.empty((len(sources), g.num_vertices), np.int32)
+    for i, s in enumerate(sources):
+        out[i] = validate.min_parent_from_dist(
+            g, int(s), res.distances_int32(i)
+        )
+    return out
+
+
+def test_wide_scan_matches_oracle_across_words(random_small):
+    # 40 sources span two 32-lane word columns; the scan must place each
+    # lane's tree at the right batch row through the lane map.
+    g = random_small
+    rng = np.random.default_rng(5)
+    sources = rng.choice(np.flatnonzero(g.degrees > 0), size=40, replace=False)
+    res = WidePackedMsBfsEngine(g).run(sources)
+    out = np.empty((40, g.num_vertices), np.int32)
+    res.parents_into(out, device="device")
+    np.testing.assert_array_equal(out, _oracle(g, sources, res))
+
+
+def test_wide_scan_equals_host_path(random_small):
+    g = random_small
+    sources = np.asarray([0, 17, 255, 499])
+    res = WidePackedMsBfsEngine(g).run(sources)
+    dev = np.empty((4, g.num_vertices), np.int32)
+    res.parents_into(dev, device="device")
+    host = np.empty_like(dev)
+    res.parents_into(host, device="host")
+    np.testing.assert_array_equal(dev, host)
+
+
+def test_hybrid_scan_covers_dense_tile_edges(rmat_small):
+    # The hybrid's residual ELL is missing the dense-tile edges; the scan
+    # must derive parents through ALL edges (its own full ELL build).
+    g = rmat_small
+    sources = np.flatnonzero(g.degrees > 0)[:8]
+    engine = HybridMsBfsEngine(g, lanes=256, tile_thr=4)
+    assert engine.hg.num_tiles > 0, "fixture must exercise dense tiles"
+    res = engine.run(sources)
+    out = np.empty((len(sources), g.num_vertices), np.int32)
+    res.parents_into(out, device="device")
+    np.testing.assert_array_equal(out, _oracle(g, sources, res))
+
+
+def test_scan_directed_orientation():
+    # Parent must be an IN-neighbor: u -> v edges only.
+    rng = np.random.default_rng(11)
+    u = rng.integers(0, 400, size=1500)
+    v = rng.integers(0, 400, size=1500)
+    g = gio.from_edges(u, v, num_vertices=400, directed=True)
+    sources = np.asarray([0, 7, 250])
+    res = WidePackedMsBfsEngine(g).run(sources)
+    out = np.empty((3, g.num_vertices), np.int32)
+    res.parents_into(out, device="device")
+    np.testing.assert_array_equal(out, _oracle(g, sources, res))
+
+
+def test_scan_isolated_source_and_unreached(random_disconnected):
+    g = random_disconnected
+    iso = np.flatnonzero(g.degrees == 0)
+    sources = np.asarray([int(iso[0]), 0])
+    res = WidePackedMsBfsEngine(g).run(sources)
+    out = np.empty((2, g.num_vertices), np.int32)
+    res.parents_into(out, device="device")
+    np.testing.assert_array_equal(out, _oracle(g, sources, res))
+    # Isolated source: component == {source}.
+    assert out[0, int(iso[0])] == int(iso[0])
+    assert np.all(np.delete(out[0], int(iso[0])) == NO_PARENT)
+
+
+def test_scan_deep_graph(line_graph):
+    # 63 levels on the path graph: large distance fields in the key.
+    res = WidePackedMsBfsEngine(line_graph, num_planes=6).run(np.asarray([0]))
+    out = np.empty((1, line_graph.num_vertices), np.int32)
+    res.parents_into(out, device="device")
+    np.testing.assert_array_equal(out, _oracle(line_graph, [0], res))
+
+
+def test_scan_serves_prebuilt_ell(random_small):
+    # New capability: a prebuilt-ELL engine retains no edge list, so the
+    # host path raises — but the scan only needs the ELL itself.
+    ell = build_ell(random_small, kcap=64)
+    res = WidePackedMsBfsEngine(ell).run(np.asarray([0, 3]))
+    with pytest.raises(ValueError, match="edge list"):
+        res.parents_into(
+            np.empty((2, random_small.num_vertices), np.int32), device="host"
+        )
+    out = np.empty((2, random_small.num_vertices), np.int32)
+    res.parents_into(out, device="device")
+    np.testing.assert_array_equal(out, _oracle(random_small, [0, 3], res))
+
+
+def test_scan_unavailable_raises_when_forced(rmat_small):
+    # A prebuilt HybridGraph retains neither edge list nor a full ELL:
+    # device='device' must say so, device='auto' must fall back... to the
+    # host path, which also cannot serve it -> its descriptive error.
+    from tpu_bfs.algorithms.msbfs_hybrid import build_hybrid
+
+    hg = build_hybrid(rmat_small, tile_thr=4)
+    res = HybridMsBfsEngine(hg, lanes=256).run(np.asarray([1]))
+    out = np.empty((1, rmat_small.num_vertices), np.int32)
+    with pytest.raises(ValueError, match="unavailable"):
+        res.parents_into(out, device="device")
+    with pytest.raises(ValueError, match="edge list"):
+        res.parents_into(out, device="auto")
+
+
+def test_scanner_rejects_unrepresentable_key(random_small):
+    # 32-bit keys: the distance field must hold the level cap.
+    ell = build_ell(random_small, kcap=64)
+    with pytest.raises(ParentScanUnavailable, match="distance field"):
+        ParentScanner(ell, max_dist=2**28)
+
+
+def test_parents_into_validates_args(random_small):
+    res = WidePackedMsBfsEngine(random_small).run(np.asarray([0, 1]))
+    with pytest.raises(ValueError, match="out is"):
+        res.parents_into(np.empty((3, random_small.num_vertices), np.int32))
+    with pytest.raises(ValueError, match="auto|host|device"):
+        res.parents_into(
+            np.empty((2, random_small.num_vertices), np.int32), device="gpu"
+        )
+
+
+def test_scan_after_checkpoint_finish(random_small):
+    # finish() results carry the same device state; the scan must work on
+    # them identically.
+    engine = WidePackedMsBfsEngine(random_small)
+    sources = np.asarray([5, 250])
+    st = engine.start(sources)
+    while not st.done:
+        st = engine.advance(st, levels=2)
+    res = engine.finish(st)
+    out = np.empty((2, random_small.num_vertices), np.int32)
+    res.parents_into(out, device="device")
+    np.testing.assert_array_equal(out, _oracle(random_small, sources, res))

@@ -173,14 +173,28 @@ class ExpandSpec(NamedTuple):
     tail_rows: int  # all-zero rows appended after the buckets
 
 
-def make_fori_expand(spec: ExpandSpec, w: int):
-    """Bucketed-ELL expansion with fori-loop OR accumulation.
+def make_fori_expand(spec: ExpandSpec, w: int, *, combine=None,
+                     identity: int = 0):
+    """Bucketed-ELL expansion with fori-loop accumulation.
 
     ``fw`` is the packed frontier table; returns the concatenated bucket
-    outputs (heavy rows, then light buckets, then ``tail_rows`` zeros). Only
-    one gather result is live at a time — the unrolled form kept ~20 padded
-    [n, w] intermediates alive and OOM'd at w >= 64.
+    outputs (heavy rows, then light buckets, then ``tail_rows`` identity
+    rows). Only one gather result is live at a time — the unrolled form kept
+    ~20 padded [n, w] intermediates alive and OOM'd at w >= 64.
+
+    ``combine``/``identity`` default to bitwise OR over 0 (the BFS frontier
+    expansion). Any associative-commutative u32 op with an identity works
+    over the same bucket structure — parent_scan.py runs this with
+    ``jnp.minimum`` over 0xFFFFFFFF to min-reduce per-lane parent keys,
+    because the fold pyramid and pad rows only assume those two algebraic
+    properties (pads/sentinels must be absorbed, order must not matter).
     """
+    if combine is None:
+        combine = jnp.bitwise_or
+    ident = jnp.uint32(identity)
+
+    def _full(shape):
+        return jnp.full(shape, ident, jnp.uint32)
 
     def expand(arrs, fw):
         parts = []
@@ -188,18 +202,17 @@ def make_fori_expand(spec: ExpandSpec, w: int):
             vr_t = arrs["virtual_t"]  # [kcap, M]
 
             def vbody(kk, acc):
-                return acc | fw[vr_t[kk]]
+                return combine(acc, fw[vr_t[kk]])
 
             acc = jax.lax.fori_loop(
-                0, spec.kcap, vbody,
-                jnp.zeros((spec.num_virtual, w), jnp.uint32),
+                0, spec.kcap, vbody, _full((spec.num_virtual, w))
             )
-            vr_ext = jnp.concatenate([acc, jnp.zeros((1, w), jnp.uint32)])
+            vr_ext = jnp.concatenate([acc, _full((1, w))])
             cur = vr_ext[arrs["fold_pad_map"]]
             pyramid = [cur]
             for _ in range(spec.fold_steps):
                 pairs = cur.reshape(-1, 2, w)
-                cur = pairs[:, 0] | pairs[:, 1]
+                cur = combine(pairs[:, 0], pairs[:, 1])
                 pyramid.append(cur)
             pyr = jnp.concatenate(pyramid) if len(pyramid) > 1 else pyramid[0]
             parts.append(pyr[arrs["heavy_pick"]])
@@ -207,12 +220,12 @@ def make_fori_expand(spec: ExpandSpec, w: int):
             bt = arrs[f"light{i}_t"]  # [k, n]
 
             def lbody(kk, acc, bt=bt):
-                return acc | fw[bt[kk]]
+                return combine(acc, fw[bt[kk]])
 
-            acc = jax.lax.fori_loop(0, k, lbody, jnp.zeros((n, w), jnp.uint32))
+            acc = jax.lax.fori_loop(0, k, lbody, _full((n, w)))
             parts.append(acc)
         if spec.tail_rows:
-            parts.append(jnp.zeros((spec.tail_rows, w), jnp.uint32))
+            parts.append(_full((spec.tail_rows, w)))
         return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
 
     return expand
@@ -452,17 +465,58 @@ class PackedBatchResult:
             )
         return self._parent_cache[i]
 
-    def parents_into(self, out: np.ndarray) -> np.ndarray:
-        """Fill ``out[i]`` with every lane's parent tree, evicting the
-        per-lane parent cache and each 32-lane distance word column once
-        its lanes are done — the bulk-export path (CLI --save-parent)
-        whose peak host memory is ``out`` plus one word column, not a
-        second cached [S, V] copy."""
+    def parents_into(self, out: np.ndarray, *, device: str = "auto") -> np.ndarray:
+        """Fill ``out[i]`` with every lane's parent tree.
+
+        ``device='auto'`` (default) runs the batched min-key scan on device
+        when the engine can supply a full-coverage ELL (parent_scan.py —
+        one bucketed min-expansion per 128 lanes, replacing an O(S*E) host
+        pass that cost ~an hour for the 4096-lane flagship batch), falling
+        back to the per-lane host path otherwise or on device OOM.
+        ``'host'`` forces the host path; ``'device'`` raises when the scan
+        is unavailable instead of falling back (tests pin each path)."""
         n = len(self.sources)
         if out.shape != (n, self._engine.num_vertices):
             raise ValueError(
                 f"out is {out.shape}, need ({n}, {self._engine.num_vertices})"
             )
+        if device not in ("auto", "host", "device"):
+            raise ValueError(f"device must be auto|host|device, got {device!r}")
+        scanner = None
+        if device != "host":
+            try:
+                scanner = parent_scanner_of(self._engine)
+            except Exception as exc:  # noqa: BLE001 — OOM-only fallback
+                # The scanner build itself transfers the full-ELL tables to
+                # the device (the largest new allocation on the hybrid
+                # path); an OOM there must fall back exactly like an OOM
+                # during the scan. The cache stays unset, so a later call
+                # with more headroom may still succeed.
+                if device == "device" or "RESOURCE_EXHAUSTED" not in str(exc):
+                    raise
+        if scanner is None and device == "device":
+            raise ValueError(
+                "device parent scan unavailable for this engine (needs a "
+                "full-coverage ELL or a retained host graph, and V small "
+                "enough for the 32-bit key encoding)"
+            )
+        if scanner is not None:
+            try:
+                return self._parents_into_scan(out, scanner)
+            except Exception as exc:  # noqa: BLE001 — OOM-only fallback
+                if device == "device" or "RESOURCE_EXHAUSTED" not in str(exc):
+                    raise
+                # The scanner's tables didn't fit next to the engine's;
+                # the host path overwrites every row, so partial device
+                # output is harmless.
+        return self._parents_into_host(out)
+
+    def _parents_into_host(self, out: np.ndarray) -> np.ndarray:
+        """Per-lane host extraction, evicting the per-lane parent cache and
+        each 32-lane distance word column once its lanes are done — peak
+        host memory is ``out`` plus one word column, not a second cached
+        [S, V] copy."""
+        n = len(self.sources)
         prev_word = None
         for i in range(n):
             out[i] = self.parents_int32(i)
@@ -474,6 +528,101 @@ class PackedBatchResult:
         if prev_word is not None:
             self._word_cache.pop(prev_word, None)
         return out
+
+    def _parents_into_scan(self, out: np.ndarray, scanner) -> np.ndarray:
+        """Device min-key scan over 128-lane column groups (parent_scan.py)."""
+        eng = self._engine
+        n = len(self.sources)
+        ell = scanner.ell
+        act = ell.num_active
+        if act != eng._act:
+            raise RuntimeError(
+                f"scanner row space ({act} active rows) does not match the "
+                f"engine's ({eng._act})"
+            )
+        # Scanner rows and engine rows both come from rank_vertices over the
+        # same edge list, so the permutation is the identity in practice —
+        # but the scan must be correct, not lucky, if a future engine ranks
+        # differently.
+        perm = None
+        if not np.array_equal(eng._rank, ell.rank):
+            perm = jnp.asarray(eng._rank[ell.old_of_new[:act]])
+        id_of_row = ell.old_of_new[:act]
+        w = eng.w
+        # lane_ids[l] = flat (word, bit) slot of batch entry l; inv is the
+        # inverse map. Word-major engines make both the identity, but the
+        # scan is lane-map-generic (the hybrid was bit-major until round 2).
+        lane_ids = eng._lane_order(np.arange(w * 32).reshape(w, 32))
+        inv = np.argsort(lane_ids)
+        iso = self._iso
+        L = scanner.lanes_per_pass
+        nw = L // 32
+        words = np.unique(lane_ids[:n] // 32)
+        for c0 in range(0, len(words), nw):
+            chunk = words[c0 : c0 + nw]
+            cols = [
+                eng._extract_word(self._planes, self._vis, self._src_bits, wi)
+                for wi in chunk
+            ]
+            dist_cols = (
+                jnp.concatenate(cols, axis=1) if len(cols) > 1 else cols[0]
+            )
+            if perm is not None:
+                dist_cols = dist_cols[perm]
+            if len(chunk) * 32 < L:
+                dist_cols = jnp.concatenate(
+                    [
+                        dist_cols,
+                        jnp.full(
+                            (act, L - len(chunk) * 32), UNREACHED, jnp.uint8
+                        ),
+                    ],
+                    axis=1,
+                )
+            pc = np.asarray(scanner.scan(dist_cols))  # [act, L] int32
+            for j, wi in enumerate(chunk):
+                for b in range(32):
+                    lane = int(inv[32 * wi + b])
+                    if lane >= n or (iso is not None and iso[lane]):
+                        continue
+                    row = out[lane]
+                    row.fill(-1)
+                    row[id_of_row] = pc[:, 32 * j + b]
+        if iso is not None:
+            # Isolated sources never reach the device; their component is
+            # {source} (same convention as distance_u8_lane).
+            for lane in np.flatnonzero(iso[:n]):
+                out[lane].fill(-1)
+                out[lane][self.sources[lane]] = self.sources[lane]
+        return out
+
+
+def parent_scanner_of(engine):
+    """Lazy per-engine ParentScanner; None when unavailable (no
+    full-coverage ELL source, or V too large for the 32-bit key encoding
+    at the engine's level cap). Cached on the engine so the hybrid's lazy
+    full-ELL build and the scan program compile happen once."""
+    cached = getattr(engine, "_parent_scanner_cache", None)
+    if cached is not None:
+        return cached or None  # False marks a probed-and-unavailable engine
+    from tpu_bfs.algorithms.parent_scan import (
+        ParentScanner,
+        ParentScanUnavailable,
+    )
+
+    scanner = None
+    get = getattr(engine, "_full_parent_ell", None)
+    if get is not None:
+        ell, arrs = get()
+        if ell is not None:
+            try:
+                scanner = ParentScanner(
+                    ell, arrs=arrs, max_dist=engine.max_levels_cap
+                )
+            except ParentScanUnavailable:
+                scanner = None
+    engine._parent_scanner_cache = scanner if scanner is not None else False
+    return scanner
 
 
 def min_parents_lane(graph, source: int, dist: np.ndarray) -> np.ndarray:

@@ -471,6 +471,18 @@ class HybridMsBfsEngine:
     def _seed_dev(self, sources: np.ndarray):
         return self._seed(*seed_scatter_args(self.hg.rank[sources], self._act))
 
+    def _full_parent_ell(self):
+        """Structure for the batched parent scan (parent_scan.py). The
+        residual ELL alone cannot derive parents — dense-tile edges are
+        missing from it — so build a full in-neighbor ELL lazily from the
+        retained host graph (same rank_vertices row space by construction).
+        parent_scanner_of caches the resulting scanner on the engine."""
+        if self.host_graph is None:
+            return None, None
+        from tpu_bfs.graph.ell import build_ell
+
+        return build_ell(self.host_graph, kcap=self.hg.kcap), None
+
     def run(self, sources, *, max_levels=None, time_it=False, check_cap=True):
         return run_packed_batch(
             self, sources, max_levels=max_levels, time_it=time_it,

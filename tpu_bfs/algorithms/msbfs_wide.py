@@ -169,6 +169,14 @@ class WidePackedMsBfsEngine:
     def _seed_dev(self, sources: np.ndarray):
         return self._seed(*seed_scatter_args(self.ell.rank[sources], self._act))
 
+    def _full_parent_ell(self):
+        """Full-coverage ELL + device arrays for the batched parent scan
+        (parent_scan.py): the gather-only engine expands over every edge
+        already, so the scan borrows its tables for free — this also makes
+        bulk parent extraction work for prebuilt-ELL engines, which the
+        host path cannot serve (no retained edge list)."""
+        return self.ell, self.arrs
+
     def run(self, sources, *, max_levels=None, time_it=False, check_cap=True):
         return run_packed_batch(
             self, sources, max_levels=max_levels, time_it=time_it,

@@ -1,0 +1,134 @@
+"""Device-side batched BFS-tree extraction for the packed MS-BFS engines.
+
+The packed level loop labels distances only (bit-sliced planes); parent
+trees are derived afterwards. The old derivation was one host-side
+O(E) ``np.minimum.at`` per lane (~0.5-1 s at scale 21) — fine for sampling
+a few lanes, but the full 4096-lane flagship batch cost ~an hour of host
+time (VERDICT r3 weak #3). This module moves the whole batch onto the
+device as a handful of bucketed-ELL *min*-expansions.
+
+Why one pass with no per-level loop works: along any edge u->v the BFS
+relaxation guarantees ``dist(u) >= dist(v) - 1`` (directed in-neighbors
+included — BFS relaxes along edge direction), and every reached v with
+``dist(v) >= 1`` has at least one in-neighbor at exactly ``dist(v) - 1``.
+Therefore the lexicographic minimum over v's in-neighbors of the 32-bit key
+
+    key(u) = (dist(u) << idbits) | orig_id(u)
+
+is attained at a neighbor with the minimum distance ``dist(v) - 1``, and —
+among those — the minimum ORIGINAL id: precisely the deterministic
+min-parent tree every engine emits (validate.min_parent_from_dist), the
+race-free replacement for the reference's nondeterministic atomicMin winner
+(bfs.cu:146-147, 940). A min-reduction over in-neighbors is exactly the
+shape of the engines' frontier expansion (OR over in-neighbors), so the
+scan reuses the same bucketed-ELL machinery (_packed_common.make_fori_expand
+with ``jnp.minimum`` over 0xFFFFFFFF) — same gathers, same fold pyramid,
+same cost profile as ONE BFS level per 128 lanes.
+
+Decode per (row, lane): valid iff the best key's distance field equals
+``dist(v) - 1``; unreached rows and rows whose neighbors are all unreached
+fail that check and come out -1. Sources (dist 0) map to themselves; a
+level-1 child's min-key neighbor at distance 0 IS the lane's source, so no
+special case is needed for it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from tpu_bfs.graph.ell import EllGraph
+from tpu_bfs.algorithms.msbfs_packed import UNREACHED
+from tpu_bfs.algorithms._packed_common import (
+    ExpandSpec,
+    expand_arrays,
+    make_fori_expand,
+)
+
+# Lanes decoded per device pass: 32-lane word columns are extracted in
+# groups of this many u32 key columns. 128 matches the engines' native
+# [.., 128] uint32 tile shape, so each pass costs about one BFS level.
+LANES_PER_PASS = 128
+
+
+class ParentScanUnavailable(ValueError):
+    """The key encoding cannot represent this graph (id field too wide for
+    the distance field). Callers fall back to the host path."""
+
+
+class ParentScanner:
+    """Batched min-key parent extraction over a full in-neighbor ELL.
+
+    ``ell`` must cover ALL edges (the wide/512-lane engines' own ELL
+    qualifies and its device arrays can be shared via ``arrs``; the hybrid
+    engine's residual ELL does NOT — build a fresh full ELL for it).
+    ``max_dist`` is the largest distance the key must represent exactly
+    (the engine's level cap); ids and distances share 32 bits, so huge
+    graphs with deep caps can be unrepresentable -> ParentScanUnavailable.
+    """
+
+    def __init__(self, ell: EllGraph, *, arrs=None, max_dist: int = 254,
+                 lanes_per_pass: int = LANES_PER_PASS):
+        act = ell.num_active
+        self.ell = ell
+        self.lanes_per_pass = lanes_per_pass
+        self.idbits = max(int(ell.num_vertices - 1).bit_length(), 1)
+        # Distances live in the top (32 - idbits) bits. Anything the field
+        # cannot hold (UNREACHED above all) clamps to the field max, which
+        # must exceed every REAL distance so clamped garbage never decodes
+        # as a valid parent (valid needs du == dv - 1 <= max_dist - 1).
+        self.dumax = (1 << (32 - self.idbits)) - 1
+        if self.dumax < max_dist + 1:
+            raise ParentScanUnavailable(
+                f"V={ell.num_vertices} needs {self.idbits} id bits, leaving "
+                f"a distance field of at most {self.dumax} < cap {max_dist}+1"
+            )
+        spec = ExpandSpec(
+            kcap=ell.kcap,
+            heavy=ell.num_heavy > 0,
+            num_virtual=ell.num_virtual,
+            fold_steps=ell.fold_steps,
+            light_meta=tuple((b.k, b.n) for b in ell.light),
+            tail_rows=act - ell.num_nonzero + 1,
+        )
+        expand_min = make_fori_expand(
+            spec, lanes_per_pass, combine=jnp.minimum, identity=0xFFFFFFFF
+        )
+        self.arrs = expand_arrays(ell) if arrs is None else arrs
+        id_of_row = ell.old_of_new[:act].astype(np.uint32)
+        idbits, dumax = self.idbits, self.dumax
+        idmask = jnp.uint32((1 << idbits) - 1)
+
+        @jax.jit
+        def scan_pass(arrs, dist_cols):
+            """[act, L] u8 distances -> [act, L] int32 original-id parents
+            (-1 where none; sources map to themselves)."""
+            ids = jnp.asarray(id_of_row)
+            du = jnp.minimum(dist_cols.astype(jnp.uint32), jnp.uint32(dumax))
+            keys = (du << idbits) | ids[:, None]
+            # Sentinel row `act` (the pad gather target) must be the min
+            # identity so padded slots never win.
+            keys = jnp.concatenate(
+                [keys, jnp.full((1, lanes_per_pass), 0xFFFFFFFF, jnp.uint32)]
+            )
+            mk = expand_min(arrs, keys)[:act]
+            dv = dist_cols.astype(jnp.int32)
+            valid = (
+                (dv != UNREACHED)
+                & ((mk >> idbits).astype(jnp.int32) == dv - 1)
+            )
+            pid = (mk & idmask).astype(jnp.int32)
+            return jnp.where(
+                dv == 0,
+                jnp.asarray(id_of_row.astype(np.int32))[:, None],
+                jnp.where(valid, pid, jnp.int32(-1)),
+            )
+
+        self._scan_pass = scan_pass
+
+    def scan(self, dist_cols) -> jax.Array:
+        """Run one device pass. ``dist_cols`` is [num_active, lanes_per_pass]
+        uint8 (UNREACHED-padded when fewer real columns remain)."""
+        return self._scan_pass(self.arrs, dist_cols)

@@ -5,28 +5,52 @@
 # until the flagship number lands or the attempt budget runs out (the
 # bench's own retry ladder handles intra-run blips; this loop handles
 # multi-hour outages).
+#
+# The bench's outage envelope (TPU_BFS_BENCH_BUDGET_S, default 2400 s)
+# makes each attempt terminate cleanly with a value=null JSON when the
+# chip never comes up — rc alone no longer distinguishes success, so
+# every stage's JSON is checked for a non-null value.
 set -u
 out=.bench_cache/chip_session
 attempts="${CHIP_SESSION_ATTEMPTS:-12}"
 mkdir -p "$out"
+
+got_value() {  # true iff $1 ends with a JSON line carrying a non-null value
+  python - "$1" <<'EOF'
+import json, sys
+try:
+    with open(sys.argv[1]) as f:
+        lines = [l for l in f if l.strip().startswith("{")]
+    sys.exit(0 if lines and json.loads(lines[-1])["value"] is not None else 1)
+except Exception:
+    sys.exit(1)
+EOF
+}
+
+stage() {  # stage <name> <json-out> [ENV=VAL...] — one bench.py run
+  local name="$1" json="$2"; shift 2
+  echo "=== $name $(date -u +%H:%M:%S) ==="
+  if env "$@" python bench.py >"$json" 2>"${json%.json}.log" \
+      && got_value "$json"; then
+    echo "$name OK: $(tail -1 "$json")"
+    return 0
+  fi
+  echo "$name FAILED (see ${json%.json}.log): $(tail -1 "$json" 2>/dev/null)"
+  return 1
+}
+
 for i in $(seq 1 "$attempts"); do
-  echo "=== attempt $i: flagship bench $(date -u +%H:%M:%S) ==="
-  if python bench.py >"$out/flagship.json" 2>"$out/flagship.log"; then
-    echo "flagship OK: $(cat "$out/flagship.json")"
+  echo "=== attempt $i $(date -u +%H:%M:%S) ==="
+  if stage "flagship" "$out/flagship.json"; then
     echo "=== width probe ==="
     python scripts/width_probe.py >"$out/width_probe.jsonl" 2>"$out/width_probe.log" \
       && echo "width probe OK" || echo "width probe FAILED (see $out/width_probe.log)"
     cat "$out/width_probe.jsonl" 2>/dev/null
-    echo "=== 8192-lane flagship sweep ==="
-    TPU_BFS_BENCH_MAX_LANES=8192 python bench.py >"$out/flagship_8k.json" 2>"$out/flagship_8k.log" \
-      && echo "8k sweep OK: $(cat "$out/flagship_8k.json")" \
-      || echo "8k sweep FAILED (see $out/flagship_8k.log)"
+    stage "8192-lane sweep" "$out/flagship_8k.json" TPU_BFS_BENCH_MAX_LANES=8192
+    stage "16384-lane sweep" "$out/flagship_16k.json" TPU_BFS_BENCH_MAX_LANES=16384
+    stage "lj-hybrid" "$out/lj_hybrid.json" TPU_BFS_BENCH_MODE=lj-hybrid
     exit 0
-  else
-    rc=$?  # captured at else-entry, before any other command clobbers it
   fi
-  echo "flagship attempt $i failed (rc=$rc); tail of log:"
-  tail -2 "$out/flagship.log"
   [ "$i" -lt "$attempts" ] && sleep "${CHIP_SESSION_SLEEP:-300}"
 done
 echo "chip never came back within the attempt budget"

@@ -129,6 +129,23 @@ def test_scan_unavailable_raises_when_forced(rmat_small):
         res.parents_into(out, device="auto")
 
 
+def test_scanner_cache_policy(random_small, rmat_small):
+    # Borrowing scanners (wide: the engine's own ELL tables) are cached;
+    # owning scanners (hybrid: a freshly transferred full ELL) are not —
+    # their device tables must not outlive the bulk export.
+    from tpu_bfs.algorithms._packed_common import parent_scanner_of
+
+    wide = WidePackedMsBfsEngine(random_small)
+    s1 = parent_scanner_of(wide)
+    assert s1 is not None and parent_scanner_of(wide) is s1
+
+    hyb = HybridMsBfsEngine(rmat_small, lanes=256, tile_thr=4)
+    res = hyb.run(np.asarray([1]))
+    out = np.empty((1, rmat_small.num_vertices), np.int32)
+    res.parents_into(out, device="device")
+    assert getattr(hyb, "_parent_scanner_cache", None) is None
+
+
 def test_scanner_rejects_unrepresentable_key(random_small):
     # 32-bit keys: the distance field must hold the level cap.
     ell = build_ell(random_small, kcap=64)

@@ -600,8 +600,16 @@ class PackedBatchResult:
 def parent_scanner_of(engine):
     """Lazy per-engine ParentScanner; None when unavailable (no
     full-coverage ELL source, or V too large for the 32-bit key encoding
-    at the engine's level cap). Cached on the engine so the hybrid's lazy
-    full-ELL build and the scan program compile happen once."""
+    at the engine's level cap).
+
+    Caching policy follows who owns the device tables: a scanner that
+    BORROWS the engine's existing ELL arrays (the wide engines — zero
+    extra HBM) is cached on the engine; a scanner that had to build and
+    transfer its OWN full-ELL tables (the hybrid, whose dense-tile design
+    exists to avoid holding a full ELL) is returned uncached, so its
+    device memory is released with the scanner after the bulk export
+    instead of growing the engine's footprint for its whole lifetime.
+    Unavailability is cached either way."""
     cached = getattr(engine, "_parent_scanner_cache", None)
     if cached is not None:
         return cached or None  # False marks a probed-and-unavailable engine
@@ -611,9 +619,11 @@ def parent_scanner_of(engine):
     )
 
     scanner = None
+    borrowed = False
     get = getattr(engine, "_full_parent_ell", None)
     if get is not None:
         ell, arrs = get()
+        borrowed = arrs is not None
         if ell is not None:
             try:
                 scanner = ParentScanner(
@@ -621,7 +631,10 @@ def parent_scanner_of(engine):
                 )
             except ParentScanUnavailable:
                 scanner = None
-    engine._parent_scanner_cache = scanner if scanner is not None else False
+    if scanner is None:
+        engine._parent_scanner_cache = False
+    elif borrowed:
+        engine._parent_scanner_cache = scanner
     return scanner
 
 

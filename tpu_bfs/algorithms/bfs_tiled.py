@@ -199,8 +199,11 @@ class TiledBfsEngine:
         # Edge/tile arrays are jit ARGUMENTS, not closure constants: baked-in
         # constants get serialized into the compile request (hundreds of MB
         # here — the remote compile service rejects them outright).
+        # ``level0`` makes this the checkpoint-resume entry too: the
+        # while-loop carry IS the traversal state, so resuming from a saved
+        # (frontier, visited, dist, level) is bit-identical to no stop.
         @jax.jit
-        def loop(edges, tiles, frontier0, visited0, dist0, max_levels):
+        def loop(edges, tiles, frontier0, visited0, dist0, level0, max_levels):
             def cond(state):
                 _, _, _, lvl, count = state
                 return (count > 0) & (lvl < max_levels)
@@ -213,10 +216,10 @@ class TiledBfsEngine:
                 return nxt, visited, dist, lvl + 1, jnp.sum(nxt.astype(jnp.int32))
 
             init = jnp.sum(frontier0.astype(jnp.int32))
-            _, _, dist, lvl, _ = lax.while_loop(
-                cond, body, (frontier0, visited0, dist0, jnp.int32(0), init)
+            frontier, visited, dist, lvl, _ = lax.while_loop(
+                cond, body, (frontier0, visited0, dist0, level0, init)
             )
-            return dist, lvl
+            return frontier, visited, dist, lvl
 
         return loop
 
@@ -248,19 +251,23 @@ class TiledBfsEngine:
             d0 = jnp.full((self.rows,), INT32_MAX, jnp.int32).at[rs].set(0)
             ml = jnp.int32(max_levels if max_levels is not None else self.rows)
             return self._loop(
-                self._edges, (self._a, self._col_t, self._seg), f0, f0, d0, ml
+                self._edges, (self._a, self._col_t, self._seg), f0, f0, d0,
+                jnp.int32(0), ml,
             )
 
         elapsed = None
         if time_it:
-            (dist_dev, _), elapsed = run_timed(go, warm=not self._warmed)
+            (_, _, dist_dev, _), elapsed = run_timed(go, warm=not self._warmed)
             self._warmed = True
         else:
-            dist_dev, _ = go()
+            _, _, dist_dev, _ = go()
 
         dr = np.asarray(dist_dev)
         live = self._rank < self._act
         dist_v[live] = dr[self._rank[live]]
+        return self._package(dist_v, source, with_parents, elapsed)
+
+    def _package(self, dist_v, source, with_parents, elapsed) -> BfsResult:
         dist_v = np.where(dist_v == INT32_MAX, INF_DIST, dist_v)
         reached_mask = dist_v != INF_DIST
         reached = int(reached_mask.sum())
@@ -282,4 +289,65 @@ class TiledBfsEngine:
             reached=reached,
             edges_traversed=slots // 2 if undirected else slots,
             elapsed_s=elapsed,
+        )
+
+    # --- checkpoint/resume (tpu_bfs/utils/checkpoint.py; SURVEY.md §5:
+    # the reference has none). Checkpoints hold REAL-vertex-id arrays [V]
+    # like every other single-source engine, so a checkpoint taken here
+    # resumes on BfsEngine / DistBfsEngine / Dist2DBfsEngine and back. ---
+
+    def start(self, source: int):
+        """Level-0 traversal state as a host checkpoint (no device work)."""
+        from tpu_bfs.utils.checkpoint import initial_checkpoint
+
+        return initial_checkpoint(self.num_vertices, source)
+
+    def advance(self, ckpt, levels: int | None = None):
+        """Run at most ``levels`` more levels; bit-identical to no stop."""
+        from tpu_bfs.utils.checkpoint import BfsCheckpoint
+
+        if len(ckpt.frontier) != self.num_vertices:
+            raise ValueError(
+                f"checkpoint has {len(ckpt.frontier)} vertices, graph has "
+                f"{self.num_vertices}"
+            )
+        live = self._rank < self._act
+        rows_live = self._rank[live]
+        f0 = np.zeros(self.rows, dtype=bool)
+        f0[rows_live] = ckpt.frontier[live]
+        vis0 = np.zeros(self.rows, dtype=bool)
+        vis0[rows_live] = ckpt.visited[live]
+        d0 = np.full(self.rows, INT32_MAX, np.int32)
+        d0[rows_live] = ckpt.distance[live]  # INF_DIST == INT32_MAX
+        cap = ckpt.level + levels if levels is not None else self.rows
+        frontier, visited, dist, level = self._loop(
+            self._edges, (self._a, self._col_t, self._seg),
+            jnp.asarray(f0), jnp.asarray(vis0), jnp.asarray(d0),
+            jnp.int32(ckpt.level), jnp.int32(min(cap, self.rows)),
+        )
+        fr, vr, dr = (np.asarray(a) for a in (frontier, visited, dist))
+        f_v = np.zeros(self.num_vertices, dtype=bool)
+        f_v[live] = fr[rows_live]
+        vis_v = np.zeros(self.num_vertices, dtype=bool)
+        vis_v[live] = vr[rows_live]
+        d_v = np.full(self.num_vertices, INF_DIST, np.int32)
+        d_v[live] = dr[rows_live]
+        # An isolated source has no rank row; its state lives only in the
+        # checkpoint (component == {source}, done after this advance).
+        if not live[ckpt.source]:
+            vis_v[ckpt.source] = True
+            d_v[ckpt.source] = 0
+        return BfsCheckpoint(
+            source=ckpt.source,
+            level=int(level),
+            frontier=f_v,
+            visited=vis_v,
+            distance=d_v,
+            nonce=getattr(ckpt, "nonce", None),
+        )
+
+    def finish(self, ckpt, *, with_parents: bool = True) -> BfsResult:
+        """Convert a (finished or partial) checkpoint into a BfsResult."""
+        return self._package(
+            ckpt.distance.copy(), ckpt.source, with_parents, None
         )

@@ -96,8 +96,18 @@ class ParentScanner:
         expand_min = make_fori_expand(
             spec, lanes_per_pass, combine=jnp.minimum, identity=0xFFFFFFFF
         )
-        self.arrs = expand_arrays(ell) if arrs is None else arrs
-        id_of_row = ell.old_of_new[:act].astype(np.uint32)
+        # Copy the (possibly borrowed) dict so adding the id array never
+        # mutates the engine's own arrs — that would change the pytree
+        # structure of the engine's compiled calls. The underlying device
+        # buffers are shared either way. The id array rides in arrs as a
+        # jit ARGUMENT, not a closure constant: baked-in [act]-sized
+        # constants get serialized into the compile request, which the
+        # remote compile service rejects at flagship scales (the same
+        # constraint bfs_tiled.py documents for its edge/tile arrays).
+        self.arrs = dict(expand_arrays(ell) if arrs is None else arrs)
+        self.arrs["pscan_ids"] = jnp.asarray(
+            ell.old_of_new[:act].astype(np.uint32)
+        )
         idbits, dumax = self.idbits, self.dumax
         idmask = jnp.uint32((1 << idbits) - 1)
 
@@ -105,7 +115,7 @@ class ParentScanner:
         def scan_pass(arrs, dist_cols):
             """[act, L] u8 distances -> [act, L] int32 original-id parents
             (-1 where none; sources map to themselves)."""
-            ids = jnp.asarray(id_of_row)
+            ids = arrs["pscan_ids"]
             du = jnp.minimum(dist_cols.astype(jnp.uint32), jnp.uint32(dumax))
             keys = (du << idbits) | ids[:, None]
             # Sentinel row `act` (the pad gather target) must be the min
@@ -122,7 +132,7 @@ class ParentScanner:
             pid = (mk & idmask).astype(jnp.int32)
             return jnp.where(
                 dv == 0,
-                jnp.asarray(id_of_row.astype(np.int32))[:, None],
+                ids.astype(jnp.int32)[:, None],
                 jnp.where(valid, pid, jnp.int32(-1)),
             )
 

@@ -366,9 +366,6 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if (args.mesh or args.devices > 1) and args.backend in ("delta", "tiled"):
         ap.error(f"--backend {args.backend} is single-device only")
-    if args.backend == "tiled" and (args.ckpt or args.resume):
-        ap.error("--backend tiled has no checkpoint support; use dopt for "
-                 "checkpointed single-source runs")
     if args.mesh and args.exchange == "sparse":
         ap.error("--exchange sparse pairs with 1D --devices meshes; the 2D "
                  "engine's row/column collectives already move O(vp/dim) bits")

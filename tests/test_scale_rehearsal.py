@@ -133,7 +133,7 @@ t_run = time.perf_counter() - t0
 from tpu_bfs.reference import bfs_scipy
 np.testing.assert_array_equal(res.distances_int32(0), bfs_scipy(g, hub))
 
-rows_loc = (eng.hd["vt"] // P) * 128
+rows_loc = eng._gather_rows_loc  # the engine's own layout, one source of truth
 state_pd = per_device_bytes((res._planes, res._vis, res._src_bits))
 struct_pd = per_device_bytes(eng.arrs)
 struct_host = sum(

@@ -1,0 +1,42 @@
+"""Wire-byte model vs the compiled program (utils/wirecheck.py).
+
+The framework's traffic accounting is modeled (formula x exact branch
+counts); these tests pin the formulas to what XLA actually compiles on
+the 8-virtual-device mesh — the one-time calibration VERDICT r3 #6 asked
+for. If an exchange implementation changes shape (a cap buffer grows a
+field, the ring gains a step), the model and the HLO diverge and this
+fails loudly.
+"""
+
+from tpu_bfs.utils.wirecheck import check_1d_sparse, check_sliced_hybrid
+
+
+def test_1d_sparse_model_matches_hlo(random_small):
+    rep = check_1d_sparse(random_small, p=8)
+    assert rep["agree"], rep
+    # Both sparse cap branches and the dense ring fallback are present.
+    assert len(rep["modeled_per_level"]) == 3, rep
+    assert rep["ring_steps"] == 7, rep
+
+
+def test_sliced_hybrid_model_matches_hlo(rmat_small):
+    rep = check_sliced_hybrid(rmat_small, p=8)
+    assert rep["agree"], rep
+    assert rep["ring_steps"] == 7, rep
+
+
+def test_shape_parsing():
+    from tpu_bfs.utils.wirecheck import Collective, hlo_collectives
+
+    txt = """
+  %a = pred[1024]{0} collective-permute(%x), channel_id=1
+  %b = (s32[1,16]{1,0}, s32[1,16]{1,0}, s32[1,16]{1,0}) all-to-all(%y)
+  %c = s32[] all-reduce(%z), to_apply=%sum
+  %g = get-tuple-element(%all-to-all.1), index=3
+"""
+    got = hlo_collectives(txt)
+    assert got == [
+        Collective("collective-permute", 1024, 1),
+        Collective("all-to-all", 192, 3),
+        Collective("all-reduce", 4, 1),
+    ]

@@ -33,6 +33,8 @@ Lane convention: word-major — lane ``l`` at word ``l // 32``, bit ``l % 32``.
 
 from __future__ import annotations
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from tpu_bfs.graph.csr import Graph
@@ -65,7 +67,7 @@ MAX_LANES = 4 * LANES
 from tpu_bfs.algorithms._packed_common import PackedBatchResult as WideBfsResult  # noqa: E402
 
 
-def _make_core(ell: EllGraph, w: int, num_planes: int):
+def _make_core(ell: EllGraph, w: int, num_planes: int, push_cfg=None):
     act = ell.num_active
     spec = ExpandSpec(
         kcap=ell.kcap,
@@ -80,7 +82,50 @@ def _make_core(ell: EllGraph, w: int, num_planes: int):
     # fw is [act+1, w]: frontier bits; sentinel row act is all-zero and is
     # never written (expand emits zero there, and `& ~vis` keeps it zero).
     expand = make_fori_expand(spec, w)
-    return make_packed_loop(expand, num_planes)
+    if push_cfg is None:
+        return make_packed_loop(expand, num_planes)
+
+    # Level-adaptive expansion (experimental, VERDICT r3 #8): the bucketed
+    # pull pays the FULL ELL slot scan every level, light or heavy. When a
+    # level's packed union frontier is sparse (<= row_cap active rows, all
+    # with out-degree <= deg_cap), a push-style pass touches only the
+    # active rows' out-edges instead: a sequential fori over the compacted
+    # active rows, each step OR-scattering its frontier word row into its
+    # out-neighbors' hit rows. Push-over-out-edges computes the same hit
+    # as pull-over-in-edges by construction (the out-CSR push table is
+    # built edge-exact, directed or not). Heavy frontiers and any level
+    # touching a >deg_cap row take the normal pull path via lax.cond.
+    row_cap, deg_cap = push_cfg
+
+    def hit_of(arrs, fw):
+        rows_active = jnp.any(fw[:act] != 0, axis=1)
+        nz = jnp.sum(rows_active.astype(jnp.int32))
+        bad = jnp.any(rows_active & arrs["push_inelig"])
+        light = (nz <= row_cap) & ~bad
+
+        def push_fn():
+            idx = jnp.where(rows_active, size=row_cap, fill_value=act)[0]
+            pt = arrs["push_t"]
+
+            def pbody(i, hit):
+                r = idx[i]  # act (sentinel) when padding: fw[act] == 0
+                nb = pt[r]  # [deg_cap], pad slots -> sentinel row act
+                return hit.at[nb].set(hit[nb] | fw[r][None, :])
+
+            # Traced trip count: the loop runs nz steps (lowered to a
+            # while loop), so a 40-row level costs 40 scatter steps, not
+            # row_cap. idx is row_cap-wide regardless; slots past nz are
+            # sentinel padding and would be no-ops anyway.
+            hit = jax.lax.fori_loop(
+                0, nz, pbody, jnp.zeros((act + 1, w), jnp.uint32)
+            )
+            # Pad slots OR real frontier words into the sentinel row;
+            # restore its all-zero invariant (next level gathers it).
+            return hit.at[act].set(0)
+
+        return jax.lax.cond(light, push_fn, lambda: expand(arrs, fw))
+
+    return make_packed_loop(hit_of, num_planes)
 
 
 class WidePackedMsBfsEngine:
@@ -103,6 +148,7 @@ class WidePackedMsBfsEngine:
         undirected: bool | None = None,
         hbm_budget_bytes: int = int(14.0e9),
         max_lanes: int = LANES,
+        adaptive_push: tuple[int, int] | None = None,
     ):
         if not (1 <= num_planes <= 8):
             raise ValueError("num_planes must be in [1, 8]")
@@ -123,11 +169,18 @@ class WidePackedMsBfsEngine:
         self._act = self.ell.num_active
         if lanes == "auto":
             # Halve from max_lanes until the packed state fits HBM next to
-            # the ELL.
+            # the ELL (and the push table, when the adaptive path is on —
+            # its [act+1, deg_cap] int32 rows are lane-independent
+            # residents just like the ELL indices).
+            push_bytes = (
+                (self._act + 1) * (adaptive_push[1] * 4 + 1)
+                if adaptive_push is not None
+                else 0
+            )
             lanes = auto_lanes(
                 self._act + 1,
                 num_planes,
-                fixed_bytes=int(self.ell.total_slots * 4.4),
+                fixed_bytes=int(self.ell.total_slots * 4.4) + push_bytes,
                 hbm_budget_bytes=hbm_budget_bytes,
                 max_lanes=max_lanes,
             )
@@ -140,8 +193,12 @@ class WidePackedMsBfsEngine:
         self.undirected = self.ell.undirected if undirected is None else undirected
         ell = self.ell
         self.arrs = expand_arrays(ell)
+        if adaptive_push is not None:
+            self._build_push_table(adaptive_push)
         self._table_rows = self._act + 1  # + the all-zero sentinel row
-        self._core, self._core_from = _make_core(ell, self.w, num_planes)
+        self._core, self._core_from = _make_core(
+            ell, self.w, num_planes, adaptive_push
+        )
         in_deg_ranked = ell.in_degree[ell.old_of_new].astype(np.int32)
         self._seed, self._lane_stats, self._extract_word = make_state_kernels(
             ell.num_vertices, self._act + 1, self.w, num_planes,
@@ -149,6 +206,35 @@ class WidePackedMsBfsEngine:
         )
         self._rank = ell.rank
         self._warmed = False
+
+    def _build_push_table(self, push_cfg):
+        """Out-CSR push table in rank space for the adaptive light-level
+        path (see _make_core): [act+1, deg_cap] out-neighbor rank ids
+        (pad/sentinel = act) plus the per-row ineligibility mask (out-deg
+        > deg_cap). Needs the retained host edge list."""
+        if self.host_graph is None:
+            raise ValueError(
+                "adaptive_push needs the edge list: construct the engine "
+                "from a Graph (a prebuilt ELL has dropped it)"
+            )
+        _, deg_cap = push_cfg
+        act = self._act
+        src, dst = self.host_graph.coo
+        rank = self.ell.rank
+        rs = rank[src].astype(np.int64)
+        rd = rank[dst].astype(np.int32)
+        out_deg = np.bincount(rs, minlength=act)[:act]
+        elig = out_deg <= deg_cap
+        order = np.argsort(rs, kind="stable")
+        rs_s, rd_s = rs[order], rd[order]
+        rp = np.zeros(act + 1, np.int64)
+        np.cumsum(out_deg, out=rp[1:])
+        pos = np.arange(len(rs_s), dtype=np.int64) - rp[rs_s]
+        keep = elig[rs_s]
+        pt = np.full((act + 1, deg_cap), act, np.int32)
+        pt[rs_s[keep], pos[keep]] = rd_s[keep]
+        self.arrs["push_t"] = jnp.asarray(pt)
+        self.arrs["push_inelig"] = jnp.asarray(~elig)
 
     @property
     def num_vertices(self) -> int:

@@ -139,7 +139,6 @@ def check_sliced_hybrid(graph, p: int = 8) -> dict:
     ((P-1) x [rows_loc, w] u32 per level) vs the compiled rotation's
     permute operand and the engine's own static ring-step count."""
     import jax.numpy as jnp
-    import numpy as np
 
     from tpu_bfs.parallel.dist_bfs import make_mesh
     from tpu_bfs.parallel.dist_msbfs_hybrid import DistHybridMsBfsEngine

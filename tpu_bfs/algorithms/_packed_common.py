@@ -535,18 +535,25 @@ class PackedBatchResult:
         n = len(self.sources)
         ell = scanner.ell
         act = ell.num_active
-        if act != eng._act:
-            raise RuntimeError(
-                f"scanner row space ({act} active rows) does not match the "
-                f"engine's ({eng._act})"
-            )
-        # Scanner rows and engine rows both come from rank_vertices over the
-        # same edge list, so the permutation is the identity in practice —
-        # but the scan must be correct, not lucky, if a future engine ranks
-        # differently.
+        # Map engine extraction rows -> scanner rows through ORIGINAL ids,
+        # so any engine row space works: the single-chip engines share the
+        # scanner's active-first rank (identity, no gather), while the
+        # distributed engines extract over chip-major padded tables of a
+        # different height and order (dist_msbfs_wide.py: every vertex has
+        # a row; tau order in the hybrid) — the perm pulls exactly the
+        # scanner's active vertices out of whatever table the engine has.
         perm = None
-        if not np.array_equal(eng._rank, ell.rank):
-            perm = jnp.asarray(eng._rank[ell.old_of_new[:act]])
+        if eng._act != act or not np.array_equal(
+            np.asarray(eng._rank), np.asarray(ell.rank)
+        ):
+            perm_np = np.asarray(eng._rank)[ell.old_of_new[:act]]
+            if perm_np.min() < 0 or perm_np.max() >= eng._act:
+                raise RuntimeError(
+                    "engine row map does not cover the scanner's active "
+                    f"vertices (rows [{perm_np.min()}, {perm_np.max()}] vs "
+                    f"{eng._act} extraction rows)"
+                )
+            perm = jnp.asarray(perm_np)
         id_of_row = ell.old_of_new[:act]
         w = eng.w
         # lane_ids[l] = flat (word, bit) slot of batch entry l; inv is the
@@ -636,6 +643,18 @@ def parent_scanner_of(engine):
     elif borrowed:
         engine._parent_scanner_cache = scanner
     return scanner
+
+
+def lazy_full_parent_ell(host_graph, kcap: int = 64):
+    """Shared `_full_parent_ell` body for engines whose own structures
+    cannot serve the parent scan (dense tiles, per-chip residual shards):
+    a fresh single-device full in-neighbor ELL from the retained host
+    graph, with owned (engine-uncached) device tables."""
+    if host_graph is None:
+        return None, None
+    from tpu_bfs.graph.ell import build_ell
+
+    return build_ell(host_graph, kcap=kcap), None
 
 
 def min_parents_lane(graph, source: int, dist: np.ndarray) -> np.ndarray:

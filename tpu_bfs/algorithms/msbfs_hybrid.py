@@ -476,12 +476,10 @@ class HybridMsBfsEngine:
         residual ELL alone cannot derive parents — dense-tile edges are
         missing from it — so build a full in-neighbor ELL lazily from the
         retained host graph (same rank_vertices row space by construction).
-        parent_scanner_of caches the resulting scanner on the engine."""
-        if self.host_graph is None:
-            return None, None
-        from tpu_bfs.graph.ell import build_ell
+        Owned tables — released after the export."""
+        from tpu_bfs.algorithms._packed_common import lazy_full_parent_ell
 
-        return build_ell(self.host_graph, kcap=self.hg.kcap), None
+        return lazy_full_parent_ell(self.host_graph, self.hg.kcap)
 
     def run(self, sources, *, max_levels=None, time_it=False, check_cap=True):
         return run_packed_batch(

@@ -64,6 +64,7 @@ from tpu_bfs.graph.ell import _ell_fill, pad_heavy_shards, rank_vertices
 from tpu_bfs.algorithms.msbfs_packed import ripple_increment
 from tpu_bfs.algorithms._packed_common import (
     ExpandSpec,
+    lazy_full_parent_ell,
     make_fori_expand,
     make_state_kernels,
     run_packed_batch,
@@ -747,6 +748,7 @@ class DistHybridMsBfsEngine(RowGatherExchangeAccounting):
                 f"but exchange {exchange!r} needs {layout!r}"
             )
         self.hd = hd
+        self._parent_kcap = kcap
         # Host-side edge list for post-loop parent extraction
         # (PackedBatchResult.parents_int32); a prebuilt shard dict dropped it.
         self.host_graph = graph if isinstance(graph, Graph) else None
@@ -830,6 +832,14 @@ class DistHybridMsBfsEngine(RowGatherExchangeAccounting):
         )
         self._record_exchange(bc, 0)
         return planes, vis, levels, alive, truncated
+
+    def _full_parent_ell(self):
+        """Batched device parent scan structure (parent_scan.py): neither
+        the dense tiles nor the per-chip residual shards concatenate into
+        one coverage structure, so build a fresh full in-neighbor ELL; the
+        scan's row-space perm maps this engine's tau-ordered extraction
+        tables into it. Owned tables — released after the export."""
+        return lazy_full_parent_ell(self.host_graph, self._parent_kcap)
 
     def run(self, sources, *, max_levels=None, time_it=False, check_cap=True):
         return run_packed_batch(

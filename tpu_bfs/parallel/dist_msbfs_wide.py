@@ -37,6 +37,7 @@ from tpu_bfs.graph.ell import ShardedEllGraph, build_ell_sharded
 from tpu_bfs.algorithms.msbfs_packed import ripple_increment
 from tpu_bfs.algorithms._packed_common import (
     ExpandSpec,
+    lazy_full_parent_ell,
     make_fori_expand,
     make_state_kernels,
     run_packed_batch,
@@ -389,6 +390,14 @@ class DistWideMsBfsEngine(RowGatherExchangeAccounting):
         planes = tuple(pl.reshape(self.sell.v_pad, self.w) for pl in planes)
         vis = vis.reshape(self.sell.v_pad, self.w)
         return planes, vis, levels, alive, truncated
+
+    def _full_parent_ell(self):
+        """Batched device parent scan structure (parent_scan.py): the
+        sharded ELL's per-chip buckets don't concatenate into one coverage
+        structure, so build a fresh single-device full ELL; the scan's
+        row-space perm maps this engine's chip-major extraction tables
+        into it. Owned tables — released after the export."""
+        return lazy_full_parent_ell(self.host_graph, self.sell.kcap)
 
     def run(self, sources, *, max_levels=None, time_it=False, check_cap=True):
         return run_packed_batch(

@@ -159,6 +159,42 @@ def test_dist_hybrid_scan_matches_oracle(random_small, exchange):
     np.testing.assert_array_equal(out, _oracle(g, sources, res))
 
 
+def test_packed512_scan_matches_oracle(random_small, random_disconnected):
+    # The 512-lane engine's result materializes distances host-side; the
+    # scan re-uploads them per 128-column pass and borrows the engine's
+    # own ELL tables (zero extra HBM).
+    from tpu_bfs.algorithms.msbfs_packed import PackedMsBfsEngine
+
+    g = random_small
+    sources = np.asarray([3, 42, 400])
+    res = PackedMsBfsEngine(g, lanes=96).run(sources)
+    dev = np.empty((3, g.num_vertices), np.int32)
+    res.parents_into(dev, device="device")
+    np.testing.assert_array_equal(dev, _oracle(g, sources, res))
+    host = np.empty_like(dev)
+    res.parents_into(host, device="host")
+    np.testing.assert_array_equal(dev, host)
+
+    # Isolated source: component == {source}, no scanner row.
+    gd = random_disconnected
+    iso = int(np.flatnonzero(gd.degrees == 0)[0])
+    r2 = PackedMsBfsEngine(gd, lanes=64).run(np.asarray([iso, 0]))
+    out = np.empty((2, gd.num_vertices), np.int32)
+    r2.parents_into(out, device="device")
+    np.testing.assert_array_equal(out, _oracle(gd, [iso, 0], r2))
+
+    # Prebuilt-ELL: host path raises (no edge list), scan serves it.
+    ell = build_ell(g, kcap=64)
+    r3 = PackedMsBfsEngine(ell, lanes=64).run(np.asarray([0, 5]))
+    with pytest.raises(ValueError, match="edge list"):
+        r3.parents_into(
+            np.empty((2, g.num_vertices), np.int32), device="host"
+        )
+    out3 = np.empty((2, g.num_vertices), np.int32)
+    r3.parents_into(out3, device="device")
+    np.testing.assert_array_equal(out3, _oracle(g, [0, 5], r3))
+
+
 def test_scanner_cache_policy(random_small, rmat_small):
     # Borrowing scanners (wide: the engine's own ELL tables) are cached;
     # owning scanners (hybrid: a freshly transferred full ELL) are not —

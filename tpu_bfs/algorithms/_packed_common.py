@@ -480,26 +480,7 @@ class PackedBatchResult:
             raise ValueError(
                 f"out is {out.shape}, need ({n}, {self._engine.num_vertices})"
             )
-        if device not in ("auto", "host", "device"):
-            raise ValueError(f"device must be auto|host|device, got {device!r}")
-        scanner = None
-        if device != "host":
-            try:
-                scanner = parent_scanner_of(self._engine)
-            except Exception as exc:  # noqa: BLE001 — OOM-only fallback
-                # The scanner build itself transfers the full-ELL tables to
-                # the device (the largest new allocation on the hybrid
-                # path); an OOM there must fall back exactly like an OOM
-                # during the scan. The cache stays unset, so a later call
-                # with more headroom may still succeed.
-                if device == "device" or "RESOURCE_EXHAUSTED" not in str(exc):
-                    raise
-        if scanner is None and device == "device":
-            raise ValueError(
-                "device parent scan unavailable for this engine (needs a "
-                "full-coverage ELL or a retained host graph, and V small "
-                "enough for the 32-bit key encoding)"
-            )
+        scanner = acquire_parent_scanner(self._engine, device)
         if scanner is not None:
             try:
                 return self._parents_into_scan(out, scanner)
@@ -642,6 +623,32 @@ def parent_scanner_of(engine):
         engine._parent_scanner_cache = False
     elif borrowed:
         engine._parent_scanner_cache = scanner
+    return scanner
+
+
+def acquire_parent_scanner(engine, device: str):
+    """Shared scanner-acquisition policy of the packed result classes
+    (PackedBatchResult here, PackedBfsResult in msbfs_packed.py): validate
+    the ``device`` argument, return the engine's scanner or None for the
+    host path, raise when ``'device'`` is forced but unavailable, and
+    swallow a RESOURCE_EXHAUSTED during the scanner build in auto mode
+    (the build itself may transfer full-ELL tables). One copy of the OOM
+    policy, so the two contracts cannot drift."""
+    if device not in ("auto", "host", "device"):
+        raise ValueError(f"device must be auto|host|device, got {device!r}")
+    scanner = None
+    if device != "host" and engine is not None:
+        try:
+            scanner = parent_scanner_of(engine)
+        except Exception as exc:  # noqa: BLE001 — OOM-only fallback
+            if device == "device" or "RESOURCE_EXHAUSTED" not in str(exc):
+                raise
+    if scanner is None and device == "device":
+        raise ValueError(
+            "device parent scan unavailable for this engine (needs a "
+            "full-coverage ELL or a retained host graph, and V small "
+            "enough for the 32-bit key encoding)"
+        )
     return scanner
 
 

@@ -51,6 +51,9 @@ class PackedBfsResult:
     elapsed_s: float | None = None  # wall time for the whole batch
     # Host edge list for parents_int32; None when built from a prebuilt ELL.
     _graph: object = None
+    # Engine backref for the device parent scan (parent_scan.py); None on
+    # results deserialized without one (host path still works).
+    _engine: object = None
     _parent_cache: dict = dataclasses.field(default_factory=dict)
 
     @property
@@ -85,18 +88,62 @@ class PackedBfsResult:
             )
         return self._parent_cache[s]
 
-    def parents_into(self, out: np.ndarray) -> np.ndarray:
-        """Fill ``out[s]`` with every lane's parent tree, evicting the
-        per-lane cache as it goes (bulk-export path; distances here are
-        already materialized so there is no word cache to manage)."""
+    def parents_into(self, out: np.ndarray, *, device: str = "auto") -> np.ndarray:
+        """Fill ``out[s]`` with every lane's parent tree.
+
+        Same contract as PackedBatchResult.parents_into: ``auto`` runs the
+        batched device min-key scan when available (this engine's own ELL
+        tables are borrowed — zero extra HBM — so the scan also serves
+        prebuilt-ELL results the host path cannot), falling back to the
+        per-lane host scatter-min; ``host``/``device`` force a path."""
         n = len(self.sources)
-        if out.shape != (n, self.distance_u8.shape[1]):
-            raise ValueError(
-                f"out is {out.shape}, need ({n}, {self.distance_u8.shape[1]})"
-            )
+        v = self.distance_u8.shape[1]
+        if out.shape != (n, v):
+            raise ValueError(f"out is {out.shape}, need ({n}, {v})")
+        from tpu_bfs.algorithms._packed_common import acquire_parent_scanner
+
+        scanner = acquire_parent_scanner(self._engine, device)
+        if scanner is not None:
+            try:
+                return self._parents_into_scan(out, scanner)
+            except Exception as exc:  # noqa: BLE001 — OOM-only fallback
+                if device == "device" or "RESOURCE_EXHAUSTED" not in str(exc):
+                    raise
+                # Scan-time OOM (key table + expansion transients): the
+                # host path below overwrites every row, so partial device
+                # output is harmless — same contract as
+                # PackedBatchResult.parents_into.
         for s in range(n):
             out[s] = self.parents_int32(s)
             self._parent_cache.pop(s, None)
+        return out
+
+    def _parents_into_scan(self, out: np.ndarray, scanner) -> np.ndarray:
+        n = len(self.sources)
+        ell = scanner.ell
+        act = ell.num_active
+        ids = ell.old_of_new[:act]
+        # Distances are already materialized host-side in old-id order;
+        # transpose the active rows into scanner row space per pass.
+        dist_rank = np.ascontiguousarray(self.distance_u8[:, ids].T)
+        L = scanner.lanes_per_pass
+        for c0 in range(0, n, L):
+            cols = dist_rank[:, c0 : c0 + L]
+            real = cols.shape[1]
+            if real < L:
+                cols = np.concatenate(
+                    [cols, np.full((act, L - real), UNREACHED, np.uint8)],
+                    axis=1,
+                )
+            pc = np.asarray(scanner.scan(jnp.asarray(cols)))
+            for j in range(real):
+                row = out[c0 + j]
+                row.fill(-1)
+                row[ids] = pc[:, j]
+                # Sources always map to themselves — including isolated
+                # sources, which have no scanner row at all.
+                src = int(self.sources[c0 + j])
+                row[src] = src
         return out
 
 
@@ -251,7 +298,15 @@ class PackedMsBfsEngine:
             arrs[f"light{i}_t"] = jnp.asarray(np.ascontiguousarray(b.idx.T))
         self.arrs = arrs
         self._core, self._extract = _make_core(ell, self.w)
+        # Depth cap of the 8-plane bit-sliced counters; the parent scan's
+        # key encoding sizes its distance field from this.
+        self.max_levels_cap = MAX_LEVELS
         self._warmed = False
+
+    def _full_parent_ell(self):
+        """Full-coverage ELL + device arrays for the batched parent scan
+        (parent_scan.py) — this engine's own, borrowed for free."""
+        return self.ell, self.arrs
 
     @property
     def num_vertices(self) -> int:
@@ -323,4 +378,5 @@ class PackedMsBfsEngine:
             edges_traversed=edges.astype(np.int64),
             elapsed_s=elapsed,
             _graph=self.host_graph,
+            _engine=self,
         )

@@ -482,14 +482,13 @@ class PackedBatchResult:
             )
         scanner = acquire_parent_scanner(self._engine, device)
         if scanner is not None:
-            try:
-                return self._parents_into_scan(out, scanner)
-            except Exception as exc:  # noqa: BLE001 — OOM-only fallback
-                if device == "device" or "RESOURCE_EXHAUSTED" not in str(exc):
-                    raise
-                # The scanner's tables didn't fit next to the engine's;
-                # the host path overwrites every row, so partial device
-                # output is harmless.
+            return parents_scan_with_fallback(
+                lambda: self._parents_into_scan(out, scanner),
+                lambda: self._parents_into_host(out),
+                device,
+                host_serves=getattr(self._engine, "host_graph", None)
+                is not None,
+            )
         return self._parents_into_host(out)
 
     def _parents_into_host(self, out: np.ndarray) -> np.ndarray:
@@ -650,6 +649,28 @@ def acquire_parent_scanner(engine, device: str):
             "enough for the 32-bit key encoding)"
         )
     return scanner
+
+
+def parents_scan_with_fallback(scan_fn, host_fn, device: str, *,
+                               host_serves: bool = True):
+    """Shared scan-time OOM policy of the packed result classes: run the
+    device scan; in auto mode a RESOURCE_EXHAUSTED falls back to the host
+    path — but ONLY when the host path can actually serve this result
+    (``host_serves``; a prebuilt-ELL result has no edge list, and masking
+    the OOM behind the host path's 'needs the edge list' error would
+    discard the real cause). Forced-device mode and non-OOM errors always
+    propagate."""
+    try:
+        return scan_fn()
+    except Exception as exc:  # noqa: BLE001 — OOM-only fallback
+        if (
+            device == "device"
+            or "RESOURCE_EXHAUSTED" not in str(exc)
+            or not host_serves
+        ):
+            raise
+    # Partial scan output is harmless: the host path overwrites every row.
+    return host_fn()
 
 
 def lazy_full_parent_ell(host_graph, kcap: int = 64):

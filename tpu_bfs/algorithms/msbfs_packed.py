@@ -100,23 +100,26 @@ class PackedBfsResult:
         v = self.distance_u8.shape[1]
         if out.shape != (n, v):
             raise ValueError(f"out is {out.shape}, need ({n}, {v})")
-        from tpu_bfs.algorithms._packed_common import acquire_parent_scanner
+        from tpu_bfs.algorithms._packed_common import (
+            acquire_parent_scanner,
+            parents_scan_with_fallback,
+        )
+
+        def host() -> np.ndarray:
+            for s in range(n):
+                out[s] = self.parents_int32(s)
+                self._parent_cache.pop(s, None)
+            return out
 
         scanner = acquire_parent_scanner(self._engine, device)
-        if scanner is not None:
-            try:
-                return self._parents_into_scan(out, scanner)
-            except Exception as exc:  # noqa: BLE001 — OOM-only fallback
-                if device == "device" or "RESOURCE_EXHAUSTED" not in str(exc):
-                    raise
-                # Scan-time OOM (key table + expansion transients): the
-                # host path below overwrites every row, so partial device
-                # output is harmless — same contract as
-                # PackedBatchResult.parents_into.
-        for s in range(n):
-            out[s] = self.parents_int32(s)
-            self._parent_cache.pop(s, None)
-        return out
+        if scanner is None:
+            return host()
+        return parents_scan_with_fallback(
+            lambda: self._parents_into_scan(out, scanner),
+            host,
+            device,
+            host_serves=self._graph is not None,
+        )
 
     def _parents_into_scan(self, out: np.ndarray, scanner) -> np.ndarray:
         n = len(self.sources)

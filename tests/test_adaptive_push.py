@@ -81,6 +81,27 @@ def test_adaptive_checkpoint_resume(rmat_small):
     _assert_same(res, full, range(2))
 
 
+def test_cli_adaptive_push(capsys):
+    from tpu_bfs import cli
+
+    rc = cli.main(["3", "random:n=300,m=1200,seed=5", "--multi-source",
+                   "7,9", "--engine", "wide", "--adaptive-push", "128,32"])
+    assert rc == 0
+    assert "Output OK" in capsys.readouterr().out
+
+
+def test_cli_adaptive_push_guards():
+    import pytest as _pytest
+
+    from tpu_bfs import cli
+
+    with _pytest.raises(SystemExit):
+        cli.main(["0", "random:n=100,m=300,seed=1", "--adaptive-push", "4,4"])
+    with _pytest.raises(SystemExit):
+        cli.main(["0", "random:n=100,m=300,seed=1", "--multi-source", "5",
+                  "--engine", "wide", "--adaptive-push", "0,4"])
+
+
 def test_adaptive_needs_host_graph(rmat_small):
     ell = build_ell(rmat_small, kcap=64)
     with pytest.raises(ValueError, match="edge list"):

@@ -212,6 +212,54 @@ def test_scanner_cache_policy(random_small, rmat_small):
     assert getattr(hyb, "_parent_scanner_cache", None) is None
 
 
+def test_single_lane_uses_cached_scanner(random_small):
+    # After a bulk export caches the wide engine's borrowing scanner,
+    # parents_int32 rides it (one word-column scan) — bit-equal to the
+    # host scatter-min, including for a not-yet-queried lane.
+    from tpu_bfs.algorithms._packed_common import min_parents_lane
+
+    g = random_small
+    sources = np.asarray([0, 17, 255, 499])
+    eng = WidePackedMsBfsEngine(g)
+    res = eng.run(sources)
+    res.parents_into(
+        np.empty((4, g.num_vertices), np.int32), device="device"
+    )
+    assert res._cached_scanner() is not None
+    for i in range(4):
+        np.testing.assert_array_equal(
+            res.parents_int32(i),
+            min_parents_lane(g, int(sources[i]), res.distances_int32(i)),
+        )
+
+
+def test_scan_oom_bottoms_out_in_host_path(random_small, monkeypatch):
+    # A device OOM during the scan must degrade to the device-free host
+    # scatter-min — for bulk export AND for single-lane queries with a
+    # cached scanner — never re-enter the scan or propagate.
+    from tpu_bfs.algorithms.parent_scan import ParentScanner
+
+    g = random_small
+    sources = np.asarray([0, 17, 499])
+    res = WidePackedMsBfsEngine(g).run(sources)
+    expected = _oracle(g, sources, res)
+
+    def oom(self, dist_cols):
+        raise RuntimeError("RESOURCE_EXHAUSTED: out of memory allocating")
+
+    monkeypatch.setattr(ParentScanner, "scan", oom)
+    out = np.empty((3, g.num_vertices), np.int32)
+    res.parents_into(out, device="auto")
+    np.testing.assert_array_equal(out, expected)
+    # Scanner is now cached (borrowing engine); single-lane queries must
+    # also survive the failing scan.
+    assert res._cached_scanner() is not None
+    np.testing.assert_array_equal(res.parents_int32(1), expected[1])
+    # Forced device mode propagates the real error instead.
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        res.parents_into(out, device="device")
+
+
 def test_scanner_rejects_unrepresentable_key(random_small):
     # 32-bit keys: the distance field must hold the level cap.
     ell = build_ell(random_small, kcap=64)

@@ -402,6 +402,9 @@ class PackedBatchResult:
     _iso: np.ndarray | None = None
     _word_cache: dict = dataclasses.field(default_factory=dict)
     _parent_cache: dict = dataclasses.field(default_factory=dict)
+    # Decoded parent columns of ONE word (32 lanes) from the cached-scanner
+    # single-lane path; see _parent_lane_scan.
+    _pword_cache: dict = dataclasses.field(default_factory=dict)
 
     @property
     def teps(self) -> float | None:
@@ -458,12 +461,82 @@ class PackedBatchResult:
         if not (0 <= i < len(self.sources)):
             raise IndexError(i)
         if i not in self._parent_cache:
-            self._parent_cache[i] = min_parents_lane(
-                getattr(self._engine, "host_graph", None),
-                int(self.sources[i]),
-                self.distances_int32(i),
-            )
+            self._parent_cache[i] = self._parent_lane(i)
         return self._parent_cache[i]
+
+    def _parent_lane(self, i: int) -> np.ndarray:
+        """One lane's tree: the cached-scanner fast path when available,
+        with the guaranteed host scatter-min fallback — a device OOM here
+        must degrade to the pre-scanner behavior, never propagate, as long
+        as the host path can serve this result."""
+        scanner = self._cached_scanner()
+        if scanner is not None:
+            try:
+                return self._parent_lane_scan(i, scanner)
+            except Exception as exc:  # noqa: BLE001 — OOM-only fallback
+                if "RESOURCE_EXHAUSTED" not in str(exc) or (
+                    getattr(self._engine, "host_graph", None) is None
+                ):
+                    raise
+        return self._parent_lane_host(i)
+
+    def _parent_lane_host(self, i: int) -> np.ndarray:
+        """The device-free O(E) host scatter-min — the path every OOM
+        fallback must bottom out in."""
+        return min_parents_lane(
+            getattr(self._engine, "host_graph", None),
+            int(self.sources[i]),
+            self.distances_int32(i),
+        )
+
+    def _cached_scanner(self):
+        """An ALREADY-CACHED borrowed scanner, or None. Single-lane queries
+        never trigger a scanner build (that can allocate a full ELL on
+        device); they just reuse one a bulk export or an earlier query on
+        a borrowing engine left behind. Guarded to scanners built from the
+        engine's OWN ell (identity row space — true for every borrowing
+        engine today); anything else takes the general host path."""
+        scanner = getattr(self._engine, "_parent_scanner_cache", None) or None
+        if scanner is not None and scanner.ell is not getattr(
+            self._engine, "ell", None
+        ):
+            return None
+        return scanner
+
+    def _parent_lane_scan(self, i: int, scanner) -> np.ndarray:
+        """One lane's tree via the cached scanner: scan the lane's 32-lane
+        word column (UNREACHED-padded to a full pass) instead of an O(E)
+        host scatter-min — the same deterministic tree, bit-equal. The
+        word's decoded [act, 32] columns are cached (one word at a time,
+        like distance_u8_lane's word cache), so querying 32 lanes of one
+        word runs one scan, not 32."""
+        import jax.numpy as jnp
+
+        eng = self._engine
+        ell = scanner.ell
+        act = ell.num_active
+        src = int(self.sources[i])
+        out = np.full(eng.num_vertices, -1, np.int32)
+        if self._iso is not None and self._iso[i]:
+            out[src] = src
+            return out
+        wi, col = eng._word_col(i)
+        pc = self._pword_cache.get(wi)
+        if pc is None:
+            dist_cols = eng._extract_word(
+                self._planes, self._vis, self._src_bits, wi
+            )
+            L = scanner.lanes_per_pass
+            if L > 32:
+                dist_cols = jnp.concatenate(
+                    [dist_cols, jnp.full((act, L - 32), UNREACHED, jnp.uint8)],
+                    axis=1,
+                )
+            pc = np.asarray(scanner.scan(dist_cols))[:, :32]
+            self._pword_cache.clear()  # one word resident at a time
+            self._pword_cache[wi] = pc
+        out[ell.old_of_new[:act]] = pc[:, col]
+        return out
 
     def parents_into(self, out: np.ndarray, *, device: str = "auto") -> np.ndarray:
         """Fill ``out[i]`` with every lane's parent tree.
@@ -492,15 +565,15 @@ class PackedBatchResult:
         return self._parents_into_host(out)
 
     def _parents_into_host(self, out: np.ndarray) -> np.ndarray:
-        """Per-lane host extraction, evicting the per-lane parent cache and
-        each 32-lane distance word column once its lanes are done — peak
-        host memory is ``out`` plus one word column, not a second cached
-        [S, V] copy."""
+        """Per-lane host extraction (the guaranteed device-free path — the
+        scan's OOM fallback lands here, so it must not re-enter the
+        cached-scanner fast path), evicting each 32-lane distance word
+        column once its lanes are done — peak host memory is ``out`` plus
+        one word column, not a second cached [S, V] copy."""
         n = len(self.sources)
         prev_word = None
         for i in range(n):
-            out[i] = self.parents_int32(i)
-            self._parent_cache.pop(i, None)
+            out[i] = self._parent_lane_host(i)
             wi = self._engine._word_col(i)[0]
             if prev_word is not None and wi != prev_word:
                 self._word_cache.pop(prev_word, None)

@@ -148,6 +148,8 @@ def _make_ms_engine(args, g, n_sources: int):
     if engine == "wide":
         from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
 
+        if args.adaptive_push:
+            lanes_kw = dict(lanes_kw, adaptive_push=args.adaptive_push)
         return WidePackedMsBfsEngine(g, num_planes=planes, **lanes_kw)
     from tpu_bfs.algorithms.msbfs_hybrid import HybridMsBfsEngine
 
@@ -352,6 +354,12 @@ def main(argv=None) -> int:
                     "multiples of 4096 opt into wider rows — more "
                     "concurrent sources per batch at proportionally more "
                     "HBM)")
+    ap.add_argument("--adaptive-push", default=None, metavar="ROWS,DEG",
+                    help="experimental level-adaptive expansion for "
+                    "--engine wide (single device): levels with <= ROWS "
+                    "active rows, all with out-degree <= DEG, take a "
+                    "push-style pass instead of the full ELL scan "
+                    "(BENCHMARKS.md 'Level-adaptive expansion')")
     ap.add_argument("--profile-dir", default=None,
                     help="write a jax.profiler trace of the timed run here")
     ap.add_argument("--ckpt", default=None, metavar="PATH",
@@ -364,6 +372,18 @@ def main(argv=None) -> int:
                     help="resume a traversal from a checkpoint written by "
                     "--ckpt (overrides <source> with the saved one)")
     args = ap.parse_args(argv)
+    if args.adaptive_push is not None:
+        if args.engine != "wide" or args.devices > 1 or not args.multi_source:
+            ap.error("--adaptive-push pairs with --multi-source --engine "
+                     "wide on a single device")
+        try:
+            r, d = (int(t) for t in args.adaptive_push.split(","))
+            if r < 1 or d < 1:
+                raise ValueError
+        except ValueError:
+            ap.error(f"--adaptive-push must be ROWS,DEG positive ints, got "
+                     f"{args.adaptive_push!r}")
+        args.adaptive_push = (r, d)
     if (args.mesh or args.devices > 1) and args.backend in ("delta", "tiled"):
         ap.error(f"--backend {args.backend} is single-device only")
     if args.mesh and args.exchange == "sparse":

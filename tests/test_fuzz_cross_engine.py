@@ -55,10 +55,24 @@ def test_single_chip_engines_agree(name, make):
 
     packed = PackedMsBfsEngine(g, lanes=96).run(np.asarray(sources))
     wide = WidePackedMsBfsEngine(g).run(np.asarray(sources))
+    # Level-adaptive push arm (round 4): same answers through the gated
+    # push/pull cond machine on every fuzz shape, including directed.
+    adaptive = WidePackedMsBfsEngine(g, adaptive_push=(64, 16)).run(
+        np.asarray(sources)
+    )
+    # Device parent scan arm: bulk trees bit-equal to the per-lane host
+    # scatter-min on every shape.
+    trees = np.empty((len(sources), g.num_vertices), np.int32)
+    wide.parents_into(trees, device="device")
     for i, s in enumerate(sources):
         validate.check_distances(packed.distances_int32(i), golden[s])
         validate.check_distances(wide.distances_int32(i), golden[s])
+        validate.check_distances(adaptive.distances_int32(i), golden[s])
         validate.certify_bfs(g, s, wide.distances_int32(i), wide.parents_int32(i))
+        np.testing.assert_array_equal(
+            trees[i],
+            validate.min_parent_from_dist(g, s, wide.distances_int32(i)),
+        )
 
 
 @pytest.mark.parametrize("name,make", CASES[:2], ids=[c[0] for c in CASES[:2]])

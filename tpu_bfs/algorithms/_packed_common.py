@@ -510,8 +510,6 @@ class PackedBatchResult:
         word's decoded [act, 32] columns are cached (one word at a time,
         like distance_u8_lane's word cache), so querying 32 lanes of one
         word runs one scan, not 32."""
-        import jax.numpy as jnp
-
         eng = self._engine
         ell = scanner.ell
         act = ell.num_active
@@ -573,7 +571,11 @@ class PackedBatchResult:
         n = len(self.sources)
         prev_word = None
         for i in range(n):
-            out[i] = self._parent_lane_host(i)
+            # Reuse (then evict) an already-cached tree; compute misses via
+            # the device-free host scatter-min — NOT parents_int32, whose
+            # fast path would re-enter the possibly-failing scan.
+            cached = self._parent_cache.pop(i, None)
+            out[i] = cached if cached is not None else self._parent_lane_host(i)
             wi = self._engine._word_col(i)[0]
             if prev_word is not None and wi != prev_word:
                 self._word_cache.pop(prev_word, None)

@@ -17,7 +17,9 @@ modes, 4096 — set 8192 to sweep w=256 rows), TPU_BFS_BENCH_SOURCES (single
 modes, 8), TPU_BFS_BENCH_VALIDATE (1), TPU_BFS_BENCH_VALIDATE_LANES (4),
 TPU_BFS_BENCH_CACHE (.bench_cache), TPU_BFS_BENCH_BUDGET_S (2400 — the
 outage envelope's wall-clock budget; 0 disables; on exhaustion the one JSON
-line carries value=null and a machine-readable "error").
+line carries value=null and a machine-readable "error"),
+TPU_BFS_BENCH_ADAPTIVE ("rows,deg" — opt-in level-adaptive push expansion
+for the hybrid/wide modes; BENCHMARKS.md "Level-adaptive expansion").
 """
 
 import json
@@ -244,6 +246,25 @@ def _env_max_lanes(*, default: int) -> int:
         log(f"TPU_BFS_BENCH_MAX_LANES={raw} not a reachable width; "
             f"clamped to {clamped}")
     return clamped
+
+
+def _env_adaptive():
+    """TPU_BFS_BENCH_ADAPTIVE="rows,deg" -> (rows, deg) or None. Mirrors
+    the CLI's validation (positive ints, right arity) so a typo degrades
+    to a logged 'off' instead of crashing a flagship build mid-bench."""
+    raw = os.environ.get("TPU_BFS_BENCH_ADAPTIVE", "")
+    if not raw:
+        return None
+    try:
+        r, d = (int(t) for t in raw.split(","))
+        if r < 1 or d < 1:
+            raise ValueError
+    except ValueError:
+        log(f"TPU_BFS_BENCH_ADAPTIVE={raw!r} must be ROWS,DEG positive "
+            f"ints; adaptive push off")
+        return None
+    log(f"adaptive push enabled: row_cap={r} deg_cap={d}")
+    return (r, d)
 
 
 def load_graph(scale: int, ef: int):
@@ -505,9 +526,14 @@ def bench_hybrid(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
     # does not fit next to the tiles; whatever width is chosen appears in
     # the metric label via engine.lanes.
     max_lanes = _env_max_lanes(default=LANES)
+    # TPU_BFS_BENCH_ADAPTIVE="rows,deg" (opt-in, experimental): the
+    # level-adaptive push path (BENCHMARKS.md 'Level-adaptive expansion');
+    # results stay oracle-validated either way.
+    adaptive = _env_adaptive()
+    kw = {} if adaptive is None else {"adaptive_push": adaptive}
     try:
         engine = retry_transient(HybridMsBfsEngine, g, max_lanes=max_lanes,
-                                 label="hybrid engine build")
+                                 label="hybrid engine build", **kw)
     except LanesDontFitError as exc:
         log(f"hybrid unavailable ({exc}); falling back to wide engine")
         return bench_wide(g, scale, ef, graph_desc)
@@ -530,8 +556,10 @@ def bench_wide(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
 
     t0 = time.perf_counter()
     max_lanes = _env_max_lanes(default=WIDE_LANES)
+    adaptive = _env_adaptive()
+    kw = {} if adaptive is None else {"adaptive_push": adaptive}
     engine = retry_transient(WidePackedMsBfsEngine, g, max_lanes=max_lanes,
-                             label="wide engine build")
+                             label="wide engine build", **kw)
     ell = engine.ell
     return _bench_batch_4096(
         g, graph_desc or f"RMAT scale-{scale} ef={ef}", engine, ell.in_degree,

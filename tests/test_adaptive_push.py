@@ -81,6 +81,28 @@ def test_adaptive_checkpoint_resume(rmat_small):
     _assert_same(res, full, range(2))
 
 
+def test_adaptive_hybrid_matches_default(rmat_small):
+    # The flagship path: light levels skip BOTH the residual scan and the
+    # dense tile pass; results stay bit-identical.
+    from tpu_bfs.algorithms.msbfs_hybrid import HybridMsBfsEngine
+
+    g = rmat_small
+    src = np.flatnonzero(g.degrees > 0)[:24]
+    base = HybridMsBfsEngine(g, lanes=256, tile_thr=4).run(src)
+    adap = HybridMsBfsEngine(
+        g, lanes=256, tile_thr=4, adaptive_push=(64, 16)
+    ).run(src)
+    _assert_same(adap, base, range(len(src)))
+
+
+def test_adaptive_hybrid_needs_host_graph(rmat_small):
+    from tpu_bfs.algorithms.msbfs_hybrid import HybridMsBfsEngine, build_hybrid
+
+    hg = build_hybrid(rmat_small, tile_thr=4)
+    with pytest.raises(ValueError, match="edge list"):
+        HybridMsBfsEngine(hg, lanes=256, adaptive_push=(64, 16))
+
+
 def test_cli_adaptive_push(capsys):
     from tpu_bfs import cli
 

@@ -50,9 +50,11 @@ from tpu_bfs.algorithms._packed_common import (
     advance_packed_batch,
     auto_lanes,
     auto_planes,
+    build_push_table,
     expand_arrays,
     finish_packed_batch,
     floor_lanes,
+    make_adaptive_hit,
     make_fori_expand,
     make_packed_loop,
     make_state_kernels,
@@ -294,7 +296,8 @@ def build_hybrid(
     )
 
 
-def _make_core(hg: HybridGraph, w: int, num_planes: int, interpret: bool):
+def _make_core(hg: HybridGraph, w: int, num_planes: int, interpret: bool,
+               push_cfg=None):
     spec = ExpandSpec(
         kcap=hg.kcap,
         heavy=hg.res_heavy > 0,
@@ -315,6 +318,13 @@ def _make_core(hg: HybridGraph, w: int, num_planes: int, interpret: bool):
             )
         return hit
 
+    if push_cfg is not None:
+        # Level-adaptive expansion (experimental): light levels skip BOTH
+        # the residual scan and the dense tile pass — see
+        # _packed_common.make_adaptive_hit, shared with the wide engine.
+        hit_of = make_adaptive_hit(
+            hit_of, hg.num_active, w, hg.vt * TILE, push_cfg
+        )
     return make_packed_loop(hit_of, num_planes)
 
 
@@ -337,6 +347,7 @@ class HybridMsBfsEngine:
         undirected: bool | None = None,
         hbm_budget_bytes: int = int(14.0e9),
         max_lanes: int = LANES,
+        adaptive_push: tuple[int, int] | None = None,
     ):
         if num_planes != "auto" and not (1 <= num_planes <= 8):
             # Validate the explicit case before the minutes-long build.
@@ -365,10 +376,18 @@ class HybridMsBfsEngine:
         # (PackedBatchResult.parents_int32); a prebuilt HybridGraph dropped it.
         self.host_graph = graph if isinstance(graph, Graph) else None
         hg = self.hg
+        if adaptive_push is not None and self.host_graph is None:
+            raise ValueError(
+                "adaptive_push needs the edge list: construct the engine "
+                "from a Graph (a prebuilt HybridGraph has dropped it)"
+            )
         res_slots = (
             hg.res_virtual.idx.size if hg.res_virtual is not None else 0
         ) + sum(b.idx.size for b in hg.res_light)
         fixed_bytes = hg.a_tiles.nbytes + int(res_slots * 4.4)
+        if adaptive_push is not None:
+            # The push table is a lane-independent resident, like the ELL.
+            fixed_bytes += (hg.num_active + 1) * (adaptive_push[1] * 4 + 1)
         if num_planes == "auto" and lanes == "auto":
             # Trade depth capacity (2**planes levels) for batch width: on a
             # graph one scale step too big for 5 planes at 4096 lanes, 4
@@ -439,10 +458,18 @@ class HybridMsBfsEngine:
             arrs["row_start"] = jnp.asarray(hg.row_start)
             arrs["col_tile"] = jnp.asarray(hg.col_tile)
             arrs["a_tiles"] = jnp.asarray(hg.a_tiles)
+        if adaptive_push is not None:
+            pt, inelig = build_push_table(
+                self.host_graph, hg.rank, hg.num_active, adaptive_push[1]
+            )
+            arrs["push_t"] = jnp.asarray(pt)
+            arrs["push_inelig"] = jnp.asarray(inelig)
         self.arrs = arrs
         self._act = hg.num_active
         self._table_rows = hg.vt * TILE
-        self._core, self._core_from = _make_core(hg, self.w, num_planes, interpret)
+        self._core, self._core_from = _make_core(
+            hg, self.w, num_planes, interpret, adaptive_push
+        )
         in_deg_ranked = hg.in_degree[hg.old_of_new].astype(np.int32)
         self._seed, self._lane_stats, self._extract_word = make_state_kernels(
             hg.num_vertices, hg.vt * TILE, self.w, num_planes,

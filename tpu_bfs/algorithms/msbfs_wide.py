@@ -43,8 +43,10 @@ from tpu_bfs.algorithms._packed_common import (
     ExpandSpec,
     advance_packed_batch,
     auto_lanes,
+    build_push_table,
     expand_arrays,
     finish_packed_batch,
+    make_adaptive_hit,
     make_fori_expand,
     make_packed_loop,
     make_state_kernels,
@@ -84,48 +86,12 @@ def _make_core(ell: EllGraph, w: int, num_planes: int, push_cfg=None):
     expand = make_fori_expand(spec, w)
     if push_cfg is None:
         return make_packed_loop(expand, num_planes)
-
-    # Level-adaptive expansion (experimental, VERDICT r3 #8): the bucketed
-    # pull pays the FULL ELL slot scan every level, light or heavy. When a
-    # level's packed union frontier is sparse (<= row_cap active rows, all
-    # with out-degree <= deg_cap), a push-style pass touches only the
-    # active rows' out-edges instead: a sequential fori over the compacted
-    # active rows, each step OR-scattering its frontier word row into its
-    # out-neighbors' hit rows. Push-over-out-edges computes the same hit
-    # as pull-over-in-edges by construction (the out-CSR push table is
-    # built edge-exact, directed or not). Heavy frontiers and any level
-    # touching a >deg_cap row take the normal pull path via lax.cond.
-    row_cap, deg_cap = push_cfg
-
-    def hit_of(arrs, fw):
-        rows_active = jnp.any(fw[:act] != 0, axis=1)
-        nz = jnp.sum(rows_active.astype(jnp.int32))
-        bad = jnp.any(rows_active & arrs["push_inelig"])
-        light = (nz <= row_cap) & ~bad
-
-        def push_fn():
-            idx = jnp.where(rows_active, size=row_cap, fill_value=act)[0]
-            pt = arrs["push_t"]
-
-            def pbody(i, hit):
-                r = idx[i]  # act (sentinel) when padding: fw[act] == 0
-                nb = pt[r]  # [deg_cap], pad slots -> sentinel row act
-                return hit.at[nb].set(hit[nb] | fw[r][None, :])
-
-            # Traced trip count: the loop runs nz steps (lowered to a
-            # while loop), so a 40-row level costs 40 scatter steps, not
-            # row_cap. idx is row_cap-wide regardless; slots past nz are
-            # sentinel padding and would be no-ops anyway.
-            hit = jax.lax.fori_loop(
-                0, nz, pbody, jnp.zeros((act + 1, w), jnp.uint32)
-            )
-            # Pad slots OR real frontier words into the sentinel row;
-            # restore its all-zero invariant (next level gathers it).
-            return hit.at[act].set(0)
-
-        return jax.lax.cond(light, push_fn, lambda: expand(arrs, fw))
-
-    return make_packed_loop(hit_of, num_planes)
+    # Level-adaptive expansion (experimental): see
+    # _packed_common.make_adaptive_hit — the gate/push machinery is shared
+    # with the hybrid engine.
+    return make_packed_loop(
+        make_adaptive_hit(expand, act, w, act + 1, push_cfg), num_planes
+    )
 
 
 class WidePackedMsBfsEngine:
@@ -208,33 +174,18 @@ class WidePackedMsBfsEngine:
         self._warmed = False
 
     def _build_push_table(self, push_cfg):
-        """Out-CSR push table in rank space for the adaptive light-level
-        path (see _make_core): [act+1, deg_cap] out-neighbor rank ids
-        (pad/sentinel = act) plus the per-row ineligibility mask (out-deg
-        > deg_cap). Needs the retained host edge list."""
+        """Device push arrays for the adaptive light-level path (the
+        shared build_push_table); needs the retained host edge list."""
         if self.host_graph is None:
             raise ValueError(
                 "adaptive_push needs the edge list: construct the engine "
                 "from a Graph (a prebuilt ELL has dropped it)"
             )
-        _, deg_cap = push_cfg
-        act = self._act
-        src, dst = self.host_graph.coo
-        rank = self.ell.rank
-        rs = rank[src].astype(np.int64)
-        rd = rank[dst].astype(np.int32)
-        out_deg = np.bincount(rs, minlength=act)[:act]
-        elig = out_deg <= deg_cap
-        order = np.argsort(rs, kind="stable")
-        rs_s, rd_s = rs[order], rd[order]
-        rp = np.zeros(act + 1, np.int64)
-        np.cumsum(out_deg, out=rp[1:])
-        pos = np.arange(len(rs_s), dtype=np.int64) - rp[rs_s]
-        keep = elig[rs_s]
-        pt = np.full((act + 1, deg_cap), act, np.int32)
-        pt[rs_s[keep], pos[keep]] = rd_s[keep]
+        pt, inelig = build_push_table(
+            self.host_graph, self.ell.rank, self._act, push_cfg[1]
+        )
         self.arrs["push_t"] = jnp.asarray(pt)
-        self.arrs["push_inelig"] = jnp.asarray(~elig)
+        self.arrs["push_inelig"] = jnp.asarray(inelig)
 
     @property
     def num_vertices(self) -> int:

@@ -145,11 +145,11 @@ def _make_ms_engine(args, g, n_sources: int):
             else max(32, -(-n_sources // 32) * 32)
         )
         return PackedMsBfsEngine(g, lanes=lanes)
+    if args.adaptive_push:
+        lanes_kw = dict(lanes_kw, adaptive_push=args.adaptive_push)
     if engine == "wide":
         from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
 
-        if args.adaptive_push:
-            lanes_kw = dict(lanes_kw, adaptive_push=args.adaptive_push)
         return WidePackedMsBfsEngine(g, num_planes=planes, **lanes_kw)
     from tpu_bfs.algorithms.msbfs_hybrid import HybridMsBfsEngine
 
@@ -356,10 +356,10 @@ def main(argv=None) -> int:
                     "HBM)")
     ap.add_argument("--adaptive-push", default=None, metavar="ROWS,DEG",
                     help="experimental level-adaptive expansion for "
-                    "--engine wide (single device): levels with <= ROWS "
-                    "active rows, all with out-degree <= DEG, take a "
-                    "push-style pass instead of the full ELL scan "
-                    "(BENCHMARKS.md 'Level-adaptive expansion')")
+                    "--engine wide|hybrid (single device): levels with "
+                    "<= ROWS active rows, all with out-degree <= DEG, "
+                    "take a push-style pass instead of the full ELL/tile "
+                    "scan (BENCHMARKS.md 'Level-adaptive expansion')")
     ap.add_argument("--profile-dir", default=None,
                     help="write a jax.profiler trace of the timed run here")
     ap.add_argument("--ckpt", default=None, metavar="PATH",
@@ -373,9 +373,13 @@ def main(argv=None) -> int:
                     "--ckpt (overrides <source> with the saved one)")
     args = ap.parse_args(argv)
     if args.adaptive_push is not None:
-        if args.engine != "wide" or args.devices > 1 or not args.multi_source:
+        if (
+            args.engine not in ("wide", "hybrid")
+            or args.devices > 1
+            or not args.multi_source
+        ):
             ap.error("--adaptive-push pairs with --multi-source --engine "
-                     "wide on a single device")
+                     "wide|hybrid on a single device")
         try:
             r, d = (int(t) for t in args.adaptive_push.split(","))
             if r < 1 or d < 1:

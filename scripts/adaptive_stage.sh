@@ -1,0 +1,55 @@
+#!/bin/bash
+# Follow-on measurement stage: once the main chip_session lands a non-null
+# flagship number (the chip is back and warm), measure the flagship with
+# the level-adaptive push path enabled (BENCHMARKS.md "Level-adaptive
+# expansion") — the keep-or-kill TPU data point the CPU measurements
+# could only project. Runs as its own process so the in-flight
+# chip_session.sh script file is never edited mid-execution.
+set -u
+out=.bench_cache/chip_session
+deadline=$(( $(date +%s) + ${ADAPTIVE_STAGE_WINDOW_S:-28800} ))
+
+has_value() {
+  python - "$1" <<'EOF'
+import json, sys
+try:
+    with open(sys.argv[1]) as f:
+        lines = [l for l in f if l.strip().startswith("{")]
+    sys.exit(0 if lines and json.loads(lines[-1])["value"] is not None else 1)
+except Exception:
+    sys.exit(1)
+EOF
+}
+
+while [ "$(date +%s)" -lt "$deadline" ]; do
+  if [ -f "$out/flagship.json" ] && has_value "$out/flagship.json"; then
+    # Wait for the main session to finish its queue before taking the
+    # chip — but never past the window (a wedged session or a stray
+    # process matching the pgrep must not hang this stage silently).
+    while pgrep -f "chip_session.sh" >/dev/null 2>&1; do
+      if [ "$(date +%s)" -ge "$deadline" ]; then
+        echo "main session still running at the window's end; skipped"
+        exit 1
+      fi
+      sleep 60
+    done
+    for i in 1 2; do
+      echo "=== adaptive flagship attempt $i $(date -u +%H:%M:%S) ==="
+      TPU_BFS_BENCH_ADAPTIVE=8192,64 python bench.py \
+        >"$out/flagship_adaptive.json" 2>"$out/flagship_adaptive.log" || true
+      # bench exits 0 with value=null on a budget-exhausted outage — only
+      # a non-null value is a landed number (same gate as flagship.json).
+      if has_value "$out/flagship_adaptive.json"; then
+        echo "adaptive OK: $(tail -1 "$out/flagship_adaptive.json")"
+        exit 0
+      fi
+      echo "adaptive attempt $i FAILED (see $out/flagship_adaptive.log): $(tail -1 "$out/flagship_adaptive.json" 2>/dev/null)"
+      [ "$(date +%s)" -lt "$deadline" ] || break
+      sleep 120
+    done
+    exit 1
+  fi
+  sleep 120
+done
+echo "flagship number never landed within the window; adaptive stage skipped"
+exit 1

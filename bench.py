@@ -19,7 +19,9 @@ TPU_BFS_BENCH_CACHE (.bench_cache), TPU_BFS_BENCH_BUDGET_S (2400 — the
 outage envelope's wall-clock budget; 0 disables; on exhaustion the one JSON
 line carries value=null and a machine-readable "error"),
 TPU_BFS_BENCH_ADAPTIVE ("rows,deg" — opt-in level-adaptive push expansion
-for the hybrid/wide modes; BENCHMARKS.md "Level-adaptive expansion").
+for the hybrid/wide modes; BENCHMARKS.md "Level-adaptive expansion"),
+TPU_BFS_BENCH_XLA_CACHE (.bench_cache/xla_cache — persistent XLA compile
+cache across bench processes; empty disables).
 """
 
 import json
@@ -681,10 +683,36 @@ def bench_single(g, scale: int, ef: int, backend: str = "scan",
     }
 
 
+def _enable_compile_cache() -> None:
+    """Persistent XLA compilation cache (TPU_BFS_BENCH_XLA_CACHE, default
+    .bench_cache/xla_cache; empty disables). First compiles of the level
+    loop cost ~20-40 s on the chip and recur on every bench process —
+    during an outage-recovery session that is wall-clock the budget
+    envelope cannot spare. Best-effort: a jax without the knob (or a
+    backend that bypasses it) degrades to the status quo."""
+    path = os.environ.get(
+        "TPU_BFS_BENCH_XLA_CACHE",
+        os.path.join(
+            os.environ.get("TPU_BFS_BENCH_CACHE", ".bench_cache"), "xla_cache"
+        ),
+    )
+    if not path:
+        return
+    try:
+        os.makedirs(path, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", path)
+        log(f"persistent compile cache: {path}")
+    except Exception as exc:  # noqa: BLE001 — cache is an optimization
+        log(f"compile cache unavailable ({exc!r}); continuing without")
+
+
 def main() -> int:
     scale = int(os.environ.get("TPU_BFS_BENCH_SCALE", "21"))
     ef = int(os.environ.get("TPU_BFS_BENCH_EF", "16"))
     mode = os.environ.get("TPU_BFS_BENCH_MODE", "hybrid")
+    _enable_compile_cache()
     watchdog = _arm_budget(mode)
     try:
         g = load_graph_lj() if mode.startswith("lj-") else load_graph(scale, ef)

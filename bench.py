@@ -683,6 +683,30 @@ def bench_single(g, scale: int, ef: int, backend: str = "scan",
     }
 
 
+def _log_result(result: dict, mode: str) -> None:
+    """Append every landed measurement to a durable in-repo log
+    (TPU_BFS_BENCH_RESULT_LOG, default bench_results.jsonl at the repo
+    root; empty disables). The official record is the driver's captured
+    stdout — but numbers landed by opportunistic sessions between driver
+    windows (scripts/chip_session.sh) live only in gitignored caches, and
+    a measurement that survived a 5-hour outage should not depend on a
+    human reading a log file before the round snapshot. Best-effort."""
+    path = os.environ.get(
+        "TPU_BFS_BENCH_RESULT_LOG",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "bench_results.jsonl"),
+    )
+    if not path:
+        return
+    try:
+        line = dict(result, mode=mode, utc=time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
+        with open(path, "a") as f:
+            f.write(json.dumps(line) + "\n")
+    except OSError as exc:
+        log(f"result log append skipped: {exc}")
+
+
 def _enable_compile_cache() -> None:
     """Persistent XLA compilation cache (TPU_BFS_BENCH_XLA_CACHE, default
     .bench_cache/xla_cache; empty disables). First compiles of the level
@@ -759,6 +783,7 @@ def main() -> int:
         if watchdog is not None:
             watchdog.cancel()
         print(json.dumps(result))
+        _log_result(result, mode)
         return 0
     finally:
         # Always disarm, whatever raised — a leaked timer would os._exit a

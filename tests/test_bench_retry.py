@@ -273,6 +273,28 @@ def test_budget_exhausted_is_not_retried_by_outer_ladders(monkeypatch):
     assert len(calls) == 1
 
 
+def test_result_log_appends_and_disables(monkeypatch, tmp_path, capsys, toy_graph):
+    # A healthy run appends one timestamped JSON line to the durable
+    # result log; the empty-string override disables it entirely.
+    monkeypatch.setenv("TPU_BFS_BENCH_MODE", "single")
+    monkeypatch.setenv("TPU_BFS_BENCH_SOURCES", "2")
+    monkeypatch.setattr(bench, "load_graph", lambda scale, ef: toy_graph)
+    log_path = tmp_path / "results.jsonl"
+    monkeypatch.setenv("TPU_BFS_BENCH_RESULT_LOG", str(log_path))
+
+    assert bench.main() == 0
+    capsys.readouterr()
+    lines = log_path.read_text().strip().splitlines()
+    assert len(lines) == 1
+    rec = json.loads(lines[0])
+    assert rec["mode"] == "single" and rec["value"] is not None and "utc" in rec
+
+    monkeypatch.setenv("TPU_BFS_BENCH_RESULT_LOG", "")
+    assert bench.main() == 0
+    capsys.readouterr()
+    assert len(log_path.read_text().strip().splitlines()) == 1
+
+
 def test_backend_init_retry_waits_and_resets(monkeypatch):
     # Stub the real clear_backends: calling it for real would wipe the
     # whole pytest process's live backend/jit caches (conftest's virtual

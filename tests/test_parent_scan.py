@@ -100,6 +100,18 @@ def test_scan_deep_graph(line_graph):
     np.testing.assert_array_equal(out, _oracle(line_graph, [0], res))
 
 
+def test_scan_wide_rows(random_small):
+    # w=256 rows (8192 lanes): the scan's word-chunking and lane map must
+    # hold past the default width (the round-3 width generalization).
+    g = random_small
+    rng = np.random.default_rng(3)
+    sources = rng.choice(np.flatnonzero(g.degrees > 0), size=40, replace=False)
+    res = WidePackedMsBfsEngine(g, lanes=8192).run(sources)
+    out = np.empty((40, g.num_vertices), np.int32)
+    res.parents_into(out, device="device")
+    np.testing.assert_array_equal(out, _oracle(g, sources, res))
+
+
 def test_scan_serves_prebuilt_ell(random_small):
     # New capability: a prebuilt-ELL engine retains no edge list, so the
     # host path raises — but the scan only needs the ELL itself.

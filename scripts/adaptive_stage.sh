@@ -10,15 +10,7 @@ out=.bench_cache/chip_session
 deadline=$(( $(date +%s) + ${ADAPTIVE_STAGE_WINDOW_S:-28800} ))
 
 has_value() {
-  python - "$1" <<'EOF'
-import json, sys
-try:
-    with open(sys.argv[1]) as f:
-        lines = [l for l in f if l.strip().startswith("{")]
-    sys.exit(0 if lines and json.loads(lines[-1])["value"] is not None else 1)
-except Exception:
-    sys.exit(1)
-EOF
+  python scripts/has_value.py "$1"
 }
 
 while [ "$(date +%s)" -lt "$deadline" ]; do

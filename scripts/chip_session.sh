@@ -16,15 +16,7 @@ attempts="${CHIP_SESSION_ATTEMPTS:-12}"
 mkdir -p "$out"
 
 got_value() {  # true iff $1 ends with a JSON line carrying a non-null value
-  python - "$1" <<'EOF'
-import json, sys
-try:
-    with open(sys.argv[1]) as f:
-        lines = [l for l in f if l.strip().startswith("{")]
-    sys.exit(0 if lines and json.loads(lines[-1])["value"] is not None else 1)
-except Exception:
-    sys.exit(1)
-EOF
+  python scripts/has_value.py "$1"
 }
 
 stage() {  # stage <name> <json-out> [ENV=VAL...] — one bench.py run

@@ -1,0 +1,24 @@
+"""Exit 0 iff the file's last JSON line carries a non-null "value".
+
+The one shared gate for bench output (scripts/chip_session.sh and
+scripts/adaptive_stage.sh): the bench's outage envelope exits 0 with a
+value=null JSON when the chip never comes up, so rc alone cannot
+distinguish a landed measurement — and the contract must live in exactly
+one place so the two orchestration scripts cannot drift.
+"""
+
+import json
+import sys
+
+
+def main(path: str) -> int:
+    try:
+        with open(path) as f:
+            lines = [l for l in f if l.strip().startswith("{")]
+        return 0 if lines and json.loads(lines[-1])["value"] is not None else 1
+    except Exception:  # noqa: BLE001 — any unreadable file is "no value"
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1]))

@@ -273,6 +273,17 @@ def test_budget_exhausted_is_not_retried_by_outer_ladders(monkeypatch):
     assert len(calls) == 1
 
 
+def test_backend_came_up_attribution(monkeypatch):
+    # The watchdog's honest attribution: a live backend means the budget
+    # lost the measurement, not an outage. In this pytest process the CPU
+    # backend is initialized -> True; an empty registry -> False.
+    from jax._src import xla_bridge
+
+    assert bench._backend_came_up() is True
+    monkeypatch.setattr(xla_bridge, "_backends", {})
+    assert bench._backend_came_up() is False
+
+
 def test_result_log_appends_and_disables(monkeypatch, tmp_path, capsys, toy_graph):
     # A healthy run appends one timestamped JSON line to the durable
     # result log; the empty-string override disables it entirely.

@@ -618,14 +618,16 @@ class PackedBatchResult:
             raise ValueError(
                 f"out is {out.shape}, need ({n}, {self._engine.num_vertices})"
             )
-        scanner = acquire_parent_scanner(self._engine, device)
+        host_serves = getattr(self._engine, "host_graph", None) is not None
+        scanner = acquire_parent_scanner(
+            self._engine, device, host_serves=host_serves
+        )
         if scanner is not None:
             return parents_scan_with_fallback(
                 lambda: self._parents_into_scan(out, scanner),
                 lambda: self._parents_into_host(out),
                 device,
-                host_serves=getattr(self._engine, "host_graph", None)
-                is not None,
+                host_serves=host_serves,
             )
         return self._parents_into_host(out)
 
@@ -767,14 +769,18 @@ def parent_scanner_of(engine):
     return scanner
 
 
-def acquire_parent_scanner(engine, device: str):
+def acquire_parent_scanner(engine, device: str, *, host_serves: bool = True):
     """Shared scanner-acquisition policy of the packed result classes
     (PackedBatchResult here, PackedBfsResult in msbfs_packed.py): validate
     the ``device`` argument, return the engine's scanner or None for the
     host path, raise when ``'device'`` is forced but unavailable, and
     swallow a RESOURCE_EXHAUSTED during the scanner build in auto mode
-    (the build itself may transfer full-ELL tables). One copy of the OOM
-    policy, so the two contracts cannot drift."""
+    (the build itself may transfer full-ELL tables) — but ONLY when the
+    host path can actually serve the result (``host_serves``; masking a
+    build-time OOM behind the host path's 'needs the edge list' error
+    would discard the real cause, the same rule
+    parents_scan_with_fallback applies at scan time). One copy of the OOM
+    policy, so the contracts cannot drift."""
     if device not in ("auto", "host", "device"):
         raise ValueError(f"device must be auto|host|device, got {device!r}")
     scanner = None
@@ -782,7 +788,11 @@ def acquire_parent_scanner(engine, device: str):
         try:
             scanner = parent_scanner_of(engine)
         except Exception as exc:  # noqa: BLE001 — OOM-only fallback
-            if device == "device" or "RESOURCE_EXHAUSTED" not in str(exc):
+            if (
+                device == "device"
+                or "RESOURCE_EXHAUSTED" not in str(exc)
+                or not host_serves
+            ):
                 raise
     if scanner is None and device == "device":
         raise ValueError(

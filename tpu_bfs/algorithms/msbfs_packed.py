@@ -111,14 +111,17 @@ class PackedBfsResult:
                 self._parent_cache.pop(s, None)
             return out
 
-        scanner = acquire_parent_scanner(self._engine, device)
+        host_serves = self._graph is not None
+        scanner = acquire_parent_scanner(
+            self._engine, device, host_serves=host_serves
+        )
         if scanner is None:
             return host()
         return parents_scan_with_fallback(
             lambda: self._parents_into_scan(out, scanner),
             host,
             device,
-            host_serves=self._graph is not None,
+            host_serves=host_serves,
         )
 
     def _parents_into_scan(self, out: np.ndarray, scanner) -> np.ndarray:

@@ -29,6 +29,11 @@ the atomicMin claim (bfs.cu:146-150), which has no TPU analog.
 
 Lane convention: word-major — lane ``l`` at word ``l // 32``, bit ``l % 32``.
 (The hybrid engine is bit-major instead, as its MXU kernel requires.)
+
+Opt-in ``adaptive_push=(row_cap, deg_cap)`` gates light levels onto a
+push-style pass over just the active rows' out-edges instead of the full
+ELL scan (_packed_common.make_adaptive_hit; BENCHMARKS.md "Level-adaptive
+expansion" for the measured keep-or-kill).
 """
 
 from __future__ import annotations

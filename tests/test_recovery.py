@@ -64,6 +64,39 @@ def test_recovery_completes_bit_identical(random_small):
     np.testing.assert_array_equal(res.parent, baseline.parent)
 
 
+def test_recovery_tiled_engine(rmat_small):
+    # Round 4 gave the tiled single-stream engine the checkpoint protocol;
+    # the recovery driver must rebuild + resume it bit-identically too.
+    from tpu_bfs.algorithms.bfs_tiled import TiledBfsEngine
+
+    g = rmat_small
+    baseline = TiledBfsEngine(g, tile_thr=4).run(1)
+    fail_times = [1]
+
+    def make():
+        eng = TiledBfsEngine(g, tile_thr=4)
+        real_advance = eng.advance
+
+        def advance(ckpt, levels=None):
+            if fail_times:
+                fail_times.pop()
+                raise FakeJaxRuntimeError(REMOTE_COMPILE_MSG)
+            return real_advance(ckpt, levels)
+
+        eng.advance = advance
+        return eng
+
+    engine = make()
+    st = engine.start(1)
+    engine, st, restarts = advance_with_recovery(
+        make, st, engine=engine, levels_per_chunk=1, log=lambda m: None
+    )
+    assert restarts == 1 and st.done
+    res = engine.finish(st)
+    np.testing.assert_array_equal(res.distance, baseline.distance)
+    np.testing.assert_array_equal(res.parent, baseline.parent)
+
+
 def test_recovery_resumes_from_last_saved_chunk(random_small, tmp_path):
     # The failure hits mid-traversal; the save callback captured the chunks
     # before it, and the traversal still finishes from them.

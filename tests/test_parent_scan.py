@@ -207,6 +207,28 @@ def test_packed512_scan_matches_oracle(random_small, random_disconnected):
     np.testing.assert_array_equal(out3, _oracle(g, [0, 5], r3))
 
 
+def test_cli_dist_save_parent(tmp_path):
+    # The --save-parent bulk export on a DISTRIBUTED multi-source run
+    # routes through the device scan (row-space perm over the sharded
+    # tables) and must match the oracle end to end.
+    from tpu_bfs import cli
+    from tpu_bfs.graph.generate import random_graph
+    from tpu_bfs.reference import bfs_scipy
+
+    out = tmp_path / "p.npy"
+    spec = "random:n=300,m=1200,seed=8"
+    rc = cli.main(["1", spec, "--multi-source", "5,9", "--devices", "4",
+                   "--save-parent", str(out)])
+    assert rc == 0
+    p = np.load(out)
+    g = random_graph(300, 1200, seed=8)
+    for i, s in enumerate([1, 5, 9]):
+        np.testing.assert_array_equal(
+            p[i],
+            validate.min_parent_from_dist(g, s, np.asarray(bfs_scipy(g, s))),
+        )
+
+
 def test_scanner_cache_policy(random_small, rmat_small):
     # Borrowing scanners (wide: the engine's own ELL tables) are cached;
     # owning scanners (hybrid: a freshly transferred full ELL) are not —

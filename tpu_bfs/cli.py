@@ -278,9 +278,10 @@ def _run_multi_source(args, g, golden) -> int:
             res.distances_int32(i) for i in range(len(sources))
         ]))
     if args.save_parent:
-        # Bulk export: one O(E) scatter-min per lane (lane 0 reuses the
-        # validation pass's cached tree), cache-evicting as it fills so
-        # peak host memory stays near the one output array.
+        # Bulk export: the batched device min-key scan when the engine can
+        # serve it (one expansion pass per 128 lanes, single-chip or
+        # distributed — parent_scan.py), host scatter-min otherwise; peak
+        # host memory stays near the one output array either way.
         out = np.empty((len(sources), g.num_vertices), np.int32)
         np.save(args.save_parent, res.parents_into(out))
     return 0

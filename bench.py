@@ -3,23 +3,24 @@
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 Baseline target: 10 GTEPS/chip (BASELINE.json north_star). TEPS follows the
 Graph500 convention: traversed input edges / per-source time, harmonic mean
-over sources. The flagship path is the 4096-lane hybrid MXU+gather
-multi-source engine (tpu_bfs/algorithms/msbfs_hybrid.py): one batch run of N
-concurrent sources, per-source time = batch time / N — the metric label says
-so explicitly.
+over sources. The flagship path is the 8192-lane hybrid MXU+gather
+multi-source engine (tpu_bfs/algorithms/msbfs_hybrid.py, round-4 measured
+default width): one batch run of N concurrent sources, per-source time =
+batch time / N — the metric label says so explicitly.
 
 Env overrides: TPU_BFS_BENCH_SCALE (default 21), TPU_BFS_BENCH_EF (16),
 TPU_BFS_BENCH_MODE (hybrid|wide|msbfs|single|single-dopt|single-tiled|
 lj-hybrid|lj-single-dopt — the lj-* modes bench the LiveJournal-shaped
 stand-in, NONETWORK.md),
 TPU_BFS_BENCH_LANES (msbfs mode, 512), TPU_BFS_BENCH_MAX_LANES (hybrid/wide
-modes, 4096 — set 8192 to sweep w=256 rows), TPU_BFS_BENCH_SOURCES (single
+modes, 8192 = the measured default — sweep knob), TPU_BFS_BENCH_SOURCES (single
 modes, 8), TPU_BFS_BENCH_VALIDATE (1), TPU_BFS_BENCH_VALIDATE_LANES (4),
 TPU_BFS_BENCH_CACHE (.bench_cache), TPU_BFS_BENCH_BUDGET_S (2400 — the
 outage envelope's wall-clock budget; 0 disables; on exhaustion the one JSON
 line carries value=null and a machine-readable "error"),
-TPU_BFS_BENCH_ADAPTIVE ("rows,deg" — opt-in level-adaptive push expansion
-for the hybrid/wide modes; BENCHMARKS.md "Level-adaptive expansion"),
+TPU_BFS_BENCH_ADAPTIVE (level-adaptive push for the hybrid/wide modes —
+default ON at the measured "8192,64"; "rows,deg" overrides, "0"/"off"
+disables; BENCHMARKS.md "Level-adaptive expansion"),
 TPU_BFS_BENCH_XLA_CACHE (.bench_cache/xla_cache — persistent XLA compile
 cache across bench processes; empty disables).
 """
@@ -280,22 +281,40 @@ def _env_max_lanes(*, default: int) -> int:
 
 
 def _env_adaptive():
-    """TPU_BFS_BENCH_ADAPTIVE="rows,deg" -> (rows, deg) or None. Mirrors
-    the CLI's validation (positive ints, right arity) so a typo degrades
-    to a logged 'off' instead of crashing a flagship build mid-bench."""
-    raw = os.environ.get("TPU_BFS_BENCH_ADAPTIVE", "")
-    if not raw:
+    """TPU_BFS_BENCH_ADAPTIVE -> (rows, deg) or None.
+
+    Default ON at the measured caps (8192, 64): the round-4 chip session
+    measured the level-adaptive push at 62.21 GTEPS vs 55.96 plain on the
+    8192-lane flagship (oracle-validated at full width). "rows,deg"
+    overrides the caps; "0"/"off" disables. A malformed value degrades to
+    a logged 'off' (never crash a flagship build mid-bench)."""
+    raw = os.environ.get("TPU_BFS_BENCH_ADAPTIVE", "").strip().lower()
+    if raw in ("0", "off", "no", "false"):
+        log("adaptive push disabled by TPU_BFS_BENCH_ADAPTIVE")
         return None
+    if not raw:
+        log("adaptive push on (default): row_cap=8192 deg_cap=64")
+        return (8192, 64)
     try:
         r, d = (int(t) for t in raw.split(","))
         if r < 1 or d < 1:
             raise ValueError
     except ValueError:
         log(f"TPU_BFS_BENCH_ADAPTIVE={raw!r} must be ROWS,DEG positive "
-            f"ints; adaptive push off")
+            f"ints or 0/off; adaptive push off")
         return None
     log(f"adaptive push enabled: row_cap={r} deg_cap={d}")
     return (r, d)
+
+
+def _is_oom(exc: BaseException) -> bool:
+    """Deterministic out-of-HBM flavors (XLA compile- or run-time). Not
+    transient — but when the adaptive push table is resident, shedding it
+    and re-running plain is a legitimate fallback (see bench_hybrid).
+    Lazy import: one marker set shared with the recovery classifier."""
+    from tpu_bfs.utils.recovery import is_oom_failure
+
+    return is_oom_failure(exc)
 
 
 def load_graph(scale: int, ef: int):
@@ -454,8 +473,8 @@ def load_graph_lj():
     return g
 
 
-def _bench_batch_4096(g, graph_desc, engine, in_degree, build_log: str, label: str) -> dict:
-    """Shared protocol of the 4096-lane batch benches: hub pilot (doubles as
+def _bench_batch_packed(g, graph_desc, engine, in_degree, build_log: str, label: str) -> dict:
+    """Shared protocol of the wide packed-batch benches: hub pilot (doubles as
     compile warm-up), search keys from the hub's traversable component
     (Graph500 samples among degree>=1 vertices), one timed batch, N-lane
     SciPy validation (TPU_BFS_BENCH_VALIDATE_LANES, default 4, spread
@@ -517,15 +536,20 @@ def _bench_batch_4096(g, graph_desc, engine, in_degree, build_log: str, label: s
     }
 
 
-def bench_hybrid(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
-    """Flagship: 4096-lane hybrid MXU+gather MS-BFS (msbfs_hybrid.py).
+def bench_hybrid(g, scale: int, ef: int, graph_desc: str | None = None,
+                 _shed_adaptive: bool = False) -> dict:
+    """Flagship: hybrid MXU+gather MS-BFS (msbfs_hybrid.py), default width
+    8192 lanes (the round-4 measured optimum; auto sizing walks down).
 
     Falls back to the gather-only wide engine when the graph's packed state
     cannot fit 4096 lanes next to the dense tiles (the Pallas kernel needs
-    w % 128 == 0, so 4096 lanes is its minimum width; wider multiples are
-    the TPU_BFS_BENCH_MAX_LANES sweep)."""
+    w % 128 == 0, so 4096 lanes is its minimum width). ``_shed_adaptive``
+    is the internal OOM-fallback flag: a re-bench with the push table
+    dropped (parameter, not env mutation — the shed must not leak into
+    later runs in the same process)."""
     from tpu_bfs.algorithms._packed_common import auto_lanes, auto_planes
     from tpu_bfs.algorithms.msbfs_hybrid import (
+        DEFAULT_MAX_LANES,
         LANES,
         HybridMsBfsEngine,
         LanesDontFitError,
@@ -551,53 +575,97 @@ def bench_hybrid(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
         return bench_wide(g, scale, ef, graph_desc)
 
     t0 = time.perf_counter()
-    # TPU_BFS_BENCH_MAX_LANES (default 4096): opt-in width sweep. The
-    # engines accept wider rows (w=256 -> 8192 lanes, msbfs_hybrid.MAX_LANES
-    # cap) but auto sizing may still settle at 4096 when the wider state
-    # does not fit next to the tiles; whatever width is chosen appears in
-    # the metric label via engine.lanes.
-    max_lanes = _env_max_lanes(default=LANES)
-    # TPU_BFS_BENCH_ADAPTIVE="rows,deg" (opt-in, experimental): the
-    # level-adaptive push path (BENCHMARKS.md 'Level-adaptive expansion');
-    # results stay oracle-validated either way.
-    adaptive = _env_adaptive()
+    # TPU_BFS_BENCH_MAX_LANES (default 8192 = DEFAULT_MAX_LANES, the
+    # round-4 measured optimum — 55.96 vs 45.68 GTEPS at 4096): width
+    # sweep knob. Auto sizing may still settle narrower when the wider
+    # state does not fit next to the tiles; whatever width is chosen
+    # appears in the metric label via engine.lanes.
+    max_lanes = _env_max_lanes(default=DEFAULT_MAX_LANES)
+    # Level-adaptive push, default ON at the measured caps (see
+    # _env_adaptive; TPU_BFS_BENCH_ADAPTIVE=0 disables, "rows,deg"
+    # re-tunes); results stay oracle-validated either way.
+    adaptive = None if _shed_adaptive else _env_adaptive()
     kw = {} if adaptive is None else {"adaptive_push": adaptive}
     try:
         engine = retry_transient(HybridMsBfsEngine, g, max_lanes=max_lanes,
                                  label="hybrid engine build", **kw)
     except LanesDontFitError as exc:
+        if adaptive is not None:
+            # The push table is ~act*deg_cap*4 B of resident state; on
+            # graphs near the HBM edge (the LJ stand-in) it can push the
+            # hybrid under its 4096-lane minimum. Dropping the push pass
+            # costs ~10% (62.2 -> 56.0 measured); dropping the MXU path
+            # for the wide engine costs ~2x — so shed adaptive FIRST.
+            log(f"hybrid+adaptive doesn't fit ({exc}); retrying hybrid "
+                f"without the push table")
+            return bench_hybrid(g, scale, ef, graph_desc,
+                                _shed_adaptive=True)
         log(f"hybrid unavailable ({exc}); falling back to wide engine")
         return bench_wide(g, scale, ef, graph_desc)
     hg = engine.hg
-    return _bench_batch_4096(
-        g, graph_desc or f"RMAT scale-{scale} ef={ef}", engine, hg.in_degree,
-        f"engine build {time.perf_counter()-t0:.1f}s: tiles={hg.num_tiles} "
-        f"dense={hg.num_dense_edges/max(g.num_edges,1)*100:.1f}% "
-        f"a_mem={hg.a_tiles.nbytes/2**30:.2f}GiB",
-        "hybrid MXU+gather",
-    )
+    shed = False
+    try:
+        return _bench_batch_packed(
+            g, graph_desc or f"RMAT scale-{scale} ef={ef}", engine,
+            hg.in_degree,
+            f"engine build {time.perf_counter()-t0:.1f}s: tiles={hg.num_tiles} "
+            f"dense={hg.num_dense_edges/max(g.num_edges,1)*100:.1f}% "
+            f"a_mem={hg.a_tiles.nbytes/2**30:.2f}GiB",
+            "hybrid MXU+gather" + ("" if adaptive is None else "+adaptive-push"),
+        )
+    except Exception as exc:  # noqa: BLE001 — OOM-shed fallback only
+        if adaptive is None or not _is_oom(exc):
+            raise
+        # Sizing models can't see every XLA temp; if the push-table
+        # configuration OOMs at compile/run time, shed it and re-bench
+        # plain (the round-4 LJ wide fallback died exactly here). The
+        # rebuild happens OUTSIDE this except block: the raised frames
+        # reference the OOM'd engine, and its device tables must be
+        # droppable before the plain engine allocates its own.
+        log(f"hybrid+adaptive OOM ({str(exc)[:200]}); re-benching plain")
+        shed = True
+    del engine, hg
+    assert shed
+    return bench_hybrid(g, scale, ef, graph_desc, _shed_adaptive=True)
 
 
-def bench_wide(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
-    """4096-lane wide packed MS-BFS, gather-only (msbfs_wide.py)."""
+def bench_wide(g, scale: int, ef: int, graph_desc: str | None = None,
+               _shed_adaptive: bool = False) -> dict:
+    """Wide packed MS-BFS, gather-only (msbfs_wide.py); default width 8192
+    lanes like the hybrid. ``_shed_adaptive`` as in bench_hybrid."""
     from tpu_bfs.algorithms.msbfs_wide import (
-        LANES as WIDE_LANES,
+        DEFAULT_MAX_LANES as WIDE_DEFAULT_MAX_LANES,
         WidePackedMsBfsEngine,
     )
 
     t0 = time.perf_counter()
-    max_lanes = _env_max_lanes(default=WIDE_LANES)
-    adaptive = _env_adaptive()
+    max_lanes = _env_max_lanes(default=WIDE_DEFAULT_MAX_LANES)
+    adaptive = None if _shed_adaptive else _env_adaptive()
     kw = {} if adaptive is None else {"adaptive_push": adaptive}
     engine = retry_transient(WidePackedMsBfsEngine, g, max_lanes=max_lanes,
                              label="wide engine build", **kw)
     ell = engine.ell
-    return _bench_batch_4096(
-        g, graph_desc or f"RMAT scale-{scale} ef={ef}", engine, ell.in_degree,
-        f"engine build {time.perf_counter()-t0:.1f}s: slots={ell.total_slots} "
-        f"(x{ell.total_slots/max(g.num_edges,1):.2f}) heavy={ell.num_heavy}",
-        "wide packed",
-    )
+    shed = False
+    try:
+        return _bench_batch_packed(
+            g, graph_desc or f"RMAT scale-{scale} ef={ef}", engine,
+            ell.in_degree,
+            f"engine build {time.perf_counter()-t0:.1f}s: slots={ell.total_slots} "
+            f"(x{ell.total_slots/max(g.num_edges,1):.2f}) heavy={ell.num_heavy}",
+            "wide packed" + ("" if adaptive is None else "+adaptive-push"),
+        )
+    except Exception as exc:  # noqa: BLE001 — OOM-shed fallback only
+        if adaptive is None or not _is_oom(exc):
+            raise
+        # Same push-table shed as bench_hybrid: the round-4 LJ run
+        # compile-OOM'd (16.22G of 15.75G hbm) with the table resident.
+        # Rebuild outside the except block so the OOM'd engine's device
+        # tables are droppable first.
+        log(f"wide+adaptive OOM ({str(exc)[:200]}); re-benching plain")
+        shed = True
+    del engine, ell
+    assert shed
+    return bench_wide(g, scale, ef, graph_desc, _shed_adaptive=True)
 
 
 def bench_msbfs(g, scale: int, ef: int) -> dict:
@@ -809,6 +877,21 @@ def main() -> int:
                 f"(last: {type(exc.cause).__name__}: {str(exc.cause)[:200]})",
             )))
             return 0
+        except Exception as exc:  # noqa: BLE001 — one-JSON-line contract
+            # Deterministic failures (a sizing bug OOMing at runtime, a
+            # validation mismatch) must still leave one parseable JSON
+            # line — the round-4 lj-hybrid run died rc=1 with only a
+            # traceback. Exit NONZERO (unlike the outage verdict): this
+            # is a bug to fix, not infrastructure to wait out.
+            if watchdog is not None:
+                watchdog.cancel()
+            import traceback
+
+            traceback.print_exc()
+            print(json.dumps(_failure_payload(
+                mode, f"{type(exc).__name__}: {str(exc)[:300]}"
+            )))
+            return 1
         if watchdog is not None:
             watchdog.cancel()
         print(json.dumps(result))

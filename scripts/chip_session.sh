@@ -10,6 +10,19 @@
 # makes each attempt terminate cleanly with a value=null JSON when the
 # chip never comes up — rc alone no longer distinguishes success, so
 # every stage's JSON is checked for a non-null value.
+#
+# Since round 4 the bench DEFAULTS are the measured-best configuration
+# (8192 lanes + level-adaptive push), so the headline "flagship" stage
+# runs plain `python bench.py`, and the comparison arms pin their env
+# explicitly — each stage measures exactly what its name claims:
+#   flagship            defaults (8192 lanes, adaptive push)
+#   flagship-noadaptive TPU_BFS_BENCH_ADAPTIVE=0      — the push A/B arm
+#   width-4096-plain    + TPU_BFS_BENCH_MAX_LANES=4096 — the width A/B arm
+#                         (also the round-1..3 historical series config)
+#   lj-hybrid           defaults on the LiveJournal-shaped stand-in
+# (The former adaptive_stage.sh follow-on is folded in as the
+# flagship-noadaptive arm: the round-4 keep-or-kill measured 62.21 GTEPS
+# adaptive vs 55.96 plain and adaptive became the default.)
 set -u
 out=.bench_cache/chip_session
 attempts="${CHIP_SESSION_ATTEMPTS:-12}"
@@ -38,8 +51,10 @@ for i in $(seq 1 "$attempts"); do
     python scripts/width_probe.py >"$out/width_probe.jsonl" 2>"$out/width_probe.log" \
       && echo "width probe OK" || echo "width probe FAILED (see $out/width_probe.log)"
     cat "$out/width_probe.jsonl" 2>/dev/null
-    stage "8192-lane sweep" "$out/flagship_8k.json" TPU_BFS_BENCH_MAX_LANES=8192
-    stage "16384-lane sweep" "$out/flagship_16k.json" TPU_BFS_BENCH_MAX_LANES=16384
+    stage "flagship-noadaptive" "$out/flagship_noadaptive.json" \
+      TPU_BFS_BENCH_ADAPTIVE=0
+    stage "width-4096-plain" "$out/flagship_4k_plain.json" \
+      TPU_BFS_BENCH_ADAPTIVE=0 TPU_BFS_BENCH_MAX_LANES=4096
     stage "lj-hybrid" "$out/lj_hybrid.json" TPU_BFS_BENCH_MODE=lj-hybrid
     exit 0
   fi

@@ -1,10 +1,11 @@
 """Exit 0 iff the file's last JSON line carries a non-null "value".
 
-The one shared gate for bench output (scripts/chip_session.sh and
-scripts/adaptive_stage.sh): the bench's outage envelope exits 0 with a
+The one shared gate for bench output (scripts/chip_session.sh — its sole
+caller since the adaptive follow-on stage was folded into the session's
+flagship-noadaptive arm): the bench's outage envelope exits 0 with a
 value=null JSON when the chip never comes up, so rc alone cannot
-distinguish a landed measurement — and the contract must live in exactly
-one place so the two orchestration scripts cannot drift.
+distinguish a landed measurement — keeping the contract in one place
+stops orchestration scripts from drifting.
 """
 
 import json

@@ -23,9 +23,27 @@ Prints one JSON line per (op, w). Safe to re-run; ~1 min total.
 from __future__ import annotations
 
 import json
+import os
+import sys
 import time
 
 import numpy as np
+
+# `python scripts/width_probe.py` puts scripts/ (not the repo root) on
+# sys.path; the tile_spmm probe imports tpu_bfs and died on that in the
+# first chip-session run.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _fence(out) -> float:
+    """Full-completion fence (shared with the engines' run_timed): a host
+    read of a scalar derived from the output — ``block_until_ready`` alone
+    returned early on the axon remote platform (the first chip-session
+    probe run "finished" a 2 GB chained gather in 36 us). The shared
+    implementation warns loudly if that early return ever recurs."""
+    from tpu_bfs.utils.timing import fence
+
+    return fence(out, warn=True)
 
 
 def probe_gather(rows: int = 1_250_000, n_idx: int = 1_000_000,
@@ -56,18 +74,26 @@ def probe_gather(rows: int = 1_250_000, n_idx: int = 1_000_000,
 
             return jax.lax.fori_loop(0, chain, body, acc)
 
-        chained(table, idx).block_until_ready()  # compile + warm
+        warm = chained(table, idx)
+        _fence(warm)  # compile + warm
+        # The fence's fixed epilogue (one tiny dispatch + host round-trip,
+        # ~0.1 s on the axon tunnel) is the same order as a few reps of the
+        # measurement itself; measure it on the already-ready warm output
+        # and subtract, and amortize the remainder over more reps — else
+        # every ns/index figure carries a ~flat +epilogue/reps bias.
+        floor = _fence(warm)
         t0 = time.perf_counter()
-        reps = 3
+        reps = 10
         for _ in range(reps):
             out = chained(table, idx)
-        out.block_until_ready()
-        dt = (time.perf_counter() - t0) / reps
+        _fence(out)  # waiting for rep N implies reps 1..N-1 (one stream)
+        dt = max(time.perf_counter() - t0 - floor, 1e-9) / reps
         ns_per_index = dt / (n_idx * chain) * 1e9
         print(json.dumps({
             "op": "chained_row_gather_or", "w_words": w, "lanes": 32 * w,
             "rows": rows, "indices": n_idx * chain,
             "ns_per_index": round(ns_per_index, 2),
+            "fence_floor_s": round(floor, 4),
             "effective_GBps": round(n_idx * chain * w * 4 / dt / 1e9, 1),
         }))
         del table
@@ -101,14 +127,15 @@ def probe_tile_spmm(num_row_tiles: int = 256, tiles_per_row: int = 16,
         args = (jnp.asarray(row_start), jnp.asarray(col_tile),
                 jnp.asarray(a_tiles), jnp.asarray(fw))
         kw = dict(num_row_tiles=num_row_tiles, w=w, interpret=interpret)
-        out = tile_spmm(*args, **kw)
-        out.block_until_ready()  # compile + warm
+        warm = tile_spmm(*args, **kw)
+        _fence(warm)  # compile + warm
+        floor = _fence(warm)  # fixed fence epilogue, subtracted below
         t0 = time.perf_counter()
-        reps = 5
+        reps = 10
         for _ in range(reps):
             out = tile_spmm(*args, **kw)
-        out.block_until_ready()
-        dt = (time.perf_counter() - t0) / reps
+        _fence(out)
+        dt = max(time.perf_counter() - t0 - floor, 1e-9) / reps
         # Small-prefix correctness: vs the NumPy reference always, and vs
         # interpret mode too when the timed run was compiled (TPU).
         small = 4

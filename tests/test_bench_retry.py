@@ -128,9 +128,12 @@ def test_bench_emits_json_despite_interrupted_first_attempt(
     assert calls["n"] >= 3  # failed warm-up + retried warm-up + timed runs
 
 
-def test_bench_fails_loud_on_validation_error(monkeypatch, toy_graph):
+def test_bench_fails_loud_on_validation_error(monkeypatch, capsys, toy_graph):
     """A genuine wrong answer must NOT be retried into silence: corrupt the
-    engine output and assert the bench raises on the first attempt."""
+    engine output and assert the bench fails on the first attempt — exit 1
+    with the ValidationError carried in the one JSON line (round 4: main
+    converts deterministic failures to a parseable value=null verdict
+    instead of a bare traceback, but never retries or exits 0 on them)."""
     from tpu_bfs.algorithms.bfs import BfsEngine
 
     monkeypatch.setenv("TPU_BFS_BENCH_MODE", "single")
@@ -151,8 +154,9 @@ def test_bench_fails_loud_on_validation_error(monkeypatch, toy_graph):
 
     monkeypatch.setattr(BfsEngine, "run", corrupt_run)
 
-    with pytest.raises(Exception):
-        bench.main()
+    assert bench.main() == 1
+    result = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert result["value"] is None and "mismatch" in result["error"]
     # First validated run fails; the outer retry must not have re-run the
     # whole bench (which would double the run count).
     assert calls["n"] == 1
@@ -331,3 +335,86 @@ def test_backend_init_retry_waits_and_resets(monkeypatch):
     # resets jax's cached failed-init state so the retry re-probes.
     assert waits == [60.0]
     assert cleared == [1]
+
+
+def test_env_adaptive_default_on_and_overrides(monkeypatch):
+    # Round 4: the flagship bench runs the level-adaptive push by default
+    # at the measured caps; explicit off-tokens and "rows,deg" overrides
+    # must keep working, and a malformed value degrades to off (never
+    # crash a flagship build mid-bench).
+    monkeypatch.delenv("TPU_BFS_BENCH_ADAPTIVE", raising=False)
+    assert bench._env_adaptive() == (8192, 64)
+    for tok in ("0", "off", "OFF", " no ", "false"):
+        monkeypatch.setenv("TPU_BFS_BENCH_ADAPTIVE", tok)
+        assert bench._env_adaptive() is None
+    monkeypatch.setenv("TPU_BFS_BENCH_ADAPTIVE", "1024,32")
+    assert bench._env_adaptive() == (1024, 32)
+    for bad in ("8192", "a,b", "8192,64,1", "-1,64", "0,64"):
+        monkeypatch.setenv("TPU_BFS_BENCH_ADAPTIVE", bad)
+        assert bench._env_adaptive() is None
+
+
+def test_main_emits_failure_json_on_deterministic_crash(
+    monkeypatch, capsys, toy_graph
+):
+    # Round 4: the lj-hybrid run compile-OOM'd and died rc=1 with only a
+    # traceback — no JSON. Deterministic failures must still leave one
+    # parseable line (value=null + the error), with a NONZERO exit (a bug,
+    # not an outage).
+    monkeypatch.setenv("TPU_BFS_BENCH_MODE", "single")
+    monkeypatch.setattr(bench, "load_graph", lambda scale, ef: toy_graph)
+
+    def blows_up(*a, **k):
+        raise RuntimeError("sizing bug: boom")
+
+    monkeypatch.setattr(bench, "bench_single", blows_up)
+    assert bench.main() == 1
+    result = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert result["value"] is None and "boom" in result["error"]
+
+
+def test_hybrid_oom_sheds_adaptive_and_rebenches_plain(
+    monkeypatch, toy_graph
+):
+    # Round 4: with the adaptive push table resident, the LJ stand-in
+    # OOM'd (16.22G of 15.75G hbm). The bench must shed the push table and
+    # re-bench plain — never surface worse behavior than the pre-default
+    # bench did.
+    calls = []
+
+    class FakeHg:
+        num_tiles = 1
+        num_dense_edges = 1
+        in_degree = np.ones(toy_graph.num_vertices)
+
+        class a_tiles:
+            nbytes = 0
+
+    class FakeEngine:
+        hg = FakeHg()
+        lanes = 4096
+
+        def __init__(self, g, **kw):
+            self.kw = kw
+            calls.append(kw)
+
+    def fake_batch(g, desc, engine, in_degree, build_log, label):
+        if "adaptive_push" in engine.kw:  # only the push-table build OOMs
+            calls.append("oom")
+            raise FakeJaxRuntimeError(
+                "RESOURCE_EXHAUSTED: Ran out of memory in memory space hbm"
+            )
+        return {"metric": label, "value": 1.0, "unit": "GTEPS",
+                "vs_baseline": 0.1}
+
+    monkeypatch.delenv("TPU_BFS_BENCH_ADAPTIVE", raising=False)
+    import tpu_bfs.algorithms.msbfs_hybrid as mh
+
+    monkeypatch.setattr(mh, "HybridMsBfsEngine", FakeEngine)
+    monkeypatch.setattr(bench, "_bench_batch_packed", fake_batch)
+    result = bench.bench_hybrid(toy_graph, 10, 16)
+    # First build carried the push table, OOM'd, then a plain rebuild
+    # landed the number with a plain label.
+    assert "oom" in calls
+    assert result["value"] == 1.0
+    assert "adaptive-push" not in result["metric"]

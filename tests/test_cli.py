@@ -155,3 +155,30 @@ def test_cli_multi_source_lanes_flag(capsys):
         out = capsys.readouterr().out
         assert rc == 0, extra
         assert "Output OK" in out, extra
+
+
+def test_cli_resume_derives_width_from_checkpoint(capsys, tmp_path):
+    # A checkpoint written at an explicit narrower width must resume
+    # WITHOUT --lanes even though the engine default is wider now (the
+    # default moved 4096 -> 8192 lanes in round 4): the CLI derives the
+    # engine width from the checkpoint's packed tables. An explicit
+    # mismatched --lanes still gets the descriptive rejection.
+    ck = tmp_path / "ck.npz"
+    rc = cli.main(
+        ["0", "random:n=200,m=900,seed=3", "--multi-source", "7",
+         "--engine", "wide", "--lanes", "64",
+         "--ckpt", str(ck), "--ckpt-every", "1"]
+    )
+    assert rc == 0
+    capsys.readouterr()
+    rc = cli.main(
+        ["0", "random:n=200,m=900,seed=3", "--multi-source", "7",
+         "--engine", "wide", "--resume", str(ck)]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0 and "(64 lanes)" in out and "Output OK" in out
+    with pytest.raises(Exception):
+        cli.main(
+            ["0", "random:n=200,m=900,seed=3", "--multi-source", "7",
+             "--engine", "wide", "--resume", str(ck), "--lanes", "96"]
+        )

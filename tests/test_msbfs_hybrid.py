@@ -10,7 +10,6 @@ import numpy as np
 import pytest
 
 from tpu_bfs.algorithms.msbfs_hybrid import (
-    LANES,
     HybridMsBfsEngine,
     build_hybrid,
 )
@@ -145,7 +144,9 @@ def test_hybrid_rejects_bad_input(random_small):
     with pytest.raises(ValueError):
         engine.run(np.array([-1]))
     with pytest.raises(ValueError):
-        engine.run(np.arange(LANES + 1))
+        # One source past the engine's actual lane capacity (valid ids, so
+        # the failure is the batch size, not the id range).
+        engine.run(np.zeros(engine.lanes + 1, np.int64))
 
 
 def test_hybrid_w256_dense_tiles(random_small):

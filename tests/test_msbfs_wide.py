@@ -129,7 +129,11 @@ def test_wide_auto_lane_sizing(random_small):
     # instead of OOMing at runtime.
     from tpu_bfs.algorithms._packed_common import auto_lanes
 
-    assert WidePackedMsBfsEngine(random_small).lanes == LANES
+    # Default cap is now 8192 lanes (DEFAULT_MAX_LANES, the round-4
+    # measured optimum); tiny graphs fit the full default width.
+    from tpu_bfs.algorithms.msbfs_wide import DEFAULT_MAX_LANES
+
+    assert WidePackedMsBfsEngine(random_small).lanes == DEFAULT_MAX_LANES
     small = WidePackedMsBfsEngine(random_small, hbm_budget_bytes=int(1.5e6))
     assert 32 <= small.lanes < LANES
     res = small.run(np.array([0, 7]))
@@ -144,18 +148,18 @@ def test_wide_rejects_bad_input(random_small):
     with pytest.raises(ValueError):
         engine.run(np.array([-1]))
     with pytest.raises(ValueError):
-        engine.run(np.arange(LANES + 1))
+        # One source past the engine's actual lane capacity (valid ids, so
+        # the failure is the batch size, not the id range).
+        engine.run(np.zeros(engine.lanes + 1, np.int64))
     with pytest.raises(ValueError):
         WidePackedMsBfsEngine(random_small, num_planes=0)
     assert LANES == 32 * W == 4096
 
 
 def test_wide_w256_lanes_past_4096(random_small):
-    # Width-generalized rows (w=256 -> 8192 lanes): the shared machinery in
-    # _packed_common is width-generic; lanes seeded past the 4096 default
-    # (word columns 128..255) must label identically to the oracle. Opt-in
-    # only — default "auto" sizing stays at 4096 until the wider gather is
-    # measured on hardware (bench.py TPU_BFS_BENCH_MAX_LANES).
+    # Width-generalized rows (w=256 -> 8192 lanes, now the default cap
+    # after the round-4 hardware sweep): lanes seeded past the first 128
+    # words (word columns 128..255) must label identically to the oracle.
     from tpu_bfs.algorithms.msbfs_wide import MAX_LANES
 
     rng = np.random.default_rng(3)

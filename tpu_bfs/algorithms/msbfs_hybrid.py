@@ -68,10 +68,17 @@ W = 128
 LANES = 32 * W
 # The dense kernel needs w to be a MULTIPLE of 128 (Mosaic: the frontier
 # slab's minor dim must be 128-aligned), so wider batches come in steps of
-# 4096 lanes up to MAX_LANES. Default sizing stays at LANES — wider rows
-# double state HBM per step and the gather amortization must be measured
-# (bench.py TPU_BFS_BENCH_MAX_LANES), not assumed.
+# 4096 lanes up to MAX_LANES.
 MAX_LANES = 4 * LANES
+# Default width cap: 8192 lanes (w=256), decided by the round-4 v5e sweep —
+# RMAT scale-21 flagship measured 45.68 GTEPS hmean at 4096 lanes vs 55.96
+# at 8192 (1.22x: the per-index gather cost stays near-flat past 128-word
+# rows, so the wider batch amortizes the same index traffic over 2x the
+# sources). A 16384-lane request auto-settled back at 8192 on the 16 GB
+# chip (state doesn't fit), so 2*LANES is also the widest width that
+# actually materializes there. Auto sizing still walks DOWN from the cap
+# whenever the packed state doesn't fit next to the tiles.
+DEFAULT_MAX_LANES = 2 * LANES
 
 
 class LanesDontFitError(ValueError):
@@ -329,10 +336,11 @@ def _make_core(hg: HybridGraph, w: int, num_planes: int, interpret: bool,
 
 
 class HybridMsBfsEngine:
-    """Up to 4096 concurrent BFS sources by default (``max_lanes`` raises
-    the cap in 4096-lane steps to MAX_LANES); dense tiles on the MXU,
-    residual on gathers. API mirrors WidePackedMsBfsEngine; results are
-    PackedBatchResult."""
+    """Up to 8192 concurrent BFS sources by default (DEFAULT_MAX_LANES,
+    the round-4 measured optimum; ``max_lanes`` moves the cap in 4096-lane
+    steps up to MAX_LANES, and auto sizing walks down when the state
+    doesn't fit); dense tiles on the MXU, residual on gathers. API mirrors
+    WidePackedMsBfsEngine; results are PackedBatchResult."""
 
     def __init__(
         self,
@@ -346,7 +354,7 @@ class HybridMsBfsEngine:
         interpret: bool | None = None,
         undirected: bool | None = None,
         hbm_budget_bytes: int = int(14.0e9),
-        max_lanes: int = LANES,
+        max_lanes: int = DEFAULT_MAX_LANES,
         adaptive_push: tuple[int, int] | None = None,
     ):
         if num_planes != "auto" and not (1 <= num_planes <= 8):

@@ -1,12 +1,15 @@
-"""Wide bit-packed multi-source BFS: 4096 lanes per traversal batch.
+"""Wide bit-packed multi-source BFS: thousands of lanes per traversal
+batch (default cap 8192 lanes = 256-word rows since the round-4 sweep).
 
 Why a second packed engine: measured on TPU v5e, a chained random row-gather
-(gather + OR, the level-loop's inner op) costs ~13 ns/index at row widths of
-64 or 128 uint32 words, ~19 ns at 16 words, and ~30 ns at 32 words — the
-per-index cost is set by tile padding (every [n, w<128] uint32 intermediate is
-physically padded to 128 lanes), not by the bytes fetched. 128-word rows
-(4096 bit-lanes) are therefore the native shape: the gather tax is amortized
-over 8x more sources than the 512-lane engine for the same index count.
+(gather + OR, the level-loop's inner op) is latency-dominated — narrow rows
+pay physical tile padding (every [n, w<128] uint32 intermediate is padded to
+128 lanes: ~19 ns/index at 16 words, ~30 at 32), while widening past 128
+words costs only ~1.2x per doubling (fence-corrected round-4 sweep: 14.5 /
+16.5 / 19.7 / 26.8 ns/index at 64 / 128 / 256 / 512 words). Wide rows are
+therefore the native shape: the same index traffic is amortized over up to
+32x more sources than the 512-lane engine, and each width doubling buys
+~1.67x more lane-bytes per second until HBM stops fitting the state.
 
 Differences from PackedMsBfsEngine (tpu_bfs/algorithms/msbfs_packed.py):
 
@@ -60,14 +63,17 @@ from tpu_bfs.algorithms._packed_common import (
     start_packed_batch,
 )
 
-W = 128  # uint32 words per row: the measured v5e sweet spot (no tile padding)
+W = 128  # uint32 words per row (narrower rows pay physical tile padding)
 LANES = 32 * W
 # Wider rows are legal (any multiple of 32 lanes up to MAX_LANES; the shared
-# machinery in _packed_common is width-generic) but default "auto" sizing
-# stays at LANES: beyond w=128 the per-index gather cost is no longer
-# amortized for free — measure before adopting (bench.py
-# TPU_BFS_BENCH_MAX_LANES sweeps it on real hardware).
+# machinery in _packed_common is width-generic).
 MAX_LANES = 4 * LANES
+# Default width cap: 8192 lanes (w=256) — the round-4 v5e sweep measured the
+# per-index gather cost near-flat from 128- to 256-word rows, and the hybrid
+# flagship gained 1.22x (45.68 -> 55.96 GTEPS hmean) from the doubled batch.
+# Auto sizing walks down from the cap whenever the packed state doesn't fit
+# HBM (msbfs_hybrid.py has the full measurement note).
+DEFAULT_MAX_LANES = 2 * LANES
 
 # Re-exported for callers that consumed these from here before the
 # _packed_common refactor.
@@ -118,7 +124,7 @@ class WidePackedMsBfsEngine:
         num_planes: int = 5,
         undirected: bool | None = None,
         hbm_budget_bytes: int = int(14.0e9),
-        max_lanes: int = LANES,
+        max_lanes: int = DEFAULT_MAX_LANES,
         adaptive_push: tuple[int, int] | None = None,
     ):
         if not (1 <= num_planes <= 8):

@@ -181,7 +181,16 @@ def _run_multi_source(args, g, golden) -> int:
             # e.g. a single-source checkpoint resumed with --multi-source.
             raise SystemExit(f"--resume: {exc}")
         sources = resume_st.sources
-        print(f"resumed {len(sources)} sources at level {resume_st.level}")
+        if args.lanes is None:
+            # Rebuild the engine at the CHECKPOINT's width, not today's
+            # default: the default moved 4096 -> 8192 lanes in round 4,
+            # and a width mismatch is (correctly) rejected downstream —
+            # without this, resuming a pre-round-4 checkpoint would demand
+            # a manual --lanes. An explicit --lanes still wins (and a
+            # mismatch still gets the descriptive rejection).
+            args.lanes = int(resume_st.frontier.shape[1]) * 32
+        print(f"resumed {len(sources)} sources at level {resume_st.level} "
+              f"({args.lanes} lanes)")
         if golden is None and not args.skip_cpu:
             from tpu_bfs.reference import bfs_golden
 

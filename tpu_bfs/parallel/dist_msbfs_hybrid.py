@@ -82,7 +82,11 @@ from tpu_bfs.parallel.dist_bfs import make_mesh
 W = 128
 LANES = 32 * W
 # Same width generalization as the single-chip engines (msbfs_hybrid):
-# wider rows in 4096-lane steps, opt-in via ``lanes``, default unchanged.
+# wider rows in 4096-lane steps, opt-in via ``lanes``. The DISTRIBUTED
+# default stays 4096 (the single-chip default moved to 8192 after the
+# round-4 sweep): the scale-26 per-chip budget below is written for
+# 128-word rows, so width here is an explicit memory trade, not a default
+# (see dist_msbfs_wide.py for the same rationale).
 from tpu_bfs.algorithms.msbfs_hybrid import MAX_LANES  # noqa: E402
 
 

@@ -53,7 +53,12 @@ W = 128
 LANES = 32 * W
 # Width generalization mirrors the single-chip wide engine: any multiple
 # of 32 lanes up to MAX_LANES is legal (the sharded tables are [rows_loc,
-# w] blocks — width-agnostic); the default stays at the measured 4096.
+# w] blocks — width-agnostic). The DISTRIBUTED default stays at 4096 even
+# though the single-chip engines moved to 8192 after the round-4 sweep:
+# the scale-26 per-chip HBM budget (BENCHMARKS.md) is written for 128-word
+# rows, and doubling row bytes would halve the largest graph a given mesh
+# can hold — width here is an explicit trade (``lanes=8192``), not a
+# default.
 from tpu_bfs.algorithms.msbfs_wide import MAX_LANES  # noqa: E402
 
 

@@ -38,13 +38,29 @@ TRANSIENT_PATTERNS = (
     "Unable to initialize backend",
 )
 
+# Out-of-HBM flavors (XLA compile- or run-time). Deterministic — never
+# retried — but callers with sheddable optional state (the bench's
+# adaptive push table) use this to decide a plain re-run. ONE definition:
+# an OOM variant added here is seen by both the transient classifier
+# below and the bench's shed fallback.
+OOM_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "out of memory",
+)
+
+
+def is_oom_failure(exc: BaseException) -> bool:
+    s = str(exc)
+    low = s.lower()
+    return any(m.lower() in low for m in OOM_MARKERS)
+
+
 # Deterministic failures that can carry an INTERNAL: status but are bugs,
 # not infra blips — retrying them burns minutes before surfacing the real
 # error. OOM and shape/lowering errors are never transient.
 NON_TRANSIENT_MARKERS = (
     "Mosaic",
-    "RESOURCE_EXHAUSTED",
-    "out of memory",
+    *OOM_MARKERS,
     "Invalid argument",
 )
 

@@ -1,4 +1,4 @@
-"""Device-timing helper shared by the BFS engines.
+"""Device-timing helpers shared by the BFS engines and measurement scripts.
 
 The reference times with std::chrono around each run (bfs.cu:624-626) and has
 no JIT to exclude; here the first execution compiles, so engines warm once per
@@ -7,20 +7,82 @@ compiled shape before timing.
 
 from __future__ import annotations
 
+import sys
 import time
 
 import jax
+import numpy as np
+
+
+def fence(out, *, warn: bool = False) -> float:
+    """Completion fence; returns seconds spent waiting.
+
+    ``block_until_ready`` alone proved unreliable as a fence on the axon
+    remote platform (round 4: the first on-chip width-probe run "finished"
+    a 2 GB gather chain in 36 µs — implied 56-213 TB/s on one v5e chip). A
+    host read of an element *derived from* the output cannot return before
+    the producing computation has run — the same discipline as the packed
+    engines' ``int(levels)`` sync (_packed_common.py). One element, so the
+    extra transfer is negligible against any timed run.
+
+    With ``warn=True`` (measurement scripts), prints a stderr diagnostic
+    when the scalar read did the real wait — the detector for the
+    early-return bug recurring. Threshold 0.5 s: the first fence also
+    compiles the one-element index op (~0.1 s), which is not a symptom.
+    """
+    t0 = time.perf_counter()
+    jax.block_until_ready(out)
+    t_block = time.perf_counter() - t0
+    # First leaf that is a non-empty device array; Python scalars are host
+    # values already and empty arrays have no element to read.
+    leaf = next(
+        (l for l in jax.tree_util.tree_leaves(out)
+         if hasattr(l, "ndim") and getattr(l, "size", 0)),
+        None,
+    )
+    if leaf is not None:
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards:
+            # Sharded output: read one element from EVERY shard — element
+            # 0 alone only forces the device owning it, and per-device
+            # work dispatched after the final collective elsewhere could
+            # still be in flight.
+            for s in shards:
+                d = s.data
+                np.asarray(d[(0,) * d.ndim])
+        else:
+            np.asarray(leaf[(0,) * leaf.ndim])
+    t_read = time.perf_counter() - t0 - t_block
+    if warn and t_read > max(0.5, 10 * t_block):
+        print(
+            f"WARNING: block_until_ready returned early (waited "
+            f"{t_block:.6f}s); the scalar-read fence did the real wait "
+            f"({t_read:.6f}s)",
+            file=sys.stderr,
+            flush=True,
+        )
+    return t_block + t_read
 
 
 def run_timed(call, *, warm: bool):
     """Execute ``call`` and return (result, elapsed_seconds).
 
     When ``warm`` is true, one untimed execution runs first (absorbing
-    compilation); the timed execution blocks until device completion.
+    compilation); the timed execution blocks until device completion. The
+    fence's fixed epilogue (dispatch + host round-trip of the element
+    reads — ~0.1 s over the axon tunnel, µs locally) is measured by a
+    second fence on the already-materialized output and subtracted, so
+    per-run figures don't carry a flat host-latency bias (the same
+    correction scripts/width_probe.py applies).
     """
     if warm:
-        jax.block_until_ready(call())
+        fence(call())
     t0 = time.perf_counter()
     out = call()
-    jax.block_until_ready(out)
-    return out, time.perf_counter() - t0
+    fence(out)
+    t1 = time.perf_counter()
+    floor = fence(out)  # output is ready: pure epilogue cost
+    # Epsilon clamp, not 0.0: downstream TEPS math divides by elapsed (a
+    # zero would turn the result's teps into None and crash its callers);
+    # 1e-9 s matches width_probe's clamp.
+    return out, max(t1 - t0 - floor, 1e-9)

@@ -21,6 +21,8 @@ line carries value=null and a machine-readable "error"),
 TPU_BFS_BENCH_ADAPTIVE (level-adaptive push for the hybrid/wide modes —
 default ON at the measured "8192,64"; "rows,deg" overrides, "0"/"off"
 disables; BENCHMARKS.md "Level-adaptive expansion"),
+TPU_BFS_BENCH_KCAP (hybrid mode: residual ELL bucket cap; default 64, the
+measured flagship optimum — sweep knob),
 TPU_BFS_BENCH_XLA_CACHE (.bench_cache/xla_cache — persistent XLA compile
 cache across bench processes; empty disables).
 """
@@ -317,6 +319,38 @@ def _is_oom(exc: BaseException) -> bool:
     return is_oom_failure(exc)
 
 
+class _ShedRetry(Exception):
+    """Internal: raised inside a packed bench's run_once when the adaptive
+    configuration cannot be built and the plain re-bench should happen
+    (the reason is already logged)."""
+
+
+def _with_adaptive_shed(run_once, rebench_plain, adaptive, what: str):
+    """Run one packed bench attempt; on an OOM (or an explicit _ShedRetry)
+    with the push table resident, re-bench plain.
+
+    One shared copy of a subtle dance (bench_hybrid and bench_wide both
+    need it): the ENGINE BUILD and the batch both run inside ``run_once``,
+    so a RESOURCE_EXHAUSTED raised while transferring the push table — not
+    just one raised mid-batch — reaches the shed; and the plain re-bench
+    runs AFTER the except block, when the raised frames (which reference
+    the OOM'd engine's device tables) have been dropped, so the rebuild
+    doesn't have to fit next to the dying engine's allocations. Sizing
+    models can't see every XLA temp (the round-4 LJ run OOM'd at
+    16.22G/15.75G with the table resident); the shed costs ~10% measured,
+    an rc=1 loses the number entirely."""
+    try:
+        return run_once()
+    except _ShedRetry:
+        pass  # reason already logged at the raise site
+    except Exception as exc:  # noqa: BLE001 — OOM-shed fallback only
+        if adaptive is None or not _is_oom(exc):
+            raise
+        log(f"{what}+adaptive OOM ({str(exc)[:200]}); shedding the push "
+            f"table and re-benching plain")
+    return rebench_plain()
+
+
 def load_graph(scale: int, ef: int):
     """Seeded RMAT graph, cached as npz so repeated bench runs skip the
     ~1 min/2^20-vertex generation cost."""
@@ -586,25 +620,35 @@ def bench_hybrid(g, scale: int, ef: int, graph_desc: str | None = None,
     # re-tunes); results stay oracle-validated either way.
     adaptive = None if _shed_adaptive else _env_adaptive()
     kw = {} if adaptive is None else {"adaptive_push": adaptive}
-    try:
-        engine = retry_transient(HybridMsBfsEngine, g, max_lanes=max_lanes,
-                                 label="hybrid engine build", **kw)
-    except LanesDontFitError as exc:
-        if adaptive is not None:
-            # The push table is ~act*deg_cap*4 B of resident state; on
-            # graphs near the HBM edge (the LJ stand-in) it can push the
-            # hybrid under its 4096-lane minimum. Dropping the push pass
-            # costs ~10% (62.2 -> 56.0 measured); dropping the MXU path
-            # for the wide engine costs ~2x — so shed adaptive FIRST.
-            log(f"hybrid+adaptive doesn't fit ({exc}); retrying hybrid "
-                f"without the push table")
-            return bench_hybrid(g, scale, ef, graph_desc,
-                                _shed_adaptive=True)
-        log(f"hybrid unavailable ({exc}); falling back to wide engine")
-        return bench_wide(g, scale, ef, graph_desc)
-    hg = engine.hg
-    shed = False
-    try:
+    # TPU_BFS_BENCH_KCAP (hybrid only): residual ELL bucket cap sweep
+    # knob. 64 is the measured flagship optimum at 4096 lanes
+    # (BENCHMARKS.md); re-sweepable at other operating points.
+    kcap_raw = os.environ.get("TPU_BFS_BENCH_KCAP", "")
+    if kcap_raw:
+        try:
+            kw["kcap"] = max(1, int(kcap_raw))
+            log(f"kcap={kw['kcap']}")
+        except ValueError:
+            log(f"TPU_BFS_BENCH_KCAP={kcap_raw!r} not an int; default kcap")
+    def run_once():
+        try:
+            engine = retry_transient(HybridMsBfsEngine, g,
+                                     max_lanes=max_lanes,
+                                     label="hybrid engine build", **kw)
+        except LanesDontFitError as exc:
+            if adaptive is not None:
+                # The push table is ~act*deg_cap*4 B of resident state; on
+                # graphs near the HBM edge (the LJ stand-in) it can push
+                # the hybrid under its 4096-lane minimum. Dropping the
+                # push pass costs ~10% (62.2 -> 56.0 measured); dropping
+                # the MXU path for the wide engine costs ~2x — so shed
+                # adaptive FIRST.
+                log(f"hybrid+adaptive doesn't fit ({exc}); retrying "
+                    f"hybrid without the push table")
+                raise _ShedRetry from None
+            log(f"hybrid unavailable ({exc}); falling back to wide engine")
+            return bench_wide(g, scale, ef, graph_desc)
+        hg = engine.hg
         return _bench_batch_packed(
             g, graph_desc or f"RMAT scale-{scale} ef={ef}", engine,
             hg.in_degree,
@@ -613,20 +657,13 @@ def bench_hybrid(g, scale: int, ef: int, graph_desc: str | None = None,
             f"a_mem={hg.a_tiles.nbytes/2**30:.2f}GiB",
             "hybrid MXU+gather" + ("" if adaptive is None else "+adaptive-push"),
         )
-    except Exception as exc:  # noqa: BLE001 — OOM-shed fallback only
-        if adaptive is None or not _is_oom(exc):
-            raise
-        # Sizing models can't see every XLA temp; if the push-table
-        # configuration OOMs at compile/run time, shed it and re-bench
-        # plain (the round-4 LJ wide fallback died exactly here). The
-        # rebuild happens OUTSIDE this except block: the raised frames
-        # reference the OOM'd engine, and its device tables must be
-        # droppable before the plain engine allocates its own.
-        log(f"hybrid+adaptive OOM ({str(exc)[:200]}); re-benching plain")
-        shed = True
-    del engine, hg
-    assert shed
-    return bench_hybrid(g, scale, ef, graph_desc, _shed_adaptive=True)
+
+    return _with_adaptive_shed(
+        run_once,
+        lambda: bench_hybrid(g, scale, ef, graph_desc, _shed_adaptive=True),
+        adaptive,
+        "hybrid",
+    )
 
 
 def bench_wide(g, scale: int, ef: int, graph_desc: str | None = None,
@@ -642,11 +679,12 @@ def bench_wide(g, scale: int, ef: int, graph_desc: str | None = None,
     max_lanes = _env_max_lanes(default=WIDE_DEFAULT_MAX_LANES)
     adaptive = None if _shed_adaptive else _env_adaptive()
     kw = {} if adaptive is None else {"adaptive_push": adaptive}
-    engine = retry_transient(WidePackedMsBfsEngine, g, max_lanes=max_lanes,
-                             label="wide engine build", **kw)
-    ell = engine.ell
-    shed = False
-    try:
+
+    def run_once():
+        engine = retry_transient(WidePackedMsBfsEngine, g,
+                                 max_lanes=max_lanes,
+                                 label="wide engine build", **kw)
+        ell = engine.ell
         return _bench_batch_packed(
             g, graph_desc or f"RMAT scale-{scale} ef={ef}", engine,
             ell.in_degree,
@@ -654,18 +692,13 @@ def bench_wide(g, scale: int, ef: int, graph_desc: str | None = None,
             f"(x{ell.total_slots/max(g.num_edges,1):.2f}) heavy={ell.num_heavy}",
             "wide packed" + ("" if adaptive is None else "+adaptive-push"),
         )
-    except Exception as exc:  # noqa: BLE001 — OOM-shed fallback only
-        if adaptive is None or not _is_oom(exc):
-            raise
-        # Same push-table shed as bench_hybrid: the round-4 LJ run
-        # compile-OOM'd (16.22G of 15.75G hbm) with the table resident.
-        # Rebuild outside the except block so the OOM'd engine's device
-        # tables are droppable first.
-        log(f"wide+adaptive OOM ({str(exc)[:200]}); re-benching plain")
-        shed = True
-    del engine, ell
-    assert shed
-    return bench_wide(g, scale, ef, graph_desc, _shed_adaptive=True)
+
+    return _with_adaptive_shed(
+        run_once,
+        lambda: bench_wide(g, scale, ef, graph_desc, _shed_adaptive=True),
+        adaptive,
+        "wide",
+    )
 
 
 def bench_msbfs(g, scale: int, ef: int) -> dict:

@@ -82,8 +82,17 @@ def probe_gather(rows: int = 1_250_000, n_idx: int = 1_000_000,
         # and subtract, and amortize the remainder over more reps — else
         # every ns/index figure carries a ~flat +epilogue/reps bias.
         floor = _fence(warm)
+        del warm  # its [n_idx, w] buffer must not sit under the timed loop
+        # Bound the reps by in-flight memory, not a constant: every
+        # dispatched-but-unconsumed rep holds its [n_idx, w] u32 result on
+        # the device next to the table, and 10 queued 1 GB outputs wedged
+        # the first w=256 run on the 16 GB chip. Keep table + queued
+        # outputs within ~8 GB at every width (floor of 1 rep: noisier at
+        # w=512, but a wedge loses the number entirely).
+        out_bytes = n_idx * w * 4
+        table_bytes = rows * w * 4
+        reps = max(1, min(10, int((8e9 - table_bytes) // max(out_bytes, 1))))
         t0 = time.perf_counter()
-        reps = 10
         for _ in range(reps):
             out = chained(table, idx)
         _fence(out)  # waiting for rep N implies reps 1..N-1 (one stream)
@@ -95,7 +104,7 @@ def probe_gather(rows: int = 1_250_000, n_idx: int = 1_000_000,
             "ns_per_index": round(ns_per_index, 2),
             "fence_floor_s": round(floor, 4),
             "effective_GBps": round(n_idx * chain * w * 4 / dt / 1e9, 1),
-        }))
+        }), flush=True)  # land each width's line even if a later one wedges
         del table
 
 
@@ -159,13 +168,13 @@ def probe_tile_spmm(num_row_tiles: int = 256, tiles_per_row: int = 16,
             "tiles": nt, "us_per_tile": round(dt / nt * 1e6, 3),
             "checked_vs_reference_tiles": ns,
             "compiled_vs_interpret": not interpret,
-        }))
+        }), flush=True)
 
 
 if __name__ == "__main__":
     import jax
 
     print(json.dumps({"backend": jax.default_backend(),
-                      "devices": len(jax.devices())}))
+                      "devices": len(jax.devices())}), flush=True)
     probe_gather()
     probe_tile_spmm()

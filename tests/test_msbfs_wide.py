@@ -143,6 +143,25 @@ def test_wide_auto_lane_sizing(random_small):
     assert auto_lanes(10**9, 8, hbm_budget_bytes=1) == 32
 
 
+def test_auto_lanes_prices_tpu_tile_padding():
+    # The sizing model must bill every [rows, w] table at its PHYSICAL
+    # width: the TPU minor dim pads to 128-word tiles, so w=64 costs the
+    # same HBM as w=128 (the round-4 LJ OOM: u32[2.59M,64] allocated at
+    # 2.0x its logical bytes). Consequence: a budget that fits w=128
+    # exactly must NOT be credited with fitting 2x the rows at w=64.
+    from tpu_bfs.algorithms._packed_common import auto_lanes, tpu_padded_words
+
+    assert [tpu_padded_words(w) for w in (1, 16, 64, 128, 129, 256)] == [
+        128, 128, 128, 128, 256, 256,
+    ]
+    rows = 10_000
+    fits_128 = (5 + 6) * rows * 128 * 4  # exactly w=128's physical bytes
+    assert auto_lanes(rows, 5, hbm_budget_bytes=fits_128) == 4096
+    # Half the budget: w=64 pads right back to 128 physical words, so the
+    # walk must fall through to the floor instead of "fitting" at 2048.
+    assert auto_lanes(rows, 5, hbm_budget_bytes=fits_128 // 2) == 32
+
+
 def test_wide_rejects_bad_input(random_small):
     engine = WidePackedMsBfsEngine(random_small)
     with pytest.raises(ValueError):

@@ -37,6 +37,18 @@ def floor_lanes(lanes: int) -> int:
     return 32 << (w.bit_length() - 1)
 
 
+def tpu_padded_words(w: int) -> int:
+    """Physical minor-dim words XLA allocates for a [rows, w] 32-bit table
+    on TPU: the native tile is (8, 128), so the minor dimension pads up to
+    a multiple of 128. Measured, not theoretical: the round-4 LJ OOM
+    report shows u32[2591042,64] allocated at 1.24G — 2.0x its 632.58M
+    unpadded size ("Extra memory due to padding ... (2.0x expansion)").
+    Sizing that ignores this believes narrow rows save HBM they don't:
+    below 128 words (4096 lanes), narrowing the batch buys NOTHING on
+    TPU — only fewer planes or fewer rows shrink the state."""
+    return -(-w // 128) * 128
+
+
 def auto_lanes(
     rows: int,
     num_planes: int,
@@ -50,12 +62,20 @@ def auto_lanes(
     The level loop keeps ~(num_planes + 6) live [rows, w] uint32 tables
     (frontier, next, hit(s), visited, planes, expansion transients —
     calibrated against the scale-21 runs on a 16 GB v5e); ``fixed_bytes``
-    covers lane-independent residents (ELL indices, dense tiles). Returns the
-    largest power-of-two word count times 32 that fits, floored at 32 lanes.
+    covers lane-independent residents (ELL indices, dense tiles). Each
+    table is priced at its PHYSICAL width (:func:`tpu_padded_words`:
+    sub-128-word rows pad to 128 on TPU — the round-4 LJ run OOM'd
+    because the previous byte-exact model credited w=64 with a halving
+    it doesn't get). Returns the largest power-of-two word count times 32
+    that fits, floored at 32 lanes. Below 128 words the TPU need no
+    longer shrinks, so when w=128 doesn't fit the walk falls through to
+    the 32-lane floor: the small batch is still cheaper to RUN (and
+    genuinely smaller on CPU), but on TPU the caller's real levers are
+    fewer planes, sharding over a mesh, or shedding optional state.
     """
     w = floor_lanes(max_lanes) // 32
     while w > 1:
-        need = (num_planes + 6) * rows * w * 4 + fixed_bytes
+        need = (num_planes + 6) * rows * tpu_padded_words(w) * 4 + fixed_bytes
         if need <= hbm_budget_bytes:
             break
         w //= 2

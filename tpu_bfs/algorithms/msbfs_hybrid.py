@@ -61,6 +61,7 @@ from tpu_bfs.algorithms._packed_common import (
     run_packed_batch,
     seed_scatter_args,
     start_packed_batch,
+    tpu_padded_words,
 )
 from tpu_bfs.ops.tile_spmm import AW, TILE, tile_spmm
 
@@ -394,8 +395,13 @@ class HybridMsBfsEngine:
         ) + sum(b.idx.size for b in hg.res_light)
         fixed_bytes = hg.a_tiles.nbytes + int(res_slots * 4.4)
         if adaptive_push is not None:
-            # The push table is a lane-independent resident, like the ELL.
-            fixed_bytes += (hg.num_active + 1) * (adaptive_push[1] * 4 + 1)
+            # The push table is a lane-independent resident, like the ELL;
+            # its [act+1, deg_cap] int32 minor dim pads to 128 on TPU
+            # (tpu_padded_words — the round-4 LJ OOM billed it at 2.0x).
+            fixed_bytes += (
+                (hg.num_active + 1)
+                * (tpu_padded_words(adaptive_push[1]) * 4 + 1)
+            )
         if num_planes == "auto" and lanes == "auto":
             # Trade depth capacity (2**planes levels) for batch width: on a
             # graph one scale step too big for 5 planes at 4096 lanes, 4

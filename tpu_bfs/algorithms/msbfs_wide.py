@@ -61,6 +61,7 @@ from tpu_bfs.algorithms._packed_common import (
     run_packed_batch,
     seed_scatter_args,
     start_packed_batch,
+    tpu_padded_words,
 )
 
 W = 128  # uint32 words per row (narrower rows pay physical tile padding)
@@ -148,9 +149,12 @@ class WidePackedMsBfsEngine:
             # Halve from max_lanes until the packed state fits HBM next to
             # the ELL (and the push table, when the adaptive path is on —
             # its [act+1, deg_cap] int32 rows are lane-independent
-            # residents just like the ELL indices).
+            # residents just like the ELL indices). The push table's minor
+            # dim pads to 128 on TPU like every 2-D 32-bit table
+            # (tpu_padded_words; the round-4 LJ OOM report billed the
+            # s32[act, 64] table at 2.0x its logical bytes).
             push_bytes = (
-                (self._act + 1) * (adaptive_push[1] * 4 + 1)
+                (self._act + 1) * (tpu_padded_words(adaptive_push[1]) * 4 + 1)
                 if adaptive_push is not None
                 else 0
             )

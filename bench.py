@@ -21,8 +21,10 @@ line carries value=null and a machine-readable "error"),
 TPU_BFS_BENCH_ADAPTIVE (level-adaptive push for the hybrid/wide modes —
 default ON at the measured "8192,64"; "rows,deg" overrides, "0"/"off"
 disables; BENCHMARKS.md "Level-adaptive expansion"),
-TPU_BFS_BENCH_KCAP (hybrid mode: residual ELL bucket cap; default 64, the
-measured flagship optimum — sweep knob),
+TPU_BFS_BENCH_KCAP / TPU_BFS_BENCH_TILE_THR / TPU_BFS_BENCH_A_BUDGET
+(hybrid structure sweep knobs: residual ELL bucket cap, dense-tile edge
+threshold, dense-tile byte budget; defaults 64 / 64 / 0.2e9 — the
+measured flagship optima),
 TPU_BFS_BENCH_XLA_CACHE (.bench_cache/xla_cache — persistent XLA compile
 cache across bench processes; empty disables).
 """
@@ -590,6 +592,28 @@ def bench_hybrid(g, scale: int, ef: int, graph_desc: str | None = None,
     )
     from tpu_bfs.graph.ell import rank_vertices
 
+    # Hybrid structure sweep knobs, all defaulting to the measured
+    # flagship optima (BENCHMARKS.md): TPU_BFS_BENCH_KCAP (residual ELL
+    # bucket cap, 64), TPU_BFS_BENCH_TILE_THR (dense-tile edge threshold,
+    # 64), TPU_BFS_BENCH_A_BUDGET (dense-tile byte budget, 0.2e9). A
+    # malformed value degrades to the default, logged. Parsed BEFORE the
+    # wide-fallback pre-check so a lowered tile budget also lowers the
+    # pre-check's fixed-resident estimate (engine selection must see the
+    # same numbers the build will).
+    kw = {}
+    for env, ctor_kw, conv in (
+        ("TPU_BFS_BENCH_KCAP", "kcap", int),
+        ("TPU_BFS_BENCH_TILE_THR", "tile_thr", int),
+        ("TPU_BFS_BENCH_A_BUDGET", "a_budget_bytes", lambda v: int(float(v))),
+    ):
+        raw = os.environ.get(env, "")
+        if raw:
+            try:
+                kw[ctor_kw] = max(1, conv(raw))
+                log(f"{ctor_kw}={kw[ctor_kw]}")
+            except (ValueError, OverflowError):  # int(float('inf')) raises
+                log(f"{env}={raw!r} not a usable number; default {ctor_kw}")
+
     # Cheap pre-check with conservative fixed-resident estimates, so a graph
     # that clearly cannot fit 4096 lanes skips the minutes-long hybrid build.
     # Mirrors the engine's own sizing: tables cover only non-isolated rows,
@@ -601,7 +625,7 @@ def bench_hybrid(g, scale: int, ef: int, graph_desc: str | None = None,
     # mass on power-law graphs (53% measured at scale 21), and the engine's
     # own sizing counts only residual slots — an all-edges estimate here
     # wrongly forced the wide fallback on graphs that fit (the LJ stand-in).
-    fixed = int(0.2e9) + int(g.num_edges * 4.4 * 0.5)
+    fixed = kw.get("a_budget_bytes", int(0.2e9)) + int(g.num_edges * 4.4 * 0.5)
     planes = auto_planes(rows, fixed_bytes=fixed)
     est = auto_lanes(rows, planes, fixed_bytes=fixed)
     if est < LANES:
@@ -619,17 +643,9 @@ def bench_hybrid(g, scale: int, ef: int, graph_desc: str | None = None,
     # _env_adaptive; TPU_BFS_BENCH_ADAPTIVE=0 disables, "rows,deg"
     # re-tunes); results stay oracle-validated either way.
     adaptive = None if _shed_adaptive else _env_adaptive()
-    kw = {} if adaptive is None else {"adaptive_push": adaptive}
-    # TPU_BFS_BENCH_KCAP (hybrid only): residual ELL bucket cap sweep
-    # knob. 64 is the measured flagship optimum at 4096 lanes
-    # (BENCHMARKS.md); re-sweepable at other operating points.
-    kcap_raw = os.environ.get("TPU_BFS_BENCH_KCAP", "")
-    if kcap_raw:
-        try:
-            kw["kcap"] = max(1, int(kcap_raw))
-            log(f"kcap={kw['kcap']}")
-        except ValueError:
-            log(f"TPU_BFS_BENCH_KCAP={kcap_raw!r} not an int; default kcap")
+    if adaptive is not None:
+        kw["adaptive_push"] = adaptive
+
     def run_once():
         try:
             engine = retry_transient(HybridMsBfsEngine, g,
@@ -838,28 +854,12 @@ def _log_result(result: dict, mode: str) -> None:
 
 
 def _enable_compile_cache() -> None:
-    """Persistent XLA compilation cache (TPU_BFS_BENCH_XLA_CACHE, default
-    .bench_cache/xla_cache; empty disables). First compiles of the level
-    loop cost ~20-40 s on the chip and recur on every bench process —
-    during an outage-recovery session that is wall-clock the budget
-    envelope cannot spare. Best-effort: a jax without the knob (or a
-    backend that bypasses it) degrades to the status quo."""
-    path = os.environ.get(
-        "TPU_BFS_BENCH_XLA_CACHE",
-        os.path.join(
-            os.environ.get("TPU_BFS_BENCH_CACHE", ".bench_cache"), "xla_cache"
-        ),
-    )
-    if not path:
-        return
-    try:
-        os.makedirs(path, exist_ok=True)
-        import jax
+    """Persistent XLA compilation cache; shared resolution lives in
+    tpu_bfs/utils/compile_cache.py (also used by scripts/width_probe.py).
+    Lazy import, like the other tpu_bfs uses in this file."""
+    from tpu_bfs.utils.compile_cache import enable_compile_cache
 
-        jax.config.update("jax_compilation_cache_dir", path)
-        log(f"persistent compile cache: {path}")
-    except Exception as exc:  # noqa: BLE001 — cache is an optimization
-        log(f"compile cache unavailable ({exc!r}); continuing without")
+    enable_compile_cache(log=log)
 
 
 def main() -> int:

@@ -174,6 +174,15 @@ def probe_tile_spmm(num_row_tiles: int = 256, tiles_per_row: int = 16,
 if __name__ == "__main__":
     import jax
 
+    from tpu_bfs.utils.compile_cache import enable_compile_cache
+
+    # Same persistent compile cache as bench.py (shared helper): each
+    # probe attempt otherwise re-pays ~30-40 s of XLA compile per width —
+    # chip-window wall-clock an outage-recovery session cannot spare.
+    enable_compile_cache(
+        log=lambda m: print(f"# {m}", file=sys.stderr, flush=True)
+    )
+
     print(json.dumps({"backend": jax.default_backend(),
                       "devices": len(jax.devices())}), flush=True)
     probe_gather()

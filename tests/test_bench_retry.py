@@ -418,3 +418,44 @@ def test_hybrid_oom_sheds_adaptive_and_rebenches_plain(
     assert "oom" in calls
     assert result["value"] == 1.0
     assert "adaptive-push" not in result["metric"]
+
+
+def test_hybrid_lanes_dont_fit_sheds_adaptive_first(monkeypatch, toy_graph):
+    # The LJ scenario: WITH the push table resident the hybrid can't reach
+    # its 4096-lane minimum; the bench must retry the HYBRID without the
+    # table (~10% cost) before falling back to the wide engine (~2x cost).
+    from tpu_bfs.algorithms.msbfs_hybrid import LanesDontFitError
+
+    builds = []
+
+    class FakeHg:
+        num_tiles = 1
+        num_dense_edges = 1
+        in_degree = np.ones(toy_graph.num_vertices)
+
+        class a_tiles:
+            nbytes = 0
+
+    class FakeEngine:
+        hg = FakeHg()
+        lanes = 4096
+
+        def __init__(self, g, **kw):
+            builds.append(kw)
+            if "adaptive_push" in kw:
+                raise LanesDontFitError("push table pushes under 4096")
+
+    def fake_batch(g, desc, engine, in_degree, build_log, label):
+        return {"metric": label, "value": 2.0, "unit": "GTEPS",
+                "vs_baseline": 0.2}
+
+    monkeypatch.delenv("TPU_BFS_BENCH_ADAPTIVE", raising=False)
+    import tpu_bfs.algorithms.msbfs_hybrid as mh
+
+    monkeypatch.setattr(mh, "HybridMsBfsEngine", FakeEngine)
+    monkeypatch.setattr(bench, "_bench_batch_packed", fake_batch)
+    result = bench.bench_hybrid(toy_graph, 10, 16)
+    assert len(builds) == 2  # adaptive build failed, plain build landed
+    assert "adaptive_push" in builds[0] and "adaptive_push" not in builds[1]
+    assert result["value"] == 2.0
+    assert "adaptive-push" not in result["metric"]

@@ -25,6 +25,16 @@ def test_sliced_hybrid_model_matches_hlo(rmat_small):
     assert rep["ring_steps"] == 7, rep
 
 
+def test_sliced_hybrid_model_matches_hlo_w256(rmat_small):
+    # Width-generic calibration: the wire model must match the compiled
+    # collectives at 256-word rows too (8192 lanes — the round-4
+    # single-chip default width; distributed stays 4096 by default, so
+    # this is the opt-in wider-row config).
+    rep = check_sliced_hybrid(rmat_small, p=8, lanes=8192)
+    assert rep["agree"], rep
+    assert "w=256" in rep["config"], rep
+
+
 def test_shape_parsing():
     from tpu_bfs.utils.wirecheck import Collective, hlo_collectives
 

@@ -1,0 +1,42 @@
+"""Persistent XLA compilation cache, shared by bench.py and the
+measurement scripts (scripts/width_probe.py).
+
+First compiles of the packed level loop cost ~20-40 s on the chip and
+recur in every fresh process; during an outage-recovery session that is
+wall-clock the bench's budget envelope cannot spare. One copy of the
+env-var resolution so the two callers cannot drift into writing separate
+caches (TPU_BFS_BENCH_XLA_CACHE, default <TPU_BFS_BENCH_CACHE>/xla_cache;
+empty disables).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_compile_cache(log=None) -> str | None:
+    """Point jax at the persistent compile cache; best-effort.
+
+    Returns the cache path when enabled, None when disabled or
+    unavailable (a jax without the knob degrades to the status quo).
+    """
+    path = os.environ.get(
+        "TPU_BFS_BENCH_XLA_CACHE",
+        os.path.join(
+            os.environ.get("TPU_BFS_BENCH_CACHE", ".bench_cache"), "xla_cache"
+        ),
+    )
+    if not path:
+        return None
+    try:
+        os.makedirs(path, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", path)
+        if log:
+            log(f"persistent compile cache: {path}")
+        return path
+    except Exception as exc:  # noqa: BLE001 — the cache is an optimization
+        if log:
+            log(f"compile cache unavailable ({exc!r}); continuing without")
+        return None

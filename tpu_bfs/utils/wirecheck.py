@@ -134,16 +134,19 @@ def check_1d_sparse(graph, p: int = 8) -> dict:
     }
 
 
-def check_sliced_hybrid(graph, p: int = 8) -> dict:
+def check_sliced_hybrid(graph, p: int = 8, lanes: int | None = None) -> dict:
     """Ring-sliced distributed hybrid: the modeled dense-slab bytes
     ((P-1) x [rows_loc, w] u32 per level) vs the compiled rotation's
-    permute operand and the engine's own static ring-step count."""
+    permute operand and the engine's own static ring-step count.
+    ``lanes`` widens the rows (the model is width-generic; the w=256 arm
+    calibrates it at the round-4 single-chip default width)."""
     import jax.numpy as jnp
 
     from tpu_bfs.parallel.dist_bfs import make_mesh
     from tpu_bfs.parallel.dist_msbfs_hybrid import DistHybridMsBfsEngine
 
-    eng = DistHybridMsBfsEngine(graph, make_mesh(p), exchange="sliced")
+    kw = {} if lanes is None else {"lanes": lanes}
+    eng = DistHybridMsBfsEngine(graph, make_mesh(p), exchange="sliced", **kw)
     rows_loc = eng._gather_rows_loc
     fw0 = eng._seed_dev(np.asarray([0]))
     hlo = (

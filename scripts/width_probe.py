@@ -1,14 +1,16 @@
 """Row-width microbenchmark: is the packed-row gather still latency-bound
 past 128 words?
 
-The packed engines' flagship width (4096 lanes = 128 uint32 words/row) came
-from a v5e measurement: a chained random row-gather + OR costs ~13 ns/index
-at 64- AND 128-word rows (flat — latency-bound), but more at narrower rows
-(tile padding). This probe extends that sweep upward (w in 64..512) to
-answer the one question the width generalization (msbfs_wide/msbfs_hybrid
-``max_lanes``) leaves open: if ~flat through 256 words, doubling the batch
-to 8192 lanes nearly doubles aggregate TEPS for the same index count; if
-the cost doubles (bandwidth-bound), the wider rows are a wash.
+The chained random row-gather + OR (the packed engines' level-loop inner
+op) is latency-dominated: the round-4 floor-corrected sweep on v5e
+measured 8.41 / 8.24 ns per index at 64- / 128-word rows (flat), and the
+earlier biased sweep's 256/512-word points (19.7 / 26.8 ns, carrying a
+~+4 ns fence-epilogue bias at reps=3) still showed widening past 128
+words costs far less than the lane doubling buys. That slope is why the
+engines default to 8192 lanes (w=256) — the end-to-end ground truth is
+55.96 vs 45.68 GTEPS on the scale-21 flagship. This probe re-measures
+the whole sweep (w in 64..512) with the fence-corrected, floor-subtracted
+protocol.
 
 Also times the tile_spmm Pallas kernel per-tile at each legal width
 (w % 128 == 0), checks a small prefix against the NumPy reference, and —
@@ -17,7 +19,8 @@ compiled-vs-interpret (the bench's Mosaic-divergence guard, at each
 probed width).
 
 Usage (real chip): python scripts/width_probe.py
-Prints one JSON line per (op, w). Safe to re-run; ~1 min total.
+Prints one JSON line per (op, w); ~5-10 min cold, less with the shared
+compile cache warm.
 """
 
 from __future__ import annotations

@@ -138,7 +138,7 @@ def _rmat_edges_m(
 
         uv = rmat_edges_native(scale, m, seed, a, b, c)
         if uv is None and impl == "native":
-            raise RuntimeError("native library not built (make -C native)")
+            raise RuntimeError("native library not built (make -C tpu_bfs/native)")
     if uv is None:
         u = np.zeros(m, dtype=np.int64)
         v = np.zeros(m, dtype=np.int64)

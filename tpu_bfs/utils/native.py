@@ -1,8 +1,9 @@
 """Native (C++) fast paths, loaded via ctypes.
 
 The reference's loader and CSR build are C++ (readGraphFromFile,
-bfs.cu:829-880); the equivalents here live in ``native/`` at the repo root and
-are compiled to ``libtpubfs.so``. Everything degrades gracefully to the NumPy
+bfs.cu:829-880); the equivalents here live in ``tpu_bfs/native/`` (inside
+the package, so wheels ship the sources namespaced) and are compiled to
+``libtpubfs.so``. Everything degrades gracefully to the NumPy
 implementations when the shared library has not been built.
 """
 
@@ -18,9 +19,10 @@ _TRIED = False
 
 
 def _native_dir() -> str:
-    """``native/`` at the repo root (three levels up from this file)."""
+    """``tpu_bfs/native/`` — a sibling of this file's parent package, so
+    the lookup survives both a checkout and an installed wheel."""
     return os.path.join(
-        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "native",
     )
 
@@ -77,7 +79,7 @@ def _find_lib():
 
 
 def ensure_built(log=None) -> None:
-    """Best-effort ``make -C native`` so a fresh (or stale) checkout gets the
+    """Best-effort ``make -C tpu_bfs/native`` so a fresh (or stale) checkout gets the
     fast paths. make itself is the up-to-date check (~ms when current).
 
     Must run before the first library lookup in the process: the ctypes

@@ -1,0 +1,23 @@
+# Convenience targets; everything also works without make (README).
+.PHONY: test native bench wheel clean
+
+# Full suite on 8 virtual CPU devices (tests/conftest.py forces the
+# platform; the axon TPU plugin is bypassed).
+test:
+	python -m pytest tests/ -x -q
+
+# Optional C++ fast paths (loader + RMAT generator); NumPy fallbacks
+# otherwise. Also built on demand by tpu_bfs/utils/native.py.
+native:
+	$(MAKE) -C tpu_bfs/native
+
+# One-line JSON benchmark on the attached accelerator (env knobs in
+# bench.py's docstring; outage envelope guarantees the line lands).
+bench:
+	python bench.py
+
+wheel:
+	python -m pip wheel . --no-deps --no-build-isolation -w dist
+
+clean:
+	rm -rf build dist *.egg-info tpu_bfs/native/build

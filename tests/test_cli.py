@@ -182,3 +182,26 @@ def test_cli_resume_derives_width_from_checkpoint(capsys, tmp_path):
             ["0", "random:n=200,m=900,seed=3", "--multi-source", "7",
              "--engine", "wide", "--resume", str(ck), "--lanes", "96"]
         )
+
+
+def test_console_entry_points_resolve():
+    # pyproject's [project.scripts] must keep pointing at callables that
+    # accept argv=None (the console-script calling convention) — a rename
+    # in cli/graph500 would otherwise ship a broken `tpu-bfs` binary.
+    import importlib
+    import inspect
+    import os
+    import tomllib
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "pyproject.toml"), "rb") as f:
+        scripts = tomllib.load(f)["project"]["scripts"]
+    assert set(scripts) == {"tpu-bfs", "tpu-bfs-graph500"}
+    for target in scripts.values():
+        mod, fn = target.split(":")
+        func = getattr(importlib.import_module(mod), fn)
+        sig = inspect.signature(func)
+        assert all(
+            p.default is not inspect.Parameter.empty
+            for p in sig.parameters.values()
+        ), target  # callable with zero args

@@ -20,6 +20,8 @@
 #   width-4096-plain    + TPU_BFS_BENCH_MAX_LANES=4096 — the width A/B arm
 #                         (also the round-1..3 historical series config)
 #   lj-hybrid           defaults on the LiveJournal-shaped stand-in
+#   kcap-32/kcap-128    residual ELL bucket-cap sweep     (TPU_BFS_BENCH_KCAP)
+#   thr32-b08/thr128    dense-tile threshold/budget sweep (TILE_THR/A_BUDGET)
 # (The former adaptive_stage.sh follow-on is folded in as the
 # flagship-noadaptive arm: the round-4 keep-or-kill measured 62.21 GTEPS
 # adaptive vs 55.96 plain and adaptive became the default.)
@@ -56,6 +58,13 @@ for i in $(seq 1 "$attempts"); do
     stage "width-4096-plain" "$out/flagship_4k_plain.json" \
       TPU_BFS_BENCH_ADAPTIVE=0 TPU_BFS_BENCH_MAX_LANES=4096
     stage "lj-hybrid" "$out/lj_hybrid.json" TPU_BFS_BENCH_MODE=lj-hybrid
+    # Structure sweep at the flagship operating point (the round-4 chip
+    # outage interrupted these; each is skippable by deleting its arm):
+    stage "kcap-32" "$out/kcap32.json" TPU_BFS_BENCH_KCAP=32
+    stage "kcap-128" "$out/kcap128.json" TPU_BFS_BENCH_KCAP=128
+    stage "thr32-b08" "$out/thr32_b08.json" \
+      TPU_BFS_BENCH_TILE_THR=32 TPU_BFS_BENCH_A_BUDGET=8e8
+    stage "thr128" "$out/thr128.json" TPU_BFS_BENCH_TILE_THR=128
     exit 0
   fi
   [ "$i" -lt "$attempts" ] && sleep "${CHIP_SESSION_SLEEP:-300}"

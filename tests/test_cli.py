@@ -191,8 +191,10 @@ def test_console_entry_points_resolve():
     import importlib
     import inspect
     import os
-    import tomllib
 
+    tomllib = pytest.importorskip(
+        "tomllib", reason="stdlib tomllib needs Python 3.11+"
+    )
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     with open(os.path.join(root, "pyproject.toml"), "rb") as f:
         scripts = tomllib.load(f)["project"]["scripts"]

@@ -69,8 +69,9 @@ def _make_ms_engine(args, g, n_sources: int):
 
     Default (no --engine): size to the workload — the 512-lane packed engine
     for small batches (lane tables scale with lane count; 254-level depth
-    cap), the 4096-lane hybrid flagship once the batch is big enough to fill
-    its 128-word rows. With --devices N the sharded-state distributed
+    cap), the hybrid flagship (8192-lane default cap since the round-4
+    hardware sweep; auto sizing walks down when the state doesn't fit) once
+    the batch is big enough to fill its packed rows. With --devices N the sharded-state distributed
     engines run instead (hybrid flagship by default, '--engine wide' for
     gather-only) — the reference reaches every capability from its one
     binary (README.md:13,22); so does this one.
@@ -78,8 +79,9 @@ def _make_ms_engine(args, g, n_sources: int):
     engine = args.engine
     planes = args.planes if args.planes is not None else 5
     # --lanes: explicit batch width (w = lanes/32 packed words per row).
-    # None -> each engine's own default/auto sizing; widths past 4096 are
-    # the opt-in wider rows (msbfs_wide/msbfs_hybrid MAX_LANES). Validated
+    # None -> each engine's own default/auto sizing (single-chip cap 8192
+    # since round 4; distributed default 4096 — the scale-26 budget's row
+    # width; msbfs_wide/msbfs_hybrid MAX_LANES bounds both). Validated
     # here so flag misuse gets the CLI's clean SystemExit, not an engine
     # traceback (engines apply their own stricter constraints on top, e.g.
     # whole 4096-lane steps for the dense kernel on TPU).
@@ -348,9 +350,10 @@ def main(argv=None) -> int:
                     "state over the mesh (DistHybrid/DistWide engines)")
     ap.add_argument("--engine", default=None,
                     choices=["hybrid", "wide", "packed"],
-                    help="--multi-source engine: 'hybrid' = 4096-lane MXU "
-                    "dense tiles + gathers (flagship), 'wide' = 4096-lane "
-                    "gather-only, 'packed' = 512-lane (254-level depth cap; "
+                    help="--multi-source engine: 'hybrid' = MXU dense "
+                    "tiles + gathers (flagship; 8192-lane default cap), "
+                    "'wide' = gather-only (same widths), 'packed' = "
+                    "512-lane (254-level depth cap; "
                     "single-device). Default: 'packed' for <=512 sources, "
                     "else 'hybrid'; with --devices N always the sharded "
                     "hybrid unless 'wide' is chosen")
@@ -360,10 +363,10 @@ def main(argv=None) -> int:
                     "traversal depth at 2**P levels (default 5)")
     ap.add_argument("--lanes", type=int, default=None, metavar="N",
                     help="packed batch width for --multi-source engines "
-                    "(default: engine auto sizing, 4096 max; larger "
-                    "multiples of 4096 opt into wider rows — more "
-                    "concurrent sources per batch at proportionally more "
-                    "HBM)")
+                    "(default: engine auto sizing — single-chip cap 8192, "
+                    "distributed 4096; wider rows trade proportionally "
+                    "more HBM for more concurrent sources. NB on TPU, "
+                    "widths below 4096 pad to the same physical tables)")
     ap.add_argument("--adaptive-push", default=None, metavar="ROWS,DEG",
                     help="experimental level-adaptive expansion for "
                     "--engine wide|hybrid (single device): levels with "

@@ -16,7 +16,11 @@ def main(path: str) -> int:
     try:
         with open(path) as f:
             lines = [l for l in f if l.strip().startswith("{")]
-        return 0 if lines and json.loads(lines[-1])["value"] is not None else 1
+        entry = json.loads(lines[-1]) if lines else {}
+        # A stale echo (round 5: the envelope replays the last durable-log
+        # number when a run is lost) is NOT a landed measurement — stages
+        # must keep retrying until a fresh value lands.
+        return 0 if entry.get("value") is not None and not entry.get("stale") else 1
     except Exception:  # noqa: BLE001 — any unreadable file is "no value"
         return 1
 

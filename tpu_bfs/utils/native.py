@@ -88,12 +88,24 @@ def ensure_built(log=None) -> None:
     diagnostic when the build fails; callers then fall back to NumPy paths
     via ``available()``/``has_rmat()``.
     """
+    import signal
     import subprocess
+
+    def _unblock_signals() -> None:
+        # bench.py's signal envelope blocks SIGTERM/SIGINT process-wide
+        # (sigwait watcher), and the mask is inherited across fork+exec —
+        # without this, a driver's group-kill could leave make (and its
+        # compiler children) unkillable and lingering past the parent.
+        # pthread_sigmask is async-signal-safe, so it is preexec-legal.
+        signal.pthread_sigmask(
+            signal.SIG_UNBLOCK, (signal.SIGTERM, signal.SIGINT)
+        )
 
     try:
         proc = subprocess.run(
             ["make", "-C", _native_dir()],
             capture_output=True, timeout=120, check=False, text=True,
+            preexec_fn=_unblock_signals,
         )
         if proc.returncode != 0 and log is not None:
             log(

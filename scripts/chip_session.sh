@@ -39,8 +39,28 @@ got_value() {  # true iff $1 ends with a JSON line carrying a non-null value
 
 stage() {  # stage <name> <json-out> [ENV=VAL...] — one bench.py run
   local name="$1" json="$2"; shift 2
+  if [ -s "$json" ] && got_value "$json"; then
+    echo "$name already landed: $(tail -1 "$json")"   # idempotent restart
+    return 0
+  fi
   echo "=== $name $(date -u +%H:%M:%S) ==="
   if env "$@" python bench.py >"$json" 2>"${json%.json}.log" \
+      && got_value "$json"; then
+    echo "$name OK: $(tail -1 "$json")"
+    return 0
+  fi
+  echo "$name FAILED (see ${json%.json}.log): $(tail -1 "$json" 2>/dev/null)"
+  return 1
+}
+
+pstage() {  # pstage <name> <json-out> <script> [ENV=VAL...] — one helper-script run
+  local name="$1" json="$2" script="$3"; shift 3
+  if [ -s "$json" ] && got_value "$json"; then
+    echo "$name already landed: $(tail -1 "$json")"
+    return 0
+  fi
+  echo "=== $name $(date -u +%H:%M:%S) ==="
+  if env "$@" python "$script" >"$json" 2>"${json%.json}.log" \
       && got_value "$json"; then
     echo "$name OK: $(tail -1 "$json")"
     return 0
@@ -52,10 +72,14 @@ stage() {  # stage <name> <json-out> [ENV=VAL...] — one bench.py run
 for i in $(seq 1 "$attempts"); do
   echo "=== attempt $i $(date -u +%H:%M:%S) ==="
   if stage "flagship" "$out/flagship.json"; then
-    echo "=== width probe ==="
-    python scripts/width_probe.py >"$out/width_probe.jsonl" 2>"$out/width_probe.log" \
-      && echo "width probe OK" || echo "width probe FAILED (see $out/width_probe.log)"
-    cat "$out/width_probe.jsonl" 2>/dev/null
+    if got_value "$out/width_probe.jsonl"; then   # completion marker line
+      echo "width probe already landed"   # idempotent restart
+    else
+      echo "=== width probe ==="
+      python scripts/width_probe.py >"$out/width_probe.jsonl" 2>"$out/width_probe.log" \
+        && echo "width probe OK" || echo "width probe FAILED (see $out/width_probe.log)"
+      cat "$out/width_probe.jsonl" 2>/dev/null
+    fi
     stage "flagship-noadaptive" "$out/flagship_noadaptive.json" \
       TPU_BFS_BENCH_ADAPTIVE=0
     stage "width-4096-plain" "$out/flagship_4k_plain.json" \
@@ -68,6 +92,20 @@ for i in $(seq 1 "$attempts"); do
     stage "thr32-b08" "$out/thr32_b08.json" \
       TPU_BFS_BENCH_TILE_THR=32 TPU_BFS_BENCH_A_BUDGET=8e8
     stage "thr128" "$out/thr128.json" TPU_BFS_BENCH_TILE_THR=128
+    # Round-5 stages (VERDICT r4 #3/#4/#5/#7 + weak #6), in verdict order:
+    # roofline attribution of the flagship, device parent scan at flagship
+    # scale, the 16384-lane arm at scale 20 (plain, matching the width
+    # series' historical config), a quiet-chip tiled single-stream run,
+    # and the scale-22 auto-walk OOM-edge rehearsal with push on.
+    pstage "roofline" "$out/roofline.json" scripts/roofline.py
+    pstage "parent-scan" "$out/parent_scan.json" scripts/parent_scan_bench.py
+    stage "lanes16k-s20" "$out/lanes16k_s20.json" \
+      TPU_BFS_BENCH_SCALE=20 TPU_BFS_BENCH_MAX_LANES=16384 \
+      TPU_BFS_BENCH_ADAPTIVE=0
+    stage "tiled-single" "$out/tiled_single.json" \
+      TPU_BFS_BENCH_MODE=single-tiled
+    stage "scale22-auto" "$out/scale22.json" TPU_BFS_BENCH_SCALE=22 \
+      TPU_BFS_BENCH_BUDGET_S=2400
     exit 0
   fi
   [ "$i" -lt "$attempts" ] && sleep "${CHIP_SESSION_SLEEP:-300}"

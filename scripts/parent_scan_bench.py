@@ -1,0 +1,118 @@
+"""Measure the device parent scan at FLAGSHIP scale on the chip.
+
+VERDICT r4 #4: the 41x device-vs-host parent-extraction speedup was a
+scale-16/512-lane CPU number; the flagship ``--save-parent`` path (8192
+lanes, RMAT scale-21) was only a projection. This script runs it for real:
+build the flagship engine, run the batch, then time
+``res.parents_into(out, device='device')`` — forced device, so an OOM
+fails loudly here instead of silently degrading to the ~hour host path
+(the bench host has 125 GB RAM; the [8192, 2^21] int32 output is ~69 GB
+and is allocated up front so the allocation itself is part of the
+verdict).
+
+Prints one JSON line: total seconds, per-128-lane-pass seconds, validated
+lane count. Validation: sampled lanes' trees checked with
+validate.check_parents against the lane's distances (the parent-property
+check the reference never runs on its parent output, bfs.cu:940).
+
+Env: TPU_BFS_BENCH_SCALE/EF/MAX_LANES/ADAPTIVE as in bench.py;
+PARENT_BENCH_LANES overrides the batch width (e.g. a 1024-lane dress
+rehearsal = ~8.6 GB output).
+
+Usage (real chip): python scripts/parent_scan_bench.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def log(msg: str) -> None:
+    print(f"# {msg}", file=sys.stderr, flush=True)
+
+
+def main() -> int:
+    import bench
+    from tpu_bfs import validate
+    from tpu_bfs.algorithms.msbfs_hybrid import (
+        DEFAULT_MAX_LANES,
+        HybridMsBfsEngine,
+    )
+    from tpu_bfs.algorithms.msbfs_packed import UNREACHED
+    from tpu_bfs.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache(log=log)
+    scale = int(os.environ.get("TPU_BFS_BENCH_SCALE", "21"))
+    ef = int(os.environ.get("TPU_BFS_BENCH_EF", "16"))
+    g = bench.load_graph(scale, ef)
+    adaptive = bench._env_adaptive()
+    kw = {} if adaptive is None else {"adaptive_push": adaptive}
+    max_lanes = bench._env_max_lanes(default=DEFAULT_MAX_LANES)
+    t0 = time.perf_counter()
+    engine = bench.retry_transient(
+        HybridMsBfsEngine, g, max_lanes=max_lanes,
+        label="parent bench engine build", **kw,
+    )
+    lanes = int(os.environ.get("PARENT_BENCH_LANES", str(engine.lanes)))
+    lanes = min(lanes, engine.lanes)
+    log(f"engine build {time.perf_counter()-t0:.1f}s: engine.lanes="
+        f"{engine.lanes}, batch lanes={lanes}")
+
+    hub = int(np.argmax(engine.hg.in_degree))
+    pilot = bench.retry_transient(engine.run, np.array([hub]),
+                                  label="parent bench pilot")
+    traversable = np.flatnonzero(pilot.distance_u8_lane(0) != UNREACHED)
+    del pilot
+    rng = np.random.default_rng(7)
+    sources = rng.choice(traversable, size=lanes,
+                         replace=len(traversable) < lanes)
+    res = bench.retry_transient(engine.run, sources,
+                                label="parent bench batch")
+
+    gib = lanes * g.num_vertices * 4 / 2**30
+    log(f"allocating [{lanes}, {g.num_vertices}] int32 output ({gib:.1f} GiB)")
+    out = np.empty((lanes, g.num_vertices), np.int32)
+    t0 = time.perf_counter()
+    bench.retry_transient(res.parents_into, out, device="device",
+                          label="device parent scan")
+    elapsed = time.perf_counter() - t0
+    passes = -(-lanes // 128)  # scanner processes 128-lane column groups
+    log(f"device scan: {elapsed:.1f}s total, {elapsed/passes:.2f}s per "
+        f"128-lane pass ({passes} passes)")
+
+    t0 = time.perf_counter()
+    nv = int(os.environ.get("TPU_BFS_BENCH_VALIDATE_LANES", "4"))
+    picks = sorted(
+        {0, lanes // 2, lanes - 1}
+        | {int(x) for x in np.linspace(0, lanes - 1, nv).round()}
+    )
+    for i in picks:
+        validate.check_parents(
+            g, int(sources[i]), res.distances_int32(i), out[i]
+        )
+    log(f"validated {len(picks)} lanes' trees in {time.perf_counter()-t0:.1f}s")
+
+    print(json.dumps({
+        "metric": (
+            f"device parent scan seconds ({lanes}-lane hybrid batch, "
+            f"RMAT scale-{scale} ef={ef}, forced device='device'), 1 chip"
+        ),
+        "value": round(elapsed, 2),
+        "unit": "s",
+        "per_pass_s": round(elapsed / passes, 3),
+        "passes": passes,
+        "out_gib": round(gib, 2),
+        "validated_lanes": len(picks),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

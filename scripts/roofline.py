@@ -1,0 +1,113 @@
+"""Roofline attribution of the flagship bench configuration on the chip.
+
+Builds the same engine bench.py's flagship mode builds (RMAT scale-21,
+8192 lanes, adaptive push at the measured caps), times one real batch for
+the anchor GTEPS, then attributes a traversal level by level
+(tpu_bfs/utils/roofline.py) and prints the JSON report — one line per
+level plus one summary line (the chip_session stage captures stdout).
+
+Also verifies the stepping loop did not perturb the traversal: its level
+count must equal the plain run's.
+
+Env: TPU_BFS_BENCH_SCALE/EF/MAX_LANES/ADAPTIVE as in bench.py;
+ROOFLINE_PROFILE_DIR (optional) additionally captures a jax.profiler trace
+of one fused batch for offline inspection.
+
+Usage (real chip): python scripts/roofline.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def log(msg: str) -> None:
+    print(f"# {msg}", file=sys.stderr, flush=True)
+
+
+def main() -> int:
+    import bench
+    from tpu_bfs.algorithms.msbfs_hybrid import (
+        DEFAULT_MAX_LANES,
+        HybridMsBfsEngine,
+    )
+    from tpu_bfs.algorithms.msbfs_packed import UNREACHED
+    from tpu_bfs.utils.compile_cache import enable_compile_cache
+    from tpu_bfs.utils.roofline import roofline_hybrid
+
+    enable_compile_cache(log=log)
+    scale = int(os.environ.get("TPU_BFS_BENCH_SCALE", "21"))
+    ef = int(os.environ.get("TPU_BFS_BENCH_EF", "16"))
+    g = bench.load_graph(scale, ef)
+    adaptive = bench._env_adaptive()
+    max_lanes = bench._env_max_lanes(default=DEFAULT_MAX_LANES)
+    t0 = time.perf_counter()
+    kw = {} if adaptive is None else {"adaptive_push": adaptive}
+    engine = bench.retry_transient(
+        HybridMsBfsEngine, g, max_lanes=max_lanes,
+        label="roofline engine build", **kw,
+    )
+    log(f"engine build {time.perf_counter()-t0:.1f}s: lanes={engine.lanes} "
+        f"planes={engine.num_planes} tiles={engine.hg.num_tiles}")
+
+    # Same source protocol as the bench: hub pilot, then keys from its
+    # traversable component.
+    hub = int(np.argmax(engine.hg.in_degree))
+    pilot = bench.retry_transient(engine.run, np.array([hub]),
+                                  label="roofline pilot")
+    traversable = np.flatnonzero(pilot.distance_u8_lane(0) != UNREACHED)
+    del pilot
+    rng = np.random.default_rng(7)
+    sources = rng.choice(traversable, size=engine.lanes,
+                         replace=len(traversable) < engine.lanes)
+
+    res = bench.retry_transient(engine.run, sources, time_it=True,
+                                label="roofline anchor batch")
+    gteps = res.teps / 1e9
+    anchor_levels = res.num_levels
+    log(f"anchor batch: {res.elapsed_s*1e3:.1f}ms, levels={anchor_levels}, "
+        f"hmean GTEPS={gteps:.3f}")
+
+    prof_dir = os.environ.get("ROOFLINE_PROFILE_DIR", "")
+    if prof_dir:
+        import jax
+
+        with jax.profiler.trace(prof_dir):
+            engine.run(sources)
+        log(f"profiler trace written to {prof_dir}")
+    del res
+
+    report = bench.retry_transient(
+        roofline_hybrid, engine, sources, measured_gteps=gteps, log=log,
+        label="roofline attribution",
+    )
+    # Stepping must reproduce the traversal: body count == anchor's count
+    # + 1 (the anchor's num_levels drops the final empty-frontier body).
+    ok = report["num_levels"] in (anchor_levels, anchor_levels + 1)
+    report["anchor_levels"] = anchor_levels
+    report["stepping_matches_run"] = ok
+    for la in report["levels"]:
+        print(json.dumps({"roofline_level": la}), flush=True)
+    summary = {k: v for k, v in report.items() if k != "levels"}
+    # chip_session's got_value gate keys on a non-null "value" in the LAST
+    # line; an attribution whose own guard failed must not count as landed
+    # (the stage should re-run on session restart).
+    summary["value"] = round(report["t_full_sum_s"], 4) if ok else None
+    summary["unit"] = "s (fused level-step sum)"
+    print(json.dumps(summary), flush=True)
+    if not ok:
+        log(f"LEVEL MISMATCH: stepping ran {report['num_levels']} bodies, "
+            f"anchor reported {anchor_levels}")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -190,3 +190,7 @@ if __name__ == "__main__":
                       "devices": len(jax.devices())}), flush=True)
     probe_gather()
     probe_tile_spmm()
+    # Completion marker as the LAST line: chip_session's idempotent
+    # restart gate (scripts/has_value.py) must distinguish a finished
+    # sweep from a partial one killed mid-probe.
+    print(json.dumps({"width_probe_complete": True, "value": 1}), flush=True)

@@ -204,9 +204,8 @@ def test_signal_after_printed_verdict_preserves_it(tmp_path, monkeypatch,
     """A signal landing after main() printed its real verdict (e.g. during
     the _log_result append) must exit with THAT outcome — never append a
     stale echo as the new last line, which would un-land the measurement
-    for scripts/has_value.py."""
-    assert bench._FINAL_RC is None
-
+    for scripts/has_value.py. (main() resets the flag on entry, so no
+    assumption is made about leftovers from earlier in-process runs.)"""
     monkeypatch.setenv("TPU_BFS_BENCH_RESULT_LOG",
                        str(_seed_log(tmp_path / "r.jsonl")))
     monkeypatch.setenv("TPU_BFS_BENCH_MODE", "single")

@@ -304,9 +304,11 @@ def build_hybrid(
     )
 
 
-def _make_core(hg: HybridGraph, w: int, num_planes: int, interpret: bool,
-               push_cfg=None):
-    spec = ExpandSpec(
+def expand_spec(hg: HybridGraph) -> ExpandSpec:
+    """Residual-ELL expansion spec of a hybrid graph (shared between the
+    engine core and the roofline phase slices, utils/roofline.py — one
+    definition so attribution measures exactly what the loop runs)."""
+    return ExpandSpec(
         kcap=hg.kcap,
         heavy=hg.res_heavy > 0,
         num_virtual=hg.res_num_virtual,
@@ -314,7 +316,11 @@ def _make_core(hg: HybridGraph, w: int, num_planes: int, interpret: bool,
         light_meta=tuple((b.k, b.n) for b in hg.res_light),
         tail_rows=hg.res_tail_rows,
     )
-    expand_residual = make_fori_expand(spec, w)
+
+
+def _make_core(hg: HybridGraph, w: int, num_planes: int, interpret: bool,
+               push_cfg=None):
+    expand_residual = make_fori_expand(expand_spec(hg), w)
     has_dense = hg.num_tiles > 0
 
     def hit_of(arrs, fw):
@@ -465,6 +471,8 @@ class HybridMsBfsEngine:
             )
         self.w = lanes // 32
         self.lanes = lanes
+        self.interpret = interpret
+        self.adaptive_push = adaptive_push
         self.undirected = hg.undirected if undirected is None else undirected
         arrs = expand_arrays(hg)
         arrs["inv_perm_ext"] = jnp.asarray(hg.inv_perm_ext)

@@ -112,8 +112,14 @@ class PackedBfsResult:
             return out
 
         host_serves = self._graph is not None
+        # Same loud-fallback gate as PackedBatchResult.parents_into: above
+        # ~1e5 rows x lanes the host path stops being interactive.
+        work_desc = (
+            f"{n} lanes x {v} vertices" if n * v > 100_000 else None
+        )
         scanner = acquire_parent_scanner(
-            self._engine, device, host_serves=host_serves
+            self._engine, device, host_serves=host_serves,
+            work_desc=work_desc,
         )
         if scanner is None:
             return host()
@@ -122,6 +128,7 @@ class PackedBfsResult:
             host,
             device,
             host_serves=host_serves,
+            work_desc=work_desc,
         )
 
     def _parents_into_scan(self, out: np.ndarray, scanner) -> np.ndarray:

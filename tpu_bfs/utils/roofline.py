@@ -1,0 +1,296 @@
+"""Per-phase attribution of the hybrid MS-BFS level loop (roofline).
+
+"Is it actually fast, or just faster than before?" — the flagship number
+(62 GTEPS hmean on RMAT scale-21, BENCHMARKS.md) is one fused
+``lax.while_loop``; this module breaks a real traversal into its phases and
+prices each against the chip's HBM bandwidth, so the binding term is NAMED
+and the next optimization is attributable instead of guesswork.
+
+Method: step the REAL engine one level at a time, device-resident
+(``engine._core_from`` with ``max_levels = level+1`` — the checkpoint API's
+host round-trip would move ~2 GB/table per level at flagship scale and
+drown the phases). On each level's live frontier, separately dispatch
+jitted PHASE SLICES rebuilt from the same specs the fused loop was built
+from (msbfs_hybrid.expand_spec / tile_spmm / the adaptive push body /
+the claim+ripple state update), each timed with the scalar-read fence and
+floor subtraction of utils/timing.run_timed. The slices re-run work the
+fused loop runs once, so their sum normally EXCEEDS the fused level time;
+the difference is XLA's fusion dividend and is reported, not hidden.
+
+The byte model is analytic and fusion-agnostic: for each phase, the HBM
+bytes its algorithm must move at least once (tables read/written, index
+arrays, gathered rows). Achieved GB/s = bytes / measured time; the phase
+with the largest share of attributed time is the binding term, and the
+implied ceiling is the batch rate if every phase ran at peak HBM bandwidth
+(v5e: ~819 GB/s) — the batched analog of BENCHMARKS.md's single-stream
+latency-wall analysis.
+
+Correctness guard: the stepping loop's level count must equal a plain
+``engine.run``'s (same sources), proving the slices did not perturb the
+traversal. Reference analog: the reference has no attribution at all —
+its record is one wall-clock print per run (bfs.cu:624-626).
+
+Works on CPU/interpret for tests (tiny graphs); meaningful numbers need
+the chip (scripts/roofline.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_bfs.algorithms._packed_common import make_fori_expand
+from tpu_bfs.algorithms.msbfs_hybrid import expand_spec
+from tpu_bfs.algorithms.msbfs_packed import ripple_increment
+from tpu_bfs.ops.tile_spmm import TILE, tile_spmm
+from tpu_bfs.utils.timing import run_timed
+
+V5E_PEAK_GBS = 819.0  # HBM2 bandwidth of one v5e chip, vendor figure
+
+
+def phase_fns(engine) -> dict:
+    """Jitted phase slices of one hybrid level step.
+
+    Keys (present when the engine has the phase): ``residual`` (bucketed
+    ELL gathers + permutation back to rank0), ``dense`` (Pallas MXU tile
+    pass), ``push`` (adaptive push body, gate-free), ``gate`` (the adaptive
+    light-level decision inputs), ``hit`` (the full expansion exactly as
+    the fused loop composes it, pull form), ``state`` (claim + visited OR +
+    ripple plane increment + liveness).
+    """
+    hg, w = engine.hg, engine.w
+    act = hg.num_active
+    out_rows = hg.vt * TILE
+    expand_residual = make_fori_expand(expand_spec(hg), w)
+    fns = {}
+
+    def residual(arrs, fw):
+        return expand_residual(arrs, fw)[arrs["inv_perm_ext"]]
+
+    fns["residual"] = jax.jit(residual)
+
+    has_dense = hg.num_tiles > 0
+    if has_dense:
+        def dense(arrs, fw):
+            return tile_spmm(
+                arrs["row_start"], arrs["col_tile"], arrs["a_tiles"], fw,
+                num_row_tiles=hg.vt, w=w, interpret=engine.interpret,
+            )
+
+        fns["dense"] = jax.jit(dense)
+
+    def hit(arrs, fw):
+        h = residual(arrs, fw)
+        return h | dense(arrs, fw) if has_dense else h
+
+    fns["hit"] = jax.jit(hit)
+
+    if engine.adaptive_push is not None:
+        row_cap, _deg_cap = engine.adaptive_push
+
+        def gate(arrs, fw):
+            rows_active = jnp.any(fw[:act] != 0, axis=1)
+            nz = jnp.sum(rows_active.astype(jnp.int32))
+            bad = jnp.any(rows_active & arrs["push_inelig"])
+            return nz, bad
+
+        fns["gate"] = jax.jit(gate)
+
+        def push(arrs, fw):
+            # The push body of _packed_common.make_adaptive_hit, without
+            # the lax.cond gate (attribution wants the branch itself).
+            rows_active = jnp.any(fw[:act] != 0, axis=1)
+            nz = jnp.sum(rows_active.astype(jnp.int32))
+            idx = jnp.where(rows_active, size=row_cap, fill_value=act)[0]
+            pt = arrs["push_t"]
+
+            def pbody(i, h):
+                r = idx[i]
+                nb = pt[r]
+                return h.at[nb].set(h[nb] | fw[r][None, :])
+
+            h = jax.lax.fori_loop(
+                0, nz, pbody, jnp.zeros((out_rows, w), jnp.uint32)
+            )
+            return h.at[act].set(0)
+
+        fns["push"] = jax.jit(push)
+
+    def state(h, vis, planes):
+        nxt = h & ~vis
+        vis2 = vis | nxt
+        planes2 = ripple_increment(planes, ~vis2)
+        return nxt, vis2, planes2, jnp.any(nxt != 0)
+
+    fns["state"] = jax.jit(state)
+    return fns
+
+
+def phase_bytes(engine, *, nz_rows: int | None = None) -> dict:
+    """Analytic HBM bytes per phase for ONE level (lower bounds: bytes the
+    phase's algorithm must move at least once; XLA fusion can only reduce
+    intermediate traffic below this for `state`, so achieved-GB/s figures
+    derived from these are conservative for the expansion phases).
+
+    ``nz_rows`` (active frontier rows) sizes the push phase; the pull
+    phases are frontier-independent by construction (the whole table is
+    scanned every level — that level-invariance is itself a roofline
+    finding worth stating).
+    """
+    hg, w = engine.hg, engine.w
+    tb = hg.vt * TILE * w * 4  # one [rows, w] u32 table
+    out = {}
+    # residual: per light bucket, k fori steps each gathering n rows
+    # (n*w*4 read) and accumulating (acc read+write) + index table; the
+    # virtual/heavy bucket adds its fold pyramid and pick gathers.
+    res = 0
+    if hg.res_heavy:
+        m = hg.res_virtual.idx.shape[0]  # rows per virtual gather
+        res += hg.kcap * (3 * hg.res_num_virtual * w * 4) + hg.kcap * m * 4
+        # fold pyramid: halving read+write chain ~ 2 * 2*num_virtual rows,
+        # then the heavy_pick gather back out.
+        res += 4 * hg.res_num_virtual * w * 4 + hg.res_heavy * w * 4
+    for b in hg.res_light:
+        n, k = b.idx.shape
+        res += k * (3 * n * w * 4) + n * k * 4
+    # permutation back to rank0: read bucket rows + write the rank0 table.
+    res += 2 * tb
+    out["residual"] = res
+    if hg.num_tiles:
+        # a_tiles streamed once; each (row,col) tile production reads a
+        # 128-row frontier slab column; output written once per row tile.
+        out["dense"] = hg.a_tiles.nbytes + hg.num_tiles * TILE * w * 4 + tb
+    if engine.adaptive_push is not None:
+        deg_cap = engine.adaptive_push[1]
+        nz = int(nz_rows or 0)
+        # zero-init of the hit table + per active row: its frontier word
+        # row read + deg_cap neighbor rows read-modify-write.
+        out["push"] = tb + nz * (1 + 2 * deg_cap) * w * 4
+    # claim reads hit+vis, writes vis and nxt; ripple reads+writes planes.
+    out["state"] = (4 + 2 * engine.num_planes) * tb
+    return out
+
+
+@dataclasses.dataclass
+class LevelAttribution:
+    level: int
+    frontier_rows: int  # active rows entering the level
+    took: str  # 'push' (adaptive light level) or 'pull'
+    t_full_s: float  # the real fused one-level step
+    phases_s: dict  # phase -> seconds (standalone slice)
+    bytes_model: dict  # phase -> analytic HBM bytes
+
+
+def roofline_hybrid(engine, sources, *, peak_gbs: float = V5E_PEAK_GBS,
+                    measured_gteps: float | None = None,
+                    log=None) -> dict:
+    """Attribute a real traversal of ``sources`` level by level.
+
+    Returns a JSON-ready report: per-level attribution, per-phase totals
+    with shares and achieved GB/s, the fusion dividend, the named binding
+    term, and the peak-bandwidth ceiling implied by the byte model (scaled
+    from ``measured_gteps`` when given — pass the timed batch's figure so
+    the ceiling is anchored to the same run protocol)."""
+    fns = phase_fns(engine)
+    arrs = engine.arrs
+    sources = np.asarray(sources)
+    fw = engine._seed_dev(sources)
+    vis = fw
+    planes = tuple(jnp.zeros_like(fw) for _ in range(engine.num_planes))
+    level, alive = 0, True
+    cap = engine.max_levels_cap
+    row_cap = engine.adaptive_push[0] if engine.adaptive_push else None
+    levels: list[LevelAttribution] = []
+
+    count_rows = jax.jit(
+        lambda f: jnp.sum(jnp.any(f[: engine._act] != 0, axis=1)
+                          .astype(jnp.int32))
+    )
+    while alive and level < cap:
+        warm = level == 0
+        nz = int(count_rows(fw))
+        took = "pull"
+        if "gate" in fns:
+            g_nz, g_bad = fns["gate"](arrs, fw)
+            if int(g_nz) <= row_cap and not bool(g_bad):
+                took = "push"
+        phases = {}
+        for name in ("residual", "dense", "push"):
+            if name not in fns:
+                continue
+            out, t = run_timed(partial(fns[name], arrs, fw), warm=warm)
+            del out  # free the [rows, w] hit before the next dispatch
+            phases[name] = t
+        # state needs a hit input: materialize the full pull expansion
+        # (untimed), then time the claim+ripple on it.
+        h = fns["hit"](arrs, fw)
+        out, t = run_timed(partial(fns["state"], h, vis, planes), warm=warm)
+        del out, h
+        phases["state"] = t
+
+        step = partial(
+            engine._core_from, arrs, fw, vis, planes,
+            jnp.int32(level), jnp.int32(level + 1),
+        )
+        (fw2, vis2, planes2, lvl2, alive2), t_full = run_timed(
+            step, warm=warm
+        )
+        levels.append(LevelAttribution(
+            level=level, frontier_rows=nz, took=took, t_full_s=t_full,
+            phases_s=phases,
+            bytes_model=phase_bytes(engine, nz_rows=nz),
+        ))
+        if log is not None:
+            log(f"level {level}: rows={nz} took={took} "
+                f"full={t_full*1e3:.1f}ms " + " ".join(
+                    f"{k}={v*1e3:.1f}ms" for k, v in phases.items()))
+        fw, vis, planes = fw2, vis2, planes2
+        level, alive = int(lvl2), bool(alive2)
+
+    # ---- aggregate ----
+    # Attributed time: the phases the fused loop actually runs per level
+    # (push levels skip residual+dense; pull levels skip push) + state.
+    tot_attr: dict[str, float] = {}
+    tot_bytes: dict[str, float] = {}
+    t_full_sum = 0.0
+    for la in levels:
+        t_full_sum += la.t_full_s
+        names = (["push"] if la.took == "push" else
+                 [n for n in ("residual", "dense") if n in la.phases_s])
+        for n in names + ["state"]:
+            tot_attr[n] = tot_attr.get(n, 0.0) + la.phases_s[n]
+            tot_bytes[n] = tot_bytes.get(n, 0.0) + la.bytes_model.get(n, 0)
+    attr_sum = sum(tot_attr.values())
+    binding = max(tot_attr, key=tot_attr.get)
+    total_bytes = sum(tot_bytes.values())
+    report = {
+        "num_levels": len(levels),
+        "levels": [dataclasses.asdict(la) for la in levels],
+        "t_full_sum_s": t_full_sum,
+        "t_attributed_sum_s": attr_sum,
+        # slices re-run what the fused loop fuses; the gap is XLA's win.
+        "fusion_dividend_s": attr_sum - t_full_sum,
+        "phase_share": {n: t / attr_sum for n, t in tot_attr.items()},
+        "phase_achieved_gbs": {
+            n: (tot_bytes[n] / 1e9) / t if t > 0 else None
+            for n, t in tot_attr.items()
+        },
+        "binding_term": binding,
+        "peak_gbs": peak_gbs,
+        "hbm_bytes_total": total_bytes,
+        # time the whole byte model would take at peak bandwidth.
+        "t_at_peak_bw_s": total_bytes / (peak_gbs * 1e9),
+    }
+    if measured_gteps is not None:
+        # The fused batch measured `measured_gteps`; if every attributed
+        # phase ran at peak HBM bandwidth, the same byte model implies:
+        report["measured_gteps"] = measured_gteps
+        report["ceiling_gteps_at_peak_bw"] = (
+            measured_gteps * t_full_sum / report["t_at_peak_bw_s"]
+            if report["t_at_peak_bw_s"] > 0 else None
+        )
+    return report

@@ -817,6 +817,7 @@ def bench_wide(g, scale: int, ef: int, graph_desc: str | None = None,
                _shed_adaptive: bool = False) -> dict:
     """Wide packed MS-BFS, gather-only (msbfs_wide.py); default width 8192
     lanes like the hybrid. ``_shed_adaptive`` as in bench_hybrid."""
+    from tpu_bfs.algorithms._packed_common import PackedStateDoesntFitError
     from tpu_bfs.algorithms.msbfs_wide import (
         DEFAULT_MAX_LANES as WIDE_DEFAULT_MAX_LANES,
         WidePackedMsBfsEngine,
@@ -828,9 +829,19 @@ def bench_wide(g, scale: int, ef: int, graph_desc: str | None = None,
     kw = {} if adaptive is None else {"adaptive_push": adaptive}
 
     def run_once():
-        engine = retry_transient(WidePackedMsBfsEngine, g,
-                                 max_lanes=max_lanes,
-                                 label="wide engine build", **kw)
+        try:
+            engine = retry_transient(WidePackedMsBfsEngine, g,
+                                     max_lanes=max_lanes,
+                                     label="wide engine build", **kw)
+        except PackedStateDoesntFitError as exc:
+            # The round-5 sizing-time raise replaces the old delayed
+            # runtime OOM; the shed ladder must still get its chance when
+            # the push table is what tipped the budget.
+            if adaptive is not None:
+                log(f"wide+adaptive doesn't fit ({exc}); retrying without "
+                    f"the push table")
+                raise _ShedRetry from None
+            raise
         ell = engine.ell
         return _bench_batch_packed(
             g, graph_desc or f"RMAT scale-{scale} ef={ef}", engine,

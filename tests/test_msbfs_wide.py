@@ -134,12 +134,24 @@ def test_wide_auto_lane_sizing(random_small):
     from tpu_bfs.algorithms.msbfs_wide import DEFAULT_MAX_LANES
 
     assert WidePackedMsBfsEngine(random_small).lanes == DEFAULT_MAX_LANES
-    small = WidePackedMsBfsEngine(random_small, hbm_budget_bytes=int(1.5e6))
-    assert 32 <= small.lanes < LANES
+    # A budget that fits the 4096-lane physical width but not 8192 lanes
+    # degrades one ladder step and still answers correctly. (Under the
+    # round-4 padding model, widths BELOW 128 words cost the same physical
+    # HBM, so 4096 lanes is the last rung a budget can buy.)
+    small = WidePackedMsBfsEngine(random_small, hbm_budget_bytes=int(3.0e6))
+    assert small.lanes == LANES
     res = small.run(np.array([0, 7]))
     golden, _ = bfs_python(random_small, 0)
     np.testing.assert_array_equal(res.distances_int32(0), golden)
-    # Never sizes below the 32-lane floor even on absurd budgets.
+    # A budget below even the narrowest physical width fails AT SIZING
+    # TIME with the levers named (ADVICE r4) — the engine no longer
+    # builds a width the model says cannot materialize on TPU.
+    from tpu_bfs.algorithms._packed_common import PackedStateDoesntFitError
+
+    with pytest.raises(PackedStateDoesntFitError, match="planes"):
+        WidePackedMsBfsEngine(random_small, hbm_budget_bytes=int(1.5e6))
+    # The estimate-mode helper never raises and never sizes below the
+    # 32-lane floor even on absurd budgets (probe/pre-check callers).
     assert auto_lanes(10**9, 8, hbm_budget_bytes=1) == 32
 
 

@@ -49,6 +49,14 @@ def tpu_padded_words(w: int) -> int:
     return -(-w // 128) * 128
 
 
+class PackedStateDoesntFitError(ValueError):
+    """Even the narrowest packed table cannot fit the HBM budget: on TPU a
+    32-lane [rows, 1]-word table occupies the same physical HBM as 128
+    words (tpu_padded_words), so no width shrink can help — the real
+    levers are fewer planes, fewer rows (shard over a mesh), or shedding
+    optional state (push table, dense-tile budget)."""
+
+
 def auto_lanes(
     rows: int,
     num_planes: int,
@@ -56,6 +64,7 @@ def auto_lanes(
     fixed_bytes: int = 0,
     hbm_budget_bytes: int = int(14.0e9),
     max_lanes: int = 4096,
+    on_unfit: str = "floor",
 ) -> int:
     """Largest lane count whose packed state fits the HBM budget.
 
@@ -72,13 +81,35 @@ def auto_lanes(
     the 32-lane floor: the small batch is still cheaper to RUN (and
     genuinely smaller on CPU), but on TPU the caller's real levers are
     fewer planes, sharding over a mesh, or shedding optional state.
+
+    ``on_unfit='raise'`` turns that fall-through into a
+    :class:`PackedStateDoesntFitError` at SIZING time when even the
+    floor's physical footprint exceeds the budget (ADVICE r4: the engine
+    constructors otherwise accept the unfit width and die minutes later
+    in an opaque runtime RESOURCE_EXHAUSTED); ``'floor'`` (default) keeps
+    the legacy estimate semantics for callers that only compare widths
+    (auto_planes' probe, the bench's engine-selection pre-check).
     """
+    if on_unfit not in ("floor", "raise"):
+        raise ValueError(f"on_unfit must be floor|raise, got {on_unfit!r}")
     w = floor_lanes(max_lanes) // 32
     while w > 1:
         need = (num_planes + 6) * rows * tpu_padded_words(w) * 4 + fixed_bytes
         if need <= hbm_budget_bytes:
             break
         w //= 2
+    if on_unfit == "raise" and w == 1:
+        need = (num_planes + 6) * rows * tpu_padded_words(1) * 4 + fixed_bytes
+        if need > hbm_budget_bytes:
+            raise PackedStateDoesntFitError(
+                f"packed state cannot fit: {rows} rows x {num_planes} "
+                f"planes needs {need/1e9:.2f} GB at the narrowest physical "
+                f"width (32 lanes pads to 128 words on TPU) vs the "
+                f"{hbm_budget_bytes/1e9:.2f} GB budget "
+                f"({fixed_bytes/1e9:.2f} GB fixed residents). Levers: "
+                f"fewer planes, shard rows over more chips, or shed "
+                f"optional state (adaptive push table, dense-tile budget)."
+            )
     return 32 * w
 
 

@@ -158,12 +158,17 @@ class WidePackedMsBfsEngine:
                 if adaptive_push is not None
                 else 0
             )
+            # on_unfit='raise': when even the 32-lane floor's PHYSICAL
+            # footprint exceeds the budget, fail here with the real levers
+            # named instead of minutes later in an opaque runtime
+            # RESOURCE_EXHAUSTED (ADVICE r4).
             lanes = auto_lanes(
                 self._act + 1,
                 num_planes,
                 fixed_bytes=int(self.ell.total_slots * 4.4) + push_bytes,
                 hbm_budget_bytes=hbm_budget_bytes,
                 max_lanes=max_lanes,
+                on_unfit="raise",
             )
         if lanes % 32 or not (32 <= lanes <= MAX_LANES):
             raise ValueError(

@@ -148,6 +148,18 @@ def _make_ms_engine(args, g, n_sources: int):
         )
         return PackedMsBfsEngine(g, lanes=lanes)
     if args.adaptive_push:
+        if g.num_input_edges < 10_000:
+            # Measured: 0.35x on a 240-vertex path graph (BENCHMARKS.md
+            # "Level-adaptive expansion") — the push pass wins by skipping
+            # the full-table scan, and tiny tables cost nothing to scan.
+            print(
+                f"WARNING: --adaptive-push on a tiny graph "
+                f"({g.num_input_edges} edges < 1e4) usually LOSES (0.35x "
+                f"measured on a 240-vertex path graph); it pays off when "
+                f"light levels skip a large table scan.",
+                file=sys.stderr,
+                flush=True,
+            )
         lanes_kw = dict(lanes_kw, adaptive_push=args.adaptive_push)
     if engine == "wide":
         from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine

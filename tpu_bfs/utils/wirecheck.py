@@ -134,6 +134,163 @@ def check_1d_sparse(graph, p: int = 8) -> dict:
     }
 
 
+def check_2d(graph, rows: int = 2, cols: int = 4, exchange: str = "ring",
+             backend: str = "scan") -> dict:
+    """2D Dist2DBfsEngine: the modeled per-level bytes (dense_2d_wire_bytes
+    — the BASELINE scale-26 config's wire model) vs the compiled loop's
+    column all-gather and row reduce-scatter.
+
+    Ring conventions as in the module docstring; ``all-gather`` result
+    holds all R pieces, so wire/chip = result - own piece = result*(R-1)/R.
+    The 'allreduce' row exchange lowers to one [C*w] s32 all-reduce whose
+    bandwidth-optimal wire cost is 2*(C-1)/C x result bytes."""
+    import jax.numpy as jnp
+
+    from tpu_bfs.parallel.collectives import dense_2d_wire_bytes
+    from tpu_bfs.parallel.dist_bfs2d import Dist2DBfsEngine, make_mesh_2d
+
+    eng = Dist2DBfsEngine(
+        graph, make_mesh_2d(rows, cols), exchange=exchange, backend=backend
+    )
+    w = eng.part.w
+    f0, vis0, d0 = eng._init_state(0)
+    hlo = (
+        eng._loop.lower(
+            eng.src_g, eng.dst_l, eng.rp, eng._aux, f0, vis0, d0,
+            jnp.int32(0), jnp.int32(64),
+        )
+        .compile()
+        .as_text()
+    )
+    colls = hlo_collectives(hlo)
+
+    # Column exchange: one pred[R*w] all-gather over 'r' per level.
+    col_ags = [
+        c for c in colls if c.op == "all-gather" and c.result_bytes == rows * w
+    ]
+    ag_wire = (rows - 1) * w if rows > 1 else 0
+
+    if exchange == "ring":
+        # Row exchange: unrolled ring, C-1 permutes of one pred[w] chunk.
+        ring = [
+            c for c in colls
+            if c.op == "collective-permute" and c.result_bytes == w
+        ]
+        row_wire = sum(c.result_bytes for c in ring)
+        row_ok = len(ring) == cols - 1
+    else:
+        # Row exchange: one s32[C*w] all-reduce (psum) over 'c'.
+        big_ars = [
+            c for c in colls
+            if c.op == "all-reduce" and c.result_bytes == 4 * cols * w
+        ]
+        row_wire = sum(
+            2 * (cols - 1) * c.result_bytes // cols for c in big_ars
+        )
+        row_ok = len(big_ars) == 1
+    scalars = [
+        c for c in colls if c.op == "all-reduce" and c.result_bytes == 4
+    ]
+
+    modeled = dense_2d_wire_bytes(rows, cols, w, exchange)
+    derived = float(ag_wire + row_wire)
+    return {
+        "config": (
+            f"2D {exchange}/{backend}, mesh {rows}x{cols}, w={w}"
+        ),
+        "modeled_per_level": modeled,
+        "hlo_per_level": derived,
+        "column_allgathers": len(col_ags),
+        "scalar_allreduces": len(scalars),
+        # A 1-row mesh column-exchanges nothing: no all-gather to find.
+        "agree": (
+            modeled == derived
+            and len(col_ags) == (1 if rows > 1 else 0)
+            and row_ok
+        ),
+    }
+
+
+def check_rows_sparse(graph, p: int = 8, lanes: int = 64) -> dict:
+    """Distributed wide engine, queue-style sparse row gather
+    (collectives.sparse_rows_gather, shared with the distributed hybrid):
+    the modeled per-branch bytes (sparse_rows_wire_bytes_per_level) vs the
+    compiled cap-ladder's all-gather sizes.
+
+    Each sparse rung c gathers (ids s32[c], vals u32[c, w]) from every
+    chip; XLA's all-gather combiner may emit them as two array ops or one
+    tuple op, so both forms are accepted. Wire/chip = (P-1)/P x gathered
+    result bytes, + the 4-byte pmax scalar every branch pays. The dense
+    fallback gathers the whole [v_loc, w] slab."""
+    import jax.numpy as jnp
+
+    from tpu_bfs.parallel.collectives import sparse_rows_wire_bytes_per_level
+    from tpu_bfs.parallel.dist_bfs import make_mesh
+    from tpu_bfs.parallel.dist_msbfs_wide import DistWideMsBfsEngine
+
+    eng = DistWideMsBfsEngine(
+        graph, make_mesh(p), lanes=lanes, exchange="sparse"
+    )
+    w = eng.w
+    rows_loc = eng._gather_rows_loc
+    caps = eng.sparse_caps
+    fw0 = eng._seed_dev(np.asarray([0]))
+    hlo = (
+        eng._dist_core.lower(eng.arrs, fw0, jnp.int32(32)).compile().as_text()
+    )
+    ags = [c for c in hlo_collectives(hlo) if c.op == "all-gather"]
+    pool = list(ags)  # ops are CONSUMED as rungs match (see below)
+
+    def _take(pred) -> bool:
+        for i, a in enumerate(pool):
+            if pred(a):
+                del pool[i]
+                return True
+        return False
+
+    def rung_result_bytes(ids_b: int, vals_b: int):
+        """Gathered result bytes of one rung, from the HLO's own ops —
+        separate ids/vals all-gathers or one combined tuple op. Matched
+        ops are consumed so size collisions between rungs (cap_j*4 ==
+        cap_i*4w, or ids_b == vals_b at w=1) can't let one op vouch for
+        two probes — a program genuinely missing a rung must fail."""
+        if _take(lambda a: a.result_bytes == ids_b + vals_b and a.pieces == 2):
+            return ids_b + vals_b
+        if _take(lambda a: a.result_bytes == ids_b and a.pieces == 1):
+            if _take(lambda a: a.result_bytes == vals_b and a.pieces == 1):
+                return ids_b + vals_b
+        return None
+
+    derived = []
+    found = []
+    for c in sorted(caps):
+        got = rung_result_bytes(p * c * 4, p * c * 4 * w)
+        found.append(got is not None)
+        derived.append(
+            None if got is None else got * (p - 1) / p + 4.0
+        )
+    dense_b = p * rows_loc * 4 * w
+    dense_got = _take(lambda a: a.result_bytes == dense_b)
+    found.append(dense_got)
+    derived.append(dense_b * (p - 1) / p + 4.0 if dense_got else None)
+
+    modeled = sparse_rows_wire_bytes_per_level(p, rows_loc, w, caps)
+    return {
+        "config": (
+            f"dist-wide sparse rows, P={p}, rows_loc={rows_loc}, w={w}, "
+            f"caps={caps}"
+        ),
+        "modeled_per_level": modeled,
+        "hlo_per_level": derived,
+        "all_gathers": len(ags),
+        "agree": (
+            all(found)
+            and [float(x) for x in modeled]
+            == [float(x) for x in derived]
+        ),
+    }
+
+
 def check_sliced_hybrid(graph, p: int = 8, lanes: int | None = None) -> dict:
     """Ring-sliced distributed hybrid: the modeled dense-slab bytes
     ((P-1) x [rows_loc, w] u32 per level) vs the compiled rotation's

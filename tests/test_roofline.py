@@ -78,11 +78,14 @@ def test_phase_slices_compose_to_fused_expansion(small_graph, engine):
         )
         np.testing.assert_array_equal(np.asarray(h), np.asarray(h_split))
     planes = tuple(jnp.zeros_like(fw) for _ in range(engine.num_planes))
-    _, vis2, _, _ = fns["state"](h, fw, planes)
-    fw_f, vis_f, _, _, _ = engine._core_from(
+    _, vis2, _ = fns["claim"](h, fw)
+    planes2 = fns["ripple"](planes, vis2)
+    fw_f, vis_f, planes_f, _, _ = engine._core_from(
         engine.arrs, fw, fw, planes, jnp.int32(0), jnp.int32(1)
     )
     np.testing.assert_array_equal(np.asarray(vis2), np.asarray(vis_f))
+    for a, b in zip(planes2, planes_f):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
 def test_stepping_does_not_perturb_distances(small_graph, adaptive_engine):

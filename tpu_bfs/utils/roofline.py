@@ -59,8 +59,10 @@ def phase_fns(engine) -> dict:
     ELL gathers + permutation back to rank0), ``dense`` (Pallas MXU tile
     pass), ``push`` (adaptive push body, gate-free), ``gate`` (the adaptive
     light-level decision inputs), ``hit`` (the full expansion exactly as
-    the fused loop composes it, pull form), ``state`` (claim + visited OR +
-    ripple plane increment + liveness).
+    the fused loop composes it, pull form), and the state update sliced
+    as ``claim`` (hit & ~vis claim + visited OR + liveness) and
+    ``ripple`` (bit-plane increment) — reported summed as 'state' in the
+    attribution.
     """
     hg, w = engine.hg, engine.w
     act = hg.num_active
@@ -120,13 +122,20 @@ def phase_fns(engine) -> dict:
 
         fns["push"] = jax.jit(push)
 
-    def state(h, vis, planes):
+    # The state update is sliced in two so each dispatch's live set fits
+    # next to the standing carry at flagship scale (claim's outputs can't
+    # alias its inputs we still hold; ripple doubles the plane tables —
+    # one fused state fn peaked ~4 extra tables and OOM'd the 16 GB chip
+    # at scale 21 / w=256). Reported summed as 'state'.
+    def claim(h, vis):
         nxt = h & ~vis
-        vis2 = vis | nxt
-        planes2 = ripple_increment(planes, ~vis2)
-        return nxt, vis2, planes2, jnp.any(nxt != 0)
+        return nxt, vis | nxt, jnp.any(nxt != 0)
 
-    fns["state"] = jax.jit(state)
+    def ripple(planes, vis2):
+        return ripple_increment(planes, ~vis2)
+
+    fns["claim"] = jax.jit(claim)
+    fns["ripple"] = jax.jit(ripple)
     return fns
 
 
@@ -199,12 +208,45 @@ def roofline_hybrid(engine, sources, *, peak_gbs: float = V5E_PEAK_GBS,
     arrs = engine.arrs
     sources = np.asarray(sources)
     fw = engine._seed_dev(sources)
-    vis = fw
+    # vis must be a DISTINCT buffer: the donating step would otherwise
+    # donate the same seed buffer through two donated parameters, which
+    # PJRT rejects at execute time.
+    vis = jnp.copy(fw)
     planes = tuple(jnp.zeros_like(fw) for _ in range(engine.num_planes))
     level, alive = 0, True
     cap = engine.max_levels_cap
     row_cap = engine.adaptive_push[0] if engine.adaptive_push else None
     levels: list[LevelAttribution] = []
+
+    def try_timed(call, warm):
+        """Phase timing with an OOM seatbelt: a slice whose live set
+        doesn't fit next to the standing carry reports None (partial
+        attribution beats losing the whole report), anything else
+        propagates."""
+        try:
+            return run_timed(call, warm=warm)
+        except Exception as exc:  # noqa: BLE001 — OOM-only degrade
+            if "RESOURCE_EXHAUSTED" not in str(exc):
+                raise
+            if log is not None:
+                log(f"phase slice OOM'd; reporting None ({str(exc)[:120]})")
+            return None, None
+
+    # One-level fused step. On TPU the carry buffers are DONATED so the
+    # step's output can alias them — without donation the old and new
+    # carries are simultaneously live (the standing 6 tables twice over)
+    # and the stepping OOMs at flagship scale where engine.run fits.
+    # Donated inputs are consumed per call, so the usual warm-by-running
+    # is impossible; the compile is absorbed via AOT lower().compile()
+    # (which executes nothing) and the Compiled object is called once per
+    # level.
+    raw_step = getattr(engine._core_from, "__wrapped__", None)
+    donating = raw_step is not None and jax.default_backend() == "tpu"
+    step_fn = (
+        jax.jit(raw_step, donate_argnums=(1, 2, 3))
+        if donating else engine._core_from
+    )
+    compiled_step = None
 
     count_rows = jax.jit(
         lambda f: jnp.sum(jnp.any(f[: engine._act] != 0, axis=1)
@@ -222,22 +264,48 @@ def roofline_hybrid(engine, sources, *, peak_gbs: float = V5E_PEAK_GBS,
         for name in ("residual", "dense", "push"):
             if name not in fns:
                 continue
-            out, t = run_timed(partial(fns[name], arrs, fw), warm=warm)
+            out, t = try_timed(partial(fns[name], arrs, fw), warm)
             del out  # free the [rows, w] hit before the next dispatch
             phases[name] = t
-        # state needs a hit input: materialize the full pull expansion
-        # (untimed), then time the claim+ripple on it.
-        h = fns["hit"](arrs, fw)
-        out, t = run_timed(partial(fns["state"], h, vis, planes), warm=warm)
-        del out, h
-        phases["state"] = t
+        # State = claim + ripple, timed separately (see phase_fns) on a
+        # freshly materialized full hit. The hit materialization itself
+        # is the largest slice intermediate — same OOM seatbelt.
+        try:
+            h = fns["hit"](arrs, fw)
+            jax.block_until_ready(h)
+        except Exception as exc:  # noqa: BLE001 — OOM-only degrade
+            if "RESOURCE_EXHAUSTED" not in str(exc):
+                raise
+            h = None
+            if log is not None:
+                log(f"hit materialization OOM'd ({str(exc)[:120]})")
+        if h is None:
+            cl, t_claim = None, None
+        else:
+            cl, t_claim = try_timed(partial(fns["claim"], h, vis), warm)
+            del h
+        if cl is None:
+            phases["state"] = None
+        else:
+            _nxt, vis2p, _ = cl
+            del cl, _nxt
+            out, t_rip = try_timed(partial(fns["ripple"], planes, vis2p), warm)
+            del out, vis2p
+            phases["state"] = (
+                None if t_rip is None else t_claim + t_rip
+            )
 
-        step = partial(
-            engine._core_from, arrs, fw, vis, planes,
-            jnp.int32(level), jnp.int32(level + 1),
+        step_args = (
+            arrs, fw, vis, planes, jnp.int32(level), jnp.int32(level + 1)
         )
+        if donating:
+            if compiled_step is None:
+                compiled_step = step_fn.lower(*step_args).compile()
+            step, step_warm = partial(compiled_step, *step_args), False
+        else:
+            step, step_warm = partial(step_fn, *step_args), warm
         (fw2, vis2, planes2, lvl2, alive2), t_full = run_timed(
-            step, warm=warm
+            step, warm=step_warm
         )
         levels.append(LevelAttribution(
             level=level, frontier_rows=nz, took=took, t_full_s=t_full,
@@ -247,7 +315,8 @@ def roofline_hybrid(engine, sources, *, peak_gbs: float = V5E_PEAK_GBS,
         if log is not None:
             log(f"level {level}: rows={nz} took={took} "
                 f"full={t_full*1e3:.1f}ms " + " ".join(
-                    f"{k}={v*1e3:.1f}ms" for k, v in phases.items()))
+                    f"{k}={v*1e3:.1f}ms" if v is not None else f"{k}=OOM"
+                    for k, v in phases.items()))
         fw, vis, planes = fw2, vis2, planes2
         level, alive = int(lvl2), bool(alive2)
 
@@ -257,15 +326,22 @@ def roofline_hybrid(engine, sources, *, peak_gbs: float = V5E_PEAK_GBS,
     tot_attr: dict[str, float] = {}
     tot_bytes: dict[str, float] = {}
     t_full_sum = 0.0
+    unmeasured = 0  # phase slices that OOM'd next to the standing carry
     for la in levels:
         t_full_sum += la.t_full_s
         names = (["push"] if la.took == "push" else
                  [n for n in ("residual", "dense") if n in la.phases_s])
         for n in names + ["state"]:
-            tot_attr[n] = tot_attr.get(n, 0.0) + la.phases_s[n]
+            t = la.phases_s.get(n)
+            if t is None:
+                unmeasured += 1
+                continue
+            tot_attr[n] = tot_attr.get(n, 0.0) + t
             tot_bytes[n] = tot_bytes.get(n, 0.0) + la.bytes_model.get(n, 0)
     attr_sum = sum(tot_attr.values())
-    binding = max(tot_attr, key=tot_attr.get)
+    # Full degradation (every slice OOM'd) still emits the partial report
+    # — per-level t_full and the unmeasured count are real data.
+    binding = max(tot_attr, key=tot_attr.get) if tot_attr else None
     total_bytes = sum(tot_bytes.values())
     report = {
         "num_levels": len(levels),
@@ -280,6 +356,7 @@ def roofline_hybrid(engine, sources, *, peak_gbs: float = V5E_PEAK_GBS,
             for n, t in tot_attr.items()
         },
         "binding_term": binding,
+        "unmeasured_phase_slices": unmeasured,
         "peak_gbs": peak_gbs,
         "hbm_bytes_total": total_bytes,
         # time the whole byte model would take at peak bandwidth.

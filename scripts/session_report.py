@@ -1,0 +1,65 @@
+"""Render a chip session's landed measurements as one markdown table.
+
+Reads every .json / .jsonl under the session directory (default
+.bench_cache/chip_session), classifies each as landed / stale-echo /
+lost / pending, and prints a markdown table plus a short todo list of
+stages still missing — the write-up scaffold for BENCHMARKS.md after a
+measurement session (round 5's slate spans 14 stages; eyeballing tails
+does not scale).
+
+Usage: python scripts/session_report.py [session_dir]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def classify(path: str):
+    """(status, value, unit, metric) of a stage output file."""
+    try:
+        with open(path) as f:
+            lines = [l.strip() for l in f if l.strip().startswith("{")]
+    except OSError:
+        return "unreadable", None, "", ""
+    if not lines:
+        return "pending", None, "", ""
+    try:
+        e = json.loads(lines[-1])
+    except ValueError:
+        return "partial", None, "", ""
+    if e.get("width_probe_complete"):
+        return "landed", len(lines) - 2, "probe lines", "width probe sweep"
+    v = e.get("value")
+    if v is None:
+        return "lost", None, "", e.get("error", "")[:80]
+    if e.get("stale"):
+        return "stale-echo", v, e.get("unit", ""), e.get("metric", "")
+    return "landed", v, e.get("unit", ""), e.get("metric", "")
+
+
+def main(argv) -> int:
+    d = argv[1] if len(argv) > 1 else ".bench_cache/chip_session"
+    rows, missing = [], []
+    names = sorted(
+        n for n in os.listdir(d) if n.endswith((".json", ".jsonl"))
+    )
+    for n in names:
+        status, v, unit, metric = classify(os.path.join(d, n))
+        rows.append((n, status, v, unit, metric))
+        if status not in ("landed",):
+            missing.append(f"{n} ({status})")
+    print("| stage file | status | value | unit | metric |")
+    print("|---|---|---|---|---|")
+    for n, status, v, unit, metric in rows:
+        print(f"| {n} | {status} | {v if v is not None else ''} | {unit} "
+              f"| {metric} |")
+    if missing:
+        print(f"\nnot landed ({len(missing)}): " + ", ".join(missing))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

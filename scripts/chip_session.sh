@@ -70,7 +70,12 @@ pstage() {  # pstage <name> <json-out> <script> [ENV=VAL...] — one helper-scri
     return 0
   fi
   echo "=== $name $(date -u +%H:%M:%S) ==="
-  if env "$@" python "$script" >"$json" 2>"${json%.json}.log" \
+  # Helper scripts have no outage envelope of their own (they never arm
+  # bench.py's watchdog), so a chip drop mid-script would otherwise wedge
+  # the whole session on one unbudgeted attempt. timeout(1) is that
+  # envelope here: on expiry the stage FAILS and the slate moves on.
+  if timeout "${CHIP_SESSION_PSTAGE_TIMEOUT_S:-5400}" \
+      env "$@" python "$script" >"$json" 2>"${json%.json}.log" \
       && got_value "$json"; then
     echo "$name OK: $(tail -1 "$json")"
     return 0
@@ -82,6 +87,21 @@ pstage() {  # pstage <name> <json-out> <script> [ENV=VAL...] — one helper-scri
 for i in $(seq 1 "$attempts"); do
   echo "=== attempt $i $(date -u +%H:%M:%S) ==="
   if stage "flagship" "$out/flagship.json"; then
+    # Round-5 slate in VERDICT r4 priority order — a short chip window
+    # should land the round's NEW measurements before re-confirmations:
+    # structure sweep at the 8192+push operating point + the
+    # floor-subtracted 256/512-word gather probe (#2), roofline
+    # attribution (#3), device parent scan at flagship scale (#4), the
+    # 16384-lane arm at scale 20 (plain, matching the width series'
+    # historical config; #5), a quiet-chip tiled single-stream run (#7),
+    # the scale-22 auto-walk OOM-edge rehearsal (weak #6), then the
+    # round-4 re-confirmation arms (their figures are already in the
+    # durable log).
+    stage "kcap-32" "$out/kcap32.json" TPU_BFS_BENCH_KCAP=32
+    stage "kcap-128" "$out/kcap128.json" TPU_BFS_BENCH_KCAP=128
+    stage "thr32-b08" "$out/thr32_b08.json" \
+      TPU_BFS_BENCH_TILE_THR=32 TPU_BFS_BENCH_A_BUDGET=8e8
+    stage "thr128" "$out/thr128.json" TPU_BFS_BENCH_TILE_THR=128
     if got_value "$out/width_probe.jsonl"; then   # completion marker line
       echo "width probe already landed"   # idempotent restart
     else
@@ -90,23 +110,6 @@ for i in $(seq 1 "$attempts"); do
         && echo "width probe OK" || echo "width probe FAILED (see $out/width_probe.log)"
       cat "$out/width_probe.jsonl" 2>/dev/null
     fi
-    stage "flagship-noadaptive" "$out/flagship_noadaptive.json" \
-      TPU_BFS_BENCH_ADAPTIVE=0
-    stage "width-4096-plain" "$out/flagship_4k_plain.json" \
-      TPU_BFS_BENCH_ADAPTIVE=0 TPU_BFS_BENCH_MAX_LANES=4096
-    stage "lj-hybrid" "$out/lj_hybrid.json" TPU_BFS_BENCH_MODE=lj-hybrid
-    # Structure sweep at the flagship operating point (the round-4 chip
-    # outage interrupted these; each is skippable by deleting its arm):
-    stage "kcap-32" "$out/kcap32.json" TPU_BFS_BENCH_KCAP=32
-    stage "kcap-128" "$out/kcap128.json" TPU_BFS_BENCH_KCAP=128
-    stage "thr32-b08" "$out/thr32_b08.json" \
-      TPU_BFS_BENCH_TILE_THR=32 TPU_BFS_BENCH_A_BUDGET=8e8
-    stage "thr128" "$out/thr128.json" TPU_BFS_BENCH_TILE_THR=128
-    # Round-5 stages (VERDICT r4 #3/#4/#5/#7 + weak #6), in verdict order:
-    # roofline attribution of the flagship, device parent scan at flagship
-    # scale, the 16384-lane arm at scale 20 (plain, matching the width
-    # series' historical config), a quiet-chip tiled single-stream run,
-    # and the scale-22 auto-walk OOM-edge rehearsal with push on.
     pstage "roofline" "$out/roofline.json" scripts/roofline.py
     pstage "parent-scan" "$out/parent_scan.json" scripts/parent_scan_bench.py
     stage "lanes16k-s20" "$out/lanes16k_s20.json" \
@@ -115,6 +118,11 @@ for i in $(seq 1 "$attempts"); do
     stage "tiled-single" "$out/tiled_single.json" \
       TPU_BFS_BENCH_MODE=single-tiled
     stage "scale22-auto" "$out/scale22.json" TPU_BFS_BENCH_SCALE=22
+    stage "flagship-noadaptive" "$out/flagship_noadaptive.json" \
+      TPU_BFS_BENCH_ADAPTIVE=0
+    stage "width-4096-plain" "$out/flagship_4k_plain.json" \
+      TPU_BFS_BENCH_ADAPTIVE=0 TPU_BFS_BENCH_MAX_LANES=4096
+    stage "lj-hybrid" "$out/lj_hybrid.json" TPU_BFS_BENCH_MODE=lj-hybrid
     exit 0
   fi
   [ "$i" -lt "$attempts" ] && sleep "${CHIP_SESSION_SLEEP:-300}"

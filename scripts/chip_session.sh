@@ -102,14 +102,10 @@ for i in $(seq 1 "$attempts"); do
     stage "thr32-b08" "$out/thr32_b08.json" \
       TPU_BFS_BENCH_TILE_THR=32 TPU_BFS_BENCH_A_BUDGET=8e8
     stage "thr128" "$out/thr128.json" TPU_BFS_BENCH_TILE_THR=128
-    if got_value "$out/width_probe.jsonl"; then   # completion marker line
-      echo "width probe already landed"   # idempotent restart
-    else
-      echo "=== width probe ==="
-      python scripts/width_probe.py >"$out/width_probe.jsonl" 2>"$out/width_probe.log" \
-        && echo "width probe OK" || echo "width probe FAILED (see $out/width_probe.log)"
-      cat "$out/width_probe.jsonl" 2>/dev/null
-    fi
+    # The probe's completion-marker line satisfies got_value, so pstage
+    # gives it the same idempotent restart + timeout envelope as the
+    # other helper scripts.
+    pstage "width-probe" "$out/width_probe.jsonl" scripts/width_probe.py
     pstage "roofline" "$out/roofline.json" scripts/roofline.py
     pstage "parent-scan" "$out/parent_scan.json" scripts/parent_scan_bench.py
     stage "lanes16k-s20" "$out/lanes16k_s20.json" \

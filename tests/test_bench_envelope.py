@@ -221,6 +221,33 @@ def test_signal_after_printed_verdict_preserves_it(tmp_path, monkeypatch,
     assert bench._FINAL_RC == 0
 
 
+def test_bench_subprocess_smoke_wide(tmp_path):
+    """The EXACT driver path (`python bench.py`), end to end in a
+    subprocess on CPU: one fresh JSON line with a real value, the durable
+    log appended, rc 0 — catches wiring regressions no in-process
+    monkeypatched run can (env parsing, signal-envelope install, compile
+    cache setup, the __main__ block itself). ~7 s at scale 10."""
+    log = tmp_path / "results.jsonl"
+    env = dict(os.environ)
+    env.update(
+        PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
+        TPU_BFS_BENCH_SCALE="10", TPU_BFS_BENCH_MODE="wide",
+        TPU_BFS_BENCH_XLA_CACHE="",
+        TPU_BFS_BENCH_CACHE=str(tmp_path / "cache"),
+        TPU_BFS_BENCH_RESULT_LOG=str(log),
+    )
+    proc = subprocess.run(
+        [sys.executable, "bench.py"], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = _last_json_line(proc.stdout)
+    assert out["value"] is not None and "stale" not in out
+    assert out["unit"] == "GTEPS" and "wide" in out["metric"]
+    logged = json.loads(log.read_text().strip().splitlines()[-1])
+    assert logged["value"] == out["value"] and logged["mode"] == "wide"
+
+
 def test_budget_default_fits_driver_window():
     """The r04 postmortem: the default budget MUST be under the observed
     ~30-40 min driver kill window (VERDICT r4 #1b pins <= 1200s)."""

@@ -25,6 +25,12 @@ no-value either way),
 TPU_BFS_BENCH_ADAPTIVE (level-adaptive push for the hybrid/wide modes —
 default ON at the measured "8192,64"; "rows,deg" overrides, "0"/"off"
 disables; BENCHMARKS.md "Level-adaptive expansion"),
+TPU_BFS_BENCH_PULL_GATE (frontier-aware pull gate for the hybrid/wide
+modes, ISSUE 1 — "1" enables; forces adaptive push off so A/B arms stay
+clean; the result JSON gains per-level "gate_level_counts"),
+TPU_BFS_BENCH_UNATTENDED ("1" adds SIGINT to the signal envelope's
+sigwait set even on a tty; by default only SIGTERM is watched
+interactively, so Ctrl-C keeps raising KeyboardInterrupt),
 TPU_BFS_BENCH_KCAP / TPU_BFS_BENCH_TILE_THR / TPU_BFS_BENCH_A_BUDGET
 (hybrid structure sweep knobs: residual ELL bucket cap, dense-tile edge
 threshold, dense-tile byte budget; defaults 64 / 64 / 0.2e9 — the
@@ -38,6 +44,56 @@ import os
 import sys
 import threading
 import time
+
+
+def _budget_seconds() -> float:
+    """TPU_BFS_BENCH_BUDGET_S as a float — THE one parse of the knob,
+    shared by the import-time signal-mask decision and _arm_budget so the
+    '<= 0 disables the envelope' rule cannot drift between them (a
+    mismatch would block signals with no watcher installed, or vice
+    versa). A malformed value reads as the 1200 s default (envelope on);
+    _arm_budget logs the complaint once at arm time."""
+    try:
+        return float(os.environ.get("TPU_BFS_BENCH_BUDGET_S", "1200"))
+    except ValueError:
+        return 1200.0
+
+
+def _envelope_signal_set() -> tuple:
+    """The signals the outage envelope watches. SIGTERM (the driver's
+    kill) always; SIGINT only when stdout is not a tty or
+    TPU_BFS_BENCH_UNATTENDED=1 — an interactive Ctrl-C must keep raising
+    KeyboardInterrupt with a traceback instead of an rc=0 stale-echo
+    verdict line (ADVICE r5; previously only the BUDGET_S=0 debug mode
+    preserved that). Empty when TPU_BFS_BENCH_BUDGET_S <= 0, the
+    documented interactive debug mode where no signal is intercepted."""
+    import signal
+
+    if _budget_seconds() <= 0:
+        return ()
+    sigs = (signal.SIGTERM,)
+    if (
+        not sys.stdout.isatty()
+        or os.environ.get("TPU_BFS_BENCH_UNATTENDED") == "1"
+    ):
+        sigs = sigs + (signal.SIGINT,)
+    return sigs
+
+
+# The mask must be blocked BEFORE numpy's import: its BLAS pool threads
+# inherit the creating thread's mask at spawn, and the kernel may deliver
+# a process-directed SIGTERM to ANY thread that leaves it unblocked — so
+# blocking only in _install_signal_envelope (after the numpy import) left
+# the envelope armed yet unable to intercept; the signal drills died
+# rc=143 deterministically on exactly this. Script path only: under
+# pytest, bench imports as a module and the host's mask stays untouched.
+_ENVELOPE_SIGS: tuple = ()
+if __name__ == "__main__":
+    _ENVELOPE_SIGS = _envelope_signal_set()
+    if _ENVELOPE_SIGS:
+        import signal as _signal
+
+        _signal.pthread_sigmask(_signal.SIG_BLOCK, _ENVELOPE_SIGS)
 
 import numpy as np
 
@@ -194,10 +250,23 @@ def _lost_run_payload(mode: str, error: str) -> dict:
 # _log_result append) must exit with THAT outcome, not append a stale echo
 # as the new last line (scripts/has_value.py reads only the last line, so a
 # trailing echo would un-land a landed measurement — or convert an rc=1 bug
-# verdict into a rc=0 outage). There remains a microseconds window between
-# the print and this assignment; the alternative (setting it before the
-# print) risks exiting with nothing printed, which is strictly worse.
+# verdict into a rc=0 outage). The print and the assignment happen under
+# _VERDICT_LOCK, which the watcher/watchdog also take before emitting
+# their payload — closing the old microseconds window where a signal
+# between main()'s print and the assignment turned a deterministic rc=1
+# verdict into a retriable-looking rc=0 stale echo (ADVICE r5).
 _FINAL_RC: int | None = None
+_VERDICT_LOCK = threading.Lock()
+
+
+def _print_verdict(payload: dict, rc: int) -> int:
+    """main()'s verdict emission: one JSON line + the final-rc record,
+    atomically w.r.t. the watcher/watchdog payload paths."""
+    global _FINAL_RC
+    with _VERDICT_LOCK:
+        print(json.dumps(payload))
+        _FINAL_RC = rc
+    return rc
 
 
 def _install_signal_envelope(mode: str) -> None:
@@ -207,41 +276,41 @@ def _install_signal_envelope(mode: str) -> None:
     signal handler only runs when the main thread reaches bytecode — during
     an axon backend init the main thread blocks for the whole poll inside
     one C call, which is exactly when the driver's kill lands. So instead:
-    block SIGTERM/SIGINT in every thread and sigwait() them in a dedicated
-    watcher, which prints the structured verdict (stale echo when the
-    durable log has one) and exits 0 no matter what the main thread is
-    stuck in. Subprocesses unblock the inherited mask (utils/native.py).
+    the watched set (_envelope_signal_set — SIGTERM always, SIGINT only
+    for non-tty/unattended runs) is blocked in every thread at module
+    import, before numpy can spawn unmasked BLAS threads, and sigwait()ed
+    here in a dedicated watcher, which prints the structured verdict
+    (stale echo when the durable log has one) and exits 0 no matter what
+    the main thread is stuck in. Subprocesses unblock the inherited mask
+    (utils/native.py).
 
     Installed only on the script path (__main__): under pytest, main()
-    runs in-process and must not alter the host's signal mask. Skipped
-    when TPU_BFS_BENCH_BUDGET_S=0 — that is the documented interactive
-    debugging mode, where Ctrl-C must keep raising KeyboardInterrupt with
-    a traceback instead of a rc=0 verdict line."""
+    runs in-process and must not alter the host's signal mask. A no-op
+    when _ENVELOPE_SIGS is empty (TPU_BFS_BENCH_BUDGET_S=0, the
+    documented interactive debugging mode, where Ctrl-C must keep raising
+    KeyboardInterrupt with a traceback instead of a rc=0 verdict line)."""
     import signal
 
-    try:
-        if float(os.environ.get("TPU_BFS_BENCH_BUDGET_S", "1200")) <= 0:
-            return
-    except ValueError:
-        pass  # malformed value: _arm_budget defaults it, envelope stays on
-
-    sigs = (signal.SIGTERM, signal.SIGINT)
-    signal.pthread_sigmask(signal.SIG_BLOCK, sigs)
+    sigs = _ENVELOPE_SIGS
+    if not sigs:
+        return
 
     def watch() -> None:
         signum = signal.sigwait(sigs)
-        if _FINAL_RC is not None:
-            os._exit(_FINAL_RC)  # verdict already printed; preserve it
-        payload = _lost_run_payload(
-            mode,
-            f"killed by {signal.Signals(signum).name} mid-run (driver "
-            f"window closed); structured verdict emitted by the signal "
-            f"envelope",
-        )
-        # stdout may hold a partial line from the main thread; start fresh.
-        sys.stdout.write("\n" + json.dumps(payload) + "\n")
-        sys.stdout.flush()
-        os._exit(0)
+        with _VERDICT_LOCK:
+            if _FINAL_RC is not None:
+                os._exit(_FINAL_RC)  # verdict already printed; preserve it
+            payload = _lost_run_payload(
+                mode,
+                f"killed by {signal.Signals(signum).name} mid-run (driver "
+                f"window closed); structured verdict emitted by the signal "
+                f"envelope",
+            )
+            # stdout may hold a partial line from the main thread; start
+            # fresh.
+            sys.stdout.write("\n" + json.dumps(payload) + "\n")
+            sys.stdout.flush()
+            os._exit(0)
 
     threading.Thread(target=watch, daemon=True, name="signal-envelope").start()
 
@@ -252,18 +321,16 @@ def _arm_budget(mode: str) -> threading.Timer | None:
     global _DEADLINE
     _DEADLINE = None
     raw = os.environ.get("TPU_BFS_BENCH_BUDGET_S", "1200")
+    budget = _budget_seconds()  # the one shared parse (see its docstring)
     try:
-        budget = float(raw)
+        float(raw)
     except ValueError:
         log(f"TPU_BFS_BENCH_BUDGET_S={raw!r} is not a number; using 1200")
-        budget = 1200.0
     if budget <= 0:  # 0 disables the envelope (e.g. interactive debugging)
         return None
     _DEADLINE = time.monotonic() + budget
 
     def fire() -> None:
-        if _FINAL_RC is not None:
-            os._exit(_FINAL_RC)  # verdict already printed; preserve it
         # Last resort: a single attempt blocked through the whole budget.
         # Attribute honestly — "TPU unavailable" only when no backend ever
         # came up (init polling a held chip); a live backend means the run
@@ -279,13 +346,16 @@ def _arm_budget(mode: str) -> threading.Timer | None:
                 f"LIVE backend — measurement lost to the budget, not an "
                 f"outage; raise TPU_BFS_BENCH_BUDGET_S"
             )
-        # stdout may hold a partial line from the main thread; start fresh
-        # on our own line.
-        sys.stdout.write(
-            "\n" + json.dumps(_lost_run_payload(mode, error)) + "\n"
-        )
-        sys.stdout.flush()
-        os._exit(0)
+        with _VERDICT_LOCK:
+            if _FINAL_RC is not None:
+                os._exit(_FINAL_RC)  # verdict already printed; preserve it
+            # stdout may hold a partial line from the main thread; start
+            # fresh on our own line.
+            sys.stdout.write(
+                "\n" + json.dumps(_lost_run_payload(mode, error)) + "\n"
+            )
+            sys.stdout.flush()
+            os._exit(0)
 
     timer = threading.Timer(budget, fire)
     timer.daemon = True
@@ -440,6 +510,20 @@ def _env_adaptive():
         return None
     log(f"adaptive push enabled: row_cap={r} deg_cap={d}")
     return (r, d)
+
+
+def _env_pull_gate() -> bool:
+    """TPU_BFS_BENCH_PULL_GATE -> bool (default off, matching the engines'
+    default until the gate is chip-measured). When on, the adaptive-push
+    default is forced off with a log line — the engines reject the
+    combination (ISSUE 1: measure the gate against the plain scan)."""
+    raw = os.environ.get("TPU_BFS_BENCH_PULL_GATE", "").strip().lower()
+    on = raw in ("1", "on", "yes", "true")
+    if on:
+        log("pull gate enabled (TPU_BFS_BENCH_PULL_GATE)")
+    elif raw and raw not in ("0", "off", "no", "false"):
+        log(f"TPU_BFS_BENCH_PULL_GATE={raw!r} not a boolean; gate off")
+    return on
 
 
 def _is_oom(exc: BaseException) -> bool:
@@ -692,7 +776,7 @@ def _bench_batch_packed(g, graph_desc, engine, in_degree, build_log: str, label:
         if hasattr(engine, "hg"):
             _validate_tile_spmm_compiled(engine)
 
-    return {
+    result = {
         "metric": (
             f"BFS harmonic-mean per-source GTEPS ({lanes}-source {label} "
             f"MS-BFS batch), {graph_desc}, 1 chip"
@@ -701,6 +785,15 @@ def _bench_batch_packed(g, graph_desc, engine, in_degree, build_log: str, label:
         "unit": "GTEPS",
         "vs_baseline": round(gteps / 10.0, 4),
     }
+    gc = getattr(engine, "last_gate_level_counts", None)
+    if gc is not None:
+        # Per-level skipped blocks of the timed batch (ISSUE 1 acceptance:
+        # gated-tile counts in the stats JSON) — extra keys are ignored by
+        # scripts/has_value.py, which reads only "value"/"stale".
+        result["gate_level_counts"] = [
+            int(x) for x in np.asarray(gc)[: res.num_levels + 1]
+        ]
+    return result
 
 
 def bench_hybrid(g, scale: int, ef: int, graph_desc: str | None = None,
@@ -770,12 +863,18 @@ def bench_hybrid(g, scale: int, ef: int, graph_desc: str | None = None,
     # state does not fit next to the tiles; whatever width is chosen
     # appears in the metric label via engine.lanes.
     max_lanes = _env_max_lanes(default=DEFAULT_MAX_LANES)
-    # Level-adaptive push, default ON at the measured caps (see
-    # _env_adaptive; TPU_BFS_BENCH_ADAPTIVE=0 disables, "rows,deg"
-    # re-tunes); results stay oracle-validated either way.
-    adaptive = None if _shed_adaptive else _env_adaptive()
-    if adaptive is not None:
-        kw["adaptive_push"] = adaptive
+    pull_gate = _env_pull_gate()
+    if pull_gate:
+        kw["pull_gate"] = True
+        log("adaptive push off (pull gate active — A/B arms stay clean)")
+        adaptive = None
+    else:
+        # Level-adaptive push, default ON at the measured caps (see
+        # _env_adaptive; TPU_BFS_BENCH_ADAPTIVE=0 disables, "rows,deg"
+        # re-tunes); results stay oracle-validated either way.
+        adaptive = None if _shed_adaptive else _env_adaptive()
+        if adaptive is not None:
+            kw["adaptive_push"] = adaptive
 
     def run_once():
         try:
@@ -802,7 +901,9 @@ def bench_hybrid(g, scale: int, ef: int, graph_desc: str | None = None,
             f"engine build {time.perf_counter()-t0:.1f}s: tiles={hg.num_tiles} "
             f"dense={hg.num_dense_edges/max(g.num_edges,1)*100:.1f}% "
             f"a_mem={hg.a_tiles.nbytes/2**30:.2f}GiB",
-            "hybrid MXU+gather" + ("" if adaptive is None else "+adaptive-push"),
+            "hybrid MXU+gather"
+            + ("" if adaptive is None else "+adaptive-push")
+            + ("+pull-gate" if pull_gate else ""),
         )
 
     return _with_adaptive_shed(
@@ -825,8 +926,13 @@ def bench_wide(g, scale: int, ef: int, graph_desc: str | None = None,
 
     t0 = time.perf_counter()
     max_lanes = _env_max_lanes(default=WIDE_DEFAULT_MAX_LANES)
-    adaptive = None if _shed_adaptive else _env_adaptive()
-    kw = {} if adaptive is None else {"adaptive_push": adaptive}
+    pull_gate = _env_pull_gate()
+    if pull_gate:
+        log("adaptive push off (pull gate active — A/B arms stay clean)")
+        adaptive, kw = None, {"pull_gate": True}
+    else:
+        adaptive = None if _shed_adaptive else _env_adaptive()
+        kw = {} if adaptive is None else {"adaptive_push": adaptive}
 
     def run_once():
         try:
@@ -848,7 +954,9 @@ def bench_wide(g, scale: int, ef: int, graph_desc: str | None = None,
             ell.in_degree,
             f"engine build {time.perf_counter()-t0:.1f}s: slots={ell.total_slots} "
             f"(x{ell.total_slots/max(g.num_edges,1):.2f}) heavy={ell.num_heavy}",
-            "wide packed" + ("" if adaptive is None else "+adaptive-push"),
+            "wide packed"
+            + ("" if adaptive is None else "+adaptive-push")
+            + ("+pull-gate" if pull_gate else ""),
         )
 
     return _with_adaptive_shed(
@@ -1053,13 +1161,11 @@ def main() -> int:
             if watchdog is not None:
                 watchdog.cancel()
             log(str(exc))
-            print(json.dumps(_lost_run_payload(
+            return _print_verdict(_lost_run_payload(
                 mode,
                 f"TPU unavailable for {exc.unavailable_s:.0f}s "
                 f"(last: {type(exc.cause).__name__}: {str(exc.cause)[:200]})",
-            )))
-            globals()["_FINAL_RC"] = 0
-            return 0
+            ), 0)
         except Exception as exc:  # noqa: BLE001 — one-JSON-line contract
             # Deterministic failures (a sizing bug OOMing at runtime, a
             # validation mismatch) must still leave one parseable JSON
@@ -1071,15 +1177,12 @@ def main() -> int:
             import traceback
 
             traceback.print_exc()
-            print(json.dumps(_failure_payload(
+            return _print_verdict(_failure_payload(
                 mode, f"{type(exc).__name__}: {str(exc)[:300]}"
-            )))
-            globals()["_FINAL_RC"] = 1
-            return 1
+            ), 1)
         if watchdog is not None:
             watchdog.cancel()
-        print(json.dumps(result))
-        globals()["_FINAL_RC"] = 0
+        _print_verdict(result, 0)
         _log_result(result, mode)
         return 0
     finally:

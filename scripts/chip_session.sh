@@ -102,6 +102,19 @@ for i in $(seq 1 "$attempts"); do
     stage "thr32-b08" "$out/thr32_b08.json" \
       TPU_BFS_BENCH_TILE_THR=32 TPU_BFS_BENCH_A_BUDGET=8e8
     stage "thr128" "$out/thr128.json" TPU_BFS_BENCH_TILE_THR=128
+    # Pull-gate A/B (ISSUE 1): gated arms at scale 21 and 20 against
+    # plain (no adaptive push on either side, so the pairs isolate the
+    # gate; the flagship-noadaptive arm below is the scale-21 baseline
+    # and plain-s20 the scale-20 one). The gated runs ride the bench's
+    # own budget envelope like every stage; their JSON lines carry the
+    # per-level gate_level_counts the byte model is checked against.
+    stage "pullgate-s21" "$out/pullgate_s21.json" \
+      TPU_BFS_BENCH_PULL_GATE=1 TPU_BFS_BENCH_ADAPTIVE=0
+    stage "pullgate-s20" "$out/pullgate_s20.json" \
+      TPU_BFS_BENCH_SCALE=20 TPU_BFS_BENCH_PULL_GATE=1 \
+      TPU_BFS_BENCH_ADAPTIVE=0
+    stage "plain-s20" "$out/plain_s20.json" \
+      TPU_BFS_BENCH_SCALE=20 TPU_BFS_BENCH_ADAPTIVE=0
     # The probe's completion-marker line satisfies got_value, so pstage
     # gives it the same idempotent restart + timeout envelope as the
     # other helper scripts.

@@ -42,6 +42,12 @@ def classify(path: str):
 
 def main(argv) -> int:
     d = argv[1] if len(argv) > 1 else ".bench_cache/chip_session"
+    if not os.path.isdir(d):
+        # A fresh checkout (or a typo'd path) has no session directory;
+        # an uncaught FileNotFoundError traceback here read as a crash in
+        # round 5's session wrap-up (ADVICE r5).
+        print(f"no session directory at {d}", file=sys.stderr)
+        return 1
     rows, missing = [], []
     names = sorted(
         n for n in os.listdir(d) if n.endswith((".json", ".jsonl"))

@@ -29,8 +29,12 @@ def test_fence_reads_every_device_leaf(monkeypatch):
 
     reads = []
     real_asarray = np.asarray
+    # Accept np.asarray's full signature: older jax dispatches through
+    # np.asarray(x, dtype) internally while materializing the device
+    # array, and a 1-arg lambda breaks THAT call instead of counting ours.
     monkeypatch.setattr(
-        timing.np, "asarray", lambda x: reads.append(1) or real_asarray(x)
+        timing.np, "asarray",
+        lambda x, *a, **kw: reads.append(1) or real_asarray(x, *a, **kw),
     )
     out = (jnp.ones((4, 4)), jnp.arange(3), {"z": jnp.zeros(7)}, 5, "s")
     timing.fence(out)

@@ -98,8 +98,70 @@ def test_distributed_engines_agree(name, make):
 
     hyb = DistHybridMsBfsEngine(g, make_mesh(4), tile_thr=4, exchange="sliced")
     res = hyb.run(np.asarray(sources))
+    # Pull-gate arm (ISSUE 1): the gated distributed run must match the
+    # ungated one bit-for-bit through the sliced rotation.
+    hyb_g = DistHybridMsBfsEngine(
+        g, make_mesh(4), tile_thr=4, exchange="sliced", pull_gate=True
+    )
+    res_g = hyb_g.run(np.asarray(sources))
     for i, s in enumerate(sources):
         validate.check_distances(res.distances_int32(i), golden[s])
+        np.testing.assert_array_equal(
+            res.distances_int32(i), res_g.distances_int32(i)
+        )
+
+
+# Random + RMAT + directed cover the gate's distinct regimes (sparse
+# chains settle slowly, power-law hubs settle first, directed breaks the
+# in==out symmetry); the dense case adds no new gate behavior and the
+# suite must fit the tier-1 timeout now that the distributed layer runs.
+GATE_CASES = [CASES[1], CASES[2], CASES[4]]
+
+
+@pytest.mark.parametrize("name,make", GATE_CASES, ids=[c[0] for c in GATE_CASES])
+def test_pull_gate_bit_identical(name, make):
+    """ISSUE 1 acceptance: gated and ungated runs produce bit-identical
+    distances AND parents on random, RMAT, and directed shapes for the
+    single-chip engines that grow the flag (the hybrid pair runs on the
+    RMAT case — the shape its dense tiles exist for). The gate may only
+    skip work whose output the claim would discard — any divergence here
+    is a settled-mask bug."""
+    from tpu_bfs.algorithms.msbfs_hybrid import HybridMsBfsEngine
+    from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
+
+    g = make()
+    rng = np.random.default_rng(23)
+    sources = np.asarray(_sources(g, rng))
+    golden = {int(s): bfs_scipy(g, int(s)) for s in sources}
+
+    # 8 planes (254-level cap): the sparse shapes have thin chains whose
+    # eccentricity can top the default 32-level cap for unlucky sources.
+    pairs = [
+        (
+            WidePackedMsBfsEngine(g, lanes=64, num_planes=8).run(sources),
+            WidePackedMsBfsEngine(
+                g, lanes=64, num_planes=8, pull_gate=True
+            ).run(sources),
+        ),
+    ]
+    if name == "rmat":
+        pairs.append((
+            HybridMsBfsEngine(g, lanes=64, num_planes=8, tile_thr=4).run(
+                sources
+            ),
+            HybridMsBfsEngine(
+                g, lanes=64, num_planes=8, tile_thr=4, pull_gate=True
+            ).run(sources),
+        ))
+    for plain, gated in pairs:
+        for i, s in enumerate(sources):
+            np.testing.assert_array_equal(
+                plain.distances_int32(i), gated.distances_int32(i)
+            )
+            validate.check_distances(gated.distances_int32(i), golden[int(s)])
+            np.testing.assert_array_equal(
+                plain.parents_int32(i), gated.parents_int32(i)
+            )
 
 
 @pytest.mark.parametrize("name,make", [CASES[2]], ids=[CASES[2][0]])

@@ -147,7 +147,292 @@ def auto_planes(
     return preferred
 
 
-def make_packed_loop(hit_of, num_planes: int):
+# --- The pull gate (ISSUE 1): frontier-aware pull expansion. -------------
+#
+# The pull phases are frontier-independent by construction — the whole lane
+# table is scanned every level (the roofline byte model names this, see
+# utils/roofline.py phase_bytes). The gate keys every level's pull work on
+# a SETTLED mask instead: a row is settled once every ACTIVE lane (batch
+# entries that actually seeded a device row) has visited it, i.e.
+# ``vis[r] == lane_mask``. A settled row can never claim again
+# (``hit & ~vis`` is empty on every active lane, and frontier words only
+# ever carry seeded lanes' bits), so all work producing its hit — bucket
+# gathers, the fold pyramid, the permutation, the claim and plane ripple —
+# is skippable with bit-identical distances/parents. The skipped work is
+# compacted away with the exact mechanism the adaptive push already uses
+# (``jnp.where(..., size=cap)`` index tables + a dynamically-bounded fori),
+# at GATE_TILE-row block granularity so slices stay TPU-tileable.
+
+GATE_TILE = 128  # settled-mask granularity: rows per gate block
+# The block-compacted serial loop only wins when most blocks are settled;
+# at peak levels (everything active) the vectorized pass is strictly
+# better, so each gated pass falls back densely above this active
+# fraction. Pure performance policy — both branches are bit-identical.
+GATE_DENSE_DEN = 4  # gated path only when active blocks <= total / 4
+
+
+def host_lane_mask(rows_of_sources: np.ndarray, act: int, w: int) -> np.ndarray:
+    """[w] uint32 active-lane mask for the pull gate: the OR of every
+    non-isolated batch entry's (word, bit) seed slot (same keep rule as
+    seed_scatter_args; word-major, the lane map every gated engine uses).
+    Lanes outside the batch — and isolated-source lanes, which never touch
+    the device — are vacuously settled. All-ones is always a SAFE
+    fallback: an over-wide mask only delays settling, never changes
+    results (a too-NARROW mask would skip live claims, so the mask must
+    cover every seeded lane)."""
+    ranks = np.asarray(rows_of_sources, dtype=np.int64)
+    lanes = np.arange(len(ranks))
+    keep = ranks < act
+    mask = np.zeros(w, np.uint32)
+    np.bitwise_or.at(
+        mask,
+        lanes[keep] // 32,
+        np.uint32(1) << (lanes[keep] % 32).astype(np.uint32),
+    )
+    return mask
+
+
+def row_unsettled(vis, act: int, lane_mask):
+    """[rows] bool: True where a real row (< ``act``) still has an active
+    lane unvisited — the row can still claim, so its pull work must run."""
+    uns = jnp.any((~vis & lane_mask[None, :]) != 0, axis=1)
+    rows = vis.shape[0]
+    return uns & (jax.lax.iota(jnp.int32, rows) < act)
+
+
+def make_gated_fori_expand(spec: "ExpandSpec", w: int, *, combine=None,
+                           identity: int = 0):
+    """Frontier-gated bucketed-ELL expansion — make_fori_expand's shape,
+    keyed on a per-bucket-output-row ``needed`` vector.
+
+    Light buckets process only the GATE_TILE-row blocks holding a needed
+    row (compacted block ids + a dynamically-bounded fori, each block
+    sliced out of the padded ``light{i}_gt`` table —
+    graph/ell.pad_gate_blocks); the heavy virtual/fold-pyramid section is
+    skipped outright once every heavy destination row has settled (hubs
+    settle first on power-law graphs, so the whole-section skip captures
+    the win without per-virtual-row bookkeeping). Every gated pass falls
+    back to the dense form via lax.cond when most blocks are still active
+    (GATE_DENSE_DEN). Skipped rows come out as ``identity`` — exactly the
+    value whose claim the caller masks away.
+
+    Returns ``expand(arrs, fw, needed) -> (outputs, skipped_blocks)``.
+    """
+    if combine is None:
+        combine = jnp.bitwise_or
+    ident = jnp.uint32(identity)
+    T = GATE_TILE
+
+    def _full(shape):
+        return jnp.full(shape, ident, jnp.uint32)
+
+    heavy_blocks = -(-spec.num_virtual // T) if spec.heavy else 0
+
+    def expand(arrs, fw, needed):
+        parts = []
+        skipped = jnp.int32(0)
+        off = 0
+        if spec.heavy:
+            nh = arrs["heavy_pick"].shape[0]
+            vr_t = arrs["virtual_t"]
+
+            def heavy_section():
+                def vbody(kk, acc):
+                    return combine(acc, fw[vr_t[kk]])
+
+                acc = jax.lax.fori_loop(
+                    0, spec.kcap, vbody, _full((spec.num_virtual, w))
+                )
+                vr_ext = jnp.concatenate([acc, _full((1, w))])
+                cur = vr_ext[arrs["fold_pad_map"]]
+                pyramid = [cur]
+                for _ in range(spec.fold_steps):
+                    pairs = cur.reshape(-1, 2, w)
+                    cur = combine(pairs[:, 0], pairs[:, 1])
+                    pyramid.append(cur)
+                pyr = (
+                    jnp.concatenate(pyramid) if len(pyramid) > 1 else pyramid[0]
+                )
+                return pyr[arrs["heavy_pick"]]
+
+            h_need = jnp.any(needed[:nh])
+            parts.append(
+                jax.lax.cond(h_need, heavy_section, lambda: _full((nh, w)))
+            )
+            skipped = skipped + jnp.where(h_need, 0, heavy_blocks)
+            off = nh
+        for i, (k, n) in enumerate(spec.light_meta):
+            bt = arrs[f"light{i}_t"]  # [k, n]
+            gt = arrs[f"light{i}_gt"]  # [k, nb*T] sentinel-padded
+            nb = gt.shape[1] // T
+            need = needed[off : off + n]
+            pad = nb * T - n
+            if pad:
+                need = jnp.concatenate([need, jnp.zeros((pad,), bool)])
+            blk = jnp.any(need.reshape(nb, T), axis=1)
+            nzb = jnp.sum(blk.astype(jnp.int32))
+            take_gated = nzb * GATE_DENSE_DEN <= nb
+
+            def dense_pass(bt=bt, k=k, n=n):
+                def lbody(kk, acc):
+                    return combine(acc, fw[bt[kk]])
+
+                return jax.lax.fori_loop(0, k, lbody, _full((n, w)))
+
+            def gated_pass(gt=gt, k=k, n=n, nb=nb, blk=blk, nzb=nzb):
+                idx = jnp.where(blk, size=nb, fill_value=0)[0]
+
+                def bbody(j, acc):
+                    b = idx[j]
+                    cols = jax.lax.dynamic_slice(gt, (0, b * T), (k, T))
+
+                    def kbody(kk, a):
+                        return combine(a, fw[cols[kk]])
+
+                    ablk = jax.lax.fori_loop(0, k, kbody, _full((T, w)))
+                    return jax.lax.dynamic_update_slice(acc, ablk, (b * T, 0))
+
+                acc = jax.lax.fori_loop(0, nzb, bbody, _full((nb * T, w)))
+                return acc[:n]
+
+            parts.append(jax.lax.cond(take_gated, gated_pass, dense_pass))
+            skipped = skipped + jnp.where(take_gated, nb - nzb, 0)
+            off += n
+        if spec.tail_rows:
+            parts.append(_full((spec.tail_rows, w)))
+        out = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+        return out, skipped
+
+    return expand
+
+
+def gated_state_update(hit, vis, planes, need_rows):
+    """Claim + visited-OR + plane ripple over only the GATE_TILE row blocks
+    still holding an unsettled row — the pull gate's state pass.
+
+    Skipped blocks are bit-identical to the dense update on everything any
+    extraction reads: their claim is zero (settled rows' ``hit & ~vis`` is
+    empty on every active lane), visited is unchanged, and the only plane
+    bits the dense ripple would still move there belong to inactive lanes
+    or pad rows — positions no distance extraction ever decodes. The
+    ragged tail block (< GATE_TILE rows; sentinel/pad rows live there)
+    always updates densely. Falls back to the one-shot dense update via
+    lax.cond when most blocks are active (GATE_DENSE_DEN).
+
+    Returns ``(nxt, vis2, planes2)``.
+    """
+    T = GATE_TILE
+    rows, w = vis.shape
+    nt = rows // T
+    tail = rows - nt * T
+
+    def dense():
+        nxt = hit & ~vis
+        vis2 = vis | nxt
+        return nxt, vis2, ripple_increment(planes, ~vis2)
+
+    if nt == 0:
+        return dense()
+    blk = jnp.any(need_rows[: nt * T].reshape(nt, T), axis=1)
+    nzt = jnp.sum(blk.astype(jnp.int32))
+
+    def gated():
+        idx = jnp.where(blk, size=nt, fill_value=0)[0]
+
+        def bbody(j, carry):
+            nxt, vis2, pl = carry
+            off = idx[j] * T
+            h = jax.lax.dynamic_slice(hit, (off, 0), (T, w))
+            v = jax.lax.dynamic_slice(vis2, (off, 0), (T, w))
+            nx = h & ~v
+            v2 = v | nx
+            p_t = tuple(
+                jax.lax.dynamic_slice(p, (off, 0), (T, w)) for p in pl
+            )
+            p2 = ripple_increment(p_t, ~v2)
+            return (
+                jax.lax.dynamic_update_slice(nxt, nx, (off, 0)),
+                jax.lax.dynamic_update_slice(vis2, v2, (off, 0)),
+                tuple(
+                    jax.lax.dynamic_update_slice(p, q, (off, 0))
+                    for p, q in zip(pl, p2)
+                ),
+            )
+
+        nxt, vis2, pl = jax.lax.fori_loop(
+            0, nzt, bbody, (jnp.zeros_like(vis), vis, planes)
+        )
+        if tail:
+            h = hit[nt * T :]
+            v = vis2[nt * T :]
+            nx = h & ~v
+            v2 = v | nx
+            p2 = ripple_increment(tuple(p[nt * T :] for p in pl), ~v2)
+            nxt = jax.lax.dynamic_update_slice(nxt, nx, (nt * T, 0))
+            vis2 = jax.lax.dynamic_update_slice(vis2, v2, (nt * T, 0))
+            pl = tuple(
+                jax.lax.dynamic_update_slice(p, q, (nt * T, 0))
+                for p, q in zip(pl, p2)
+            )
+        return nxt, vis2, pl
+
+    return jax.lax.cond(nzt * GATE_DENSE_DEN <= nt, gated, dense)
+
+
+class PullGateHost:
+    """Mixin for pull-gated packed engines: host-side lane-mask bookkeeping
+    plus the single-chip core wrappers that thread the mask into the gated
+    jitted loop and record the per-level skipped-block counters
+    (``last_gate_level_counts`` — same host-attribute idiom as the
+    distributed engines' exchange accounting, collectives.py). Hosts set
+    ``pull_gate``, ``_gate_core_jit`` / ``_gate_core_from_jit``
+    (make_packed_loop gated entries), ``_lane_mask_dev`` (all-ones until
+    the first batch refines it — always safe, see host_lane_mask), and the
+    engine-protocol attributes ``_rank`` / ``_act`` / ``w``."""
+
+    pull_gate = False
+    last_gate_level_counts = None
+
+    def _note_batch_sources(self, sources) -> None:
+        if not self.pull_gate:
+            return
+        rows = np.asarray(self._rank)[np.asarray(sources, dtype=np.int64)]
+        self._lane_mask_dev = jnp.asarray(
+            host_lane_mask(rows, self._act, self.w)
+        )
+
+    def _gated_core(self, arrs, fw0, max_levels):
+        planes, vis, levels, alive, truncated, gc = self._gate_core_jit(
+            arrs, fw0, max_levels, self._lane_mask_dev
+        )
+        # Kept as a device array so the record costs nothing inside a
+        # timed batch; np.asarray it at read time (stats/CLI do).
+        self.last_gate_level_counts = gc
+        return planes, vis, levels, alive, truncated
+
+    def _gated_core_from(self, arrs, fw, vis, planes, level0, max_levels):
+        fw_f, vis_f, planes_f, level, alive, gc = self._gate_core_from_jit(
+            arrs, fw, vis, planes, level0, max_levels, self._lane_mask_dev
+        )
+        self.last_gate_level_counts = gc
+        return fw_f, vis_f, planes_f, level, alive
+
+    def _core_from_probe(self, arrs, fw, vis, planes, level0, max_levels):
+        """advance's cap-boundary probe entry: the same gated loop, minus
+        the counter record — the probe's one boundary body must not
+        clobber the real run's per-level counts. Ungated instances
+        delegate to the exact pre-gate probe resolution (raw jitted loop
+        where the engine has one, else _core_from)."""
+        if not self.pull_gate:
+            fn = getattr(self, "_core_from_jit", None) or self._core_from
+            return fn(arrs, fw, vis, planes, level0, max_levels)
+        return self._gate_core_from_jit(
+            arrs, fw, vis, planes, level0, max_levels, self._lane_mask_dev
+        )[:5]
+
+
+def make_packed_loop(hit_of, num_planes: int, *, gate_levels: int = 0,
+                     act: int | None = None):
     """The level loop shared by the wide and hybrid engines, as two jitted
     entry points over one body:
 
@@ -162,28 +447,49 @@ def make_packed_loop(hit_of, num_planes: int):
     ``hit_of(arrs, fw)`` is the engine's one-level frontier expansion
     (gather-only for the wide engine; MXU tiles + gather residual +
     permutation for the hybrid).
-    """
 
-    def _run(arrs, fw, vis, planes, level0, max_levels):
+    With ``gate_levels`` > 0 the loop runs in PULL-GATED mode (``act``
+    required): ``hit_of(arrs, fw, vis, lane_mask)`` returns
+    ``(hit, skipped_blocks)``, both entry points take a trailing
+    ``lane_mask`` argument (host_lane_mask), the state pass runs gated
+    over unsettled GATE_TILE blocks (gated_state_update), and both return
+    a trailing [gate_levels] int32 per-level skipped-block array.
+    """
+    gated = gate_levels > 0
+    if gated and act is None:
+        raise ValueError("gated make_packed_loop needs act (real row count)")
+
+    def call_hit(arrs, fw, vis, lane_mask):
+        if gated:
+            return hit_of(arrs, fw, vis, lane_mask)
+        return hit_of(arrs, fw), jnp.int32(0)
+
+    def _run(arrs, fw, vis, planes, level0, max_levels, lane_mask, gc):
         def cond(carry):
-            _, _, _, level, alive = carry
+            _, _, _, level, alive, _ = carry
             return alive & (level < max_levels)
 
         def body(carry):
-            fw, vis, planes, level, _ = carry
-            nxt = hit_of(arrs, fw) & ~vis
-            vis2 = vis | nxt
-            # Pad/sentinel rows count up harmlessly (never visited, sliced
-            # off at extraction).
-            planes = ripple_increment(planes, ~vis2)
+            fw, vis, planes, level, _, gc = carry
+            hit, skipped = call_hit(arrs, fw, vis, lane_mask)
+            if gated:
+                need = row_unsettled(vis, act, lane_mask)
+                nxt, vis2, planes = gated_state_update(hit, vis, planes, need)
+                gc = gc.at[jnp.minimum(level, gate_levels - 1)].set(skipped)
+            else:
+                nxt = hit & ~vis
+                vis2 = vis | nxt
+                # Pad/sentinel rows count up harmlessly (never visited,
+                # sliced off at extraction).
+                planes = ripple_increment(planes, ~vis2)
             alive = jnp.any(nxt != 0)
-            return nxt, vis2, planes, level + 1, alive
+            return nxt, vis2, planes, level + 1, alive, gc
 
         return jax.lax.while_loop(
-            cond, body, (fw, vis, planes, level0, jnp.bool_(True))
+            cond, body, (fw, vis, planes, level0, jnp.bool_(True), gc)
         )
 
-    def _truncated(arrs, fw_f, vis_f, levels, alive, max_levels):
+    def _truncated(arrs, fw_f, vis_f, levels, alive, max_levels, lane_mask):
         # `alive` only says the last body claimed something. When the loop
         # exits at the cap, distances <= max_levels are all labeled
         # correctly; the traversal is incomplete only if one MORE level
@@ -191,24 +497,53 @@ def make_packed_loop(hit_of, num_planes: int):
         # expand, so a traversal whose eccentricity lands exactly on the
         # cap does not falsely report truncation.
         def deeper():
-            return jnp.any((hit_of(arrs, fw_f) & ~vis_f) != 0)
+            hit = call_hit(arrs, fw_f, vis_f, lane_mask)[0]
+            return jnp.any((hit & ~vis_f) != 0)
 
         return jax.lax.cond(
             alive & (levels >= max_levels), deeper, lambda: jnp.bool_(False)
         )
 
+    def _gc0():
+        return jnp.zeros((max(gate_levels, 1),), jnp.int32)
+
+    if gated:
+
+        @jax.jit
+        def core(arrs, fw0, max_levels, lane_mask):
+            planes0 = tuple(jnp.zeros_like(fw0) for _ in range(num_planes))
+            fw_f, vis_f, planes_f, levels, alive, gc = _run(
+                arrs, fw0, fw0, planes0, jnp.int32(0), max_levels,
+                lane_mask, _gc0(),
+            )
+            truncated = _truncated(
+                arrs, fw_f, vis_f, levels, alive, max_levels, lane_mask
+            )
+            return planes_f, vis_f, levels, alive, truncated, gc
+
+        @jax.jit
+        def core_from(arrs, fw, vis, planes, level0, max_levels, lane_mask):
+            return _run(
+                arrs, fw, vis, planes, level0, max_levels, lane_mask, _gc0()
+            )
+
+        return core, core_from
+
     @jax.jit
     def core(arrs, fw0, max_levels):
         planes0 = tuple(jnp.zeros_like(fw0) for _ in range(num_planes))
-        fw_f, vis_f, planes_f, levels, alive = _run(
-            arrs, fw0, fw0, planes0, jnp.int32(0), max_levels
+        fw_f, vis_f, planes_f, levels, alive, _ = _run(
+            arrs, fw0, fw0, planes0, jnp.int32(0), max_levels, None, _gc0()
         )
-        truncated = _truncated(arrs, fw_f, vis_f, levels, alive, max_levels)
+        truncated = _truncated(
+            arrs, fw_f, vis_f, levels, alive, max_levels, None
+        )
         return planes_f, vis_f, levels, alive, truncated
 
     @jax.jit
     def core_from(arrs, fw, vis, planes, level0, max_levels):
-        return _run(arrs, fw, vis, planes, level0, max_levels)
+        out = _run(arrs, fw, vis, planes, level0, max_levels, None, _gc0())
+        return out[:5]
 
     return core, core_from
 
@@ -670,7 +1005,7 @@ class PackedBatchResult:
                 f"out is {out.shape}, need ({n}, {self._engine.num_vertices})"
             )
         host_serves = getattr(self._engine, "host_graph", None) is not None
-        # Above ~1e5 rows x lanes the host path stops being interactive
+        # Above ~1e5 lanes x vertices the host path stops being interactive
         # (the flagship 8192-lane scale-21 batch prices at ~an hour); an
         # OOM fallback there must be loud (VERDICT r4 weak #4).
         work_desc = (
@@ -1035,6 +1370,12 @@ def advance_packed_batch(engine, ckpt, levels: int | None = None):
         )
     if not ckpt.alive:
         return ckpt
+    # Pull-gated engines derive their active-lane mask from the batch's
+    # sources (host_lane_mask) before any core dispatch; other engines
+    # have no hook and skip this.
+    note = getattr(engine, "_note_batch_sources", None)
+    if note is not None:
+        note(ckpt.sources)
     cap = engine.max_levels_cap
     ml = min(ckpt.level + levels, cap) if levels is not None else cap
     to_fw, from_fw = _fw_hooks(engine)
@@ -1070,7 +1411,14 @@ def advance_packed_batch(engine, ckpt, levels: int | None = None):
         # extra gather is the same documented modeling gap as the
         # distributed hybrid's claim-free check
         # (collectives.record_row_gather_exchange).
-        probe_fn = getattr(engine, "_core_from_jit", None) or engine._core_from
+        # Gated engines expose _core_from_probe for the same reason (their
+        # raw jitted loop takes the extra lane-mask argument, and the
+        # probe must not clobber the run's gate counters).
+        probe_fn = (
+            getattr(engine, "_core_from_probe", None)
+            or getattr(engine, "_core_from_jit", None)
+            or engine._core_from
+        )
         out = probe_fn(
             engine.arrs, fw_f, vis_f, planes_f,
             jnp.int32(int(level)), jnp.int32(int(level) + 1),
@@ -1176,6 +1524,11 @@ def run_packed_batch(
 ) -> PackedBatchResult:
     """Generic batch driver shared by the wide and hybrid engines."""
     sources = _check_batch_sources(engine, sources)
+    # Same pull-gate hook as advance_packed_batch: the gated cores need
+    # the batch's active-lane mask before dispatch.
+    note = getattr(engine, "_note_batch_sources", None)
+    if note is not None:
+        note(sources)
     cap = engine.max_levels_cap
     max_levels = cap if max_levels is None else min(max_levels, cap)
 

@@ -87,6 +87,69 @@ def make_tiles_expand(vt: int):
     return tiles_expand
 
 
+def make_gated_tiles_expand(vt: int, num_tiles: int):
+    """Pull-gated form of make_tiles_expand (ISSUE 1): process only tiles
+    whose source column-tile holds a frontier bit AND whose destination
+    row-tile is not fully visited.
+
+    The source half is EXACT (an empty frontier column-tile contributes
+    nothing); the destination half is claim-masked like the packed
+    engines' settled rows (the caller ANDs the pass with ``~visited``), so
+    both gates are bit-identical to the dense pass. Tiles are compacted
+    with the shared ``jnp.where(size=...)`` + bounded-fori mechanism; the
+    dense pass takes over via lax.cond when most tiles are active
+    (_packed_common.GATE_DENSE_DEN — at peak levels the serial per-tile
+    loop would forfeit the vectorized pass's throughput).
+
+    Returns ``expand(a_tiles, col_t, seg, fb, visited) ->
+    ([vt*TILE] bool hits, skipped_tiles int32)``.
+    """
+    from tpu_bfs.algorithms._packed_common import GATE_DENSE_DEN
+
+    dense_expand = make_tiles_expand(vt)
+
+    def expand(a_tiles, col_t, seg, fb, visited):
+        shifts = jnp.arange(32, dtype=jnp.uint32)
+        src_on = jnp.any(fb, axis=1)[col_t]
+        dst_done = jnp.all(visited.reshape(vt, TILE), axis=1)[seg]
+        on = src_on & ~dst_done
+        nz = jnp.sum(on.astype(jnp.int32))
+
+        def dense():
+            return dense_expand(a_tiles, col_t, seg, fb), jnp.int32(0)
+
+        def gated():
+            idx = jnp.where(on, size=num_tiles, fill_value=0)[0]
+
+            def body(j, hit):
+                t = idx[j]
+                sel = a_tiles[t] & jnp.where(
+                    fb[col_t[t]][None, :],
+                    jnp.uint32(0xFFFFFFFF),
+                    jnp.uint32(0),
+                )
+                red = sel  # [AW, TILE] -> [AW] by tree halving
+                while red.shape[-1] > 1:
+                    half = red.shape[-1] // 2
+                    red = red[..., :half] | red[..., half:]
+                red = red[..., 0]
+                # Same r = bit*AW + word layout as the dense pass.
+                contrib = (
+                    ((red[None, :] >> shifts[:, None]) & 1) > 0
+                ).reshape(TILE)
+                rt = seg[t]
+                return hit.at[rt].set(hit[rt] | contrib)
+
+            hit = jax.lax.fori_loop(
+                0, nz, body, jnp.zeros((vt, TILE), jnp.bool_)
+            )
+            return hit.reshape(-1), num_tiles - nz
+
+        return lax.cond(nz * GATE_DENSE_DEN <= num_tiles, gated, dense)
+
+    return expand
+
+
 class TiledBfsEngine:
     """Single-source BFS: dopt ladder + dense-tile bitset heavy levels.
 
@@ -102,6 +165,7 @@ class TiledBfsEngine:
         tile_thr: int = 32,
         a_budget_bytes: int = int(0.8e9),
         dopt_caps: tuple[int, ...] | None = None,
+        pull_gate: bool = False,
     ):
         # Defaults are the measured scale-21 knee (BENCHMARKS.md): thr=32 /
         # 0.8 GB reaches 67% dense coverage at hmean 0.030 GTEPS; doubling
@@ -131,7 +195,19 @@ class TiledBfsEngine:
         self._a = jnp.asarray(a_tiles)
         self._col_t = jnp.asarray((uniq % vt).astype(np.int32))
         self._seg = jnp.asarray((uniq // vt).astype(np.int32))
+        # Pull gate (ISSUE 1): frontier/visited-aware tile pass; default
+        # off until chip-measured. ``last_gate_skipped_tiles`` records the
+        # skipped-tile total of the most recent loop dispatch — a whole
+        # run(), or ONE advance() segment of a checkpointed traversal
+        # (segments overwrite, they do not accumulate across a chain).
+        self.pull_gate = pull_gate
+        self.last_gate_skipped_tiles: int | None = None
         self._tiles_expand = make_tiles_expand(vt)
+        self._gated_tiles_expand = (
+            make_gated_tiles_expand(vt, self.num_tiles)
+            if pull_gate and self.num_tiles
+            else None
+        )
 
         # Full adjacency, src-major: the sparse top-down branches.
         order_sm = _lexsort_pairs(c, r, rows, rows)
@@ -161,8 +237,10 @@ class TiledBfsEngine:
     def _make_loop(self):
         rows, vt = self.rows, self.vt
         tiles_expand = self._tiles_expand
+        gated_tiles_expand = self._gated_tiles_expand
         caps = self.dopt_caps
         has_tiles = self.num_tiles > 0
+        gated = gated_tiles_expand is not None
 
         def level(edges, tiles, frontier, visited):
             # The shared dopt rung ladder (frontier.level_step_dopt): sparse
@@ -170,6 +248,7 @@ class TiledBfsEngine:
             # is the edge-centric scan over the RESIDUAL in-CSR only (this
             # engine's edges.src/dst/in_rp hold just the residual edges).
             hit = level_step_dopt(edges, frontier, visited, caps=caps)
+            skipped = jnp.int32(0)
             if has_tiles:
                 # The tile pass sits in its own single cond, firing exactly
                 # when the dense fallback fires (no rung fits — fits() is
@@ -185,16 +264,31 @@ class TiledBfsEngine:
                     (fsum <= top) & (nfront <= min(top, rows))
                 )
                 a, col_t, seg = tiles
-                hit = lax.cond(
-                    dense_level,
-                    lambda: hit
-                    | (
-                        tiles_expand(a, col_t, seg, frontier.reshape(vt, TILE))
-                        & ~visited
-                    ),
-                    lambda: hit,
-                )
-            return hit
+                if gated:
+                    def tile_pass():
+                        th, sk = gated_tiles_expand(
+                            a, col_t, seg, frontier.reshape(vt, TILE),
+                            visited,
+                        )
+                        return hit | (th & ~visited), sk
+
+                    hit, skipped = lax.cond(
+                        dense_level, tile_pass,
+                        lambda: (hit, jnp.int32(0)),
+                    )
+                else:
+                    hit = lax.cond(
+                        dense_level,
+                        lambda: hit
+                        | (
+                            tiles_expand(
+                                a, col_t, seg, frontier.reshape(vt, TILE)
+                            )
+                            & ~visited
+                        ),
+                        lambda: hit,
+                    )
+            return hit, skipped
 
         # Edge/tile arrays are jit ARGUMENTS, not closure constants: baked-in
         # constants get serialized into the compile request (hundreds of MB
@@ -202,23 +296,34 @@ class TiledBfsEngine:
         # ``level0`` makes this the checkpoint-resume entry too: the
         # while-loop carry IS the traversal state, so resuming from a saved
         # (frontier, visited, dist, level) is bit-identical to no stop.
+        # In gated mode the carry (and return) grows a skipped-tile total.
         @jax.jit
         def loop(edges, tiles, frontier0, visited0, dist0, level0, max_levels):
             def cond(state):
-                _, _, _, lvl, count = state
+                lvl, count = state[3], state[4]
                 return (count > 0) & (lvl < max_levels)
 
             def body(state):
-                frontier, visited, dist, lvl, _ = state
-                nxt = level(edges, tiles, frontier, visited)
+                frontier, visited, dist, lvl, _ = state[:5]
+                nxt, skipped = level(edges, tiles, frontier, visited)
                 dist = jnp.where(nxt, lvl + 1, dist)
                 visited = visited | nxt
-                return nxt, visited, dist, lvl + 1, jnp.sum(nxt.astype(jnp.int32))
+                out = (
+                    nxt, visited, dist, lvl + 1,
+                    jnp.sum(nxt.astype(jnp.int32)),
+                )
+                if gated:
+                    out = out + (state[5] + skipped,)
+                return out
 
             init = jnp.sum(frontier0.astype(jnp.int32))
-            frontier, visited, dist, lvl, _ = lax.while_loop(
-                cond, body, (frontier0, visited0, dist0, level0, init)
-            )
+            state0 = (frontier0, visited0, dist0, level0, init)
+            if gated:
+                state0 = state0 + (jnp.int32(0),)
+            out = lax.while_loop(cond, body, state0)
+            frontier, visited, dist, lvl = out[:4]
+            if gated:
+                return frontier, visited, dist, lvl, out[5]
             return frontier, visited, dist, lvl
 
         return loop
@@ -257,10 +362,13 @@ class TiledBfsEngine:
 
         elapsed = None
         if time_it:
-            (_, _, dist_dev, _), elapsed = run_timed(go, warm=not self._warmed)
+            out, elapsed = run_timed(go, warm=not self._warmed)
             self._warmed = True
         else:
-            _, _, dist_dev, _ = go()
+            out = go()
+        dist_dev = out[2]
+        if self.pull_gate and self.num_tiles:
+            self.last_gate_skipped_tiles = int(out[4])
 
         dr = np.asarray(dist_dev)
         live = self._rank < self._act
@@ -320,11 +428,14 @@ class TiledBfsEngine:
         d0 = np.full(self.rows, INT32_MAX, np.int32)
         d0[rows_live] = ckpt.distance[live]  # INF_DIST == INT32_MAX
         cap = ckpt.level + levels if levels is not None else self.rows
-        frontier, visited, dist, level = self._loop(
+        out = self._loop(
             self._edges, (self._a, self._col_t, self._seg),
             jnp.asarray(f0), jnp.asarray(vis0), jnp.asarray(d0),
             jnp.int32(ckpt.level), jnp.int32(min(cap, self.rows)),
         )
+        frontier, visited, dist, level = out[:4]
+        if self.pull_gate and self.num_tiles:
+            self.last_gate_skipped_tiles = int(out[4])
         fr, vr, dr = (np.asarray(a) for a in (frontier, visited, dist))
         f_v = np.zeros(self.num_vertices, dtype=bool)
         f_v[live] = fr[rows_live]

@@ -44,9 +44,16 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpu_bfs.graph.csr import Graph
-from tpu_bfs.graph.ell import EllBucket, bucketize_rows, rank_vertices
+from tpu_bfs.graph.ell import (
+    EllBucket,
+    bucketize_rows,
+    gate_forward_map,
+    pad_gate_blocks,
+    rank_vertices,
+)
 from tpu_bfs.algorithms._packed_common import (
     ExpandSpec,
+    PullGateHost,
     advance_packed_batch,
     auto_lanes,
     auto_planes,
@@ -56,8 +63,10 @@ from tpu_bfs.algorithms._packed_common import (
     floor_lanes,
     make_adaptive_hit,
     make_fori_expand,
+    make_gated_fori_expand,
     make_packed_loop,
     make_state_kernels,
+    row_unsettled,
     run_packed_batch,
     seed_scatter_args,
     start_packed_batch,
@@ -319,17 +328,45 @@ def expand_spec(hg: HybridGraph) -> ExpandSpec:
 
 
 def _make_core(hg: HybridGraph, w: int, num_planes: int, interpret: bool,
-               push_cfg=None):
-    expand_residual = make_fori_expand(expand_spec(hg), w)
+               push_cfg=None, gate_levels: int = 0):
     has_dense = hg.num_tiles > 0
+
+    def dense_pass(arrs, fw):
+        return tile_spmm(
+            arrs["row_start"], arrs["col_tile"], arrs["a_tiles"], fw,
+            num_row_tiles=hg.vt, w=w, interpret=interpret,
+        )
+
+    if gate_levels:
+        # Pull gate (ISSUE 1): residual bucket outputs live in r_order, so
+        # the per-rank0-row unsettled mask routes through the build-time
+        # forward map (gate_forward_map) before keying the gated buckets.
+        # The dense MXU pass stays ungated — its tiles are already the
+        # compacted hot set, and the Pallas grid takes no dynamic tile
+        # list; its hits on settled rows are claim-masked like any other.
+        gated_residual = make_gated_fori_expand(expand_spec(hg), w)
+
+        def hit_of(arrs, fw, vis, lane_mask):
+            need = row_unsettled(vis, hg.num_active, lane_mask)
+            need_ext = jnp.concatenate([need, jnp.zeros((1,), bool)])
+            res, skipped = gated_residual(
+                arrs, fw, need_ext[arrs["gate_fwd"]]
+            )
+            hit = res[arrs["inv_perm_ext"]]
+            if has_dense:
+                hit = hit | dense_pass(arrs, fw)
+            return hit, skipped
+
+        return make_packed_loop(
+            hit_of, num_planes, gate_levels=gate_levels, act=hg.num_active
+        )
+
+    expand_residual = make_fori_expand(expand_spec(hg), w)
 
     def hit_of(arrs, fw):
         hit = expand_residual(arrs, fw)[arrs["inv_perm_ext"]]
         if has_dense:
-            hit = hit | tile_spmm(
-                arrs["row_start"], arrs["col_tile"], arrs["a_tiles"], fw,
-                num_row_tiles=hg.vt, w=w, interpret=interpret,
-            )
+            hit = hit | dense_pass(arrs, fw)
         return hit
 
     if push_cfg is not None:
@@ -342,12 +379,18 @@ def _make_core(hg: HybridGraph, w: int, num_planes: int, interpret: bool,
     return make_packed_loop(hit_of, num_planes)
 
 
-class HybridMsBfsEngine:
+class HybridMsBfsEngine(PullGateHost):
     """Up to 8192 concurrent BFS sources by default (DEFAULT_MAX_LANES,
     the round-4 measured optimum; ``max_lanes`` moves the cap in 4096-lane
     steps up to MAX_LANES, and auto sizing walks down when the state
     doesn't fit); dense tiles on the MXU, residual on gathers. API mirrors
-    WidePackedMsBfsEngine; results are PackedBatchResult."""
+    WidePackedMsBfsEngine; results are PackedBatchResult.
+
+    ``pull_gate=True`` (default off until chip-measured) keys the residual
+    scan and the state passes on the per-row settled mask — late levels
+    stop paying the whole-table pull bill; per-level skipped blocks land
+    in ``last_gate_level_counts``. Bit-identical to the plain scan; the
+    dense MXU pass stays ungated (see _make_core)."""
 
     def __init__(
         self,
@@ -363,10 +406,18 @@ class HybridMsBfsEngine:
         hbm_budget_bytes: int = int(14.0e9),
         max_lanes: int = DEFAULT_MAX_LANES,
         adaptive_push: tuple[int, int] | None = None,
+        pull_gate: bool = False,
     ):
         if num_planes != "auto" and not (1 <= num_planes <= 8):
             # Validate the explicit case before the minutes-long build.
             raise ValueError("num_planes must be in [1, 8]")
+        if pull_gate and adaptive_push is not None:
+            # Same rule as the wide engine: both gate the per-level scan,
+            # by different keys — measure the pull gate against the plain
+            # scan first (ISSUE 1's A/B stage) before composing.
+            raise ValueError(
+                "pull_gate and adaptive_push cannot combine (yet): pick one"
+            )
         if max_lanes % 32 or not (32 <= max_lanes <= MAX_LANES):
             # Same early-validation rule: a bad width cap must fail in
             # seconds, not after the build (and auto_lanes would otherwise
@@ -486,12 +537,37 @@ class HybridMsBfsEngine:
             )
             arrs["push_t"] = jnp.asarray(pt)
             arrs["push_inelig"] = jnp.asarray(inelig)
-        self.arrs = arrs
         self._act = hg.num_active
         self._table_rows = hg.vt * TILE
-        self._core, self._core_from = _make_core(
-            hg, self.w, num_planes, interpret, adaptive_push
-        )
+        self.pull_gate = pull_gate
+        if pull_gate:
+            # Gate tables: sentinel-padded whole-block bucket indices (the
+            # residual pad row vt*TILE-1 stays all-zero) and the forward
+            # routing map bucket-position -> rank0 row (graph/ell.py).
+            sentinel = hg.vt * TILE - 1
+            for i, b in enumerate(hg.res_light):
+                arrs[f"light{i}_gt"] = jnp.asarray(
+                    pad_gate_blocks(np.ascontiguousarray(b.idx.T), sentinel)
+                )
+            num_real = hg.res_heavy + sum(b.n for b in hg.res_light)
+            out_height = num_real + hg.res_tail_rows
+            arrs["gate_fwd"] = jnp.asarray(
+                gate_forward_map(hg.inv_perm_ext, out_height, num_real)
+            )
+            self._lane_mask_dev = jnp.full(
+                (self.w,), 0xFFFFFFFF, jnp.uint32
+            )
+            self._gate_core_jit, self._gate_core_from_jit = _make_core(
+                hg, self.w, num_planes, interpret,
+                gate_levels=self.max_levels_cap,
+            )
+            self._core = self._gated_core
+            self._core_from = self._gated_core_from
+        else:
+            self._core, self._core_from = _make_core(
+                hg, self.w, num_planes, interpret, adaptive_push
+            )
+        self.arrs = arrs
         in_deg_ranked = hg.in_degree[hg.old_of_new].astype(np.int32)
         self._seed, self._lane_stats, self._extract_word = make_state_kernels(
             hg.num_vertices, hg.vt * TILE, self.w, num_planes,

@@ -113,7 +113,7 @@ class PackedBfsResult:
 
         host_serves = self._graph is not None
         # Same loud-fallback gate as PackedBatchResult.parents_into: above
-        # ~1e5 rows x lanes the host path stops being interactive.
+        # ~1e5 lanes x vertices the host path stops being interactive.
         work_desc = (
             f"{n} lanes x {v} vertices" if n * v > 100_000 else None
         )

@@ -46,7 +46,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpu_bfs.graph.csr import Graph
-from tpu_bfs.graph.ell import EllGraph, build_ell
+from tpu_bfs.graph.ell import EllGraph, build_ell, pad_gate_blocks
 from tpu_bfs.algorithms._packed_common import (
     ExpandSpec,
     advance_packed_batch,
@@ -54,10 +54,13 @@ from tpu_bfs.algorithms._packed_common import (
     build_push_table,
     expand_arrays,
     finish_packed_batch,
+    PullGateHost,
     make_adaptive_hit,
     make_fori_expand,
+    make_gated_fori_expand,
     make_packed_loop,
     make_state_kernels,
+    row_unsettled,
     run_packed_batch,
     seed_scatter_args,
     start_packed_batch,
@@ -81,7 +84,8 @@ DEFAULT_MAX_LANES = 2 * LANES
 from tpu_bfs.algorithms._packed_common import PackedBatchResult as WideBfsResult  # noqa: E402
 
 
-def _make_core(ell: EllGraph, w: int, num_planes: int, push_cfg=None):
+def _make_core(ell: EllGraph, w: int, num_planes: int, push_cfg=None,
+               gate_levels: int = 0):
     act = ell.num_active
     spec = ExpandSpec(
         kcap=ell.kcap,
@@ -93,6 +97,19 @@ def _make_core(ell: EllGraph, w: int, num_planes: int, push_cfg=None):
         # no row at all (rank space is active-first, graph/ell.py).
         tail_rows=act - ell.num_nonzero + 1,
     )
+    if gate_levels:
+        # Pull gate (ISSUE 1): bucket outputs are table rows in order here
+        # (no permutation), so the per-row unsettled mask IS the per-
+        # bucket-output-row needed vector, no forward map required.
+        gated_expand = make_gated_fori_expand(spec, w)
+
+        def hit_of(arrs, fw, vis, lane_mask):
+            need = row_unsettled(vis, act, lane_mask)
+            return gated_expand(arrs, fw, need)
+
+        return make_packed_loop(
+            hit_of, num_planes, gate_levels=gate_levels, act=act
+        )
     # fw is [act+1, w]: frontier bits; sentinel row act is all-zero and is
     # never written (expand emits zero there, and `& ~vis` keeps it zero).
     expand = make_fori_expand(spec, w)
@@ -106,7 +123,7 @@ def _make_core(ell: EllGraph, w: int, num_planes: int, push_cfg=None):
     )
 
 
-class WidePackedMsBfsEngine:
+class WidePackedMsBfsEngine(PullGateHost):
     """Runs up to 4096 BFS sources concurrently, bit-packed 128 words wide.
 
     ``num_planes`` bit-sliced counter planes bound the level count at
@@ -114,6 +131,12 @@ class WidePackedMsBfsEngine:
     social graphs in HBM at w=128. ``run`` raises if the traversal is still
     alive at the cap (pass more planes for high-diameter graphs — or use the
     512-lane PackedMsBfsEngine, whose 8 planes reach 254 levels).
+
+    ``pull_gate=True`` (default off until chip-measured) turns on the
+    frontier-aware pull gate: settled rows' bucket blocks and state tiles
+    are skipped per level (_packed_common.make_gated_fori_expand /
+    gated_state_update), bit-identical to the plain scan; per-level skipped
+    blocks land in ``last_gate_level_counts``.
     """
 
     def __init__(
@@ -127,9 +150,18 @@ class WidePackedMsBfsEngine:
         hbm_budget_bytes: int = int(14.0e9),
         max_lanes: int = DEFAULT_MAX_LANES,
         adaptive_push: tuple[int, int] | None = None,
+        pull_gate: bool = False,
     ):
         if not (1 <= num_planes <= 8):
             raise ValueError("num_planes must be in [1, 8]")
+        if pull_gate and adaptive_push is not None:
+            # Both gate the same per-level scan, by different keys (settled
+            # destinations vs light frontiers); composing them is a
+            # measurement question, not a wiring one — measure the pull
+            # gate against the plain scan first (ISSUE 1's A/B stage).
+            raise ValueError(
+                "pull_gate and adaptive_push cannot combine (yet): pick one"
+            )
         if max_lanes % 32 or not (32 <= max_lanes <= MAX_LANES):
             # Fail before the ELL build, like the num_planes check above.
             raise ValueError(
@@ -182,9 +214,29 @@ class WidePackedMsBfsEngine:
         if adaptive_push is not None:
             self._build_push_table(adaptive_push)
         self._table_rows = self._act + 1  # + the all-zero sentinel row
-        self._core, self._core_from = _make_core(
-            ell, self.w, num_planes, adaptive_push
-        )
+        self.pull_gate = pull_gate
+        if pull_gate:
+            # Sentinel-padded whole-block bucket tables for the gated
+            # expansion (graph/ell.pad_gate_blocks; sentinel = the all-zero
+            # row act, the buckets' own pad convention).
+            for i, b in enumerate(ell.light):
+                self.arrs[f"light{i}_gt"] = jnp.asarray(
+                    pad_gate_blocks(
+                        np.ascontiguousarray(b.idx.T), self._act
+                    )
+                )
+            self._lane_mask_dev = jnp.full(
+                (self.w,), 0xFFFFFFFF, jnp.uint32
+            )
+            self._gate_core_jit, self._gate_core_from_jit = _make_core(
+                ell, self.w, num_planes, gate_levels=self.max_levels_cap
+            )
+            self._core = self._gated_core
+            self._core_from = self._gated_core_from
+        else:
+            self._core, self._core_from = _make_core(
+                ell, self.w, num_planes, adaptive_push
+            )
         in_deg_ranked = ell.in_degree[ell.old_of_new].astype(np.int32)
         self._seed, self._lane_stats, self._extract_word = make_state_kernels(
             ell.num_vertices, self._act + 1, self.w, num_planes,

@@ -94,6 +94,8 @@ def _make_ms_engine(args, g, n_sources: int):
                 f"got {args.lanes}"
             )
     lanes_kw = {} if args.lanes is None else {"lanes": args.lanes}
+    if args.pull_gate:
+        lanes_kw["pull_gate"] = True
     if args.devices > 1:
         if engine == "packed":
             raise SystemExit(
@@ -123,6 +125,11 @@ def _make_ms_engine(args, g, n_sources: int):
                     "rotated expansion over dense tiles + pair ELL); use "
                     "--engine hybrid"
                 )
+            if args.pull_gate:
+                raise SystemExit(
+                    "--pull-gate on a mesh runs through the distributed "
+                    "hybrid engine; drop --engine wide"
+                )
             from tpu_bfs.parallel.dist_msbfs_wide import DistWideMsBfsEngine
 
             return DistWideMsBfsEngine(
@@ -138,9 +145,18 @@ def _make_ms_engine(args, g, n_sources: int):
         if engine == "packed" and (args.ckpt or args.resume):
             # Checkpointing needs resumable packed state (wide/hybrid).
             engine = "wide"
+        if engine == "packed" and args.pull_gate:
+            # The gate lives in the wide/hybrid machinery only.
+            engine = "hybrid"
     if engine == "packed":
         from tpu_bfs.algorithms.msbfs_packed import PackedMsBfsEngine
 
+        if args.pull_gate:
+            raise SystemExit(
+                "--pull-gate applies to the wide/hybrid engines (the "
+                "512-lane packed engine keeps no settled-mask state); use "
+                "--engine wide or hybrid"
+            )
         lanes = (
             args.lanes
             if args.lanes is not None
@@ -275,7 +291,16 @@ def _run_multi_source(args, g, golden) -> int:
     if res.teps:
         print(f"Harmonic-mean GTEPS/source: {res.teps / 1e9:.4f}")
     if args.stats:
-        for line in level_stats(res.distances_int32(0), g.degrees).json_lines():
+        gated_counts = getattr(engine, "last_gate_level_counts", None)
+        if gated_counts is not None:
+            # Trim the cap-length counter array to the BATCH's level count
+            # (not lane 0's eccentricity — level_stats keeps the deeper
+            # levels other lanes ran, where the gate skips the most).
+            gated_counts = np.asarray(gated_counts)[: res.num_levels + 1]
+        stats = level_stats(
+            res.distances_int32(0), g.degrees, gated_tiles=gated_counts
+        )
+        for line in stats.json_lines():
             print(line)
     if args.certify:
         # Oracle-free certificate for the primary lane (see the
@@ -379,6 +404,14 @@ def main(argv=None) -> int:
                     "distributed 4096; wider rows trade proportionally "
                     "more HBM for more concurrent sources. NB on TPU, "
                     "widths below 4096 pad to the same physical tables)")
+    ap.add_argument("--pull-gate", action="store_true",
+                    help="frontier-aware pull expansion (experimental, "
+                    "default off): settled rows' bucket blocks, state "
+                    "tiles, and (single-source 'tiled') dense-tile passes "
+                    "are skipped per level, bit-identical to the plain "
+                    "scan. Applies to --multi-source wide/hybrid engines "
+                    "(single device or --devices N hybrid) and --backend "
+                    "tiled; --stats adds per-level gated_tiles counts")
     ap.add_argument("--adaptive-push", default=None, metavar="ROWS,DEG",
                     help="experimental level-adaptive expansion for "
                     "--engine wide|hybrid (single device): levels with "
@@ -413,6 +446,15 @@ def main(argv=None) -> int:
             ap.error(f"--adaptive-push must be ROWS,DEG positive ints, got "
                      f"{args.adaptive_push!r}")
         args.adaptive_push = (r, d)
+    if args.pull_gate and args.adaptive_push is not None:
+        ap.error("--pull-gate and --adaptive-push cannot combine (both "
+                 "gate the per-level scan; measure them separately)")
+    if args.pull_gate and not args.multi_source and (
+        args.backend != "tiled" or args.mesh or args.devices > 1
+    ):
+        ap.error("--pull-gate for single-source runs needs --backend "
+                 "tiled on a single device (the other single-source "
+                 "backends have no tile pass to gate)")
     if (args.mesh or args.devices > 1) and args.backend in ("delta", "tiled"):
         ap.error(f"--backend {args.backend} is single-device only")
     if args.mesh and args.exchange == "sparse":
@@ -501,7 +543,7 @@ def main(argv=None) -> int:
         if args.backend == "tiled":
             from tpu_bfs.algorithms.bfs_tiled import TiledBfsEngine
 
-            return TiledBfsEngine(g)
+            return TiledBfsEngine(g, pull_gate=args.pull_gate)
         return BfsEngine(g, backend=args.backend)
 
     engine = make_engine()
@@ -544,6 +586,9 @@ def main(argv=None) -> int:
     if res.teps:
         print(f"Traversed edges: {res.edges_traversed}  GTEPS: {res.teps / 1e9:.4f}")
     print(f"Reached {res.reached} vertices in {res.num_levels} levels")
+    skipped = getattr(engine, "last_gate_skipped_tiles", None)
+    if skipped is not None:
+        print(f"Pull gate skipped {skipped} dense-tile passes")
 
     if args.stats:
         from tpu_bfs.utils.stats import level_stats

@@ -82,6 +82,37 @@ class EllGraph:
         return m + sum(b.idx.size for b in self.light)
 
 
+def pad_gate_blocks(idx_t: np.ndarray, sentinel: int, tile: int = 128) -> np.ndarray:
+    """Pad a transposed [k, n] bucket index table to whole ``tile``-row
+    blocks ([k, ceil(n/tile)*tile], pad = ``sentinel``) for the pull gate's
+    block-compacted expansion (_packed_common.make_gated_fori_expand).
+    The sentinel must gather the engine's all-zero frontier row, so a
+    processed block's pad columns contribute identity — exactly like the
+    in-bucket column pads _ell_fill writes."""
+    k, n = idx_t.shape
+    nb = max(-(-n // tile), 1)
+    out = np.full((k, nb * tile), sentinel, dtype=np.int32)
+    out[:, :n] = idx_t
+    return out
+
+
+def gate_forward_map(routing: np.ndarray, out_height: int, num_real: int) -> np.ndarray:
+    """Forward form of a bucket routing map for the pull gate.
+
+    ``routing`` maps each table row to its bucket-output position (the
+    hybrid's ``inv_perm_ext``; positions >= ``num_real`` are the shared
+    zero row). Returns ``fwd`` [out_height] int32 with ``fwd[p]`` = the
+    table row whose bucket output is position p, and ``len(routing)``
+    (one past the table) at pad/tail positions — callers gather from a
+    per-row needed vector extended with one trailing False, so pad rows
+    are never "needed"."""
+    fwd = np.full(out_height, len(routing), dtype=np.int32)
+    pos = routing.astype(np.int64)
+    m = pos < num_real
+    fwd[pos[m]] = np.flatnonzero(m).astype(np.int32)
+    return fwd
+
+
 def _ell_fill(lens: np.ndarray, flat: np.ndarray, k: int, pad: int) -> np.ndarray:
     """Pack concatenated variable-length rows (lengths ``lens``, data ``flat``)
     into a dense [len(lens), k] matrix padded with ``pad``."""

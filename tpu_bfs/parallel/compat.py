@@ -1,0 +1,31 @@
+"""Version-gated shard_map entry point.
+
+The engines target the public ``jax.shard_map`` (jax >= 0.6, keyword
+``check_vma``); older jax only ships ``jax.experimental.shard_map`` with
+the same semantics under the keyword ``check_rep``. One wrapper keeps
+every distributed engine importable on both — without it, a jax
+downgrade silently takes out the whole parallel/ layer at call time
+(the shape of the round-5 seed: every distributed test dead on
+``AttributeError: module 'jax' has no attribute 'shard_map'``).
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = False):
+    """``jax.shard_map`` when available, else the experimental spelling
+    (``check_vma`` -> ``check_rep`` — the pre-0.6 name for the same
+    replication/varying-manual-axes check)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )

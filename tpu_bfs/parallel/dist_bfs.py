@@ -30,6 +30,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpu_bfs.parallel.compat import shard_map
+
 from tpu_bfs.algorithms.bfs import BfsResult
 from tpu_bfs.algorithms.frontier import (
     INT32_MAX,
@@ -152,7 +154,7 @@ def _dist_bfs_fn(
 
     aux_specs = (P("v", None), P("v", None)) if dopt else ()
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             local_loop,
             mesh=mesh,
             in_specs=(
@@ -196,7 +198,7 @@ def _dist_parents_fn(mesh: Mesh, p: int, vloc: int, exchange: str):
         return jnp.where(dist_loc == INT32_MAX, -1, parent_loc)
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             local_parents,
             mesh=mesh,
             in_specs=(P("v", None), P("v", None), P("v")),

@@ -23,6 +23,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpu_bfs.parallel.compat import shard_map
+
 from tpu_bfs.algorithms.bfs import BfsResult
 from tpu_bfs.algorithms.frontier import (
     INT32_MAX,
@@ -117,7 +119,7 @@ def _dist2d_bfs_fn(mesh: Mesh, rows: int, cols: int, w: int, exchange: str,
 
     aux_specs = (P("r", "c", None), P("r", "c", None)) if dopt else ()
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             local_loop,
             mesh=mesh,
             in_specs=(
@@ -162,7 +164,7 @@ def _dist2d_parents_fn(mesh: Mesh, rows: int, cols: int, w: int, exchange: str):
         return jnp.where(dist_loc == INT32_MAX, -1, parent_loc)
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             local_parents,
             mesh=mesh,
             in_specs=(P("r", "c", None), P("r", "c", None), P(("r", "c"))),

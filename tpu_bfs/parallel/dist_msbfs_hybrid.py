@@ -59,13 +59,23 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpu_bfs.parallel.compat import shard_map
+
 from tpu_bfs.graph.csr import Graph
-from tpu_bfs.graph.ell import _ell_fill, pad_heavy_shards, rank_vertices
+from tpu_bfs.graph.ell import (
+    _ell_fill,
+    gate_forward_map,
+    pad_gate_blocks,
+    pad_heavy_shards,
+    rank_vertices,
+)
 from tpu_bfs.algorithms.msbfs_packed import ripple_increment
 from tpu_bfs.algorithms._packed_common import (
     ExpandSpec,
+    PullGateHost,
     lazy_full_parent_ell,
     make_fori_expand,
+    make_gated_fori_expand,
     make_state_kernels,
     run_packed_batch,
     seed_scatter_args,
@@ -460,6 +470,7 @@ def build_dist_hybrid(
 def _make_dist_core(
     hd, w: int, num_planes: int, mesh: Mesh, interpret: bool,
     exchange: str = "dense", sparse_caps: tuple[int, ...] = (),
+    gate_levels: int = 0,
 ):
     p_count = mesh.devices.size
     rows = hd["rows"]
@@ -469,11 +480,30 @@ def _make_dist_core(
     has_dense = hd["num_tiles"] > 0
     nb = len(sparse_caps) + 1 if exchange == "sparse" else 1
     sliced = hd.get("layout", "gather") == "sliced"
+    # Pull gate (ISSUE 1): gate_levels > 0 makes the cores take a trailing
+    # replicated lane-mask argument and return a trailing per-chip
+    # [1, gate_levels] skipped-block array (host-summed — deliberately NOT
+    # psum'd, so the gated program adds no collective the ungated one
+    # lacks; utils/wirecheck.check_gated_hybrid audits exactly that).
+    # Gating keys differ by layout: the gather layout skips residual
+    # bucket blocks whose destination rows all settled (chip-resident vis
+    # decides, same rule as the single-chip engines); the sliced layout
+    # skips a chip's contribution computes outright on levels where its
+    # RESIDENT frontier shard is empty — destination settledness lives on
+    # the accumulator's home chip there, so source-side emptiness is the
+    # gate that composes with the rotation without new exchange. The ring
+    # ppermutes themselves always run: a collective inside a per-chip cond
+    # would deadlock chips that disagree — that is the "where legal" line.
+    gated = gate_levels > 0
+    gated_expand = (
+        make_gated_fori_expand(hd["res_spec"], w) if gated and not sliced
+        else None
+    )
 
     def _global_any(x):
         return lax.psum(jnp.any(x != 0).astype(jnp.int32), "v") > 0
 
-    def _make_loop_sliced(arrs, max_levels):
+    def _make_loop_sliced(arrs, max_levels, lane_mask=None):
         """Ring-sliced level machinery: no gathered frontier ever exists.
 
         Each chip expands its (source-resident) edge groups against its own
@@ -503,47 +533,91 @@ def _make_dist_core(
                 )
             return out
 
-        def hit_own_of(fw):
+        def hit_claim(fw, vis):
+            """(hit_own, skipped_contribs). Gated: a chip whose resident
+            frontier shard is empty contributes identity at every ring
+            step, so its P contribution computes (gathers + tiles) are
+            skipped under lax.cond; the rotation itself still runs on
+            every chip (see _make_dist_core's gating note)."""
             fw_ext = jnp.concatenate([fw, jnp.zeros((1, w), jnp.uint32)])
-            acc = contrib(fw, fw_ext, {k: arrs[k][0] for k in step_keys})
+            if gated:
+                empty = ~jnp.any(fw != 0)
+
+                def step(s_arrs):
+                    return lax.cond(
+                        empty,
+                        lambda: jnp.zeros((rows_loc, w), jnp.uint32),
+                        lambda: contrib(fw, fw_ext, s_arrs),
+                    )
+            else:
+                def step(s_arrs):
+                    return contrib(fw, fw_ext, s_arrs)
+
+            acc = step({k: arrs[k][0] for k in step_keys})
 
             def sbody(acc, xs):
                 acc = lax.ppermute(acc, "v", ring)
-                return acc | contrib(fw, fw_ext, xs), None
+                return acc | step(xs), None
 
             if p_count > 1:
                 acc, _ = lax.scan(
                     sbody, acc, {k: arrs[k][1:] for k in step_keys}
                 )
-            return acc & arrs["valid"]
+            skipped = (
+                jnp.where(empty, p_count, 0) if gated else jnp.int32(0)
+            )
+            return acc & arrs["valid"], skipped
+
+        def body_claim(fw, vis):
+            hit, skipped = hit_claim(fw, vis)
+            return hit, jnp.int32(0), skipped
+
+        return _make_run_from(body_claim, max_levels), hit_claim
+
+    def _make_run_from(body_claim, max_levels):
+        """The shared while-loop shell of both layouts: ``body_claim(fw,
+        vis) -> (hit_own, exchange_branch, skipped)`` plugs in the
+        per-layout expansion; the carry grows the per-level skipped-block
+        array in gated mode."""
 
         def cond(carry):
-            _, _, _, level, alive, _ = carry
+            level, alive = carry[3], carry[4]
             return alive & (level < max_levels)
 
         def body(carry):
-            fw, vis, planes, level, _, bc = carry
-            nxt = hit_own_of(fw) & ~vis
+            fw, vis, planes, level, _, bc = carry[:6]
+            hit, branch, skipped = body_claim(fw, vis)
+            nxt = hit & ~vis
             vis2 = vis | nxt
             planes = ripple_increment(planes, ~vis2)
-            bc = bc + (jnp.arange(nb, dtype=jnp.int32) == 0)
+            bc = bc + (jnp.arange(nb, dtype=jnp.int32) == branch)
+            # One psum per level is the whole termination protocol (the
+            # reference needs a host-visible MPI_Allreduce, bfs_mpi.cu:621).
             alive = _global_any(nxt)
-            return nxt, vis2, planes, level + 1, alive, bc
+            out = (nxt, vis2, planes, level + 1, alive, bc)
+            if gated:
+                gc = carry[6].at[
+                    jnp.minimum(level, gate_levels - 1)
+                ].set(skipped)
+                out = out + (gc,)
+            return out
 
         def run_from(fw, vis, planes, level0):
-            return lax.while_loop(
-                cond, body,
-                (fw, vis, planes, level0, jnp.bool_(True),
-                 jnp.zeros(nb, jnp.int32)),
-            )
+            init = (fw, vis, planes, level0, jnp.bool_(True),
+                    jnp.zeros(nb, jnp.int32))
+            if gated:
+                init = init + (jnp.zeros(gate_levels, jnp.int32),)
+            return lax.while_loop(cond, body, init)
 
-        return run_from, hit_own_of
+        return run_from
 
-    def _make_loop(arrs, max_levels):
+    def _make_loop(arrs, max_levels, lane_mask=None):
         """This chip's level machinery over its stripped arrays: returns
-        (run_from, hit_own_of) — shared by the fresh and resume entries."""
+        (run_from, hit_claim) — shared by the fresh and resume entries.
+        ``hit_claim(fw, vis) -> (hit_own, skipped)``; vis/lane_mask are
+        only consulted in gated mode."""
         if sliced:
-            return _make_loop_sliced(arrs, max_levels)
+            return _make_loop_sliced(arrs, max_levels, lane_mask)
 
         def dense_gather(fw_own):
             # Transient full frontier in global rank0 order: global tile
@@ -571,77 +645,78 @@ def _make_dist_core(
                 return sparse_gather(fw_own)
             return dense_gather(fw_own), jnp.int32(0)
 
-        def hit_of_gathered(fw_g):
-            hit = expand(arrs, fw_g)[arrs["perm"]]  # own rows, local order
+        def hit_of_gathered(fw_g, vis):
+            if gated:
+                # Destination-settled gating, chip-resident: this chip's
+                # vis shard covers exactly the rows its buckets produce.
+                valid_rows = arrs["valid"][:, 0] != 0
+                need = (
+                    jnp.any((~vis & lane_mask[None, :]) != 0, axis=1)
+                    & valid_rows
+                )
+                need_ext = jnp.concatenate([need, jnp.zeros((1,), bool)])
+                res, skipped = gated_expand(
+                    arrs, fw_g, need_ext[arrs["gate_fwd"]]
+                )
+                hit = res[arrs["perm"]]
+            else:
+                hit = expand(arrs, fw_g)[arrs["perm"]]  # own rows, local
+                skipped = jnp.int32(0)
             if has_dense:
                 hit = hit | tile_spmm(
                     arrs["row_start"], arrs["col_tile"], arrs["a_tiles"], fw_g,
                     num_row_tiles=nrt, w=w, interpret=interpret,
                 )
-            return hit & arrs["valid"]
+            return hit & arrs["valid"], skipped
 
-        def hit_own_of(fw_own):
-            return hit_of_gathered(gather_frontier(fw_own)[0])
+        def hit_claim(fw_own, vis):
+            return hit_of_gathered(gather_frontier(fw_own)[0], vis)
 
-        def cond(carry):
-            _, _, _, level, alive, _ = carry
-            return alive & (level < max_levels)
-
-        def body(carry):
-            fw, vis, planes, level, _, branch_counts = carry
+        def body_claim(fw, vis):
             fw_g, branch = gather_frontier(fw)
-            nxt = hit_of_gathered(fw_g) & ~vis  # own rows only
-            vis2 = vis | nxt
-            planes = ripple_increment(planes, ~vis2)
-            branch_counts = branch_counts + (
-                jnp.arange(nb, dtype=jnp.int32) == branch
-            )
-            # One psum per level is the whole termination protocol (the
-            # reference needs a host-visible MPI_Allreduce, bfs_mpi.cu:621).
-            alive = _global_any(nxt)
-            return nxt, vis2, planes, level + 1, alive, branch_counts
+            hit, skipped = hit_of_gathered(fw_g, vis)
+            return hit, branch, skipped
 
-        def run_from(fw, vis, planes, level0):
-            return lax.while_loop(
-                cond, body,
-                (fw, vis, planes, level0, jnp.bool_(True),
-                 jnp.zeros(nb, jnp.int32)),
-            )
+        return _make_run_from(body_claim, max_levels), hit_claim
 
-        return run_from, hit_own_of
-
-    def chip_fn(arrs, fw0, max_levels):
+    def chip_fn(arrs, fw0, max_levels, *mask):
         arrs = {k: a[0] for k, a in arrs.items()}  # strip this chip's P axis
-        run_from, hit_own_of = _make_loop(arrs, max_levels)
+        run_from, hit_claim = _make_loop(arrs, max_levels, *mask)
         planes0 = tuple(
             jnp.zeros((rows_loc, w), jnp.uint32) for _ in range(num_planes)
         )
-        fw_f, vis_f, planes_f, levels, alive, branch_counts = run_from(
-            fw0, fw0, planes0, jnp.int32(0)
-        )
+        out = run_from(fw0, fw0, planes0, jnp.int32(0))
+        fw_f, vis_f, planes_f, levels, alive, branch_counts = out[:6]
 
         def deeper():
-            return _global_any(hit_own_of(fw_f) & ~vis_f)
+            return _global_any(hit_claim(fw_f, vis_f)[0] & ~vis_f)
 
         truncated = lax.cond(
             alive & (levels >= max_levels), deeper, lambda: jnp.bool_(False)
         )
-        return planes_f, vis_f, levels, alive, truncated, branch_counts
+        res = (planes_f, vis_f, levels, alive, truncated, branch_counts)
+        if gated:
+            res = res + (out[6][None],)  # [1, L]; host sums the chip axis
+        return res
 
-    def chip_fn_from(arrs, fw, vis, planes, level0, max_levels):
+    def chip_fn_from(arrs, fw, vis, planes, level0, max_levels, *mask):
         # Checkpoint-resume entry: the while-loop carry (all in the same
         # sharded tau row space) restored mid-traversal — bit-identical to
         # never having stopped (_packed_common.advance_packed_batch).
         arrs = {k: a[0] for k, a in arrs.items()}
-        run_from, _ = _make_loop(arrs, max_levels)
-        return run_from(fw, vis, planes, level0)
+        run_from, _ = _make_loop(arrs, max_levels, *mask)
+        out = run_from(fw, vis, planes, level0)
+        return out[:6] + ((out[6][None],) if gated else ())
 
     def build(n_arrs):
+        mask_in = (P(),) if gated else ()  # replicated lane mask
+        gc_out = (P("v"),) if gated else ()  # [P, L] per-chip counters
         core = jax.jit(
-            jax.shard_map(
+            shard_map(
                 chip_fn,
                 mesh=mesh,
-                in_specs=({k: P("v") for k in n_arrs}, P("v"), P()),
+                in_specs=({k: P("v") for k in n_arrs}, P("v"), P())
+                + mask_in,
                 out_specs=(
                     tuple(P("v") for _ in range(num_planes)),
                     P("v"),
@@ -649,12 +724,13 @@ def _make_dist_core(
                     P(),
                     P(),
                     P(),
-                ),
+                )
+                + gc_out,
                 check_vma=False,
             )
         )
         core_from = jax.jit(
-            jax.shard_map(
+            shard_map(
                 chip_fn_from,
                 mesh=mesh,
                 in_specs=(
@@ -664,7 +740,8 @@ def _make_dist_core(
                     tuple(P("v") for _ in range(num_planes)),
                     P(),
                     P(),
-                ),
+                )
+                + mask_in,
                 out_specs=(
                     P("v"),
                     P("v"),
@@ -672,7 +749,8 @@ def _make_dist_core(
                     P(),
                     P(),
                     P(),
-                ),
+                )
+                + gc_out,
                 check_vma=False,
             )
         )
@@ -685,7 +763,7 @@ def _make_dist_core(
     return build
 
 
-class DistHybridMsBfsEngine(RowGatherExchangeAccounting):
+class DistHybridMsBfsEngine(RowGatherExchangeAccounting, PullGateHost):
     """Multi-chip 4096-lane hybrid MS-BFS: dense MXU tiles + gather residual.
 
     API mirrors HybridMsBfsEngine; frontier/visited/planes are all sharded
@@ -693,6 +771,14 @@ class DistHybridMsBfsEngine(RowGatherExchangeAccounting):
     row-tiles), so per-chip state memory falls as the mesh grows — the
     scaling the reference's full-replication design forecloses
     (bfs.cu:346-351).
+
+    ``pull_gate=True`` works on every exchange; NB the unit of
+    ``last_gate_level_counts`` differs by layout: gather/sparse count
+    skipped 128-row bucket blocks (chip-summed, like the single-chip
+    engines), while the ring-sliced layout counts skipped per-chip
+    CONTRIBUTION COMPUTES (<= P per level — a chip with an empty resident
+    frontier shard skips all P of its expansion steps). Compare gated
+    counters within one layout only.
     """
 
     def __init__(
@@ -708,6 +794,7 @@ class DistHybridMsBfsEngine(RowGatherExchangeAccounting):
         exchange: str = "dense",
         sparse_caps: int | tuple[int, ...] | None = None,
         lanes: int = LANES,
+        pull_gate: bool = False,
     ):
         if not (1 <= num_planes <= 8):
             raise ValueError("num_planes must be in [1, 8]")
@@ -778,11 +865,44 @@ class DistHybridMsBfsEngine(RowGatherExchangeAccounting):
         self._gather_rows_loc = rows_loc
         self.last_exchange_level_counts: np.ndarray | None = None
         self.last_exchange_bytes: float | None = None
+        self.pull_gate = pull_gate
+        if pull_gate and layout == "gather":
+            # Per-chip gate tables (common shapes, like every other array
+            # under shard_map): sentinel-padded whole-block bucket indices
+            # + the forward routing map bucket-position -> local row.
+            spec = hd["res_spec"]
+            sentinel = rows - 1
+            for i, (_k, _n) in enumerate(spec.light_meta):
+                lt = hd["res_arrs"][f"light{i}_t"]  # [P, k, n]
+                n_arrs[f"light{i}_gt"] = np.stack(
+                    [pad_gate_blocks(lt[p], sentinel) for p in range(p_count)]
+                )
+            nh = (
+                hd["res_arrs"]["heavy_pick"].shape[1] if spec.heavy else 0
+            )
+            out_height = nh + sum(n for _, n in spec.light_meta) + spec.tail_rows
+            num_real = out_height - 1  # the shared zero row is last
+            n_arrs["gate_fwd"] = np.stack([
+                gate_forward_map(hd["perm_s"][p], out_height, num_real)
+                for p in range(p_count)
+            ])
+        if pull_gate:
+            self._lane_mask_dev = jnp.full((self.w,), 0xFFFFFFFF, jnp.uint32)
         build = _make_dist_core(
             hd, self.w, num_planes, self.mesh, interpret, exchange,
             self.sparse_caps,
+            gate_levels=self.max_levels_cap if pull_gate else 0,
         )
-        self._dist_core, self._core_from_jit, self.arrs = build(n_arrs)
+        if pull_gate:
+            # The raw jitted resume loop takes the extra lane-mask arg and
+            # returns the counter array; keep it OFF the _core_from_jit
+            # name so the generic cap-boundary probe and the exchange-
+            # accounting wrapper can't mis-call it (PullGateHost).
+            self._dist_core, self._gate_core_from_jit, self.arrs = build(
+                n_arrs
+            )
+        else:
+            self._dist_core, self._core_from_jit, self.arrs = build(n_arrs)
         self._table_rows = hd["rows"]
 
         # Extraction maps vertices through tau (vertex -> sharded-table row);
@@ -831,11 +951,37 @@ class DistHybridMsBfsEngine(RowGatherExchangeAccounting):
         return self._seed_k(*seed_scatter_args(tau, self._act))
 
     def _core(self, arrs, fw0, max_levels):
-        planes, vis, levels, alive, truncated, bc = self._dist_core(
-            arrs, fw0, max_levels
-        )
+        if self.pull_gate:
+            planes, vis, levels, alive, truncated, bc, gc = self._dist_core(
+                arrs, fw0, max_levels, self._lane_mask_dev
+            )
+            # [P, L] per-chip skipped blocks; the chip-axis sum happens
+            # here on host — no collective was added for it (wirecheck
+            # check_gated_hybrid pins that).
+            self.last_gate_level_counts = np.asarray(gc).sum(axis=0)
+        else:
+            planes, vis, levels, alive, truncated, bc = self._dist_core(
+                arrs, fw0, max_levels
+            )
         self._record_exchange(bc, 0)
         return planes, vis, levels, alive, truncated
+
+    def _core_from(self, arrs, fw, vis, planes, level0, max_levels):
+        if not self.pull_gate:
+            return super()._core_from(
+                arrs, fw, vis, planes, level0, max_levels
+            )
+        fw_f, vis_f, planes_f, level, alive, bc, gc = (
+            self._gate_core_from_jit(
+                arrs, fw, vis, planes, level0, max_levels,
+                self._lane_mask_dev,
+            )
+        )
+        self._record_exchange(
+            bc, int(level0), getattr(self, "_pending_chain_nonce", None)
+        )
+        self.last_gate_level_counts = np.asarray(gc).sum(axis=0)
+        return fw_f, vis_f, planes_f, level, alive
 
     def _full_parent_ell(self):
         """Batched device parent scan structure (parent_scan.py): neither

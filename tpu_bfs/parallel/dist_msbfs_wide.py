@@ -32,6 +32,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpu_bfs.parallel.compat import shard_map
+
 from tpu_bfs.graph.csr import Graph
 from tpu_bfs.graph.ell import ShardedEllGraph, build_ell_sharded
 from tpu_bfs.algorithms.msbfs_packed import ripple_increment
@@ -177,7 +179,7 @@ def _make_dist_core(
     def build(n_arrs):
         specs = {k: P("v") for k in n_arrs}
         core = jax.jit(
-            jax.shard_map(
+            shard_map(
                 chip_fn,
                 mesh=mesh,
                 in_specs=(specs, P(), P()),
@@ -193,7 +195,7 @@ def _make_dist_core(
             )
         )
         core_from = jax.jit(
-            jax.shard_map(
+            shard_map(
                 chip_fn_from,
                 mesh=mesh,
                 in_specs=(

@@ -139,25 +139,37 @@ def phase_fns(engine) -> dict:
     return fns
 
 
-def phase_bytes(engine, *, nz_rows: int | None = None) -> dict:
+def phase_bytes(engine, *, nz_rows: int | None = None,
+                active_tiles: int | None = None) -> dict:
     """Analytic HBM bytes per phase for ONE level (lower bounds: bytes the
     phase's algorithm must move at least once; XLA fusion can only reduce
     intermediate traffic below this for `state`, so achieved-GB/s figures
     derived from these are conservative for the expansion phases).
 
-    ``nz_rows`` (active frontier rows) sizes the push phase; the pull
-    phases are frontier-independent by construction (the whole table is
-    scanned every level — that level-invariance is itself a roofline
-    finding worth stating).
+    ``nz_rows`` (active frontier rows) sizes the push phase. Without the
+    pull gate, the pull phases are frontier-independent by construction
+    (the whole table is scanned every level — that level-invariance was
+    the roofline finding ISSUE 1 acted on). On a pull-gated engine,
+    ``active_tiles`` (unsettled GATE_TILE row blocks this level) sizes the
+    gated model instead: light-bucket gathers and the state pass scale
+    with the active-tile count; the heavy section is all-or-nothing
+    (counted fully while any tile is active, zero at 0); the permutation
+    gather and the next-frontier zero-init stay full-table (the compiled
+    program still writes them full-height), and the settled-mask read adds
+    one table scan — the model bills the gate's own overhead so the gated
+    entry stays honest.
     """
     hg, w = engine.hg, engine.w
-    tb = hg.vt * TILE * w * 4  # one [rows, w] u32 table
+    rows = hg.vt * TILE
+    tb = rows * w * 4  # one [rows, w] u32 table
+    gated = bool(getattr(engine, "pull_gate", False)) and active_tiles is not None
+    at_rows = min(int(active_tiles or 0) * TILE, rows) if gated else rows
     out = {}
     # residual: per light bucket, k fori steps each gathering n rows
     # (n*w*4 read) and accumulating (acc read+write) + index table; the
     # virtual/heavy bucket adds its fold pyramid and pick gathers.
     res = 0
-    if hg.res_heavy:
+    if hg.res_heavy and (not gated or at_rows > 0):
         m = hg.res_virtual.idx.shape[0]  # rows per virtual gather
         res += hg.kcap * (3 * hg.res_num_virtual * w * 4) + hg.kcap * m * 4
         # fold pyramid: halving read+write chain ~ 2 * 2*num_virtual rows,
@@ -165,13 +177,15 @@ def phase_bytes(engine, *, nz_rows: int | None = None) -> dict:
         res += 4 * hg.res_num_virtual * w * 4 + hg.res_heavy * w * 4
     for b in hg.res_light:
         n, k = b.idx.shape
-        res += k * (3 * n * w * 4) + n * k * 4
+        ne = min(n, at_rows) if gated else n
+        res += k * (3 * ne * w * 4) + ne * k * 4
     # permutation back to rank0: read bucket rows + write the rank0 table.
     res += 2 * tb
     out["residual"] = res
     if hg.num_tiles:
         # a_tiles streamed once; each (row,col) tile production reads a
         # 128-row frontier slab column; output written once per row tile.
+        # (Ungated even on gated engines — see msbfs_hybrid._make_core.)
         out["dense"] = hg.a_tiles.nbytes + hg.num_tiles * TILE * w * 4 + tb
     if engine.adaptive_push is not None:
         deg_cap = engine.adaptive_push[1]
@@ -179,8 +193,16 @@ def phase_bytes(engine, *, nz_rows: int | None = None) -> dict:
         # zero-init of the hit table + per active row: its frontier word
         # row read + deg_cap neighbor rows read-modify-write.
         out["push"] = tb + nz * (1 + 2 * deg_cap) * w * 4
-    # claim reads hit+vis, writes vis and nxt; ripple reads+writes planes.
-    out["state"] = (4 + 2 * engine.num_planes) * tb
+    if gated:
+        # Gated state: full-table settled-mask read + next-frontier
+        # zero-init, then claim/visited/ripple traffic on active tiles.
+        out["state"] = 2 * tb + (
+            (3 + 2 * engine.num_planes) * at_rows * w * 4
+        )
+    else:
+        # claim reads hit+vis, writes vis and nxt; ripple reads+writes
+        # planes.
+        out["state"] = (4 + 2 * engine.num_planes) * tb
     return out
 
 
@@ -192,6 +214,9 @@ class LevelAttribution:
     t_full_s: float  # the real fused one-level step
     phases_s: dict  # phase -> seconds (standalone slice)
     bytes_model: dict  # phase -> analytic HBM bytes
+    # Unsettled GATE_TILE blocks entering the level (pull-gated engines
+    # only; sizes the gated byte model). None when the engine is ungated.
+    active_tiles: int | None = None
 
 
 def roofline_hybrid(engine, sources, *, peak_gbs: float = V5E_PEAK_GBS,
@@ -207,6 +232,14 @@ def roofline_hybrid(engine, sources, *, peak_gbs: float = V5E_PEAK_GBS,
     fns = phase_fns(engine)
     arrs = engine.arrs
     sources = np.asarray(sources)
+    # Pull-gated engines: refine the gate's lane mask to this batch (the
+    # all-ones default is safe but gates nothing until every lane settles).
+    # The phase SLICES stay the ungated forms — for a gated engine the
+    # per-level gap between the slice sum and t_full then measures the
+    # gate's win directly; the byte model switches to the gated entries.
+    note = getattr(engine, "_note_batch_sources", None)
+    if note is not None:
+        note(sources)
     fw = engine._seed_dev(sources)
     # vis must be a DISTINCT buffer: the donating step would otherwise
     # donate the same seed buffer through two donated parameters, which
@@ -252,9 +285,44 @@ def roofline_hybrid(engine, sources, *, peak_gbs: float = V5E_PEAK_GBS,
         lambda f: jnp.sum(jnp.any(f[: engine._act] != 0, axis=1)
                           .astype(jnp.int32))
     )
+    count_tiles = None
+    if getattr(engine, "pull_gate", False):
+        from tpu_bfs.algorithms._packed_common import (
+            GATE_TILE,
+            row_unsettled,
+        )
+
+        nt_tiles = engine._table_rows // GATE_TILE
+
+        @jax.jit
+        def count_tiles(v, lane_mask):
+            need = row_unsettled(v, engine._act, lane_mask)
+            blk = jnp.any(
+                need[: nt_tiles * GATE_TILE].reshape(nt_tiles, GATE_TILE),
+                axis=1,
+            )
+            return jnp.sum(blk.astype(jnp.int32))
+
+    # Each slice warms on its own FIRST dispatch, not at level 0: the push
+    # slice no longer dispatches on pull levels (ADVICE r5 — timing it
+    # there ran a row_cap-truncated index table through an nz-trip fori,
+    # a million-iteration clamped scatter that could blow the pstage
+    # timeout), so its first dispatch can land at any level.
+    warmed: set[str] = set()
+
+    def timed_slice(name, call):
+        out, t = try_timed(call, name not in warmed)
+        warmed.add(name)
+        return out, t
+
     while alive and level < cap:
         warm = level == 0
         nz = int(count_rows(fw))
+        at = (
+            int(count_tiles(vis, engine._lane_mask_dev))
+            if count_tiles is not None
+            else None
+        )
         took = "pull"
         if "gate" in fns:
             g_nz, g_bad = fns["gate"](arrs, fw)
@@ -264,7 +332,12 @@ def roofline_hybrid(engine, sources, *, peak_gbs: float = V5E_PEAK_GBS,
         for name in ("residual", "dense", "push"):
             if name not in fns:
                 continue
-            out, t = try_timed(partial(fns[name], arrs, fw), warm)
+            if name == "push" and took != "push":
+                # The fused loop does not run push this level; dispatching
+                # the gate-free slice anyway would time an out-of-contract
+                # input (see the warmed-set note above).
+                continue
+            out, t = timed_slice(name, partial(fns[name], arrs, fw))
             del out  # free the [rows, w] hit before the next dispatch
             phases[name] = t
         # State = claim + ripple, timed separately (see phase_fns) on a
@@ -282,14 +355,16 @@ def roofline_hybrid(engine, sources, *, peak_gbs: float = V5E_PEAK_GBS,
         if h is None:
             cl, t_claim = None, None
         else:
-            cl, t_claim = try_timed(partial(fns["claim"], h, vis), warm)
+            cl, t_claim = timed_slice("claim", partial(fns["claim"], h, vis))
             del h
         if cl is None:
             phases["state"] = None
         else:
             _nxt, vis2p, _ = cl
             del cl, _nxt
-            out, t_rip = try_timed(partial(fns["ripple"], planes, vis2p), warm)
+            out, t_rip = timed_slice(
+                "ripple", partial(fns["ripple"], planes, vis2p)
+            )
             del out, vis2p
             phases["state"] = (
                 None if t_rip is None else t_claim + t_rip
@@ -310,10 +385,12 @@ def roofline_hybrid(engine, sources, *, peak_gbs: float = V5E_PEAK_GBS,
         levels.append(LevelAttribution(
             level=level, frontier_rows=nz, took=took, t_full_s=t_full,
             phases_s=phases,
-            bytes_model=phase_bytes(engine, nz_rows=nz),
+            bytes_model=phase_bytes(engine, nz_rows=nz, active_tiles=at),
+            active_tiles=at,
         ))
         if log is not None:
-            log(f"level {level}: rows={nz} took={took} "
+            gate_msg = "" if at is None else f"active_tiles={at} "
+            log(f"level {level}: rows={nz} took={took} {gate_msg}"
                 f"full={t_full*1e3:.1f}ms " + " ".join(
                     f"{k}={v*1e3:.1f}ms" if v is not None else f"{k}=OOM"
                     for k, v in phases.items()))
@@ -345,6 +422,10 @@ def roofline_hybrid(engine, sources, *, peak_gbs: float = V5E_PEAK_GBS,
     total_bytes = sum(tot_bytes.values())
     report = {
         "num_levels": len(levels),
+        # Gated engines: the byte model uses the gated entries and the
+        # slices stay ungated, so per-level (slice sum - t_full) includes
+        # the gate's win; levels[i].active_tiles records the gate's input.
+        "pull_gate": bool(getattr(engine, "pull_gate", False)),
         "levels": [dataclasses.asdict(la) for la in levels],
         "t_full_sum_s": t_full_sum,
         "t_attributed_sum_s": attr_sum,

@@ -26,6 +26,10 @@ class LevelStats:
     edges_scanned: np.ndarray  # [L+1] sum of out-degrees of each level's frontier
     reached: int
     unreached: int
+    # Pull gate (ISSUE 1): blocks/tiles the gate skipped expanding each
+    # level (engine.last_gate_level_counts, trimmed to the level count).
+    # None on ungated runs — the key is then absent from json_lines.
+    gated_tiles: np.ndarray | None = None
 
     @property
     def num_levels(self) -> int:
@@ -33,23 +37,36 @@ class LevelStats:
 
     def json_lines(self) -> list[str]:
         """One JSON object per level (the --stats output format)."""
-        return [
-            json.dumps(
-                {
-                    "level": lvl,
-                    "frontier": int(self.frontier_size[lvl]),
-                    "edges_scanned": int(self.edges_scanned[lvl]),
-                }
-            )
-            for lvl in range(len(self.frontier_size))
-        ]
+        lines = []
+        for lvl in range(len(self.frontier_size)):
+            entry = {
+                "level": lvl,
+                "frontier": int(self.frontier_size[lvl]),
+                "edges_scanned": int(self.edges_scanned[lvl]),
+            }
+            if self.gated_tiles is not None:
+                entry["gated_tiles"] = int(self.gated_tiles[lvl])
+            lines.append(json.dumps(entry))
+        return lines
 
 
-def level_stats(distance: np.ndarray, degrees: np.ndarray) -> LevelStats:
+def level_stats(distance: np.ndarray, degrees: np.ndarray,
+                gated_tiles: np.ndarray | None = None) -> LevelStats:
     """Compute LevelStats from a distance array (int32, INF_DIST = unreached).
 
     ``edges_scanned[l]`` is the work a level-synchronous sweep performs
     expanding level l — the degree sum of that level's frontier.
+    ``gated_tiles`` (a pull-gated engine's ``last_gate_level_counts``,
+    trimmed by the caller to the BATCH's level count) indexes the level
+    being EXPANDED, matching ``edges_scanned``'s convention. When the
+    batch ran deeper than THIS distance array's eccentricity (a
+    multi-source batch where other lanes kept claiming — exactly the
+    tail levels the gate targets), the output extends to the counts'
+    length with zero frontier/edges rows rather than silently dropping
+    the deepest counts. NB the counters' unit is engine-specific:
+    skipped 128-row blocks on the single-chip/gather engines, skipped
+    per-chip contribution computes (<= P per level) on the ring-sliced
+    distributed layout.
     """
     distance = np.asarray(distance)
     reached_mask = distance != INF_DIST
@@ -60,16 +77,25 @@ def level_stats(distance: np.ndarray, degrees: np.ndarray) -> LevelStats:
             edges_scanned=np.zeros(1, np.int64),
             reached=0,
             unreached=int((~reached_mask).sum()),
+            gated_tiles=None if gated_tiles is None else np.zeros(1, np.int64),
         )
     n_levels = int(reached.max())
-    frontier = np.bincount(reached, minlength=n_levels + 1).astype(np.int64)
+    n_out = n_levels + 1
+    gt = None
+    if gated_tiles is not None:
+        src = np.asarray(gated_tiles, np.int64)
+        n_out = max(n_out, len(src))
+        gt = np.zeros(n_out, np.int64)
+        gt[: len(src)] = src
+    frontier = np.bincount(reached, minlength=n_out).astype(np.int64)
     edges = np.bincount(
         reached, weights=np.asarray(degrees, np.float64)[reached_mask],
-        minlength=n_levels + 1,
+        minlength=n_out,
     ).astype(np.int64)
     return LevelStats(
         frontier_size=frontier,
         edges_scanned=edges,
         reached=int(reached_mask.sum()),
         unreached=int((~reached_mask).sum()),
+        gated_tiles=gt,
     )

@@ -291,6 +291,42 @@ def check_rows_sparse(graph, p: int = 8, lanes: int = 64) -> dict:
     }
 
 
+def check_gated_hybrid(graph, p: int = 8, exchange: str = "dense") -> dict:
+    """Pull-gated distributed hybrid (ISSUE 1): the gate must move ZERO
+    extra collective bytes — its settled mask is chip-resident, and its
+    per-level skipped-block counters come back per-chip (a sharded
+    [P, L] output summed on host, deliberately not a psum). Proof: compile
+    the gated and ungated cores for the same graph/mesh/exchange and
+    compare the full multiset of collective instructions (op, result
+    bytes, tuple arity) — equality means the gated program's exchange is
+    instruction-for-instruction the ungated one's. Works for every
+    exchange the engine grows the flag on ('dense', 'sparse', 'sliced')."""
+    import jax.numpy as jnp
+
+    from tpu_bfs.parallel.dist_bfs import make_mesh
+    from tpu_bfs.parallel.dist_msbfs_hybrid import DistHybridMsBfsEngine
+
+    mesh = make_mesh(p)
+    colls = {}
+    for gate in (False, True):
+        eng = DistHybridMsBfsEngine(
+            graph, mesh, exchange=exchange, pull_gate=gate
+        )
+        args = (eng.arrs, eng._seed_dev(np.asarray([0])), jnp.int32(32))
+        if gate:
+            args = args + (eng._lane_mask_dev,)
+        hlo = eng._dist_core.lower(*args).compile().as_text()
+        colls[gate] = sorted(
+            (c.op, c.result_bytes, c.pieces) for c in hlo_collectives(hlo)
+        )
+    return {
+        "config": f"gated-vs-ungated dist hybrid, P={p}, exchange={exchange}",
+        "ungated_collectives": colls[False],
+        "gated_collectives": colls[True],
+        "agree": colls[False] == colls[True] and len(colls[False]) > 0,
+    }
+
+
 def check_sliced_hybrid(graph, p: int = 8, lanes: int | None = None) -> dict:
     """Ring-sliced distributed hybrid: the modeled dense-slab bytes
     ((P-1) x [rows_loc, w] u32 per level) vs the compiled rotation's

@@ -1,5 +1,5 @@
 # Convenience targets; everything also works without make (README).
-.PHONY: test native bench wheel clean
+.PHONY: test native bench serve-smoke wheel clean
 
 # Full suite on 8 virtual CPU devices (tests/conftest.py forces the
 # platform; the axon TPU plugin is bypassed).
@@ -15,6 +15,19 @@ native:
 # bench.py's docstring; outage envelope guarantees the line lands).
 bench:
 	python bench.py
+
+# Round-trip 3 queries through the JSONL serving frontend on CPU
+# (tpu_bfs/serve; README "Serving mode") and check the responses decode.
+serve-smoke:
+	printf '{"id":1,"source":0}\n{"id":2,"source":3}\n{"id":3,"source":5}\n' | \
+	env JAX_PLATFORMS=cpu python -m tpu_bfs.serve random:n=96,m=480,seed=3 \
+	  --lanes 32 --linger-ms 1 --statsz-every 0 | \
+	python -c "import sys, json; \
+	from tpu_bfs.serve.frontend import decode_distances; \
+	rs = [json.loads(l) for l in sys.stdin if l.strip()]; \
+	assert len(rs) == 3 and all(r['status'] == 'ok' for r in rs), rs; \
+	assert all(int(decode_distances(r['distances_npy'])[r['source']]) == 0 for r in rs), rs; \
+	print('serve-smoke OK:', sorted(r['id'] for r in rs))"
 
 wheel:
 	python -m pip wheel . --no-deps --no-build-isolation -w dist

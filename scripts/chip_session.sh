@@ -115,6 +115,11 @@ for i in $(seq 1 "$attempts"); do
       TPU_BFS_BENCH_ADAPTIVE=0
     stage "plain-s20" "$out/plain_s20.json" \
       TPU_BFS_BENCH_SCALE=20 TPU_BFS_BENCH_ADAPTIVE=0
+    # Serve-throughput stage (ISSUE 2): the closed-loop lane-batching
+    # query server at scale 20 — the first latency/QPS number for the
+    # serving subsystem (serve_qps/serve_p99_ms/fill_ratio in the JSON).
+    stage "serve-s20" "$out/serve_s20.json" \
+      TPU_BFS_BENCH_MODE=serve TPU_BFS_BENCH_SCALE=20
     # The probe's completion-marker line satisfies got_value, so pstage
     # gives it the same idempotent restart + timeout envelope as the
     # other helper scripts.

@@ -1,0 +1,78 @@
+"""utils/compile_cache.py: env resolution + graceful degrade.
+
+The persistent XLA cache is what makes the serve registry's warm-ups
+cheap across processes (serve/registry.py arms it at construction), so
+its resolution rules get dedicated coverage: TPU_BFS_BENCH_XLA_CACHE
+wins over TPU_BFS_BENCH_CACHE's derived default, empty string disables,
+and a jax that rejects the knob degrades to None instead of raising —
+the cache is an optimization, never a dependency.
+"""
+
+import os
+
+import jax
+import pytest
+
+from tpu_bfs.utils.compile_cache import enable_compile_cache
+
+
+@pytest.fixture
+def _restore_jax_cache_config():
+    before = jax.config.jax_compilation_cache_dir
+    yield
+    jax.config.update("jax_compilation_cache_dir", before)
+
+
+def test_explicit_xla_cache_wins(monkeypatch, tmp_path,
+                                 _restore_jax_cache_config):
+    explicit = tmp_path / "explicit"
+    monkeypatch.setenv("TPU_BFS_BENCH_XLA_CACHE", str(explicit))
+    monkeypatch.setenv("TPU_BFS_BENCH_CACHE", str(tmp_path / "derived"))
+    msgs = []
+    path = enable_compile_cache(log=msgs.append)
+    assert path == str(explicit)
+    assert os.path.isdir(explicit)
+    assert jax.config.jax_compilation_cache_dir == str(explicit)
+    assert any("persistent compile cache" in m for m in msgs)
+
+
+def test_derived_default_under_bench_cache(monkeypatch, tmp_path,
+                                           _restore_jax_cache_config):
+    monkeypatch.delenv("TPU_BFS_BENCH_XLA_CACHE", raising=False)
+    monkeypatch.setenv("TPU_BFS_BENCH_CACHE", str(tmp_path / "bc"))
+    path = enable_compile_cache()
+    assert path == os.path.join(str(tmp_path / "bc"), "xla_cache")
+    assert os.path.isdir(path)
+
+
+def test_empty_string_disables(monkeypatch, tmp_path):
+    monkeypatch.setenv("TPU_BFS_BENCH_XLA_CACHE", "")
+    monkeypatch.setenv("TPU_BFS_BENCH_CACHE", str(tmp_path / "unused"))
+    msgs = []
+    assert enable_compile_cache(log=msgs.append) is None
+    # Disabled means no side effects at all: no directory, no log line.
+    assert not os.path.exists(tmp_path / "unused")
+    assert msgs == []
+
+
+def test_degrades_when_jax_config_update_raises(monkeypatch, tmp_path):
+    # No restore fixture needed: update raises, so config never changes.
+    monkeypatch.setenv("TPU_BFS_BENCH_XLA_CACHE", str(tmp_path / "cc"))
+
+    def boom(name, value):
+        raise AttributeError(f"no such config: {name}")
+
+    monkeypatch.setattr(jax.config, "update", boom)
+    msgs = []
+    assert enable_compile_cache(log=msgs.append) is None
+    assert any("compile cache unavailable" in m for m in msgs)
+
+
+def test_degrade_logs_nothing_without_logger(monkeypatch, tmp_path):
+    # The no-log path must swallow the failure silently, not raise.
+    monkeypatch.setenv("TPU_BFS_BENCH_XLA_CACHE", str(tmp_path / "cc2"))
+    monkeypatch.setattr(
+        jax.config, "update",
+        lambda *a: (_ for _ in ()).throw(RuntimeError("nope")),
+    )
+    assert enable_compile_cache() is None

@@ -164,6 +164,75 @@ def test_pull_gate_bit_identical(name, make):
             )
 
 
+# Serving must be batch-composition-invariant: a query's answer can
+# never depend on which batch-mates the scheduler happened to coalesce
+# it with (lanes are independent by construction; this arm pins the
+# serve path — padding, masking, per-lane extraction — to that
+# guarantee). Random + directed cover the symmetric and asymmetric
+# shapes; the serve path is engine-agnostic above the lane machinery
+# the other arms already sweep.
+SERVE_CASES = [CASES[0], CASES[4]]
+
+
+@pytest.mark.serve
+@pytest.mark.parametrize("name,make", SERVE_CASES, ids=[c[0] for c in SERVE_CASES])
+def test_serve_bit_identical_to_one_shot(name, make):
+    """ISSUE 2 fuzz arm: served distances are bit-identical to one-shot
+    engine runs for the same (graph, source), across batch compositions
+    — alone, grouped with different mates, duplicated, and re-ordered."""
+    from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
+    from tpu_bfs.serve import BfsService, EngineRegistry
+
+    g = make()
+    rng = np.random.default_rng(29)
+    sources = _sources(g, rng, n=6)
+    one_shot = {}
+    eng = WidePackedMsBfsEngine(g, lanes=32, num_planes=8)
+    for s in sources:
+        one_shot[s] = eng.run(np.asarray([s])).distances_int32(0)
+        validate.check_distances(one_shot[s], bfs_scipy(g, s))
+
+    # One shared registry: the three composition services reuse ONE
+    # served engine (and stay inside the tier-1 wall-clock budget) —
+    # the compositions differ in batching, not in engine state.
+    reg = EngineRegistry(capacity=2)
+    reg.add_graph("fuzz-serve", g)
+
+    def svc():
+        return BfsService("fuzz-serve", registry=reg, lanes=32,
+                          linger_ms=0.0, autostart=False)
+
+    # Three compositions of the same queries: singletons, one big batch
+    # (staged before start so they coalesce), and shuffled duplicates
+    # split across two batches.
+    with svc() as s1:
+        s1.start()
+        for s in sources:
+            np.testing.assert_array_equal(
+                s1.query(s, timeout=60).distances, one_shot[s]
+            )
+    with svc() as s2:
+        staged = [s2.submit(s) for s in sources]
+        s2.start()
+        for s, q in zip(sources, staged):
+            r = q.result(timeout=60)
+            assert r.batch_lanes == len(sources)  # really one batch
+            np.testing.assert_array_equal(r.distances, one_shot[s])
+    with svc() as s3:
+        mixed = [int(s) for s in rng.permutation(sources * 2)]
+        first, second = mixed[: len(sources)], mixed[len(sources):]
+        staged = [s3.submit(s) for s in first]
+        s3.start()
+        for s, q in zip(first, staged):
+            np.testing.assert_array_equal(
+                q.result(timeout=60).distances, one_shot[s]
+            )
+        for s in second:
+            np.testing.assert_array_equal(
+                s3.query(s, timeout=60).distances, one_shot[s]
+            )
+
+
 @pytest.mark.parametrize("name,make", [CASES[2]], ids=[CASES[2][0]])
 def test_widths_agree(name, make):
     # Cross-WIDTH determinism on ONE engine: the same batch on the same

@@ -1,0 +1,172 @@
+"""serve/scheduler.py + serve/metrics.py unit coverage (no engines).
+
+The admission queue is the serving subsystem's control surface: bounded
+admission (shed, never unbounded backlog), batch coalescing with linger,
+deadline bookkeeping, and the exactly-once resolution contract every
+other serve test builds on.
+"""
+
+import threading
+import time
+
+import pytest
+
+from tpu_bfs.serve.metrics import ServeMetrics
+from tpu_bfs.serve.scheduler import (
+    STATUS_EXPIRED,
+    STATUS_OK,
+    STATUS_REJECTED,
+    AdmissionQueue,
+    PendingQuery,
+    QueryResult,
+)
+
+pytestmark = pytest.mark.serve
+
+
+def _q(source=0, **kw):
+    return PendingQuery(source, **kw)
+
+
+def test_offer_sheds_at_cap():
+    aq = AdmissionQueue(cap=2)
+    assert aq.offer(_q()) and aq.offer(_q())
+    assert not aq.offer(_q())  # full -> caller sheds
+    assert aq.depth() == 2
+
+
+def test_next_batch_drains_up_to_max():
+    aq = AdmissionQueue(cap=16)
+    qs = [_q(i) for i in range(5)]
+    for q in qs:
+        aq.offer(q)
+    batch = aq.next_batch(3, linger_s=0.0)
+    assert [b.source for b in batch] == [0, 1, 2]  # FIFO
+    assert aq.depth() == 2
+
+
+def test_linger_waits_for_fill_and_returns_early_when_full():
+    aq = AdmissionQueue(cap=16)
+    aq.offer(_q(0))
+
+    def feed():
+        for i in range(1, 4):
+            time.sleep(0.01)
+            aq.offer(_q(i))
+
+    t = threading.Thread(target=feed)
+    t.start()
+    t0 = time.monotonic()
+    batch = aq.next_batch(4, linger_s=5.0)
+    elapsed = time.monotonic() - t0
+    t.join()
+    # Filled by the feeder long before the 5 s linger bound.
+    assert len(batch) == 4 and elapsed < 2.0
+
+
+def test_linger_expires_on_partial_batch():
+    aq = AdmissionQueue(cap=16)
+    aq.offer(_q(0))
+    t0 = time.monotonic()
+    batch = aq.next_batch(4, linger_s=0.05)
+    assert len(batch) == 1
+    assert 0.04 <= time.monotonic() - t0 < 1.0
+
+
+def test_requeue_goes_to_front_and_ignores_cap():
+    aq = AdmissionQueue(cap=2)
+    a, b = _q(1), _q(2)
+    aq.offer(a), aq.offer(b)
+    popped = aq.next_batch(2, 0.0)
+    c = _q(3)
+    aq.offer(c)
+    aq.requeue(popped)  # 3 items in a cap-2 queue: requeue never sheds
+    assert aq.depth() == 3
+    assert [q.source for q in aq.next_batch(3, 0.0)] == [1, 2, 3]
+
+
+def test_stop_drains_immediately_without_linger():
+    aq = AdmissionQueue(cap=8)
+    aq.offer(_q(0))
+    aq.stop()
+    t0 = time.monotonic()
+    assert len(aq.next_batch(8, linger_s=10.0)) == 1
+    assert time.monotonic() - t0 < 1.0
+    assert aq.next_batch(8, linger_s=10.0) == []  # stopped + empty
+    assert not aq.offer(_q(1))  # admission closed
+
+
+def test_pending_query_resolves_exactly_once():
+    q = _q(5)
+    seen = []
+    q.add_done_callback(lambda p: seen.append(p.result().status))
+    assert q.resolve_status(STATUS_EXPIRED)
+    assert not q.resolve_status(STATUS_OK)  # first writer wins
+    assert q.result(timeout=1).status == STATUS_EXPIRED
+    assert seen == [STATUS_EXPIRED]
+    # A late callback fires immediately on the caller's thread.
+    q.add_done_callback(lambda p: seen.append("late"))
+    assert seen == [STATUS_EXPIRED, "late"]
+
+
+def test_pending_query_deadline_bookkeeping():
+    now = time.monotonic()
+    q = PendingQuery(3, deadline=now + 0.02, now=now)
+    assert not q.expired(now)
+    assert q.expired(now + 0.03)
+    assert PendingQuery(3).expired(now + 1e9) is False  # no deadline
+
+
+def test_result_timeout_raises():
+    with pytest.raises(TimeoutError):
+        _q().result(timeout=0.01)
+
+
+def test_metrics_snapshot_and_fill_ratio():
+    m = ServeMetrics()
+    m.record_batch(24, 32, [1.0, 2.0, 3.0])
+    m.record_batch(32, 32, [4.0])
+    m.record_rejected()
+    m.record_expired(2)
+    m.record_retry()
+    m.record_oom_degrade(requeued=5)
+    snap = m.snapshot(queue_depth=7, lanes=32)
+    assert snap["completed"] == 4
+    assert snap["batches"] == 2
+    assert snap["fill_ratio"] == pytest.approx(56 / 64)
+    assert snap["rejected"] == 1 and snap["expired"] == 2
+    assert snap["retries"] == 1 and snap["oom_degrades"] == 1
+    assert snap["requeued"] == 5
+    assert snap["queue_depth"] == 7 and snap["lanes"] == 32
+    assert snap["p50_ms"] == pytest.approx(2.5)
+    assert snap["qps"] > 0
+    line = m.statsz_line()
+    assert line.startswith("statsz {")
+
+
+def test_metrics_interval_window_owned_by_statsz_line():
+    # Ad-hoc snapshot() observers must not advance the periodic
+    # emitter's interval window; only statsz_line (mark_interval) does.
+    t = [0.0]
+    m = ServeMetrics(now=lambda: t[0])
+    t[0] = 10.0
+    m.record_batch(4, 32, [1.0] * 4)
+    assert m.snapshot()["interval_qps"] == pytest.approx(0.4)
+    t[0] = 20.0
+    # The plain snapshot above did NOT reset the window: still 4/20s.
+    assert m.snapshot()["interval_qps"] == pytest.approx(0.2)
+    m.statsz_line()  # the periodic emitter marks the window
+    t[0] = 21.0
+    m.record_batch(2, 32, [1.0] * 2)
+    assert m.snapshot()["interval_qps"] == pytest.approx(2.0)
+
+
+def test_metrics_empty_percentiles_are_none():
+    snap = ServeMetrics().snapshot()
+    assert snap["p50_ms"] is None and snap["p99_ms"] is None
+    assert snap["fill_ratio"] == 0.0
+
+
+def test_query_result_ok_flag():
+    assert QueryResult(id=1, source=0, status=STATUS_OK).ok
+    assert not QueryResult(id=1, source=0, status=STATUS_REJECTED).ok

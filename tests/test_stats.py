@@ -43,8 +43,35 @@ def test_cli_stats_flag(tmp_path, capsys):
     rc = cli_main(["0", str(path), "--stats"])
     out = capsys.readouterr().out
     assert rc == 0 and "Output OK" in out
-    stat_lines = [l for l in out.splitlines() if l.startswith("{")]
-    assert [json.loads(l)["frontier"] for l in stat_lines] == [1, 1, 1, 1]
+    # Level lines only: --stats may append a {"recovery": ...} trailer
+    # when earlier activity in this process tripped the recovery
+    # counters (stats.recovery_stats_line).
+    level_lines = [
+        json.loads(l) for l in out.splitlines()
+        if l.startswith("{") and "recovery" not in l
+    ]
+    assert [e["frontier"] for e in level_lines] == [1, 1, 1, 1]
+
+
+def test_cli_stats_recovery_trailer(tmp_path, capsys):
+    from tpu_bfs.utils.recovery import COUNTERS
+
+    path = tmp_path / "g.txt"
+    path.write_text("4 3\n0 1\n1 2\n2 3\n")
+    before = COUNTERS.as_dict()
+    COUNTERS.bump("transient_retries")
+    try:
+        rc = cli_main(["0", str(path), "--stats"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        (rline,) = [l for l in out.splitlines() if '"recovery"' in l]
+        rec = json.loads(rline)["recovery"]
+        assert rec["transient_retries"] >= 1
+    finally:
+        COUNTERS.reset()
+        for k, v in before.items():
+            if v:
+                COUNTERS.bump(k, v)
 
 
 def test_cli_multi_source(tmp_path, capsys):

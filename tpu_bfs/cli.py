@@ -302,6 +302,13 @@ def _run_multi_source(args, g, golden) -> int:
         )
         for line in stats.json_lines():
             print(line)
+        from tpu_bfs.utils.stats import recovery_stats_line
+
+        rline = recovery_stats_line()
+        if rline:
+            # Post-hoc incident visibility: retries/rebuilds/OOM degrades
+            # that fired this process (utils/recovery.COUNTERS).
+            print(rline)
     if args.certify:
         # Oracle-free certificate for the primary lane (see the
         # single-source path); no CPU golden run at any scale. The message
@@ -591,10 +598,15 @@ def main(argv=None) -> int:
         print(f"Pull gate skipped {skipped} dense-tile passes")
 
     if args.stats:
-        from tpu_bfs.utils.stats import level_stats
+        from tpu_bfs.utils.stats import level_stats, recovery_stats_line
 
         for line in level_stats(res.distance, g.degrees).json_lines():
             print(line)
+        rline = recovery_stats_line()
+        if rline:
+            # Retry/OOM-degrade counters, when any fired (post-hoc
+            # visibility for checkpointed runs' recovery loops).
+            print(rline)
 
     if args.certify:
         # Oracle-free certificate: parent chains + edge-level property

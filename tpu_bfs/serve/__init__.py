@@ -1,0 +1,36 @@
+"""Lane-batching BFS query server (ISSUE 2).
+
+The packed engines' lane axis is a request-batching axis: one device
+dispatch answers up to ``lanes`` independent sources (msbfs_packed.py /
+msbfs_wide.py — the MS-BFS batching idea, same motivation as the batched
+frontier processing in the distributed-memory BFS literature, PAPERS.md).
+This package turns that into a long-lived query service instead of the
+one-shot CLI's fresh-process-per-query flow:
+
+- ``registry``  — load graphs once, build-and-warm engines keyed by
+  (graph, engine, lanes, pull_gate, devices) with an LRU bound, warm-up
+  hitting the persistent XLA cache (utils/compile_cache.py);
+- ``scheduler`` — bounded admission queue coalescing pending single-source
+  queries into one packed batch per dispatch (linger knob trades latency
+  for batch fill; per-query deadlines; shed-on-overload);
+- ``executor``  — batch dispatch with transient-failure retry and
+  OOM lane-count degrade (classifier shared with utils/recovery.py);
+- ``frontend``  — the in-process ``BfsService`` API and the stdin/stdout
+  JSONL protocol behind the ``tpu-bfs-serve`` entry point;
+- ``metrics``   — /statsz-style serve counters (QPS, p50/p99 latency,
+  batch fill ratio, queue depth, retries, sheds).
+"""
+
+from tpu_bfs.serve.frontend import BfsService  # noqa: F401
+from tpu_bfs.serve.metrics import ServeMetrics  # noqa: F401
+from tpu_bfs.serve.registry import EngineRegistry, EngineSpec  # noqa: F401
+from tpu_bfs.serve.scheduler import (  # noqa: F401
+    STATUS_ERROR,
+    STATUS_EXPIRED,
+    STATUS_OK,
+    STATUS_REJECTED,
+    STATUS_SHUTDOWN,
+    AdmissionQueue,
+    PendingQuery,
+    QueryResult,
+)

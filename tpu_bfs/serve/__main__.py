@@ -1,0 +1,8 @@
+"""``python -m tpu_bfs.serve`` — the JSONL query server (frontend.py)."""
+
+import sys
+
+from tpu_bfs.serve.frontend import main
+
+if __name__ == "__main__":
+    sys.exit(main())

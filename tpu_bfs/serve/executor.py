@@ -1,0 +1,126 @@
+"""Batch execution with failure classification, retry, and OOM degrade.
+
+The serving dispatch path reuses the one transient/deterministic
+classifier the whole repo shares (utils/recovery.py): transient infra
+errors re-dispatch the SAME batch with capped backoff (the queries are
+already coalesced; re-enqueueing them would just re-form the same
+batch), OOM hands the queries back to the service for re-admission at a
+narrower lane count (floor_lanes halving — the degrade ladder), and
+everything else resolves the batch's queries with explicit error
+results. Unlike bench.py's retry ladder there is no wall-clock budget:
+the server is the long-lived process the budget envelope exists to
+protect elsewhere.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from tpu_bfs.serve.scheduler import STATUS_ERROR, STATUS_OK, QueryResult
+from tpu_bfs.utils.recovery import (
+    COUNTERS,
+    is_oom_failure,
+    is_transient_failure,
+)
+
+
+def pad_batch(sources: np.ndarray, lanes: int) -> tuple[np.ndarray, int]:
+    """Pad a partial batch to exactly ``lanes`` sources so every dispatch
+    reuses ONE compiled shape (a variable-length batch would retrace the
+    level loop per distinct size). Pad lanes repeat the first real source
+    — a valid vertex by construction — and are masked out on extract by
+    never being read (lanes [n:) belong to no query)."""
+    n = len(sources)
+    if n > lanes:
+        raise ValueError(f"batch of {n} exceeds {lanes} lanes")
+    if n == lanes:
+        return np.asarray(sources, dtype=np.int64), n
+    out = np.empty(lanes, dtype=np.int64)
+    out[:n] = sources
+    out[n:] = sources[0]
+    return out, n
+
+
+class OomRequeue(Exception):
+    """Internal signal: the batch OOM'd; its queries ride along for the
+    service to degrade the lane count and re-admit."""
+
+    def __init__(self, queries, cause: BaseException):
+        super().__init__(str(cause))
+        self.queries = queries
+        self.cause = cause
+
+
+class BatchExecutor:
+    """Runs coalesced batches through an engine's ``run`` protocol."""
+
+    def __init__(self, metrics, *, max_retries: int = 2,
+                 backoff_s: float = 0.05, backoff_cap_s: float = 2.0,
+                 log=None, sleep=time.sleep):
+        self.metrics = metrics
+        self.max_retries = max_retries
+        self.backoff_s = backoff_s
+        self.backoff_cap_s = backoff_cap_s
+        self._log = log or (lambda msg: None)
+        self._sleep = sleep
+
+    def run_batch(self, engine, queries) -> None:
+        """Dispatch ``queries`` (<= engine.lanes of them) as one padded
+        batch and resolve every query exactly once. Raises
+        :class:`OomRequeue` when the dispatch OOM'd — the only outcome
+        that leaves the queries unresolved, because re-admission (at a
+        narrower width) is the service's call, not the executor's."""
+        sources = np.asarray([q.source for q in queries], dtype=np.int64)
+        padded, n = pad_batch(sources, engine.lanes)
+        attempt = 0
+        while True:
+            try:
+                res = engine.run(padded, time_it=False)
+                break
+            except Exception as exc:  # noqa: BLE001 — gated by the classifier
+                if is_oom_failure(exc):
+                    raise OomRequeue(list(queries), exc) from exc
+                if is_transient_failure(exc) and attempt < self.max_retries:
+                    attempt += 1
+                    wait = min(self.backoff_s * attempt, self.backoff_cap_s)
+                    self.metrics.record_retry()
+                    COUNTERS.bump("transient_retries")
+                    self._log(
+                        f"transient failure serving a {n}-query batch "
+                        f"(attempt {attempt}/{self.max_retries}): "
+                        f"{type(exc).__name__}: {str(exc)[:200]} — "
+                        f"retrying in {wait:.2f}s"
+                    )
+                    self._sleep(wait)
+                    continue
+                err = f"{type(exc).__name__}: {str(exc)[:300]}"
+                self._log(f"batch failed deterministically: {err}")
+                for q in queries:
+                    q.resolve_status(STATUS_ERROR, error=err)
+                self.metrics.record_errors(n)
+                return
+        self._resolve_ok(engine, res, queries, n)
+
+    def _resolve_ok(self, engine, res, queries, n: int) -> None:
+        from tpu_bfs.graph.csr import INF_DIST
+
+        t_done = time.monotonic()
+        latencies = []
+        for i, q in enumerate(queries):
+            d = res.distances_int32(i)
+            finite = d[d != INF_DIST]
+            latency_ms = (t_done - q.t_submit) * 1e3
+            q.resolve(QueryResult(
+                id=q.id,
+                source=q.source,
+                status=STATUS_OK,
+                distances=d,
+                levels=int(finite.max()) if finite.size else 0,
+                reached=int(res.reached[i]),
+                latency_ms=latency_ms,
+                batch_lanes=n,
+            ))
+            latencies.append(latency_ms)
+        self.metrics.record_batch(n, engine.lanes, latencies)

@@ -1,0 +1,513 @@
+"""The serving front-end: in-process ``BfsService`` + stdin/stdout JSONL.
+
+``BfsService`` is the API tests and the bench drive; the JSONL loop
+(``tpu-bfs-serve`` / ``python -m tpu_bfs.serve``) is the same service
+behind a line protocol:
+
+    request   {"id": 7, "source": 12345}            (+ "deadline_ms")
+    response  {"id": 7, "source": 12345, "status": "ok", "levels": 6,
+               "reached": 104857, "latency_ms": 18.4, "batch_lanes": 31,
+               "distances_npy": "<base64 .npy bytes>"}
+
+Non-ok responses carry ``status`` in {rejected, deadline_exceeded,
+error, shutdown} plus ``error``. Responses are emitted as queries
+complete (batch order, not arrival order); ``id`` is the correlation
+key. stdout carries ONLY protocol lines; logs and the periodic statsz
+line go to stderr.
+
+One scheduler thread owns all device dispatch: clients only enqueue and
+wait, so jax never sees concurrent dispatch from racing threads.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import io
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+
+from tpu_bfs.serve.executor import BatchExecutor, OomRequeue
+from tpu_bfs.serve.metrics import ServeMetrics
+from tpu_bfs.serve.registry import DEFAULT_PLANES, EngineRegistry, EngineSpec
+from tpu_bfs.serve.scheduler import (
+    STATUS_ERROR,
+    STATUS_EXPIRED,
+    STATUS_REJECTED,
+    STATUS_SHUTDOWN,
+    AdmissionQueue,
+    PendingQuery,
+)
+from tpu_bfs.utils.recovery import (
+    COUNTERS,
+    is_oom_failure,
+    is_transient_failure,
+)
+
+MIN_LANES = 32
+
+
+class BfsService:
+    """Long-lived lane-batching BFS query service over one graph.
+
+    ``graph`` is a loaded ``Graph`` or a CLI graph spec string (path /
+    ``rmat:scale=...`` / ``random:n=...``). Queries submitted from any
+    thread are coalesced into packed batches of up to ``lanes`` sources
+    by one scheduler thread; ``linger_ms`` bounds how long a partial
+    batch waits for fill; ``queue_cap`` bounds the backlog (overload
+    sheds with REJECTED); ``deadline_ms`` (default: none) bounds each
+    query's QUEUE wait — see scheduler.py for the semantics. An OOM'd
+    dispatch halves the lane count (floor_lanes ladder, down to 32) and
+    re-admits its queries; transient failures retry in place.
+    """
+
+    def __init__(
+        self,
+        graph,
+        *,
+        engine: str = "wide",
+        lanes: int = 512,
+        planes: int = DEFAULT_PLANES,
+        pull_gate: bool = False,
+        devices: int = 1,
+        linger_ms: float = 2.0,
+        queue_cap: int = 1024,
+        deadline_ms: float = 0.0,
+        max_retries: int = 2,
+        registry: EngineRegistry | None = None,
+        registry_capacity: int = 4,
+        autostart: bool = True,
+        log=None,
+    ):
+        self._log = log or (lambda msg: None)
+        self._registry = registry or EngineRegistry(
+            capacity=registry_capacity, log=self._log
+        )
+        if isinstance(graph, str):
+            self._graph_key = graph
+        else:
+            self._graph_key = f"graph@{id(graph):x}"
+            self._registry.add_graph(self._graph_key, graph)
+        self._graph = self._registry.graph(self._graph_key)
+        self._engine_kind = engine
+        self._planes = planes
+        self._pull_gate = pull_gate
+        self._devices = devices
+        self._lanes = lanes
+        self._spec().validate()  # fail at construction, not first dispatch
+        self._linger_s = max(linger_ms, 0.0) / 1e3
+        self._default_deadline_s = max(deadline_ms, 0.0) / 1e3
+        self._queue = AdmissionQueue(queue_cap)
+        self.metrics = ServeMetrics()
+        self._executor = BatchExecutor(
+            self.metrics, max_retries=max_retries, log=self._log
+        )
+        self._max_retries = max_retries
+        self._closed = False
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+        if autostart:
+            self.start()
+
+    # --- lifecycle --------------------------------------------------------
+
+    def _spec(self) -> EngineSpec:
+        return EngineSpec(
+            graph_key=self._graph_key,
+            engine=self._engine_kind,
+            lanes=self._lanes,
+            planes=self._planes,
+            pull_gate=self._pull_gate,
+            devices=self._devices,
+        )
+
+    def start(self) -> "BfsService":
+        """Build-and-warm the serving engine, then start the scheduler
+        thread. Idempotent; called by the constructor unless
+        ``autostart=False`` (tests that stage queries before dispatch)."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("service is closed")
+            if self._thread is not None:
+                return self
+            self._acquire_engine()  # pay the build+warm before serving
+            self._thread = threading.Thread(
+                target=self._loop, name="bfs-serve-scheduler", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        """Stop serving: in-flight batch completes, queued queries
+        resolve with SHUTDOWN. Idempotent."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            thread = self._thread
+        self._queue.stop()
+        if thread is not None:
+            thread.join()
+        else:
+            # Never started: drain staged queries here instead.
+            for q in self._queue.next_batch(self._queue.cap, 0.0):
+                if q.resolve_status(STATUS_SHUTDOWN, error="service closed"):
+                    self.metrics.record_shutdown()
+
+    def __enter__(self) -> "BfsService":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # --- client API -------------------------------------------------------
+
+    @property
+    def num_vertices(self) -> int:
+        return self._graph.num_vertices
+
+    @property
+    def lanes(self) -> int:
+        """Current serving batch width (halves on OOM degrade)."""
+        return self._lanes
+
+    def submit(self, source, *, id=None, deadline_ms: float | None = None
+               ) -> PendingQuery:
+        """Enqueue one query; returns a PendingQuery whose ``result()``
+        always resolves (ok / rejected / deadline_exceeded / error /
+        shutdown — never a hang, never a silent drop)."""
+        now = time.monotonic()
+        ddl_s = (
+            self._default_deadline_s
+            if deadline_ms is None
+            else max(deadline_ms, 0.0) / 1e3
+        )
+        q = PendingQuery(
+            source, id=id, now=now,
+            deadline=(now + ddl_s) if ddl_s > 0 else None,
+        )
+        if not (0 <= q.source < self._graph.num_vertices):
+            q.resolve_status(
+                STATUS_ERROR,
+                error=f"source {q.source} out of range "
+                      f"[0, {self._graph.num_vertices})",
+            )
+            self.metrics.record_errors()
+            return q
+        if self._closed or not self._queue.offer(q):
+            q.resolve_status(
+                STATUS_REJECTED,
+                error="service closed" if self._closed else "queue full",
+            )
+            self.metrics.record_rejected()
+        return q
+
+    def query(self, source, *, timeout: float | None = None,
+              deadline_ms: float | None = None):
+        """Blocking submit-and-wait convenience."""
+        return self.submit(source, deadline_ms=deadline_ms).result(timeout)
+
+    def statsz(self) -> dict:
+        out = self.metrics.snapshot(
+            queue_depth=self._queue.depth(), lanes=self._lanes
+        )
+        resident = self._registry.resident()
+        # None: a build holds the registry lock right now (resident() is
+        # deliberately non-blocking — see registry.py).
+        out["resident_engines"] = None if resident is None else len(resident)
+        return out
+
+    # --- scheduler thread -------------------------------------------------
+
+    def _acquire_engine(self):
+        """The serving engine for the CURRENT lane count, retrying
+        transient build failures and degrading on build-time OOM (an
+        engine build allocates the packed tables, so it can OOM exactly
+        like a dispatch)."""
+        attempt = 0
+        while True:
+            try:
+                return self._registry.get(self._spec())
+            except Exception as exc:  # noqa: BLE001 — gated by classifiers
+                if is_oom_failure(exc) and self._degrade():
+                    continue
+                if is_transient_failure(exc) and attempt < self._max_retries:
+                    attempt += 1
+                    self.metrics.record_retry()
+                    COUNTERS.bump("transient_retries")
+                    self._log(
+                        f"transient engine-build failure (attempt "
+                        f"{attempt}/{self._max_retries}): {str(exc)[:200]}"
+                    )
+                    time.sleep(min(0.05 * attempt, 2.0))
+                    continue
+                raise
+
+    def _degrade(self, requeued: int = 0) -> bool:
+        """Halve the serving lane count after an OOM (dispatch- or
+        build-time); False at the floor. ``requeued`` is the query count
+        the caller is about to re-admit, for the metrics record. The
+        OOM'd width's engine is evicted from the registry first: the
+        narrower rebuild must not have to fit next to the dying engine's
+        tables, and every wider rung would otherwise stay pinned in HBM."""
+        from tpu_bfs.algorithms._packed_common import floor_lanes
+
+        new = floor_lanes(max(MIN_LANES, self._lanes // 2))
+        if new >= self._lanes:
+            return False
+        self._registry.evict(self._spec())
+        self._log(f"OOM degrade: {self._lanes} -> {new} lanes")
+        self._lanes = new
+        COUNTERS.bump("oom_degrades")
+        self.metrics.record_oom_degrade(requeued)
+        return True
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._queue.next_batch(self._lanes, self._linger_s)
+            if self._queue.stopped:
+                n = 0
+                for q in batch:
+                    if q.resolve_status(STATUS_SHUTDOWN, error="service closed"):
+                        n += 1
+                if n:
+                    self.metrics.record_shutdown(n)
+                if not batch:
+                    return
+                continue
+            now = time.monotonic()
+            live = []
+            expired = 0
+            for q in batch:
+                if q.expired(now):
+                    if q.resolve_status(
+                        STATUS_EXPIRED,
+                        error="deadline expired before dispatch",
+                    ):
+                        expired += 1
+                else:
+                    live.append(q)
+            if expired:
+                self.metrics.record_expired(expired)
+            if not live:
+                continue
+            try:
+                engine = self._acquire_engine()
+                if len(live) > engine.lanes:
+                    # A build-time OOM degraded the width AFTER this batch
+                    # was popped at the old one: serve what fits, re-admit
+                    # the tail at the front (same contract as OomRequeue —
+                    # degrade must never turn into error responses).
+                    self._queue.requeue(live[engine.lanes:])
+                    live = live[: engine.lanes]
+                self._executor.run_batch(engine, live)
+            except OomRequeue as exc:
+                # Drop this frame's reference to the OOM'd engine before
+                # the narrower rebuild (the registry eviction in _degrade
+                # frees the tables only once nothing else holds them).
+                engine = None  # noqa: F841 — releases device tables
+                if self._degrade(requeued=len(exc.queries)):
+                    self._queue.requeue(exc.queries)
+                    continue
+                err = (
+                    f"out of memory at the minimum lane count "
+                    f"({self._lanes}): {str(exc.cause)[:200]}"
+                )
+                self._log(err)
+                for q in exc.queries:
+                    q.resolve_status(STATUS_ERROR, error=err)
+                self.metrics.record_errors(len(exc.queries))
+            except Exception as exc:  # noqa: BLE001 — engine build failed
+                err = f"{type(exc).__name__}: {str(exc)[:300]}"
+                self._log(f"engine unavailable: {err}")
+                for q in live:
+                    q.resolve_status(STATUS_ERROR, error=err)
+                self.metrics.record_errors(len(live))
+
+
+# --- JSONL protocol -------------------------------------------------------
+
+
+def _encode_distances(d: np.ndarray) -> str:
+    buf = io.BytesIO()
+    np.save(buf, d)
+    return base64.b64encode(buf.getvalue()).decode("ascii")
+
+
+def decode_distances(payload: str) -> np.ndarray:
+    """Inverse of the response's ``distances_npy`` field (client helper,
+    also what the tests and `make serve-smoke` round-trip through)."""
+    return np.load(io.BytesIO(base64.b64decode(payload)))
+
+
+def result_to_response(r, *, with_distances: bool = True) -> dict:
+    out = {"id": r.id, "source": r.source, "status": r.status}
+    if r.ok:
+        out["levels"] = r.levels
+        out["reached"] = r.reached
+        out["latency_ms"] = round(r.latency_ms, 3)
+        out["batch_lanes"] = r.batch_lanes
+        if with_distances:
+            out["distances_npy"] = _encode_distances(r.distances)
+    else:
+        out["error"] = r.error
+        if r.latency_ms is not None:
+            out["latency_ms"] = round(r.latency_ms, 3)
+    return out
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="tpu-bfs-serve",
+        description="Lane-batching BFS query server: JSONL requests "
+        '({"id":..,"source":..}) on stdin, one JSON response line each '
+        "on stdout; logs and periodic statsz on stderr.",
+    )
+    ap.add_argument("graph", help="graph file path or generator spec "
+                    "(rmat:scale=20,ef=16 | random:n=...,m=...)")
+    ap.add_argument("--engine", default="wide",
+                    choices=["wide", "hybrid", "packed"],
+                    help="serving engine (default wide; hybrid needs "
+                    ">= 4096 lanes)")
+    ap.add_argument("--lanes", type=int, default=512,
+                    help="batch width = max queries per dispatch "
+                    "(multiple of 32; default 512)")
+    ap.add_argument("--planes", type=int, default=DEFAULT_PLANES,
+                    choices=range(1, 9), metavar="P",
+                    help=f"bit-plane count (depth cap 2**P; default "
+                    f"{DEFAULT_PLANES} — serving favors depth headroom)")
+    ap.add_argument("--pull-gate", action="store_true",
+                    help="frontier-aware pull gate (wide/hybrid engines)")
+    ap.add_argument("--devices", type=int, default=1,
+                    help="shard the engine over N devices (default 1)")
+    ap.add_argument("--linger-ms", type=float, default=2.0,
+                    help="max wait for batch fill before dispatching a "
+                    "partial batch (default 2.0)")
+    ap.add_argument("--queue-cap", type=int, default=1024,
+                    help="admission queue bound; beyond it queries are "
+                    "shed with status=rejected (default 1024)")
+    ap.add_argument("--deadline-ms", type=float, default=0.0,
+                    help="default per-query queue-wait deadline; 0 = none "
+                    "(per-request \"deadline_ms\" overrides)")
+    ap.add_argument("--max-retries", type=int, default=2,
+                    help="transient-failure re-dispatches per batch "
+                    "(default 2)")
+    ap.add_argument("--no-distances", action="store_true",
+                    help="omit the distances_npy payload from responses "
+                    "(metadata-only serving)")
+    ap.add_argument("--statsz-every", type=float, default=10.0,
+                    help="seconds between statsz lines on stderr; 0 "
+                    "disables (default 10)")
+    ap.add_argument("--registry-cap", type=int, default=4,
+                    help="LRU bound on resident warmed engines (default 4)")
+    return ap
+
+
+def run_server(args, stdin=None, stdout=None, stderr=None,
+               registry=None) -> int:
+    """The JSONL loop, parameterized over streams (and optionally a
+    shared registry) so tests run it in-process. Reads requests until
+    EOF, then drains outstanding responses, prints a final statsz line,
+    and closes the service."""
+    stdin = sys.stdin if stdin is None else stdin
+    stdout = sys.stdout if stdout is None else stdout
+    stderr = sys.stderr if stderr is None else stderr
+
+    def log(msg: str) -> None:
+        print(f"# {msg}", file=stderr, flush=True)
+
+    service = BfsService(
+        args.graph,
+        engine=args.engine,
+        lanes=args.lanes,
+        planes=args.planes,
+        pull_gate=args.pull_gate,
+        devices=args.devices,
+        linger_ms=args.linger_ms,
+        queue_cap=args.queue_cap,
+        deadline_ms=args.deadline_ms,
+        max_retries=args.max_retries,
+        registry=registry,
+        registry_capacity=args.registry_cap,
+        log=log,
+    )
+    out_lock = threading.Lock()
+    outstanding = [0]
+    drained = threading.Condition(out_lock)
+
+    def emit(resp: dict) -> None:
+        with out_lock:
+            stdout.write(json.dumps(resp) + "\n")
+            stdout.flush()
+
+    def on_done(q: PendingQuery) -> None:
+        emit(result_to_response(
+            q.result(), with_distances=not args.no_distances
+        ))
+        with drained:
+            outstanding[0] -= 1
+            if outstanding[0] == 0:
+                drained.notify_all()
+
+    stop_statsz = threading.Event()
+    if args.statsz_every > 0:
+        def statsz_loop() -> None:
+            while not stop_statsz.wait(args.statsz_every):
+                print(service.metrics.statsz_line(
+                    queue_depth=service._queue.depth(), lanes=service.lanes,
+                ), file=stderr, flush=True)
+
+        threading.Thread(
+            target=statsz_loop, name="bfs-serve-statsz", daemon=True
+        ).start()
+
+    log(f"serving {args.graph!r}: engine={args.engine} lanes={args.lanes} "
+        f"linger={args.linger_ms}ms queue_cap={args.queue_cap}")
+    try:
+        for line in stdin:
+            line = line.strip()
+            if not line:
+                continue
+            qid = None
+            try:
+                req = json.loads(line)
+                if not isinstance(req, dict):
+                    raise TypeError("request must be a JSON object")
+                qid = req.get("id")
+                source = int(req["source"])
+                ddl = req.get("deadline_ms")
+                ddl = float(ddl) if ddl is not None else None
+            except (ValueError, KeyError, TypeError) as exc:
+                emit({
+                    "id": qid,
+                    "status": STATUS_ERROR,
+                    "error": f"bad request: {exc!r}",
+                })
+                continue
+            with drained:
+                outstanding[0] += 1
+            service.submit(
+                source, id=qid, deadline_ms=ddl,
+            ).add_done_callback(on_done)
+        with drained:
+            while outstanding[0] > 0:
+                drained.wait()
+    finally:
+        stop_statsz.set()
+        print(service.metrics.statsz_line(
+            queue_depth=service._queue.depth(), lanes=service.lanes,
+        ), file=stderr, flush=True)
+        service.close()
+    return 0
+
+
+def main(argv=None) -> int:
+    return run_server(build_arg_parser().parse_args(argv))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,129 @@
+"""Serve-mode observability: the /statsz counters.
+
+The traversal engines' observability (utils/stats.py) is per-run; a
+server needs per-PROCESS counters that survive across batches — QPS,
+latency percentiles, batch fill ratio, queue depth, retries, sheds. One
+lock guards everything: every writer is either the scheduler thread or a
+client thread shedding at admission, and the snapshot is read at human
+timescales (the periodic statsz line), so contention is irrelevant next
+to a device dispatch.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+# Latency reservoir size: percentiles are computed over the most recent
+# window, not all-time (a server that ran a slow cold batch an hour ago
+# should not report it in p99 forever). 4096 completions cover minutes of
+# saturated traffic at serving batch sizes.
+LATENCY_WINDOW = 4096
+
+
+class ServeMetrics:
+    """Thread-safe serve counters + a bounded latency reservoir."""
+
+    def __init__(self, *, now=time.monotonic):
+        self._now = now
+        self._lock = threading.Lock()
+        self._t0 = now()
+        self._latencies_ms: deque = deque(maxlen=LATENCY_WINDOW)
+        self.completed = 0
+        self.rejected = 0  # shed at admission (queue full / closed)
+        self.expired = 0  # deadline passed while queued
+        self.errors = 0
+        self.shutdown = 0  # resolved unserved at close
+        self.retries = 0  # transient-failure re-dispatches
+        self.oom_degrades = 0  # lane-count halvings after OOM
+        self.requeued = 0  # queries re-admitted after an OOM'd batch
+        self.batches = 0
+        self.lanes_used = 0  # real (non-pad) queries across all batches
+        self.lanes_offered = 0  # sum of batch capacity (engine lanes)
+        # Interval bookkeeping for the statsz line's recent-QPS figure.
+        self._last_snap_t = self._t0
+        self._last_snap_completed = 0
+
+    def record_batch(self, used: int, capacity: int, latencies_ms) -> None:
+        with self._lock:
+            self.batches += 1
+            self.lanes_used += used
+            self.lanes_offered += capacity
+            self.completed += len(latencies_ms)
+            self._latencies_ms.extend(latencies_ms)
+
+    def record_rejected(self) -> None:
+        with self._lock:
+            self.rejected += 1
+
+    def record_expired(self, n: int = 1) -> None:
+        with self._lock:
+            self.expired += n
+
+    def record_errors(self, n: int = 1) -> None:
+        with self._lock:
+            self.errors += n
+
+    def record_shutdown(self, n: int = 1) -> None:
+        with self._lock:
+            self.shutdown += n
+
+    def record_retry(self) -> None:
+        with self._lock:
+            self.retries += 1
+
+    def record_oom_degrade(self, requeued: int) -> None:
+        with self._lock:
+            self.oom_degrades += 1
+            self.requeued += requeued
+
+    def snapshot(self, *, queue_depth: int | None = None,
+                 lanes: int | None = None, mark_interval: bool = False) -> dict:
+        """One /statsz observation. ``interval_qps`` covers the window
+        since the last ``mark_interval=True`` snapshot; only the ONE
+        periodic emitter (statsz_line) passes that flag — ad-hoc
+        observers (BfsService.statsz, the bench) must not reset the
+        periodic line's window. ``qps`` is lifetime."""
+        with self._lock:
+            now = self._now()
+            uptime = max(now - self._t0, 1e-9)
+            interval = max(now - self._last_snap_t, 1e-9)
+            interval_done = self.completed - self._last_snap_completed
+            if mark_interval:
+                self._last_snap_t = now
+                self._last_snap_completed = self.completed
+            lat = np.asarray(self._latencies_ms, dtype=np.float64)
+            out = {
+                "uptime_s": round(uptime, 3),
+                "completed": self.completed,
+                "qps": round(self.completed / uptime, 2),
+                "interval_qps": round(interval_done / interval, 2),
+                "p50_ms": round(float(np.percentile(lat, 50)), 3) if lat.size else None,
+                "p99_ms": round(float(np.percentile(lat, 99)), 3) if lat.size else None,
+                "fill_ratio": round(
+                    self.lanes_used / self.lanes_offered, 4
+                ) if self.lanes_offered else 0.0,
+                "batches": self.batches,
+                "rejected": self.rejected,
+                "expired": self.expired,
+                "errors": self.errors,
+                "shutdown": self.shutdown,
+                "retries": self.retries,
+                "oom_degrades": self.oom_degrades,
+                "requeued": self.requeued,
+            }
+        if queue_depth is not None:
+            out["queue_depth"] = queue_depth
+        if lanes is not None:
+            out["lanes"] = lanes
+        return out
+
+    def statsz_line(self, **kw) -> str:
+        """The periodic stderr line: a stable prefix + one JSON object, so
+        log scrapers can grep ``statsz`` and parse the rest. The ONLY
+        caller that advances the interval-QPS window."""
+        return "statsz " + json.dumps(self.snapshot(mark_interval=True, **kw))

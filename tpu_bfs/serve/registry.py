@@ -1,0 +1,218 @@
+"""Warm-engine registry: graphs loaded once, engines built-and-warmed once.
+
+A fresh engine build costs an ELL/tile build plus an XLA compile of the
+packed level loop (~20-40 s first-compile on chip); a server cannot pay
+that per query. The registry keys resident engines by
+``(graph_key, engine, lanes, pull_gate, devices)`` — every axis that
+changes the compiled program — warms each build with one full-width
+batch so serving dispatches never see the compile, and bounds residency
+with an LRU (each resident engine holds its packed tables in HBM, so
+"cache them all" is not an option).
+
+``enable_compile_cache`` (utils/compile_cache.py) is armed at registry
+construction: the warm-up run populates the persistent XLA cache, so
+even an evicted-and-rebuilt engine (or a restarted server) pays a disk
+hit, not a recompile.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import OrderedDict
+
+import numpy as np
+
+from tpu_bfs.utils.compile_cache import enable_compile_cache
+
+ENGINE_KINDS = ("wide", "hybrid", "packed")
+
+# Serving engines default to 8 planes (254-level depth cap) where the
+# one-shot CLI defaults to 5 (32 levels): a server answers arbitrary
+# sources on a long-lived process, and one high-eccentricity query
+# truncating a whole batch into error responses costs far more than the
+# 3 extra planes' HBM.
+DEFAULT_PLANES = 8
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineSpec:
+    """One resident engine's identity — everything that changes the
+    compiled program or its tables."""
+
+    graph_key: str
+    engine: str = "wide"
+    lanes: int = 512
+    planes: int = DEFAULT_PLANES
+    pull_gate: bool = False
+    devices: int = 1
+
+    def validate(self) -> None:
+        if self.engine not in ENGINE_KINDS:
+            raise ValueError(
+                f"engine must be one of {ENGINE_KINDS}, got {self.engine!r}"
+            )
+        if self.lanes % 32 or self.lanes < 32:
+            raise ValueError(
+                f"lanes must be a multiple of 32 >= 32, got {self.lanes}"
+            )
+        if self.engine == "packed" and self.pull_gate:
+            raise ValueError(
+                "pull_gate applies to the wide/hybrid engines (the packed "
+                "engine keeps no settled-mask state)"
+            )
+        if self.engine == "packed" and self.devices > 1:
+            raise ValueError("the packed engine is single-device")
+        if self.engine == "wide" and self.devices > 1 and self.pull_gate:
+            # Mirrors the CLI's rejection: the distributed wide engine has
+            # no gate machinery — silently serving ungated would lie.
+            raise ValueError(
+                "pull_gate on a mesh runs through the distributed hybrid "
+                "engine; use engine='hybrid' with devices > 1"
+            )
+
+
+class EngineRegistry:
+    """LRU-bounded store of warmed engines over once-loaded graphs."""
+
+    def __init__(self, *, capacity: int = 4, warm: bool = True, log=None):
+        if capacity < 1:
+            raise ValueError(f"registry capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._warm = warm
+        self._log = log or (lambda msg: None)
+        self._graphs: dict = {}
+        self._engines: OrderedDict = OrderedDict()
+        # One build at a time: engine builds allocate device tables, and
+        # two concurrent builds of the same spec would double-build AND
+        # double-allocate. RLock so get() -> _build() -> graph() nests.
+        self._lock = threading.RLock()
+        self.builds = 0
+        self.evictions = 0
+        enable_compile_cache(log=self._log)
+
+    # --- graphs -----------------------------------------------------------
+
+    def add_graph(self, key: str, graph) -> str:
+        """Register an already-loaded Graph under ``key``."""
+        with self._lock:
+            self._graphs[key] = graph
+        return key
+
+    def graph(self, key: str):
+        """The graph for ``key``, loading it on first use when the key is
+        a CLI graph spec (path / rmat:... / random:...)."""
+        with self._lock:
+            g = self._graphs.get(key)
+            if g is None:
+                from tpu_bfs.cli import load_graph
+
+                t0 = time.perf_counter()
+                g = load_graph(key)
+                self._graphs[key] = g
+                self._log(
+                    f"graph {key!r} loaded: V={g.num_vertices} "
+                    f"E={g.num_edges} in {time.perf_counter() - t0:.1f}s"
+                )
+            return g
+
+    # --- engines ----------------------------------------------------------
+
+    def get(self, spec: EngineSpec):
+        """The warmed engine for ``spec``, building it on first use and
+        evicting least-recently-served engines over ``capacity``."""
+        spec.validate()
+        with self._lock:
+            eng = self._engines.get(spec)
+            if eng is not None:
+                self._engines.move_to_end(spec)
+                return eng
+            eng = self._build(spec)
+            if self._warm:
+                self._warm_up(spec, eng)
+            self._engines[spec] = eng
+            while len(self._engines) > self.capacity:
+                old_spec, _ = self._engines.popitem(last=False)
+                self.evictions += 1
+                self._log(f"evicted engine {old_spec}")
+            return eng
+
+    def _build(self, spec: EngineSpec):
+        g = self.graph(spec.graph_key)
+        t0 = time.perf_counter()
+        if spec.devices > 1:
+            from tpu_bfs.parallel.dist_bfs import make_mesh
+
+            mesh = make_mesh(spec.devices)
+            if spec.engine == "wide":
+                from tpu_bfs.parallel.dist_msbfs_wide import DistWideMsBfsEngine
+
+                eng = DistWideMsBfsEngine(
+                    g, mesh, num_planes=spec.planes, lanes=spec.lanes
+                )
+            else:
+                from tpu_bfs.parallel.dist_msbfs_hybrid import (
+                    DistHybridMsBfsEngine,
+                )
+
+                eng = DistHybridMsBfsEngine(
+                    g, mesh, num_planes=spec.planes, lanes=spec.lanes,
+                    pull_gate=spec.pull_gate,
+                )
+        elif spec.engine == "packed":
+            from tpu_bfs.algorithms.msbfs_packed import PackedMsBfsEngine
+
+            eng = PackedMsBfsEngine(g, lanes=spec.lanes)
+        elif spec.engine == "hybrid":
+            from tpu_bfs.algorithms.msbfs_hybrid import HybridMsBfsEngine
+
+            eng = HybridMsBfsEngine(
+                g, lanes=spec.lanes, num_planes=spec.planes,
+                pull_gate=spec.pull_gate,
+            )
+        else:
+            from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
+
+            eng = WidePackedMsBfsEngine(
+                g, lanes=spec.lanes, num_planes=spec.planes,
+                pull_gate=spec.pull_gate,
+            )
+        self.builds += 1
+        self._log(f"engine built {spec} in {time.perf_counter() - t0:.1f}s")
+        return eng
+
+    def _warm_up(self, spec: EngineSpec, eng) -> None:
+        """One full-width batch so the serving shape is compiled (and the
+        persistent XLA cache populated) before the first real dispatch.
+        The serving executor always pads batches to exactly ``lanes``
+        sources, so this warm run compiles THE shape every later dispatch
+        reuses. Vertex 0 always exists; its answer is discarded."""
+        t0 = time.perf_counter()
+        eng.run(np.zeros(eng.lanes, dtype=np.int64), time_it=False)
+        self._log(f"engine warmed {spec} in {time.perf_counter() - t0:.1f}s")
+
+    def evict(self, spec: EngineSpec) -> bool:
+        """Drop ``spec``'s engine (if resident) so its device tables can
+        free. The OOM-degrade ladder calls this on the JUST-OOM'd width
+        BEFORE building the narrower engine — the rebuild must not have
+        to fit next to the dying engine's allocations (the same lesson
+        bench.py's adaptive-shed dance encodes)."""
+        with self._lock:
+            if self._engines.pop(spec, None) is None:
+                return False
+            self.evictions += 1
+            self._log(f"evicted engine {spec} (explicit)")
+            return True
+
+    def resident(self) -> list | None:
+        """Resident specs, least-recently-served first (for /statsz), or
+        None when a build currently holds the registry lock — the
+        observability read must never block behind a minutes-long
+        compile (it exists to watch exactly those incidents)."""
+        if not self._lock.acquire(timeout=0.05):
+            return None
+        try:
+            return list(self._engines)
+        finally:
+            self._lock.release()

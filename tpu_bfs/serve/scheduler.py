@@ -1,0 +1,190 @@
+"""Admission queue + lane-batch coalescing for the BFS query server.
+
+Single-source queries arrive one at a time; the packed engines answer up
+to ``lanes`` of them in one device dispatch. The scheduler's whole job is
+bridging that impedance:
+
+- a BOUNDED queue (``queue_cap``): at overload, new queries are shed with
+  an explicit REJECTED result instead of growing an unbounded backlog —
+  a server that queues forever converts overload into timeout storms;
+- COALESCING: each dispatch drains up to ``max_n`` pending queries into
+  one batch, lingering up to ``linger_s`` for stragglers when the batch
+  is not yet full (latency <-> fill trade, the --linger-ms knob);
+- DEADLINES: a query whose deadline passes while queued resolves with
+  DEADLINE_EXCEEDED at batch-forming time. Deadlines bound queue WAIT,
+  not device execution — once dispatched, a batch runs to completion and
+  late results are still delivered (killing a running batch would punish
+  its 8000 batch-mates for one impatient client).
+
+Every admitted query is resolved exactly once — completion, expiry,
+rejection, error, or shutdown — never silently dropped (the acceptance
+bar: "never hangs, never silent drops").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+STATUS_OK = "ok"
+STATUS_REJECTED = "rejected"  # shed at admission (queue full / closed)
+STATUS_EXPIRED = "deadline_exceeded"
+STATUS_ERROR = "error"
+STATUS_SHUTDOWN = "shutdown"  # still queued when the service closed
+
+
+@dataclasses.dataclass
+class QueryResult:
+    """One query's terminal outcome (exactly one per admitted query)."""
+
+    id: object
+    source: int
+    status: str
+    distances: np.ndarray | None = None  # [V] int32, INF_DIST unreached
+    levels: int | None = None  # this source's eccentricity (max finite dist)
+    reached: int | None = None
+    latency_ms: float | None = None  # submit -> resolve
+    batch_lanes: int | None = None  # real queries in the serving batch
+    error: str | None = None
+
+    @property
+    def ok(self) -> bool:
+        return self.status == STATUS_OK
+
+
+_QUERY_SEQ = itertools.count(1)
+
+
+class PendingQuery:
+    """A submitted query: a one-shot future the scheduler resolves.
+
+    ``resolve`` is idempotent (first writer wins) so racy paths — e.g. a
+    shutdown drain against an in-flight batch completing — can both try
+    without double-delivery. Callbacks added after resolution fire
+    immediately on the caller's thread."""
+
+    __slots__ = ("id", "source", "deadline", "t_submit", "_event", "_lock",
+                 "_result", "_callbacks")
+
+    def __init__(self, source: int, *, id=None, deadline: float | None = None,
+                 now: float | None = None):
+        self.id = next(_QUERY_SEQ) if id is None else id
+        self.source = int(source)
+        self.deadline = deadline  # absolute time.monotonic() value, or None
+        self.t_submit = time.monotonic() if now is None else now
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self._result: QueryResult | None = None
+        self._callbacks: list = []
+
+    def expired(self, now: float) -> bool:
+        return self.deadline is not None and now > self.deadline
+
+    def resolve(self, result: QueryResult) -> bool:
+        """Deliver the terminal result; False if already resolved."""
+        with self._lock:
+            if self._result is not None:
+                return False
+            self._result = result
+            callbacks, self._callbacks = self._callbacks, []
+        self._event.set()
+        for cb in callbacks:
+            cb(self)
+        return True
+
+    def resolve_status(self, status: str, *, error: str | None = None) -> bool:
+        return self.resolve(QueryResult(
+            id=self.id, source=self.source, status=status, error=error,
+            latency_ms=(time.monotonic() - self.t_submit) * 1e3,
+        ))
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: float | None = None) -> QueryResult:
+        if not self._event.wait(timeout):
+            raise TimeoutError(f"query {self.id!r} still pending after {timeout}s")
+        return self._result
+
+    def add_done_callback(self, cb) -> None:
+        with self._lock:
+            if self._result is None:
+                self._callbacks.append(cb)
+                return
+        cb(self)
+
+
+class AdmissionQueue:
+    """Bounded FIFO of PendingQuery with batch-draining semantics.
+
+    The queue itself never resolves queries (metrics and result policy
+    stay with the service); it only admits, re-admits, and hands out
+    batches. ``requeue`` bypasses the cap: those queries were already
+    admitted once, and dropping them on re-admission after an OOM would
+    be a silent drop."""
+
+    def __init__(self, cap: int):
+        if cap < 1:
+            raise ValueError(f"queue cap must be >= 1, got {cap}")
+        self.cap = cap
+        self._items: deque = deque()
+        self._cond = threading.Condition()
+        self._stopped = False
+
+    def offer(self, q: PendingQuery) -> bool:
+        """Admit, or False when the queue is full/stopped (caller sheds)."""
+        with self._cond:
+            if self._stopped or len(self._items) >= self.cap:
+                return False
+            self._items.append(q)
+            self._cond.notify()
+            return True
+
+    def requeue(self, queries) -> None:
+        """Re-admit (at the FRONT, preserving order) queries popped by a
+        batch that could not run — an OOM'd dispatch being re-served at a
+        narrower lane count must not send its queries to the back of the
+        line, and must never shed them against the cap."""
+        with self._cond:
+            for q in reversed(list(queries)):
+                self._items.appendleft(q)
+            self._cond.notify()
+
+    def depth(self) -> int:
+        with self._cond:
+            return len(self._items)
+
+    @property
+    def stopped(self) -> bool:
+        return self._stopped
+
+    def next_batch(self, max_n: int, linger_s: float) -> list:
+        """Block until work exists, then drain up to ``max_n`` queries.
+
+        When fewer than ``max_n`` are pending, lingers up to ``linger_s``
+        from the moment the batch starts forming, returning early the
+        instant it fills. After ``stop()`` the remaining queries drain
+        immediately (no linger) so shutdown is prompt; returns [] only
+        when stopped AND empty."""
+        with self._cond:
+            while not self._items and not self._stopped:
+                self._cond.wait()
+            if not self._stopped and linger_s > 0 and len(self._items) < max_n:
+                deadline = time.monotonic() + linger_s
+                while len(self._items) < max_n and not self._stopped:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(remaining)
+            n = min(max_n, len(self._items))
+            return [self._items.popleft() for _ in range(n)]
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()

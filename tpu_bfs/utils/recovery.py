@@ -12,7 +12,54 @@ classifier guards the benchmark's compile-heavy stages (bench.py).
 
 from __future__ import annotations
 
+import dataclasses
+import threading
 import time
+
+
+@dataclasses.dataclass
+class RecoveryCounters:
+    """Process-wide retry/degrade visibility (one instance: ``COUNTERS``).
+
+    Until round 6 every retry here and in bench.py was invisible after
+    the fact — a run that survived three transient failures and an OOM
+    shed reported the same clean output as one that never hiccuped, so
+    serve-mode incidents left no post-hoc trace. Every retry path now
+    bumps these; the CLI's --stats emits them as a final JSON line and
+    bench.py attaches them to its verdict line when any fired."""
+
+    transient_retries: int = 0  # re-attempts after a transient classification
+    engine_rebuilds: int = 0  # advance_with_recovery engine reconstructions
+    backend_init_resets: int = 0  # reset_failed_backend_init firings
+    oom_degrades: int = 0  # OOM-driven sheds/lane-halvings (bench + serve)
+
+    _lock: threading.Lock = dataclasses.field(
+        default_factory=threading.Lock, repr=False, compare=False
+    )
+
+    def bump(self, field: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, field, getattr(self, field) + n)
+
+    def as_dict(self) -> dict:
+        with self._lock:
+            return {
+                f.name: getattr(self, f.name)
+                for f in dataclasses.fields(self)
+                if f.name != "_lock"
+            }
+
+    def any(self) -> bool:
+        return any(self.as_dict().values())
+
+    def reset(self) -> None:
+        with self._lock:
+            for f in dataclasses.fields(self):
+                if f.name != "_lock":
+                    setattr(self, f.name, 0)
+
+
+COUNTERS = RecoveryCounters()
 
 # Substrings that mark an error as plausibly-transient infrastructure
 # trouble: compile-service/transport failures and XLA's INTERNAL/UNAVAILABLE
@@ -123,6 +170,7 @@ def reset_failed_backend_init(exc: BaseException, *, log=None) -> bool:
     except Exception as clear_exc:  # noqa: BLE001 — best-effort
         if log is not None:
             log(f"backend cache clear failed ({clear_exc!r}); retrying anyway")
+    COUNTERS.bump("backend_init_resets")
     return True
 
 
@@ -163,6 +211,8 @@ def advance_with_recovery(
             if restarts >= max_restarts or not is_transient_failure(exc):
                 raise
             restarts += 1
+            COUNTERS.bump("transient_retries")
+            COUNTERS.bump("engine_rebuilds")
             if log is not None:
                 log(
                     f"transient failure at level {ckpt.level} "
@@ -181,6 +231,8 @@ def advance_with_recovery(
                     if restarts >= max_restarts or not is_transient_failure(exc2):
                         raise
                     restarts += 1
+                    COUNTERS.bump("transient_retries")
+                    COUNTERS.bump("engine_rebuilds")
                     if log is not None:
                         log(
                             f"transient failure rebuilding the engine "

@@ -99,3 +99,17 @@ def level_stats(distance: np.ndarray, degrees: np.ndarray,
         unreached=int((~reached_mask).sum()),
         gated_tiles=gt,
     )
+
+
+def recovery_stats_line() -> str | None:
+    """The --stats trailer surfacing the process's recovery counters
+    (utils/recovery.COUNTERS): one ``{"recovery": {...}}`` JSON line when
+    any retry/rebuild/OOM-degrade fired this process, None otherwise — a
+    run that silently survived infrastructure trouble must say so in the
+    same place its level stats land (round-6 satellite: recovery used to
+    retry with no post-hoc trace)."""
+    from tpu_bfs.utils.recovery import COUNTERS
+
+    if not COUNTERS.any():
+        return None
+    return json.dumps({"recovery": COUNTERS.as_dict()})

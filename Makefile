@@ -16,17 +16,24 @@ native:
 bench:
 	python bench.py
 
-# Round-trip 3 queries through the JSONL serving frontend on CPU
-# (tpu_bfs/serve; README "Serving mode") and check the responses decode.
+# Round-trip 4 queries through the JSONL serving frontend on CPU
+# (tpu_bfs/serve; README "Serving mode") over a 2-width ladder, so the
+# adaptive routing + pipelined extraction path runs in CI, not just on
+# chip; checks the distance payloads decode and that a
+# want_distances=false request answers metadata-only.
 serve-smoke:
-	printf '{"id":1,"source":0}\n{"id":2,"source":3}\n{"id":3,"source":5}\n' | \
+	printf '{"id":1,"source":0}\n{"id":2,"source":3}\n{"id":3,"source":5}\n{"id":4,"source":5,"want_distances":false}\n' | \
 	env JAX_PLATFORMS=cpu python -m tpu_bfs.serve random:n=96,m=480,seed=3 \
-	  --lanes 32 --linger-ms 1 --statsz-every 0 | \
+	  --lanes 64 --ladder 32,64 --linger-ms 1 --statsz-every 0 | \
 	python -c "import sys, json; \
 	from tpu_bfs.serve.frontend import decode_distances; \
 	rs = [json.loads(l) for l in sys.stdin if l.strip()]; \
-	assert len(rs) == 3 and all(r['status'] == 'ok' for r in rs), rs; \
-	assert all(int(decode_distances(r['distances_npy'])[r['source']]) == 0 for r in rs), rs; \
+	assert len(rs) == 4 and all(r['status'] == 'ok' for r in rs), rs; \
+	assert all(r['dispatched_lanes'] == 32 for r in rs), rs; \
+	withd = [r for r in rs if r['id'] != 4]; \
+	assert all(int(decode_distances(r['distances_npy'])[r['source']]) == 0 for r in withd), rs; \
+	meta = [r for r in rs if r['id'] == 4][0]; \
+	assert 'distances_npy' not in meta and meta['levels'] >= 1, rs; \
 	print('serve-smoke OK:', sorted(r['id'] for r in rs))"
 
 wheel:

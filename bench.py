@@ -12,10 +12,12 @@ Env overrides: TPU_BFS_BENCH_SCALE (default 21), TPU_BFS_BENCH_EF (16),
 TPU_BFS_BENCH_MODE (hybrid|wide|msbfs|single|single-dopt|single-tiled|
 serve|lj-hybrid|lj-single-dopt — the lj-* modes bench the LiveJournal-shaped
 stand-in, NONETWORK.md; 'serve' is the closed-loop serve-throughput stage
-over tpu_bfs/serve, emitting serve_qps/serve_p99_ms/fill_ratio with knobs
-TPU_BFS_BENCH_SERVE_CLIENTS (64) / TPU_BFS_BENCH_SERVE_QUERIES (8 per
-client) / TPU_BFS_BENCH_SERVE_LANES (256) / TPU_BFS_BENCH_SERVE_ENGINE
-(wide)),
+over tpu_bfs/serve, emitting serve_qps/serve_p99_ms/fill_ratio/
+serve_routing/serve_extract_p50_ms with knobs TPU_BFS_BENCH_SERVE_CLIENTS
+(64) / TPU_BFS_BENCH_SERVE_QUERIES (8 per client) /
+TPU_BFS_BENCH_SERVE_LANES (256, the ladder max) /
+TPU_BFS_BENCH_SERVE_LADDER (auto|off|'32,128,...') /
+TPU_BFS_BENCH_SERVE_PIPELINE (1) / TPU_BFS_BENCH_SERVE_ENGINE (wide)),
 TPU_BFS_BENCH_LANES (msbfs mode, 512), TPU_BFS_BENCH_MAX_LANES (hybrid/wide
 modes, 8192 = the measured default — sweep knob), TPU_BFS_BENCH_SOURCES (single
 modes, 8), TPU_BFS_BENCH_VALIDATE (1), TPU_BFS_BENCH_VALIDATE_LANES (4),
@@ -1096,12 +1098,19 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
     — each submitting its next query the moment the previous one resolves,
     until TPU_BFS_BENCH_SERVE_QUERIES (default 8 per client) complete.
     The JSON line's value is serve QPS; serve_p99_ms / serve_p50_ms /
-    fill_ratio ride along (the serving latency/throughput record the
-    one-shot GTEPS metric cannot express). TPU_BFS_BENCH_SERVE_LANES
-    (default 256) sets the batch width — smaller than the flagship's 8192
-    because a serving batch only ever carries the queries that are
-    actually waiting. Validation: TPU_BFS_BENCH_VALIDATE_LANES responses
-    re-checked against the SciPy oracle."""
+    fill_ratio (vs DISPATCHED width) / serve_routing (the width ladder's
+    per-width batch histogram) ride along (the serving latency/throughput
+    record the one-shot GTEPS metric cannot express).
+    TPU_BFS_BENCH_SERVE_LANES (default 256) sets the MAX batch width —
+    smaller than the flagship's 8192 because a serving batch only ever
+    carries the queries that are actually waiting;
+    TPU_BFS_BENCH_SERVE_LADDER ('auto' default, 'off', or an explicit
+    '32,128,...' list) sets the adaptive-width ladder and
+    TPU_BFS_BENCH_SERVE_PIPELINE=0 disables the pipelined extraction —
+    together they are the adaptive-vs-fixed A/B axes
+    (scripts/chip_session.sh serve stages). Validation:
+    TPU_BFS_BENCH_VALIDATE_LANES responses re-checked against the SciPy
+    oracle."""
     from tpu_bfs.algorithms._packed_common import floor_lanes
     from tpu_bfs.serve import BfsService
 
@@ -1110,17 +1119,21 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
     lanes = floor_lanes(
         max(32, int(os.environ.get("TPU_BFS_BENCH_SERVE_LANES", "256")))
     )
+    ladder = os.environ.get("TPU_BFS_BENCH_SERVE_LADDER", "auto")
+    pipeline = os.environ.get("TPU_BFS_BENCH_SERVE_PIPELINE", "1") == "1"
     engine = os.environ.get("TPU_BFS_BENCH_SERVE_ENGINE", "wide")
     do_validate = os.environ.get("TPU_BFS_BENCH_VALIDATE", "1") == "1"
 
     t0 = time.perf_counter()
     service = retry_transient(
         BfsService, g, engine=engine, lanes=lanes, planes=8,
+        width_ladder=ladder, pipeline=pipeline,
         linger_ms=2.0, queue_cap=max(1024, 2 * clients),
         log=log, label="serve engine build",
     )
     log(f"service up in {time.perf_counter()-t0:.1f}s: engine={engine} "
-        f"lanes={lanes} clients={clients} queries={clients * per_client}")
+        f"lanes={lanes} ladder={service.width_ladder} pipeline={pipeline} "
+        f"clients={clients} queries={clients * per_client}")
 
     rng = np.random.default_rng(7)
     candidates = np.flatnonzero(g.degrees > 0)
@@ -1178,7 +1191,9 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
     return {
         "metric": (
             f"BFS serve throughput ({clients} closed-loop clients, "
-            f"{lanes}-lane {engine} batches, tpu_bfs/serve), "
+            f"{lanes}-max-lane {engine} batches, ladder="
+            f"{'-'.join(str(w) for w in snap['ladder'])}, "
+            f"pipeline={'on' if pipeline else 'off'}, tpu_bfs/serve), "
             f"{graph_desc or f'RMAT scale-{scale} ef={ef}'}, 1 chip"
         ),
         "value": round(qps, 2),
@@ -1188,6 +1203,10 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
         "serve_p50_ms": snap["p50_ms"],
         "serve_p99_ms": snap["p99_ms"],
         "fill_ratio": snap["fill_ratio"],
+        "serve_routing": snap["routing"],
+        "serve_extract_p50_ms": snap["extract_p50_ms"],
+        "serve_padded_lanes": snap["padded_lanes_total"],
+        "serve_pipeline": pipeline,
         "serve_retries": snap["retries"],
         "serve_sheds": snap["rejected"],
     }

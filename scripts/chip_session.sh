@@ -115,11 +115,18 @@ for i in $(seq 1 "$attempts"); do
       TPU_BFS_BENCH_ADAPTIVE=0
     stage "plain-s20" "$out/plain_s20.json" \
       TPU_BFS_BENCH_SCALE=20 TPU_BFS_BENCH_ADAPTIVE=0
-    # Serve-throughput stage (ISSUE 2): the closed-loop lane-batching
-    # query server at scale 20 — the first latency/QPS number for the
-    # serving subsystem (serve_qps/serve_p99_ms/fill_ratio in the JSON).
-    stage "serve-s20" "$out/serve_s20.json" \
+    # Serve-throughput A/B (ISSUE 3): the closed-loop lane-batching
+    # query server at scale 20, adaptive (width ladder + pipelined
+    # extraction — the defaults) vs fixed (one width, inline extraction
+    # — the PR-2 behavior). The pair isolates the adaptive dispatch win:
+    # compare serve_qps/serve_p99_ms/serve_extract_p50_ms across the two
+    # JSONs; serve_routing in the adaptive line shows where batches
+    # actually landed on the ladder.
+    stage "serve-adaptive-s20" "$out/serve_adaptive_s20.json" \
       TPU_BFS_BENCH_MODE=serve TPU_BFS_BENCH_SCALE=20
+    stage "serve-fixed-s20" "$out/serve_fixed_s20.json" \
+      TPU_BFS_BENCH_MODE=serve TPU_BFS_BENCH_SCALE=20 \
+      TPU_BFS_BENCH_SERVE_LADDER=off TPU_BFS_BENCH_SERVE_PIPELINE=0
     # The probe's completion-marker line satisfies got_value, so pstage
     # gives it the same idempotent restart + timeout envelope as the
     # other helper scripts.

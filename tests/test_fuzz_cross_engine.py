@@ -177,9 +177,12 @@ SERVE_CASES = [CASES[0], CASES[4]]
 @pytest.mark.serve
 @pytest.mark.parametrize("name,make", SERVE_CASES, ids=[c[0] for c in SERVE_CASES])
 def test_serve_bit_identical_to_one_shot(name, make):
-    """ISSUE 2 fuzz arm: served distances are bit-identical to one-shot
+    """ISSUE 2/3 fuzz arm: served distances are bit-identical to one-shot
     engine runs for the same (graph, source), across batch compositions
-    — alone, grouped with different mates, duplicated, and re-ordered."""
+    — alone, grouped with different mates, duplicated, and re-ordered —
+    and across the adaptive-dispatch axes: each composition randomizes
+    the width ladder (fixed width / a 2-rung ladder) and pipelined vs
+    inline extraction, so adaptive routing can never change an answer."""
     from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
     from tpu_bfs.serve import BfsService, EngineRegistry
 
@@ -192,14 +195,22 @@ def test_serve_bit_identical_to_one_shot(name, make):
         one_shot[s] = eng.run(np.asarray([s])).distances_int32(0)
         validate.check_distances(one_shot[s], bfs_scipy(g, s))
 
-    # One shared registry: the three composition services reuse ONE
-    # served engine (and stay inside the tier-1 wall-clock budget) —
-    # the compositions differ in batching, not in engine state.
+    # One shared registry: the composition services reuse the served
+    # engines (and stay inside the tier-1 wall-clock budget) — the
+    # compositions differ in batching and routing, not in engine state.
     reg = EngineRegistry(capacity=2)
     reg.add_graph("fuzz-serve", g)
 
     def svc():
-        return BfsService("fuzz-serve", registry=reg, lanes=32,
+        # Randomized adaptive axes: ladder off (one 32/64 width) or a
+        # [32, 64] two-rung ladder; extraction pipelined or inline.
+        if rng.integers(2):
+            lanes, ladder = 64, "32,64"
+        else:
+            lanes, ladder = int(rng.choice([32, 64])), "off"
+        return BfsService("fuzz-serve", registry=reg, lanes=lanes,
+                          width_ladder=ladder,
+                          pipeline=bool(rng.integers(2)),
                           linger_ms=0.0, autostart=False)
 
     # Three compositions of the same queries: singletons, one big batch
@@ -217,6 +228,7 @@ def test_serve_bit_identical_to_one_shot(name, make):
         for s, q in zip(sources, staged):
             r = q.result(timeout=60)
             assert r.batch_lanes == len(sources)  # really one batch
+            assert r.dispatched_lanes in s2.width_ladder
             np.testing.assert_array_equal(r.distances, one_shot[s])
     with svc() as s3:
         mixed = [int(s) for s in rng.permutation(sources * 2)]

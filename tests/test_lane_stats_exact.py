@@ -43,7 +43,7 @@ def test_lane_stats_exact_beyond_f32():
     # deg sum = 2**24 + 1: an f32 accumulator returns 2**24 (the +1 is
     # below the ULP); the int32 block path must return the exact value.
     in_deg = np.array([1 << 24, 1, 0, 0], dtype=np.int32)
-    _, lane_stats, _ = pc.make_state_kernels(
+    _, lane_stats, _, _ = pc.make_state_kernels(
         4, 4, 1, 1, in_deg_host=in_deg
     )
     vis = jnp.asarray(np.array([[1], [1], [0], [0]], dtype=np.uint32))
@@ -63,7 +63,7 @@ def test_lane_stats_multi_block_exact(monkeypatch):
     monkeypatch.setattr(
         pc, "degree_sum_blocks", lambda d, a, cap=0: orig(d, a, cap=512)
     )
-    _, lane_stats, _ = pc.make_state_kernels(
+    _, lane_stats, _, _ = pc.make_state_kernels(
         act, act, 1, 1, in_deg_host=in_deg
     )
     vis_np = rng.integers(0, 2**32, size=(act, 1), dtype=np.uint32)
@@ -73,6 +73,33 @@ def test_lane_stats_multi_block_exact(monkeypatch):
     bits = (vis_np[:, 0:1] >> np.arange(32, dtype=np.uint32)) & 1
     expected = (bits.astype(np.int64) * in_deg[:, None].astype(np.int64)).sum(axis=0)
     np.testing.assert_array_equal(total, expected)
+
+
+def test_lane_ecc_matches_decoded_distances():
+    """The on-device per-lane eccentricity (ISSUE 3) equals the max
+    finite distance of the decoded lane — on the wide AND packed engines
+    (independent decode paths), including an isolated-source lane
+    (ecc 0)."""
+    from tpu_bfs.graph.csr import INF_DIST
+    from tpu_bfs.graph.generate import random_graph
+    from tpu_bfs.algorithms.msbfs_packed import PackedMsBfsEngine
+    from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
+
+    g = random_graph(200, 700, seed=9)
+    srcs = list(np.flatnonzero(g.degrees > 0)[:4])
+    iso = np.flatnonzero(g.degrees == 0)
+    if iso.size:
+        srcs.append(int(iso[0]))
+    srcs = np.asarray(srcs)
+    for res in (
+        WidePackedMsBfsEngine(g, lanes=32, num_planes=8).run(srcs),
+        PackedMsBfsEngine(g, lanes=32).run(srcs),
+    ):
+        assert res.ecc is not None and len(res.ecc) == len(srcs)
+        for i in range(len(srcs)):
+            d = res.distances_int32(i)
+            finite = d[d != INF_DIST]
+            assert int(res.ecc[i]) == int(finite.max()), (i, srcs[i])
 
 
 def test_engine_edges_traversed_exact(random_small):

@@ -1,0 +1,172 @@
+"""Distance-free serving (ISSUE 3): ``want_distances=false`` /
+``--no-distances`` queries must never transfer the O(V)-per-lane
+distance table off the device — the engines' on-device summaries
+(reached / per-lane ecc) answer everything such a query returns.
+
+A spy wrapped around a REAL engine's results counts distances_int32
+pulls; the round-trip arm pins decode_distances as the exact inverse of
+the response payload for the paths that DO want distances.
+"""
+
+import io
+import json
+
+import numpy as np
+import pytest
+
+from tpu_bfs.graph.csr import INF_DIST
+from tpu_bfs.graph.generate import random_graph
+from tpu_bfs.reference.cpu_bfs import bfs_python
+from tpu_bfs.serve import BfsService, EngineRegistry
+from tpu_bfs.serve.frontend import (
+    _encode_distances,
+    build_arg_parser,
+    decode_distances,
+    run_server,
+)
+
+pytestmark = pytest.mark.serve
+
+
+@pytest.fixture(scope="module")
+def df_graph():
+    return random_graph(120, 800, seed=41)
+
+
+@pytest.fixture(scope="module")
+def df_registry(df_graph):
+    reg = EngineRegistry(capacity=2)
+    reg.add_graph("df-graph", df_graph)
+    return reg
+
+
+class PullSpy:
+    """Wraps a real engine: dispatch/fetch pass through, but every result
+    records its per-lane distance pulls."""
+
+    def __init__(self, engine):
+        self._engine = engine
+        self.lanes = engine.lanes
+        self.pulls = []
+
+    def dispatch(self, sources, **kw):
+        return self._engine.dispatch(sources, **kw)
+
+    def fetch(self, handle, **kw):
+        res = self._engine.fetch(handle, **kw)
+        spy = self
+
+        class SpyResult:
+            reached = res.reached
+            ecc = res.ecc
+
+            @staticmethod
+            def distances_int32(i):
+                spy.pulls.append(i)
+                return res.distances_int32(i)
+
+        return SpyResult()
+
+
+def _spy_service(df_registry, monkeypatch, **kw):
+    svc = BfsService(
+        "df-graph", registry=df_registry, lanes=32, linger_ms=2.0,
+        autostart=False, **kw,
+    )
+    spy = PullSpy(svc._registry.get(svc._spec()))
+    monkeypatch.setattr(svc._registry, "get", lambda spec: spy)
+    svc.start()
+    return svc, spy
+
+
+def test_want_distances_false_pulls_zero_distance_words(df_graph,
+                                                        df_registry,
+                                                        monkeypatch):
+    svc, spy = _spy_service(df_registry, monkeypatch)
+    golden = {s: bfs_python(df_graph, s)[0] for s in (0, 3, 7)}
+    for s, ref in golden.items():
+        r = svc.query(s, want_distances=False, timeout=60)
+        assert r.ok, (r.status, r.error)
+        assert r.distances is None
+        # Metadata still exact, from the on-device summaries alone.
+        assert r.reached == int(np.sum(ref != INF_DIST))
+        assert r.levels == int(ref[ref != INF_DIST].max())
+    assert spy.pulls == []  # ZERO per-lane host pulls
+    svc.close()
+
+
+def test_no_distances_service_default_and_per_request_override(
+        df_graph, df_registry, monkeypatch):
+    svc, spy = _spy_service(df_registry, monkeypatch, distances=False)
+    ref = bfs_python(df_graph, 5)[0]
+    r = svc.query(5, timeout=60)  # service default: metadata-only
+    assert r.ok and r.distances is None
+    assert spy.pulls == []
+    # Per-request override still gets (and pays for) the distances.
+    r = svc.query(5, want_distances=True, timeout=60)
+    assert r.ok and r.distances is not None
+    np.testing.assert_array_equal(r.distances, ref)
+    assert len(spy.pulls) == 1
+    svc.close()
+
+
+def test_mixed_batch_pulls_only_wanting_lanes(df_graph, df_registry,
+                                              monkeypatch):
+    svc, spy = _spy_service(df_registry, monkeypatch)
+    staged = [
+        svc.submit(0, want_distances=False),
+        svc.submit(3, want_distances=True),
+        svc.submit(7, want_distances=False),
+    ]
+    rs = [q.result(60) for q in staged]
+    assert all(r.ok for r in rs)
+    if rs[1].batch_lanes == 3:
+        # One coalesced batch: only the one wanting lane was pulled.
+        assert spy.pulls == [1]
+    assert rs[0].distances is None and rs[2].distances is None
+    np.testing.assert_array_equal(
+        rs[1].distances, bfs_python(df_graph, 3)[0]
+    )
+    svc.close()
+
+
+def test_decode_distances_round_trip():
+    """decode_distances inverts the response encoding exactly, including
+    the INF_DIST sentinel and int32 dtype."""
+    d = np.array([0, 3, INF_DIST, 1, 2, INF_DIST], dtype=np.int32)
+    out = decode_distances(_encode_distances(d))
+    assert out.dtype == d.dtype
+    np.testing.assert_array_equal(out, d)
+
+
+def test_jsonl_want_distances_false(df_registry):
+    """The wire form: a want_distances=false request answers without a
+    distances_npy field; a plain request on the same server still
+    round-trips its distances through decode_distances."""
+    args = build_arg_parser().parse_args(
+        ["random:n=96,m=480,seed=3", "--lanes", "32", "--linger-ms", "1",
+         "--statsz-every", "0"]
+    )
+    reqs = (
+        '{"id": 1, "source": 2, "want_distances": false}\n'
+        '{"id": 2, "source": 2}\n'
+        '{"id": 3, "source": 2, "want_distances": "false"}\n'
+    )
+    out, err = io.StringIO(), io.StringIO()
+    rc = run_server(args, stdin=io.StringIO(reqs), stdout=out, stderr=err)
+    assert rc == 0
+    by_id = {
+        r["id"]: r
+        for r in (json.loads(l) for l in out.getvalue().splitlines() if l.strip())
+    }
+    assert by_id[1]["status"] == "ok" and "distances_npy" not in by_id[1]
+    assert by_id[1]["levels"] >= 1 and by_id[1]["reached"] >= 1
+    assert by_id[2]["status"] == "ok"
+    d = decode_distances(by_id[2]["distances_npy"])
+    assert int(d[2]) == 0
+    assert by_id[2]["levels"] == by_id[1]["levels"]
+    assert by_id[2]["reached"] == by_id[1]["reached"]
+    # The JSON STRING "false" is truthy — coercing it would silently
+    # invert the client's intent, so it must be rejected outright.
+    assert by_id[3]["status"] == "error"
+    assert "want_distances" in by_id[3]["error"]

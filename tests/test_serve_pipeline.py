@@ -1,0 +1,250 @@
+"""Pipelined extraction (ISSUE 3): the dispatch/fetch split under the
+serve executor and scheduler.
+
+- OVERLAP: batch N+1 is dispatched before batch N's extraction completes
+  (spy-ordered events through a fake engine whose fetch blocks until it
+  observes the next dispatch);
+- EXACTLY-ONCE across the handoff: a transient fetch failure re-dispatches
+  the identical batch; a fetch-time OOM degrades the width and re-admits
+  (the classifier runs on both pipeline halves);
+- the satellite latency fix: per-query latency is stamped at resolve time
+  (extraction cost is client-visible) and extract_ms lands in metrics.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpu_bfs.graph.csr import INF_DIST
+from tpu_bfs.graph.generate import random_graph
+from tpu_bfs.serve import BfsService, EngineRegistry
+
+pytestmark = pytest.mark.serve
+
+TRANSIENT_MSG = (
+    "INTERNAL: during context [pre-optimization]: "
+    "remote_compile: read body closed"
+)
+OOM_MSG = "RESOURCE_EXHAUSTED: Out of memory while trying to allocate"
+
+
+class FakeResult:
+    """Minimal engine-result protocol: on-device summaries (ecc/reached)
+    plus per-lane distance pulls, with an optional per-pull delay."""
+
+    def __init__(self, sources, v, *, pull_delay_s: float = 0.0,
+                 pull_log: list | None = None):
+        self._sources = np.asarray(sources)
+        self._v = v
+        self._pull_delay_s = pull_delay_s
+        self._pull_log = pull_log
+        n = len(self._sources)
+        self.reached = np.ones(n, np.int64)
+        self.ecc = np.zeros(n, np.int32)
+
+    def distances_int32(self, i):
+        if self._pull_log is not None:
+            self._pull_log.append(i)
+        if self._pull_delay_s:
+            time.sleep(self._pull_delay_s)
+        d = np.full(self._v, INF_DIST, np.int32)
+        d[self._sources[i]] = 0
+        return d
+
+
+class FakeEngine:
+    """dispatch/fetch protocol double; subclasses override fetch."""
+
+    def __init__(self, lanes, v, **kw):
+        self.lanes = lanes
+        self.num_vertices = v
+        self.dispatches = 0
+        self.fetches = 0
+        self.kw = kw
+
+    def dispatch(self, padded):
+        self.dispatches += 1
+        return np.asarray(padded)
+
+    def fetch(self, handle):
+        self.fetches += 1
+        return FakeResult(handle, self.num_vertices, **self.kw)
+
+
+@pytest.fixture
+def fake_graph():
+    return random_graph(64, 300, seed=5)
+
+
+def _svc_with_engines(fake_graph, monkeypatch, engines: dict, **kw):
+    """A BfsService whose registry hands out fake engines by width."""
+    reg = EngineRegistry(capacity=4, warm=False)
+    reg.add_graph("fake", fake_graph)
+    monkeypatch.setattr(reg, "get", lambda spec: engines[spec.lanes])
+    kw.setdefault("linger_ms", 0.0)
+    return BfsService("fake", registry=reg, autostart=False, **kw)
+
+
+def test_next_batch_dispatched_before_prior_extraction_completes(
+        fake_graph, monkeypatch):
+    """The acceptance ordering: with pipelining on, the scheduler
+    dispatches batch N+1 while batch N is still extracting."""
+    events = []
+    ev = threading.Lock()
+    second_dispatch = threading.Event()
+
+    class Eng(FakeEngine):
+        def dispatch(self, padded):
+            with ev:
+                events.append("dispatch")
+                if events.count("dispatch") >= 2:
+                    second_dispatch.set()
+            return super().dispatch(padded)
+
+        def fetch(self, handle):
+            with ev:
+                events.append("extract_start")
+                first = events.count("extract_start") == 1
+            if first:
+                # Park batch 1's extraction until batch 2 is dispatched —
+                # only a pipelined scheduler ever gets there.
+                assert second_dispatch.wait(30), \
+                    "batch 2 never dispatched during batch 1's extraction"
+            res = super().fetch(handle)
+            with ev:
+                events.append("extract_done")
+            return res
+
+    eng = Eng(32, fake_graph.num_vertices)
+    svc = _svc_with_engines(
+        fake_graph, monkeypatch, {32: eng}, lanes=32, width_ladder="off",
+        pipeline=True,
+    )
+    svc.start()
+    q1 = svc.submit(0)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        with ev:
+            if events.count("dispatch") >= 1:
+                break
+        time.sleep(0.001)
+    q2 = svc.submit(1)
+    assert q1.result(60).ok and q2.result(60).ok
+    svc.close()
+    with ev:
+        dispatch2 = [i for i, e in enumerate(events) if e == "dispatch"][1]
+        done1 = events.index("extract_done")
+    assert dispatch2 < done1, events
+
+
+def test_transient_fetch_failure_redispatches_same_batch(fake_graph,
+                                                         monkeypatch):
+    """The classifier holds on the fetch half: a transient failure after
+    the handoff re-dispatches the identical padded batch, and the query
+    still resolves exactly once."""
+
+    class Eng(FakeEngine):
+        def fetch(self, handle):
+            self.fetches += 1
+            if self.fetches == 1:
+                raise RuntimeError(TRANSIENT_MSG)
+            return FakeResult(handle, self.num_vertices)
+
+    eng = Eng(32, fake_graph.num_vertices)
+    svc = _svc_with_engines(
+        fake_graph, monkeypatch, {32: eng}, lanes=32, width_ladder="off",
+    )
+    svc.start()
+    r = svc.query(3, timeout=60)
+    assert r.ok, (r.status, r.error)
+    assert eng.dispatches == 2 and eng.fetches == 2
+    assert svc.statsz()["retries"] == 1
+    svc.close()
+
+
+def test_fetch_oom_degrades_across_handoff(fake_graph, monkeypatch):
+    """A transient AND an OOM injected on the fetch half of the SAME
+    query's journey: retry in place, then degrade 64 -> 32 and re-admit,
+    with exactly-once resolution end to end."""
+
+    class Oom64(FakeEngine):
+        def fetch(self, handle):
+            self.fetches += 1
+            if self.fetches == 1:
+                raise RuntimeError(TRANSIENT_MSG)
+            raise RuntimeError(OOM_MSG)
+
+    eng64 = Oom64(64, fake_graph.num_vertices)
+    eng32 = FakeEngine(32, fake_graph.num_vertices)
+    svc = _svc_with_engines(
+        fake_graph, monkeypatch, {64: eng64, 32: eng32}, lanes=64,
+        width_ladder="off",
+    )
+    svc.start()
+    resolves = []
+    q = svc.submit(5)
+    q.add_done_callback(lambda pq: resolves.append(pq.result().status))
+    r = q.result(60)
+    assert r.ok, (r.status, r.error)
+    assert r.dispatched_lanes == 32  # re-served below the OOM'd width
+    assert eng64.fetches == 2  # transient retry, then the OOM
+    assert eng32.fetches == 1
+    assert svc.lanes == 32 and svc.width_ladder == [32]
+    snap = svc.statsz()
+    assert snap["retries"] == 1
+    assert snap["oom_degrades"] == 1 and snap["requeued"] == 1
+    assert resolves == ["ok"]  # exactly once
+    svc.close()
+
+
+def test_floor_oom_collapses_ladder_and_names_real_width(fake_graph,
+                                                         monkeypatch):
+    """An OOM at the 32-lane floor rung must (a) name THAT width in the
+    error, not the ladder cap, and (b) collapse the ladder onto the floor
+    — wider rungs can only OOM harder, so routing must stop dispatching
+    into them."""
+
+    class Oom32(FakeEngine):
+        def dispatch(self, padded):
+            raise RuntimeError(OOM_MSG)
+
+    svc = _svc_with_engines(
+        fake_graph, monkeypatch,
+        {32: Oom32(32, fake_graph.num_vertices),
+         64: FakeEngine(64, fake_graph.num_vertices)},
+        lanes=64, width_ladder="32,64",
+    )
+    svc.start()
+    r = svc.query(1, timeout=60)  # routes to the 32 rung
+    assert r.status == "error", (r.status, r.error)
+    assert "minimum lane count (32)" in r.error, r.error
+    assert svc.width_ladder == [32] and svc.lanes == 32
+    svc.close()
+
+
+def test_latency_stamped_at_resolve_time_and_extract_ms_recorded(
+        fake_graph, monkeypatch):
+    """Satellite: per-query latency includes that query's extraction wait
+    (the old shared pre-extraction stamp reported identical latencies for
+    a whole batch), and extract_ms makes the extraction cost visible."""
+    delay = 0.02
+    eng = FakeEngine(32, fake_graph.num_vertices, pull_delay_s=delay)
+    svc = _svc_with_engines(
+        fake_graph, monkeypatch, {32: eng}, lanes=32, width_ladder="off",
+    )
+    staged = [svc.submit(s) for s in (0, 1, 2)]
+    svc.start()
+    rs = [q.result(60) for q in staged]
+    assert all(r.ok for r in rs)
+    assert rs[0].batch_lanes == 3  # one coalesced batch
+    lat = [r.latency_ms for r in rs]
+    # Lane i resolves after i+1 distance pulls of ~20ms each: later lanes
+    # must report strictly more latency than earlier ones.
+    assert lat[0] < lat[1] < lat[2], lat
+    assert lat[2] - lat[0] >= delay * 1e3, lat
+    snap = svc.statsz()
+    assert snap["extract_p50_ms"] >= 3 * delay * 1e3 * 0.9
+    assert snap["extract_ms_total"] >= snap["extract_p50_ms"]
+    svc.close()

@@ -159,19 +159,19 @@ def test_transient_failure_retries_in_place(serve_registry, serve_golden,
     COUNTERS.reset()
     svc = _svc(serve_registry, autostart=False)
     eng = svc._registry.get(svc._spec())  # the engine start() will serve
-    real_run = eng.run
+    real_dispatch = eng.dispatch
     fails = [1]
 
-    def flaky_run(sources, **kw):
+    def flaky_dispatch(sources, **kw):
         if fails:
             fails.pop()
             raise RuntimeError(
                 "INTERNAL: during context [pre-optimization]: "
                 "remote_compile: read body closed"
             )
-        return real_run(sources, **kw)
+        return real_dispatch(sources, **kw)
 
-    monkeypatch.setattr(eng, "run", flaky_run)
+    monkeypatch.setattr(eng, "dispatch", flaky_dispatch)
     svc.start()
     s = next(iter(serve_golden))
     r = svc.query(s, timeout=60)
@@ -184,11 +184,13 @@ def test_transient_failure_retries_in_place(serve_registry, serve_golden,
 
 def test_oom_degrades_lanes_and_requeues(serve_registry, serve_golden,
                                          monkeypatch):
+    # width_ladder="off": a single fixed 64-lane width, so the OOM ladder
+    # (not adaptive routing) is what serves the query after the failure.
     COUNTERS.reset()
-    svc = _svc(serve_registry, lanes=64, autostart=False)
+    svc = _svc(serve_registry, lanes=64, width_ladder="off", autostart=False)
     eng64 = svc._registry.get(svc._spec())
     monkeypatch.setattr(
-        eng64, "run",
+        eng64, "dispatch",
         lambda *a, **kw: (_ for _ in ()).throw(RuntimeError(
             "RESOURCE_EXHAUSTED: Out of memory while trying to allocate"
         )),
@@ -201,6 +203,7 @@ def test_oom_degrades_lanes_and_requeues(serve_registry, serve_golden,
     assert r.ok, (r.status, r.error)
     np.testing.assert_array_equal(r.distances, serve_golden[s])
     assert svc.lanes == 32
+    assert svc.width_ladder == [32]
     snap = svc.statsz()
     assert snap["oom_degrades"] == 1 and snap["requeued"] == 1
     assert COUNTERS.as_dict()["oom_degrades"] == 1
@@ -244,7 +247,7 @@ def test_oom_at_floor_is_explicit_error(serve_registry, monkeypatch):
     svc = _svc(serve_registry, autostart=False)  # 32 = MIN_LANES
     eng = svc._registry.get(svc._spec())
     monkeypatch.setattr(
-        eng, "run",
+        eng, "dispatch",
         lambda *a, **kw: (_ for _ in ()).throw(RuntimeError(
             "RESOURCE_EXHAUSTED: Out of memory"
         )),
@@ -252,6 +255,68 @@ def test_oom_at_floor_is_explicit_error(serve_registry, monkeypatch):
     svc.start()
     r = svc.query(0, timeout=60)
     assert r.status == STATUS_ERROR and "minimum lane count" in r.error
+    svc.close()
+
+
+def test_adaptive_width_routes_low_load_to_narrow_rung(serve_registry,
+                                                       serve_golden):
+    """ISSUE 3 acceptance: at low offered load, batches route to a rung
+    NARROWER than the max (the routing histogram shows >= 2 widths used)
+    with every response still oracle-validated, and fill is reported
+    against the DISPATCHED width."""
+    sources = list(serve_golden)
+    svc = _svc(serve_registry, lanes=64, linger_ms=5.0, autostart=False)
+    assert svc.width_ladder == [32, 64]
+    # Stage a 40-query burst: it must coalesce into one 64-routed batch.
+    staged = [svc.submit(sources[i % len(sources)]) for i in range(40)]
+    svc.start()
+    for q in staged:
+        r = q.result(timeout=120)
+        assert r.ok, (r.status, r.error)
+        assert r.dispatched_lanes == 64 and r.batch_lanes == 40
+        np.testing.assert_array_equal(r.distances, serve_golden[r.source])
+    # Low offered load: single queries must route to the 32 rung.
+    for s in sources[:4]:
+        r = svc.query(s, timeout=120)
+        assert r.ok, (r.status, r.error)
+        assert r.dispatched_lanes == 32
+        np.testing.assert_array_equal(r.distances, serve_golden[s])
+    snap = svc.statsz()
+    assert set(snap["routing"]) == {"32", "64"}, snap["routing"]
+    assert snap["routing"]["64"] == 1
+    # Fill is against dispatched width: the 40-wide batch scored 40/64,
+    # each single 1/32 — never 1/64.
+    offered = 64 + 32 * snap["routing"]["32"]
+    # fill_ratio is rounded to 4 digits in the snapshot.
+    assert abs(snap["fill_ratio"] - svc.metrics.lanes_used / offered) < 1e-4
+    svc.close()
+
+
+def test_pad_waste_is_bounded_by_routing(serve_registry, serve_golden):
+    """Satellite: with the ladder, a batch's pad waste is irreducible —
+    the batch did not fit the next-narrower rung (else it would have
+    routed there), so waste < dispatched - next_narrower; and the
+    residual shows up in padded_lanes_total."""
+    sources = list(serve_golden)
+    svc = _svc(serve_registry, lanes=64, linger_ms=5.0, autostart=False)
+    ladder = svc.width_ladder
+    staged = [svc.submit(sources[i % len(sources)]) for i in range(40)]
+    svc.start()
+    per_batch = {}  # (dispatched, batch_lanes) per distinct batch shape
+    for q in staged:
+        r = q.result(timeout=120)
+        assert r.ok
+        width = r.dispatched_lanes
+        narrower = [w for w in ladder if w < width]
+        if narrower:
+            # Routing optimality: the batch overflowed the rung below.
+            assert r.batch_lanes > narrower[-1]
+            assert width - r.batch_lanes < width - narrower[-1]
+        per_batch[(width, r.batch_lanes)] = width - r.batch_lanes
+    snap = svc.statsz()
+    assert snap["padded_lanes_total"] == sum(per_batch.values()), (
+        per_batch, snap,
+    )
     svc.close()
 
 

@@ -17,6 +17,7 @@ build, make_state_kernels), ``_extract_word``; and the two lane-map hooks
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from typing import NamedTuple
 
@@ -761,8 +762,14 @@ def make_state_kernels(
     ``in_deg_host`` (table row order, length >= act) is captured by
     lane_stats — it both sizes the static degree-sum blocks and provides
     the summed values, so the overflow-safety analysis and the data can
-    never diverge. Required for lane_stats; seed/extract_word work
-    without it.
+    never diverge. Required for lane_stats; seed/extract_word/lane_ecc
+    work without it.
+
+    Returns ``(seed, lane_stats, extract_word, lane_ecc)``; ``lane_ecc``
+    is the on-device per-lane eccentricity reduction (ISSUE 3): max
+    finite distance per lane as [w, 32] int32, so distance-free serving
+    queries read one [w, 32] summary instead of the O(V * lanes)
+    distance table.
     """
     act = v if active is None else min(active, v)
     if in_deg_host is not None:
@@ -829,7 +836,44 @@ def make_state_kernels(
             srcw, jnp.uint8(0), jnp.where(visw, cnt + jnp.uint8(1), UNREACHED)
         )
 
-    return seed, lane_stats, extract_word
+    @jax.jit
+    def lane_ecc(planes, vis, src_bits):
+        """Per-lane eccentricity (max finite distance) as [w, 32] int32.
+
+        The same bit-sliced decode as extract_word, but reduced over rows
+        on device: unvisited rows contribute 0 (a lane whose component is
+        only its source has eccentricity 0), sources contribute 0, every
+        other visited row its distance cnt + 1."""
+        if act == 0:
+            # Edgeless tables (every vertex isolated): no row is ever
+            # visited, and the row-max below has no identity over zero
+            # rows. Every lane's component is at most its source: ecc 0.
+            return jnp.zeros((w, 32), jnp.int32)
+        shifts = jnp.arange(32, dtype=jnp.uint32)
+
+        def wbody(wi, acc):
+            cnt = jnp.zeros((act, 32), jnp.int32)
+            for i, p in enumerate(planes):
+                col = jax.lax.dynamic_slice(p, (0, wi), (rows, 1))[:act]
+                bit = ((col >> shifts) & 1).astype(jnp.int32)
+                cnt = cnt + (bit << i)
+            visw = (
+                (jax.lax.dynamic_slice(vis, (0, wi), (rows, 1))[:act] >> shifts) & 1
+            ) != 0
+            srcw = (
+                (jax.lax.dynamic_slice(src_bits, (0, wi), (rows, 1))[:act] >> shifts)
+                & 1
+            ) != 0
+            dist = jnp.where(
+                srcw, 0, jnp.where(visw, cnt + 1, 0)
+            )  # [act, 32]
+            return jax.lax.dynamic_update_slice(
+                acc, jnp.max(dist, axis=0)[None], (wi, 0)
+            )
+
+        return jax.lax.fori_loop(0, w, wbody, jnp.zeros((w, 32), jnp.int32))
+
+    return seed, lane_stats, extract_word, lane_ecc
 
 
 @dataclasses.dataclass
@@ -853,6 +897,7 @@ class PackedBatchResult:
     # Lanes whose source is an isolated vertex (no table row; traversal is
     # trivially {source}); None when the engine's tables cover all vertices.
     _iso: np.ndarray | None = None
+    _ecc_cache: np.ndarray | None = None
     _word_cache: dict = dataclasses.field(default_factory=dict)
     _parent_cache: dict = dataclasses.field(default_factory=dict)
     # Decoded parent columns of ONE word (32 lanes) from the cached-scanner
@@ -867,6 +912,30 @@ class PackedBatchResult:
         per_source_time = self.elapsed_s / len(self.sources)
         t = self.edges_traversed / per_source_time
         return float(len(t) / np.sum(1.0 / np.maximum(t, 1e-9)))
+
+    @property
+    def ecc(self) -> np.ndarray | None:
+        """[S] int32 per-lane eccentricity (max finite distance), reduced
+        ON DEVICE (make_state_kernels lane_ecc) and cached on first
+        access — one [w, 32] summary transfer instead of decoding
+        distance words host-side, so distance-free consumers (the serve
+        path's want_distances=false) answer ``levels`` without ever
+        pulling a distance row. Lazy: one-shot callers that never read it
+        never pay the kernel. None when the engine predates the kernel."""
+        if self._ecc_cache is None:
+            lane_ecc = getattr(self._engine, "_lane_ecc", None)
+            if lane_ecc is None:
+                return None
+            eng = self._engine
+            e = eng._lane_order(
+                np.asarray(lane_ecc(self._planes, self._vis, self._src_bits))
+            )[: len(self.sources)].astype(np.int32)
+            if self._iso is not None:
+                # Isolated sources never touch the device; their component
+                # is {source} — eccentricity 0.
+                e[self._iso] = 0
+            self._ecc_cache = e
+        return self._ecc_cache
 
     def distance_u8_lane(self, i: int) -> np.ndarray:
         """[V] uint8 distances of batch entry i (UNREACHED where unreached)."""
@@ -1463,6 +1532,10 @@ def _assemble_packed_result(
     slot_sum = engine._lane_order(np.asarray(d).astype(np.int64).sum(axis=1))[:s]
     edges = slot_sum // 2 if engine.undirected else slot_sum
 
+    # Engines whose result tables use a different row order than their seed
+    # table (the distributed wide engine) provide a converting view.
+    src_bits = getattr(engine, "_src_bits_view", lambda x: x)(src_bits_raw)
+
     # Lanes seeded at isolated sources have no device row: the table scan
     # sees nothing, but the source itself is trivially reached.
     iso = (
@@ -1476,9 +1549,6 @@ def _assemble_packed_result(
     else:
         iso = None
 
-    # Engines whose result tables use a different row order than their seed
-    # table (the distributed wide engine) provide a converting view.
-    src_bits = getattr(engine, "_src_bits_view", lambda x: x)(src_bits_raw)
     res = PackedBatchResult(
         sources=sources.astype(np.int32),
         num_levels=levels,
@@ -1514,6 +1584,99 @@ def finish_packed_batch(engine, ckpt) -> PackedBatchResult:
     )
 
 
+@dataclasses.dataclass
+class PackedDispatch:
+    """An in-flight packed batch: the level loop is LAUNCHED (JAX dispatch
+    is async) but nothing host-side has blocked on it yet.
+
+    The dispatch/fetch split exists for the serving pipeline (ISSUE 3):
+    ``dispatch_packed_batch`` returns immediately with the device output
+    references, so the serve executor can hand a completed batch to an
+    extraction worker and form/dispatch the next batch while this one's
+    results are still being pulled. ``fetch_packed_batch`` is the blocking
+    half — level-count readback, plane-cap check, result assembly.
+    Device-side failures of an async dispatch (OOM included) surface at
+    the fetch, so callers must run their failure classifier on BOTH
+    halves."""
+
+    sources: np.ndarray
+    fw0: object  # seed table (device)
+    planes: tuple
+    vis: object
+    levels: object  # device scalar; int() blocks on the loop
+    alive: object
+    truncated: object
+    max_levels: int
+    t0: float
+
+
+def _engine_dispatch_lock(engine):
+    """Per-engine lock serializing the note-mask -> core-launch window.
+
+    The pull gate's lane mask is a host attribute the gated core reads at
+    call time; with the serve pipeline, a transient-retry re-dispatch can
+    run on the extraction worker while the scheduler dispatches the next
+    batch on the SAME engine — without the lock their note/core pairs
+    could interleave and bind the wrong batch's mask. dict.setdefault is
+    atomic under the GIL, so both racers agree on one lock."""
+    lock = getattr(engine, "_dispatch_lock", None)
+    if lock is None:
+        lock = engine.__dict__.setdefault("_dispatch_lock", threading.Lock())
+    return lock
+
+
+def dispatch_packed_batch(
+    engine, sources, *, max_levels: int | None = None
+) -> PackedDispatch:
+    """Launch one packed batch without blocking on its result."""
+    sources = _check_batch_sources(engine, sources)
+    cap = engine.max_levels_cap
+    max_levels = cap if max_levels is None else min(max_levels, cap)
+    with _engine_dispatch_lock(engine):
+        # Same pull-gate hook as advance_packed_batch: the gated cores
+        # need the batch's active-lane mask before dispatch. The mask is
+        # bound into the core call inside the lock, so a concurrent
+        # dispatch (serve pipeline retry vs scheduler) cannot interleave
+        # its note between this batch's note and core launch.
+        note = getattr(engine, "_note_batch_sources", None)
+        if note is not None:
+            note(sources)
+        fw0 = engine._seed_dev(sources)
+        t0 = time.perf_counter()
+        planes, vis, levels, alive, truncated = engine._core(
+            engine.arrs, fw0, jnp.int32(max_levels)
+        )
+    return PackedDispatch(
+        sources=sources, fw0=fw0, planes=planes, vis=vis, levels=levels,
+        alive=alive, truncated=truncated, max_levels=max_levels, t0=t0,
+    )
+
+
+def fetch_packed_batch(
+    engine, pend: PackedDispatch, *, check_cap: bool = True,
+    time_it: bool = False,
+) -> PackedBatchResult:
+    """Block on a dispatched batch and assemble its result."""
+    levels = int(pend.levels)  # blocks until the loop finishes
+    elapsed = (time.perf_counter() - pend.t0) if time_it else None
+    engine._warmed = True
+    if (
+        check_cap
+        and bool(pend.truncated)
+        and pend.max_levels == engine.max_levels_cap
+    ):
+        raise RuntimeError(
+            f"traversal truncated at {levels} levels; "
+            f"num_planes={engine.num_planes} caps at "
+            f"{engine.max_levels_cap} — construct the engine with more "
+            "planes for this graph"
+        )
+    return _assemble_packed_result(
+        engine, pend.sources, pend.planes, pend.vis, pend.fw0, levels,
+        bool(pend.alive), elapsed
+    )
+
+
 def run_packed_batch(
     engine,
     sources,
@@ -1522,33 +1685,35 @@ def run_packed_batch(
     time_it: bool = False,
     check_cap: bool = True,
 ) -> PackedBatchResult:
-    """Generic batch driver shared by the wide and hybrid engines."""
-    sources = _check_batch_sources(engine, sources)
-    # Same pull-gate hook as advance_packed_batch: the gated cores need
-    # the batch's active-lane mask before dispatch.
-    note = getattr(engine, "_note_batch_sources", None)
-    if note is not None:
-        note(sources)
-    cap = engine.max_levels_cap
-    max_levels = cap if max_levels is None else min(max_levels, cap)
-
-    fw0 = engine._seed_dev(sources)
+    """Generic batch driver shared by the wide and hybrid engines: one
+    dispatch immediately fetched (the split halves above are the serving
+    pipeline's entry points; this is everyone else's)."""
     if time_it and not engine._warmed:
-        int(engine._core(engine.arrs, fw0, jnp.int32(max_levels))[2])
-    t0 = time.perf_counter()
-    planes, vis, levels, alive, truncated = engine._core(
-        engine.arrs, fw0, jnp.int32(max_levels)
+        int(dispatch_packed_batch(engine, sources, max_levels=max_levels).levels)
+    pend = dispatch_packed_batch(engine, sources, max_levels=max_levels)
+    return fetch_packed_batch(
+        engine, pend, check_cap=check_cap, time_it=time_it
     )
-    levels = int(levels)  # blocks until the loop finishes
-    elapsed = (time.perf_counter() - t0) if time_it else None
-    engine._warmed = True
-    if check_cap and bool(truncated) and max_levels == cap:
-        raise RuntimeError(
-            f"traversal truncated at {levels} levels; "
-            f"num_planes={engine.num_planes} caps at {cap} — construct the "
-            "engine with more planes for this graph"
+
+
+class PackedRunProtocol:
+    """The packed-family batch entry points, defined once for every engine
+    built on the shared level-loop machinery (wide, hybrid, and their
+    distributed forms): blocking ``run``, and the async ``dispatch`` /
+    ``fetch`` halves the serve pipeline overlaps (dispatch_packed_batch /
+    fetch_packed_batch above)."""
+
+    def run(self, sources, *, max_levels=None, time_it=False,
+            check_cap=True):
+        return run_packed_batch(
+            self, sources, max_levels=max_levels, time_it=time_it,
+            check_cap=check_cap,
         )
 
-    return _assemble_packed_result(
-        engine, sources, planes, vis, fw0, levels, bool(alive), elapsed
-    )
+    def dispatch(self, sources, *, max_levels=None):
+        """Launch a batch without blocking (JAX dispatch is async)."""
+        return dispatch_packed_batch(self, sources, max_levels=max_levels)
+
+    def fetch(self, pend, *, check_cap=True):
+        """Block on a :meth:`dispatch` handle and assemble its result."""
+        return fetch_packed_batch(self, pend, check_cap=check_cap)

@@ -57,6 +57,7 @@ from tpu_bfs.algorithms._packed_common import (
     advance_packed_batch,
     auto_lanes,
     auto_planes,
+    PackedRunProtocol,
     build_push_table,
     expand_arrays,
     finish_packed_batch,
@@ -67,7 +68,6 @@ from tpu_bfs.algorithms._packed_common import (
     make_packed_loop,
     make_state_kernels,
     row_unsettled,
-    run_packed_batch,
     seed_scatter_args,
     start_packed_batch,
     tpu_padded_words,
@@ -379,7 +379,7 @@ def _make_core(hg: HybridGraph, w: int, num_planes: int, interpret: bool,
     return make_packed_loop(hit_of, num_planes)
 
 
-class HybridMsBfsEngine(PullGateHost):
+class HybridMsBfsEngine(PackedRunProtocol, PullGateHost):
     """Up to 8192 concurrent BFS sources by default (DEFAULT_MAX_LANES,
     the round-4 measured optimum; ``max_lanes`` moves the cap in 4096-lane
     steps up to MAX_LANES, and auto sizing walks down when the state
@@ -569,7 +569,9 @@ class HybridMsBfsEngine(PullGateHost):
             )
         self.arrs = arrs
         in_deg_ranked = hg.in_degree[hg.old_of_new].astype(np.int32)
-        self._seed, self._lane_stats, self._extract_word = make_state_kernels(
+        (
+            self._seed, self._lane_stats, self._extract_word, self._lane_ecc,
+        ) = make_state_kernels(
             hg.num_vertices, hg.vt * TILE, self.w, num_planes,
             active=self._act, in_deg_host=in_deg_ranked,
         )
@@ -606,11 +608,7 @@ class HybridMsBfsEngine(PullGateHost):
 
         return lazy_full_parent_ell(self.host_graph, self.hg.kcap)
 
-    def run(self, sources, *, max_levels=None, time_it=False, check_cap=True):
-        return run_packed_batch(
-            self, sources, max_levels=max_levels, time_it=time_it,
-            check_cap=check_cap,
-        )
+    # run/dispatch/fetch come from PackedRunProtocol (_packed_common).
 
     # --- checkpoint/resume (_packed_common; SURVEY.md §5: reference has none) ---
 

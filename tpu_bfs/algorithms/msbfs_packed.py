@@ -42,19 +42,60 @@ MAX_LEVELS = 254  # bit-sliced counters are 8 planes wide
 
 
 @dataclasses.dataclass
+class _PackedPending:
+    """An in-flight packed batch (dispatch/fetch split — see
+    _packed_common.PackedDispatch for the serving-pipeline rationale)."""
+
+    sources: np.ndarray
+    src_bits: object  # seed table minus the sentinel row (device)
+    planes: tuple
+    vis: object
+    levels: object  # device scalar; int() blocks
+    t0: float
+
+
+@dataclasses.dataclass
 class PackedBfsResult:
     sources: np.ndarray  # [S] int32
-    distance_u8: np.ndarray  # [S, V] uint8, UNREACHED where not reached
     num_levels: int  # joint level count (max over sources)
     reached: np.ndarray  # [S] int64
     edges_traversed: np.ndarray  # [S] int64 (Graph500 TEPS numerator per source)
     elapsed_s: float | None = None  # wall time for the whole batch
+    # [S] int32 per-lane eccentricity, reduced on device (ISSUE 3): levels
+    # and reached are answerable without any distance transfer.
+    ecc: np.ndarray | None = None
     # Host edge list for parents_int32; None when built from a prebuilt ELL.
     _graph: object = None
-    # Engine backref for the device parent scan (parent_scan.py); None on
-    # results deserialized without one (host path still works).
+    # Engine backref for the device parent scan (parent_scan.py) and the
+    # lazy distance materialization; None on results deserialized without
+    # one (host path still works off a materialized _dist_u8).
     _engine: object = None
+    # Bit-sliced device state (planes, vis, src_bits) the distance table
+    # materializes from on first access — distance-free consumers (the
+    # serve path's want_distances=false) never pay the O(V * lanes)
+    # device->host transfer.
+    _dist_state: tuple | None = None
+    _dist_u8: np.ndarray | None = None  # materialized [S, V] cache
     _parent_cache: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def distance_u8(self) -> np.ndarray:
+        """[S, V] uint8 distances, UNREACHED where not reached. Lazily
+        unpacked from the bit-sliced device state on first access; the
+        device state is released once the host copy exists (reached/ecc/
+        edges were already reduced at fetch), so a retained result stops
+        pinning ~(planes + 2) [act, w] tables in device memory."""
+        if self._dist_u8 is None:
+            if self._dist_state is None or self._engine is None:
+                raise ValueError(
+                    "distances were not materialized and no engine is "
+                    "attached to unpack them"
+                )
+            self._dist_u8 = self._engine._materialize_distances(
+                self.sources, *self._dist_state
+            )
+            self._dist_state = None
+        return self._dist_u8
 
     @property
     def teps(self) -> float | None:
@@ -311,6 +352,18 @@ class PackedMsBfsEngine:
             arrs[f"light{i}_t"] = jnp.asarray(np.ascontiguousarray(b.idx.T))
         self.arrs = arrs
         self._core, self._extract = _make_core(ell, self.w)
+        # Shared per-lane device reductions (reached / degree sum / ecc) —
+        # the same state kernels the wide/hybrid engines use; lazy import
+        # because _packed_common imports this module at its top.
+        from tpu_bfs.algorithms._packed_common import make_state_kernels
+
+        act = self.ell.num_active
+        _, self._lane_stats, _, self._lane_ecc = make_state_kernels(
+            self.ell.num_vertices, act, self.w, 8, active=act,
+            in_deg_host=self.ell.in_degree[self.ell.old_of_new].astype(
+                np.int32
+            ),
+        )
         # Depth cap of the 8-plane bit-sliced counters; the parent scan's
         # key encoding sizes its distance field from this.
         self.max_levels_cap = MAX_LEVELS
@@ -334,6 +387,66 @@ class PackedMsBfsEngine:
                 fw0[r, i // 32] |= np.uint32(1 << (i % 32))
         return fw0
 
+    def dispatch(self, sources, *, max_levels: int = MAX_LEVELS):
+        """Launch one packed batch without blocking on it (JAX dispatch is
+        async) — the serve pipeline's entry; ``fetch`` is the blocking
+        half. Returns an opaque pending handle."""
+        sources = np.asarray(sources, dtype=np.int64)
+        if sources.ndim != 1 or len(sources) == 0 or len(sources) > self.lanes:
+            raise ValueError(f"need 1..{self.lanes} sources, got {sources.shape}")
+        if sources.min() < 0 or sources.max() >= self.ell.num_vertices:
+            raise ValueError("source out of range")
+        max_levels = min(max_levels, MAX_LEVELS)
+        fw0 = jnp.asarray(self._seed(sources))
+        vis0 = fw0[:-1]
+        t0 = time.perf_counter()
+        planes, vis, levels = self._core(
+            self.arrs, fw0, vis0, jnp.int32(max_levels)
+        )
+        return _PackedPending(
+            sources=sources, src_bits=vis0, planes=planes, vis=vis,
+            levels=levels, t0=t0,
+        )
+
+    def fetch(self, pend, *, time_it: bool = False) -> PackedBfsResult:
+        """Block on a :meth:`dispatch` handle and assemble its result.
+
+        ``reached``/``ecc``/``edges_traversed`` reduce on device
+        (lane_stats / lane_ecc); the distance table stays bit-sliced on
+        device and unpacks lazily on first ``distance_u8`` access, so
+        distance-free consumers never pay the O(V * lanes) transfer."""
+        int(pend.levels)  # blocks until the loop finishes
+        elapsed = (time.perf_counter() - pend.t0) if time_it else None
+        self._warmed = True
+
+        sources = pend.sources
+        s = len(sources)
+        act = self.ell.num_active
+        r, d = self._lane_stats(pend.vis)
+        e = self._lane_ecc(pend.planes, pend.vis, pend.src_bits)
+        reached = np.asarray(r).reshape(-1)[:s].astype(np.int64)
+        ecc = np.asarray(e).reshape(-1)[:s].astype(np.int32)
+        slot_sum = (
+            np.asarray(d).astype(np.int64).sum(axis=1).reshape(-1)[:s]
+        )
+        edges = slot_sum // 2 if self.undirected else slot_sum
+        # Isolated sources were never seeded; their component is {source}.
+        iso = np.flatnonzero(self.ell.rank[sources] >= act)
+        reached[iso], ecc[iso], edges[iso] = 1, 0, 0
+        return PackedBfsResult(
+            sources=sources.astype(np.int32),
+            # Max eccentricity over lanes, not loop iterations (which
+            # include the final empty-frontier step) — BfsEngine semantics.
+            num_levels=int(ecc.max()) if s else 0,
+            reached=reached,
+            edges_traversed=edges.astype(np.int64),
+            elapsed_s=elapsed,
+            ecc=ecc,
+            _graph=self.host_graph,
+            _engine=self,
+            _dist_state=(pend.planes, pend.vis, pend.src_bits),
+        )
+
     def run(
         self,
         sources,
@@ -341,25 +454,16 @@ class PackedMsBfsEngine:
         max_levels: int = MAX_LEVELS,
         time_it: bool = False,
     ) -> PackedBfsResult:
-        sources = np.asarray(sources, dtype=np.int64)
-        if sources.ndim != 1 or len(sources) == 0 or len(sources) > self.lanes:
-            raise ValueError(f"need 1..{self.lanes} sources, got {sources.shape}")
-        if sources.min() < 0 or sources.max() >= self.ell.num_vertices:
-            raise ValueError("source out of range")
-        max_levels = min(max_levels, MAX_LEVELS)
-
-        fw0 = jnp.asarray(self._seed(sources))
-        vis0 = fw0[:-1]
         if time_it and not self._warmed:
-            int(self._core(self.arrs, fw0, vis0, jnp.int32(max_levels))[2])
-        t0 = time.perf_counter()
-        planes, vis, levels = self._core(self.arrs, fw0, vis0, jnp.int32(max_levels))
-        levels = int(levels)  # blocks until the loop finishes
-        elapsed = (time.perf_counter() - t0) if time_it else None
-        self._warmed = True
+            int(self.dispatch(sources, max_levels=max_levels).levels)
+        return self.fetch(
+            self.dispatch(sources, max_levels=max_levels), time_it=time_it
+        )
 
-        dist_rank = self._extract(planes, vis, vis0)
-        dn = np.asarray(dist_rank)  # [act, lanes], rank space
+    def _materialize_distances(self, sources, planes, vis, src_bits):
+        """[S, V] uint8 distance table in old-id order — the one full
+        unpack + transfer, deferred until someone asks for distances."""
+        dn = np.asarray(self._extract(planes, vis, src_bits))  # rank space
         s = len(sources)
         act = self.ell.num_active
         v = self.ell.num_vertices
@@ -374,22 +478,4 @@ class PackedMsBfsEngine:
         # Isolated sources were never seeded; their component is {source}.
         for i in np.flatnonzero(ranks[sources] >= act):
             dist[i, sources[i]] = 0
-
-        reached_mask = dist != UNREACHED
-        # Loop iterations include the final empty-frontier step; report the
-        # max eccentricity over lanes instead (BfsEngine semantics).
-        if reached_mask.any():
-            levels = int(dist[reached_mask].max())
-        reached = reached_mask.sum(axis=1).astype(np.int64)
-        slot_sum = reached_mask @ self.ell.in_degree  # [S]
-        edges = slot_sum // 2 if self.undirected else slot_sum
-        return PackedBfsResult(
-            sources=sources.astype(np.int32),
-            distance_u8=dist,
-            num_levels=levels,
-            reached=reached,
-            edges_traversed=edges.astype(np.int64),
-            elapsed_s=elapsed,
-            _graph=self.host_graph,
-            _engine=self,
-        )
+        return dist

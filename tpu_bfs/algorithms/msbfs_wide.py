@@ -49,6 +49,7 @@ from tpu_bfs.graph.csr import Graph
 from tpu_bfs.graph.ell import EllGraph, build_ell, pad_gate_blocks
 from tpu_bfs.algorithms._packed_common import (
     ExpandSpec,
+    PackedRunProtocol,
     advance_packed_batch,
     auto_lanes,
     build_push_table,
@@ -61,7 +62,6 @@ from tpu_bfs.algorithms._packed_common import (
     make_packed_loop,
     make_state_kernels,
     row_unsettled,
-    run_packed_batch,
     seed_scatter_args,
     start_packed_batch,
     tpu_padded_words,
@@ -123,7 +123,7 @@ def _make_core(ell: EllGraph, w: int, num_planes: int, push_cfg=None,
     )
 
 
-class WidePackedMsBfsEngine(PullGateHost):
+class WidePackedMsBfsEngine(PackedRunProtocol, PullGateHost):
     """Runs up to 4096 BFS sources concurrently, bit-packed 128 words wide.
 
     ``num_planes`` bit-sliced counter planes bound the level count at
@@ -238,7 +238,9 @@ class WidePackedMsBfsEngine(PullGateHost):
                 ell, self.w, num_planes, adaptive_push
             )
         in_deg_ranked = ell.in_degree[ell.old_of_new].astype(np.int32)
-        self._seed, self._lane_stats, self._extract_word = make_state_kernels(
+        (
+            self._seed, self._lane_stats, self._extract_word, self._lane_ecc,
+        ) = make_state_kernels(
             ell.num_vertices, self._act + 1, self.w, num_planes,
             active=self._act, in_deg_host=in_deg_ranked,
         )
@@ -286,11 +288,7 @@ class WidePackedMsBfsEngine(PullGateHost):
         host path cannot serve (no retained edge list)."""
         return self.ell, self.arrs
 
-    def run(self, sources, *, max_levels=None, time_it=False, check_cap=True):
-        return run_packed_batch(
-            self, sources, max_levels=max_levels, time_it=time_it,
-            check_cap=check_cap,
-        )
+    # run/dispatch/fetch come from PackedRunProtocol (_packed_common).
 
     # --- checkpoint/resume (_packed_common; SURVEY.md §5: reference has none) ---
 

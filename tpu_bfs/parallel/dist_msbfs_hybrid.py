@@ -72,12 +72,12 @@ from tpu_bfs.graph.ell import (
 from tpu_bfs.algorithms.msbfs_packed import ripple_increment
 from tpu_bfs.algorithms._packed_common import (
     ExpandSpec,
+    PackedRunProtocol,
     PullGateHost,
     lazy_full_parent_ell,
     make_fori_expand,
     make_gated_fori_expand,
     make_state_kernels,
-    run_packed_batch,
     seed_scatter_args,
 )
 from tpu_bfs.algorithms.msbfs_hybrid import fill_a_tiles, select_dense_tiles
@@ -763,7 +763,9 @@ def _make_dist_core(
     return build
 
 
-class DistHybridMsBfsEngine(RowGatherExchangeAccounting, PullGateHost):
+class DistHybridMsBfsEngine(
+    PackedRunProtocol, RowGatherExchangeAccounting, PullGateHost
+):
     """Multi-chip 4096-lane hybrid MS-BFS: dense MXU tiles + gather residual.
 
     API mirrors HybridMsBfsEngine; frontier/visited/planes are all sharded
@@ -914,8 +916,10 @@ class DistHybridMsBfsEngine(RowGatherExchangeAccounting, PullGateHost):
         in_deg_tau[hd["tau_of_vertex"][valid_v]] = hd["in_degree"][
             valid_v
         ].astype(np.int32)
-        _, self._lane_stats, self._extract_word = make_state_kernels(
-            rows, rows, self.w, num_planes, in_deg_host=in_deg_tau
+        _, self._lane_stats, self._extract_word, self._lane_ecc = (
+            make_state_kernels(
+                rows, rows, self.w, num_planes, in_deg_host=in_deg_tau
+            )
         )
         sharded = NamedSharding(self.mesh, P("v"))
         w_ = self.w
@@ -991,11 +995,7 @@ class DistHybridMsBfsEngine(RowGatherExchangeAccounting, PullGateHost):
         tables into it. Owned tables — released after the export."""
         return lazy_full_parent_ell(self.host_graph, self._parent_kcap)
 
-    def run(self, sources, *, max_levels=None, time_it=False, check_cap=True):
-        return run_packed_batch(
-            self, sources, max_levels=max_levels, time_it=time_it,
-            check_cap=check_cap,
-        )
+    # run/dispatch/fetch come from PackedRunProtocol (_packed_common).
 
     # --- checkpoint/resume: every table lives in one (tau, sharded) row
     # space, so the generic real-id protocol applies unchanged — and since

@@ -39,10 +39,10 @@ from tpu_bfs.graph.ell import ShardedEllGraph, build_ell_sharded
 from tpu_bfs.algorithms.msbfs_packed import ripple_increment
 from tpu_bfs.algorithms._packed_common import (
     ExpandSpec,
+    PackedRunProtocol,
     lazy_full_parent_ell,
     make_fori_expand,
     make_state_kernels,
-    run_packed_batch,
 )
 from tpu_bfs.parallel.collectives import (
     RowGatherExchangeAccounting,
@@ -226,7 +226,7 @@ def _make_dist_core(
     return build
 
 
-class DistWideMsBfsEngine(RowGatherExchangeAccounting):
+class DistWideMsBfsEngine(PackedRunProtocol, RowGatherExchangeAccounting):
     """Multi-chip 4096-lane packed MS-BFS: sharded ELL, replicated frontier.
 
     Per-chip HBM is O(V * W/8 * num_planes) for the packed state plus the
@@ -335,8 +335,11 @@ class DistWideMsBfsEngine(RowGatherExchangeAccounting):
         in_deg_cm[self._rank] = sell.in_degree.astype(np.int32)
         # Stats/extraction over the reassembled chip-major tables: every row
         # participates (pad rows are never visited, so they contribute zero).
-        _, self._lane_stats, self._extract_word = make_state_kernels(
-            sell.v_pad, sell.v_pad, self.w, num_planes, in_deg_host=in_deg_cm
+        _, self._lane_stats, self._extract_word, self._lane_ecc = (
+            make_state_kernels(
+                sell.v_pad, sell.v_pad, self.w, num_planes,
+                in_deg_host=in_deg_cm,
+            )
         )
         # Seed table is one row taller (the ELL sentinel row at v_pad).
         rows_seed, w = sell.v_pad + 1, self.w
@@ -406,11 +409,7 @@ class DistWideMsBfsEngine(RowGatherExchangeAccounting):
         into it. Owned tables — released after the export."""
         return lazy_full_parent_ell(self.host_graph, self.sell.kcap)
 
-    def run(self, sources, *, max_levels=None, time_it=False, check_cap=True):
-        return run_packed_batch(
-            self, sources, max_levels=max_levels, time_it=time_it,
-            check_cap=check_cap,
-        )
+    # run/dispatch/fetch come from PackedRunProtocol (_packed_common).
 
     # --- checkpoint/resume. Checkpoints are real-vertex-id (portable to the
     # single-chip engines and other mesh sizes — elastic restart); the only

@@ -13,12 +13,17 @@ one-shot CLI's fresh-process-per-query flow:
 - ``scheduler`` — bounded admission queue coalescing pending single-source
   queries into one packed batch per dispatch (linger knob trades latency
   for batch fill; per-query deadlines; shed-on-overload);
-- ``executor``  — batch dispatch with transient-failure retry and
-  OOM lane-count degrade (classifier shared with utils/recovery.py);
-- ``frontend``  — the in-process ``BfsService`` API and the stdin/stdout
-  JSONL protocol behind the ``tpu-bfs-serve`` entry point;
+- ``executor``  — batch dispatch through the engines' async
+  dispatch/fetch halves, with transient-failure retry and OOM lane-count
+  degrade on BOTH halves (classifier shared with utils/recovery.py);
+- ``frontend``  — the in-process ``BfsService`` API (adaptive width
+  ladder: each batch routes to the narrowest warmed width that fits;
+  pipelined extraction: a worker pulls batch N's results while batch N+1
+  dispatches) and the stdin/stdout JSONL protocol behind the
+  ``tpu-bfs-serve`` entry point;
 - ``metrics``   — /statsz-style serve counters (QPS, p50/p99 latency,
-  batch fill ratio, queue depth, retries, sheds).
+  fill ratio vs dispatched width, per-width routing histogram, pad
+  waste, extraction time, queue depth, retries, sheds).
 """
 
 from tpu_bfs.serve.frontend import BfsService  # noqa: F401

@@ -10,6 +10,17 @@ everything else resolves the batch's queries with explicit error
 results. Unlike bench.py's retry ladder there is no wall-clock budget:
 the server is the long-lived process the budget envelope exists to
 protect elsewhere.
+
+The execution is split into PIPELINE HALVES (ISSUE 3): ``dispatch_batch``
+launches the device level loop through the engine's async ``dispatch``
+entry and returns a :class:`PendingBatch` immediately; ``finish_batch``
+blocks on the result and extracts/resolves. The split lets the service
+hand completed batches to an extraction worker and keep dispatching —
+and because JAX surfaces async-dispatch failures (OOM included) at the
+blocking fetch, the SAME classifier runs on both halves: a transient
+fetch failure re-dispatches the identical padded batch, an OOM raises
+:class:`OomRequeue` from whichever half saw it, and every admitted query
+still resolves exactly once.
 """
 
 from __future__ import annotations
@@ -28,10 +39,13 @@ from tpu_bfs.utils.recovery import (
 
 def pad_batch(sources: np.ndarray, lanes: int) -> tuple[np.ndarray, int]:
     """Pad a partial batch to exactly ``lanes`` sources so every dispatch
-    reuses ONE compiled shape (a variable-length batch would retrace the
-    level loop per distinct size). Pad lanes repeat the first real source
-    — a valid vertex by construction — and are masked out on extract by
-    never being read (lanes [n:) belong to no query)."""
+    reuses ONE compiled shape per ladder width (a variable-length batch
+    would retrace the level loop per distinct size). Pad lanes repeat the
+    first real source — a valid vertex by construction — and are masked
+    out on extract by never being read (lanes [n:) belong to no query).
+    With the width ladder the residual waste is bounded: routing already
+    picked the narrowest resident width >= n, and what's left shows up in
+    the ``padded_lanes_total`` counter."""
     n = len(sources)
     if n > lanes:
         raise ValueError(f"batch of {n} exceeds {lanes} lanes")
@@ -53,8 +67,43 @@ class OomRequeue(Exception):
         self.cause = cause
 
 
+class PendingBatch:
+    """One dispatched-but-unresolved batch crossing the pipeline handoff.
+
+    Carries everything either half needs: the engine, the admitted
+    queries (for exactly-once resolution), the padded source array (so a
+    transient fetch failure can re-dispatch the identical batch), the
+    async handle, and the retry attempt counter — shared across both
+    halves so the retry budget cannot double through the handoff."""
+
+    __slots__ = ("engine", "queries", "n", "padded", "handle", "attempt",
+                 "lanes")
+
+    def __init__(self, engine, queries, n: int, padded: np.ndarray):
+        self.engine = engine
+        self.queries = list(queries)
+        self.n = n
+        self.padded = padded
+        self.handle = None
+        self.attempt = 0
+        # Recorded at dispatch: the OOM handler clears ``engine`` to drop
+        # the device-table reference before a narrower rebuild, but the
+        # service still needs the width the failure happened at.
+        self.lanes = engine.lanes
+
+
+class _Ready:
+    """Degenerate handle for engines exposing only the blocking ``run``
+    protocol (test fakes): the whole run happens at dispatch time."""
+
+    __slots__ = ("res",)
+
+    def __init__(self, res):
+        self.res = res
+
+
 class BatchExecutor:
-    """Runs coalesced batches through an engine's ``run`` protocol."""
+    """Runs coalesced batches through an engine's dispatch/fetch halves."""
 
     def __init__(self, metrics, *, max_retries: int = 2,
                  backoff_s: float = 0.05, backoff_cap_s: float = 2.0,
@@ -66,61 +115,138 @@ class BatchExecutor:
         self._log = log or (lambda msg: None)
         self._sleep = sleep
 
-    def run_batch(self, engine, queries) -> None:
-        """Dispatch ``queries`` (<= engine.lanes of them) as one padded
-        batch and resolve every query exactly once. Raises
-        :class:`OomRequeue` when the dispatch OOM'd — the only outcome
-        that leaves the queries unresolved, because re-admission (at a
-        narrower width) is the service's call, not the executor's."""
+    # --- pipeline halves --------------------------------------------------
+
+    def dispatch_batch(self, engine, queries) -> PendingBatch | None:
+        """Pad and launch ``queries`` (<= engine.lanes of them) as one
+        batch WITHOUT blocking on the result. Returns the pending handoff
+        (resolve via :meth:`finish_batch`), or None when the batch already
+        resolved with deterministic errors. Raises :class:`OomRequeue` on
+        a dispatch-time OOM — the only outcome that leaves the queries
+        unresolved, because re-admission at a narrower width is the
+        service's call, not the executor's."""
         sources = np.asarray([q.source for q in queries], dtype=np.int64)
         padded, n = pad_batch(sources, engine.lanes)
-        attempt = 0
+        pending = PendingBatch(engine, queries, n, padded)
         while True:
             try:
-                res = engine.run(padded, time_it=False)
+                pending.handle = self._dispatch(engine, padded)
+                return pending
+            except Exception as exc:  # noqa: BLE001 — gated by the classifier
+                if not self._classify_failure(pending, exc):
+                    return None
+
+    def finish_batch(self, pending: PendingBatch) -> None:
+        """Block on a dispatched batch and resolve every query exactly
+        once. Transient fetch failures re-dispatch the same padded batch
+        (the handle is dead once its fetch raised); OOM raises
+        :class:`OomRequeue` exactly as the dispatch half does."""
+        engine = pending.engine
+        while True:
+            try:
+                if pending.handle is None:  # re-dispatch after a retry
+                    pending.handle = self._dispatch(engine, pending.padded)
+                res = self._fetch(engine, pending.handle)
                 break
             except Exception as exc:  # noqa: BLE001 — gated by the classifier
-                if is_oom_failure(exc):
-                    raise OomRequeue(list(queries), exc) from exc
-                if is_transient_failure(exc) and attempt < self.max_retries:
-                    attempt += 1
-                    wait = min(self.backoff_s * attempt, self.backoff_cap_s)
-                    self.metrics.record_retry()
-                    COUNTERS.bump("transient_retries")
-                    self._log(
-                        f"transient failure serving a {n}-query batch "
-                        f"(attempt {attempt}/{self.max_retries}): "
-                        f"{type(exc).__name__}: {str(exc)[:200]} — "
-                        f"retrying in {wait:.2f}s"
-                    )
-                    self._sleep(wait)
-                    continue
-                err = f"{type(exc).__name__}: {str(exc)[:300]}"
-                self._log(f"batch failed deterministically: {err}")
-                for q in queries:
-                    q.resolve_status(STATUS_ERROR, error=err)
-                self.metrics.record_errors(n)
-                return
-        self._resolve_ok(engine, res, queries, n)
+                pending.handle = None
+                if not self._classify_failure(pending, exc):
+                    return
+        # The result now owns whatever device state extraction needs; drop
+        # the handle's copy so the batch's loop outputs free as soon as
+        # the result does.
+        pending.handle = None
+        self._resolve_ok(pending, res)
 
-    def _resolve_ok(self, engine, res, queries, n: int) -> None:
+    def run_batch(self, engine, queries) -> None:
+        """The unpipelined path: dispatch immediately finished."""
+        pending = self.dispatch_batch(engine, queries)
+        if pending is not None:
+            self.finish_batch(pending)
+
+    # --- internals --------------------------------------------------------
+
+    @staticmethod
+    def _dispatch(engine, padded):
+        dispatch = getattr(engine, "dispatch", None)
+        if dispatch is not None:
+            return dispatch(padded)
+        return _Ready(engine.run(padded, time_it=False))
+
+    @staticmethod
+    def _fetch(engine, handle):
+        if isinstance(handle, _Ready):
+            return handle.res
+        return engine.fetch(handle)
+
+    def _classify_failure(self, pending: PendingBatch, exc) -> bool:
+        """The one classifier both halves share. True = retry the batch;
+        False = resolved as deterministic errors; OOM raises OomRequeue."""
+        if is_oom_failure(exc):
+            raise OomRequeue(list(pending.queries), exc) from exc
+        if is_transient_failure(exc) and pending.attempt < self.max_retries:
+            pending.attempt += 1
+            wait = min(self.backoff_s * pending.attempt, self.backoff_cap_s)
+            self.metrics.record_retry()
+            COUNTERS.bump("transient_retries")
+            self._log(
+                f"transient failure serving a {pending.n}-query batch "
+                f"(attempt {pending.attempt}/{self.max_retries}): "
+                f"{type(exc).__name__}: {str(exc)[:200]} — "
+                f"retrying in {wait:.2f}s"
+            )
+            self._sleep(wait)
+            return True
+        err = f"{type(exc).__name__}: {str(exc)[:300]}"
+        self._log(f"batch failed deterministically: {err}")
+        for q in pending.queries:
+            q.resolve_status(STATUS_ERROR, error=err)
+        self.metrics.record_errors(pending.n)
+        return False
+
+    def _resolve_ok(self, pending: PendingBatch, res) -> None:
         from tpu_bfs.graph.csr import INF_DIST
 
-        t_done = time.monotonic()
+        engine, queries, n = pending.engine, pending.queries, pending.n
+        width = engine.lanes
+        # The on-device ecc summary is only worth its kernel dispatch when
+        # some query skips the distance decode; all-want_distances batches
+        # derive levels from the rows they pull anyway.
+        ecc = (
+            getattr(res, "ecc", None)
+            if any(not getattr(q, "want_distances", True) for q in queries)
+            else None
+        )
+        t_x0 = time.monotonic()
         latencies = []
         for i, q in enumerate(queries):
-            d = res.distances_int32(i)
-            finite = d[d != INF_DIST]
-            latency_ms = (t_done - q.t_submit) * 1e3
+            want = getattr(q, "want_distances", True)
+            d = None
+            if want or ecc is None:
+                # The one per-lane device->host distance pull. Metadata-only
+                # queries skip it entirely when the engine reduced the
+                # summaries on device (ecc — every packed engine does).
+                d = res.distances_int32(i)
+            if ecc is not None:
+                levels = int(ecc[i])
+            else:
+                finite = d[d != INF_DIST]
+                levels = int(finite.max()) if finite.size else 0
+            # Stamp at RESOLVE time, per query: extraction cost is real
+            # client-visible latency (the old shared pre-extraction stamp
+            # hid it, and hid the pipelining win with it).
+            latency_ms = (time.monotonic() - q.t_submit) * 1e3
             q.resolve(QueryResult(
                 id=q.id,
                 source=q.source,
                 status=STATUS_OK,
-                distances=d,
-                levels=int(finite.max()) if finite.size else 0,
+                distances=d if want else None,
+                levels=levels,
                 reached=int(res.reached[i]),
                 latency_ms=latency_ms,
                 batch_lanes=n,
+                dispatched_lanes=width,
             ))
             latencies.append(latency_ms)
-        self.metrics.record_batch(n, engine.lanes, latencies)
+        extract_ms = (time.monotonic() - t_x0) * 1e3
+        self.metrics.record_batch(n, width, latencies, extract_ms=extract_ms)

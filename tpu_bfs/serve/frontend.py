@@ -4,10 +4,11 @@
 (``tpu-bfs-serve`` / ``python -m tpu_bfs.serve``) is the same service
 behind a line protocol:
 
-    request   {"id": 7, "source": 12345}            (+ "deadline_ms")
+    request   {"id": 7, "source": 12345}
+              (+ "deadline_ms", + "want_distances": false)
     response  {"id": 7, "source": 12345, "status": "ok", "levels": 6,
                "reached": 104857, "latency_ms": 18.4, "batch_lanes": 31,
-               "distances_npy": "<base64 .npy bytes>"}
+               "dispatched_lanes": 32, "distances_npy": "<base64 .npy>"}
 
 Non-ok responses carry ``status`` in {rejected, deadline_exceeded,
 error, shutdown} plus ``error``. Responses are emitted as queries
@@ -15,8 +16,16 @@ complete (batch order, not arrival order); ``id`` is the correlation
 key. stdout carries ONLY protocol lines; logs and the periodic statsz
 line go to stderr.
 
-One scheduler thread owns all device dispatch: clients only enqueue and
-wait, so jax never sees concurrent dispatch from racing threads.
+Adaptive dispatch (ISSUE 3): the service holds a small geometric WIDTH
+LADDER of warmed engines (default rungs lanes/16, lanes/4, lanes — e.g.
+32/128/512) and routes each coalesced batch to the narrowest rung that
+fits, so a 3-query batch stops paying 512 lanes of compute; and result
+extraction runs on a dedicated worker (PIPELINED, the engines'
+dispatch/fetch split), so the scheduler thread is already forming and
+dispatching batch N+1 while batch N's distances are still being pulled.
+The scheduler thread owns all BFS dispatch as before; the extraction
+worker's device work is limited to result readback of already-completed
+batches.
 """
 
 from __future__ import annotations
@@ -25,6 +34,7 @@ import argparse
 import base64
 import io
 import json
+import queue as _queue
 import sys
 import threading
 import time
@@ -49,6 +59,42 @@ from tpu_bfs.utils.recovery import (
 )
 
 MIN_LANES = 32
+# Auto ladder spacing: each rung 4x the previous (32/128/512 at the
+# default 512 max). Factor 4 keeps the rung count (and the HBM cost of
+# resident engines) low while bounding pad waste per batch below the
+# dispatched width's 3/4 — the routing histogram in /statsz shows where
+# traffic actually lands.
+LADDER_FACTOR = 4
+
+
+def build_width_ladder(lanes: int, ladder="auto") -> list:
+    """The service's resident widths, ascending, topped by ``lanes``.
+
+    ``"auto"`` walks down from ``lanes`` by :data:`LADDER_FACTOR` to the
+    32-lane floor; ``"off"``/None serves one fixed width (the pre-ladder
+    behavior, and the A/B baseline); an explicit sequence gives the rungs
+    directly (each a multiple of 32 in [32, lanes])."""
+    from tpu_bfs.algorithms._packed_common import floor_lanes
+
+    if ladder in (None, "off"):
+        return [lanes]
+    if isinstance(ladder, str) and ladder != "auto":
+        ladder = [int(tok) for tok in ladder.replace(",", " ").split()]
+    if ladder == "auto":
+        rungs = {lanes}
+        w = lanes
+        while w > MIN_LANES:
+            w = floor_lanes(max(MIN_LANES, w // LADDER_FACTOR))
+            rungs.add(w)
+        return sorted(rungs)
+    rungs = sorted({int(w) for w in ladder} | {lanes})
+    for w in rungs:
+        if w % 32 or not (MIN_LANES <= w <= lanes):
+            raise ValueError(
+                f"ladder width {w} must be a multiple of 32 in "
+                f"[{MIN_LANES}, {lanes}]"
+            )
+    return rungs
 
 
 class BfsService:
@@ -57,12 +103,20 @@ class BfsService:
     ``graph`` is a loaded ``Graph`` or a CLI graph spec string (path /
     ``rmat:scale=...`` / ``random:n=...``). Queries submitted from any
     thread are coalesced into packed batches of up to ``lanes`` sources
-    by one scheduler thread; ``linger_ms`` bounds how long a partial
-    batch waits for fill; ``queue_cap`` bounds the backlog (overload
-    sheds with REJECTED); ``deadline_ms`` (default: none) bounds each
-    query's QUEUE wait — see scheduler.py for the semantics. An OOM'd
-    dispatch halves the lane count (floor_lanes ladder, down to 32) and
-    re-admits its queries; transient failures retry in place.
+    by one scheduler thread; each batch is routed to the narrowest
+    ``width_ladder`` rung that fits ("auto" builds the geometric ladder,
+    "off" pins the single fixed width). ``linger_ms`` bounds how long a
+    partial batch waits for fill; ``queue_cap`` bounds the backlog
+    (overload sheds with REJECTED); ``deadline_ms`` (default: none)
+    bounds each query's QUEUE wait — see scheduler.py for the semantics.
+    An OOM at rung W evicts W and every wider rung and re-admits the
+    batch's queries below W (floor_lanes halving, down to 32); transient
+    failures retry in place. With ``pipeline=True`` (default) result
+    extraction overlaps the next batch's dispatch on a worker thread
+    (``pipeline_depth`` bounds the in-flight handoff). ``distances``
+    (default True) is the service-wide default for whether responses
+    carry the distance table; per-query ``want_distances`` overrides, and
+    distance-free queries never transfer the O(V) row off the device.
     """
 
     def __init__(
@@ -74,18 +128,32 @@ class BfsService:
         planes: int = DEFAULT_PLANES,
         pull_gate: bool = False,
         devices: int = 1,
+        width_ladder="auto",
+        pipeline: bool = True,
+        pipeline_depth: int = 2,
         linger_ms: float = 2.0,
         queue_cap: int = 1024,
         deadline_ms: float = 0.0,
         max_retries: int = 2,
+        distances: bool = True,
         registry: EngineRegistry | None = None,
         registry_capacity: int = 4,
         autostart: bool = True,
         log=None,
     ):
         self._log = log or (lambda msg: None)
+        # Widths and the degrade cap share one lock: the scheduler routes
+        # while the extraction worker may be shrinking the ladder after a
+        # fetch-time OOM.
+        self._width_lock = threading.Lock()
+        self._ladder = build_width_ladder(lanes, width_ladder)
+        self._max_lanes = self._ladder[-1]
+        # An internally-created registry must hold the WHOLE ladder
+        # resident (plus one degrade-rung slot) or routing thrashes
+        # rebuilds; a caller-supplied registry keeps its own policy.
         self._registry = registry or EngineRegistry(
-            capacity=registry_capacity, log=self._log
+            capacity=max(registry_capacity, len(self._ladder) + 1),
+            log=self._log,
         )
         if isinstance(graph, str):
             self._graph_key = graph
@@ -97,8 +165,8 @@ class BfsService:
         self._planes = planes
         self._pull_gate = pull_gate
         self._devices = devices
-        self._lanes = lanes
-        self._spec().validate()  # fail at construction, not first dispatch
+        for w in self._ladder:
+            self._spec(w).validate()  # fail at construction, not first dispatch
         self._linger_s = max(linger_ms, 0.0) / 1e3
         self._default_deadline_s = max(deadline_ms, 0.0) / 1e3
         self._queue = AdmissionQueue(queue_cap)
@@ -107,34 +175,51 @@ class BfsService:
             self.metrics, max_retries=max_retries, log=self._log
         )
         self._max_retries = max_retries
+        self._want_distances_default = bool(distances)
+        self._pipe_q: _queue.Queue | None = (
+            _queue.Queue(maxsize=max(1, int(pipeline_depth)))
+            if pipeline else None
+        )
         self._closed = False
         self._thread: threading.Thread | None = None
+        self._extract_thread: threading.Thread | None = None
         self._lock = threading.Lock()
         if autostart:
             self.start()
 
     # --- lifecycle --------------------------------------------------------
 
-    def _spec(self) -> EngineSpec:
+    def _spec(self, width: int | None = None) -> EngineSpec:
         return EngineSpec(
             graph_key=self._graph_key,
             engine=self._engine_kind,
-            lanes=self._lanes,
+            lanes=self._max_lanes if width is None else width,
             planes=self._planes,
             pull_gate=self._pull_gate,
             devices=self._devices,
         )
 
     def start(self) -> "BfsService":
-        """Build-and-warm the serving engine, then start the scheduler
-        thread. Idempotent; called by the constructor unless
-        ``autostart=False`` (tests that stage queries before dispatch)."""
+        """Build-and-warm every ladder rung's engine (widest first, so
+        the width most likely to OOM degrades the ladder before anything
+        narrower is paid for), then start the scheduler thread and — when
+        pipelining — the extraction worker. Idempotent; called by the
+        constructor unless ``autostart=False`` (tests that stage queries
+        before dispatch)."""
         with self._lock:
             if self._closed:
                 raise RuntimeError("service is closed")
             if self._thread is not None:
                 return self
-            self._acquire_engine()  # pay the build+warm before serving
+            for w in sorted(self.width_ladder, reverse=True):
+                if w <= self._max_lanes:  # rungs above a degraded cap died
+                    self._acquire_engine(w)
+            if self._pipe_q is not None:
+                self._extract_thread = threading.Thread(
+                    target=self._extract_loop, name="bfs-serve-extract",
+                    daemon=True,
+                )
+                self._extract_thread.start()
             self._thread = threading.Thread(
                 target=self._loop, name="bfs-serve-scheduler", daemon=True
             )
@@ -142,16 +227,21 @@ class BfsService:
         return self
 
     def close(self) -> None:
-        """Stop serving: in-flight batch completes, queued queries
-        resolve with SHUTDOWN. Idempotent."""
+        """Stop serving: in-flight batches complete (the extraction
+        worker drains its handoff before exiting), queued queries resolve
+        with SHUTDOWN. Idempotent."""
         with self._lock:
             if self._closed:
                 return
             self._closed = True
             thread = self._thread
+            extract_thread = self._extract_thread
         self._queue.stop()
         if thread is not None:
             thread.join()
+            if extract_thread is not None:
+                self._pipe_q.put(None)  # after scheduler exit: no more puts
+                extract_thread.join()
         else:
             # Never started: drain staged queries here instead.
             for q in self._queue.next_batch(self._queue.cap, 0.0):
@@ -172,14 +262,23 @@ class BfsService:
 
     @property
     def lanes(self) -> int:
-        """Current serving batch width (halves on OOM degrade)."""
-        return self._lanes
+        """Current maximum serving batch width (halves on OOM degrade)."""
+        return self._max_lanes
 
-    def submit(self, source, *, id=None, deadline_ms: float | None = None
-               ) -> PendingQuery:
+    @property
+    def width_ladder(self) -> list:
+        """Resident dispatch widths, ascending (shrinks on OOM degrade)."""
+        with self._width_lock:
+            return list(self._ladder)
+
+    def submit(self, source, *, id=None, deadline_ms: float | None = None,
+               want_distances: bool | None = None) -> PendingQuery:
         """Enqueue one query; returns a PendingQuery whose ``result()``
         always resolves (ok / rejected / deadline_exceeded / error /
-        shutdown — never a hang, never a silent drop)."""
+        shutdown — never a hang, never a silent drop).
+        ``want_distances=False`` asks for a metadata-only answer (levels/
+        reached) that never pulls the distance row off the device; None
+        uses the service-wide ``distances`` default."""
         now = time.monotonic()
         ddl_s = (
             self._default_deadline_s
@@ -189,6 +288,10 @@ class BfsService:
         q = PendingQuery(
             source, id=id, now=now,
             deadline=(now + ddl_s) if ddl_s > 0 else None,
+            want_distances=(
+                self._want_distances_default
+                if want_distances is None else want_distances
+            ),
         )
         if not (0 <= q.source < self._graph.num_vertices):
             q.resolve_status(
@@ -207,14 +310,19 @@ class BfsService:
         return q
 
     def query(self, source, *, timeout: float | None = None,
-              deadline_ms: float | None = None):
+              deadline_ms: float | None = None,
+              want_distances: bool | None = None):
         """Blocking submit-and-wait convenience."""
-        return self.submit(source, deadline_ms=deadline_ms).result(timeout)
+        return self.submit(
+            source, deadline_ms=deadline_ms, want_distances=want_distances,
+        ).result(timeout)
 
     def statsz(self) -> dict:
         out = self.metrics.snapshot(
-            queue_depth=self._queue.depth(), lanes=self._lanes
+            queue_depth=self._queue.depth(), lanes=self._max_lanes
         )
+        out["ladder"] = self.width_ladder
+        out["pipeline"] = self._pipe_q is not None
         resident = self._registry.resident()
         # None: a build holds the registry lock right now (resident() is
         # deliberately non-blocking — see registry.py).
@@ -223,17 +331,27 @@ class BfsService:
 
     # --- scheduler thread -------------------------------------------------
 
-    def _acquire_engine(self):
-        """The serving engine for the CURRENT lane count, retrying
-        transient build failures and degrading on build-time OOM (an
-        engine build allocates the packed tables, so it can OOM exactly
-        like a dispatch)."""
+    def _route_width(self, n: int) -> int:
+        """The narrowest ladder rung that fits ``n`` queries (the cap when
+        nothing does — the caller splits and re-admits the tail)."""
+        with self._width_lock:
+            for w in self._ladder:
+                if w >= n:
+                    return w
+            return self._max_lanes
+
+    def _acquire_engine(self, width: int):
+        """The warmed engine for ``width`` (clamped to the degrade cap),
+        retrying transient build failures and degrading on build-time OOM
+        (an engine build allocates the packed tables, so it can OOM
+        exactly like a dispatch)."""
         attempt = 0
         while True:
+            width = min(width, self._max_lanes)
             try:
-                return self._registry.get(self._spec())
+                return self._registry.get(self._spec(width))
             except Exception as exc:  # noqa: BLE001 — gated by classifiers
-                if is_oom_failure(exc) and self._degrade():
+                if is_oom_failure(exc) and self._degrade(width):
                     continue
                 if is_transient_failure(exc) and attempt < self._max_retries:
                     attempt += 1
@@ -247,28 +365,115 @@ class BfsService:
                     continue
                 raise
 
-    def _degrade(self, requeued: int = 0) -> bool:
-        """Halve the serving lane count after an OOM (dispatch- or
-        build-time); False at the floor. ``requeued`` is the query count
-        the caller is about to re-admit, for the metrics record. The
-        OOM'd width's engine is evicted from the registry first: the
-        narrower rebuild must not have to fit next to the dying engine's
-        tables, and every wider rung would otherwise stay pinned in HBM."""
+    def _degrade(self, at_width: int, requeued: int = 0) -> bool:
+        """Shrink the ladder after an OOM at ``at_width`` (dispatch-,
+        fetch-, or build-time); False at the floor. The new cap is one
+        halving below the OOM'd width; every rung >= it is evicted from
+        the registry FIRST — the narrower rebuild must not have to fit
+        next to the dying engines' tables, and wider rungs than an OOM'd
+        width can only OOM harder. ``requeued`` is the query count the
+        caller is about to re-admit, for the metrics record."""
         from tpu_bfs.algorithms._packed_common import floor_lanes
 
-        new = floor_lanes(max(MIN_LANES, self._lanes // 2))
-        if new >= self._lanes:
+        with self._width_lock:
+            new = floor_lanes(max(MIN_LANES, at_width // 2))
+            if new >= at_width:
+                # At the floor: no narrower width exists. Wider rungs can
+                # only OOM harder, so still collapse the ladder onto the
+                # floor — routing must stop dispatching into guaranteed
+                # OOMs even though this batch's queries resolve as errors.
+                dying = [w for w in self._ladder if w > at_width]
+                self._ladder = [w for w in self._ladder if w <= at_width]
+                self._max_lanes = at_width
+            else:
+                dying = [w for w in self._ladder if w > new]
+                self._ladder = [w for w in self._ladder if w <= new]
+                if new not in self._ladder:
+                    self._ladder.append(new)
+                self._max_lanes = new
+        for w in dying:
+            self._registry.evict(self._spec(w))
+        if new >= at_width:
+            if dying:
+                self._log(
+                    f"OOM at the {at_width}-lane floor: ladder collapsed "
+                    f"to {self._max_lanes} (evicted {dying})"
+                )
             return False
-        self._registry.evict(self._spec())
-        self._log(f"OOM degrade: {self._lanes} -> {new} lanes")
-        self._lanes = new
+        self._log(f"OOM degrade: {at_width} -> {new} lanes (cap {new})")
         COUNTERS.bump("oom_degrades")
         self.metrics.record_oom_degrade(requeued)
         return True
 
+    def _handle_batch_oom(self, queries, at_width: int, cause) -> None:
+        """Degrade below the OOM'd width and re-admit, or resolve with
+        explicit errors at the floor. Shared by the dispatch half (the
+        scheduler thread) and the fetch half (the extraction worker)."""
+        if self._degrade(at_width, requeued=len(queries)):
+            self._queue.requeue(queries)
+            if self._queue.stopped:
+                # The scheduler may already have drained and exited;
+                # re-admitted queries must still resolve (exactly-once).
+                n = 0
+                for q in self._queue.next_batch(self._queue.cap, 0.0):
+                    if q.resolve_status(
+                        STATUS_SHUTDOWN, error="service closed"
+                    ):
+                        n += 1
+                if n:
+                    self.metrics.record_shutdown(n)
+            return
+        err = (
+            f"out of memory at the minimum lane count "
+            f"({at_width}): {str(cause)[:200]}"
+        )
+        self._log(err)
+        n = 0
+        for q in queries:
+            if q.resolve_status(STATUS_ERROR, error=err):
+                n += 1
+        if n:
+            self.metrics.record_errors(n)
+
+    def _finish(self, pending) -> None:
+        """The extraction half, wherever it runs (inline or worker).
+        Never lets an exception escape with queries unresolved: an error
+        the executor's classifier didn't translate (e.g. a device failure
+        inside result extraction itself) still resolves the batch with
+        explicit errors — the exactly-once bar."""
+        try:
+            self._executor.finish_batch(pending)
+        except OomRequeue as exc:
+            width = pending.lanes
+            # Drop the references to the OOM'd engine before the narrower
+            # rebuild (the registry eviction in _degrade frees the tables
+            # only once nothing else holds them).
+            pending.engine = None
+            pending.handle = None
+            self._handle_batch_oom(exc.queries, width, exc.cause)
+        except Exception as exc:  # noqa: BLE001 — resolve, never strand
+            err = f"{type(exc).__name__}: {str(exc)[:300]}"
+            self._log(f"batch extraction failed: {err}")
+            n = 0
+            for q in pending.queries:
+                if q.resolve_status(STATUS_ERROR, error=err):
+                    n += 1  # idempotent: count only queries WE resolved
+            if n:
+                self.metrics.record_errors(n)
+
+    def _extract_loop(self) -> None:
+        while True:
+            pending = self._pipe_q.get()
+            if pending is None:
+                return
+            self._finish(pending)  # resolves its own failures
+            # Don't pin the finished batch's engine/handle refs (device
+            # tables) while idling in get() for the next one.
+            pending = None  # noqa: F841 — releases device state
+
     def _loop(self) -> None:
         while True:
-            batch = self._queue.next_batch(self._lanes, self._linger_s)
+            batch = self._queue.next_batch(self._max_lanes, self._linger_s)
             if self._queue.stopped:
                 n = 0
                 for q in batch:
@@ -296,37 +501,45 @@ class BfsService:
             if not live:
                 continue
             try:
-                engine = self._acquire_engine()
+                engine = self._acquire_engine(self._route_width(len(live)))
                 if len(live) > engine.lanes:
-                    # A build-time OOM degraded the width AFTER this batch
-                    # was popped at the old one: serve what fits, re-admit
-                    # the tail at the front (same contract as OomRequeue —
-                    # degrade must never turn into error responses).
+                    # An OOM degraded the cap AFTER this batch was popped
+                    # at the old one: serve what fits, re-admit the tail
+                    # at the front (same contract as OomRequeue — degrade
+                    # must never turn into error responses).
                     self._queue.requeue(live[engine.lanes:])
                     live = live[: engine.lanes]
-                self._executor.run_batch(engine, live)
+                pending = self._executor.dispatch_batch(engine, live)
             except OomRequeue as exc:
                 # Drop this frame's reference to the OOM'd engine before
-                # the narrower rebuild (the registry eviction in _degrade
-                # frees the tables only once nothing else holds them).
+                # the narrower rebuild (OomRequeue is only raised by
+                # dispatch_batch, so `engine` is always bound here).
+                width = engine.lanes
                 engine = None  # noqa: F841 — releases device tables
-                if self._degrade(requeued=len(exc.queries)):
-                    self._queue.requeue(exc.queries)
-                    continue
-                err = (
-                    f"out of memory at the minimum lane count "
-                    f"({self._lanes}): {str(exc.cause)[:200]}"
-                )
-                self._log(err)
-                for q in exc.queries:
-                    q.resolve_status(STATUS_ERROR, error=err)
-                self.metrics.record_errors(len(exc.queries))
+                self._handle_batch_oom(exc.queries, width, exc.cause)
+                continue
             except Exception as exc:  # noqa: BLE001 — engine build failed
+                engine = None  # noqa: F841 — don't pin a half-built engine
                 err = f"{type(exc).__name__}: {str(exc)[:300]}"
                 self._log(f"engine unavailable: {err}")
                 for q in live:
                     q.resolve_status(STATUS_ERROR, error=err)
                 self.metrics.record_errors(len(live))
+                continue
+            if pending is not None:
+                if self._pipe_q is not None:
+                    # Bounded handoff: blocks when the extraction worker
+                    # falls behind (pipeline_depth batches) — natural
+                    # backpressure.
+                    self._pipe_q.put(pending)
+                else:
+                    self._finish(pending)
+            # This frame must not pin the batch's engine/device refs while
+            # blocked in the next next_batch(): a fetch-OOM on the worker
+            # may evict and rebuild narrower, and the dying tables have to
+            # actually free (the same invariant the OomRequeue handler
+            # documents).
+            engine = pending = None  # noqa: F841 — releases device state
 
 
 # --- JSONL protocol -------------------------------------------------------
@@ -351,7 +564,8 @@ def result_to_response(r, *, with_distances: bool = True) -> dict:
         out["reached"] = r.reached
         out["latency_ms"] = round(r.latency_ms, 3)
         out["batch_lanes"] = r.batch_lanes
-        if with_distances:
+        out["dispatched_lanes"] = r.dispatched_lanes
+        if with_distances and r.distances is not None:
             out["distances_npy"] = _encode_distances(r.distances)
     else:
         out["error"] = r.error
@@ -374,8 +588,20 @@ def build_arg_parser() -> argparse.ArgumentParser:
                     help="serving engine (default wide; hybrid needs "
                     ">= 4096 lanes)")
     ap.add_argument("--lanes", type=int, default=512,
-                    help="batch width = max queries per dispatch "
+                    help="maximum batch width = max queries per dispatch "
                     "(multiple of 32; default 512)")
+    ap.add_argument("--ladder", default="auto",
+                    help="adaptive dispatch widths: 'auto' (geometric "
+                    "rungs down from --lanes, e.g. 32/128/512), 'off' "
+                    "(single fixed width), or an explicit list like "
+                    "'32,128,512'; each batch routes to the narrowest "
+                    "rung that fits (default auto)")
+    ap.add_argument("--no-pipeline", action="store_true",
+                    help="extract results on the scheduler thread instead "
+                    "of overlapping extraction with the next dispatch")
+    ap.add_argument("--pipeline-depth", type=int, default=2,
+                    help="max dispatched-but-unextracted batches in "
+                    "flight (default 2)")
     ap.add_argument("--planes", type=int, default=DEFAULT_PLANES,
                     choices=range(1, 9), metavar="P",
                     help=f"bit-plane count (depth cap 2**P; default "
@@ -397,13 +623,17 @@ def build_arg_parser() -> argparse.ArgumentParser:
                     help="transient-failure re-dispatches per batch "
                     "(default 2)")
     ap.add_argument("--no-distances", action="store_true",
-                    help="omit the distances_npy payload from responses "
-                    "(metadata-only serving)")
+                    help="metadata-only serving by default: responses "
+                    "omit distances_npy AND the distance rows are never "
+                    "pulled off the device (per-request "
+                    "\"want_distances\" overrides)")
     ap.add_argument("--statsz-every", type=float, default=10.0,
                     help="seconds between statsz lines on stderr; 0 "
                     "disables (default 10)")
     ap.add_argument("--registry-cap", type=int, default=4,
-                    help="LRU bound on resident warmed engines (default 4)")
+                    help="LRU bound on resident warmed engines (default 4, "
+                    "raised automatically to fit the width ladder's rungs "
+                    "plus one degrade slot)")
     return ap
 
 
@@ -427,10 +657,14 @@ def run_server(args, stdin=None, stdout=None, stderr=None,
         planes=args.planes,
         pull_gate=args.pull_gate,
         devices=args.devices,
+        width_ladder=args.ladder,
+        pipeline=not args.no_pipeline,
+        pipeline_depth=args.pipeline_depth,
         linger_ms=args.linger_ms,
         queue_cap=args.queue_cap,
         deadline_ms=args.deadline_ms,
         max_retries=args.max_retries,
+        distances=not args.no_distances,
         registry=registry,
         registry_capacity=args.registry_cap,
         log=log,
@@ -445,9 +679,7 @@ def run_server(args, stdin=None, stdout=None, stderr=None,
             stdout.flush()
 
     def on_done(q: PendingQuery) -> None:
-        emit(result_to_response(
-            q.result(), with_distances=not args.no_distances
-        ))
+        emit(result_to_response(q.result()))
         with drained:
             outstanding[0] -= 1
             if outstanding[0] == 0:
@@ -466,7 +698,9 @@ def run_server(args, stdin=None, stdout=None, stderr=None,
         ).start()
 
     log(f"serving {args.graph!r}: engine={args.engine} lanes={args.lanes} "
-        f"linger={args.linger_ms}ms queue_cap={args.queue_cap}")
+        f"ladder={service.width_ladder} "
+        f"pipeline={not args.no_pipeline} linger={args.linger_ms}ms "
+        f"queue_cap={args.queue_cap}")
     try:
         for line in stdin:
             line = line.strip()
@@ -481,6 +715,14 @@ def run_server(args, stdin=None, stdout=None, stderr=None,
                 source = int(req["source"])
                 ddl = req.get("deadline_ms")
                 ddl = float(ddl) if ddl is not None else None
+                want = req.get("want_distances")
+                if want is not None and not isinstance(want, bool):
+                    # bool("false") is True — a lenient coercion would
+                    # silently invert the client's intent.
+                    raise TypeError(
+                        "want_distances must be a JSON boolean, got "
+                        f"{want!r}"
+                    )
             except (ValueError, KeyError, TypeError) as exc:
                 emit({
                     "id": qid,
@@ -491,7 +733,7 @@ def run_server(args, stdin=None, stdout=None, stderr=None,
             with drained:
                 outstanding[0] += 1
             service.submit(
-                source, id=qid, deadline_ms=ddl,
+                source, id=qid, deadline_ms=ddl, want_distances=want,
             ).add_done_callback(on_done)
         with drained:
             while outstanding[0] > 0:

@@ -2,11 +2,12 @@
 
 The traversal engines' observability (utils/stats.py) is per-run; a
 server needs per-PROCESS counters that survive across batches — QPS,
-latency percentiles, batch fill ratio, queue depth, retries, sheds. One
-lock guards everything: every writer is either the scheduler thread or a
-client thread shedding at admission, and the snapshot is read at human
-timescales (the periodic statsz line), so contention is irrelevant next
-to a device dispatch.
+latency percentiles, batch fill ratio vs DISPATCHED width, the width
+ladder's routing histogram, pad waste, extraction time, queue depth,
+retries, sheds. One lock guards everything: writers are the scheduler
+thread, the extraction worker, and client threads shedding at admission,
+and the snapshot is read at human timescales (the periodic statsz line),
+so contention is irrelevant next to a device dispatch.
 """
 
 from __future__ import annotations
@@ -14,7 +15,7 @@ from __future__ import annotations
 import json
 import threading
 import time
-from collections import deque
+from collections import Counter, deque
 
 import numpy as np
 
@@ -43,18 +44,31 @@ class ServeMetrics:
         self.requeued = 0  # queries re-admitted after an OOM'd batch
         self.batches = 0
         self.lanes_used = 0  # real (non-pad) queries across all batches
-        self.lanes_offered = 0  # sum of batch capacity (engine lanes)
+        # Sum of DISPATCHED batch capacity: with the width ladder this is
+        # the routed width per batch, so fill_ratio reports waste against
+        # the width actually paid for, not the configured maximum.
+        self.lanes_offered = 0
+        self.padded_lanes_total = 0  # residual pad waste after routing
+        self.batches_by_width = Counter()  # routing histogram: width -> batches
+        self._extract_ms: deque = deque(maxlen=LATENCY_WINDOW)
+        self.extract_ms_total = 0.0  # host extraction time across batches
         # Interval bookkeeping for the statsz line's recent-QPS figure.
         self._last_snap_t = self._t0
         self._last_snap_completed = 0
 
-    def record_batch(self, used: int, capacity: int, latencies_ms) -> None:
+    def record_batch(self, used: int, capacity: int, latencies_ms, *,
+                     extract_ms: float | None = None) -> None:
         with self._lock:
             self.batches += 1
             self.lanes_used += used
             self.lanes_offered += capacity
+            self.padded_lanes_total += max(capacity - used, 0)
+            self.batches_by_width[int(capacity)] += 1
             self.completed += len(latencies_ms)
             self._latencies_ms.extend(latencies_ms)
+            if extract_ms is not None:
+                self._extract_ms.append(extract_ms)
+                self.extract_ms_total += extract_ms
 
     def record_rejected(self) -> None:
         with self._lock:
@@ -97,6 +111,7 @@ class ServeMetrics:
                 self._last_snap_t = now
                 self._last_snap_completed = self.completed
             lat = np.asarray(self._latencies_ms, dtype=np.float64)
+            ext = np.asarray(self._extract_ms, dtype=np.float64)
             out = {
                 "uptime_s": round(uptime, 3),
                 "completed": self.completed,
@@ -107,6 +122,17 @@ class ServeMetrics:
                 "fill_ratio": round(
                     self.lanes_used / self.lanes_offered, 4
                 ) if self.lanes_offered else 0.0,
+                "padded_lanes_total": self.padded_lanes_total,
+                # Routing histogram (width ladder): how many batches each
+                # dispatched width served. JSON keys must be strings.
+                "routing": {
+                    str(wd): n
+                    for wd, n in sorted(self.batches_by_width.items())
+                },
+                "extract_p50_ms": round(
+                    float(np.percentile(ext, 50)), 3
+                ) if ext.size else None,
+                "extract_ms_total": round(self.extract_ms_total, 3),
                 "batches": self.batches,
                 "rejected": self.rejected,
                 "expired": self.expired,

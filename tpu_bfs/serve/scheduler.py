@@ -48,8 +48,9 @@ class QueryResult:
     distances: np.ndarray | None = None  # [V] int32, INF_DIST unreached
     levels: int | None = None  # this source's eccentricity (max finite dist)
     reached: int | None = None
-    latency_ms: float | None = None  # submit -> resolve
+    latency_ms: float | None = None  # submit -> resolve (extraction included)
     batch_lanes: int | None = None  # real queries in the serving batch
+    dispatched_lanes: int | None = None  # width the batch was routed to
     error: str | None = None
 
     @property
@@ -66,17 +67,22 @@ class PendingQuery:
     ``resolve`` is idempotent (first writer wins) so racy paths — e.g. a
     shutdown drain against an in-flight batch completing — can both try
     without double-delivery. Callbacks added after resolution fire
-    immediately on the caller's thread."""
+    immediately on the caller's thread.
 
-    __slots__ = ("id", "source", "deadline", "t_submit", "_event", "_lock",
-                 "_result", "_callbacks")
+    ``want_distances=False`` marks a metadata-only query (levels/reached
+    only): with the engines' on-device summaries, such a query never
+    pulls its distance row off the device at all."""
+
+    __slots__ = ("id", "source", "deadline", "t_submit", "want_distances",
+                 "_event", "_lock", "_result", "_callbacks")
 
     def __init__(self, source: int, *, id=None, deadline: float | None = None,
-                 now: float | None = None):
+                 now: float | None = None, want_distances: bool = True):
         self.id = next(_QUERY_SEQ) if id is None else id
         self.source = int(source)
         self.deadline = deadline  # absolute time.monotonic() value, or None
         self.t_submit = time.monotonic() if now is None else now
+        self.want_distances = bool(want_distances)
         self._event = threading.Event()
         self._lock = threading.Lock()
         self._result: QueryResult | None = None

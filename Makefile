@@ -1,5 +1,5 @@
 # Convenience targets; everything also works without make (README).
-.PHONY: test native bench serve-smoke wheel clean
+.PHONY: test native bench serve-smoke chaos-smoke wheel clean
 
 # Full suite on 8 virtual CPU devices (tests/conftest.py forces the
 # platform; the axon TPU plugin is bypassed).
@@ -35,6 +35,16 @@ serve-smoke:
 	meta = [r for r in rs if r['id'] == 4][0]; \
 	assert 'distances_npy' not in meta and meta['levels'] >= 1, rs; \
 	print('serve-smoke OK:', sorted(r['id'] for r in rs))"
+
+# The seeded chaos soak (README "Failure model"): a JSONL server under a
+# deterministic fault schedule (transient + OOM degrade + slow extract)
+# must answer bit-identically to the fault-free run with every injected
+# fault visible in statsz; SIGTERM mid-stream must drain cleanly; and a
+# corrupted checkpoint save must quarantine + fall back on load. The
+# pytest `chaos` marker runs the same machinery in-process
+# (tests/test_chaos.py, tests/test_faults.py).
+chaos-smoke:
+	env JAX_PLATFORMS=cpu python scripts/chaos_smoke.py
 
 wheel:
 	python -m pip wheel . --no-deps --no-build-isolation -w dist

@@ -1123,17 +1123,33 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
     pipeline = os.environ.get("TPU_BFS_BENCH_SERVE_PIPELINE", "1") == "1"
     engine = os.environ.get("TPU_BFS_BENCH_SERVE_ENGINE", "wide")
     do_validate = os.environ.get("TPU_BFS_BENCH_VALIDATE", "1") == "1"
+    watchdog_ms = float(os.environ.get("TPU_BFS_BENCH_SERVE_WATCHDOG_MS",
+                                       "0") or 0)
+    # Chaos arm (scripts/chip_session.sh chaos-s20): a deterministic fault
+    # schedule (tpu_bfs/faults.py) injected into the serving hot path; the
+    # closed loop must still answer every query correctly, and the
+    # recovery/fault counters ride the JSON line. Armed AFTER the service
+    # is up (below) so bounded budgets land on measured serving
+    # dispatches, not on engine warm-up.
+    fault_spec = os.environ.get("TPU_BFS_BENCH_FAULTS", "").strip()
+    fault_sched = None
 
     t0 = time.perf_counter()
     service = retry_transient(
         BfsService, g, engine=engine, lanes=lanes, planes=8,
         width_ladder=ladder, pipeline=pipeline,
         linger_ms=2.0, queue_cap=max(1024, 2 * clients),
+        watchdog_ms=watchdog_ms,
         log=log, label="serve engine build",
     )
     log(f"service up in {time.perf_counter()-t0:.1f}s: engine={engine} "
         f"lanes={lanes} ladder={service.width_ladder} pipeline={pipeline} "
         f"clients={clients} queries={clients * per_client}")
+    if fault_spec:
+        from tpu_bfs import faults as faults_mod
+
+        fault_sched = faults_mod.arm_from_spec(fault_spec)
+        log(f"fault schedule armed: {fault_sched.to_spec()}")
 
     rng = np.random.default_rng(7)
     candidates = np.flatnonzero(g.degrees > 0)
@@ -1209,6 +1225,14 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
         "serve_pipeline": pipeline,
         "serve_retries": snap["retries"],
         "serve_sheds": snap["rejected"],
+        # Robustness counters (chaos harness / serve hardening): OOM
+        # degrades, watchdog firings, breaker opens, requeue-budget sheds
+        # — plus the per-kind injected-fault audit when a schedule ran.
+        "serve_oom_degrades": snap["oom_degrades"],
+        "serve_watchdog_trips": snap["watchdog_trips"],
+        "serve_breaker_opens": snap["breaker_opens"],
+        "serve_requeue_shed": snap["requeue_shed"],
+        **({"serve_faults": fault_sched.counts()} if fault_sched else {}),
     }
 
 

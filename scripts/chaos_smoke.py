@@ -1,0 +1,216 @@
+"""The seeded chaos soak (`make chaos-smoke`): serve + fault schedule +
+drain-on-SIGTERM + checkpoint corruption, end to end on CPU.
+
+Four acts, all against the REAL subprocess/server/recovery machinery (no
+monkeypatching anywhere — that is the point of tpu_bfs/faults.py):
+
+1. BASELINE — a fault-free JSONL server answers the query set; its
+   responses are the bit-identity reference.
+2. CHAOS — the same server with a seeded schedule injecting a transient,
+   an OOM (degrading the width ladder), and a slow extraction. Every
+   response must be byte-identical to the baseline, and every injected
+   fault must be visible in the final statsz counters.
+3. DRAIN — with queries in flight and the stdin pipe still open, SIGTERM
+   must drain cleanly: every submitted query resolves, the final statsz
+   line lands, the process exits 0 within the timeout.
+4. CHECKPOINT — an in-process checkpointed traversal whose LAST sharded
+   save is corrupted by a corrupt_ckpt rule: the loader must quarantine
+   the bad shard, fall back to the newest intact generation, and the
+   resumed run must finish bit-identical to fault-free.
+
+Prints one JSON line (value = chaos-served query count) so
+scripts/chip_session.sh's has_value gate can drive it as a stage.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+# Runnable as `python scripts/chaos_smoke.py` from the repo root (the
+# same idiom as the other helper scripts): the in-process act imports
+# tpu_bfs directly.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+GRAPH = "random:n=96,m=480,seed=3"
+# 40 queries + a long linger: the first serving batch coalesces past 32
+# and routes to the 64 rung — the width the scheduled OOM targets.
+QUERIES = list(range(0, 80, 2))
+# Site-visit arithmetic for the schedule: server startup warms the 64 and
+# 32 rungs (one dispatch + one fetch visit each), so the rung-64 OOM
+# skips the 64 warm-up dispatch (skip=1) and fires on the FIRST SERVING
+# 64-wide dispatch, and the slow extraction skips both warm-up fetches
+# (skip=2); the serve_batch site is never visited by warm-up, so the
+# transient lands on the first serving batch's first dispatch attempt.
+# Story: transient -> retry -> 64-rung OOM -> degrade + requeue ->
+# re-served at 32 with the slowed extraction. Same answers throughout.
+FAULTS = ("seed=11:transient@serve_batch:n=1,oom@rung=64:n=1:skip=1,"
+          "slow_extract:ms=100:n=1:skip=2")
+SERVER = [sys.executable, "-m", "tpu_bfs.serve", GRAPH,
+          "--lanes", "64", "--ladder", "32,64", "--linger-ms", "200",
+          "--statsz-every", "0"]
+ENV = dict(os.environ, JAX_PLATFORMS="cpu")
+
+
+def log(msg):
+    print(f"[chaos-smoke] {msg}", file=sys.stderr, flush=True)
+
+
+def run_server(extra_args, requests, *, sigterm_after=None, timeout=300):
+    """One server subprocess: write requests, optionally SIGTERM after
+    the first ``sigterm_after`` responses, return (responses, stderr, rc).
+    """
+    proc = subprocess.Popen(
+        SERVER + extra_args, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, env=ENV,
+    )
+    head = requests if sigterm_after is None else requests[:sigterm_after]
+    tail = [] if sigterm_after is None else requests[sigterm_after:]
+    responses = []
+    payload = None
+    if sigterm_after is None:
+        payload = "".join(json.dumps(req) + "\n" for req in head)
+    else:
+        for req in head:
+            proc.stdin.write(json.dumps(req) + "\n")
+        proc.stdin.flush()
+        # Wait until the head queries are answered, then push the tail
+        # and SIGTERM with the pipe still open — the drain must resolve
+        # everything submitted, emit the final statsz, and exit 0.
+        while len(responses) < len(head):
+            line = proc.stdout.readline()
+            if not line:
+                break
+            responses.append(json.loads(line))
+        for req in tail:
+            proc.stdin.write(json.dumps(req) + "\n")
+        proc.stdin.flush()
+        log(f"sending SIGTERM with the pipe open and {len(tail)} "
+            f"queries just written")
+        proc.send_signal(signal.SIGTERM)
+    t0 = time.monotonic()
+    try:
+        out, err = proc.communicate(input=payload, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        raise SystemExit(f"FAIL: server did not exit within {timeout}s "
+                         f"(the drain hung)")
+    responses += [json.loads(l) for l in out.splitlines() if l.strip()]
+    log(f"server exited rc={proc.returncode} in "
+        f"{time.monotonic() - t0:.1f}s with {len(responses)} responses")
+    return responses, err, proc.returncode
+
+
+def check(cond, msg):
+    if not cond:
+        raise SystemExit(f"FAIL: {msg}")
+    log(f"ok: {msg}")
+
+
+def last_statsz(err: str) -> dict:
+    lines = [l for l in err.splitlines() if l.startswith("statsz ")]
+    check(lines, "final statsz line emitted")
+    return json.loads(lines[-1][len("statsz "):])
+
+
+def main() -> int:
+    reqs = [{"id": i, "source": s} for i, s in enumerate(QUERIES)]
+
+    log("act 1: fault-free baseline")
+    base, err, rc = run_server([], reqs)
+    check(rc == 0, "baseline server exits 0")
+    check(len(base) == len(reqs)
+          and all(r["status"] == "ok" for r in base),
+          "baseline answers every query ok")
+    base_by_id = {r["id"]: r for r in base}
+
+    log(f"act 2: chaos run with --faults {FAULTS!r}")
+    chaos, err, rc = run_server(["--faults", FAULTS], reqs)
+    check(rc == 0, "chaos server exits 0")
+    check(len(chaos) == len(reqs)
+          and all(r["status"] == "ok" for r in chaos),
+          "chaos run answers every query ok despite the schedule")
+    for r in chaos:
+        b = base_by_id[r["id"]]
+        check(r["distances_npy"] == b["distances_npy"]
+              and r["levels"] == b["levels"]
+              and r["reached"] == b["reached"],
+              f"query {r['id']} bit-identical to the fault-free run")
+    snap = last_statsz(err)
+    check(snap.get("faults") == {"transient": 1, "oom": 1,
+                                 "slow_extract": 1},
+          f"all three injected faults visible in statsz: {snap.get('faults')}")
+    check(snap["retries"] >= 1, "the transient was retried")
+    check(snap["oom_degrades"] == 1, "the OOM degraded the width ladder")
+
+    log("act 3: SIGTERM drain with the pipe open and queries in flight")
+    drained, err, rc = run_server([], reqs * 3, sigterm_after=len(reqs))
+    check(rc == 0, "drained server exits 0")
+    check(all(r["status"] in ("ok", "shutdown", "rejected")
+              for r in drained),
+          "every resolved query has an explicit terminal status")
+    first = [r for r in drained[:len(reqs)]]
+    check(all(r["status"] == "ok" for r in first),
+          "every pre-signal query was answered ok")
+    check("received: draining" in err, "the drain log line landed")
+    last_statsz(err)
+
+    log("act 4: corrupt-checkpoint fallback (in-process)")
+    import tempfile
+
+    import numpy as np
+
+    from tpu_bfs import faults
+    from tpu_bfs.algorithms.bfs import BfsEngine
+    from tpu_bfs.cli import load_graph
+    from tpu_bfs.utils import checkpoint as ck
+    from tpu_bfs.utils.recovery import advance_with_recovery
+
+    g = load_graph(GRAPH)
+    clean = BfsEngine(g).run(1)
+    with tempfile.TemporaryDirectory() as d0:
+        saves = []
+        eng = BfsEngine(g)
+        advance_with_recovery(
+            lambda: BfsEngine(g), eng.start(1), engine=eng,
+            levels_per_chunk=1,
+            save=lambda c: saves.append(
+                ck.save_checkpoint_sharded(d0, c, num_shards=2)),
+        )
+    with tempfile.TemporaryDirectory() as d:
+        faults.arm_from_spec(
+            f"seed=13:corrupt_ckpt:n=1:skip={2 * len(saves) - 2}")
+        try:
+            eng = BfsEngine(g)
+            _, st, _ = advance_with_recovery(
+                lambda: BfsEngine(g), eng.start(1), engine=eng,
+                levels_per_chunk=1,
+                save=lambda c: ck.save_checkpoint_sharded(d, c, num_shards=2),
+            )
+        finally:
+            faults.disarm()
+        msgs = []
+        back = ck.load_checkpoint_sharded(d, log=msgs.append)
+        check(msgs and "falling back" in msgs[0],
+              "corrupt shard quarantined; loader fell back to the "
+              "previous generation")
+        eng = BfsEngine(g)
+        while not back.done:
+            back = eng.advance(back, levels=4)
+        check(bool(np.array_equal(back.distance, clean.distance)),
+              "resumed-from-fallback distances bit-identical to fault-free")
+
+    print(json.dumps({
+        "metric": "chaos smoke (serve soak + SIGTERM drain + checkpoint "
+                  "corruption fallback, CPU)",
+        "value": len(chaos),
+        "unit": "queries",
+        "faults": snap.get("faults"),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -127,6 +127,15 @@ for i in $(seq 1 "$attempts"); do
     stage "serve-fixed-s20" "$out/serve_fixed_s20.json" \
       TPU_BFS_BENCH_MODE=serve TPU_BFS_BENCH_SCALE=20 \
       TPU_BFS_BENCH_SERVE_LADDER=off TPU_BFS_BENCH_SERVE_PIPELINE=0
+    # Chaos arm (robustness): the same closed-loop serve stage under a
+    # seeded fault schedule (tpu_bfs/faults.py) — injected transients and
+    # slowed extraction ON CHIP must not change a single answer (the
+    # stage's own oracle validation) and the recovery/fault counters ride
+    # the JSON line (serve_faults / serve_watchdog_trips / recovery).
+    stage "chaos-s20" "$out/chaos_s20.json" \
+      TPU_BFS_BENCH_MODE=serve TPU_BFS_BENCH_SCALE=20 \
+      TPU_BFS_BENCH_FAULTS="seed=7:transient@serve_batch:n=2,slow_extract:ms=50:n=4" \
+      TPU_BFS_BENCH_SERVE_WATCHDOG_MS=600000
     # The probe's completion-marker line satisfies got_value, so pstage
     # gives it the same idempotent restart + timeout envelope as the
     # other helper scripts.

@@ -1,0 +1,492 @@
+"""Chaos harness acceptance: seeded fault schedules against the REAL
+recovery paths, serve lifecycle hardening, and bounded failure handling.
+
+The bar (robustness issue): under a seeded schedule injecting
+transients, OOMs, and slow extraction, serve responses are BIT-IDENTICAL
+to the fault-free run and every injected fault is visible in
+RecoveryCounters/statsz; a SIGTERM mid-stream drains cleanly (all
+submitted queries resolve, final statsz emitted, no hang); a hung device
+fetch trips the dispatch watchdog into the transient path instead of
+wedging the executor; a rung that fails deterministically opens its
+circuit breaker and routing goes around it; and the OOM requeue ladder
+carries a bounded budget, resolving hopeless queries with their attempt
+history instead of looping forever.
+"""
+
+import io
+import json
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpu_bfs import faults
+from tpu_bfs.graph.generate import random_graph
+from tpu_bfs.reference.cpu_bfs import bfs_python
+from tpu_bfs.serve import BfsService, EngineRegistry
+from tpu_bfs.serve.executor import CircuitBreaker
+from tpu_bfs.utils.recovery import COUNTERS
+
+pytestmark = [pytest.mark.serve, pytest.mark.chaos]
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+@pytest.fixture(scope="module")
+def chaos_graph():
+    return random_graph(160, 1200, seed=31)
+
+
+@pytest.fixture(scope="module")
+def chaos_registry(chaos_graph):
+    """One warmed engine set shared across the module (tier-1 wall-clock:
+    fresh builds cost seconds each)."""
+    reg = EngineRegistry(capacity=4)
+    reg.add_graph("chaos-graph", chaos_graph)
+    return reg
+
+
+@pytest.fixture(scope="module")
+def chaos_golden(chaos_graph):
+    cand = np.flatnonzero(chaos_graph.degrees > 0)[:10]
+    return {int(s): bfs_python(chaos_graph, int(s))[0] for s in cand}
+
+
+# --- the soak: bit-identical under a seeded fault schedule -----------------
+
+
+def test_chaos_soak_serve_bit_identical(chaos_graph, chaos_golden):
+    """>=1 transient, >=1 OOM, >=1 slow-extract injected into the serving
+    hot path; every response must match the fault-free answers (the CPU
+    oracle) bit for bit, and every injected fault must be visible in the
+    counters. A dedicated registry: the OOM degrade evicts engines, and
+    the module-shared set must stay warm for the other tests."""
+    reg = EngineRegistry(capacity=4)
+    reg.add_graph("soak", chaos_graph)
+    COUNTERS.reset()
+    sources = list(chaos_golden) * 4  # 40 queries: fills the 64 rung
+    svc = BfsService("soak", registry=reg, lanes=64, width_ladder="32,64",
+                     linger_ms=5.0, autostart=False)
+    svc.start()  # warm BEFORE arming: the soak targets serving dispatches
+    sched = faults.arm_from_spec(
+        "seed=9:transient@serve_batch:n=1,oom@rung=64:n=1,"
+        "slow_extract:ms=50:n=1"
+    )
+    try:
+        staged = [svc.submit(s) for s in sources]
+        for q in staged:
+            r = q.result(timeout=120)
+            assert r.ok, (r.status, r.error)
+            np.testing.assert_array_equal(
+                r.distances, chaos_golden[r.source]
+            )
+        snap = svc.statsz()
+    finally:
+        svc.close()
+        faults.disarm()
+    # Every scheduled fault landed and is visible post-hoc.
+    assert sched.exhausted(), sched.counts()
+    assert sched.counts() == {
+        "transient": 1, "oom": 1, "slow_extract": 1,
+    }
+    assert snap["faults"] == sched.counts()
+    assert snap["retries"] >= 1  # the transient really was retried
+    assert snap["oom_degrades"] == 1  # the OOM really degraded the ladder
+    c = COUNTERS.as_dict()
+    assert c["faults_injected"] == 3
+    assert c["transient_retries"] >= 1 and c["oom_degrades"] == 1
+    # The OOM'd 64 rung is gone; the batch was re-served narrower.
+    assert svc.width_ladder == [32]
+
+
+def test_chaos_soak_traversal_with_corrupt_checkpoint(chaos_graph):
+    """The traversal half of the soak: a transient at the advance site
+    plus ONE corrupted checkpoint save; the run must complete
+    bit-identically to the fault-free run, resuming from the newest
+    intact generation after the corruption is quarantined."""
+    from tpu_bfs.algorithms.bfs import BfsEngine
+    from tpu_bfs.utils import checkpoint as ck
+    from tpu_bfs.utils.recovery import advance_with_recovery
+
+    import tempfile
+
+    COUNTERS.reset()
+    clean = BfsEngine(chaos_graph).run(1)
+    # Count the run's checkpoint saves fault-free first: the corrupt rule
+    # then targets the LAST save via skip= (each sharded save visits the
+    # ckpt_save site twice — once per shard), so the newest generation is
+    # the corrupted one and the fallback story actually exercises.
+    with tempfile.TemporaryDirectory() as d0:
+        saves = []
+        eng0 = BfsEngine(chaos_graph)
+        advance_with_recovery(
+            lambda: BfsEngine(chaos_graph), eng0.start(1), engine=eng0,
+            levels_per_chunk=1,
+            save=lambda c: saves.append(
+                ck.save_checkpoint_sharded(d0, c, num_shards=2)
+            ),
+        )
+    site_visits = 2 * len(saves)
+    with tempfile.TemporaryDirectory() as d:
+        sched = faults.arm_from_spec(
+            f"seed=13:transient@advance:n=1,"
+            f"corrupt_ckpt:n=1:skip={site_visits - 2}"
+        )
+        try:
+            eng = BfsEngine(chaos_graph)
+            _, st, restarts = advance_with_recovery(
+                lambda: BfsEngine(chaos_graph), eng.start(1), engine=eng,
+                levels_per_chunk=1,
+                save=lambda c: ck.save_checkpoint_sharded(d, c, num_shards=2),
+            )
+        finally:
+            faults.disarm()
+        assert restarts == 1 and sched.exhausted()
+        np.testing.assert_array_equal(st.distance, clean.distance)
+        # One shard of one generation was corrupted by the schedule; the
+        # loader must quarantine it and fall back to the newest intact
+        # generation — never resume from poisoned state.
+        msgs = []
+        back = ck.load_checkpoint_sharded(d, log=msgs.append)
+        corrupts = [
+            f for g in ("gen_a", "gen_b")
+            for f in (os.listdir(os.path.join(d, g))
+                      if os.path.isdir(os.path.join(d, g)) else [])
+            if f.endswith(".corrupt")
+        ]
+        assert corrupts, "the corrupt_ckpt fault never landed"
+        assert msgs and "falling back" in msgs[0]
+        eng2 = BfsEngine(chaos_graph)
+        while not back.done:
+            back = eng2.advance(back, levels=4)
+        np.testing.assert_array_equal(back.distance, clean.distance)
+    assert COUNTERS.as_dict()["faults_injected"] == 2
+
+
+# --- dispatch watchdog -----------------------------------------------------
+
+
+class _FakeResult:
+    def __init__(self, sources, v):
+        self._sources = np.asarray(sources)
+        self._v = v
+        self.reached = np.ones(len(self._sources), np.int64)
+        self.ecc = np.zeros(len(self._sources), np.int32)
+
+    def distances_int32(self, i):
+        from tpu_bfs.graph.csr import INF_DIST
+
+        d = np.full(self._v, INF_DIST, np.int32)
+        d[self._sources[i]] = 0
+        return d
+
+
+class _FakeEngine:
+    def __init__(self, lanes, v):
+        self.lanes = lanes
+        self.num_vertices = v
+        self.dispatches = 0
+        self.fetches = 0
+
+    def dispatch(self, padded):
+        self.dispatches += 1
+        return np.asarray(padded)
+
+    def fetch(self, handle):
+        self.fetches += 1
+        return _FakeResult(handle, self.num_vertices)
+
+
+def _svc_with_engines(graph, monkeypatch, engines: dict, **kw):
+    reg = EngineRegistry(capacity=4, warm=False)
+    reg.add_graph("fake", graph)
+    monkeypatch.setattr(reg, "get", lambda spec: engines[spec.lanes])
+    kw.setdefault("linger_ms", 0.0)
+    return BfsService("fake", registry=reg, autostart=False, **kw)
+
+
+@pytest.fixture
+def fake_graph():
+    return random_graph(64, 300, seed=5)
+
+
+def test_watchdog_classifies_hung_fetch_as_transient(fake_graph,
+                                                     monkeypatch):
+    """A fetch that exceeds the watchdog deadline is classified transient
+    and re-dispatched — the executor never hangs on a wedged device."""
+
+    class HangsOnce(_FakeEngine):
+        def fetch(self, handle):
+            self.fetches += 1
+            if self.fetches == 1:
+                time.sleep(5.0)  # far past the watchdog deadline
+            return _FakeResult(handle, self.num_vertices)
+
+    COUNTERS.reset()
+    eng = HangsOnce(32, fake_graph.num_vertices)
+    svc = _svc_with_engines(fake_graph, monkeypatch, {32: eng}, lanes=32,
+                            width_ladder="off", watchdog_ms=200.0)
+    svc.start()
+    r = svc.query(3, timeout=60)
+    assert r.ok, (r.status, r.error)
+    assert eng.dispatches == 2  # the hung attempt was abandoned + retried
+    snap = svc.statsz()
+    assert snap["watchdog_trips"] == 1 and snap["retries"] == 1
+    assert COUNTERS.as_dict()["watchdog_trips"] == 1
+    svc.close()
+
+
+# --- circuit breaker -------------------------------------------------------
+
+
+def test_breaker_state_machine():
+    t = [0.0]
+    br = CircuitBreaker(threshold=2, cooldown_s=10.0, now=lambda: t[0])
+    assert br.allow(32)
+    assert not br.record_failure(32)  # 1 of 2
+    assert br.allow(32)
+    assert br.record_failure(32)  # opens
+    assert br.opens == 1 and br.open_keys() == [32]
+    assert not br.allow(32)  # open, cooldown running
+    t[0] = 11.0
+    assert br.allow(32)  # half-open: one probe
+    assert not br.allow(32)  # probe outstanding
+    assert br.record_failure(32)  # failed probe re-opens
+    t[0] = 22.0
+    assert br.allow(32)
+    br.record_success(32)  # probe succeeded: closed
+    assert br.allow(32) and br.open_keys() == []
+    # Success resets the consecutive count.
+    br.record_failure(32)
+    br.record_success(32)
+    assert not br.record_failure(32)
+
+
+def test_breaker_opens_and_routing_goes_around(fake_graph, monkeypatch):
+    """Deterministic failures at the 32 rung open its breaker; later
+    batches route to the 64 rung and succeed (visible in statsz)."""
+
+    class Broken32(_FakeEngine):
+        def dispatch(self, padded):
+            self.dispatches += 1
+            raise RuntimeError("deterministic lowering bug: boom")
+
+    COUNTERS.reset()
+    broken = Broken32(32, fake_graph.num_vertices)
+    healthy = _FakeEngine(64, fake_graph.num_vertices)
+    svc = _svc_with_engines(
+        fake_graph, monkeypatch, {32: broken, 64: healthy},
+        lanes=64, width_ladder="32,64",
+        breaker_threshold=2, breaker_cooldown_ms=3600_000.0,
+    )
+    svc.start()
+    # Two singleton queries route narrow, fail deterministically, and
+    # open the 32-lane breaker.
+    for _ in range(2):
+        r = svc.query(1, timeout=60)
+        assert r.status == "error" and "boom" in r.error
+    snap = svc.statsz()
+    assert snap["breaker_open"] == [32] and snap["breaker_opens"] == 1
+    assert COUNTERS.as_dict()["breaker_opens"] == 1
+    # The next singleton routes AROUND the open rung and succeeds.
+    r = svc.query(2, timeout=60)
+    assert r.ok, (r.status, r.error)
+    assert r.dispatched_lanes == 64
+    assert healthy.dispatches == 1
+    svc.close()
+
+
+def test_breaker_half_open_probe_recovers(fake_graph, monkeypatch):
+    """After the cooldown the breaker admits one probe; a success closes
+    it and routing returns to the narrow rung."""
+
+    class FlakyThenFine(_FakeEngine):
+        def __init__(self, *a):
+            super().__init__(*a)
+            self.fail = True
+
+        def dispatch(self, padded):
+            self.dispatches += 1
+            if self.fail:
+                raise RuntimeError("deterministic: boom")
+            return super().dispatch(padded)
+
+    eng32 = FlakyThenFine(32, fake_graph.num_vertices)
+    eng64 = _FakeEngine(64, fake_graph.num_vertices)
+    svc = _svc_with_engines(
+        fake_graph, monkeypatch, {32: eng32, 64: eng64},
+        lanes=64, width_ladder="32,64",
+        breaker_threshold=1, breaker_cooldown_ms=50.0,
+    )
+    svc.start()
+    assert svc.query(1, timeout=60).status == "error"  # opens at 32
+    assert svc.statsz()["breaker_open"] == [32]
+    eng32.fail = False  # the rung heals during the cooldown
+    time.sleep(0.08)
+    r = svc.query(2, timeout=60)  # the half-open probe
+    assert r.ok and r.dispatched_lanes == 32
+    assert svc.statsz()["breaker_open"] == []
+    svc.close()
+
+
+# --- requeue budget --------------------------------------------------------
+
+
+def test_requeue_budget_sheds_with_attempt_history(fake_graph, monkeypatch):
+    """When every rung keeps OOMing, a query's re-admissions are bounded:
+    past the budget it resolves with an explicit error naming the widths
+    it attempted — never an infinite degrade/requeue loop."""
+
+    class AlwaysOom(_FakeEngine):
+        def dispatch(self, padded):
+            self.dispatches += 1
+            raise RuntimeError("RESOURCE_EXHAUSTED: injected table alloc")
+
+    COUNTERS.reset()
+    engines = {w: AlwaysOom(w, fake_graph.num_vertices)
+               for w in (32, 64, 128)}
+    svc = _svc_with_engines(
+        fake_graph, monkeypatch, engines, lanes=128,
+        width_ladder="32,64,128", linger_ms=20.0, max_requeues=1,
+    )
+    staged = [svc.submit(i % 8) for i in range(100)]  # fills the 128 rung
+    svc.start()
+    shed_errors = 0
+    for q in staged:
+        r = q.result(timeout=60)
+        assert r.status == "error", (r.status, r.error)
+        if "requeue budget exhausted" in r.error:
+            shed_errors += 1
+            assert "128" in r.error  # the history names the first width
+    assert shed_errors > 0
+    snap = svc.statsz()
+    assert snap["requeue_shed"] == shed_errors
+    assert COUNTERS.as_dict()["requeue_sheds"] == shed_errors
+    svc.close()
+
+
+# --- drain / SIGTERM -------------------------------------------------------
+
+
+def test_drain_stops_admission_resolves_existing(chaos_registry,
+                                                 chaos_golden):
+    svc = BfsService("chaos-graph", registry=chaos_registry, lanes=32,
+                     autostart=False)
+    staged = [svc.submit(s) for s in list(chaos_golden)[:3]]
+    svc.drain()
+    late = svc.submit(next(iter(chaos_golden)))
+    assert late.done()
+    r = late.result(1)
+    assert r.status == "rejected" and "draining" in r.error
+    assert svc.statsz()["draining"] is True
+    svc.start()  # queued work still runs to resolution
+    for q in staged:
+        got = q.result(timeout=60)
+        assert got.ok
+        np.testing.assert_array_equal(
+            got.distances, chaos_golden[got.source]
+        )
+    svc.close()
+
+
+class _BlockingStdin:
+    """Yields the given lines, then blocks — a live client pipe with no
+    EOF, the exact shape a SIGTERM drain must handle."""
+
+    def __init__(self, lines):
+        self._lines = list(lines)
+        self._gate = threading.Event()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._lines:
+            return self._lines.pop(0)
+        self._gate.wait()  # forever (daemon reader dies with the process)
+        raise StopIteration
+
+
+def test_sigterm_drains_cleanly_with_final_statsz(chaos_registry,
+                                                  chaos_golden):
+    """The lifecycle acceptance bar, in-process: SIGTERM while the stdin
+    pipe is still open resolves every submitted query, emits every
+    response line, prints the final statsz, and returns 0 — no hang."""
+    from tpu_bfs.serve.frontend import build_arg_parser, run_server
+
+    sources = list(chaos_golden)[:3]
+    lines = [json.dumps({"id": i, "source": s}) + "\n"
+             for i, s in enumerate(sources)]
+    args = build_arg_parser().parse_args(
+        ["chaos-graph", "--lanes", "32", "--linger-ms", "1",
+         "--statsz-every", "0"]
+    )
+    out, err = io.StringIO(), io.StringIO()
+
+    def fire_when_served():
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if out.getvalue().count('"status"') >= len(sources):
+                break
+            time.sleep(0.01)
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    killer = threading.Thread(target=fire_when_served, daemon=True)
+    killer.start()
+    t0 = time.monotonic()
+    rc = run_server(args, stdin=_BlockingStdin(lines), stdout=out,
+                    stderr=err, registry=chaos_registry)
+    killer.join(timeout=60)
+    assert rc == 0
+    assert time.monotonic() - t0 < 60  # drained, never hung
+    resp = [json.loads(l) for l in out.getvalue().splitlines() if l.strip()]
+    assert len(resp) == len(sources)  # every submitted query resolved
+    assert all(r["status"] == "ok" for r in resp)
+    assert "SIGTERM received: draining" in err.getvalue()
+    assert "statsz {" in err.getvalue()  # the final statsz line landed
+    # The handler was restored: a later SIGTERM must not re-enter ours.
+    assert signal.getsignal(signal.SIGTERM) is signal.SIG_DFL
+
+
+def test_watchdog_abandoned_fetch_cap_bounds_wedged_rung(fake_graph,
+                                                         monkeypatch):
+    """A permanently wedged device must not accumulate one abandoned
+    fetch thread per watchdog trip forever: past the cap the executor
+    refuses to watch another fetch — a deterministic error that feeds
+    the breaker — instead of pinning more device state."""
+    gate = threading.Event()
+
+    class Wedged(_FakeEngine):
+        def fetch(self, handle):
+            self.fetches += 1
+            gate.wait(30)  # "hung" until the test releases it
+            return _FakeResult(handle, self.num_vertices)
+
+    eng = Wedged(32, fake_graph.num_vertices)
+    svc = _svc_with_engines(fake_graph, monkeypatch, {32: eng}, lanes=32,
+                            width_ladder="off", watchdog_ms=100.0,
+                            max_retries=0)
+    svc._executor.max_abandoned = 2
+    svc.start()
+    try:
+        rs = [svc.query(i, timeout=60) for i in range(3)]
+        assert all(r.status == "error" for r in rs)
+        assert "watchdog" in rs[0].error
+        assert "abandoned fetches" in rs[2].error  # refused at the cap
+        assert eng.fetches == 2  # the third fetch was never started
+        assert svc.statsz()["watchdog_trips"] == 2
+    finally:
+        gate.set()  # release the "hung" threads
+        svc.close()
+    deadline = time.monotonic() + 5
+    while svc._executor._abandoned and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert svc._executor._abandoned == 0  # abandoned count paid back

@@ -418,3 +418,173 @@ class TestDistPacked:
         np.testing.assert_array_equal(
             res.distances_int32(1), single.finish(st).distances_int32(1)
         )
+
+
+class TestIntegrity:
+    """Checkpoint integrity (robustness issue): a CRC32 of the payload is
+    recorded on save and verified on load; a bit-flipped file is
+    QUARANTINED (renamed ``.corrupt``) with an error naming the file, and
+    a sharded load falls back to the newest intact generation instead of
+    resuming from poisoned state."""
+
+    @staticmethod
+    def _flip_byte(path, offset=None):
+        # Target a byte INSIDE a zip member's compressed data (an
+        # arbitrary offset can land in zip dead space and leave the file
+        # semantically intact — a vacuous corruption drill).
+        from tpu_bfs.faults import corruption_offset
+
+        off = corruption_offset(path) if offset is None else offset
+        with open(path, "r+b") as f:
+            f.seek(off)
+            b = f.read(1)
+            f.seek(off)
+            f.write(bytes([b[0] ^ 0xFF]))
+
+    def test_crc_recorded_and_roundtrip_clean(self, tmp_path, random_small):
+        eng = BfsEngine(random_small)
+        st = eng.advance(eng.start(3), levels=2)
+        path = str(tmp_path / "ck.npz")
+        ckpt_mod.save_checkpoint(path, st)
+        z = np.load(path)
+        assert "payload_crc32" in z.files  # the integrity record rides along
+        st2 = ckpt_mod.load_checkpoint(path)
+        np.testing.assert_array_equal(st2.distance, st.distance)
+
+    def test_corrupt_single_file_is_quarantined(self, tmp_path, random_small):
+        eng = BfsEngine(random_small)
+        st = eng.advance(eng.start(3), levels=2)
+        path = str(tmp_path / "ck.npz")
+        ckpt_mod.save_checkpoint(path, st)
+        self._flip_byte(path)
+        with pytest.raises(ckpt_mod.CorruptCheckpointError, match="ck.npz"):
+            ckpt_mod.load_checkpoint(path)
+        assert not os.path.exists(path)  # quarantined, never re-loadable
+        assert os.path.exists(path + ".corrupt")
+
+    def test_corrupt_packed_checkpoint_is_quarantined(self, tmp_path,
+                                                      random_small):
+        from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
+
+        eng = WidePackedMsBfsEngine(random_small, lanes=32)
+        st = eng.advance(eng.start(np.array([0, 1])), levels=1)
+        path = str(tmp_path / "packed.npz")
+        ckpt_mod.save_packed_checkpoint(path, st)
+        self._flip_byte(path)
+        with pytest.raises(ckpt_mod.CorruptCheckpointError):
+            ckpt_mod.load_packed_checkpoint(path)
+        assert os.path.exists(path + ".corrupt")
+
+    def test_sharded_corruption_falls_back_to_previous_generation(
+        self, tmp_path, random_small
+    ):
+        eng = BfsEngine(random_small)
+        st1 = eng.advance(eng.start(1), levels=1)
+        st2 = eng.advance(st1, levels=1)
+        d = str(tmp_path / "gens")
+        ckpt_mod.save_checkpoint_sharded(d, st1, num_shards=2)  # gen_a
+        ckpt_mod.save_checkpoint_sharded(d, st2, num_shards=2)  # gen_b
+        # Corrupt one ACTIVE-generation shard: the load must quarantine it
+        # and fall back to the newest intact checkpoint (gen_a / level 1).
+        self._flip_byte(os.path.join(d, "gen_b", "shard_00001.npz"))
+        msgs = []
+        back = ckpt_mod.load_checkpoint_sharded(d, log=msgs.append)
+        assert back.level == st1.level
+        np.testing.assert_array_equal(back.distance, st1.distance)
+        assert msgs and "falling back" in msgs[0]
+        assert os.path.exists(
+            os.path.join(d, "gen_b", "shard_00001.npz.corrupt")
+        )
+        # Resume from the fallback completes correctly.
+        while not back.done:
+            back = eng.advance(back, levels=1)
+        golden, _ = bfs_python(random_small, 1)
+        validate.check_distances(
+            eng.finish(back, with_parents=False).distance, golden
+        )
+
+    def test_both_generations_corrupt_raises(self, tmp_path, random_small):
+        eng = BfsEngine(random_small)
+        st1 = eng.advance(eng.start(1), levels=1)
+        st2 = eng.advance(st1, levels=1)
+        d = str(tmp_path / "dead")
+        ckpt_mod.save_checkpoint_sharded(d, st1, num_shards=2)
+        ckpt_mod.save_checkpoint_sharded(d, st2, num_shards=2)
+        self._flip_byte(os.path.join(d, "gen_a", "shard_00000.npz"))
+        self._flip_byte(os.path.join(d, "gen_b", "shard_00000.npz"))
+        with pytest.raises(ckpt_mod.CorruptCheckpointError,
+                           match="no intact checkpoint generation"):
+            ckpt_mod.load_checkpoint_sharded(d)
+
+    def test_corrupt_ckpt_fault_is_caught_by_the_crc(self, tmp_path,
+                                                     random_small):
+        """Chaos wiring end to end: a corrupt_ckpt rule flips a byte after
+        the atomic save; the very next load detects it, quarantines, and
+        names the file — a bit-flipped checkpoint can never load
+        silently."""
+        from tpu_bfs import faults
+
+        eng = BfsEngine(random_small)
+        st = eng.advance(eng.start(2), levels=2)
+        path = str(tmp_path / "chaos.npz")
+        faults.arm_from_spec("seed=1:corrupt_ckpt:n=1")
+        try:
+            ckpt_mod.save_checkpoint(path, st)
+        finally:
+            faults.disarm()
+        with pytest.raises(ckpt_mod.CorruptCheckpointError):
+            ckpt_mod.load_checkpoint(path)
+        assert os.path.exists(path + ".corrupt")
+
+    def test_fallback_generation_with_different_shard_count(
+        self, tmp_path, random_small
+    ):
+        # Re-sharding across saves is a documented use (elastic restart):
+        # the fallback must derive the PREVIOUS generation's shard count
+        # from its own files, not the newer meta's.
+        eng = BfsEngine(random_small)
+        st1 = eng.advance(eng.start(1), levels=1)
+        st2 = eng.advance(st1, levels=1)
+        d = str(tmp_path / "resharded")
+        ckpt_mod.save_checkpoint_sharded(d, st1, num_shards=4)  # gen_a
+        ckpt_mod.save_checkpoint_sharded(d, st2, num_shards=2)  # gen_b
+        self._flip_byte(os.path.join(d, "gen_b", "shard_00000.npz"))
+        back = ckpt_mod.load_checkpoint_sharded(d)
+        assert back.level == st1.level
+        np.testing.assert_array_equal(back.distance, st1.distance)
+
+    def test_fallback_survives_reload_after_quarantine(self, tmp_path,
+                                                       random_small):
+        # Crash/retry safety: once a corrupt active-generation shard has
+        # been quarantined (renamed .corrupt), a SECOND load — a restart
+        # after a crash, or a retry loop — must still fall back to the
+        # intact generation, not die on the now-missing file.
+        eng = BfsEngine(random_small)
+        st1 = eng.advance(eng.start(1), levels=1)
+        st2 = eng.advance(st1, levels=1)
+        d = str(tmp_path / "retry")
+        ckpt_mod.save_checkpoint_sharded(d, st1, num_shards=2)
+        ckpt_mod.save_checkpoint_sharded(d, st2, num_shards=2)
+        self._flip_byte(os.path.join(d, "gen_b", "shard_00000.npz"))
+        for _ in range(2):  # second iteration hits the quarantined gap
+            back = ckpt_mod.load_checkpoint_sharded(d)
+            assert back.level == st1.level
+            np.testing.assert_array_equal(back.distance, st1.distance)
+
+    def test_fallback_refuses_another_traversals_generation(
+        self, tmp_path, random_small
+    ):
+        # A reused checkpoint dir: run 1 (source 5) left gen_a; run 2
+        # (source 9) wrote gen_b, which then corrupted. The fallback must
+        # REFUSE run 1's generation — resuming another traversal's arrays
+        # under this run's source would be silently wrong results.
+        eng = BfsEngine(random_small)
+        d = str(tmp_path / "reused")
+        st_a = eng.advance(eng.start(5), levels=2)
+        ckpt_mod.save_checkpoint_sharded(d, st_a, num_shards=2)  # gen_a
+        st_b = eng.advance(eng.start(9), levels=2)
+        ckpt_mod.save_checkpoint_sharded(d, st_b, num_shards=2)  # gen_b
+        self._flip_byte(os.path.join(d, "gen_b", "shard_00000.npz"))
+        with pytest.raises(ckpt_mod.CorruptCheckpointError,
+                           match="no intact checkpoint generation"):
+            ckpt_mod.load_checkpoint_sharded(d)

@@ -1,0 +1,200 @@
+"""The deterministic fault-injection subsystem (tpu_bfs/faults.py).
+
+- spec-string grammar: parse, validation errors, canonical round-trip;
+- schedule determinism: same seed => same injection sequence over the
+  same site visits (the property the chaos soak's bit-identical
+  acceptance bar rests on);
+- site arming/disarming: rules fire only at their site, only within
+  budget, and the module-global guard is None unless explicitly armed;
+- the injected errors classify exactly like the real thing through the
+  ONE shared classifier (utils/recovery.py).
+"""
+
+import time
+
+import pytest
+
+from tpu_bfs import faults
+from tpu_bfs.utils.recovery import (
+    COUNTERS,
+    is_oom_failure,
+    is_transient_failure,
+)
+
+SOAK_SPEC = ("seed=7:transient@dispatch:p=0.05,oom@rung=512:n=2,"
+             "slow_extract:ms=200,corrupt_ckpt:n=1")
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    """Every test starts and ends with no schedule armed — the module
+    global is process-wide state."""
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+def test_spec_parses_the_issue_example():
+    s = faults.FaultSchedule.from_spec(SOAK_SPEC)
+    assert s.seed == 7
+    kinds = [r.kind for r in s.rules]
+    assert kinds == ["transient", "oom", "slow_extract", "corrupt_ckpt"]
+    t, o, sl, c = s.rules
+    assert t.site == "dispatch" and t.p == 0.05 and t.n is None
+    assert o.site == "dispatch" and o.qual == (("rung", 512),) and o.n == 2
+    assert sl.site == "fetch" and sl.ms == 200 and sl.n == 1  # default n=1
+    assert c.site == "ckpt_save" and c.n == 1
+
+
+def test_spec_round_trip_is_canonical():
+    s = faults.FaultSchedule.from_spec(SOAK_SPEC)
+    canon = s.to_spec()
+    s2 = faults.FaultSchedule.from_spec(canon)
+    assert s2.to_spec() == canon
+    assert s2.rules == s.rules and s2.seed == s.seed
+
+
+@pytest.mark.parametrize("bad", [
+    "",
+    "mystery@dispatch",  # unknown kind
+    "transient@nowhere",  # unknown site
+    "transient:q=3",  # unknown parameter
+    "transient:p=2.0",  # probability out of range
+    "slow",  # slow needs ms=
+    "oom@rung=wat",  # non-int qualifier
+    "seed=x:transient",  # bad seed
+])
+def test_bad_specs_raise(bad):
+    with pytest.raises(ValueError):
+        faults.FaultSchedule.from_spec(bad)
+
+
+def test_same_seed_same_injection_sequence():
+    def run(seed):
+        s = faults.FaultSchedule.from_spec(f"seed={seed}:transient:p=0.3")
+        fired = []
+        for i in range(200):
+            try:
+                s.hit("dispatch", lanes=64)
+                fired.append(0)
+            except RuntimeError:
+                fired.append(1)
+        return fired
+
+    a, b = run(11), run(11)
+    assert a == b and sum(a) > 0  # deterministic, and it does inject
+    assert run(12) != a  # a different seed is a different schedule
+
+
+def test_rules_fire_only_at_their_site_and_within_budget():
+    s = faults.FaultSchedule.from_spec("transient@fetch:n=2")
+    s.hit("dispatch", lanes=32)  # wrong site: no-op
+    with pytest.raises(RuntimeError):
+        s.hit("fetch", lanes=32)
+    with pytest.raises(RuntimeError):
+        s.hit("fetch", lanes=32)
+    s.hit("fetch", lanes=32)  # budget spent: no-op
+    assert s.exhausted()
+    assert [e["site"] for e in s.events] == ["fetch", "fetch"]
+
+
+def test_rung_qualifier_matches_dispatch_width():
+    s = faults.FaultSchedule.from_spec("oom@rung=64:n=1")
+    s.hit("dispatch", lanes=32)  # width mismatch: no-op
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        s.hit("dispatch", lanes=64)
+
+
+def test_injected_errors_classify_like_the_real_thing():
+    s = faults.FaultSchedule.from_spec("transient:n=1,oom:n=1")
+    with pytest.raises(RuntimeError) as t:
+        s.hit("dispatch", lanes=32)
+    assert is_transient_failure(t.value) and not is_oom_failure(t.value)
+    with pytest.raises(RuntimeError) as o:
+        s.hit("dispatch", lanes=32)
+    assert is_oom_failure(o.value) and not is_transient_failure(o.value)
+
+
+def test_slow_rule_sleeps_without_raising():
+    s = faults.FaultSchedule.from_spec("slow_extract:ms=40:n=1")
+    t0 = time.monotonic()
+    s.hit("fetch", lanes=32)  # sleeps ~40ms
+    assert time.monotonic() - t0 >= 0.03
+    t0 = time.monotonic()
+    s.hit("fetch", lanes=32)  # budget spent
+    assert time.monotonic() - t0 < 0.02
+
+
+def test_take_consumes_corrupt_budget_once():
+    s = faults.FaultSchedule.from_spec("corrupt_ckpt:n=1")
+    assert s.take("ckpt_save", "corrupt_ckpt", path="x")
+    assert not s.take("ckpt_save", "corrupt_ckpt", path="x")
+    assert s.counts() == {"corrupt_ckpt": 1}
+
+
+def test_arming_is_explicit_and_counted():
+    assert faults.ACTIVE is None  # the production no-op state
+    COUNTERS.reset()
+    sched = faults.arm_from_spec("transient@advance:n=1")
+    assert faults.ACTIVE is sched
+    with pytest.raises(RuntimeError):
+        sched.hit("advance", level=3)
+    assert COUNTERS.as_dict()["faults_injected"] == 1
+    faults.disarm()
+    assert faults.ACTIVE is None
+
+
+def test_arm_from_env(monkeypatch):
+    monkeypatch.setenv(faults.ENV_VAR, "  ")
+    assert faults.arm_from_env() is None and faults.ACTIVE is None
+    monkeypatch.setenv(faults.ENV_VAR, "seed=3:transient:n=1")
+    sched = faults.arm_from_env()
+    assert sched is faults.ACTIVE and sched.seed == 3
+
+
+def test_advance_with_recovery_handles_injected_transient(line_graph):
+    """The tentpole wiring: a transient injected at the `advance` site
+    runs the REAL rebuild-and-resume path (no monkeypatching anywhere)
+    and the traversal completes bit-identically to a fault-free run."""
+    import numpy as np
+
+    from tpu_bfs.algorithms.bfs import BfsEngine
+    from tpu_bfs.utils.recovery import advance_with_recovery
+
+    COUNTERS.reset()
+    clean_engine = BfsEngine(line_graph)
+    _, clean, _ = advance_with_recovery(
+        lambda: BfsEngine(line_graph), clean_engine.start(0),
+        engine=clean_engine, levels_per_chunk=16,
+    )
+    faults.arm_from_spec("seed=5:transient@advance:n=2")
+    builds = []
+    try:
+        def make():
+            builds.append(1)
+            return BfsEngine(line_graph)
+
+        engine, st, restarts = advance_with_recovery(
+            make, BfsEngine(line_graph).start(0), levels_per_chunk=16,
+        )
+    finally:
+        faults.disarm()
+    assert restarts == 2
+    assert len(builds) >= 2  # the engine really was rebuilt
+    np.testing.assert_array_equal(st.distance, clean.distance)
+    snap = COUNTERS.as_dict()
+    assert snap["faults_injected"] == 2
+    assert snap["transient_retries"] == 2 and snap["engine_rebuilds"] == 2
+
+
+def test_site_and_qualifier_targets_compose_and_round_trip():
+    s = faults.FaultSchedule.from_spec("seed=2:oom@fetch@rung=64:n=1")
+    (r,) = s.rules
+    assert r.site == "fetch" and r.qual == (("rung", 64),)
+    assert faults.FaultSchedule.from_spec(s.to_spec()).rules == s.rules
+    s.hit("fetch", lanes=32)  # qualifier mismatch: no-op
+    s.hit("dispatch", lanes=64)  # site mismatch: no-op
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        s.hit("fetch", lanes=64)
+    with pytest.raises(ValueError, match="two sites"):
+        faults.FaultSchedule.from_spec("oom@fetch@dispatch")

@@ -267,3 +267,52 @@ def test_widths_agree(name, make):
         np.testing.assert_array_equal(
             narrow.distances_int32(i), wide.distances_int32(i)
         )
+
+
+@pytest.mark.serve
+@pytest.mark.chaos
+@pytest.mark.parametrize("name,make", [CASES[0]], ids=[CASES[0][0]])
+def test_serve_chaos_matches_oracle(name, make):
+    """Chaos fuzz arm (robustness issue): a RANDOMIZED seeded fault
+    schedule — transients, slow extraction, and (sometimes) an OOM —
+    injected into the serving hot path must never change an answer:
+    every response still matches the one-shot oracle bit for bit. The
+    schedule is derived from the sweep's own rng, so a failure replays
+    from the printed spec alone."""
+    from tpu_bfs import faults
+    from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
+    from tpu_bfs.serve import BfsService, EngineRegistry
+
+    g = make()
+    rng = np.random.default_rng(37)
+    sources = _sources(g, rng, n=6)
+    eng = WidePackedMsBfsEngine(g, lanes=32, num_planes=8)
+    one_shot = {}
+    for s in sources:
+        one_shot[s] = eng.run(np.asarray([s])).distances_int32(0)
+        validate.check_distances(one_shot[s], bfs_scipy(g, s))
+
+    reg = EngineRegistry(capacity=3)
+    reg.add_graph("chaos-fuzz", g)
+    for round_i in range(3):
+        clauses = ["transient@serve_batch:p=0.4:n=2",
+                   f"slow_extract:ms={int(rng.integers(5, 30))}:n=2"]
+        if rng.integers(2):
+            clauses.append("oom@rung=64:n=1")
+        spec = f"seed={int(rng.integers(1 << 16))}:" + ",".join(clauses)
+        svc = BfsService("chaos-fuzz", registry=reg, lanes=64,
+                         width_ladder="32,64", linger_ms=5.0,
+                         autostart=False)
+        svc.start()  # warm first: the schedule targets serving dispatches
+        faults.arm_from_spec(spec)
+        try:
+            staged = [svc.submit(s) for s in sources * 2]
+            for q in staged:
+                r = q.result(timeout=120)
+                assert r.ok, (spec, r.status, r.error)
+                np.testing.assert_array_equal(
+                    r.distances, one_shot[r.source], err_msg=spec
+                )
+        finally:
+            svc.close()
+            faults.disarm()

@@ -117,3 +117,61 @@ def test_auto_ids_when_absent(_serve):
     assert len(lines) == 2
     assert all(r["status"] == "ok" and r["id"] is not None for r in lines)
     assert lines[0]["id"] != lines[1]["id"]
+
+
+def test_strict_source_typing(_serve):
+    # Hardening: bool/fractional sources are structured errors, never a
+    # silent int() coercion (true -> vertex 1, 7.9 -> vertex 7).
+    reqs = (
+        '{"id": 1, "source": true}\n'
+        '{"id": 2, "source": 7.9}\n'
+        '{"id": 3, "source": 7.0}\n'
+        '{"id": 4, "source": "5"}\n'
+    )
+    lines, _ = _serve(reqs)
+    by_id = {r["id"]: r for r in lines}
+    assert by_id[1]["status"] == "error" and "integer" in by_id[1]["error"]
+    assert by_id[2]["status"] == "error"
+    assert by_id[3]["status"] == "ok"  # integral float: accepted
+    assert by_id[4]["status"] == "error"  # strings are not vertex ids
+
+
+def test_fuzz_line_stream_survives(_serve):
+    """Chaos satellite: a hostile request stream — binary garbage, hugely
+    nested JSON (RecursionError territory), wrong shapes, bad field types
+    — interleaved with valid requests. EVERY line gets exactly one
+    response, the valid ones all serve correctly, and the reader loop
+    survives to EOF."""
+    rng = __import__("numpy").random.default_rng(41)
+    garbage = [
+        "\x00\x01\x02 not json at all",
+        "[" * 4000,  # deep-nesting parser bomb
+        '{"source": {"nested": 1}}',
+        '{"source": null}',
+        '{"id": [1,2], "source": 1e99}',
+        '{"source": -9999999999999999999999}',
+        '"just a string"',
+        "9" * 5000,
+        '{"id": 1, "source": 2, "deadline_ms": [1]}',
+        '{"id": 2, "source": 2, "want_distances": "yes"}',
+    ]
+    valid_sources = [0, 1, 2, 3, 5]
+    lines_in = []
+    valid = 0
+    for i in range(60):
+        if rng.integers(2):
+            lines_in.append(json.dumps(
+                {"id": f"ok-{valid}",
+                 "source": valid_sources[valid % len(valid_sources)]}
+            ))
+            valid += 1
+        else:
+            lines_in.append(garbage[int(rng.integers(len(garbage)))])
+    lines, err = _serve("\n".join(lines_in) + "\n")
+    assert len(lines) == 60  # one response per line, none dropped
+    ok = [r for r in lines if r["status"] == "ok"]
+    bad = [r for r in lines if r["status"] == "error"]
+    assert len(ok) == valid and len(bad) == 60 - valid
+    assert all(str(r["id"]).startswith("ok-") for r in ok)
+    assert all("bad request" in r["error"] or "out of range" in r["error"]
+               for r in bad)

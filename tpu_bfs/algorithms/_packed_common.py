@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tpu_bfs import faults as _faults
 from tpu_bfs.graph.csr import INF_DIST
 from tpu_bfs.algorithms.msbfs_packed import UNREACHED, ripple_increment
 
@@ -1629,6 +1630,10 @@ def dispatch_packed_batch(
     engine, sources, *, max_levels: int | None = None
 ) -> PackedDispatch:
     """Launch one packed batch without blocking on its result."""
+    if _faults.ACTIVE is not None:
+        # Chaos-harness injection site (tpu_bfs/faults.py): the guard is
+        # one attribute check, so the un-armed hot path pays nothing.
+        _faults.ACTIVE.hit("dispatch", lanes=engine.lanes)
     sources = _check_batch_sources(engine, sources)
     cap = engine.max_levels_cap
     max_levels = cap if max_levels is None else min(max_levels, cap)
@@ -1657,6 +1662,11 @@ def fetch_packed_batch(
     time_it: bool = False,
 ) -> PackedBatchResult:
     """Block on a dispatched batch and assemble its result."""
+    if _faults.ACTIVE is not None:
+        # Chaos-harness injection site: slow_extract sleeps here; a
+        # transient/oom raised here surfaces on the blocking half exactly
+        # like a real async-dispatch failure (tpu_bfs/faults.py).
+        _faults.ACTIVE.hit("fetch", lanes=engine.lanes)
     levels = int(pend.levels)  # blocks until the loop finishes
     elapsed = (time.perf_counter() - pend.t0) if time_it else None
     engine._warmed = True

@@ -436,7 +436,19 @@ def main(argv=None) -> int:
     ap.add_argument("--resume", default=None, metavar="PATH",
                     help="resume a traversal from a checkpoint written by "
                     "--ckpt (overrides <source> with the saved one)")
+    ap.add_argument("--faults", default=None, metavar="SPEC",
+                    help="arm a deterministic fault-injection schedule "
+                    "(chaos testing, tpu_bfs/faults.py), e.g. "
+                    "'seed=7:transient@advance:n=1,corrupt_ckpt:n=1'; "
+                    "default: the TPU_BFS_FAULTS env var, else disabled. "
+                    "Injected faults exercise the real recovery paths; "
+                    "--stats surfaces the counters")
     args = ap.parse_args(argv)
+    from tpu_bfs import faults as faults_mod
+
+    sched = faults_mod.arm_from_spec_or_env(args.faults)
+    if sched is not None:
+        print(f"[faults] schedule armed: {sched.to_spec()}", file=sys.stderr)
     if args.adaptive_push is not None:
         if (
             args.engine not in ("wide", "hybrid")

@@ -1,0 +1,430 @@
+"""Deterministic fault injection: one auditable mechanism for every
+recovery path in the stack.
+
+The reference has no failure story at all — a failed rank hangs the
+MPI_Allreduce (bfs_mpi.cu:621) and the traversal is lost. This repo's
+recovery machinery (transient classifier in utils/recovery.py, the serve
+OOM width ladder, checkpoint/resume) used to be exercised only by ad-hoc
+monkeypatch spies scattered across tests. This module replaces those
+with a seeded, replayable :class:`FaultSchedule` armed process-wide and
+consulted at NAMED INJECTION SITES inside the production code itself:
+
+========== =======================================================
+site        where it lives
+========== =======================================================
+dispatch    _packed_common.dispatch_packed_batch (engine level loop)
+fetch       _packed_common.fetch_packed_batch (blocking result half)
+serve_batch serve/executor.BatchExecutor.dispatch_batch (any engine)
+engine_build serve/registry.EngineRegistry._build
+ckpt_save   utils/checkpoint._atomic_savez (corruption happens here)
+ckpt_load   utils/checkpoint load paths
+advance     utils/recovery.advance_with_recovery (chunk step)
+========== =======================================================
+
+Production code never pays for this when disabled: every site guard is
+one module-attribute check (``if faults.ACTIVE is not None``) against a
+global that is ``None`` unless a schedule was explicitly armed via
+``--faults`` (CLI and serve), the ``TPU_BFS_FAULTS`` env var, or
+:func:`arm` in tests.
+
+Spec grammar (``--faults`` / ``TPU_BFS_FAULTS``)::
+
+    spec    := [ "seed=" INT ":" ] clause ("," clause)*
+    clause  := kind ( "@" target )* ( ":" param )*
+    target  := SITE                 (e.g. "@fetch")
+             | QUAL "=" INT         (e.g. "@rung=512" — context match)
+               (targets compose: at most one site + any qualifiers,
+                e.g. "oom@fetch@rung=64")
+    param   := "p=" FLOAT | "n=" INT | "ms=" FLOAT | "skip=" INT
+    kind    := "transient" | "oom" | "slow" | "slow_extract"
+             | "corrupt_ckpt"
+
+Examples::
+
+    seed=7:transient@dispatch:p=0.05,oom@rung=512:n=2,slow_extract:ms=200,corrupt_ckpt:n=1
+
+``n`` bounds how many times a clause fires (default 1 when no ``p``
+given); ``p`` is a per-visit probability drawn from the schedule's own
+seeded RNG, so the same seed over the same visit sequence injects the
+same faults — the determinism the chaos soak's bit-identical acceptance
+bar rests on. ``rung`` matches the dispatch width (``lanes`` in site
+context); ``ms`` is the sleep for the slow kinds; ``skip=K`` passes over
+the first K matching site visits — deterministic targeting of "the
+(K+1)-th event" (e.g. the final checkpoint save of a run). Injected transients
+carry an ``INTERNAL:`` message and injected OOMs a ``RESOURCE_EXHAUSTED``
+one, so the ONE classifier the whole repo shares (utils/recovery.py)
+routes them exactly like the real thing. Every firing is recorded in
+``schedule.events`` and bumps ``RecoveryCounters.faults_injected``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import threading
+import time
+
+SITES = (
+    "dispatch",
+    "fetch",
+    "serve_batch",
+    "engine_build",
+    "ckpt_save",
+    "ckpt_load",
+    "advance",
+)
+
+# Where a clause lands when it names no "@site". slow_extract is the
+# spec-friendly alias for slowing the blocking result half.
+DEFAULT_SITE = {
+    "transient": "dispatch",
+    "oom": "dispatch",
+    "slow": "fetch",
+    "slow_extract": "fetch",
+    "corrupt_ckpt": "ckpt_save",
+}
+KINDS = tuple(DEFAULT_SITE)
+
+# Raising kinds produce messages the shared classifier (utils/recovery.py)
+# routes like real infrastructure failures; the non-raising kinds act in
+# place (sleep / corrupt-after-write).
+_RAISING_KINDS = ("transient", "oom")
+
+# Context-qualifier aliases: "rung" reads the site's "lanes" context key
+# (the spec grammar talks about ladder rungs; the sites report widths).
+_QUAL_ALIASES = {"rung": "lanes"}
+
+
+@dataclasses.dataclass
+class FaultRule:
+    """One parsed spec clause plus its runtime budget."""
+
+    kind: str
+    site: str
+    qual: tuple = ()  # ((ctx_key, int_value), ...) — all must match
+    p: float | None = None  # per-visit probability (None = always)
+    n: int | None = None  # firing budget (None = unlimited)
+    ms: float | None = None  # sleep for slow kinds
+    skip: int = 0  # matching visits to pass over before becoming eligible
+    remaining: int | None = dataclasses.field(default=None, compare=False)
+    fired: int = dataclasses.field(default=0, compare=False)
+    visits: int = dataclasses.field(default=0, compare=False)
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r} (one of {KINDS})"
+            )
+        if self.site not in SITES:
+            raise ValueError(
+                f"unknown fault site {self.site!r} (one of {SITES})"
+            )
+        if self.kind in ("slow", "slow_extract") and self.ms is None:
+            raise ValueError(f"{self.kind} needs an ms= parameter")
+        if self.p is not None and not (0.0 <= self.p <= 1.0):
+            raise ValueError(f"p must be in [0, 1], got {self.p}")
+        if self.remaining is None:
+            self.remaining = self.n
+
+    def matches(self, site: str, ctx: dict) -> bool:
+        """Site + context-qualifier match (budget/skip/probability are the
+        schedule's concern — see ``FaultSchedule._select``)."""
+        if site != self.site:
+            return False
+        for key, want in self.qual:
+            got = ctx.get(_QUAL_ALIASES.get(key, key))
+            if got is None or int(got) != want:
+                return False
+        return True
+
+    def to_clause(self) -> str:
+        out = self.kind
+        if self.site != DEFAULT_SITE[self.kind]:
+            out += f"@{self.site}"
+        out += "".join(f"@{k}={v}" for k, v in self.qual)
+        if self.p is not None:
+            out += f":p={self.p:g}"
+        if self.n is not None:
+            out += f":n={self.n}"
+        if self.ms is not None:
+            out += f":ms={self.ms:g}"
+        if self.skip:
+            out += f":skip={self.skip}"
+        return out
+
+
+def _parse_clause(clause: str) -> FaultRule:
+    head, *params = clause.split(":")
+    head = head.strip()
+    kind, _, target = head.partition("@")
+    kind = kind.strip()
+    if kind not in KINDS:
+        raise ValueError(
+            f"unknown fault kind {kind!r} in clause {clause!r} "
+            f"(one of {KINDS})"
+        )
+    site = DEFAULT_SITE[kind]
+    qual = []
+    explicit_site = False
+    # "@" targets compose: at most one site plus any context qualifiers
+    # (e.g. "oom@fetch@rung=64" — OOM the fetch half of 64-wide batches).
+    for tok in target.split("@") if target else ():
+        tok = tok.strip()
+        if "=" in tok:
+            qk, _, qv = tok.partition("=")
+            try:
+                qual.append((qk.strip(), int(qv)))
+            except ValueError:
+                raise ValueError(
+                    f"qualifier {tok!r} in clause {clause!r} must be "
+                    f"name=int"
+                ) from None
+        elif explicit_site:
+            raise ValueError(
+                f"clause {clause!r} names two sites ({site!r}, {tok!r})"
+            )
+        else:
+            site = tok
+            explicit_site = True
+    qual = tuple(qual)
+    p = n = ms = None
+    skip = 0
+    for param in params:
+        k, eq, v = param.partition("=")
+        k = k.strip()
+        if not eq:
+            raise ValueError(f"parameter {param!r} in clause {clause!r} "
+                             f"must be key=value")
+        try:
+            if k == "p":
+                p = float(v)
+            elif k == "n":
+                n = int(v)
+            elif k == "ms":
+                ms = float(v)
+            elif k == "skip":
+                skip = int(v)
+            else:
+                raise ValueError
+        except ValueError:
+            raise ValueError(
+                f"unknown/invalid parameter {param!r} in clause {clause!r} "
+                "(p=FLOAT, n=INT, ms=FLOAT, skip=INT)"
+            ) from None
+    if p is None and n is None:
+        n = 1  # a bare clause fires exactly once — deterministic by default
+    return FaultRule(kind=kind, site=site, qual=qual, p=p, n=n, ms=ms,
+                     skip=skip)
+
+
+class FaultSchedule:
+    """A seeded set of :class:`FaultRule` consulted at injection sites.
+
+    Thread-safe: the serve scheduler, extraction worker, and client
+    threads may all hit sites concurrently; rule budgets and the RNG are
+    guarded by one lock. Probability draws consume the schedule's own
+    ``random.Random(seed)``, so the injection sequence is a pure function
+    of (seed, site-visit sequence)."""
+
+    def __init__(self, rules, *, seed: int = 0):
+        self.rules = list(rules)
+        self.seed = int(seed)
+        self._rng = random.Random(self.seed)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self.events: list[dict] = []  # audit log of every firing
+
+    # --- construction -----------------------------------------------------
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "FaultSchedule":
+        spec = spec.strip()
+        if not spec:
+            raise ValueError("empty fault spec")
+        seed = 0
+        if spec.startswith("seed="):
+            head, _, rest = spec.partition(":")
+            try:
+                seed = int(head[len("seed="):])
+            except ValueError:
+                raise ValueError(f"bad seed in fault spec {spec!r}") from None
+            spec = rest
+        clauses = [c for c in spec.split(",") if c.strip()]
+        if not clauses:
+            raise ValueError("fault spec has no clauses")
+        return cls([_parse_clause(c) for c in clauses], seed=seed)
+
+    def to_spec(self) -> str:
+        """Canonical spec string; ``from_spec(to_spec())`` round-trips."""
+        return f"seed={self.seed}:" + ",".join(
+            r.to_clause() for r in self.rules
+        )
+
+    # --- runtime ----------------------------------------------------------
+
+    def _select(self, site: str, ctx: dict, kinds=None) -> list[FaultRule]:
+        """Consume budgets/RNG for matching rules; returns fired rules."""
+        fired = []
+        with self._lock:
+            for rule in self.rules:
+                if kinds is not None and rule.kind not in kinds:
+                    continue
+                if not rule.matches(site, ctx):
+                    continue
+                rule.visits += 1
+                if rule.visits <= rule.skip:
+                    continue  # not eligible yet (skip=K targets visit K+1)
+                if rule.remaining is not None and rule.remaining <= 0:
+                    continue
+                if rule.p is not None and self._rng.random() >= rule.p:
+                    continue
+                rule.fired += 1
+                if rule.remaining is not None:
+                    rule.remaining -= 1
+                self._seq += 1
+                self.events.append({
+                    "seq": self._seq,
+                    "site": site,
+                    "kind": rule.kind,
+                    "clause": rule.to_clause(),
+                    "ctx": {k: v for k, v in ctx.items()},
+                })
+                fired.append(rule)
+                if rule.kind in _RAISING_KINDS:
+                    break  # one raise per visit; later rules keep budget
+        for rule in fired:
+            self._count_injected()
+        return fired
+
+    @staticmethod
+    def _count_injected() -> None:
+        # Lazy import: recovery counters live under tpu_bfs.utils and this
+        # module must stay stdlib-only at import time.
+        from tpu_bfs.utils.recovery import COUNTERS
+
+        COUNTERS.bump("faults_injected")
+
+    def hit(self, site: str, **ctx) -> None:
+        """Consult the schedule at ``site``. Sleeps for slow rules, then
+        raises for at most one transient/oom rule — messages routed by the
+        shared classifier exactly like real infrastructure failures."""
+        raising = None
+        # Only the kinds hit() can act on — in-place kinds (corrupt_ckpt)
+        # keep their budget for the dedicated take() consultation.
+        kinds = (*_RAISING_KINDS, "slow", "slow_extract")
+        for rule in self._select(site, ctx, kinds=kinds):
+            if rule.kind in ("slow", "slow_extract"):
+                time.sleep((rule.ms or 0.0) / 1e3)
+            elif raising is None and rule.kind in _RAISING_KINDS:
+                raising = rule
+        if raising is None:
+            return
+        where = f"site={site}" + "".join(
+            f" {k}={v}" for k, v in sorted(ctx.items())
+        )
+        if raising.kind == "transient":
+            raise RuntimeError(
+                f"INTERNAL: injected transient fault ({where}, "
+                f"clause {raising.to_clause()!r}) [tpu_bfs.faults]"
+            )
+        raise RuntimeError(
+            f"RESOURCE_EXHAUSTED: injected out-of-memory fault ({where}, "
+            f"clause {raising.to_clause()!r}) [tpu_bfs.faults]"
+        )
+
+    def take(self, site: str, kind: str, **ctx) -> bool:
+        """Non-raising consultation for in-place kinds (corrupt_ckpt):
+        True when a matching rule fired (budget consumed)."""
+        return bool(self._select(site, ctx, kinds=(kind,)))
+
+    def counts(self) -> dict:
+        """Fired-count per kind — the statsz/audit summary."""
+        with self._lock:
+            out: dict = {}
+            for rule in self.rules:
+                out[rule.kind] = out.get(rule.kind, 0) + rule.fired
+            return out
+
+    def exhausted(self) -> bool:
+        """True once every bounded rule has spent its budget."""
+        with self._lock:
+            return all(
+                r.remaining is not None and r.remaining <= 0
+                for r in self.rules
+            )
+
+
+# --- process-wide arming ---------------------------------------------------
+
+# THE guard production sites check: None (the default) keeps every
+# injection site a single attribute test with no further work.
+ACTIVE: FaultSchedule | None = None
+
+ENV_VAR = "TPU_BFS_FAULTS"
+
+
+def arm(schedule: FaultSchedule) -> FaultSchedule:
+    global ACTIVE
+    ACTIVE = schedule
+    return schedule
+
+
+def arm_from_spec(spec: str) -> FaultSchedule:
+    return arm(FaultSchedule.from_spec(spec))
+
+
+def arm_from_env(env: str = ENV_VAR) -> FaultSchedule | None:
+    spec = os.environ.get(env, "").strip()
+    return arm_from_spec(spec) if spec else None
+
+
+def arm_from_spec_or_env(spec: str | None,
+                         env: str = ENV_VAR) -> FaultSchedule | None:
+    """The entry points' shared precedence: an explicit ``--faults`` spec
+    wins over the environment variable; neither set = stay disarmed."""
+    return arm_from_spec(spec) if spec else arm_from_env(env)
+
+
+def disarm() -> None:
+    global ACTIVE
+    ACTIVE = None
+
+
+def corruption_offset(path: str) -> int:
+    """A byte offset guaranteed to sit inside REAL payload: the first
+    byte of a zip archive's last member's compressed data (checkpoints
+    are npz = zip). A flip at an arbitrary offset can land in zip dead
+    space — padding, central directory slack — leaving the file
+    semantically intact, which would make a corruption drill silently
+    vacuous. Falls back to the file midpoint for non-zip files."""
+    try:
+        import struct
+        import zipfile
+
+        with zipfile.ZipFile(path) as z:
+            info = z.infolist()[-1]
+        with open(path, "rb") as f:
+            f.seek(info.header_offset + 26)
+            nlen, elen = struct.unpack("<HH", f.read(4))
+        return info.header_offset + 30 + nlen + elen
+    except Exception:  # noqa: BLE001 — not a zip: best-effort midpoint
+        return os.path.getsize(path) // 2
+
+
+def maybe_corrupt_file(path: str) -> bool:
+    """``ckpt_save`` site hook for ``corrupt_ckpt`` rules: flip one
+    payload byte after a completed atomic write, simulating
+    storage-level corruption the load-side CRC must catch. True when it
+    fired."""
+    sched = ACTIVE
+    if sched is None or not sched.take("ckpt_save", "corrupt_ckpt",
+                                       path=path):
+        return False
+    off = corruption_offset(path)
+    with open(path, "r+b") as f:
+        f.seek(off)
+        byte = f.read(1) or b"\x00"
+        f.seek(off)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    return True

@@ -15,7 +15,11 @@ one-shot CLI's fresh-process-per-query flow:
   for batch fill; per-query deadlines; shed-on-overload);
 - ``executor``  — batch dispatch through the engines' async
   dispatch/fetch halves, with transient-failure retry and OOM lane-count
-  degrade on BOTH halves (classifier shared with utils/recovery.py);
+  degrade on BOTH halves (classifier shared with utils/recovery.py), a
+  dispatch watchdog (a hung device fetch is classified transient instead
+  of wedging the executor), and a per-width circuit breaker over
+  deterministic failures (routing goes around an open rung; half-open
+  probe on a timer);
 - ``frontend``  — the in-process ``BfsService`` API (adaptive width
   ladder: each batch routes to the narrowest warmed width that fits;
   pipelined extraction: a worker pulls batch N's results while batch N+1
@@ -23,9 +27,17 @@ one-shot CLI's fresh-process-per-query flow:
   ``tpu-bfs-serve`` entry point;
 - ``metrics``   — /statsz-style serve counters (QPS, p50/p99 latency,
   fill ratio vs dispatched width, per-width routing histogram, pad
-  waste, extraction time, queue depth, retries, sheds).
+  waste, extraction time, queue depth, retries, sheds, watchdog trips,
+  breaker state, requeue-budget sheds).
+
+Lifecycle (robustness issue): the JSONL server drains gracefully on
+SIGTERM/SIGINT (admission stops, in-flight batches flush, queued queries
+resolve SHUTDOWN, final statsz emitted), and the whole failure surface is
+exercised by the deterministic chaos harness (tpu_bfs/faults.py,
+``--faults`` / TPU_BFS_FAULTS) — see README "Failure model".
 """
 
+from tpu_bfs.serve.executor import CircuitBreaker  # noqa: F401
 from tpu_bfs.serve.frontend import BfsService  # noqa: F401
 from tpu_bfs.serve.metrics import ServeMetrics  # noqa: F401
 from tpu_bfs.serve.registry import EngineRegistry, EngineSpec  # noqa: F401

@@ -25,10 +25,12 @@ still resolves exactly once.
 
 from __future__ import annotations
 
+import threading
 import time
 
 import numpy as np
 
+from tpu_bfs import faults as _faults
 from tpu_bfs.serve.scheduler import STATUS_ERROR, STATUS_OK, QueryResult
 from tpu_bfs.utils.recovery import (
     COUNTERS,
@@ -55,6 +57,96 @@ def pad_batch(sources: np.ndarray, lanes: int) -> tuple[np.ndarray, int]:
     out[:n] = sources
     out[n:] = sources[0]
     return out, n
+
+
+class CircuitBreaker:
+    """Per-key (dispatch width) circuit breaker over DETERMINISTIC batch
+    failures.
+
+    A rung whose every dispatch fails deterministically (wedged device
+    state, a compiler bug tripped by one shape) would otherwise burn its
+    full retry ladder on every batch routed to it, forever. The breaker
+    OPENS after ``threshold`` consecutive deterministic failures at a
+    key: the service's router then skips that rung (queries route to the
+    next wider one). After ``cooldown_s`` it HALF-OPENS — one probe batch
+    is admitted; success closes the breaker, failure re-opens it for
+    another cooldown. OOMs never count here (the width-degrade ladder
+    already evicts and routes around those); transient failures never
+    count (the retry ladder owns them).
+
+    Thread-safe; open transitions bump ``RecoveryCounters.breaker_opens``
+    and are visible in statsz (``breaker_open`` / ``breaker_opens``)."""
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(self, *, threshold: int = 3, cooldown_s: float = 30.0,
+                 now=time.monotonic, log=None):
+        if threshold < 1:
+            raise ValueError(f"breaker threshold must be >= 1, got {threshold}")
+        self.threshold = threshold
+        self.cooldown_s = cooldown_s
+        self._now = now
+        self._log = log or (lambda msg: None)
+        self._lock = threading.Lock()
+        self._state: dict = {}  # key -> [state, consecutive_fails, opened_at]
+        self.opens = 0
+
+    def allow(self, key) -> bool:
+        """May a batch be routed to ``key`` right now? Open keys refuse
+        until the cooldown elapses, then admit exactly one probe."""
+        with self._lock:
+            st = self._state.get(key)
+            if st is None or st[0] == self.CLOSED:
+                return True
+            # OPEN past the cooldown admits one probe (half-open); a
+            # HALF_OPEN whose probe never reported back (lost outside the
+            # executor, e.g. a failed engine build) re-admits one more
+            # probe per cooldown period — a lost probe must not block the
+            # rung forever.
+            if self._now() - st[2] >= self.cooldown_s:
+                st[0] = self.HALF_OPEN
+                st[2] = self._now()
+                self._log(f"circuit breaker half-open for width {key}: "
+                          f"admitting one probe batch")
+                return True
+            return False  # open, or half-open with the probe in flight
+
+    def record_success(self, key) -> None:
+        with self._lock:
+            st = self._state.pop(key, None)
+            if st is not None and st[0] != self.CLOSED:
+                self._log(f"circuit breaker closed for width {key} "
+                          f"(probe batch succeeded)")
+
+    def record_failure(self, key) -> bool:
+        """Count one deterministic failure; True when the breaker OPENED
+        (first crossing of the threshold, or a failed half-open probe)."""
+        with self._lock:
+            st = self._state.setdefault(key, [self.CLOSED, 0, 0.0])
+            st[1] += 1
+            opened = (
+                st[0] == self.HALF_OPEN
+                or (st[0] == self.CLOSED and st[1] >= self.threshold)
+            )
+            if opened:
+                st[0] = self.OPEN
+                st[2] = self._now()
+                self.opens += 1
+        if opened:
+            COUNTERS.bump("breaker_opens")
+            self._log(
+                f"circuit breaker OPEN for width {key} after {st[1]} "
+                f"consecutive deterministic failures (cooldown "
+                f"{self.cooldown_s:.1f}s)"
+            )
+        return opened
+
+    def open_keys(self) -> list:
+        """Keys currently open/half-open (for statsz)."""
+        with self._lock:
+            return sorted(
+                k for k, st in self._state.items() if st[0] != self.CLOSED
+            )
 
 
 class OomRequeue(Exception):
@@ -107,13 +199,29 @@ class BatchExecutor:
 
     def __init__(self, metrics, *, max_retries: int = 2,
                  backoff_s: float = 0.05, backoff_cap_s: float = 2.0,
-                 log=None, sleep=time.sleep):
+                 log=None, sleep=time.sleep, watchdog_s: float = 0.0,
+                 breaker: CircuitBreaker | None = None):
         self.metrics = metrics
         self.max_retries = max_retries
         self.backoff_s = backoff_s
         self.backoff_cap_s = backoff_cap_s
         self._log = log or (lambda msg: None)
         self._sleep = sleep
+        # Dispatch watchdog: > 0 bounds how long the blocking fetch half
+        # may run before being CLASSIFIED AS TRANSIENT (the existing
+        # retry/rebuild path fires instead of the executor hanging
+        # forever on a wedged device). 0 keeps the plain inline fetch.
+        self.watchdog_s = watchdog_s
+        self.breaker = breaker
+        # Every watchdog trip abandons a daemon thread still blocked in
+        # engine.fetch, pinning that batch's device handle until the
+        # fetch eventually returns. On a permanently wedged device those
+        # would accumulate forever; past this cap new watched fetches are
+        # REFUSED with a deterministic error (feeding the breaker, which
+        # then routes around the rung) instead of abandoning more state.
+        self.max_abandoned = 8
+        self._abandoned = 0
+        self._abandon_lock = threading.Lock()
 
     # --- pipeline halves --------------------------------------------------
 
@@ -130,6 +238,12 @@ class BatchExecutor:
         pending = PendingBatch(engine, queries, n, padded)
         while True:
             try:
+                if _faults.ACTIVE is not None:
+                    # Chaos-harness injection site: engine-agnostic (the
+                    # _packed_common dispatch/fetch sites cover real
+                    # engines; this one also covers test doubles).
+                    _faults.ACTIVE.hit("serve_batch", lanes=engine.lanes,
+                                       n=pending.n)
                 pending.handle = self._dispatch(engine, padded)
                 return pending
             except Exception as exc:  # noqa: BLE001 — gated by the classifier
@@ -146,7 +260,7 @@ class BatchExecutor:
             try:
                 if pending.handle is None:  # re-dispatch after a retry
                     pending.handle = self._dispatch(engine, pending.padded)
-                res = self._fetch(engine, pending.handle)
+                res = self._fetch_watched(engine, pending)
                 break
             except Exception as exc:  # noqa: BLE001 — gated by the classifier
                 pending.handle = None
@@ -179,6 +293,78 @@ class BatchExecutor:
             return handle.res
         return engine.fetch(handle)
 
+    def _fetch_watched(self, engine, pending: PendingBatch):
+        """The blocking fetch, under the dispatch watchdog when armed.
+
+        A device computation that exceeds ``watchdog_s`` is CLASSIFIED AS
+        TRANSIENT (a DEADLINE_EXCEEDED RuntimeError the shared classifier
+        retries), so a wedged device fires the existing re-dispatch path
+        instead of hanging the executor forever. The abandoned fetch runs
+        on a daemon thread; if it eventually completes, its result is
+        discarded — the batch's queries resolve exactly once through
+        whichever attempt the retry ladder lands.
+
+        Deliberately one thread PER watched fetch, not a persistent
+        worker: after a trip the abandoned fetch may block its thread
+        indefinitely, and the retry's fetch must proceed concurrently —
+        a single long-lived worker would serialize behind exactly the
+        hang the watchdog exists to route around. Thread spawn cost is
+        noise next to a device level-loop fetch."""
+        if self.watchdog_s <= 0:
+            return self._fetch(engine, pending.handle)
+        with self._abandon_lock:
+            over_cap = self._abandoned >= self.max_abandoned
+        if over_cap:
+            # Deterministic (no transient marker): resolves the batch's
+            # queries with errors and feeds the breaker, instead of
+            # abandoning yet another fetch on a wedged device.
+            raise RuntimeError(
+                f"dispatch watchdog: {self._abandoned} abandoned fetches "
+                f"still running (cap {self.max_abandoned}); refusing to "
+                f"watch another fetch on this engine"
+            )
+        box: list = []
+        done = threading.Event()
+        state = {"abandoned": False}
+
+        def work(handle=pending.handle):
+            try:
+                box.append(("ok", self._fetch(engine, handle)))
+            except BaseException as exc:  # noqa: BLE001 — re-raised below
+                box.append(("err", exc))
+            finally:
+                # done + the abandoned-count handoff commute under one
+                # lock: either the watcher sees done in time, or it marks
+                # the thread abandoned and this finally pays it back.
+                with self._abandon_lock:
+                    if state["abandoned"]:
+                        self._abandoned -= 1
+                    done.set()
+
+        threading.Thread(
+            target=work, name="bfs-serve-fetch", daemon=True
+        ).start()
+        if not done.wait(self.watchdog_s):
+            tripped = False
+            with self._abandon_lock:
+                if not done.is_set():
+                    state["abandoned"] = True
+                    self._abandoned += 1
+                    tripped = True
+            if tripped:
+                COUNTERS.bump("watchdog_trips")
+                self.metrics.record_watchdog_trip()
+                raise RuntimeError(
+                    f"DEADLINE_EXCEEDED: dispatch watchdog: a "
+                    f"{pending.n}-query batch's device fetch is still "
+                    f"running after {self.watchdog_s:.1f}s — classifying "
+                    f"as transient"
+                )
+        kind, val = box[0]
+        if kind == "err":
+            raise val
+        return val
+
     def _classify_failure(self, pending: PendingBatch, exc) -> bool:
         """The one classifier both halves share. True = retry the batch;
         False = resolved as deterministic errors; OOM raises OomRequeue."""
@@ -199,6 +385,11 @@ class BatchExecutor:
             return True
         err = f"{type(exc).__name__}: {str(exc)[:300]}"
         self._log(f"batch failed deterministically: {err}")
+        if self.breaker is not None:
+            # Deterministic failures (exhausted transients included) feed
+            # the per-width breaker so routing stops paying this rung's
+            # full retry ladder per batch once it is provably broken.
+            self.breaker.record_failure(pending.lanes)
         for q in pending.queries:
             q.resolve_status(STATUS_ERROR, error=err)
         self.metrics.record_errors(pending.n)
@@ -209,6 +400,8 @@ class BatchExecutor:
 
         engine, queries, n = pending.engine, pending.queries, pending.n
         width = engine.lanes
+        if self.breaker is not None:
+            self.breaker.record_success(width)
         # The on-device ecc summary is only worth its kernel dispatch when
         # some query skips the distance decode; all-want_distances batches
         # derive levels from the rows they pull anyway.

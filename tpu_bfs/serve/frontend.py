@@ -35,13 +35,15 @@ import base64
 import io
 import json
 import queue as _queue
+import signal
 import sys
 import threading
 import time
 
 import numpy as np
 
-from tpu_bfs.serve.executor import BatchExecutor, OomRequeue
+from tpu_bfs import faults as _faults
+from tpu_bfs.serve.executor import BatchExecutor, CircuitBreaker, OomRequeue
 from tpu_bfs.serve.metrics import ServeMetrics
 from tpu_bfs.serve.registry import DEFAULT_PLANES, EngineRegistry, EngineSpec
 from tpu_bfs.serve.scheduler import (
@@ -135,6 +137,10 @@ class BfsService:
         queue_cap: int = 1024,
         deadline_ms: float = 0.0,
         max_retries: int = 2,
+        max_requeues: int = 8,
+        watchdog_ms: float = 0.0,
+        breaker_threshold: int = 3,
+        breaker_cooldown_ms: float = 30_000.0,
         distances: bool = True,
         registry: EngineRegistry | None = None,
         registry_capacity: int = 4,
@@ -171,16 +177,30 @@ class BfsService:
         self._default_deadline_s = max(deadline_ms, 0.0) / 1e3
         self._queue = AdmissionQueue(queue_cap)
         self.metrics = ServeMetrics()
+        # Per-width circuit breaker over deterministic batch failures:
+        # routing skips an open rung (see _route_width) instead of paying
+        # its full retry ladder per batch; half-opens on a timer.
+        self._breaker = CircuitBreaker(
+            threshold=breaker_threshold,
+            cooldown_s=max(breaker_cooldown_ms, 0.0) / 1e3,
+            log=self._log,
+        )
         self._executor = BatchExecutor(
-            self.metrics, max_retries=max_retries, log=self._log
+            self.metrics, max_retries=max_retries, log=self._log,
+            watchdog_s=max(watchdog_ms, 0.0) / 1e3, breaker=self._breaker,
         )
         self._max_retries = max_retries
+        # Bounded OOM requeue budget: a query re-admitted more than this
+        # many times resolves with an explicit error carrying its attempt
+        # history instead of looping forever when every rung is broken.
+        self._max_requeues = max(int(max_requeues), 0)
         self._want_distances_default = bool(distances)
         self._pipe_q: _queue.Queue | None = (
             _queue.Queue(maxsize=max(1, int(pipeline_depth)))
             if pipeline else None
         )
         self._closed = False
+        self._draining = False
         self._thread: threading.Thread | None = None
         self._extract_thread: threading.Thread | None = None
         self._lock = threading.Lock()
@@ -225,6 +245,13 @@ class BfsService:
             )
             self._thread.start()
         return self
+
+    def drain(self) -> None:
+        """Stop ADMISSION only: new submits shed with REJECTED while
+        queued and in-flight queries run to resolution. The first half of
+        a graceful shutdown (the JSONL server's SIGTERM path); ``close``
+        completes it. Idempotent."""
+        self._draining = True
 
     def close(self) -> None:
         """Stop serving: in-flight batches complete (the extraction
@@ -301,10 +328,14 @@ class BfsService:
             )
             self.metrics.record_errors()
             return q
-        if self._closed or not self._queue.offer(q):
+        if self._closed or self._draining or not self._queue.offer(q):
             q.resolve_status(
                 STATUS_REJECTED,
-                error="service closed" if self._closed else "queue full",
+                error=(
+                    "service closed" if self._closed
+                    else "service draining" if self._draining
+                    else "queue full"
+                ),
             )
             self.metrics.record_rejected()
         return q
@@ -317,9 +348,25 @@ class BfsService:
             source, deadline_ms=deadline_ms, want_distances=want_distances,
         ).result(timeout)
 
+    def statsz_extras(self) -> dict:
+        """Service-level observations beyond the metrics counters —
+        merged into both the statsz() snapshot and the JSONL server's
+        periodic/final statsz lines."""
+        out = {
+            "breaker_open": self._breaker.open_keys(),
+            "breaker_opens": self._breaker.opens,
+            "draining": self._draining,
+        }
+        if _faults.ACTIVE is not None:
+            # Chaos-harness visibility: per-kind injected-fault counts so
+            # a soak can check every scheduled fault actually landed.
+            out["faults"] = _faults.ACTIVE.counts()
+        return out
+
     def statsz(self) -> dict:
         out = self.metrics.snapshot(
-            queue_depth=self._queue.depth(), lanes=self._max_lanes
+            queue_depth=self._queue.depth(), lanes=self._max_lanes,
+            extra=self.statsz_extras(),
         )
         out["ladder"] = self.width_ladder
         out["pipeline"] = self._pipe_q is not None
@@ -333,12 +380,16 @@ class BfsService:
 
     def _route_width(self, n: int) -> int:
         """The narrowest ladder rung that fits ``n`` queries (the cap when
-        nothing does — the caller splits and re-admits the tail)."""
+        nothing does — the caller splits and re-admits the tail), skipping
+        rungs whose circuit breaker is open. When EVERY candidate is open
+        the narrowest fitting rung is used anyway — the breaker routes
+        around broken rungs, it must never wedge the service."""
         with self._width_lock:
-            for w in self._ladder:
-                if w >= n:
-                    return w
-            return self._max_lanes
+            fits = [w for w in self._ladder if w >= n] or [self._max_lanes]
+        for w in fits:
+            if self._breaker.allow(w):
+                return w
+        return fits[0]
 
     def _acquire_engine(self, width: int):
         """The warmed engine for ``width`` (clamped to the degrade cap),
@@ -408,7 +459,42 @@ class BfsService:
     def _handle_batch_oom(self, queries, at_width: int, cause) -> None:
         """Degrade below the OOM'd width and re-admit, or resolve with
         explicit errors at the floor. Shared by the dispatch half (the
-        scheduler thread) and the fetch half (the extraction worker)."""
+        scheduler thread) and the fetch half (the extraction worker).
+
+        Re-admission carries a BOUNDED attempt budget (``max_requeues``):
+        a query whose every attempted rung keeps OOMing resolves with an
+        explicit error naming its attempt history instead of cycling
+        through the ladder forever."""
+        live = []
+        shed = 0
+        for q in queries:
+            q.requeues += 1
+            q.attempt_widths.append(at_width)
+            if q.requeues > self._max_requeues:
+                if q.resolve_status(
+                    STATUS_ERROR,
+                    error=(
+                        f"requeue budget exhausted: {q.requeues} OOM "
+                        f"re-admissions (attempted widths "
+                        f"{q.attempt_widths}) — every remaining rung is "
+                        f"failing"
+                    ),
+                ):
+                    shed += 1
+            else:
+                live.append(q)
+        if shed:
+            self._log(f"shed {shed} queries at the requeue budget "
+                      f"({self._max_requeues})")
+            COUNTERS.bump("requeue_sheds", shed)
+            self.metrics.record_requeue_shed(shed)
+            self.metrics.record_errors(shed)
+        queries = live
+        if not queries:
+            # Still account the degrade attempt below even when every
+            # query shed: the rung DID fail, and routing must move off it.
+            self._degrade(at_width)
+            return
         if self._degrade(at_width, requeued=len(queries)):
             self._queue.requeue(queries)
             if self._queue.stopped:
@@ -622,6 +708,27 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument("--max-retries", type=int, default=2,
                     help="transient-failure re-dispatches per batch "
                     "(default 2)")
+    ap.add_argument("--max-requeues", type=int, default=8,
+                    help="OOM re-admission budget per query; beyond it the "
+                    "query resolves with an explicit error carrying its "
+                    "attempt history (default 8)")
+    ap.add_argument("--watchdog-ms", type=float, default=0.0,
+                    help="dispatch watchdog: a batch's device fetch "
+                    "exceeding this is classified as transient and "
+                    "re-dispatched instead of hanging the executor; 0 "
+                    "disables (default 0)")
+    ap.add_argument("--breaker-threshold", type=int, default=3,
+                    help="consecutive deterministic batch failures at one "
+                    "width before its circuit breaker opens and routing "
+                    "skips the rung (default 3)")
+    ap.add_argument("--breaker-cooldown-ms", type=float, default=30000.0,
+                    help="how long an open breaker waits before admitting "
+                    "one half-open probe batch (default 30000)")
+    ap.add_argument("--faults", default=None, metavar="SPEC",
+                    help="arm a deterministic fault-injection schedule "
+                    "(tpu_bfs/faults.py), e.g. 'seed=7:transient@dispatch:"
+                    "p=0.05,oom@rung=512:n=2,slow_extract:ms=200'; "
+                    "default: the TPU_BFS_FAULTS env var, else disabled")
     ap.add_argument("--no-distances", action="store_true",
                     help="metadata-only serving by default: responses "
                     "omit distances_npy AND the distance rows are never "
@@ -637,18 +744,81 @@ def build_arg_parser() -> argparse.ArgumentParser:
     return ap
 
 
+def _parse_request_line(line: str):
+    """Parse one JSONL request into (id, source, deadline_ms, want).
+    Raises on ANYTHING malformed — the caller answers with a structured
+    error line; nothing a client sends may kill the reader loop."""
+    req = json.loads(line)
+    if not isinstance(req, dict):
+        raise TypeError("request must be a JSON object")
+    qid = req.get("id")
+    try:
+        if "source" not in req:
+            raise KeyError("source")
+        source = req["source"]
+        # bool is an int subclass and json floats arrive for "7.0":
+        # accept exactly the integers (ints and integral floats), reject
+        # the rest — a lenient int() would silently truncate 7.9 to
+        # vertex 7.
+        if isinstance(source, bool) or not isinstance(source, (int, float)):
+            raise TypeError(
+                f"source must be an integer vertex id, got {source!r}"
+            )
+        if isinstance(source, float):
+            if not source.is_integer():
+                raise TypeError(
+                    f"source must be an integer vertex id, got {source!r}"
+                )
+            source = int(source)
+        ddl = req.get("deadline_ms")
+        if ddl is not None:
+            # Same strictness as source: float(True) == 1.0 and
+            # float("100") == 100.0 would silently accept a client bug
+            # and surface it later as a bogus deadline expiry.
+            if isinstance(ddl, bool) or not isinstance(ddl, (int, float)):
+                raise TypeError(
+                    f"deadline_ms must be a JSON number, got {ddl!r}"
+                )
+            ddl = float(ddl)
+        want = req.get("want_distances")
+        if want is not None and not isinstance(want, bool):
+            # bool("false") is True — a lenient coercion would silently
+            # invert the client's intent.
+            raise TypeError(
+                f"want_distances must be a JSON boolean, got {want!r}"
+            )
+    except Exception as exc:
+        exc._request_id = qid  # the error line must still correlate
+        raise
+    return qid, source, ddl, want
+
+
 def run_server(args, stdin=None, stdout=None, stderr=None,
                registry=None) -> int:
     """The JSONL loop, parameterized over streams (and optionally a
     shared registry) so tests run it in-process. Reads requests until
     EOF, then drains outstanding responses, prints a final statsz line,
-    and closes the service."""
+    and closes the service.
+
+    LIFECYCLE (robustness issue): requests are read on a dedicated
+    reader thread; the main thread waits for either the reader's normal
+    EOF drain or a SIGTERM/SIGINT. A signal triggers a GRACEFUL DRAIN —
+    admission stops (late submits shed REJECTED), in-flight batches
+    flush, still-queued queries resolve as SHUTDOWN, every resolution is
+    emitted, and the final statsz line lands — instead of the default
+    die-mid-batch. Handlers are only installed when running on the main
+    thread and are restored on exit, so in-process test runs are
+    unaffected."""
     stdin = sys.stdin if stdin is None else stdin
     stdout = sys.stdout if stdout is None else stdout
     stderr = sys.stderr if stderr is None else stderr
 
     def log(msg: str) -> None:
         print(f"# {msg}", file=stderr, flush=True)
+
+    sched = _faults.arm_from_spec_or_env(args.faults)
+    if sched is not None:
+        log(f"fault-injection schedule ARMED: {sched.to_spec()}")
 
     service = BfsService(
         args.graph,
@@ -664,6 +834,10 @@ def run_server(args, stdin=None, stdout=None, stderr=None,
         queue_cap=args.queue_cap,
         deadline_ms=args.deadline_ms,
         max_retries=args.max_retries,
+        max_requeues=args.max_requeues,
+        watchdog_ms=args.watchdog_ms,
+        breaker_threshold=args.breaker_threshold,
+        breaker_cooldown_ms=args.breaker_cooldown_ms,
         distances=not args.no_distances,
         registry=registry,
         registry_capacity=args.registry_cap,
@@ -674,9 +848,15 @@ def run_server(args, stdin=None, stdout=None, stderr=None,
     drained = threading.Condition(out_lock)
 
     def emit(resp: dict) -> None:
-        with out_lock:
-            stdout.write(json.dumps(resp) + "\n")
-            stdout.flush()
+        # Never let a dead client pipe propagate into the resolver
+        # threads (a callback exception would kill the scheduler or the
+        # extraction worker mid-drain).
+        try:
+            with out_lock:
+                stdout.write(json.dumps(resp) + "\n")
+                stdout.flush()
+        except (OSError, ValueError) as exc:
+            log(f"response emit failed ({exc!r}); dropping line")
 
     def on_done(q: PendingQuery) -> None:
         emit(result_to_response(q.result()))
@@ -685,12 +865,34 @@ def run_server(args, stdin=None, stdout=None, stderr=None,
             if outstanding[0] == 0:
                 drained.notify_all()
 
+    stop = threading.Event()  # reader EOF-drain complete
+    got_signal = [None]
+
+    def on_signal(signum, frame) -> None:
+        # ONLY plain attribute stores here: the handler runs on the main
+        # thread between bytecodes, possibly while the interrupted frame
+        # holds the stop-Event's internal (non-reentrant) lock inside
+        # stop.wait() — calling stop.set() from the handler could
+        # deadlock the exact shutdown it implements. The main loop polls
+        # got_signal every wait timeout instead.
+        got_signal[0] = signum
+        service.drain()  # stop admission immediately (a plain bool store)
+
+    old_handlers = {}
+    if threading.current_thread() is threading.main_thread():
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                old_handlers[sig] = signal.signal(sig, on_signal)
+            except (ValueError, OSError):  # exotic embedding: skip
+                pass
+
     stop_statsz = threading.Event()
     if args.statsz_every > 0:
         def statsz_loop() -> None:
             while not stop_statsz.wait(args.statsz_every):
                 print(service.metrics.statsz_line(
                     queue_depth=service._queue.depth(), lanes=service.lanes,
+                    extra=service.statsz_extras(),
                 ), file=stderr, flush=True)
 
         threading.Thread(
@@ -701,49 +903,90 @@ def run_server(args, stdin=None, stdout=None, stderr=None,
         f"ladder={service.width_ladder} "
         f"pipeline={not args.no_pipeline} linger={args.linger_ms}ms "
         f"queue_cap={args.queue_cap}")
-    try:
-        for line in stdin:
-            line = line.strip()
-            if not line:
-                continue
-            qid = None
-            try:
-                req = json.loads(line)
-                if not isinstance(req, dict):
-                    raise TypeError("request must be a JSON object")
-                qid = req.get("id")
-                source = int(req["source"])
-                ddl = req.get("deadline_ms")
-                ddl = float(ddl) if ddl is not None else None
-                want = req.get("want_distances")
-                if want is not None and not isinstance(want, bool):
-                    # bool("false") is True — a lenient coercion would
-                    # silently invert the client's intent.
-                    raise TypeError(
-                        "want_distances must be a JSON boolean, got "
-                        f"{want!r}"
-                    )
-            except (ValueError, KeyError, TypeError) as exc:
-                emit({
-                    "id": qid,
-                    "status": STATUS_ERROR,
-                    "error": f"bad request: {exc!r}",
-                })
-                continue
+
+    def reader() -> None:
+        try:
+            for line in stdin:
+                line = line.strip()
+                if not line:
+                    continue
+                qid = None
+                try:
+                    try:
+                        qid, source, ddl, want = _parse_request_line(line)
+                    except Exception as exc:  # noqa: BLE001 — answered, never fatal
+                        # Includes RecursionError from hostile nesting and
+                        # any parser surprise: one bad line must get one
+                        # structured error response, never kill the loop.
+                        emit({
+                            "id": getattr(exc, "_request_id", None),
+                            "status": STATUS_ERROR,
+                            "error": f"bad request: {exc!r}",
+                        })
+                        continue
+                    with drained:
+                        outstanding[0] += 1
+                    try:
+                        service.submit(
+                            source, id=qid, deadline_ms=ddl,
+                            want_distances=want,
+                        ).add_done_callback(on_done)
+                    except Exception:
+                        # No response will ever fire for this query: the
+                        # increment must be unwound or the EOF drain
+                        # waits on it forever.
+                        with drained:
+                            outstanding[0] -= 1
+                            if outstanding[0] == 0:
+                                drained.notify_all()
+                        raise
+                except Exception as exc:  # noqa: BLE001 — keep reading
+                    log(f"request line dropped ({exc!r})")
+            # EOF: wait for every outstanding response, then finish.
             with drained:
-                outstanding[0] += 1
-            service.submit(
-                source, id=qid, deadline_ms=ddl, want_distances=want,
-            ).add_done_callback(on_done)
-        with drained:
-            while outstanding[0] > 0:
-                drained.wait()
+                while outstanding[0] > 0 and not stop.is_set():
+                    drained.wait(0.2)
+        finally:
+            stop.set()
+            with drained:
+                drained.notify_all()
+
+    reader_t = threading.Thread(
+        target=reader, name="bfs-serve-reader", daemon=True
+    )
+    try:
+        reader_t.start()
+        # Main thread parks here so signal handlers can run promptly;
+        # each wait timeout polls the handler's signal flag.
+        while not stop.wait(0.2):
+            if got_signal[0] is not None:
+                break
+        if got_signal[0] is not None:
+            name = signal.Signals(got_signal[0]).name
+            log(f"{name} received: draining — admission stopped, flushing "
+                f"in-flight batches, resolving queued queries as shutdown")
     finally:
+        # Drain to completion: close() flushes in-flight batches and
+        # resolves still-queued queries as SHUTDOWN; their callbacks emit
+        # the response lines, so wait for outstanding to hit zero (with a
+        # hard bound — a graceful drain must never become a hang).
+        service.close()
+        deadline = time.monotonic() + 30.0
+        with drained:
+            while outstanding[0] > 0 and time.monotonic() < deadline:
+                drained.wait(0.2)
+            if outstanding[0] > 0:
+                log(f"drain timeout: {outstanding[0]} responses unemitted")
         stop_statsz.set()
         print(service.metrics.statsz_line(
             queue_depth=service._queue.depth(), lanes=service.lanes,
+            extra=service.statsz_extras(),
         ), file=stderr, flush=True)
-        service.close()
+        for sig, handler in old_handlers.items():
+            try:
+                signal.signal(sig, handler)
+            except (ValueError, OSError):
+                pass
     return 0
 
 

@@ -42,6 +42,8 @@ class ServeMetrics:
         self.retries = 0  # transient-failure re-dispatches
         self.oom_degrades = 0  # lane-count halvings after OOM
         self.requeued = 0  # queries re-admitted after an OOM'd batch
+        self.watchdog_trips = 0  # dispatch-watchdog deadline firings
+        self.requeue_shed = 0  # queries shed at the requeue budget
         self.batches = 0
         self.lanes_used = 0  # real (non-pad) queries across all batches
         # Sum of DISPATCHED batch capacity: with the width ladder this is
@@ -95,8 +97,17 @@ class ServeMetrics:
             self.oom_degrades += 1
             self.requeued += requeued
 
+    def record_watchdog_trip(self) -> None:
+        with self._lock:
+            self.watchdog_trips += 1
+
+    def record_requeue_shed(self, n: int = 1) -> None:
+        with self._lock:
+            self.requeue_shed += n
+
     def snapshot(self, *, queue_depth: int | None = None,
-                 lanes: int | None = None, mark_interval: bool = False) -> dict:
+                 lanes: int | None = None, mark_interval: bool = False,
+                 extra: dict | None = None) -> dict:
         """One /statsz observation. ``interval_qps`` covers the window
         since the last ``mark_interval=True`` snapshot; only the ONE
         periodic emitter (statsz_line) passes that flag — ad-hoc
@@ -141,11 +152,17 @@ class ServeMetrics:
                 "retries": self.retries,
                 "oom_degrades": self.oom_degrades,
                 "requeued": self.requeued,
+                "watchdog_trips": self.watchdog_trips,
+                "requeue_shed": self.requeue_shed,
             }
         if queue_depth is not None:
             out["queue_depth"] = queue_depth
         if lanes is not None:
             out["lanes"] = lanes
+        if extra:
+            # Service-level observations riding the line (breaker state,
+            # drain flag, injected-fault audit — BfsService.statsz_extras).
+            out.update(extra)
         return out
 
     def statsz_line(self, **kw) -> str:

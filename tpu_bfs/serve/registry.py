@@ -24,6 +24,7 @@ from collections import OrderedDict
 
 import numpy as np
 
+from tpu_bfs import faults as _faults
 from tpu_bfs.utils.compile_cache import enable_compile_cache
 
 ENGINE_KINDS = ("wide", "hybrid", "packed")
@@ -139,6 +140,11 @@ class EngineRegistry:
             return eng
 
     def _build(self, spec: EngineSpec):
+        if _faults.ACTIVE is not None:
+            # Chaos-harness injection site: a transient raised here runs
+            # the service's engine-build retry; an OOM runs the width
+            # degrade — exactly like a real build failure.
+            _faults.ACTIVE.hit("engine_build", lanes=spec.lanes)
         g = self.graph(spec.graph_key)
         t0 = time.perf_counter()
         if spec.devices > 1:

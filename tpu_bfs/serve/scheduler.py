@@ -71,9 +71,15 @@ class PendingQuery:
 
     ``want_distances=False`` marks a metadata-only query (levels/reached
     only): with the engines' on-device summaries, such a query never
-    pulls its distance row off the device at all."""
+    pulls its distance row off the device at all.
+
+    ``requeues``/``attempt_widths`` record every OOM-driven re-admission
+    (the service's degrade ladder): the requeue budget reads the count,
+    and a query shed at the budget carries its attempt history in the
+    error so the failure names every width that was tried."""
 
     __slots__ = ("id", "source", "deadline", "t_submit", "want_distances",
+                 "requeues", "attempt_widths",
                  "_event", "_lock", "_result", "_callbacks")
 
     def __init__(self, source: int, *, id=None, deadline: float | None = None,
@@ -83,6 +89,8 @@ class PendingQuery:
         self.deadline = deadline  # absolute time.monotonic() value, or None
         self.t_submit = time.monotonic() if now is None else now
         self.want_distances = bool(want_distances)
+        self.requeues = 0  # OOM-driven re-admissions so far
+        self.attempt_widths: list = []  # width each failed attempt ran at
         self._event = threading.Event()
         self._lock = threading.Lock()
         self._result: QueryResult | None = None

@@ -18,10 +18,73 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import zipfile
+import zlib
 
 import numpy as np
 
+from tpu_bfs import faults as _faults
+
 _STATE_VERSION = 1
+
+
+class CorruptCheckpointError(ValueError):
+    """An on-disk checkpoint failed its integrity check (payload CRC32
+    mismatch, or unreadable npz). The offending file has been QUARANTINED
+    (renamed ``<path>.corrupt``) so a retry loop can never resume from
+    poisoned state; the message names the exact file/shard."""
+
+
+def _payload_crc32(arrays: dict) -> int:
+    """CRC32 over every array's name, dtype, shape, and bytes — the
+    integrity record written into each checkpoint npz on save and
+    verified on load. Key-order independent (sorted), so save and load
+    agree regardless of kwargs order."""
+    crc = 0
+    for name in sorted(arrays):
+        a = np.asarray(arrays[name])
+        crc = zlib.crc32(f"{name}:{a.dtype.str}:{a.shape}".encode(), crc)
+        # The contiguous ndarray feeds crc32 through the buffer protocol
+        # directly — no tobytes() copy, which would transiently double
+        # peak host memory on exactly the memory-pressured runs where
+        # checkpointing matters most.
+        crc = zlib.crc32(np.ascontiguousarray(a), crc)
+    return crc
+
+
+def _quarantine(path: str, reason: str) -> None:
+    qpath = path + ".corrupt"
+    try:
+        os.replace(path, qpath)
+    except OSError:
+        qpath = path  # read-only fs: still refuse to load it
+    raise CorruptCheckpointError(
+        f"checkpoint {path} failed integrity verification ({reason}); "
+        f"quarantined as {qpath} — resume from an intact checkpoint"
+    )
+
+
+def _load_npz_verified(path: str) -> dict:
+    """Load an npz written by ``_atomic_savez`` and verify its payload
+    CRC32. Unreadable or mismatching files are quarantined (renamed
+    ``.corrupt``) and raise :class:`CorruptCheckpointError` naming the
+    file. Files written before the CRC field existed load unverified."""
+    if _faults.ACTIVE is not None:
+        _faults.ACTIVE.hit("ckpt_load", path=path)
+    try:
+        with np.load(path) as z:
+            arrays = {k: z[k] for k in z.files}
+    except (zipfile.BadZipFile, zlib.error, EOFError, ValueError,
+            KeyError) as exc:
+        # DECODE failures only: quarantine is irreversible, so resource
+        # blips that say nothing about the bytes on disk (MemoryError
+        # mid-decompression, a transient OSError from a flaky mount)
+        # must propagate without destroying an intact checkpoint.
+        _quarantine(path, f"unreadable: {type(exc).__name__}: {exc}")
+    crc = arrays.pop("payload_crc32", None)
+    if crc is not None and int(crc) != _payload_crc32(arrays):
+        _quarantine(path, "payload CRC32 mismatch")
+    return arrays
 
 
 def _new_nonce() -> int:
@@ -79,22 +142,33 @@ def initial_checkpoint(num_vertices: int, source: int) -> BfsCheckpoint:
 
 
 def _atomic_savez(path: str, **arrays) -> None:
-    """savez_compressed to exactly ``path``, atomically.
+    """savez_compressed to exactly ``path``, atomically, with integrity.
 
     A file handle (not a bare path) stops ``np.savez_compressed`` from
     appending ``.npz`` — which would make ``--ckpt state`` save ``state.npz``
     while ``--resume state`` opens ``state`` and fails. Writing to a sibling
     temp file and ``os.replace``-ing keeps the previous good checkpoint
     intact if the process dies mid-save — the exact failure checkpointing
-    exists to survive."""
+    exists to survive. A ``payload_crc32`` field rides in the npz so the
+    load path can detect bit-level corruption (``_load_npz_verified``)
+    instead of silently resuming from a flipped table."""
+    if _faults.ACTIVE is not None:
+        _faults.ACTIVE.hit("ckpt_save", path=path)
     tmp = f"{path}.tmp"
     try:
         with open(tmp, "wb") as f:
-            np.savez_compressed(f, **arrays)
+            np.savez_compressed(
+                f, payload_crc32=np.uint32(_payload_crc32(arrays)), **arrays
+            )
         os.replace(tmp, path)
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
+    # Chaos-harness corruption (corrupt_ckpt rules) happens AFTER the
+    # completed atomic write — simulating storage corruption, which the
+    # CRC above exists to catch on the next load.
+    if _faults.ACTIVE is not None:
+        _faults.maybe_corrupt_file(path)
 
 
 def save_checkpoint(path: str, ckpt: BfsCheckpoint) -> None:
@@ -112,15 +186,15 @@ def save_checkpoint(path: str, ckpt: BfsCheckpoint) -> None:
 
 
 def load_checkpoint(path: str) -> BfsCheckpoint:
-    z = np.load(path)
+    z = _load_npz_verified(path)
     if int(z["version"]) != _STATE_VERSION:
         raise ValueError(f"unsupported checkpoint version {int(z['version'])}")
-    if "kind" in z.files and str(z["kind"]) == "packed":
+    if "kind" in z and str(z["kind"]) == "packed":
         raise ValueError(
             f"{path} is a packed-batch checkpoint (use load_packed_checkpoint"
             " / resume it with a multi-source engine)"
         )
-    nonce = int(z["nonce"]) if "nonce" in z.files else -1
+    nonce = int(z["nonce"]) if "nonce" in z else -1
     return BfsCheckpoint(
         source=int(z["source"]),
         level=int(z["level"]),
@@ -188,16 +262,16 @@ def save_packed_checkpoint(path: str, ckpt: PackedCheckpoint) -> None:
 
 
 def load_packed_checkpoint(path: str) -> PackedCheckpoint:
-    z = np.load(path)
+    z = _load_npz_verified(path)
     if int(z["version"]) != _STATE_VERSION:
         raise ValueError(f"unsupported checkpoint version {int(z['version'])}")
-    if "kind" not in z.files or str(z["kind"]) != "packed":
+    if "kind" not in z or str(z["kind"]) != "packed":
         raise ValueError(
             f"{path} is not a packed-batch checkpoint (use load_checkpoint "
             "for single-source state)"
         )
-    iso = z["iso"] if "iso" in z.files else np.empty(0, bool)
-    nonce = int(z["nonce"]) if "nonce" in z.files else -1
+    iso = z["iso"] if "iso" in z else np.empty(0, bool)
+    nonce = int(z["nonce"]) if "nonce" in z else -1
     return PackedCheckpoint(
         sources=z["sources"].astype(np.int64),
         level=int(z["level"]),
@@ -252,11 +326,42 @@ def save_checkpoint_sharded(dirpath: str, ckpt: BfsCheckpoint, num_shards: int) 
         "generation": gen,
         "nonce": ckpt.nonce,  # chain identity (None on old checkpoints)
     }
+    # Clear stale files from an earlier save of this generation FIRST: a
+    # re-shard to fewer shards (elastic restart on a smaller mesh) must
+    # not leave old-level shard_000NN.npz files behind — the fallback
+    # loader derives a generation's shard count from its directory, and
+    # stale extras would make an intact generation look torn. Earlier
+    # quarantines (.corrupt) are cleared too; they documented a failure
+    # this save supersedes.
+    for fname in os.listdir(gen_dir):
+        if not fname.startswith("shard_"):
+            continue
+        if fname.endswith(".npz.corrupt"):
+            stale = True
+        elif fname.endswith(".npz"):
+            try:
+                stale = not 0 <= int(fname[len("shard_"):-len(".npz")]) < num_shards
+            except ValueError:
+                stale = False  # not ours; leave it
+        else:
+            continue
+        if stale:
+            try:
+                os.unlink(os.path.join(gen_dir, fname))
+            except OSError:
+                pass
     for k in range(num_shards):
         sl = slice(k * cpk, min((k + 1) * cpk, v))
         _atomic_savez(
             os.path.join(gen_dir, f"shard_{k:05d}.npz"),
             level=ckpt.level,
+            # Traversal identity rides in every shard (not just meta):
+            # the corruption fallback loads a PREVIOUS generation, whose
+            # meta was overwritten by the newer save — without these a
+            # reused checkpoint dir could silently resume another run's
+            # arrays under this run's source label.
+            source=ckpt.source,
+            nonce=-1 if ckpt.nonce is None else ckpt.nonce,
             frontier=ckpt.frontier[sl],
             visited=ckpt.visited[sl],
             distance=ckpt.distance[sl],
@@ -267,42 +372,133 @@ def save_checkpoint_sharded(dirpath: str, ckpt: BfsCheckpoint, num_shards: int) 
     os.replace(tmp, meta_path)
 
 
-def load_checkpoint_sharded(dirpath: str) -> BfsCheckpoint:
+def _load_sharded_generation(
+    dirpath: str, meta: dict, gen: str | None, *, expect_level: int | None
+) -> BfsCheckpoint:
+    """Assemble one generation's shard set. ``expect_level`` cross-checks
+    each shard against meta (the active generation); None accepts any one
+    consistent level (a fallback generation — meta describes the newer,
+    lost one), returning whatever level its shards agree on."""
+    shard_dir = os.path.join(dirpath, gen) if gen else dirpath
+    num_shards = int(meta["num_shards"])
+    if expect_level is None:
+        # Fallback generation: meta describes the NEWER (lost) save, whose
+        # shard count may differ (re-sharding across mesh sizes is a
+        # documented use) — derive the count from the generation's own
+        # files; the num_vertices cross-check below still rejects a torn
+        # or incomplete set.
+        num_shards = len([
+            f for f in os.listdir(shard_dir)
+            if f.startswith("shard_") and f.endswith(".npz")
+        ])
+        if num_shards == 0:
+            raise FileNotFoundError(f"no shards in {shard_dir}")
+    parts = []
+    level = expect_level
+    source = nonce = None
+    for k in range(num_shards):
+        p = _load_npz_verified(os.path.join(shard_dir, f"shard_{k:05d}.npz"))
+        # Shards written before this field existed load as level-consistent.
+        lvl = int(p["level"]) if "level" in p else int(meta["level"])
+        if level is None:
+            level = lvl
+        if lvl != level:
+            raise ValueError(
+                f"torn sharded checkpoint: shard {k} is from level {lvl} "
+                f"but {'meta.json records' if expect_level is not None else 'its siblings are from'} "
+                f"level {level} — the save was interrupted; re-checkpoint "
+                f"before resuming"
+            )
+        if "source" in p:
+            src = int(p["source"])
+            if source is None:
+                source = src
+            if src != source:
+                raise ValueError(
+                    f"torn sharded checkpoint: shard {k} is from source "
+                    f"{src} but its siblings are from source {source}"
+                )
+            if "nonce" in p:
+                n = int(p["nonce"])
+                nonce = None if n < 0 else n
+        parts.append(p)
+    # Identity comes from the shards themselves when recorded: a fallback
+    # generation may predate the traversal meta.json now describes (a
+    # reused checkpoint dir), and stamping its arrays with the newer
+    # source would silently resume the wrong run. Shards without the
+    # field (pre-integrity saves) fall back to meta.
+    if source is None:
+        source, nonce = int(meta["source"]), meta.get("nonce")
+    elif expect_level is not None and source != int(meta["source"]):
+        raise ValueError(
+            f"sharded checkpoint source mismatch: shards record source "
+            f"{source} but meta.json records {meta['source']}"
+        )
+    ckpt = BfsCheckpoint(
+        source=source,
+        level=int(level),
+        frontier=np.concatenate([p["frontier"] for p in parts]),
+        visited=np.concatenate([p["visited"] for p in parts]),
+        distance=np.concatenate([p["distance"] for p in parts]),
+        nonce=nonce,
+    )
+    if len(ckpt.frontier) != int(meta["num_vertices"]):
+        raise ValueError("shard sizes do not add up to the recorded vertex count")
+    return ckpt
+
+
+def load_checkpoint_sharded(dirpath: str, *, log=None) -> BfsCheckpoint:
     """Re-assemble a sharded checkpoint into one host checkpoint.
 
     The result is shard-count-agnostic: resume it on any mesh whose engine
-    shares the same padded vertex count.
+    shares the same padded vertex count. A corrupt shard in the active
+    generation is quarantined (``.corrupt``) and the load FALLS BACK to
+    the previous generation — the newest intact checkpoint — instead of
+    failing outright or resuming from poisoned state; only when both
+    generations are damaged does the corruption error propagate.
     """
     with open(os.path.join(dirpath, "meta.json")) as f:
         meta = json.load(f)
     if int(meta["version"]) != _STATE_VERSION:
         raise ValueError(f"unsupported checkpoint version {meta['version']}")
     # Generation layout; checkpoints written before it load from the flat dir.
-    shard_dir = os.path.join(dirpath, meta["generation"]) if "generation" in meta else dirpath
-    parts = [
-        np.load(os.path.join(shard_dir, f"shard_{k:05d}.npz"))
-        for k in range(int(meta["num_shards"]))
-    ]
-    for k, p in enumerate(parts):
-        # Shards written before this field existed load as level-consistent.
-        lvl = int(p["level"]) if "level" in p.files else int(meta["level"])
-        if lvl != int(meta["level"]):
-            raise ValueError(
-                f"torn sharded checkpoint: shard {k} is from level {lvl} "
-                f"but meta.json records level {meta['level']} — the save "
-                f"was interrupted; re-checkpoint before resuming"
+    gen = meta.get("generation")
+    try:
+        return _load_sharded_generation(
+            dirpath, meta, gen, expect_level=int(meta["level"])
+        )
+    except (CorruptCheckpointError, FileNotFoundError) as exc:
+        # FileNotFoundError covers a RE-load after a shard was already
+        # quarantined (renamed .corrupt) by an earlier attempt — e.g. a
+        # crash-between-quarantine-and-resume, or a retry loop: the
+        # fallback must still reach the intact generation.
+        prev = {"gen_a": "gen_b", "gen_b": "gen_a"}.get(gen)
+        if prev is None or not os.path.isdir(os.path.join(dirpath, prev)):
+            raise
+        if log is not None:
+            log(f"active generation {gen} is corrupt ({exc}); falling back "
+                f"to the previous generation {prev}")
+        try:
+            back = _load_sharded_generation(
+                dirpath, meta, prev, expect_level=None
             )
-    ckpt = BfsCheckpoint(
-        source=int(meta["source"]),
-        level=int(meta["level"]),
-        frontier=np.concatenate([p["frontier"] for p in parts]),
-        visited=np.concatenate([p["visited"] for p in parts]),
-        distance=np.concatenate([p["distance"] for p in parts]),
-        nonce=meta.get("nonce"),
-    )
-    if len(ckpt.frontier) != int(meta["num_vertices"]):
-        raise ValueError("shard sizes do not add up to the recorded vertex count")
-    return ckpt
+            if back.source != int(meta["source"]):
+                # A reused checkpoint dir: the previous generation is an
+                # intact checkpoint of a DIFFERENT traversal — falling
+                # back to it would resume the wrong run.
+                raise CorruptCheckpointError(
+                    f"fallback generation {prev} records source "
+                    f"{back.source}, not this traversal's "
+                    f"{meta['source']}"
+                )
+            return back
+        except (ValueError, FileNotFoundError) as exc2:
+            # ValueError covers CorruptCheckpointError AND a torn/short
+            # fallback set — either way both generations are unusable.
+            raise CorruptCheckpointError(
+                f"no intact checkpoint generation in {dirpath}: "
+                f"active {gen}: {exc}; fallback {prev}: {exc2}"
+            ) from exc2
 
 
 def save_result(path: str, res) -> None:
@@ -327,7 +523,7 @@ def save_result(path: str, res) -> None:
 def load_result(path: str):
     from tpu_bfs.algorithms.bfs import BfsResult
 
-    z = np.load(path)
+    z = _load_npz_verified(path)
     if int(z["version"]) != _STATE_VERSION:
         raise ValueError(f"unsupported result version {int(z['version'])}")
     parent = z["parent"]

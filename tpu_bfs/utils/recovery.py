@@ -16,6 +16,8 @@ import dataclasses
 import threading
 import time
 
+from tpu_bfs import faults as _faults
+
 
 @dataclasses.dataclass
 class RecoveryCounters:
@@ -32,6 +34,10 @@ class RecoveryCounters:
     engine_rebuilds: int = 0  # advance_with_recovery engine reconstructions
     backend_init_resets: int = 0  # reset_failed_backend_init firings
     oom_degrades: int = 0  # OOM-driven sheds/lane-halvings (bench + serve)
+    watchdog_trips: int = 0  # serve dispatch-watchdog deadline firings
+    breaker_opens: int = 0  # serve circuit-breaker open transitions
+    requeue_sheds: int = 0  # queries shed at the serve requeue budget
+    faults_injected: int = 0  # tpu_bfs/faults.py injections (chaos only)
 
     _lock: threading.Lock = dataclasses.field(
         default_factory=threading.Lock, repr=False, compare=False
@@ -206,6 +212,12 @@ def advance_with_recovery(
             room = max_level - ckpt.level
             levels = room if levels is None else min(levels, room)
         try:
+            if _faults.ACTIVE is not None:
+                # Chaos-harness injection site: a transient raised here is
+                # handled by exactly the rebuild-and-resume path below —
+                # the mechanism the ad-hoc per-test monkeypatches used to
+                # approximate (tpu_bfs/faults.py).
+                _faults.ACTIVE.hit("advance", level=ckpt.level)
             nxt = engine.advance(ckpt, levels=levels)
         except Exception as exc:  # noqa: BLE001 — gated by the classifier
             if restarts >= max_restarts or not is_transient_failure(exc):

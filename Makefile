@@ -1,5 +1,5 @@
 # Convenience targets; everything also works without make (README).
-.PHONY: test native bench serve-smoke chaos-smoke wheel clean
+.PHONY: test native bench wirecheck serve-smoke chaos-smoke wheel clean
 
 # Full suite on 8 virtual CPU devices (tests/conftest.py forces the
 # platform; the axon TPU plugin is bypassed).
@@ -16,12 +16,24 @@ native:
 bench:
 	python bench.py
 
+# Byte-model vs compiled-HLO audit (fast, CPU-only, 8 virtual devices):
+# every wire-byte formula the framework prints is re-derived from the
+# compiled program's own collective shapes — including the ISSUE 5
+# packed-exchange proof (uint32 words = 1/8 the ring bytes, 1/32 the
+# allreduce operand, zero extra collectives) and the pack/unpack
+# property tests. A model regression fails HERE, before a chip session
+# ever spends hardware time on it; hence it is also a prerequisite of
+# the smoke targets.
+wirecheck:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_wirecheck.py \
+	  tests/test_collectives_pack.py -q -p no:cacheprovider
+
 # Round-trip 4 queries through the JSONL serving frontend on CPU
 # (tpu_bfs/serve; README "Serving mode") over a 2-width ladder, so the
 # adaptive routing + pipelined extraction path runs in CI, not just on
 # chip; checks the distance payloads decode and that a
 # want_distances=false request answers metadata-only.
-serve-smoke:
+serve-smoke: wirecheck
 	printf '{"id":1,"source":0}\n{"id":2,"source":3}\n{"id":3,"source":5}\n{"id":4,"source":5,"want_distances":false}\n' | \
 	env JAX_PLATFORMS=cpu python -m tpu_bfs.serve random:n=96,m=480,seed=3 \
 	  --lanes 64 --ladder 32,64 --linger-ms 1 --statsz-every 0 | \
@@ -43,7 +55,7 @@ serve-smoke:
 # corrupted checkpoint save must quarantine + fall back on load. The
 # pytest `chaos` marker runs the same machinery in-process
 # (tests/test_chaos.py, tests/test_faults.py).
-chaos-smoke:
+chaos-smoke: wirecheck
 	env JAX_PLATFORMS=cpu python scripts/chaos_smoke.py
 
 wheel:

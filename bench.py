@@ -10,8 +10,15 @@ batch time / N — the metric label says so explicitly.
 
 Env overrides: TPU_BFS_BENCH_SCALE (default 21), TPU_BFS_BENCH_EF (16),
 TPU_BFS_BENCH_MODE (hybrid|wide|msbfs|single|single-dopt|single-tiled|
-serve|lj-hybrid|lj-single-dopt — the lj-* modes bench the LiveJournal-shaped
-stand-in, NONETWORK.md; 'serve' is the closed-loop serve-throughput stage
+dist|serve|lj-hybrid|lj-single-dopt — the lj-* modes bench the
+LiveJournal-shaped stand-in, NONETWORK.md; 'dist' is the 1D distributed
+single-source stage over the attached mesh, the ISSUE 5 wire-format A/B
+with knobs TPU_BFS_BENCH_DIST_DEVICES (all attached) /
+TPU_BFS_BENCH_DIST_EXCHANGE (ring|allreduce|sparse, default ring) /
+TPU_BFS_BENCH_WIRE_PACK ("1" bit-packs the exchange to uint32 words —
+default OFF until chip-measured, like the pull gate), emitting
+wire_bytes_per_level / wire_level_counts / wire_bytes_total;
+'serve' is the closed-loop serve-throughput stage
 over tpu_bfs/serve, emitting serve_qps/serve_p99_ms/fill_ratio/
 serve_routing/serve_extract_p50_ms with knobs TPU_BFS_BENCH_SERVE_CLIENTS
 (64) / TPU_BFS_BENCH_SERVE_QUERIES (8 per client) /
@@ -521,18 +528,33 @@ def _env_adaptive():
     return (r, d)
 
 
+def _env_bool(name: str, what: str, off_word: str) -> bool:
+    """Opt-in boolean knob: unset/falsy -> False, logged when enabled,
+    malformed values logged and treated as off (a chip session must never
+    die on a typo'd env var — it just runs the default arm)."""
+    raw = os.environ.get(name, "").strip().lower()
+    on = raw in ("1", "on", "yes", "true")
+    if on:
+        log(f"{what} enabled ({name})")
+    elif raw and raw not in ("0", "off", "no", "false"):
+        log(f"{name}={raw!r} not a boolean; {off_word} off")
+    return on
+
+
 def _env_pull_gate() -> bool:
     """TPU_BFS_BENCH_PULL_GATE -> bool (default off, matching the engines'
     default until the gate is chip-measured). When on, the adaptive-push
     default is forced off with a log line — the engines reject the
     combination (ISSUE 1: measure the gate against the plain scan)."""
-    raw = os.environ.get("TPU_BFS_BENCH_PULL_GATE", "").strip().lower()
-    on = raw in ("1", "on", "yes", "true")
-    if on:
-        log("pull gate enabled (TPU_BFS_BENCH_PULL_GATE)")
-    elif raw and raw not in ("0", "off", "no", "false"):
-        log(f"TPU_BFS_BENCH_PULL_GATE={raw!r} not a boolean; gate off")
-    return on
+    return _env_bool("TPU_BFS_BENCH_PULL_GATE", "pull gate", "gate")
+
+
+def _env_wire_pack() -> bool:
+    """TPU_BFS_BENCH_WIRE_PACK -> bool (default off until chip-measured,
+    like the pull gate — ISSUE 5). Applies to the dist mode's exchange;
+    packed runs are bit-identical to plain (fuzz-pinned), so the A/B pair
+    isolates the wire-format win."""
+    return _env_bool("TPU_BFS_BENCH_WIRE_PACK", "wire pack", "pack")
 
 
 def _is_oom(exc: BaseException) -> bool:
@@ -1091,6 +1113,84 @@ def bench_single(g, scale: int, ef: int, backend: str = "scan",
     }
 
 
+def bench_dist(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
+    """Multi-device 1D-partition single-source BFS (TPU_BFS_BENCH_MODE=
+    dist) — the wire-format A/B stage (ISSUE 5). Knobs:
+    TPU_BFS_BENCH_DIST_DEVICES (device count, default all attached),
+    TPU_BFS_BENCH_DIST_EXCHANGE (ring|allreduce|sparse, default ring),
+    TPU_BFS_BENCH_WIRE_PACK (uint32 word packing, default OFF until
+    chip-measured — like the pull gate), TPU_BFS_BENCH_SOURCES (8).
+
+    The verdict carries the modeled per-level exchange price list
+    (``wire_bytes_per_level``, one entry per exchange branch — ascending
+    sparse caps then dense), the exact per-branch level counts summed over
+    the timed sources (``wire_level_counts``) and the total modeled bytes
+    one chip moved (``wire_bytes_total``) — the keys BENCHMARKS.md's
+    "Exchange bytes" table is fed from, and the figures
+    utils/wirecheck.check_packed_exchange pins to the compiled HLO. On a
+    1-device attachment the exchange moves nothing and the wire keys are
+    zero (the A/B then only measures pack/unpack compute overhead)."""
+    from tpu_bfs.parallel.dist_bfs import DistBfsEngine, make_mesh
+
+    n_sources = int(os.environ.get("TPU_BFS_BENCH_SOURCES", "8"))
+    exchange = os.environ.get("TPU_BFS_BENCH_DIST_EXCHANGE", "ring")
+    ndev_raw = os.environ.get("TPU_BFS_BENCH_DIST_DEVICES", "").strip()
+    ndev = int(ndev_raw) if ndev_raw else None
+    wire_pack = _env_wire_pack()
+    do_validate = os.environ.get("TPU_BFS_BENCH_VALIDATE", "1") == "1"
+
+    t0 = time.perf_counter()
+    engine = retry_transient(
+        DistBfsEngine, g, make_mesh(ndev), exchange=exchange,
+        wire_pack=wire_pack, label="dist engine build",
+    )
+    per_level = [float(x) for x in engine.wire_bytes_per_level()]
+    log(f"dist engine build {time.perf_counter()-t0:.1f}s: P={engine.p} "
+        f"vloc={engine.part.vloc} exchange={exchange} "
+        f"wire_pack={'on' if wire_pack else 'off'} bytes/level={per_level}")
+    rng = np.random.default_rng(7)
+    candidates = np.flatnonzero(g.degrees > 0)
+    sources = rng.choice(candidates, size=n_sources, replace=False)
+    warm = retry_transient(engine.run, int(sources[0]), with_parents=False,
+                           label="dist warm-up")
+    if do_validate:
+        from tpu_bfs import validate
+        from tpu_bfs.reference import bfs_scipy
+
+        validate.check_distances(warm.distance, bfs_scipy(g, int(sources[0])))
+        log(f"validated src={int(sources[0])}")
+    teps = []
+    counts = np.zeros(len(per_level), dtype=np.int64)
+    total_bytes = 0.0
+    for s in sources:
+        res = retry_transient(engine.run, int(s), with_parents=False,
+                              time_it=True, label=f"dist src={int(s)}")
+        teps.append(res.teps)
+        counts = counts + np.asarray(engine.last_exchange_level_counts)
+        total_bytes += float(engine.last_exchange_bytes)
+        log(f"src={int(s)} t={res.elapsed_s*1e3:.2f}ms levels="
+            f"{res.num_levels} GTEPS={res.teps/1e9:.3f} "
+            f"wire={engine.last_exchange_bytes:.0f}B")
+    gteps = len(teps) / sum(1.0 / t for t in teps) / 1e9
+    return {
+        "metric": (
+            f"BFS harmonic-mean GTEPS (1D distributed, P={engine.p}, "
+            f"{exchange} exchange, wire-pack "
+            f"{'on' if wire_pack else 'off'}), "
+            f"{graph_desc or f'RMAT scale-{scale} ef={ef}'}"
+        ),
+        "value": round(gteps, 4),
+        "unit": "GTEPS",
+        "vs_baseline": None,
+        "wire_pack": wire_pack,
+        "wire_exchange": exchange,
+        "wire_devices": engine.p,
+        "wire_bytes_per_level": per_level,
+        "wire_level_counts": [int(x) for x in counts],
+        "wire_bytes_total": total_bytes,
+    }
+
+
 def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
     """Closed-loop serve-throughput stage (TPU_BFS_BENCH_MODE=serve):
     N client threads (TPU_BFS_BENCH_SERVE_CLIENTS, default 64) drive the
@@ -1298,6 +1398,7 @@ def main() -> int:
             "single": bench_single,
             "single-dopt": partial(bench_single, backend="dopt"),
             "single-tiled": partial(bench_single, backend="tiled"),
+            "dist": bench_dist,
             "serve": bench_serve,
             "lj-hybrid": partial(bench_hybrid, graph_desc=lj_desc),
             "lj-single-dopt": partial(bench_single, backend="dopt", graph_desc=lj_desc),

@@ -136,6 +136,20 @@ for i in $(seq 1 "$attempts"); do
       TPU_BFS_BENCH_MODE=serve TPU_BFS_BENCH_SCALE=20 \
       TPU_BFS_BENCH_FAULTS="seed=7:transient@serve_batch:n=2,slow_extract:ms=50:n=4" \
       TPU_BFS_BENCH_SERVE_WATCHDOG_MS=600000
+    # Wire-format A/B (ISSUE 5): the 1D distributed exchange bit-packed
+    # (TPU_BFS_BENCH_WIRE_PACK=1: uint32 words, 1 bit/vertex on the wire
+    # — wirecheck-proven 1/8 the ring bytes) vs plain (pred ring) at
+    # scale 20 — packing defaults OFF until chip-measured, like the pull
+    # gate, so the plain arm is today's behavior. Each JSON line carries
+    # wire_bytes_per_level / wire_level_counts / wire_bytes_total for the
+    # BENCHMARKS.md "Exchange bytes" table. On a 1-chip attachment the
+    # pair still lands (wire keys zero; the A/B then only prices the
+    # pack/unpack compute).
+    stage "dist-plain-s20" "$out/dist_plain_s20.json" \
+      TPU_BFS_BENCH_MODE=dist TPU_BFS_BENCH_SCALE=20
+    stage "dist-packed-s20" "$out/dist_packed_s20.json" \
+      TPU_BFS_BENCH_MODE=dist TPU_BFS_BENCH_SCALE=20 \
+      TPU_BFS_BENCH_WIRE_PACK=1
     # The probe's completion-marker line satisfies got_value, so pstage
     # gives it the same idempotent restart + timeout envelope as the
     # other helper scripts.

@@ -207,3 +207,19 @@ def test_console_entry_points_resolve():
             p.default is not inspect.Parameter.empty
             for p in sig.parameters.values()
         ), target  # callable with zero args
+
+
+def test_cli_wire_pack_distributed(capsys):
+    # --wire-pack reaches the 1D and 2D engines and results still
+    # validate (packing is wire encoding only — ISSUE 5).
+    rc = cli.main(["1", "random:n=250,m=1000,seed=8", "--devices", "4",
+                   "--wire-pack"])
+    assert rc == 0
+    assert "Output OK" in capsys.readouterr().out
+
+
+def test_cli_rejects_wire_pack_single_chip():
+    # A single chip moves nothing over the wire; packing there is a
+    # config error, not a silent no-op.
+    with pytest.raises(SystemExit):
+        cli.main(["0", "random:n=100,m=300,seed=1", "--wire-pack"])

@@ -1,0 +1,161 @@
+"""Bit-packed wire format primitives (ISSUE 5): pack_bits/unpack_bits
+round-trip properties — including lengths not divisible by 32, where the
+tail word's padding bits must be ZERO so cross-chip word OR combines
+exactly as the bools would — and packed-vs-unpacked bit-identity of the
+whole ``reduce_scatter_or`` exchange on random masks for p in {1, 2, 4}.
+
+These are the unit-level guarantees under the compiled-HLO byte proof in
+tests/test_wirecheck.py::test_packed_exchange_proof: the wirecheck pins
+what the packed program MOVES, these pin what it MEANS.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpu_bfs.parallel.collectives import (
+    default_sparse_caps,
+    pack_bits,
+    packed_words,
+    reduce_scatter_or,
+    sparse_exchange_or,
+    unpack_bits,
+)
+from tpu_bfs.parallel.compat import shard_map
+from tpu_bfs.parallel.dist_bfs import make_mesh
+
+# Lengths straddling word boundaries: 1 (single bit), 31/33 (one off a
+# boundary), 32/64 (exact), 50/100 (mid-word tails), 1024 (the aligned
+# vloc the engines actually ship).
+LENGTHS = (1, 31, 32, 33, 50, 64, 100, 1024)
+
+
+@pytest.mark.parametrize("n", LENGTHS)
+def test_pack_roundtrip(n):
+    rng = np.random.default_rng(n)
+    for density in (0.0, 0.1, 0.5, 1.0):
+        m = rng.random(n) < density
+        w = np.asarray(pack_bits(jnp.asarray(m)))
+        assert w.shape == (packed_words(n),)
+        assert w.dtype == np.uint32
+        np.testing.assert_array_equal(
+            np.asarray(unpack_bits(jnp.asarray(w), n)), m
+        )
+
+
+def test_pack_roundtrip_batched_axes():
+    # Only the LAST axis packs; leading axes (lanes, destination chunks)
+    # pass through — the [p, n] per-chunk layout the exchange uses.
+    rng = np.random.default_rng(5)
+    m = rng.random((3, 4, 50)) < 0.4
+    w = np.asarray(pack_bits(jnp.asarray(m)))
+    assert w.shape == (3, 4, packed_words(50))
+    np.testing.assert_array_equal(np.asarray(unpack_bits(jnp.asarray(w), 50)), m)
+
+
+def test_pack_bit_layout():
+    # Vertex 32*j + i lands in bit i of word j — the layout the docstring
+    # promises, pinned so a refactor cannot silently flip endianness and
+    # still pass the round-trip tests.
+    n = 70
+    for v in (0, 1, 31, 32, 63, 69):
+        m = np.zeros(n, bool)
+        m[v] = True
+        w = np.asarray(pack_bits(jnp.asarray(m)))
+        assert w[v // 32] == np.uint32(1) << (v % 32)
+        assert (np.delete(w, v // 32) == 0).all()
+
+
+@pytest.mark.parametrize("n", [31, 33, 50, 100])
+def test_tail_padding_is_zero(n):
+    """The tail word's padding bits must be 0 — the OR identity — even for
+    the all-ones mask: packed buffers from different chips then combine
+    with word OR exactly as the bools would (no tail mask on unpack)."""
+    w = np.asarray(pack_bits(jnp.ones(n, bool)))
+    tail_bits = n % 32
+    assert w[-1] == (np.uint32(1) << tail_bits) - 1  # high bits clear
+    assert (w[:-1] == np.uint32(0xFFFFFFFF)).all()
+    # And word OR == mask OR through a full pack/combine/unpack cycle.
+    rng = np.random.default_rng(n)
+    a, b = (rng.random(n) < 0.5 for _ in range(2))
+    combined = np.asarray(
+        unpack_bits(pack_bits(jnp.asarray(a)) | pack_bits(jnp.asarray(b)), n)
+    )
+    np.testing.assert_array_equal(combined, a | b)
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def _exchange_fn(p, impl, wire_pack, caps):
+    """One jitted exchange per config — reused across the random masks so
+    the sweep pays each compile once."""
+    mesh = make_mesh(p)
+
+    def local(x):
+        if caps is not None:
+            hit, _ = sparse_exchange_or(
+                x[0], "v", p, caps=caps, wire_pack=wire_pack
+            )
+            return hit
+        return reduce_scatter_or(x[0], "v", p, impl=impl, wire_pack=wire_pack)
+
+    return jax.jit(
+        shard_map(
+            local, mesh=mesh, in_specs=(P("v", None),), out_specs=P("v"),
+            check_vma=False,
+        )
+    )
+
+
+def _exchange(p, n, mask_pp, impl, wire_pack, caps=None):
+    """Run one exchange over a p-device mesh: ``mask_pp`` is the [p, p*n]
+    per-chip full-size contribution (row i = chip i's buffer), the return
+    the [p*n] owner-ordered OR — what the engines' level loop sees."""
+    fn = _exchange_fn(p, impl, wire_pack, caps)
+    return np.asarray(fn(jnp.asarray(mask_pp)))
+
+
+# n=50 keeps a live tail word in every packed chunk; n=64 is the aligned
+# control. p=1 pins the degenerate no-wire case.
+@pytest.mark.parametrize("p", [1, 2, 4])
+@pytest.mark.parametrize("impl", ["ring", "allreduce"])
+def test_packed_reduce_scatter_bit_identity(p, impl):
+    rng = np.random.default_rng(p * 100 + len(impl))
+    for n in (50, 64):
+        for density in (0.05, 0.7):
+            mask = rng.random((p, p * n)) < density
+            plain = _exchange(p, n, mask, impl, wire_pack=False)
+            packed = _exchange(p, n, mask, impl, wire_pack=True)
+            np.testing.assert_array_equal(packed, plain)
+            np.testing.assert_array_equal(plain, mask.any(axis=0))
+
+
+@pytest.mark.parametrize("p", [2, 4])
+def test_packed_sparse_dense_fallback_bit_identity(p):
+    # Caps of 1 force the dense fallback on any non-trivial mask, so this
+    # exercises sparse_exchange_or's PACKED phase-2b specifically.
+    rng = np.random.default_rng(p)
+    n = 50
+    mask = rng.random((p, p * n)) < 0.5
+    plain = _exchange(p, n, mask, "ring", wire_pack=False, caps=(1,))
+    packed = _exchange(p, n, mask, "ring", wire_pack=True, caps=(1,))
+    np.testing.assert_array_equal(packed, plain)
+    np.testing.assert_array_equal(plain, mask.any(axis=0))
+
+
+def test_default_caps_recalibrated_for_packing():
+    """The cap ladder prices ids against the dense fallback it competes
+    with: packed dense costs 1/8 the bytes, so the packed rungs must sit
+    8x lower (ids only win below vloc/32 entries — vloc/8 packed-dense
+    bytes / 4 bytes per id — and the wide rung keeps the same ~2x
+    undercut of its dense cost as the unpacked ladder)."""
+    vloc = 1 << 16
+    plain = default_sparse_caps(vloc)
+    packed = default_sparse_caps(vloc, wire_pack=True)
+    assert max(packed) == max(plain) // 8
+    assert max(packed) <= vloc // 32
+    assert min(packed) >= 16

@@ -125,3 +125,21 @@ def test_byte_model_covers_attributed_phases(small_graph, adaptive_engine):
         assert b["dense"] > 0
     # push bytes scale with the active-row count
     assert phase_bytes(adaptive_engine, nz_rows=20)["push"] > b["push"]
+
+
+def test_distributed_ms_exchange_entry(small_graph):
+    # Distributed MS engines get a per-level WIRE-bytes 'exchange' entry
+    # (the dense slab-gather ceiling), priced by the SAME
+    # collectives.dense_rows_wire_bytes the engines' exchange accounting
+    # uses — one formula, never two copies to drift apart.
+    from tpu_bfs.parallel.collectives import dense_rows_wire_bytes
+    from tpu_bfs.parallel.dist_bfs import make_mesh
+    from tpu_bfs.parallel.dist_msbfs_wide import DistWideMsBfsEngine
+
+    eng = DistWideMsBfsEngine(small_graph, make_mesh(4), lanes=64)
+    pb = phase_bytes(eng)
+    assert set(pb) == {"exchange"}  # no hg: HBM phases are not re-derived
+    assert pb["exchange"] == dense_rows_wire_bytes(
+        eng._gather_p, eng._gather_rows_loc, eng.w
+    )
+    assert pb["exchange"] > 0

@@ -96,6 +96,11 @@ def _make_ms_engine(args, g, n_sources: int):
     lanes_kw = {} if args.lanes is None else {"lanes": args.lanes}
     if args.pull_gate:
         lanes_kw["pull_gate"] = True
+    if args.devices > 1 and args.wire_pack:
+        # The packed MS engines' wire format is already one bit per
+        # (vertex, lane); the flag is accepted for knob uniformity and
+        # recorded (a validated no-op — see the engines' docstrings).
+        lanes_kw["wire_pack"] = True
     if args.devices > 1:
         if engine == "packed":
             raise SystemExit(
@@ -411,6 +416,17 @@ def main(argv=None) -> int:
                     "distributed 4096; wider rows trade proportionally "
                     "more HBM for more concurrent sources. NB on TPU, "
                     "widths below 4096 pad to the same physical tables)")
+    ap.add_argument("--wire-pack", action="store_true",
+                    help="bit-pack the boolean frontier exchanges to uint32 "
+                    "words, 32 vertices/word (experimental, default off "
+                    "until chip-measured): 1D --devices ring/allreduce/"
+                    "sparse-fallback and both 2D --mesh collectives ship "
+                    "1 bit per vertex instead of 1-4 bytes, bit-identical "
+                    "results (utils/wirecheck.check_packed_exchange proves "
+                    "the byte ratios from the compiled HLO). The "
+                    "--multi-source packed engines already exchange "
+                    "bit-packed lane words; there the flag is a recorded "
+                    "no-op")
     ap.add_argument("--pull-gate", action="store_true",
                     help="frontier-aware pull expansion (experimental, "
                     "default off): settled rows' bucket blocks, state "
@@ -476,6 +492,9 @@ def main(argv=None) -> int:
                  "backends have no tile pass to gate)")
     if (args.mesh or args.devices > 1) and args.backend in ("delta", "tiled"):
         ap.error(f"--backend {args.backend} is single-device only")
+    if args.wire_pack and args.devices == 1 and not args.mesh:
+        ap.error("--wire-pack packs multi-device exchanges; add --devices N "
+                 "or --mesh RxC (a single chip moves nothing over the wire)")
     if args.mesh and args.exchange == "sparse":
         ap.error("--exchange sparse pairs with 1D --devices meshes; the 2D "
                  "engine's row/column collectives already move O(vp/dim) bits")
@@ -550,14 +569,14 @@ def main(argv=None) -> int:
                 ap.error(f"--mesh must look like RxC (e.g. 2x4), got {args.mesh!r}")
             return Dist2DBfsEngine(
                 g, make_mesh_2d(r, c), exchange=args.exchange,
-                backend=args.backend,
+                backend=args.backend, wire_pack=args.wire_pack,
             )
         if args.devices > 1:
             from tpu_bfs.parallel.dist_bfs import DistBfsEngine, make_mesh
 
             return DistBfsEngine(
                 g, make_mesh(args.devices), exchange=args.exchange,
-                backend=args.backend,
+                backend=args.backend, wire_pack=args.wire_pack,
             )
         if args.backend == "tiled":
             from tpu_bfs.algorithms.bfs_tiled import TiledBfsEngine

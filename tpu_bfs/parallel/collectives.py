@@ -19,11 +19,25 @@ Two implementations, selectable and cross-checked in tests:
   TPU form of the reference's per-destination frontier buckets. Moves only
   actual frontier ids when every bucket fits a static cap; falls back to
   the dense ring bitmap level-by-level otherwise.
+
+Wire format (ISSUE 5): every boolean exchange additionally has a
+``wire_pack`` form that ships uint32 words, 32 vertices per word
+(:func:`pack_bits` / :func:`unpack_bits`), instead of the unpacked
+dtypes — pred chunks on the ring (ONE byte per vertex per hop) and s32
+on the allreduce path (FOUR bytes per vertex). Packing is pure compute:
+the packed programs emit the same collective instruction count as the
+unpacked ones, moving 1/8 (ring) and 1/32 (allreduce operand) the bytes
+— proven from the compiled HLO by utils/wirecheck.check_packed_exchange.
+The sparse exchange's per-level sparse-ids/dense decision (the Buluç &
+Madduri format flip, arXiv:1104.4518) is the shared
+:func:`cap_ladder_select`; under ``wire_pack`` its dense fallback is the
+packed ring and the cap ladder is recalibrated against the packed dense
+cost (``default_sparse_caps``).
 """
 
 from __future__ import annotations
 
-from functools import partial
+from functools import partial, reduce as _reduce
 
 import jax.numpy as jnp
 import numpy as np
@@ -32,6 +46,72 @@ from jax import lax
 
 def _chunk(x_full, c, size):
     return lax.dynamic_slice_in_dim(x_full, c * size, size)
+
+
+def packed_words(n: int) -> int:
+    """uint32 words needed to carry ``n`` booleans (32 vertices/word)."""
+    return -(-n // 32)
+
+
+def pack_bits(x):
+    """Pack a boolean array's LAST axis into uint32 words, 32 vertices per
+    word (vertex ``32*j + i`` -> bit ``i`` of word ``j``).
+
+    Tail semantics: when the axis length ``n`` is not a multiple of 32 the
+    final word's top ``32*ceil(n/32) - n`` bits are ZERO — the identity of
+    bitwise_or — so packed buffers from different chips combine with word
+    OR exactly as the bools would, and ``unpack_bits(.., n)`` recovers the
+    mask without a tail mask. The padded bits are disjoint per word, so
+    the packing sum cannot carry."""
+    n = x.shape[-1]
+    pad = packed_words(n) * 32 - n
+    xb = x.astype(jnp.uint32)
+    if pad:
+        xb = jnp.concatenate(
+            [xb, jnp.zeros(x.shape[:-1] + (pad,), jnp.uint32)], axis=-1
+        )
+    xb = xb.reshape(x.shape[:-1] + (packed_words(n), 32))
+    return jnp.sum(
+        xb << jnp.arange(32, dtype=jnp.uint32), axis=-1, dtype=jnp.uint32
+    )
+
+
+def unpack_bits(words, n: int):
+    """Inverse of :func:`pack_bits`: the last axis of uint32 words back to
+    ``n`` booleans (tail-padding bits are dropped)."""
+    nw = words.shape[-1]
+    bits = (words[..., None] >> jnp.arange(32, dtype=jnp.uint32)) & jnp.uint32(1)
+    return bits.reshape(words.shape[:-1] + (nw * 32,))[..., :n] != 0
+
+
+def _packed_reduce_scatter_or(x_full, axis_name: str, num_devices: int, impl: str):
+    """Bit-packed OR-reduce-scatter: the uint32 wire format of both dense
+    exchange impls.
+
+    ``ring``: pack each destination chunk to ``ceil(n/32)`` words and run
+    the same P-1-hop ring with ``bitwise_or`` as the word combine — 1/8
+    the bytes of the pred ring, hop for hop. ``allreduce``: `lax.psum`
+    cannot OR (word sums carry across bit positions), and max on words is
+    not OR either — but the allreduce path only ever kept its own chunk of
+    the psum, i.e. it IS a reduce-scatter; so the packed form is ONE
+    `all_to_all` of the per-destination word chunks plus a local OR fold.
+    Same collective instruction count (one), 1/32 the collective operand
+    bytes of the s32 psum — and it sheds the psum's all-gather half on
+    top, so the modeled wire bytes equal the packed ring's
+    (``dense_or_wire_bytes``)."""
+    p = num_devices
+    if p == 1:
+        return x_full
+    n = x_full.shape[0] // p
+    words = pack_bits(x_full.reshape(p, n))  # [p, nw], per-chunk packed
+    if impl == "ring":
+        out = ring_reduce_scatter(
+            words.reshape(-1), axis_name, p, jnp.bitwise_or
+        )
+    else:
+        recv = lax.all_to_all(words, axis_name, 0, 0, tiled=True)  # [p, nw]
+        out = _reduce(jnp.bitwise_or, [recv[j] for j in range(p)])
+    return unpack_bits(out, n)
 
 
 def ring_reduce_scatter(x_full, axis_name: str, num_devices: int, op):
@@ -68,9 +148,20 @@ def _check_impl(impl: str) -> None:
         )
 
 
-def reduce_scatter_or(x_full, axis_name: str, num_devices: int, *, impl: str = "ring"):
-    """OR-reduce-scatter of a boolean contribution buffer (frontier exchange)."""
+def reduce_scatter_or(
+    x_full, axis_name: str, num_devices: int, *, impl: str = "ring",
+    wire_pack: bool = False,
+):
+    """OR-reduce-scatter of a boolean contribution buffer (frontier exchange).
+
+    Wire dtypes, pinned to the compiled HLO by tests/test_wirecheck.py:
+    ``ring`` ships each chunk as PRED — one byte per vertex per hop;
+    ``allreduce`` ships the whole buffer as S32 — four bytes per vertex.
+    ``wire_pack=True`` ships uint32 words instead, 32 vertices per word
+    (see :func:`_packed_reduce_scatter_or`)."""
     _check_impl(impl)
+    if wire_pack:
+        return _packed_reduce_scatter_or(x_full, axis_name, num_devices, impl)
     if impl == "ring":
         return ring_reduce_scatter(x_full, axis_name, num_devices, jnp.logical_or)
     n = x_full.shape[0] // num_devices
@@ -89,36 +180,88 @@ def reduce_scatter_min(x_full, axis_name: str, num_devices: int, *, impl: str = 
     return _chunk(m, lax.axis_index(axis_name), n)
 
 
-def dense_or_wire_bytes(p: int, n: int, impl: str) -> float:
+def dense_or_wire_bytes(
+    p: int, n: int, impl: str, *, wire_pack: bool = False
+) -> float:
     """Off-chip bytes one chip moves per level for the dense bitmap exchange.
 
-    ``ring`` sends P-1 chunks of n bools (1 byte each on the wire);
-    ``allreduce`` psums an int32 [P*n] buffer — bandwidth-optimal allreduce
-    moves 2*(P-1)*n int32 per chip."""
+    Dtypes per branch (each pinned to the compiled program by
+    tests/test_wirecheck.py::test_packed_exchange_proof): ``ring`` sends
+    P-1 chunks of n PRED elements — one BYTE per vertex per hop, not one
+    bit; ``allreduce`` psums an S32 [P*n] buffer — four bytes per vertex,
+    2*(P-1)*n int32 per chip at bandwidth-optimal allreduce cost. With
+    ``wire_pack`` both impls ship uint32 words, ceil(n/32) per chunk: the
+    ring as P-1 word-chunk hops, the allreduce path as one all_to_all
+    that keeps the self chunk local — (P-1)*4*ceil(n/32) either way.
+
+    The per-level termination psum (4 B scalar) is outside this model's
+    scope by convention (see utils/wirecheck.py); only the SPARSE models
+    carry a flat +4, for the phase-1 pmax scalar that exists only on that
+    path."""
     if p == 1:
         return 0.0
+    if wire_pack:
+        return float((p - 1) * 4 * packed_words(n))
     return float(2 * (p - 1) * n * 4 if impl == "allreduce" else (p - 1) * n)
 
 
-def dense_2d_wire_bytes(rows: int, cols: int, w: int, impl: str) -> float:
+def dense_2d_wire_bytes(
+    rows: int, cols: int, w: int, impl: str, *, wire_pack: bool = False
+) -> float:
     """Off-chip bytes one chip moves per level in the 2D engine's level
     loop: the column all-gather over the 'r' axis (ring: each chip sends
-    its [w] bool slice rows-1 times) plus the row reduce-scatter over 'c'
-    (same shapes as the 1D dense exchange, dense_or_wire_bytes). Modeled,
-    like every wire-byte figure here."""
-    ag = float((rows - 1) * w) if rows > 1 else 0.0
-    return ag + dense_or_wire_bytes(cols, w, impl)
+    its [w] pred slice rows-1 times; packed: its ceil(w/32) uint32 words)
+    plus the row reduce-scatter over 'c' (same shapes as the 1D dense
+    exchange, dense_or_wire_bytes). Modeled, like every wire-byte figure
+    here."""
+    if rows > 1:
+        ag = float((rows - 1) * 4 * packed_words(w)) if wire_pack else float(
+            (rows - 1) * w
+        )
+    else:
+        ag = 0.0
+    return ag + dense_or_wire_bytes(cols, w, impl, wire_pack=wire_pack)
 
 
-def default_sparse_caps(vloc: int) -> tuple[int, ...]:
+def default_sparse_caps(vloc: int, *, wire_pack: bool = False) -> tuple[int, ...]:
     """Two-tier cap ladder: a tight cap for trickle levels (BFS start/tail,
-    high-diameter graphs) and a wide one that still undercuts the bitmap's
-    vloc wire bytes by ~2x (ids cost 4 bytes each)."""
+    high-diameter graphs) and a wide one that still undercuts the dense
+    bitmap's wire bytes by ~2x (ids cost 4 bytes each).
+
+    Against the PACKED dense bitmap (vloc/8 bytes on the wire instead of
+    vloc) the break-even density falls 8x: ids only win below vloc/32
+    entries, so the packed ladder is the unpacked one shifted three
+    octaves down — wide rung vloc/64 (the same ~2x undercut of the packed
+    dense cost), tight rung vloc/512."""
+    if wire_pack:
+        return tuple(sorted({max(16, vloc // 512), max(16, vloc // 64)}))
     return tuple(sorted({max(16, vloc // 64), max(16, vloc // 8)}))
 
 
+def cap_ladder_select(biggest, caps: tuple[int, ...], make_sparse, dense_path):
+    """The level-adaptive exchange selector shared by every queue-style
+    exchange (``sparse_exchange_or``, ``sparse_rows_gather``): one
+    mesh-uniform population scalar (a pmax already paid by phase 1) picks,
+    level by level, the smallest rung of the ascending ``caps`` ladder
+    that covers every chip — or ``dense_path`` when all overflow. This is
+    the Buluç & Madduri sparse-ids/dense-bitmap format flip
+    (arXiv:1104.4518) as one reusable `lax.cond` ladder: the scalar is
+    identical on every chip, so all chips take the same branch and the
+    collectives stay matched. ``make_sparse(cap, idx)`` returns the branch
+    body for one rung; branch index = rung position (ascending) or
+    ``len(caps)`` for dense."""
+    ladder = sorted(caps)
+    step = dense_path
+    for idx in range(len(ladder) - 1, -1, -1):
+        step = partial(
+            lax.cond, biggest <= ladder[idx], make_sparse(ladder[idx], idx), step
+        )
+    return step(None)
+
+
 def sparse_exchange_or(
-    x_full, axis_name: str, num_devices: int, *, caps: tuple[int, ...]
+    x_full, axis_name: str, num_devices: int, *, caps: tuple[int, ...],
+    wire_pack: bool = False,
 ):
     """Two-phase sparse (queue-style) frontier exchange.
 
@@ -141,14 +284,20 @@ def sparse_exchange_or(
       on heavy mid-BFS levels of power-law graphs the bitmap IS the compact
       encoding.
 
-    `lax.cond` executes exactly one branch at runtime (the pmax scalar is
-    mesh-uniform, so every chip takes the same branch and the collectives
-    stay matched). Returns ``(hit [n] bool, branch int32)`` — ``branch`` is
-    the index of the cap that ran (ascending ladder order) or ``len(caps)``
-    for the dense fallback; callers accumulate exact int32 per-branch level
-    counts and convert to wire bytes on the host
+    The per-level branch decision is the shared :func:`cap_ladder_select`
+    (one mesh-uniform pmax scalar, every chip takes the same branch, so
+    the collectives stay matched). Returns ``(hit [n] bool, branch int32)``
+    — ``branch`` is the index of the cap that ran (ascending ladder order)
+    or ``len(caps)`` for the dense fallback; callers accumulate exact
+    int32 per-branch level counts and convert to wire bytes on the host
     (``sparse_wire_bytes_per_level``), so the traffic accounting never
     loses small sparse levels to float rounding.
+
+    ``wire_pack=True`` swaps the dense fallback for the bit-packed ring
+    (uint32 words, 1/8 the bytes); pair it with
+    ``default_sparse_caps(vloc, wire_pack=True)`` so the ladder is
+    calibrated against the packed dense cost (ids only win below vloc/32
+    entries then).
     """
     p = num_devices
     n = x_full.shape[0] // p
@@ -185,15 +334,13 @@ def sparse_exchange_or(
         return sparse_path
 
     def dense_path(_):
-        hit = ring_reduce_scatter(x_full, axis_name, p, jnp.logical_or)
+        if wire_pack:
+            hit = _packed_reduce_scatter_or(x_full, axis_name, p, "ring")
+        else:
+            hit = ring_reduce_scatter(x_full, axis_name, p, jnp.logical_or)
         return hit, jnp.int32(len(ladder))
 
-    step = dense_path
-    for idx in range(len(ladder) - 1, -1, -1):
-        step = partial(
-            lax.cond, biggest <= ladder[idx], make_sparse(ladder[idx], idx), step
-        )
-    return step(None)
+    return cap_ladder_select(biggest, caps, make_sparse, dense_path)
 
 
 def merge_exchange_counts(prev, counts, resumed_level: int):
@@ -285,13 +432,7 @@ def sparse_rows_gather(
     def dense_branch(_):
         return dense_fn(), jnp.int32(len(caps))
 
-    step = dense_branch
-    ladder = sorted(caps)
-    for idx in range(len(ladder) - 1, -1, -1):
-        step = partial(
-            lax.cond, biggest <= ladder[idx], make_sparse(ladder[idx], idx), step
-        )
-    return step(None)
+    return cap_ladder_select(biggest, caps, make_sparse, dense_branch)
 
 
 def default_row_gather_caps(rows_loc: int, w: int) -> tuple[int, ...]:
@@ -303,6 +444,16 @@ def default_row_gather_caps(rows_loc: int, w: int) -> tuple[int, ...]:
     return tuple(sorted({max(1, be // 16), max(1, be // 2)}))
 
 
+def dense_rows_wire_bytes(p: int, rows_loc: int, w: int) -> float:
+    """Off-chip bytes one chip moves per level gathering the full packed
+    [rows_loc, w] u32 slab from every peer — the packed MS engines' dense
+    exchange (and the sliced rotation's per-level total, which moves the
+    same slab in P-1 ring hops). The single source for this figure:
+    exchange accounting, the sparse ladder's dense rung, and
+    roofline.phase_bytes all price from here."""
+    return 0.0 if p == 1 else float((p - 1) * rows_loc * 4 * w)
+
+
 def sparse_rows_wire_bytes_per_level(
     p: int, rows_loc: int, w: int, caps: tuple[int, ...]
 ) -> list[float]:
@@ -311,9 +462,8 @@ def sparse_rows_wire_bytes_per_level(
     pmax scalar. A 1-device mesh moves nothing."""
     if p == 1:
         return [0.0] * (len(caps) + 1)
-    dense = float((p - 1) * rows_loc * 4 * w)
     return [float((p - 1) * c * (4 + 4 * w) + 4) for c in sorted(caps)] + [
-        dense + 4.0
+        dense_rows_wire_bytes(p, rows_loc, w) + 4.0
     ]
 
 
@@ -334,7 +484,7 @@ def record_row_gather_exchange(
     if exchange == "sparse":
         per = sparse_rows_wire_bytes_per_level(p, rows_loc, w, caps)
     else:
-        per = [0.0 if p == 1 else float((p - 1) * rows_loc * 4 * w)]
+        per = [dense_rows_wire_bytes(p, rows_loc, w)]
     return counts, float(np.dot(counts, per))
 
 
@@ -372,13 +522,14 @@ class RowGatherExchangeAccounting:
 
 
 def sparse_wire_bytes_per_level(
-    p: int, n: int, caps: tuple[int, ...]
+    p: int, n: int, caps: tuple[int, ...], *, wire_pack: bool = False
 ) -> list[float]:
     """Host-side off-chip bytes per level for each sparse_exchange_or branch,
-    in branch-index order (ascending caps, then the dense ring fallback).
-    Each branch pays 4 bytes for the phase-1 pmax scalar."""
+    in branch-index order (ascending caps, then the dense ring fallback —
+    the bit-packed ring under ``wire_pack``). Each branch pays 4 bytes for
+    the phase-1 pmax scalar."""
     if p == 1:
         return [0.0] * (len(caps) + 1)
     return [float((p - 1) * c * 4 + 4) for c in sorted(caps)] + [
-        dense_or_wire_bytes(p, n, "ring") + 4.0
+        dense_or_wire_bytes(p, n, "ring", wire_pack=wire_pack) + 4.0
     ]

@@ -74,6 +74,7 @@ def make_mesh(num_devices: int | None = None, devices=None) -> Mesh:
 def _dist_bfs_fn(
     mesh: Mesh, p: int, vloc: int, exchange: str, backend: str,
     sparse_caps: tuple[int, ...], dopt_caps: tuple[int, ...] = (),
+    wire_pack: bool = False,
 ):
     """Build the shard_map'd BFS level loop for a fixed mesh/partition.
 
@@ -88,7 +89,13 @@ def _dist_bfs_fn(
     each chip independently picks the sparse top-down branch when its OWN
     frontier's local out-degree sum fits a ``dopt_caps`` rung (the branch
     is collective-free, so per-chip divergence is safe — exchange and
-    termination collectives sit outside the `lax.cond`)."""
+    termination collectives sit outside the `lax.cond`).
+
+    ``wire_pack=True`` ships every boolean exchange bit-packed (uint32
+    words, 32 vertices/word — collectives.pack_bits): the dense ring/
+    allreduce paths and the sparse exchange's dense fallback; the sparse
+    id rungs already move 4-byte ids. Same collective count, 1/8-1/32 the
+    bytes (wirecheck.check_packed_exchange proves it from the HLO)."""
     nb = len(sparse_caps) + 1 if exchange == "sparse" else 1
     dopt = backend == "dopt"
 
@@ -130,9 +137,13 @@ def _dist_bfs_fn(
             frontier, visited, dist, level, _, branch_counts = state
             contrib = expand_local(frontier)
             if exchange == "sparse":
-                hit, branch = sparse_exchange_or(contrib, "v", p, caps=sparse_caps)
+                hit, branch = sparse_exchange_or(
+                    contrib, "v", p, caps=sparse_caps, wire_pack=wire_pack
+                )
             else:
-                hit = reduce_scatter_or(contrib, "v", p, impl=exchange)
+                hit = reduce_scatter_or(
+                    contrib, "v", p, impl=exchange, wire_pack=wire_pack
+                )
                 branch = jnp.int32(0)
             branch_counts = branch_counts + (
                 jnp.arange(nb, dtype=jnp.int32) == branch
@@ -293,6 +304,7 @@ class DistBfsEngine(VertexCheckpointMixin):
         backend: str = "scan",
         sparse_caps: int | tuple[int, ...] | None = None,
         dopt_caps: tuple[int, ...] | None = None,
+        wire_pack: bool = False,
     ):
         if exchange not in ("ring", "allreduce", "sparse"):
             # Before the partition/device_put work, so a typo fails instantly.
@@ -300,6 +312,11 @@ class DistBfsEngine(VertexCheckpointMixin):
                 f"unknown exchange {exchange!r}; have 'ring', 'allreduce', 'sparse'"
             )
         self._exchange = exchange
+        #: bit-packed wire format (ISSUE 5): boolean exchanges ship uint32
+        #: words, 32 vertices/word; results are bit-identical to unpacked
+        #: (fuzz-pinned), only the wire encoding changes. Default OFF until
+        #: chip-measured, like the pull gate.
+        self.wire_pack = bool(wire_pack)
         self.mesh = mesh if mesh is not None else make_mesh(num_devices)
         self.p = self.mesh.devices.size
         self.graph_meta = (graph.num_input_edges, graph.undirected)
@@ -324,13 +341,16 @@ class DistBfsEngine(VertexCheckpointMixin):
                 dopt_caps = default_dopt_caps(part.ep_chip)
         self.dopt_caps = tuple(sorted(set(dopt_caps))) if dopt_caps else ()
         if sparse_caps is None:
-            sparse_caps = default_sparse_caps(part.vloc)
+            # The ladder calibrates against the dense fallback it competes
+            # with: the packed bitmap costs 1/8, so the packed rungs sit
+            # three octaves lower (collectives.default_sparse_caps).
+            sparse_caps = default_sparse_caps(part.vloc, wire_pack=self.wire_pack)
         elif isinstance(sparse_caps, int):
             sparse_caps = (sparse_caps,)
         self.sparse_caps = tuple(sorted(sparse_caps))
         self._loop = _dist_bfs_fn(
             self.mesh, self.p, part.vloc, exchange, backend, self.sparse_caps,
-            self.dopt_caps,
+            self.dopt_caps, self.wire_pack,
         )
         # Parent merge is a one-shot int32 MIN reduce-scatter — queue-style
         # exchange does not apply; 'sparse' rides the ring there.
@@ -343,17 +363,32 @@ class DistBfsEngine(VertexCheckpointMixin):
         self.last_exchange_bytes: float | None = None
         self._warmed = False
 
+    def wire_bytes_per_level(self) -> list[float]:
+        """Modeled off-chip bytes one chip moves per level, per exchange
+        branch (ascending sparse caps then the dense fallback; the dense
+        impls have the single entry) — the price list behind
+        ``last_exchange_bytes``, and the feed for the bench verdict's
+        ``wire_bytes_per_level`` key (TPU_BFS_BENCH_MODE=dist) and the
+        BENCHMARKS.md "Exchange bytes" table."""
+        if self._exchange == "sparse":
+            return sparse_wire_bytes_per_level(
+                self.p, self.part.vloc, self.sparse_caps,
+                wire_pack=self.wire_pack,
+            )
+        return [
+            dense_or_wire_bytes(
+                self.p, self.part.vloc, self._exchange,
+                wire_pack=self.wire_pack,
+            )
+        ]
+
     def _record_exchange(
         self, branch_counts, *, resumed_level: int = 0, chain_nonce=None
     ) -> None:
         prev = gate_and_stamp_chain(self, resumed_level, chain_nonce)
         counts = merge_exchange_counts(prev, branch_counts, resumed_level)
-        if self._exchange == "sparse":
-            per = sparse_wire_bytes_per_level(self.p, self.part.vloc, self.sparse_caps)
-        else:
-            per = [dense_or_wire_bytes(self.p, self.part.vloc, self._exchange)]
         self.last_exchange_level_counts = counts
-        self.last_exchange_bytes = float(np.dot(counts, per))
+        self.last_exchange_bytes = float(np.dot(counts, self.wire_bytes_per_level()))
 
     def _init_state(self, source: int):
         part = self.part

@@ -38,8 +38,10 @@ from tpu_bfs.parallel.collectives import (
     dense_2d_wire_bytes,
     gate_and_stamp_chain,
     merge_exchange_counts,
+    pack_bits,
     reduce_scatter_min,
     reduce_scatter_or,
+    unpack_bits,
 )
 from tpu_bfs.parallel.dist_bfs import VertexCheckpointMixin
 from tpu_bfs.parallel.partition2d import out_csr_2d, partition_2d
@@ -56,13 +58,19 @@ def make_mesh_2d(rows: int, cols: int, devices=None) -> Mesh:
 
 
 def _dist2d_bfs_fn(mesh: Mesh, rows: int, cols: int, w: int, exchange: str,
-                   backend: str, dopt_caps: tuple[int, ...] = ()):
+                   backend: str, dopt_caps: tuple[int, ...] = (),
+                   wire_pack: bool = False):
     """2D level loop. ``backend='dopt'`` = the BASELINE scale-26 config
     ("2D edge partition + direction-optimizing BFS"): after the column
     all-gather, each chip independently runs the sparse top-down branch
     when its column frontier's local out-degree sum fits a ``dopt_caps``
     rung — the branch is collective-free (both collectives sit outside the
-    `lax.cond`), so per-chip divergence is safe."""
+    `lax.cond`), so per-chip divergence is safe.
+
+    ``wire_pack=True`` bit-packs BOTH per-level collectives (ISSUE 5): the
+    column all-gather over 'r' ships each chip's [w] slice as ceil(w/32)
+    uint32 words, and the row reduce-scatter over 'c' runs the packed
+    dense exchange — same collective count, 1/8+ the bytes."""
     row_block = cols * w
     col_block = rows * w
     dopt = backend == "dopt"
@@ -101,10 +109,20 @@ def _dist2d_bfs_fn(mesh: Mesh, rows: int, cols: int, w: int, exchange: str,
         def body(state):
             frontier, visited, dist, level, _ = state
             # Column exchange: assemble this mesh column's frontier slices.
-            col_frontier = lax.all_gather(frontier, "r", tiled=True)  # [R*w]
+            if wire_pack and rows > 1:
+                # Packed wire: gather uint32 words (one per 32 vertices of
+                # each chip's slice), unpack per chunk after landing.
+                gw = lax.all_gather(pack_bits(frontier), "r", tiled=True)
+                col_frontier = unpack_bits(gw.reshape(rows, -1), w).reshape(
+                    rows * w
+                )
+            else:
+                col_frontier = lax.all_gather(frontier, "r", tiled=True)  # [R*w]
             contrib = expand_local(col_frontier)
             # Row exchange: combine row-block contributions, keep own chunk.
-            hit = reduce_scatter_or(contrib, "c", cols, impl=exchange)
+            hit = reduce_scatter_or(
+                contrib, "c", cols, impl=exchange, wire_pack=wire_pack
+            )
             new = hit & ~visited
             dist = jnp.where(new, level + 1, dist)
             visited = visited | new
@@ -190,6 +208,7 @@ class Dist2DBfsEngine(VertexCheckpointMixin):
         exchange: str = "ring",
         backend: str = "scan",
         dopt_caps: tuple[int, ...] | None = None,
+        wire_pack: bool = False,
     ):
         if mesh is None:
             mesh = make_mesh_2d(rows or 1, cols or 1)
@@ -230,9 +249,13 @@ class Dist2DBfsEngine(VertexCheckpointMixin):
                 dopt_caps = default_dopt_caps(src_gidx.shape[2])
         self.dopt_caps = tuple(sorted(set(dopt_caps))) if dopt_caps else ()
         self._exchange = exchange
+        #: bit-packed wire format (ISSUE 5): both per-level collectives
+        #: (column all-gather, row reduce-scatter) ship uint32 words.
+        #: Bit-identical results; default OFF until chip-measured.
+        self.wire_pack = bool(wire_pack)
         self._loop = _dist2d_bfs_fn(
             mesh, self.rows, self.cols, part.w, exchange, backend,
-            self.dopt_caps,
+            self.dopt_caps, self.wire_pack,
         )
         self._parents = _dist2d_parents_fn(mesh, self.rows, self.cols, part.w, exchange)
         #: level count of the last traversal (one branch — the 2D loop has
@@ -243,6 +266,18 @@ class Dist2DBfsEngine(VertexCheckpointMixin):
         self.last_exchange_bytes: float | None = None
         self._warmed = False
 
+    def wire_bytes_per_level(self) -> list[float]:
+        """Modeled off-chip bytes one chip moves per level (single entry —
+        the 2D loop has no cap ladder): column all-gather + row
+        reduce-scatter, packed or plain per ``wire_pack``. Same contract
+        as DistBfsEngine.wire_bytes_per_level."""
+        return [
+            dense_2d_wire_bytes(
+                self.rows, self.cols, self.part.w, self._exchange,
+                wire_pack=self.wire_pack,
+            )
+        ]
+
     def _record_exchange(
         self, levels_run: int, *, resumed_level: int = 0, chain_nonce=None
     ) -> None:
@@ -250,9 +285,8 @@ class Dist2DBfsEngine(VertexCheckpointMixin):
         counts = merge_exchange_counts(
             prev, np.array([levels_run], dtype=np.int64), resumed_level
         )
-        per = dense_2d_wire_bytes(self.rows, self.cols, self.part.w, self._exchange)
         self.last_exchange_level_counts = counts
-        self.last_exchange_bytes = float(counts[0] * per)
+        self.last_exchange_bytes = float(counts[0] * self.wire_bytes_per_level()[0])
 
     def _init_state(self, source: int):
         part = self.part

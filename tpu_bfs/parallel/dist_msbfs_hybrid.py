@@ -797,9 +797,19 @@ class DistHybridMsBfsEngine(
         sparse_caps: int | tuple[int, ...] | None = None,
         lanes: int = LANES,
         pull_gate: bool = False,
+        wire_pack: bool = False,
     ):
         if not (1 <= num_planes <= 8):
             raise ValueError("num_planes must be in [1, 8]")
+        # Wire format (ISSUE 5): every exchange this engine runs — the
+        # dense/sparse row gathers AND the sliced layout's rotating
+        # source-contribution accumulators — already moves uint32 lane
+        # words, one BIT per (vertex, source) pair; bit-packing is the
+        # packed MS representation itself, so there is nothing left to
+        # compress. The flag is accepted for knob uniformity with the
+        # single-source engines (CLI --wire-pack, bench A/B) and pinned
+        # to a no-op by the fuzz suite.
+        self.wire_pack = bool(wire_pack)
         if exchange not in ("dense", "sparse", "sliced"):
             raise ValueError(
                 f"unknown exchange {exchange!r}; have 'dense', 'sparse', "

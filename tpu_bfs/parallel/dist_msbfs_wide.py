@@ -244,6 +244,7 @@ class DistWideMsBfsEngine(PackedRunProtocol, RowGatherExchangeAccounting):
         num_planes: int = 5,
         exchange: str = "dense",
         sparse_caps: int | tuple[int, ...] | None = None,
+        wire_pack: bool = False,
     ):
         if not (1 <= num_planes <= 8):
             raise ValueError("num_planes must be in [1, 8]")
@@ -251,6 +252,13 @@ class DistWideMsBfsEngine(PackedRunProtocol, RowGatherExchangeAccounting):
             raise ValueError(
                 f"unknown exchange {exchange!r}; have 'dense', 'sparse'"
             )
+        # Wire format (ISSUE 5): this engine's exchange already ships
+        # uint32 lane words — one BIT per (vertex, source) pair, the
+        # information content — so there is nothing left to pack. The
+        # flag is accepted so one --wire-pack / bench knob sweeps every
+        # distributed engine uniformly; the fuzz suite pins it to a
+        # no-op (bit-identical results either way).
+        self.wire_pack = bool(wire_pack)
         if lanes % 32 or not (32 <= lanes <= MAX_LANES):
             raise ValueError(
                 f"lanes must be a multiple of 32 in [32, {MAX_LANES}]"

@@ -158,13 +158,29 @@ def phase_bytes(engine, *, nz_rows: int | None = None,
     program still writes them full-height), and the settled-mask read adds
     one table scan — the model bills the gate's own overhead so the gated
     entry stays honest.
+
+    Distributed MS engines (``_gather_p > 1``) add an ``exchange`` entry —
+    per-level WIRE bytes, not HBM: the dense slab gather and the sliced
+    ring rotation both move (P-1) x [rows/P, w] u32 per chip per level
+    (dist_msbfs_hybrid; the sparse row-gather rungs move less — this is
+    the dense ceiling). The packed MS wire format already carries one bit
+    per (vertex, lane), so ISSUE 5's ``wire_pack`` does not change this
+    entry; their HBM phases are the single-chip model's, per chip, and
+    are not re-derived here (``hg`` is absent on those engines).
     """
-    hg, w = engine.hg, engine.w
+    from tpu_bfs.parallel.collectives import dense_rows_wire_bytes
+
+    hg, w = getattr(engine, "hg", None), engine.w
+    out = {}
+    p = int(getattr(engine, "_gather_p", 1))
+    if p > 1:
+        out["exchange"] = dense_rows_wire_bytes(p, engine._gather_rows_loc, w)
+    if hg is None:
+        return out
     rows = hg.vt * TILE
     tb = rows * w * 4  # one [rows, w] u32 table
     gated = bool(getattr(engine, "pull_gate", False)) and active_tiles is not None
     at_rows = min(int(active_tiles or 0) * TILE, rows) if gated else rows
-    out = {}
     # residual: per light bucket, k fori steps each gathering n rows
     # (n*w*4 read) and accumulating (acc read+write) + index table; the
     # virtual/heavy bucket adds its fold pyramid and pick gathers.

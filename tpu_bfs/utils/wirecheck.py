@@ -14,9 +14,17 @@ Conventions (ring collectives over P devices):
 - ``collective-permute`` sends its whole operand once per execution.
 - ``all-to-all`` with a P-piece tuple operand keeps one piece local and
   sends P-1 — wire bytes = (P-1) x piece bytes.
-- scalar ``all-reduce`` (termination psum, phase-1 pmax) is the model's
-  flat +4 bytes; the termination psum itself is outside the model's
-  stated scope (exchange traffic), reported separately here.
+- scalar ``all-reduce``: the SPARSE models carry a flat +4 bytes for
+  their phase-1 pmax scalar (it exists only on that path); the DENSE
+  models carry no flat term — their per-level termination psum is
+  outside every model's stated scope (exchange traffic) and is reported
+  separately here.
+- wire dtypes: the unpacked dense ring ships PRED chunks (one BYTE per
+  vertex per hop — n result bytes pins the dtype), the unpacked
+  allreduce an S32 buffer (four bytes per vertex); ``wire_pack`` ships
+  U32 words, 32 vertices/word, on both (``check_packed_exchange``
+  asserts the exact /8 and /32 ratios plus an unchanged collective
+  instruction count).
 """
 
 from __future__ import annotations
@@ -85,19 +93,12 @@ def hlo_collectives(hlo_text: str) -> list[Collective]:
     return out
 
 
-def check_1d_sparse(graph, p: int = 8) -> dict:
-    """1D DistBfsEngine, queue-style sparse exchange: the modeled per-level
-    branch bytes (sparse_wire_bytes_per_level) vs the compiled program's
-    all-to-all piece sizes and ring-step permutes."""
+def _lower_1d_loop(eng) -> str:
+    """Compiled HLO text of a 1D DistBfsEngine's level loop."""
     import jax.numpy as jnp
 
-    from tpu_bfs.parallel.collectives import sparse_wire_bytes_per_level
-    from tpu_bfs.parallel.dist_bfs import DistBfsEngine, make_mesh
-
-    eng = DistBfsEngine(graph, make_mesh(p), exchange="sparse")
-    n = eng.part.vloc
     f0, vis0, d0 = eng._init_state(0)
-    hlo = (
+    return (
         eng._loop.lower(
             eng.src, eng.dst, eng.rp, eng._aux, f0, vis0, d0,
             jnp.int32(0), jnp.int32(64),
@@ -105,7 +106,22 @@ def check_1d_sparse(graph, p: int = 8) -> dict:
         .compile()
         .as_text()
     )
-    colls = hlo_collectives(hlo)
+
+
+def check_1d_sparse(graph, p: int = 8, wire_pack: bool = False) -> dict:
+    """1D DistBfsEngine, queue-style sparse exchange: the modeled per-level
+    branch bytes (sparse_wire_bytes_per_level) vs the compiled program's
+    all-to-all piece sizes and ring-step permutes. ``wire_pack`` audits
+    the bit-packed dense fallback (u32 word permutes) against the packed
+    model and the recalibrated default cap ladder."""
+    from tpu_bfs.parallel.collectives import sparse_wire_bytes_per_level
+    from tpu_bfs.parallel.dist_bfs import DistBfsEngine, make_mesh
+
+    eng = DistBfsEngine(
+        graph, make_mesh(p), exchange="sparse", wire_pack=wire_pack
+    )
+    n = eng.part.vloc
+    colls = hlo_collectives(_lower_1d_loop(eng))
 
     # Sparse branches: each cap's [P, cap] s32 bucket buffer all-to-all
     # keeps the self piece local -> (P-1) * 4c on the wire.
@@ -114,15 +130,20 @@ def check_1d_sparse(graph, p: int = 8) -> dict:
          for c in colls if c.op == "all-to-all"}
     )
     # Dense fallback: unrolled ring reduce-scatter, P-1 permutes of one
-    # [n] bool chunk each.
+    # [n] bool chunk each (one [ceil(n/32)] u32 chunk under wire_pack).
     ring = [c for c in colls if c.op == "collective-permute"]
     ring_wire = sum(c.result_bytes for c in ring)
     scalars = [c for c in colls if c.op == "all-reduce"]
 
-    modeled = sparse_wire_bytes_per_level(p, n, eng.sparse_caps)
+    modeled = sparse_wire_bytes_per_level(
+        p, n, eng.sparse_caps, wire_pack=wire_pack
+    )
     derived = [w + 4.0 for w in a2a_wire] + [ring_wire + 4.0]
     return {
-        "config": f"1D sparse exchange, P={p}, vloc={n}, caps={eng.sparse_caps}",
+        "config": (
+            f"1D sparse exchange, P={p}, vloc={n}, caps={eng.sparse_caps}, "
+            f"wire_pack={wire_pack}"
+        ),
         "modeled_per_level": modeled,
         "hlo_per_level": derived,
         "ring_steps": len(ring),
@@ -135,7 +156,7 @@ def check_1d_sparse(graph, p: int = 8) -> dict:
 
 
 def check_2d(graph, rows: int = 2, cols: int = 4, exchange: str = "ring",
-             backend: str = "scan") -> dict:
+             backend: str = "scan", wire_pack: bool = False) -> dict:
     """2D Dist2DBfsEngine: the modeled per-level bytes (dense_2d_wire_bytes
     — the BASELINE scale-26 config's wire model) vs the compiled loop's
     column all-gather and row reduce-scatter.
@@ -143,16 +164,22 @@ def check_2d(graph, rows: int = 2, cols: int = 4, exchange: str = "ring",
     Ring conventions as in the module docstring; ``all-gather`` result
     holds all R pieces, so wire/chip = result - own piece = result*(R-1)/R.
     The 'allreduce' row exchange lowers to one [C*w] s32 all-reduce whose
-    bandwidth-optimal wire cost is 2*(C-1)/C x result bytes."""
+    bandwidth-optimal wire cost is 2*(C-1)/C x result bytes. Under
+    ``wire_pack`` the column gather moves u32[R*ceil(w/32)] words, the
+    ring permutes u32[ceil(w/32)] chunks, and the allreduce row exchange
+    becomes one all-to-all of per-destination word chunks (keep-own
+    convention, as in the 1D packed audit)."""
     import jax.numpy as jnp
 
-    from tpu_bfs.parallel.collectives import dense_2d_wire_bytes
+    from tpu_bfs.parallel.collectives import dense_2d_wire_bytes, packed_words
     from tpu_bfs.parallel.dist_bfs2d import Dist2DBfsEngine, make_mesh_2d
 
     eng = Dist2DBfsEngine(
-        graph, make_mesh_2d(rows, cols), exchange=exchange, backend=backend
+        graph, make_mesh_2d(rows, cols), exchange=exchange, backend=backend,
+        wire_pack=wire_pack,
     )
     w = eng.part.w
+    nw = packed_words(w)
     f0, vis0, d0 = eng._init_state(0)
     hlo = (
         eng._loop.lower(
@@ -164,20 +191,33 @@ def check_2d(graph, rows: int = 2, cols: int = 4, exchange: str = "ring",
     )
     colls = hlo_collectives(hlo)
 
-    # Column exchange: one pred[R*w] all-gather over 'r' per level.
+    # Column exchange: one pred[R*w] (u32[R*nw] packed) all-gather over 'r'.
+    ag_result = rows * 4 * nw if wire_pack else rows * w
     col_ags = [
-        c for c in colls if c.op == "all-gather" and c.result_bytes == rows * w
+        c for c in colls if c.op == "all-gather" and c.result_bytes == ag_result
     ]
-    ag_wire = (rows - 1) * w if rows > 1 else 0
+    ag_wire = (rows - 1) * (ag_result // rows) if rows > 1 else 0
 
     if exchange == "ring":
-        # Row exchange: unrolled ring, C-1 permutes of one pred[w] chunk.
+        # Row exchange: unrolled ring, C-1 permutes of one pred[w]
+        # (u32[nw] packed) chunk.
+        chunk = 4 * nw if wire_pack else w
         ring = [
             c for c in colls
-            if c.op == "collective-permute" and c.result_bytes == w
+            if c.op == "collective-permute" and c.result_bytes == chunk
         ]
         row_wire = sum(c.result_bytes for c in ring)
         row_ok = len(ring) == cols - 1
+    elif wire_pack:
+        # Packed row exchange: one u32[C, nw] all-to-all, keep-own piece.
+        a2as = [
+            c for c in colls
+            if c.op == "all-to-all" and c.result_bytes == 4 * cols * nw
+        ]
+        row_wire = sum(
+            (c.pieces - 1) * (c.result_bytes // c.pieces) for c in a2as
+        )
+        row_ok = len(a2as) == 1
     else:
         # Row exchange: one s32[C*w] all-reduce (psum) over 'c'.
         big_ars = [
@@ -192,11 +232,12 @@ def check_2d(graph, rows: int = 2, cols: int = 4, exchange: str = "ring",
         c for c in colls if c.op == "all-reduce" and c.result_bytes == 4
     ]
 
-    modeled = dense_2d_wire_bytes(rows, cols, w, exchange)
+    modeled = dense_2d_wire_bytes(rows, cols, w, exchange, wire_pack=wire_pack)
     derived = float(ag_wire + row_wire)
     return {
         "config": (
-            f"2D {exchange}/{backend}, mesh {rows}x{cols}, w={w}"
+            f"2D {exchange}/{backend}, mesh {rows}x{cols}, w={w}, "
+            f"wire_pack={wire_pack}"
         ),
         "modeled_per_level": modeled,
         "hlo_per_level": derived,
@@ -287,6 +328,101 @@ def check_rows_sparse(graph, p: int = 8, lanes: int = 64) -> dict:
             all(found)
             and [float(x) for x in modeled]
             == [float(x) for x in derived]
+        ),
+    }
+
+
+def check_packed_exchange(graph, p: int = 8) -> dict:
+    """ISSUE 5 tentpole proof, from the compiled HLO: the bit-packed wire
+    format moves exactly 1/8 the collective bytes of the pred ring and
+    exactly 1/32 the collective operand bytes of the s32 allreduce, with
+    an IDENTICAL collective instruction count — packing is pure compute,
+    it never adds a collective.
+
+    Compiles the 1D level loop four ways (ring/allreduce x plain/packed)
+    and derives everything from the instructions' own shapes:
+
+    - ring: P-1 collective-permutes both ways; plain chunks are pred[n]
+      (n result bytes — ONE byte per vertex, pinning the dtype the model
+      documents), packed chunks u32[ceil(n/32)]. vloc is 1024-aligned by
+      partition_1d, so the /8 ratio is exact, never ceil-rounded.
+    - allreduce: ONE collective both ways; plain is an s32[P*n] all-reduce
+      (4 bytes per vertex), packed is one u32 all-to-all whose operand is
+      P*n/8 bytes — exactly 1/32. (The packed form also sheds the psum's
+      all-gather half, so its modeled WIRE bytes, keep-own convention,
+      equal the packed ring's — dense_or_wire_bytes says so.)
+    """
+    from tpu_bfs.parallel.collectives import dense_or_wire_bytes, packed_words
+    from tpu_bfs.parallel.dist_bfs import DistBfsEngine, make_mesh
+
+    mesh = make_mesh(p)
+    colls, n = {}, None
+    for impl in ("ring", "allreduce"):
+        for packed in (False, True):
+            eng = DistBfsEngine(graph, mesh, exchange=impl, wire_pack=packed)
+            n = eng.part.vloc
+            colls[impl, packed] = hlo_collectives(_lower_1d_loop(eng))
+    nw = packed_words(n)
+
+    def wire(c: Collective) -> int:
+        # Permutes send their operand; an all-to-all keeps its own piece.
+        if c.op == "all-to-all":
+            return (c.pieces - 1) * (c.result_bytes // c.pieces)
+        return c.result_bytes
+
+    ring_plain = [
+        c for c in colls["ring", False] if c.op == "collective-permute"
+    ]
+    ring_packed = [
+        c for c in colls["ring", True] if c.op == "collective-permute"
+    ]
+    # The big exchange all-reduce; the 4-byte scalars are the termination
+    # psums, present identically in every variant.
+    ar_plain = [
+        c for c in colls["allreduce", False]
+        if c.op == "all-reduce" and c.result_bytes > 4
+    ]
+    a2a_packed = [c for c in colls["allreduce", True] if c.op == "all-to-all"]
+
+    ring_bytes = sum(wire(c) for c in ring_plain)
+    ring_packed_bytes = sum(wire(c) for c in ring_packed)
+    ar_operand = sum(c.result_bytes for c in ar_plain)
+    a2a_operand = sum(c.result_bytes for c in a2a_packed)
+    counts = {
+        (impl, packed): len(cs) for (impl, packed), cs in colls.items()
+    }
+    modeled = {
+        impl: dense_or_wire_bytes(p, n, impl, wire_pack=True)
+        for impl in ("ring", "allreduce")
+    }
+    derived = {
+        "ring": float(ring_packed_bytes),
+        "allreduce": float(sum(wire(c) for c in a2a_packed)),
+    }
+    return {
+        "config": f"packed vs plain 1D exchange, P={p}, vloc={n}",
+        "vloc": n,
+        "ring_permute_result_bytes": sorted(
+            {c.result_bytes for c in ring_plain}
+        )[0] if ring_plain else None,
+        "allreduce_operand_bytes": ar_operand,
+        "ring_reduction": ring_bytes / ring_packed_bytes
+        if ring_packed_bytes else None,
+        "allreduce_operand_reduction": ar_operand / a2a_operand
+        if a2a_operand else None,
+        "collective_counts": {f"{i}/{p_}": c for (i, p_), c in counts.items()},
+        "modeled_packed_per_level": modeled,
+        "hlo_packed_per_level": derived,
+        "agree": (
+            len(ring_plain) == len(ring_packed) == p - 1
+            and len(ar_plain) == 1
+            and len(a2a_packed) == 1
+            and counts["ring", True] == counts["ring", False]
+            and counts["allreduce", True] == counts["allreduce", False]
+            and ring_packed_bytes * 8 == ring_bytes
+            and a2a_operand * 32 == ar_operand
+            and derived == {k: float(v) for k, v in modeled.items()}
+            and ring_packed_bytes == (p - 1) * 4 * nw
         ),
     }
 

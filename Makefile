@@ -1,5 +1,5 @@
 # Convenience targets; everything also works without make (README).
-.PHONY: test native bench wirecheck serve-smoke chaos-smoke wheel clean
+.PHONY: test native bench wirecheck serve-smoke chaos-smoke obs-smoke wheel clean
 
 # Full suite on 8 virtual CPU devices (tests/conftest.py forces the
 # platform; the axon TPU plugin is bypassed).
@@ -57,6 +57,17 @@ serve-smoke: wirecheck
 # (tests/test_chaos.py, tests/test_faults.py).
 chaos-smoke: wirecheck
 	env JAX_PLATFORMS=cpu python scripts/chaos_smoke.py
+
+# The telemetry smoke (README "Observability"): a tracing-armed JSONL
+# server must emit a Perfetto trace holding the FULL span chain of every
+# query id (admit -> coalesce -> dispatch -> fetch -> extract -> resolve)
+# plus the per-level engine-trace track and a /metricz text that agrees
+# with statsz; the chaos variant injects a watchdog trip and asserts the
+# flight recorder dumps a replayable artifact naming the fault's site.
+# The pytest `obs` marker runs the same layer in-process
+# (tests/test_obs.py — including the disarmed-path zero-overhead spies).
+obs-smoke: wirecheck
+	env JAX_PLATFORMS=cpu python scripts/obs_smoke.py
 
 wheel:
 	python -m pip wheel . --no-deps --no-build-isolation -w dist

@@ -49,7 +49,12 @@ TPU_BFS_BENCH_KCAP / TPU_BFS_BENCH_TILE_THR / TPU_BFS_BENCH_A_BUDGET
 threshold, dense-tile byte budget; defaults 64 / 64 / 0.2e9 — the
 measured flagship optima),
 TPU_BFS_BENCH_XLA_CACHE (.bench_cache/xla_cache — persistent XLA compile
-cache across bench processes; empty disables).
+cache across bench processes; empty disables),
+TPU_BFS_BENCH_OBS (serve mode: arm the telemetry recorder, spec grammar
+of tpu_bfs/obs — the verdict gains serve_obs_events/serve_flight_dumps/
+serve_trace), TPU_BFS_BENCH_TRACE_OUT (dist + serve modes: write a
+Chrome/Perfetto trace-event JSON here; dist mode always emits the "trace"
+per-level summary keys — BENCHMARKS.md "Trace summary").
 """
 
 import json
@@ -1172,6 +1177,28 @@ def bench_dist(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
             f"{res.num_levels} GTEPS={res.teps/1e9:.3f} "
             f"wire={engine.last_exchange_bytes:.0f}B")
     gteps = len(teps) / sum(1.0 / t for t in teps) / 1e9
+    # Per-level engine trace of the LAST timed source (the unified
+    # contract of tpu_bfs/obs/engine_trace; BENCHMARKS.md "Trace
+    # summary") — the wire_* keys above already aggregate all sources.
+    from tpu_bfs.obs.engine_trace import trace_summary
+
+    trace_out = os.environ.get("TPU_BFS_BENCH_TRACE_OUT", "").strip()
+    if trace_out:
+        from tpu_bfs.obs.exporters import write_perfetto
+
+        try:
+            write_perfetto(
+                [], trace_out,
+                level_traces=[(f"dist-1d/p{engine.p}",
+                               engine.last_run_trace or [])],
+                meta={"tool": "tpu-bfs-bench", "mode": "dist",
+                      "exchange": exchange, "devices": engine.p},
+            )
+            log(f"trace written -> {trace_out}")
+        except OSError as exc:
+            # A bad TPU_BFS_BENCH_TRACE_OUT path must not cost the run's
+            # verdict (the timed work is already done).
+            log(f"trace write failed ({exc!r})")
     return {
         "metric": (
             f"BFS harmonic-mean GTEPS (1D distributed, P={engine.p}, "
@@ -1188,6 +1215,7 @@ def bench_dist(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
         "wire_bytes_per_level": per_level,
         "wire_level_counts": [int(x) for x in counts],
         "wire_bytes_total": total_bytes,
+        "trace": trace_summary(engine.last_run_trace, engine),
     }
 
 
@@ -1233,6 +1261,24 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
     # dispatches, not on engine warm-up.
     fault_spec = os.environ.get("TPU_BFS_BENCH_FAULTS", "").strip()
     fault_sched = None
+    # Telemetry arm (TPU_BFS_BENCH_OBS, spec grammar of tpu_bfs/obs):
+    # armed BEFORE the service so registry build/warm spans land in the
+    # trace; the verdict then carries the obs event census and — with
+    # TPU_BFS_BENCH_TRACE_OUT — a Perfetto JSON of the whole stage.
+    obs_spec = os.environ.get("TPU_BFS_BENCH_OBS", "").strip()
+    trace_out = os.environ.get("TPU_BFS_BENCH_TRACE_OUT", "").strip()
+    recorder = None
+    if obs_spec or trace_out:
+        from tpu_bfs import obs as obs_mod
+
+        # Same arming contract as the CLI surfaces (obs.arm_for_run): an
+        # explicit spec wins, a falsy spec disarms, and TRACE_OUT alone
+        # arms a default recorder — the documented dist+serve TRACE_OUT
+        # support must not silently depend on TPU_BFS_BENCH_OBS.
+        recorder = obs_mod.arm_for_run(obs_spec or None, trace_out)
+        if recorder is not None:
+            log("obs recorder armed"
+                + (f" (spec {obs_spec!r})" if obs_spec else " (trace-out)"))
 
     t0 = time.perf_counter()
     service = retry_transient(
@@ -1304,6 +1350,42 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
         log(f"validated {nv} serve responses in {time.perf_counter()-t0:.1f}s")
     service.close()
 
+    obs_keys: dict = {}
+    if recorder is not None:
+        from tpu_bfs.obs.engine_trace import trace_summary
+
+        level_traces = [
+            (f"{spec.engine}/w{spec.lanes}", eng.last_run_trace)
+            for spec, eng in service._registry.resident_engines()
+            if getattr(eng, "last_run_trace", None)
+        ]
+        obs_keys = {
+            "serve_obs_events": recorder.counts_by_name(),
+            "serve_flight_dumps": len(recorder.dumps),
+        }
+        if level_traces:
+            # The widest rung's trace (the batch shape the closed loop
+            # mostly ran) stands in for "the" serve engine trace.
+            label, trace = max(
+                level_traces, key=lambda lt: int(lt[0].rsplit("/w", 1)[1])
+            )
+            obs_keys["serve_trace"] = trace_summary(trace)
+            obs_keys["serve_trace_engine"] = label
+        if trace_out:
+            from tpu_bfs.obs.exporters import write_perfetto
+
+            try:
+                write_perfetto(
+                    recorder.snapshot(), trace_out, t0=recorder.t0,
+                    level_traces=level_traces,
+                    meta={"tool": "tpu-bfs-bench", "mode": "serve"},
+                )
+                log(f"trace written -> {trace_out}")
+            except OSError as exc:
+                # A bad TPU_BFS_BENCH_TRACE_OUT path must not cost the
+                # run's verdict (the timed work is already done).
+                log(f"trace write failed ({exc!r})")
+
     return {
         "metric": (
             f"BFS serve throughput ({clients} closed-loop clients, "
@@ -1333,6 +1415,7 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
         "serve_breaker_opens": snap["breaker_opens"],
         "serve_requeue_shed": snap["requeue_shed"],
         **({"serve_faults": fault_sched.counts()} if fault_sched else {}),
+        **obs_keys,
     }
 
 

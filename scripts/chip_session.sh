@@ -136,6 +136,16 @@ for i in $(seq 1 "$attempts"); do
       TPU_BFS_BENCH_MODE=serve TPU_BFS_BENCH_SCALE=20 \
       TPU_BFS_BENCH_FAULTS="seed=7:transient@serve_batch:n=2,slow_extract:ms=50:n=4" \
       TPU_BFS_BENCH_SERVE_WATCHDOG_MS=600000
+    # Telemetry arm (ISSUE 6): the same serve stage with the obs
+    # recorder on — the JSON line gains serve_obs_events/serve_trace and
+    # a Perfetto trace of the whole on-chip serving session lands next to
+    # the stage output (load it at ui.perfetto.dev; README
+    # "Observability"). A/B against serve-adaptive-s20 prices the armed
+    # recorder's overhead on real hardware (<2% is the acceptance bar).
+    stage "obs-s20" "$out/obs_s20.json" \
+      TPU_BFS_BENCH_MODE=serve TPU_BFS_BENCH_SCALE=20 \
+      TPU_BFS_BENCH_OBS="dump_dir=$out" \
+      TPU_BFS_BENCH_TRACE_OUT="$out/obs_s20_trace.json"
     # Wire-format A/B (ISSUE 5): the 1D distributed exchange bit-packed
     # (TPU_BFS_BENCH_WIRE_PACK=1: uint32 words, 1 bit/vertex on the wire
     # — wirecheck-proven 1/8 the ring bytes) vs plain (pred ring) at

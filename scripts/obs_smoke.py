@@ -1,0 +1,185 @@
+"""The telemetry smoke (`make obs-smoke`): tracing-armed serving end to
+end on CPU, against the REAL subprocess/server machinery.
+
+Two acts (the disabled-path zero-overhead guarantee is pinned in-process
+by tests/test_obs.py's spy counters — a subprocess cannot observe it):
+
+1. TRACE — a JSONL server with the recorder armed (``--obs``) serves 3
+   queries and writes a Chrome/Perfetto trace (``--trace-out``) plus the
+   Prometheus text (``--metricz-out``). The trace must be Perfetto-
+   loadable and contain the FULL span chain for every query id:
+   query begin/end, the coalesce record, and its batch's
+   dispatch/fetch/extract spans; the engine's per-level trace track must
+   ride along; the metricz text must agree with the final statsz line.
+2. WATCHDOG — the chaos variant: a seeded ``slow`` fault holds the first
+   serving fetch past ``--watchdog-ms``, so the watchdog trips into the
+   transient-retry path (every query still answers ok) and the flight
+   recorder auto-dumps. The dump must name the injected fault's site,
+   carry the watchdog-trip event, and hold the span chain of the
+   affected query ids up to the trip.
+
+Prints one JSON line (value = traced query count) so
+scripts/chip_session.sh's has_value gate can drive it as a stage.
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+GRAPH = "random:n=96,m=480,seed=3"
+SERVER = [sys.executable, "-m", "tpu_bfs.serve", GRAPH,
+          "--lanes", "32", "--ladder", "off", "--linger-ms", "50",
+          "--statsz-interval-s", "0"]
+ENV = dict(os.environ, JAX_PLATFORMS="cpu")
+
+
+def log(msg):
+    print(f"[obs-smoke] {msg}", file=sys.stderr, flush=True)
+
+
+def check(cond, msg):
+    if not cond:
+        raise SystemExit(f"FAIL: {msg}")
+    log(f"ok: {msg}")
+
+
+def run_server(extra_args, requests, *, timeout=300):
+    payload = "".join(json.dumps(r) + "\n" for r in requests)
+    proc = subprocess.run(
+        SERVER + extra_args, input=payload, capture_output=True,
+        text=True, env=ENV, timeout=timeout,
+    )
+    responses = [json.loads(l) for l in proc.stdout.splitlines() if l.strip()]
+    log(f"server exited rc={proc.returncode} with "
+        f"{len(responses)} responses")
+    return responses, proc.stderr, proc.returncode
+
+
+def span_events(events, name, qid):
+    """The async begin/end pair for span ``name`` with correlation id
+    ``qid`` in a Chrome trace-event list."""
+    return {e["ph"]: e for e in events
+            if e.get("name") == name and e.get("id") == qid}
+
+
+def main() -> int:
+    reqs = [{"id": i, "source": s} for i, s in enumerate((0, 3, 5), 1)]
+
+    with tempfile.TemporaryDirectory() as d:
+        trace_path = os.path.join(d, "trace.json")
+        metricz_path = os.path.join(d, "metricz.txt")
+
+        log("act 1: tracing-armed serve (3 queries)")
+        resp, err, rc = run_server(
+            ["--obs", f"dump_dir={d}", "--trace-out", trace_path,
+             "--metricz-out", metricz_path],
+            reqs,
+        )
+        check(rc == 0, "traced server exits 0")
+        check(len(resp) == len(reqs)
+              and all(r["status"] == "ok" for r in resp),
+              "every traced query answered ok")
+        doc = json.load(open(trace_path))
+        check(isinstance(doc.get("traceEvents"), list)
+              and any(e.get("ph") == "M" for e in doc["traceEvents"]),
+              "trace-out is Perfetto-loadable trace-event JSON")
+        evs = doc["traceEvents"]
+        batches = set()
+        for r in reqs:
+            qid = f"q{r['id']}"
+            q = span_events(evs, "query", qid)
+            check("b" in q and "e" in q,
+                  f"query {r['id']}: begin+end span pair in the trace")
+            check(q["e"]["args"].get("status") == "ok",
+                  f"query {r['id']}: span closes with its terminal status")
+            bid = q["e"]["args"].get("batch")
+            check(bid is not None, f"query {r['id']}: span carries its "
+                  f"batch id ({bid})")
+            batches.add(bid)
+            check(any(e.get("name") == "coalesce"
+                      and r["id"] in (e["args"].get("queries") or ())
+                      for e in evs),
+                  f"query {r['id']}: coalesce record names it")
+            for stage in ("dispatch", "fetch", "extract"):
+                s = span_events(evs, stage, f"b{bid}")
+                check("b" in s and "e" in s,
+                      f"query {r['id']}: batch b{bid} {stage} span pair")
+        check(any(e.get("cat") == "engine.level" for e in evs),
+              "per-level engine-trace track rides in the trace")
+        check(any(e.get("name") == "engine_build" for e in evs)
+              and any(e.get("name") == "engine_warm" for e in evs),
+              "registry build/warm spans land in the trace")
+        metricz = open(metricz_path).read()
+        statsz = [l for l in err.splitlines() if l.startswith("statsz ")]
+        check(statsz, "final statsz line emitted")
+        snap = json.loads(statsz[-1][len("statsz "):])
+        check(f"tpu_bfs_serve_completed {snap['completed']}" in metricz,
+              "metricz text agrees with the statsz line (completed)")
+        check('tpu_bfs_serve_latency_ms_bucket{le="+Inf"} '
+              f"{snap['completed']}" in metricz,
+              "latency histogram exported with every completion counted")
+        check(not glob.glob(os.path.join(d, "flightrec-*")),
+              "no flight dump on a healthy run")
+
+    with tempfile.TemporaryDirectory() as d:
+        log("act 2: injected watchdog trip -> flight-recorder dump")
+        # Site-visit arithmetic: the single-rung warm-up visits the fetch
+        # site once (unwatched), so skip=1 lands the 1.5 s stall on the
+        # FIRST SERVING fetch — far past the 250 ms watchdog. The trip
+        # classifies as a transient, the retry re-dispatches (the slow
+        # budget is spent), and every query still answers ok.
+        resp, err, rc = run_server(
+            ["--obs", f"dump_dir={d}",
+             "--faults", "seed=5:slow:ms=1500:n=1:skip=1",
+             "--watchdog-ms", "250"],
+            reqs,
+        )
+        check(rc == 0, "watchdog-tripped server exits 0")
+        check(len(resp) == len(reqs)
+              and all(r["status"] == "ok" for r in resp),
+              "every query answered ok through the tripped watchdog")
+        dumps = sorted(glob.glob(os.path.join(d, "flightrec-*.jsonl")))
+        check(len(dumps) == 1, f"exactly one flight dump written: {dumps}")
+        lines = [json.loads(l) for l in open(dumps[0])]
+        header, events = lines[0], lines[1:]
+        check(header.get("flight_recorder") == "watchdog_trip",
+              "dump header names the trigger")
+        fault = [e for e in events if e["name"] == "fault_injected"]
+        check(fault and fault[0]["args"]["site"] == "fetch",
+              "dump carries the injected fault's site")
+        trips = [e for e in events if e["name"] == "watchdog_trip"]
+        check(len(trips) == 1, "dump carries the watchdog-trip event")
+        affected = trips[0]["args"]["queries"]
+        check(affected, "the trip names its affected query ids")
+        for qid in affected:
+            mine = [e for e in events
+                    if e.get("id") == f"q{qid}"
+                    or qid == e["args"].get("query")
+                    or qid in (e["args"].get("queries") or ())]
+            names = {e["name"] for e in mine}
+            check({"query", "enqueue", "coalesce", "batch"} <= names,
+                  f"query {qid}: span chain up to the trip is in the dump "
+                  f"({sorted(names)})")
+            # The dispatch/fetch spans hang off the batch's correlation
+            # id; follow the chain one hop.
+            bid = next(e["args"]["batch"] for e in mine
+                       if e["name"] == "batch")
+            check(any(e["name"] == "dispatch" and e.get("id") == f"b{bid}"
+                      for e in events),
+                  f"query {qid}: its batch b{bid}'s dispatch span is in "
+                  f"the dump")
+
+    print(json.dumps({
+        "metric": "obs smoke (span-chain trace + metricz + watchdog "
+                  "flight dump, CPU)",
+        "value": len(reqs),
+        "unit": "queries",
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

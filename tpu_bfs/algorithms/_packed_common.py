@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpu_bfs import faults as _faults
+from tpu_bfs import obs as _obs
 from tpu_bfs.graph.csr import INF_DIST
 from tpu_bfs.algorithms.msbfs_packed import UNREACHED, ripple_increment
 
@@ -1681,10 +1682,19 @@ def fetch_packed_batch(
             f"{engine.max_levels_cap} — construct the engine with more "
             "planes for this graph"
         )
-    return _assemble_packed_result(
+    result = _assemble_packed_result(
         engine, pend.sources, pend.planes, pend.vis, pend.fw0, levels,
         bool(pend.alive), elapsed
     )
+    if _obs.ACTIVE is not None:
+        # Engine-trace assembly (tpu_bfs/obs/engine_trace) reads the gate
+        # counter — a device array whose transfer must stay behind the
+        # ACTIVE guard: disarmed fetches pay this one attribute check and
+        # nothing else (pinned by tests/test_obs.py's spy counter).
+        from tpu_bfs.obs.engine_trace import record_packed_run
+
+        record_packed_run(engine, levels, recorder=_obs.ACTIVE)
+    return result
 
 
 def run_packed_batch(

@@ -64,6 +64,47 @@ def _maybe_profile(profile_dir):
     return jax.profiler.trace(profile_dir)
 
 
+def _arm_obs(args):
+    """Arm the telemetry recorder (tpu_bfs/obs) for a one-shot run —
+    the shared ``--obs``-wins / ``--trace-out``-implies precedence."""
+    from tpu_bfs import obs as obs_mod
+
+    rec = obs_mod.arm_for_run(getattr(args, "obs", None),
+                              getattr(args, "trace_out", None))
+    if rec is not None:
+        print(f"[obs] telemetry recorder armed (flight window "
+              f"{rec.window_s:.0f}s, dump dir {rec.dump_dir!r})",
+              file=sys.stderr)
+    return rec
+
+
+def _finish_obs(args, engine, label: str) -> None:
+    """One-shot run epilogue: --stats prints the engine-trace summary
+    line, --trace-out writes the Perfetto JSON (recorder span stream +
+    the engine's per-level trace as its own track)."""
+    import json
+
+    from tpu_bfs import obs as obs_mod
+
+    trace = getattr(engine, "last_run_trace", None)
+    if args.stats and trace:
+        from tpu_bfs.obs.engine_trace import trace_summary
+
+        # Same stable-prefix-plus-JSON shape as the statsz/recovery
+        # lines: grep "^trace " and parse the rest.
+        print("trace " + json.dumps(trace_summary(trace, engine)))
+    rec = obs_mod.ACTIVE
+    if getattr(args, "trace_out", None) and rec is not None:
+        from tpu_bfs.obs.exporters import write_perfetto
+
+        write_perfetto(
+            rec.snapshot(), args.trace_out, t0=rec.t0,
+            level_traces=[(label, trace)] if trace else [],
+            meta={"tool": "tpu-bfs-cli", "graph": args.graph},
+        )
+        print(f"[obs] trace written -> {args.trace_out}", file=sys.stderr)
+
+
 def _make_ms_engine(args, g, n_sources: int):
     """Select the multi-source engine for --multi-source / --engine.
 
@@ -236,7 +277,11 @@ def _run_multi_source(args, g, golden) -> int:
             f"--multi-source vertices {bad.tolist()} out of range "
             f"[0, {g.num_vertices})"
         )
-    engine = _make_ms_engine(args, g, len(sources))
+    from tpu_bfs import obs as obs_mod
+
+    with obs_mod.maybe_span("engine_build", "cli", cat="cli",
+                            lanes=args.lanes, engine=args.engine):
+        engine = _make_ms_engine(args, g, len(sources))
     res = None
     if args.ckpt or args.resume:
         # Chunked batch traversal with durable packed state
@@ -273,13 +318,24 @@ def _run_multi_source(args, g, golden) -> int:
         res = engine.finish(st)
     else:
         try:
-            for _ in range(max(1, args.repeat)):
-                with _maybe_profile(args.profile_dir):
-                    res = engine.run(
-                        sources,
-                        max_levels=args.max_levels if args.max_levels is not None else 254,
-                        time_it=True,
-                    )
+            for rep in range(max(1, args.repeat)):
+                rec = obs_mod.ACTIVE
+                if rec is not None:
+                    rec.begin("run", "cli", cat="cli", rep=rep,
+                              sources=len(sources))
+                try:
+                    with _maybe_profile(args.profile_dir):
+                        res = engine.run(
+                            sources,
+                            max_levels=args.max_levels if args.max_levels is not None else 254,
+                            time_it=True,
+                        )
+                finally:
+                    # finally, not success-path: a handled truncation
+                    # must not leave the span dangling in the trace.
+                    if rec is not None:
+                        rec.end("run", "cli", cat="cli", rep=rep,
+                                levels=None if res is None else res.num_levels)
         except RuntimeError as exc:
             if "truncated" not in str(exc):
                 raise
@@ -344,6 +400,7 @@ def _run_multi_source(args, g, golden) -> int:
         # host memory stays near the one output array either way.
         out = np.empty((len(sources), g.num_vertices), np.int32)
         np.save(args.save_parent, res.parents_into(out))
+    _finish_obs(args, engine, type(engine).__name__)
     return 0
 
 
@@ -459,12 +516,26 @@ def main(argv=None) -> int:
                     "default: the TPU_BFS_FAULTS env var, else disabled. "
                     "Injected faults exercise the real recovery paths; "
                     "--stats surfaces the counters")
+    ap.add_argument("--obs", default=None, metavar="SPEC", nargs="?",
+                    const="1",
+                    help="arm the telemetry recorder (tpu_bfs/obs): span "
+                    "tracing, per-level engine traces, and the flight "
+                    "recorder. SPEC e.g. 'dump_dir=/tmp/fr,window=60'; "
+                    "bare --obs uses defaults; default: the TPU_BFS_OBS "
+                    "env var, else disabled. --stats adds the engine "
+                    "trace-summary line")
+    ap.add_argument("--trace-out", default=None, metavar="PATH",
+                    help="write a Chrome/Perfetto trace-event JSON of the "
+                    "run here (host spans + a per-level engine-trace "
+                    "track: frontier count, direction, gated tiles, "
+                    "exchange choice, modeled wire bytes; implies --obs)")
     args = ap.parse_args(argv)
     from tpu_bfs import faults as faults_mod
 
     sched = faults_mod.arm_from_spec_or_env(args.faults)
     if sched is not None:
         print(f"[faults] schedule armed: {sched.to_spec()}", file=sys.stderr)
+    recorder = _arm_obs(args)
     if args.adaptive_push is not None:
         if (
             args.engine not in ("wide", "hybrid")
@@ -517,7 +588,10 @@ def main(argv=None) -> int:
     from tpu_bfs.algorithms.bfs import BfsEngine
 
     t0 = time.perf_counter()
-    g = load_graph(args.graph)
+    from tpu_bfs import obs as obs_mod
+
+    with obs_mod.maybe_span("graph_load", "cli", cat="cli", graph=args.graph):
+        g = load_graph(args.graph)
     print(f"Number of vertices {g.num_vertices}")  # reference prints these (bfs.cu:789-790)
     print(f"Number of edges {g.num_edges}")
     print(f"[load] {time.perf_counter() - t0:.3f}s")
@@ -584,7 +658,9 @@ def main(argv=None) -> int:
             return TiledBfsEngine(g, pull_gate=args.pull_gate)
         return BfsEngine(g, backend=args.backend)
 
-    engine = make_engine()
+    with obs_mod.maybe_span("engine_build", "cli", cat="cli",
+                            backend=args.backend, devices=args.devices):
+        engine = make_engine()
 
     if args.ckpt or args.resume:
         # Chunked traversal with durable state (tpu_bfs/utils/checkpoint.py):
@@ -611,14 +687,25 @@ def main(argv=None) -> int:
         res = engine.finish(st, with_parents=not args.no_parents)
     else:
         res = None
-        for _ in range(max(1, args.repeat)):
-            with _maybe_profile(args.profile_dir):
-                res = engine.run(
-                    args.source,
-                    max_levels=args.max_levels,
-                    with_parents=not args.no_parents,
-                    time_it=True,
-                )
+        for rep in range(max(1, args.repeat)):
+            if recorder is not None:
+                recorder.begin("run", "cli", cat="cli", source=args.source,
+                               rep=rep)
+            try:
+                with _maybe_profile(args.profile_dir):
+                    res = engine.run(
+                        args.source,
+                        max_levels=args.max_levels,
+                        with_parents=not args.no_parents,
+                        time_it=True,
+                    )
+            finally:
+                if recorder is not None:
+                    recorder.end(
+                        "run", "cli", cat="cli", rep=rep,
+                        levels=None if res is None else res.num_levels,
+                        reached=None if res is None else res.reached,
+                    )
             # Reference prints device elapsed ms (bfs.cu:624-626).
             print(f"Elapsed time in milliseconds (device): {res.elapsed_s * 1e3:.3f}")
     if res.teps:
@@ -663,6 +750,7 @@ def main(argv=None) -> int:
         np.save(args.save_dist, res.distance)
     if args.save_parent and res.parent is not None:
         np.save(args.save_parent, res.parent)
+    _finish_obs(args, engine, type(engine).__name__)
     return 0
 
 

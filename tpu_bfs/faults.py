@@ -295,7 +295,23 @@ class FaultSchedule:
                     break  # one raise per visit; later rules keep budget
         for rule in fired:
             self._count_injected()
+            self._record_obs(site, rule, ctx)
         return fired
+
+    @staticmethod
+    def _record_obs(site: str, rule: FaultRule, ctx: dict) -> None:
+        # Telemetry cross-link (lazy import, same stdlib-only discipline
+        # as _count_injected): when the obs recorder is armed, every
+        # firing lands in the span stream — a flight-recorder dump of a
+        # chaos incident then names the injected fault's site alongside
+        # the spans it broke.
+        from tpu_bfs import obs as _obs
+
+        if _obs.ACTIVE is not None:
+            _obs.ACTIVE.event(
+                "fault_injected", cat="faults", site=site, kind=rule.kind,
+                clause=rule.to_clause(), **ctx,
+            )
 
     @staticmethod
     def _count_injected() -> None:

@@ -1,0 +1,202 @@
+"""Per-level engine traces: ``last_run_trace`` assembly and summaries.
+
+Two sources, matching what each engine family can observe without
+adding device work to its level loop:
+
+- the DISTRIBUTED SINGLE-SOURCE loops (1D ``DistBfsEngine``, 2D
+  ``Dist2DBfsEngine``) already compute a per-level new-frontier popcount
+  (their termination psum) and, on the 1D sparse path, the per-level
+  cap-ladder branch; both now land in small fixed-size carry arrays
+  (:data:`TRACE_LEVELS` slots) that :func:`assemble_dist_trace` prices
+  with ``wire_bytes_per_level()`` — so every per-level row carries
+  frontier count, direction, exchange choice, and modeled wire bytes;
+
+- the PACKED MS engines record per-level gate skips
+  (``last_gate_level_counts``) and exact per-branch exchange level
+  counts (``last_exchange_level_counts``); :func:`assemble_packed_trace`
+  folds those into per-level rows. Their loops compute no per-level
+  frontier popcount (only an ``any``), so packed rows carry
+  ``frontier=None`` and, when a sparse run mixed branches, the exchange
+  choice ``"mixed"`` with the exact per-branch counts in the trace
+  summary — observability must not add reductions to the hot loop the
+  serve bench times.
+
+Every row is one plain dict::
+
+    {"level": int,            # the level being EXPANDED
+     "frontier": int|None,    # vertices claimed by this expansion
+     "direction": str,        # "push" | "pull" | "pull-gated" | ...
+     "gated_tiles": int|None, # blocks the pull gate skipped
+     "exchange": str|None,    # "sparse[cap]" | "dense" | "mixed" | None
+     "wire_bytes": float|None}# modeled off-chip bytes, this level
+
+``engine.last_run_trace`` holds the rows of the engine's most recent
+core invocation (a checkpoint-resumed chunk covers that chunk's levels).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# Per-level recording depth of the distributed single-source loop
+# carries. Deeper traversals clamp into the last slot: its frontier is
+# the exact SUM over the clamped levels (the loops accumulate with
+# .add, so frontier_total never undercounts), its branch/wire columns
+# are the LAST clamped level's, and the assembled row marks itself
+# truncated. 64 levels covers every power-law serving graph by a wide
+# margin.
+TRACE_LEVELS = 64
+
+
+def branch_label(branch: int, caps) -> str:
+    """Human form of a cap-ladder branch index (ascending caps, then the
+    dense fallback — the collectives.cap_ladder_select convention)."""
+    caps = sorted(caps or ())
+    if 0 <= branch < len(caps):
+        return f"sparse[{caps[branch]}]"
+    return "dense"
+
+
+def assemble_dist_trace(
+    engine, levels: int, front_seq, branch_seq, *, direction: str,
+    level0: int = 0,
+) -> list[dict]:
+    """Per-level rows for the distributed single-source engines from
+    their loop-carry recordings. ``front_seq``/``branch_seq`` are the
+    [TRACE_LEVELS] arrays (branch -1 = slot never written); pricing
+    comes from ``engine.wire_bytes_per_level()`` so the trace can never
+    disagree with the exchange accounting. ``levels`` counts the levels
+    THIS invocation ran; ``level0`` re-offsets a checkpoint-resumed
+    chunk's rows to absolute traversal levels. Past ``TRACE_LEVELS`` the
+    last row aggregates: exact frontier sum of the clamped tail,
+    last-written branch/wire, and a ``truncated_levels`` marker."""
+    front = np.asarray(front_seq)
+    branch = np.asarray(branch_seq)
+    per_level = [float(x) for x in engine.wire_bytes_per_level()]
+    # The cap-ladder labels only apply to the sparse exchange; ring/
+    # allreduce runs have one branch, labeled by the impl itself (the
+    # engines keep sparse_caps populated either way, so the caps alone
+    # cannot distinguish the modes).
+    mode = getattr(engine, "_exchange", None)
+    caps = tuple(getattr(engine, "sparse_caps", ()) or ())
+    if mode != "sparse":
+        caps = ()
+    n = min(int(levels), TRACE_LEVELS)
+    rows = []
+    for lvl in range(n):
+        b = int(branch[lvl])
+        known = 0 <= b < len(per_level)
+        label = branch_label(b, caps) if known else None
+        if label == "dense" and mode not in (None, "sparse"):
+            label = mode
+        rows.append({
+            "level": int(level0) + lvl,
+            "frontier": int(front[lvl]),
+            "direction": direction,
+            "gated_tiles": None,
+            "exchange": label,
+            "wire_bytes": per_level[b] if known else None,
+        })
+    if int(levels) > TRACE_LEVELS:
+        rows[-1]["truncated_levels"] = int(levels) - TRACE_LEVELS + 1
+    return rows
+
+
+def assemble_packed_trace(engine, levels: int) -> list[dict]:
+    """Per-level rows for a packed MS engine's last run, from its
+    host-visible artifacts (gate counters, per-branch exchange counts).
+    Exchange choice is exact when the whole run used one branch (always
+    true for dense exchanges); a mixed sparse run labels rows "mixed"
+    and the exact split lives in :func:`trace_summary`."""
+    n = int(levels)
+    gc = getattr(engine, "last_gate_level_counts", None)
+    if gc is not None:
+        gc = np.asarray(gc)
+    direction = "pull-gated" if getattr(engine, "pull_gate", False) else "pull"
+    if getattr(engine, "_adaptive_push", None) or getattr(
+        engine, "adaptive_push", None
+    ):
+        direction = "pull+adaptive-push"
+    counts = getattr(engine, "last_exchange_level_counts", None)
+    caps = tuple(getattr(engine, "sparse_caps", ()) or ())
+    exchange = None
+    wire_each = None
+    if counts is not None:
+        counts = np.asarray(counts)
+        wb = getattr(engine, "wire_bytes_per_level", None)
+        per_level = [float(x) for x in wb()] if wb is not None else None
+        used = np.flatnonzero(counts)
+        if len(used) == 1:
+            b = int(used[0])
+            exchange = branch_label(b, caps) if len(counts) > 1 else "dense"
+            if per_level is not None:
+                wire_each = per_level[b]
+        elif len(used) > 1:
+            exchange = "mixed"
+    rows = []
+    for lvl in range(n):
+        rows.append({
+            "level": lvl,
+            "frontier": None,
+            "direction": direction,
+            "gated_tiles": int(gc[lvl]) if gc is not None and lvl < len(gc)
+            else None,
+            "exchange": exchange,
+            "wire_bytes": wire_each,
+        })
+    return rows
+
+
+def trace_summary(trace, engine=None) -> dict:
+    """Compact verdict/statsz form of one ``last_run_trace``: the keys
+    bench.py folds into its JSON line (BENCHMARKS.md "Trace summary")."""
+    trace = trace or []
+    out: dict = {"levels": len(trace)}
+    fronts = [r["frontier"] for r in trace if r.get("frontier") is not None]
+    if fronts:
+        out["frontier_total"] = int(sum(fronts))
+        out["frontier_peak"] = int(max(fronts))
+    directions = sorted({r["direction"] for r in trace if r.get("direction")})
+    if directions:
+        out["directions"] = directions
+    gates = [r["gated_tiles"] for r in trace if r.get("gated_tiles") is not None]
+    if gates:
+        out["gated_tiles_total"] = int(sum(gates))
+    exchanges: dict = {}
+    for r in trace:
+        ex = r.get("exchange")
+        if ex is not None:
+            exchanges[ex] = exchanges.get(ex, 0) + 1
+    if exchanges:
+        out["exchange_levels"] = exchanges
+    wires = [r["wire_bytes"] for r in trace if r.get("wire_bytes") is not None]
+    if wires:
+        out["wire_bytes_total"] = float(sum(wires))
+    if engine is not None:
+        counts = getattr(engine, "last_exchange_level_counts", None)
+        if counts is not None:
+            out["exchange_branch_counts"] = [int(x) for x in np.asarray(counts)]
+        wbytes = getattr(engine, "last_exchange_bytes", None)
+        if wbytes is not None:
+            # The accounting's figure wins (covers levels past the trace
+            # clamp and mixed-branch packed runs).
+            out["wire_bytes_total"] = float(wbytes)
+    return out
+
+
+def record_packed_run(engine, levels: int, *, recorder=None,
+                      label: str | None = None) -> list[dict]:
+    """Assemble and store ``engine.last_run_trace`` for a finished packed
+    batch, emitting one per-level obs event per row when a recorder is
+    given. Called only under the obs ACTIVE guard (the assembly reads
+    ``last_gate_level_counts``, a device array — transferring it per
+    batch must not tax the un-instrumented serve hot path)."""
+    trace = assemble_packed_trace(engine, levels)
+    engine.last_run_trace = trace
+    if recorder is not None:
+        name = label or type(engine).__name__
+        recorder.event(
+            "engine.run_trace", cat="engine", engine=name,
+            summary=trace_summary(trace, engine),
+        )
+    return trace

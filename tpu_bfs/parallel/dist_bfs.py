@@ -51,6 +51,7 @@ from tpu_bfs.parallel.collectives import (
     sparse_exchange_or,
     sparse_wire_bytes_per_level,
 )
+from tpu_bfs.obs.engine_trace import TRACE_LEVELS, assemble_dist_trace
 from tpu_bfs.parallel.partition import out_csr_1d, partition_1d
 from tpu_bfs.utils.timing import run_timed
 
@@ -95,7 +96,15 @@ def _dist_bfs_fn(
     words, 32 vertices/word — collectives.pack_bits): the dense ring/
     allreduce paths and the sparse exchange's dense fallback; the sparse
     id rungs already move 4-byte ids. Same collective count, 1/8-1/32 the
-    bytes (wirecheck.check_packed_exchange proves it from the HLO)."""
+    bytes (wirecheck.check_packed_exchange proves it from the HLO).
+
+    The carry also records two tiny per-level arrays for the engine trace
+    (tpu_bfs/obs/engine_trace, ISSUE 6): the new-frontier popcount and
+    the exchange-branch index of each level, in [TRACE_LEVELS] int32
+    slots (levels past the window clamp into the last slot). Both reuse
+    scalars the loop already computes — the termination psum and the
+    ladder branch — so the recording is two dynamic-updates of 256-byte
+    replicated arrays per level, collective-free."""
     nb = len(sparse_caps) + 1 if exchange == "sparse" else 1
     dopt = backend == "dopt"
 
@@ -130,11 +139,12 @@ def _dist_bfs_fn(
             expand_local = dense_fn
 
         def cond(state):
-            _, _, _, level, front_count, _ = state
+            _, _, _, level, front_count, _, _, _ = state
             return (front_count > 0) & (level < max_levels)
 
         def body(state):
-            frontier, visited, dist, level, _, branch_counts = state
+            (frontier, visited, dist, level, _, branch_counts,
+             front_seq, branch_seq) = state
             contrib = expand_local(frontier)
             if exchange == "sparse":
                 hit, branch = sparse_exchange_or(
@@ -152,16 +162,29 @@ def _dist_bfs_fn(
             dist = jnp.where(new, level + 1, dist)
             visited = visited | new
             count = lax.psum(jnp.sum(new.astype(jnp.int32)), "v")
-            return new, visited, dist, level + 1, count, branch_counts
+            # Engine-trace slot for the level just EXPANDED (relative to
+            # this invocation's resume point; the assembler re-offsets).
+            # Frontier counts ADD so the clamp slot aggregates every
+            # level past the window (frontier_total stays exact); the
+            # branch index is last-write-wins there (documented in
+            # engine_trace.assemble_dist_trace).
+            slot = jnp.minimum(level - level0, TRACE_LEVELS - 1)
+            front_seq = front_seq.at[slot].add(count)
+            branch_seq = branch_seq.at[slot].set(branch)
+            return (new, visited, dist, level + 1, count, branch_counts,
+                    front_seq, branch_seq)
 
         init_count = lax.psum(jnp.sum(frontier.astype(jnp.int32)), "v")
-        frontier, visited, dist, level, _, branch_counts = lax.while_loop(
+        (frontier, visited, dist, level, _, branch_counts, front_seq,
+         branch_seq) = lax.while_loop(
             cond,
             body,
             (frontier, visited, dist, jnp.int32(level0), init_count,
-             jnp.zeros(nb, jnp.int32)),
+             jnp.zeros(nb, jnp.int32),
+             jnp.zeros(TRACE_LEVELS, jnp.int32),
+             jnp.full(TRACE_LEVELS, -1, jnp.int32)),
         )
-        return frontier, visited, dist, level, branch_counts
+        return frontier, visited, dist, level, branch_counts, front_seq, branch_seq
 
     aux_specs = (P("v", None), P("v", None)) if dopt else ()
     return jax.jit(
@@ -179,7 +202,7 @@ def _dist_bfs_fn(
                 P(),
                 P(),
             ),
-            out_specs=(P("v"), P("v"), P("v"), P(), P()),
+            out_specs=(P("v"), P("v"), P("v"), P(), P(), P(), P()),
             check_vma=False,
         )
     )
@@ -361,6 +384,13 @@ class DistBfsEngine(VertexCheckpointMixin):
         #: the off-chip bytes one chip moved — set by distances_padded/advance.
         self.last_exchange_level_counts: np.ndarray | None = None
         self.last_exchange_bytes: float | None = None
+        # Raw loop carries of the last core invocation; the per-level
+        # rows assemble lazily on first last_run_trace access (property
+        # below) so the device->host transfers and row building stay out
+        # of run_timed's wall clock.
+        self._trace_pending: tuple | None = None
+        self._trace_cache: list[dict] | None = None
+        self._direction = "dopt" if backend == "dopt" else "push"
         self._warmed = False
 
     def wire_bytes_per_level(self) -> list[float]:
@@ -390,6 +420,30 @@ class DistBfsEngine(VertexCheckpointMixin):
         self.last_exchange_level_counts = counts
         self.last_exchange_bytes = float(np.dot(counts, self.wire_bytes_per_level()))
 
+    @property
+    def last_run_trace(self) -> list[dict] | None:
+        """Per-level rows of the last core invocation (frontier count,
+        direction, exchange choice, modeled wire bytes) — the unified
+        engine-trace contract (tpu_bfs/obs/engine_trace, ISSUE 6).
+        Assembled lazily from the stashed loop carries so the timed path
+        pays nothing for the trace."""
+        pend = self._trace_pending
+        if pend is not None:
+            level, front_seq, branch_seq, level0 = pend
+            self._trace_pending = None
+            self._trace_cache = assemble_dist_trace(
+                self, int(level) - level0, front_seq, branch_seq,
+                direction=self._direction, level0=level0,
+            )
+        return self._trace_cache
+
+    @last_run_trace.setter
+    def last_run_trace(self, rows: list[dict] | None) -> None:
+        # The roofline walk overwrites the trace with its own (richer,
+        # exact-frontier) rows — honor direct assignment.
+        self._trace_pending = None
+        self._trace_cache = rows
+
     def _init_state(self, source: int):
         part = self.part
         pid = int(part.to_padded(source))
@@ -404,11 +458,13 @@ class DistBfsEngine(VertexCheckpointMixin):
         """Device (padded-id, sharded) distance vector + level counter."""
         frontier0, visited0, dist0 = self._init_state(source)
         ml = jnp.int32(max_levels if max_levels is not None else self.part.vp)
-        _, _, dist, level, branch_counts = self._loop(
+        _, _, dist, level, branch_counts, front_seq, branch_seq = self._loop(
             self.src, self.dst, self.rp, self._aux, frontier0, visited0, dist0,
             jnp.int32(0), ml,
         )
         self._record_exchange(branch_counts)
+        self._trace_pending = (level, front_seq, branch_seq, 0)
+        self._trace_cache = None
         return dist, level
 
     # --- checkpoint/resume: VertexCheckpointMixin provides
@@ -419,13 +475,17 @@ class DistBfsEngine(VertexCheckpointMixin):
         return self.part.num_vertices
 
     def _advance_loop(self, f0, vis0, d0, level0: int, cap: int, *, chain_nonce=None):
-        frontier, visited, dist, level, branch_counts = self._loop(
-            self.src, self.dst, self.rp, self._aux, f0, vis0, d0,
-            jnp.int32(level0), jnp.int32(cap),
+        frontier, visited, dist, level, branch_counts, front_seq, branch_seq = (
+            self._loop(
+                self.src, self.dst, self.rp, self._aux, f0, vis0, d0,
+                jnp.int32(level0), jnp.int32(cap),
+            )
         )
         self._record_exchange(
             branch_counts, resumed_level=level0, chain_nonce=chain_nonce
         )
+        self._trace_pending = (level, front_seq, branch_seq, level0)
+        self._trace_cache = None
         return frontier, visited, dist, level
 
     def run(

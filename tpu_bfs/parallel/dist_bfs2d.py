@@ -43,6 +43,7 @@ from tpu_bfs.parallel.collectives import (
     reduce_scatter_or,
     unpack_bits,
 )
+from tpu_bfs.obs.engine_trace import TRACE_LEVELS, assemble_dist_trace
 from tpu_bfs.parallel.dist_bfs import VertexCheckpointMixin
 from tpu_bfs.parallel.partition2d import out_csr_2d, partition_2d
 from tpu_bfs.utils.timing import run_timed
@@ -103,11 +104,11 @@ def _dist2d_bfs_fn(mesh: Mesh, rows: int, cols: int, w: int, exchange: str,
             expand_local = dense_fn
 
         def cond(state):
-            _, _, _, level, count = state
+            _, _, _, level, count, _ = state
             return (count > 0) & (level < max_levels)
 
         def body(state):
-            frontier, visited, dist, level, _ = state
+            frontier, visited, dist, level, _, front_seq = state
             # Column exchange: assemble this mesh column's frontier slices.
             if wire_pack and rows > 1:
                 # Packed wire: gather uint32 words (one per 32 vertices of
@@ -127,13 +128,21 @@ def _dist2d_bfs_fn(mesh: Mesh, rows: int, cols: int, w: int, exchange: str,
             dist = jnp.where(new, level + 1, dist)
             visited = visited | new
             count = lax.psum(jnp.sum(new.astype(jnp.int32)), ("r", "c"))
-            return new, visited, dist, level + 1, count
+            # Engine-trace slot (tpu_bfs/obs/engine_trace): the 2D loop
+            # has no exchange ladder, so only the frontier popcount —
+            # already paid by the termination psum — is recorded. ADD,
+            # not set: the clamp slot aggregates levels past the window.
+            slot = jnp.minimum(level - level0, TRACE_LEVELS - 1)
+            front_seq = front_seq.at[slot].add(count)
+            return new, visited, dist, level + 1, count, front_seq
 
         init = lax.psum(jnp.sum(frontier.astype(jnp.int32)), ("r", "c"))
-        frontier, visited, dist, level, _ = lax.while_loop(
-            cond, body, (frontier, visited, dist, jnp.int32(level0), init)
+        frontier, visited, dist, level, _, front_seq = lax.while_loop(
+            cond, body,
+            (frontier, visited, dist, jnp.int32(level0), init,
+             jnp.zeros(TRACE_LEVELS, jnp.int32)),
         )
-        return frontier, visited, dist, level
+        return frontier, visited, dist, level, front_seq
 
     aux_specs = (P("r", "c", None), P("r", "c", None)) if dopt else ()
     return jax.jit(
@@ -151,7 +160,7 @@ def _dist2d_bfs_fn(mesh: Mesh, rows: int, cols: int, w: int, exchange: str,
                 P(),
                 P(),
             ),
-            out_specs=(P(("r", "c")), P(("r", "c")), P(("r", "c")), P()),
+            out_specs=(P(("r", "c")), P(("r", "c")), P(("r", "c")), P(), P()),
             check_vma=False,
         )
     )
@@ -264,6 +273,12 @@ class Dist2DBfsEngine(VertexCheckpointMixin):
         #: analog of DistBfsEngine's exchange accounting.
         self.last_exchange_level_counts: np.ndarray | None = None
         self.last_exchange_bytes: float | None = None
+        # Raw loop carries of the last core invocation; the per-level
+        # rows assemble lazily on first last_run_trace access (same
+        # contract as DistBfsEngine.last_run_trace).
+        self._trace_pending: tuple | None = None
+        self._trace_cache: list[dict] | None = None
+        self._direction = "dopt" if backend == "dopt" else "push"
         self._warmed = False
 
     def wire_bytes_per_level(self) -> list[float]:
@@ -301,11 +316,12 @@ class Dist2DBfsEngine(VertexCheckpointMixin):
     def distances_padded(self, source: int, *, max_levels: int | None = None):
         frontier0, visited0, dist0 = self._init_state(source)
         ml = jnp.int32(max_levels if max_levels is not None else self.part.vp)
-        _, _, dist, level = self._loop(
+        _, _, dist, level, front_seq = self._loop(
             self.src_g, self.dst_l, self.rp, self._aux,
             frontier0, visited0, dist0, jnp.int32(0), ml,
         )
         self._record_exchange(int(level))
+        self._record_trace(front_seq, int(level), 0)
         return dist, level
 
     # --- checkpoint/resume: VertexCheckpointMixin (dist_bfs.py) provides
@@ -317,14 +333,45 @@ class Dist2DBfsEngine(VertexCheckpointMixin):
         return self.part.base.num_vertices
 
     def _advance_loop(self, f0, vis0, d0, level0: int, cap: int, *, chain_nonce=None):
-        frontier, visited, dist, level = self._loop(
+        frontier, visited, dist, level, front_seq = self._loop(
             self.src_g, self.dst_l, self.rp, self._aux, f0, vis0, d0,
             jnp.int32(level0), jnp.int32(cap),
         )
         self._record_exchange(
             int(level) - level0, resumed_level=level0, chain_nonce=chain_nonce
         )
+        self._record_trace(front_seq, int(level) - level0, level0)
         return frontier, visited, dist, level
+
+    def _record_trace(self, front_seq, levels_run: int, level0: int) -> None:
+        self._trace_pending = (front_seq, int(levels_run), int(level0))
+        self._trace_cache = None
+
+    @property
+    def last_run_trace(self) -> list[dict] | None:
+        """Per-level rows of the last core invocation — assembled lazily
+        (same contract and rationale as DistBfsEngine.last_run_trace;
+        tpu_bfs/obs/engine_trace)."""
+        pend = self._trace_pending
+        if pend is not None:
+            front_seq, levels_run, level0 = pend
+            self._trace_pending = None
+            # The 2D loop has one exchange branch (no cap ladder): every
+            # recorded level ran branch 0, levels past the trace window
+            # stay -1 so the assembler prices only what was recorded.
+            branch_seq = np.where(
+                np.arange(TRACE_LEVELS) < min(levels_run, TRACE_LEVELS), 0, -1
+            ).astype(np.int32)
+            self._trace_cache = assemble_dist_trace(
+                self, levels_run, front_seq, branch_seq,
+                direction=self._direction, level0=level0,
+            )
+        return self._trace_cache
+
+    @last_run_trace.setter
+    def last_run_trace(self, rows: list[dict] | None) -> None:
+        self._trace_pending = None
+        self._trace_cache = rows
 
     def run(
         self,

@@ -25,12 +25,14 @@ still resolves exactly once.
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 
 import numpy as np
 
 from tpu_bfs import faults as _faults
+from tpu_bfs import obs as _obs
 from tpu_bfs.serve.scheduler import STATUS_ERROR, STATUS_OK, QueryResult
 from tpu_bfs.utils.recovery import (
     COUNTERS,
@@ -149,6 +151,12 @@ class CircuitBreaker:
             )
 
 
+# Batch ordinals are assigned unconditionally (one integer increment):
+# the obs layer needs a stable correlation id, and tests that spy on the
+# disabled path count obs-layer CALLS, not plain counters.
+_BATCH_SEQ = itertools.count(1)
+
+
 class OomRequeue(Exception):
     """Internal signal: the batch OOM'd; its queries ride along for the
     service to degrade the lane count and re-admit."""
@@ -169,7 +177,7 @@ class PendingBatch:
     halves so the retry budget cannot double through the handoff."""
 
     __slots__ = ("engine", "queries", "n", "padded", "handle", "attempt",
-                 "lanes")
+                 "lanes", "bid")
 
     def __init__(self, engine, queries, n: int, padded: np.ndarray):
         self.engine = engine
@@ -182,6 +190,9 @@ class PendingBatch:
         # the device-table reference before a narrower rebuild, but the
         # service still needs the width the failure happened at.
         self.lanes = engine.lanes
+        # Process-wide batch ordinal: the span-correlation id every obs
+        # event of this batch (and its queries) carries.
+        self.bid = next(_BATCH_SEQ)
 
 
 class _Ready:
@@ -236,6 +247,22 @@ class BatchExecutor:
         sources = np.asarray([q.source for q in queries], dtype=np.int64)
         padded, n = pad_batch(sources, engine.lanes)
         pending = PendingBatch(engine, queries, n, padded)
+        rec = _obs.ACTIVE
+        if rec is not None:
+            # The batch span opens at dispatch and closes when every
+            # query resolved (finish) or the batch failed; every query's
+            # own span learns its batch id here. Latest wins: a query
+            # requeued out of an OOM'd batch must close naming the batch
+            # that actually served it, not the aborted one (the aborted
+            # batch's own events still list the query id).
+            for q in pending.queries:
+                if hasattr(q, "obs_batch"):
+                    q.obs_batch = pending.bid
+            rec.begin("batch", f"b{pending.bid}", cat="serve.batch",
+                      batch=pending.bid, n=n, width=engine.lanes,
+                      queries=[q.id for q in pending.queries])
+            rec.begin("dispatch", f"b{pending.bid}", cat="serve.batch",
+                      batch=pending.bid, width=engine.lanes)
         while True:
             try:
                 if _faults.ACTIVE is not None:
@@ -245,9 +272,29 @@ class BatchExecutor:
                     _faults.ACTIVE.hit("serve_batch", lanes=engine.lanes,
                                        n=pending.n)
                 pending.handle = self._dispatch(engine, padded)
+                if rec is not None:
+                    rec.end("dispatch", f"b{pending.bid}", cat="serve.batch",
+                            batch=pending.bid, attempt=pending.attempt)
                 return pending
             except Exception as exc:  # noqa: BLE001 — gated by the classifier
-                if not self._classify_failure(pending, exc):
+                try:
+                    retry = self._classify_failure(pending, exc)
+                except OomRequeue:
+                    # The OOM rides up to the service's requeue ladder;
+                    # the open dispatch span must not dangle in the trace
+                    # (the classifier already ended the batch span).
+                    if rec is not None:
+                        rec.end("dispatch", f"b{pending.bid}",
+                                cat="serve.batch", batch=pending.bid,
+                                oom=True)
+                    raise
+                if not retry:
+                    if rec is not None:
+                        rec.end("dispatch", f"b{pending.bid}",
+                                cat="serve.batch", batch=pending.bid,
+                                failed=True)
+                        rec.end("batch", f"b{pending.bid}", cat="serve.batch",
+                                batch=pending.bid, failed=True)
                     return None
 
     def finish_batch(self, pending: PendingBatch) -> None:
@@ -256,6 +303,10 @@ class BatchExecutor:
         (the handle is dead once its fetch raised); OOM raises
         :class:`OomRequeue` exactly as the dispatch half does."""
         engine = pending.engine
+        rec = _obs.ACTIVE
+        if rec is not None:
+            rec.begin("fetch", f"b{pending.bid}", cat="serve.batch",
+                      batch=pending.bid, n=pending.n)
         while True:
             try:
                 if pending.handle is None:  # re-dispatch after a retry
@@ -264,8 +315,25 @@ class BatchExecutor:
                 break
             except Exception as exc:  # noqa: BLE001 — gated by the classifier
                 pending.handle = None
-                if not self._classify_failure(pending, exc):
+                try:
+                    retry = self._classify_failure(pending, exc)
+                except OomRequeue:
+                    # Same discipline as the dispatch half: close the
+                    # open fetch span before the OOM rides up.
+                    if rec is not None:
+                        rec.end("fetch", f"b{pending.bid}", cat="serve.batch",
+                                batch=pending.bid, oom=True)
+                    raise
+                if not retry:
+                    if rec is not None:
+                        rec.end("fetch", f"b{pending.bid}", cat="serve.batch",
+                                batch=pending.bid, failed=True)
+                        rec.end("batch", f"b{pending.bid}", cat="serve.batch",
+                                batch=pending.bid, failed=True)
                     return
+        if rec is not None:
+            rec.end("fetch", f"b{pending.bid}", cat="serve.batch",
+                    batch=pending.bid, attempt=pending.attempt)
         # The result now owns whatever device state extraction needs; drop
         # the handle's copy so the batch's loop outputs free as soon as
         # the result does.
@@ -354,6 +422,15 @@ class BatchExecutor:
             if tripped:
                 COUNTERS.bump("watchdog_trips")
                 self.metrics.record_watchdog_trip()
+                rec = _obs.ACTIVE
+                if rec is not None:
+                    # Flight-recorder trigger: the trip is exactly the
+                    # incident class the ring buffer exists to replay.
+                    rec.event("watchdog_trip", cat="serve.batch",
+                              batch=pending.bid, n=pending.n,
+                              watchdog_s=self.watchdog_s,
+                              queries=[q.id for q in pending.queries])
+                    rec.flight_dump("watchdog_trip")
                 raise RuntimeError(
                     f"DEADLINE_EXCEEDED: dispatch watchdog: a "
                     f"{pending.n}-query batch's device fetch is still "
@@ -368,13 +445,24 @@ class BatchExecutor:
     def _classify_failure(self, pending: PendingBatch, exc) -> bool:
         """The one classifier both halves share. True = retry the batch;
         False = resolved as deterministic errors; OOM raises OomRequeue."""
+        rec = _obs.ACTIVE
         if is_oom_failure(exc):
+            if rec is not None:
+                rec.event("batch_oom", cat="serve.batch", batch=pending.bid,
+                          width=pending.lanes,
+                          queries=[q.id for q in pending.queries])
+                rec.end("batch", f"b{pending.bid}", cat="serve.batch",
+                        batch=pending.bid, oom=True)
             raise OomRequeue(list(pending.queries), exc) from exc
         if is_transient_failure(exc) and pending.attempt < self.max_retries:
             pending.attempt += 1
             wait = min(self.backoff_s * pending.attempt, self.backoff_cap_s)
             self.metrics.record_retry()
             COUNTERS.bump("transient_retries")
+            if rec is not None:
+                rec.event("retry", cat="serve.batch", batch=pending.bid,
+                          attempt=pending.attempt,
+                          error=f"{type(exc).__name__}: {str(exc)[:120]}")
             self._log(
                 f"transient failure serving a {pending.n}-query batch "
                 f"(attempt {pending.attempt}/{self.max_retries}): "
@@ -385,23 +473,51 @@ class BatchExecutor:
             return True
         err = f"{type(exc).__name__}: {str(exc)[:300]}"
         self._log(f"batch failed deterministically: {err}")
+        if rec is not None:
+            rec.event("batch_error", cat="serve.batch", batch=pending.bid,
+                      width=pending.lanes, error=err,
+                      queries=[q.id for q in pending.queries])
         if self.breaker is not None:
             # Deterministic failures (exhausted transients included) feed
             # the per-width breaker so routing stops paying this rung's
             # full retry ladder per batch once it is provably broken.
-            self.breaker.record_failure(pending.lanes)
+            opened = self.breaker.record_failure(pending.lanes)
+            if opened and rec is not None:
+                # Flight-recorder trigger: a rung going provably dark is
+                # an incident worth a replayable artifact.
+                rec.event("breaker_open", cat="serve.batch",
+                          width=pending.lanes, batch=pending.bid)
+                rec.flight_dump("breaker_open")
         for q in pending.queries:
             q.resolve_status(STATUS_ERROR, error=err)
         self.metrics.record_errors(pending.n)
         return False
 
     def _resolve_ok(self, pending: PendingBatch, res) -> None:
+        if self.breaker is not None:
+            self.breaker.record_success(pending.engine.lanes)
+        rec = _obs.ACTIVE
+        if rec is not None:
+            rec.begin("extract", f"b{pending.bid}", cat="serve.batch",
+                      batch=pending.bid, n=pending.n)
+        try:
+            self._extract(pending, res, rec)
+        except Exception:
+            # An extraction failure propagates to the service's catch-all
+            # (which flight-dumps it); the open extract/batch spans must
+            # not dangle in the very trace written for that incident.
+            if rec is not None:
+                rec.end("extract", f"b{pending.bid}", cat="serve.batch",
+                        batch=pending.bid, failed=True)
+                rec.end("batch", f"b{pending.bid}", cat="serve.batch",
+                        batch=pending.bid, failed=True)
+            raise
+
+    def _extract(self, pending: PendingBatch, res, rec) -> None:
         from tpu_bfs.graph.csr import INF_DIST
 
         engine, queries, n = pending.engine, pending.queries, pending.n
         width = engine.lanes
-        if self.breaker is not None:
-            self.breaker.record_success(width)
         # The on-device ecc summary is only worth its kernel dispatch when
         # some query skips the distance decode; all-want_distances batches
         # derive levels from the rows they pull anyway.
@@ -442,4 +558,9 @@ class BatchExecutor:
             ))
             latencies.append(latency_ms)
         extract_ms = (time.monotonic() - t_x0) * 1e3
+        if rec is not None:
+            rec.end("extract", f"b{pending.bid}", cat="serve.batch",
+                    batch=pending.bid, extract_ms=round(extract_ms, 3))
+            rec.end("batch", f"b{pending.bid}", cat="serve.batch",
+                    batch=pending.bid, n=n, width=width)
         self.metrics.record_batch(n, width, latencies, extract_ms=extract_ms)

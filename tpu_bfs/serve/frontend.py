@@ -34,6 +34,7 @@ import argparse
 import base64
 import io
 import json
+import os
 import queue as _queue
 import signal
 import sys
@@ -43,6 +44,7 @@ import time
 import numpy as np
 
 from tpu_bfs import faults as _faults
+from tpu_bfs import obs as _obs
 from tpu_bfs.serve.executor import BatchExecutor, CircuitBreaker, OomRequeue
 from tpu_bfs.serve.metrics import ServeMetrics
 from tpu_bfs.serve.registry import DEFAULT_PLANES, EngineRegistry, EngineSpec
@@ -376,6 +378,17 @@ class BfsService:
         out["resident_engines"] = None if resident is None else len(resident)
         return out
 
+    def metricz(self) -> str:
+        """The one-shot /metricz observation: statsz()'s snapshot
+        through the ONE renderer (ServeMetrics.prometheus_text). The
+        JSONL server's periodic ``--metricz-out`` instead renders the
+        exact snapshot dict its statsz line just printed — one
+        observation, two formats, never disagreeing (this one-shot form
+        takes its own fresh snapshot, deliberately without
+        mark_interval so it cannot consume the periodic line's
+        interval-QPS window)."""
+        return self.metrics.prometheus_text(snapshot=self.statsz())
+
     # --- scheduler thread -------------------------------------------------
 
     def _route_width(self, n: int) -> int:
@@ -454,6 +467,10 @@ class BfsService:
         self._log(f"OOM degrade: {at_width} -> {new} lanes (cap {new})")
         COUNTERS.bump("oom_degrades")
         self.metrics.record_oom_degrade(requeued)
+        rec = _obs.ACTIVE
+        if rec is not None:
+            rec.event("oom_degrade", cat="serve.batch", from_width=at_width,
+                      to_width=new, requeued=requeued)
         return True
 
     def _handle_batch_oom(self, queries, at_width: int, cause) -> None:
@@ -489,6 +506,14 @@ class BfsService:
             COUNTERS.bump("requeue_sheds", shed)
             self.metrics.record_requeue_shed(shed)
             self.metrics.record_errors(shed)
+            rec = _obs.ACTIVE
+            if rec is not None:
+                # Flight-recorder trigger: queries dying at the requeue
+                # budget mean every remaining rung is failing — exactly
+                # the incident whose run-up the ring buffer holds.
+                rec.event("requeue_shed", cat="serve.batch", shed=shed,
+                          width=at_width)
+                rec.flight_dump("requeue_shed")
         queries = live
         if not queries:
             # Still account the degrade attempt below even when every
@@ -540,6 +565,15 @@ class BfsService:
         except Exception as exc:  # noqa: BLE001 — resolve, never strand
             err = f"{type(exc).__name__}: {str(exc)[:300]}"
             self._log(f"batch extraction failed: {err}")
+            rec = _obs.ACTIVE
+            if rec is not None:
+                # Flight-recorder trigger: an error the executor's
+                # classifier did not translate is by definition the
+                # unexpected kind — dump the run-up.
+                rec.event("executor_error", cat="serve.batch",
+                          batch=getattr(pending, "bid", None), error=err,
+                          queries=[q.id for q in pending.queries])
+                rec.flight_dump("executor_error")
             n = 0
             for q in pending.queries:
                 if q.resolve_status(STATUS_ERROR, error=err):
@@ -587,7 +621,16 @@ class BfsService:
             if not live:
                 continue
             try:
-                engine = self._acquire_engine(self._route_width(len(live)))
+                width = self._route_width(len(live))
+                rec = _obs.ACTIVE
+                if rec is not None:
+                    # The coalesce record: which queries formed this
+                    # batch and which ladder rung routing picked — the
+                    # span-chain link between admission and dispatch.
+                    rec.event("coalesce", cat="serve.batch", n=len(live),
+                              width=width, queries=[q.id for q in live],
+                              queue_depth=self._queue.depth())
+                engine = self._acquire_engine(width)
                 if len(live) > engine.lanes:
                     # An OOM degraded the cap AFTER this batch was popped
                     # at the old one: serve what fits, re-admit the tail
@@ -734,9 +777,36 @@ def build_arg_parser() -> argparse.ArgumentParser:
                     "omit distances_npy AND the distance rows are never "
                     "pulled off the device (per-request "
                     "\"want_distances\" overrides)")
-    ap.add_argument("--statsz-every", type=float, default=10.0,
-                    help="seconds between statsz lines on stderr; 0 "
-                    "disables (default 10)")
+    ap.add_argument("--statsz-interval-s", type=float, default=None,
+                    metavar="S",
+                    help="seconds between periodic telemetry emissions "
+                    "(the stderr statsz line AND the --metricz-out text, "
+                    "which render the same snapshot); 0 disables. "
+                    "Default: the TPU_BFS_STATSZ_INTERVAL env var, else "
+                    "10")
+    ap.add_argument("--statsz-every", type=float, default=None,
+                    help="legacy alias of --statsz-interval-s")
+    ap.add_argument("--obs", default=None, metavar="SPEC", nargs="?",
+                    const="1",
+                    help="arm the telemetry recorder (tpu_bfs/obs): span "
+                    "tracing through the serve lifecycle, per-level "
+                    "engine traces, and the flight recorder (auto-dumps "
+                    "the last window on watchdog trip / breaker open / "
+                    "requeue shed / executor error / SIGTERM drain). "
+                    "SPEC e.g. 'dump_dir=/tmp/fr,window=60'; bare --obs "
+                    "uses defaults; default: the TPU_BFS_OBS env var, "
+                    "else disabled")
+    ap.add_argument("--trace-out", default=None, metavar="PATH",
+                    help="write a Chrome/Perfetto trace-event JSON of the "
+                    "whole serving session here at exit (implies --obs)")
+    ap.add_argument("--metricz-out", default=None, metavar="PATH",
+                    help="write the Prometheus-style /metricz text here, "
+                    "atomically replaced every statsz interval and once "
+                    "at exit")
+    ap.add_argument("--xprof-dir", default=None, metavar="DIR",
+                    help="capture a jax.profiler device trace of the "
+                    "serving session into DIR, so device profiles line "
+                    "up with the host spans in --trace-out")
     ap.add_argument("--registry-cap", type=int, default=4,
                     help="LRU bound on resident warmed engines (default 4, "
                     "raised automatically to fit the width ladder's rungs "
@@ -793,6 +863,30 @@ def _parse_request_line(line: str):
     return qid, source, ddl, want
 
 
+DEFAULT_STATSZ_INTERVAL_S = 10.0
+
+
+def resolve_statsz_interval(args, *, env=None) -> float:
+    """The periodic-emission interval precedence (ISSUE 6 satellite):
+    ``--statsz-interval-s`` wins, then the legacy ``--statsz-every``
+    alias, then ``TPU_BFS_STATSZ_INTERVAL``, then 10 s. One resolved
+    value drives BOTH renderings of the snapshot — the stderr statsz
+    line and the ``--metricz-out`` text — so they stay on one cadence.
+    An unparsable env value falls back to the default (a typo'd fleet
+    variable must not kill the periodic line)."""
+    interval = getattr(args, "statsz_interval_s", None)
+    if interval is None:
+        interval = getattr(args, "statsz_every", None)
+    if interval is None:
+        env_iv = (env if env is not None
+                  else os.environ.get("TPU_BFS_STATSZ_INTERVAL", "")).strip()
+        try:
+            interval = float(env_iv) if env_iv else DEFAULT_STATSZ_INTERVAL_S
+        except ValueError:
+            interval = DEFAULT_STATSZ_INTERVAL_S
+    return float(interval)
+
+
 def run_server(args, stdin=None, stdout=None, stderr=None,
                registry=None) -> int:
     """The JSONL loop, parameterized over streams (and optionally a
@@ -819,6 +913,25 @@ def run_server(args, stdin=None, stdout=None, stderr=None,
     sched = _faults.arm_from_spec_or_env(args.faults)
     if sched is not None:
         log(f"fault-injection schedule ARMED: {sched.to_spec()}")
+
+    # Telemetry arming: --obs SPEC wins, else TPU_BFS_OBS; --trace-out
+    # needs a recorder, so it arms one with defaults when nothing else
+    # did. The recorder is armed BEFORE the service so registry
+    # build/warm spans land in the trace (cold start is the expensive
+    # part worth seeing).
+    recorder = _obs.arm_for_run(getattr(args, "obs", None),
+                                getattr(args, "trace_out", None))
+    if recorder is not None:
+        log(f"telemetry recorder ARMED (capacity "
+            f"{recorder._events.maxlen}, flight window "
+            f"{recorder.window_s:.0f}s, dump dir {recorder.dump_dir!r})")
+    statsz_interval = resolve_statsz_interval(args)
+    xprof = getattr(args, "xprof_dir", None)
+    if xprof:
+        import jax
+
+        jax.profiler.start_trace(xprof)
+        log(f"jax.profiler trace started -> {xprof}")
 
     service = BfsService(
         args.graph,
@@ -886,14 +999,35 @@ def run_server(args, stdin=None, stdout=None, stderr=None,
             except (ValueError, OSError):  # exotic embedding: skip
                 pass
 
+    metricz_out = getattr(args, "metricz_out", None)
+
+    def emit_telemetry() -> None:
+        """ONE observation, two renderings: the stderr statsz line and
+        the --metricz-out text are the same snapshot dict, so they can
+        never disagree — and the interval-QPS window is consumed exactly
+        once per cycle (a second snapshot microseconds later would read
+        a near-empty interval and export garbage interval_qps)."""
+        snap = service.metrics.snapshot(
+            mark_interval=True, queue_depth=service._queue.depth(),
+            lanes=service.lanes, extra=service.statsz_extras(),
+        )
+        print(service.metrics.statsz_line(snapshot=snap), file=stderr,
+              flush=True)
+        if not metricz_out:
+            return
+        from tpu_bfs.obs.exporters import write_metricz
+
+        try:
+            write_metricz(service.metrics.prometheus_text(snapshot=snap),
+                          metricz_out)
+        except OSError as exc:
+            log(f"metricz write failed ({exc!r})")
+
     stop_statsz = threading.Event()
-    if args.statsz_every > 0:
+    if statsz_interval > 0:
         def statsz_loop() -> None:
-            while not stop_statsz.wait(args.statsz_every):
-                print(service.metrics.statsz_line(
-                    queue_depth=service._queue.depth(), lanes=service.lanes,
-                    extra=service.statsz_extras(),
-                ), file=stderr, flush=True)
+            while not stop_statsz.wait(statsz_interval):
+                emit_telemetry()
 
         threading.Thread(
             target=statsz_loop, name="bfs-serve-statsz", daemon=True
@@ -965,6 +1099,12 @@ def run_server(args, stdin=None, stdout=None, stderr=None,
             name = signal.Signals(got_signal[0]).name
             log(f"{name} received: draining — admission stopped, flushing "
                 f"in-flight batches, resolving queued queries as shutdown")
+            rec = _obs.ACTIVE
+            if rec is not None:
+                # Flight-recorder trigger: the drain snapshot is the last
+                # chance to capture what the dying process was doing.
+                rec.event("signal_drain", cat="serve.lifecycle", signal=name)
+                rec.flight_dump(f"{name.lower()}_drain")
     finally:
         # Drain to completion: close() flushes in-flight batches and
         # resolves still-queued queries as SHUTDOWN; their callbacks emit
@@ -978,10 +1118,35 @@ def run_server(args, stdin=None, stdout=None, stderr=None,
             if outstanding[0] > 0:
                 log(f"drain timeout: {outstanding[0]} responses unemitted")
         stop_statsz.set()
-        print(service.metrics.statsz_line(
-            queue_depth=service._queue.depth(), lanes=service.lanes,
-            extra=service.statsz_extras(),
-        ), file=stderr, flush=True)
+        emit_telemetry()  # the final statsz line + --metricz-out text
+        if xprof:
+            import jax
+
+            try:
+                jax.profiler.stop_trace()
+                log(f"jax.profiler trace stopped -> {xprof}")
+            except Exception as exc:  # noqa: BLE001 — exit path, best effort
+                log(f"jax.profiler stop failed ({exc!r})")
+        trace_out = getattr(args, "trace_out", None)
+        if trace_out and recorder is not None:
+            from tpu_bfs.obs.exporters import write_perfetto
+
+            # Engine level tracks ride along when any resident engine
+            # recorded a per-level trace (armed runs only).
+            level_traces = []
+            for spec, eng in service._registry.resident_engines():
+                trace = getattr(eng, "last_run_trace", None)
+                if trace:
+                    level_traces.append((f"{spec.engine}/w{spec.lanes}", trace))
+            try:
+                write_perfetto(
+                    recorder.snapshot(), trace_out, t0=recorder.t0,
+                    level_traces=level_traces,
+                    meta={"tool": "tpu-bfs-serve", "graph": args.graph},
+                )
+                log(f"trace written -> {trace_out}")
+            except OSError as exc:
+                log(f"trace write failed ({exc!r})")
         for sig, handler in old_handlers.items():
             try:
                 signal.signal(sig, handler)

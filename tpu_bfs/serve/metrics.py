@@ -1,4 +1,4 @@
-"""Serve-mode observability: the /statsz counters.
+"""Serve-mode observability: the /statsz counters and /metricz export.
 
 The traversal engines' observability (utils/stats.py) is per-run; a
 server needs per-PROCESS counters that survive across batches — QPS,
@@ -8,32 +8,193 @@ retries, sheds. One lock guards everything: writers are the scheduler
 thread, the extraction worker, and client threads shedding at admission,
 and the snapshot is read at human timescales (the periodic statsz line),
 so contention is irrelevant next to a device dispatch.
+
+Latency distributions are MERGEABLE LOG2-BUCKET HISTOGRAMS (ISSUE 6
+satellite), not the old 4096-sample sliding-window ``np.percentile``
+deques: exact counts over fixed bucket boundaries, so N replicas'
+histograms sum into a fleet-wide distribution (the deques could only be
+concatenated-and-resampled, which is not a percentile of anything), and
+the same buckets drive the Prometheus exporter
+(tpu_bfs/obs/exporters.prometheus_text) without a second accounting
+path. The ``p50_ms``/``p99_ms`` snapshot keys keep their shape (float
+ms or None) — their values are now histogram estimates with bounded
+relative error (sub-bucketed octaves, clamped to the observed min/max,
+so single-sample distributions report exactly), computed over a
+two-generation recent window (``RECENT_WINDOW_S``) so the old deque's
+recency property survives: a slow cold batch ages out of p99 instead of
+haunting it for the process lifetime.
 """
 
 from __future__ import annotations
 
 import json
+import math
 import threading
 import time
-from collections import Counter, deque
+from collections import Counter
 
-import numpy as np
 
-# Latency reservoir size: percentiles are computed over the most recent
-# window, not all-time (a server that ran a slow cold batch an hour ago
-# should not report it in p99 forever). 4096 completions cover minutes of
-# saturated traffic at serving batch sizes.
-LATENCY_WINDOW = 4096
+class Log2Histogram:
+    """Exact-count histogram over log2 buckets with linear sub-buckets.
+
+    Bucket boundaries are fixed process-independent constants (octaves
+    ``2**EMIN .. 2**EMAX``, each split into ``SUB`` equal-width
+    sub-buckets — the HDR-histogram shape), so histograms from different
+    replicas :meth:`merge` by elementwise count addition. Quantile
+    estimates interpolate inside one bucket (relative error <= 1/SUB per
+    octave) and clamp to the exact observed min/max, so a single-sample
+    histogram reports that sample exactly. Values at or below 0 land in
+    the underflow bucket ``[0, 2**EMIN)``."""
+
+    __slots__ = ("counts", "count", "total", "vmin", "vmax")
+
+    SUB = 16  # sub-buckets per octave: <= 6.25% relative estimate error
+    EMIN = -10  # 2**-10 ms ~ 1 us
+    EMAX = 22  # 2**22 ms ~ 70 min
+    NBUCKETS = (EMAX - EMIN) * SUB + 2  # + underflow and overflow
+
+    def __init__(self):
+        self.counts = [0] * self.NBUCKETS
+        self.count = 0
+        self.total = 0.0
+        self.vmin = math.inf
+        self.vmax = -math.inf
+
+    def _index(self, v: float) -> int:
+        if v < 2.0 ** self.EMIN:
+            return 0
+        if v >= 2.0 ** self.EMAX:
+            return self.NBUCKETS - 1
+        m, e = math.frexp(v)  # v = m * 2**e, m in [0.5, 1)
+        octave = e - 1
+        sub = int((v / 2.0 ** octave - 1.0) * self.SUB)
+        return 1 + (octave - self.EMIN) * self.SUB + min(sub, self.SUB - 1)
+
+    def bounds(self, i: int) -> tuple[float, float]:
+        """[lo, hi) of bucket ``i``."""
+        if i <= 0:
+            return 0.0, 2.0 ** self.EMIN
+        if i >= self.NBUCKETS - 1:
+            return 2.0 ** self.EMAX, math.inf
+        j = i - 1
+        octave = self.EMIN + j // self.SUB
+        sub = j % self.SUB
+        width = 2.0 ** octave / self.SUB
+        lo = 2.0 ** octave + sub * width
+        return lo, lo + width
+
+    def add(self, v: float) -> None:
+        v = float(v)
+        self.counts[self._index(v)] += 1
+        self.count += 1
+        self.total += v
+        if v < self.vmin:
+            self.vmin = v
+        if v > self.vmax:
+            self.vmax = v
+
+    def add_many(self, values) -> None:
+        for v in values:
+            self.add(v)
+
+    def merge(self, other: "Log2Histogram") -> "Log2Histogram":
+        """Fold ``other``'s counts in (the multi-replica aggregation)."""
+        for i, c in enumerate(other.counts):
+            self.counts[i] += c
+        self.count += other.count
+        self.total += other.total
+        self.vmin = min(self.vmin, other.vmin)
+        self.vmax = max(self.vmax, other.vmax)
+        return self
+
+    def percentile(self, q: float) -> float | None:
+        """Estimated q-th percentile (linear interpolation inside the
+        covering bucket, clamped to the observed extremes); None when
+        empty."""
+        if not self.count:
+            return None
+        target = (q / 100.0) * self.count
+        cum = 0
+        for i, c in enumerate(self.counts):
+            if not c:
+                continue
+            if cum + c >= target:
+                lo, hi = self.bounds(i)
+                if not math.isfinite(hi):
+                    hi = max(self.vmax, lo)
+                frac = (target - cum) / c
+                est = lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+                return float(min(max(est, self.vmin), self.vmax))
+            cum += c
+        return float(self.vmax)
+
+    def cumulative_buckets(self):
+        """Prometheus exposition form: ``(upper_bound, cumulative_count)``
+        at octave boundaries (+Inf last, bound None) — octave granularity
+        keeps the text small while the sub-buckets keep estimates tight."""
+        out = []
+        cum = 0
+        next_octave_end = self.SUB  # sub-bucket index (0-based past underflow)
+        pending = self.counts[0]
+        for j in range((self.EMAX - self.EMIN) * self.SUB):
+            pending += self.counts[1 + j]
+            if j + 1 == next_octave_end:
+                cum += pending
+                pending = 0
+                octave = self.EMIN + (j + 1) // self.SUB
+                if cum or out:
+                    out.append((2.0 ** octave, cum))
+                next_octave_end += self.SUB
+        cum += pending + self.counts[-1]
+        out.append((None, cum))
+        return out
+
+    def state_dict(self) -> dict:
+        """JSON-portable form (exact; merge via :meth:`from_state`)."""
+        return {
+            "counts": {str(i): c for i, c in enumerate(self.counts) if c},
+            "count": self.count,
+            "total": self.total,
+            "min": None if self.count == 0 else self.vmin,
+            "max": None if self.count == 0 else self.vmax,
+        }
+
+    @classmethod
+    def from_state(cls, state: dict) -> "Log2Histogram":
+        h = cls()
+        for i, c in state.get("counts", {}).items():
+            h.counts[int(i)] = int(c)
+        h.count = int(state.get("count", 0))
+        h.total = float(state.get("total", 0.0))
+        if h.count:
+            h.vmin = float(state["min"])
+            h.vmax = float(state["max"])
+        return h
+
+
+# How far back the p50/p99 SNAPSHOT keys look. The all-time histograms
+# (histograms(), the Prometheus export) are monotone by design — scrapers
+# difference them; the human-facing statsz percentiles instead read a
+# two-generation window pair so a slow cold batch an hour ago cannot
+# inflate p99 forever (the invariant the old 4096-sample deque kept by
+# count, now kept by time: estimates cover the last 1-2 windows).
+RECENT_WINDOW_S = 60.0
 
 
 class ServeMetrics:
-    """Thread-safe serve counters + a bounded latency reservoir."""
+    """Thread-safe serve counters + mergeable latency histograms."""
 
     def __init__(self, *, now=time.monotonic):
         self._now = now
         self._lock = threading.Lock()
         self._t0 = now()
-        self._latencies_ms: deque = deque(maxlen=LATENCY_WINDOW)
+        self._latency_hist = Log2Histogram()
+        self._extract_hist = Log2Histogram()
+        # [current, previous] window pair behind the percentile snapshot
+        # keys; rotated in place at RECENT_WINDOW_S boundaries.
+        self._recent_t0 = self._t0
+        self._lat_recent = [Log2Histogram(), Log2Histogram()]
+        self._ext_recent = [Log2Histogram(), Log2Histogram()]
         self.completed = 0
         self.rejected = 0  # shed at admission (queue full / closed)
         self.expired = 0  # deadline passed while queued
@@ -52,7 +213,6 @@ class ServeMetrics:
         self.lanes_offered = 0
         self.padded_lanes_total = 0  # residual pad waste after routing
         self.batches_by_width = Counter()  # routing histogram: width -> batches
-        self._extract_ms: deque = deque(maxlen=LATENCY_WINDOW)
         self.extract_ms_total = 0.0  # host extraction time across batches
         # Interval bookkeeping for the statsz line's recent-QPS figure.
         self._last_snap_t = self._t0
@@ -67,10 +227,28 @@ class ServeMetrics:
             self.padded_lanes_total += max(capacity - used, 0)
             self.batches_by_width[int(capacity)] += 1
             self.completed += len(latencies_ms)
-            self._latencies_ms.extend(latencies_ms)
+            self._rotate_recent()
+            self._latency_hist.add_many(latencies_ms)
+            self._lat_recent[0].add_many(latencies_ms)
             if extract_ms is not None:
-                self._extract_ms.append(extract_ms)
+                self._extract_hist.add(extract_ms)
+                self._ext_recent[0].add(extract_ms)
                 self.extract_ms_total += extract_ms
+
+    def _rotate_recent(self) -> None:
+        """Age the percentile window pair (caller holds the lock): one
+        elapsed window shifts current -> previous; two or more mean
+        everything recorded is stale and both drop."""
+        elapsed = self._now() - self._recent_t0
+        if elapsed < RECENT_WINDOW_S:
+            return
+        if elapsed >= 2 * RECENT_WINDOW_S:
+            self._lat_recent = [Log2Histogram(), Log2Histogram()]
+            self._ext_recent = [Log2Histogram(), Log2Histogram()]
+        else:
+            self._lat_recent = [Log2Histogram(), self._lat_recent[0]]
+            self._ext_recent = [Log2Histogram(), self._ext_recent[0]]
+        self._recent_t0 = self._now()
 
     def record_rejected(self) -> None:
         with self._lock:
@@ -105,6 +283,9 @@ class ServeMetrics:
         with self._lock:
             self.requeue_shed += n
 
+    def _round(self, v: float | None) -> float | None:
+        return None if v is None else round(v, 3)
+
     def snapshot(self, *, queue_depth: int | None = None,
                  lanes: int | None = None, mark_interval: bool = False,
                  extra: dict | None = None) -> dict:
@@ -121,15 +302,22 @@ class ServeMetrics:
             if mark_interval:
                 self._last_snap_t = now
                 self._last_snap_completed = self.completed
-            lat = np.asarray(self._latencies_ms, dtype=np.float64)
-            ext = np.asarray(self._extract_ms, dtype=np.float64)
+            # Percentile keys read the recent window pair (a long-idle
+            # server's percentiles age back to None rather than echoing
+            # an hour-old cold batch); the all-time histograms stay the
+            # exported/mergeable record.
+            self._rotate_recent()
+            lat = Log2Histogram().merge(
+                self._lat_recent[0]).merge(self._lat_recent[1])
+            ext = Log2Histogram().merge(
+                self._ext_recent[0]).merge(self._ext_recent[1])
             out = {
                 "uptime_s": round(uptime, 3),
                 "completed": self.completed,
                 "qps": round(self.completed / uptime, 2),
                 "interval_qps": round(interval_done / interval, 2),
-                "p50_ms": round(float(np.percentile(lat, 50)), 3) if lat.size else None,
-                "p99_ms": round(float(np.percentile(lat, 99)), 3) if lat.size else None,
+                "p50_ms": self._round(lat.percentile(50)),
+                "p99_ms": self._round(lat.percentile(99)),
                 "fill_ratio": round(
                     self.lanes_used / self.lanes_offered, 4
                 ) if self.lanes_offered else 0.0,
@@ -140,9 +328,7 @@ class ServeMetrics:
                     str(wd): n
                     for wd, n in sorted(self.batches_by_width.items())
                 },
-                "extract_p50_ms": round(
-                    float(np.percentile(ext, 50)), 3
-                ) if ext.size else None,
+                "extract_p50_ms": self._round(ext.percentile(50)),
                 "extract_ms_total": round(self.extract_ms_total, 3),
                 "batches": self.batches,
                 "rejected": self.rejected,
@@ -165,8 +351,35 @@ class ServeMetrics:
             out.update(extra)
         return out
 
-    def statsz_line(self, **kw) -> str:
+    def histograms(self) -> dict:
+        """CONSISTENT COPIES of the mergeable all-time distributions,
+        taken under the lock — a batch completing mid-render must not
+        yield an exposition whose +Inf bucket disagrees with its _count
+        (the Prometheus histogram invariant scrapers difference on).
+        Copies are also safe to hand to a merging aggregator."""
+        with self._lock:
+            return {
+                "latency_ms": Log2Histogram().merge(self._latency_hist),
+                "extract_ms": Log2Histogram().merge(self._extract_hist),
+            }
+
+    def prometheus_text(self, snapshot: dict | None = None, **kw) -> str:
+        """THE ONE /metricz renderer (BfsService.metricz and the
+        periodic ``--metricz-out`` writer both delegate here): pass the
+        exact snapshot dict another rendering just printed (the statsz
+        line) so the two outputs come from one observation and can
+        never disagree; with no snapshot given, one is taken now."""
+        from tpu_bfs.obs.exporters import prometheus_text
+
+        snap = snapshot if snapshot is not None else self.snapshot(**kw)
+        return prometheus_text(snap, histograms=self.histograms())
+
+    def statsz_line(self, snapshot: dict | None = None, **kw) -> str:
         """The periodic stderr line: a stable prefix + one JSON object, so
-        log scrapers can grep ``statsz`` and parse the rest. The ONLY
-        caller that advances the interval-QPS window."""
-        return "statsz " + json.dumps(self.snapshot(mark_interval=True, **kw))
+        log scrapers can grep ``statsz`` and parse the rest. The only
+        path that advances the interval-QPS window — either directly or
+        via the prebuilt ``snapshot`` the periodic emitter shares with
+        the /metricz rendering."""
+        if snapshot is None:
+            snapshot = self.snapshot(mark_interval=True, **kw)
+        return "statsz " + json.dumps(snapshot)

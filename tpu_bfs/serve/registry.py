@@ -25,6 +25,7 @@ from collections import OrderedDict
 import numpy as np
 
 from tpu_bfs import faults as _faults
+from tpu_bfs import obs as _obs
 from tpu_bfs.utils.compile_cache import enable_compile_cache
 
 ENGINE_KINDS = ("wide", "hybrid", "packed")
@@ -140,6 +141,27 @@ class EngineRegistry:
             return eng
 
     def _build(self, spec: EngineSpec):
+        rec = _obs.ACTIVE
+        if rec is not None:
+            # Registry lifecycle span: builds are the 30-second events a
+            # trace of a cold start is mostly made of.
+            rec.begin("engine_build", f"w{spec.lanes}", cat="serve.registry",
+                      engine=spec.engine, width=spec.lanes,
+                      planes=spec.planes, devices=spec.devices)
+        try:
+            eng = self._build_inner(spec)
+        except Exception as exc:
+            if rec is not None:
+                rec.end("engine_build", f"w{spec.lanes}",
+                        cat="serve.registry", width=spec.lanes,
+                        error=f"{type(exc).__name__}: {str(exc)[:120]}")
+            raise
+        if rec is not None:
+            rec.end("engine_build", f"w{spec.lanes}", cat="serve.registry",
+                    width=spec.lanes)
+        return eng
+
+    def _build_inner(self, spec: EngineSpec):
         if _faults.ACTIVE is not None:
             # Chaos-harness injection site: a transient raised here runs
             # the service's engine-build retry; an OOM runs the width
@@ -195,7 +217,10 @@ class EngineRegistry:
         sources, so this warm run compiles THE shape every later dispatch
         reuses. Vertex 0 always exists; its answer is discarded."""
         t0 = time.perf_counter()
-        eng.run(np.zeros(eng.lanes, dtype=np.int64), time_it=False)
+        with _obs.maybe_span("engine_warm", f"w{spec.lanes}",
+                             cat="serve.registry", width=spec.lanes,
+                             engine=spec.engine):
+            eng.run(np.zeros(eng.lanes, dtype=np.int64), time_it=False)
         self._log(f"engine warmed {spec} in {time.perf_counter() - t0:.1f}s")
 
     def evict(self, spec: EngineSpec) -> bool:
@@ -220,5 +245,16 @@ class EngineRegistry:
             return None
         try:
             return list(self._engines)
+        finally:
+            self._lock.release()
+
+    def resident_engines(self) -> list:
+        """``(spec, engine)`` pairs, same non-blocking discipline as
+        :meth:`resident` (empty when a build holds the lock) — the trace
+        exporter walks these for ``last_run_trace`` level tracks."""
+        if not self._lock.acquire(timeout=0.05):
+            return []
+        try:
+            return list(self._engines.items())
         finally:
             self._lock.release()

@@ -31,6 +31,8 @@ from collections import deque
 
 import numpy as np
 
+from tpu_bfs import obs as _obs
+
 STATUS_OK = "ok"
 STATUS_REJECTED = "rejected"  # shed at admission (queue full / closed)
 STATUS_EXPIRED = "deadline_exceeded"
@@ -79,7 +81,7 @@ class PendingQuery:
     error so the failure names every width that was tried."""
 
     __slots__ = ("id", "source", "deadline", "t_submit", "want_distances",
-                 "requeues", "attempt_widths",
+                 "requeues", "attempt_widths", "obs_batch",
                  "_event", "_lock", "_result", "_callbacks")
 
     def __init__(self, source: int, *, id=None, deadline: float | None = None,
@@ -91,10 +93,20 @@ class PendingQuery:
         self.want_distances = bool(want_distances)
         self.requeues = 0  # OOM-driven re-admissions so far
         self.attempt_widths: list = []  # width each failed attempt ran at
+        self.obs_batch = None  # serving batch id (telemetry; armed only)
         self._event = threading.Event()
         self._lock = threading.Lock()
         self._result: QueryResult | None = None
         self._callbacks: list = []
+        rec = _obs.ACTIVE
+        if rec is not None:
+            # The query's span opens at ADMISSION; resolve() closes it
+            # with the terminal status, batch id, and attempt history —
+            # one span chain per query id across whichever threads serve
+            # it (tpu_bfs/obs).
+            rec.begin("query", f"q{self.id}", cat="serve.query",
+                      query=self.id, source=self.source,
+                      want_distances=self.want_distances)
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now > self.deadline
@@ -106,6 +118,14 @@ class PendingQuery:
                 return False
             self._result = result
             callbacks, self._callbacks = self._callbacks, []
+        rec = _obs.ACTIVE
+        if rec is not None:
+            rec.end("query", f"q{self.id}", cat="serve.query",
+                    query=self.id, status=result.status,
+                    latency_ms=result.latency_ms, batch=self.obs_batch,
+                    dispatched_lanes=result.dispatched_lanes,
+                    requeues=self.requeues,
+                    attempt_widths=list(self.attempt_widths))
         self._event.set()
         for cb in callbacks:
             cb(self)
@@ -156,18 +176,27 @@ class AdmissionQueue:
             if self._stopped or len(self._items) >= self.cap:
                 return False
             self._items.append(q)
+            depth = len(self._items)
             self._cond.notify()
-            return True
+        rec = _obs.ACTIVE
+        if rec is not None:
+            rec.event("enqueue", cat="serve.queue", query=q.id, depth=depth)
+        return True
 
     def requeue(self, queries) -> None:
         """Re-admit (at the FRONT, preserving order) queries popped by a
         batch that could not run — an OOM'd dispatch being re-served at a
         narrower lane count must not send its queries to the back of the
         line, and must never shed them against the cap."""
+        queries = list(queries)
         with self._cond:
-            for q in reversed(list(queries)):
+            for q in reversed(queries):
                 self._items.appendleft(q)
             self._cond.notify()
+        rec = _obs.ACTIVE
+        if rec is not None:
+            rec.event("requeue", cat="serve.queue",
+                      queries=[q.id for q in queries])
 
     def depth(self) -> int:
         with self._cond:

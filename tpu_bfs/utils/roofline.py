@@ -46,6 +46,7 @@ import numpy as np
 from tpu_bfs.algorithms._packed_common import make_fori_expand
 from tpu_bfs.algorithms.msbfs_hybrid import expand_spec
 from tpu_bfs.algorithms.msbfs_packed import ripple_increment
+from tpu_bfs.obs.engine_trace import trace_summary as _trace_summary
 from tpu_bfs.ops.tile_spmm import TILE, tile_spmm
 from tpu_bfs.utils.timing import run_timed
 
@@ -432,6 +433,36 @@ def roofline_hybrid(engine, sources, *, peak_gbs: float = V5E_PEAK_GBS,
             tot_attr[n] = tot_attr.get(n, 0.0) + t
             tot_bytes[n] = tot_bytes.get(n, 0.0) + la.bytes_model.get(n, 0)
     attr_sum = sum(tot_attr.values())
+    # Fold the walk into the unified engine-trace contract (ISSUE 6):
+    # the roofline drives the level loop one step at a time, so it
+    # observes per-level frontier rows and direction directly — richer
+    # than the fused loop's own recording. gated_tiles converts the
+    # gate's input (active tiles) into the trace's skip count.
+    trace_rows = []
+    exch_bytes = getattr(engine, "wire_bytes_per_level", None)
+    exch_each = None
+    if exch_bytes is not None:
+        per = exch_bytes()
+        exch_each = float(per[0]) if len(per) == 1 else None
+    for la in levels:
+        gated_tiles = None
+        if la.active_tiles is not None:
+            from tpu_bfs.algorithms._packed_common import GATE_TILE
+
+            total_tiles = engine._table_rows // GATE_TILE
+            gated_tiles = max(total_tiles - la.active_tiles, 0)
+        trace_rows.append({
+            "level": la.level,
+            "frontier": la.frontier_rows,
+            "direction": (
+                "push" if la.took == "push"
+                else "pull-gated" if la.active_tiles is not None else "pull"
+            ),
+            "gated_tiles": gated_tiles,
+            "exchange": None,
+            "wire_bytes": exch_each,
+        })
+    engine.last_run_trace = trace_rows
     # Full degradation (every slice OOM'd) still emits the partial report
     # — per-level t_full and the unmeasured count are real data.
     binding = max(tot_attr, key=tot_attr.get) if tot_attr else None
@@ -454,6 +485,9 @@ def roofline_hybrid(engine, sources, *, peak_gbs: float = V5E_PEAK_GBS,
         },
         "binding_term": binding,
         "unmeasured_phase_slices": unmeasured,
+        # Compact engine-trace form (obs/engine_trace.trace_summary): the
+        # same keys bench.py's verdict carries, derived from this walk.
+        "trace_summary": _trace_summary(trace_rows, engine),
         "peak_gbs": peak_gbs,
         "hbm_bytes_total": total_bytes,
         # time the whole byte model would take at peak bandwidth.

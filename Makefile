@@ -18,12 +18,15 @@ bench:
 
 # Byte-model vs compiled-HLO audit (fast, CPU-only, 8 virtual devices):
 # every wire-byte formula the framework prints is re-derived from the
-# compiled program's own collective shapes — including the ISSUE 5
-# packed-exchange proof (uint32 words = 1/8 the ring bytes, 1/32 the
-# allreduce operand, zero extra collectives) and the pack/unpack
-# property tests. A model regression fails HERE, before a chip session
-# ever spends hardware time on it; hence it is also a prerequisite of
-# the smoke targets.
+# compiled program's own collective shapes — the ISSUE 5 packed-exchange
+# proof (uint32 words = 1/8 the ring bytes, 1/32 the allreduce operand,
+# zero extra collectives), the ISSUE 7 sparse-format proofs (delta
+# branches ship 1 + ceil(cap*b/32) uint32 words per destination, the
+# sieve adds EXACTLY ONE packed vis all-gather, the 2D sparse row
+# exchange and the MS row-gather delta stream price to their models),
+# and the codec/planner property tests. A model regression fails HERE,
+# before a chip session ever spends hardware time on it; hence it is
+# also a prerequisite of the smoke targets.
 wirecheck:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_wirecheck.py \
 	  tests/test_collectives_pack.py -q -p no:cacheprovider

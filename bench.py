@@ -16,8 +16,12 @@ single-source stage over the attached mesh, the ISSUE 5 wire-format A/B
 with knobs TPU_BFS_BENCH_DIST_DEVICES (all attached) /
 TPU_BFS_BENCH_DIST_EXCHANGE (ring|allreduce|sparse, default ring) /
 TPU_BFS_BENCH_WIRE_PACK ("1" bit-packs the exchange to uint32 words —
-default OFF until chip-measured, like the pull gate), emitting
-wire_bytes_per_level / wire_level_counts / wire_bytes_total;
+default OFF until chip-measured, like the pull gate) /
+TPU_BFS_BENCH_SPARSE_DELTA / TPU_BFS_BENCH_SPARSE_SIEVE /
+TPU_BFS_BENCH_SPARSE_PREDICT (the ISSUE 7 exchange planner on the
+sparse exchange — delta-encoded ids, backward visited sieve,
+history-predictive selection; all default OFF until chip-measured),
+emitting wire_bytes_per_level / wire_level_counts / wire_bytes_total;
 'serve' is the closed-loop serve-throughput stage
 over tpu_bfs/serve, emitting serve_qps/serve_p99_ms/fill_ratio/
 serve_routing/serve_extract_p50_ms with knobs TPU_BFS_BENCH_SERVE_CLIENTS
@@ -560,6 +564,25 @@ def _env_wire_pack() -> bool:
     packed runs are bit-identical to plain (fuzz-pinned), so the A/B pair
     isolates the wire-format win."""
     return _env_bool("TPU_BFS_BENCH_WIRE_PACK", "wire pack", "pack")
+
+
+def _env_sparse_planner() -> tuple[tuple[int, ...], bool, bool]:
+    """The ISSUE 7 exchange-planner knobs (all default off until
+    chip-measured, like wire_pack): TPU_BFS_BENCH_SPARSE_DELTA (8/16-bit
+    delta-encoded id chunks), TPU_BFS_BENCH_SPARSE_SIEVE (backward
+    visited sieve), TPU_BFS_BENCH_SPARSE_PREDICT (history-predictive
+    dense selection). They apply to the dist mode's sparse exchange
+    (TPU_BFS_BENCH_DIST_EXCHANGE=sparse); planner runs are bit-identical
+    to plain sparse (fuzz-pinned), so the A/B stages isolate each
+    format's wire win."""
+    delta = _env_bool("TPU_BFS_BENCH_SPARSE_DELTA", "sparse delta", "delta")
+    sieve = _env_bool("TPU_BFS_BENCH_SPARSE_SIEVE", "visited sieve", "sieve")
+    predict = _env_bool(
+        "TPU_BFS_BENCH_SPARSE_PREDICT", "exchange predictor", "predictor"
+    )
+    from tpu_bfs.parallel.collectives import DELTA_BITS_DEFAULT
+
+    return (DELTA_BITS_DEFAULT if delta else (), sieve, predict)
 
 
 def _is_oom(exc: BaseException) -> bool:
@@ -1120,11 +1143,14 @@ def bench_single(g, scale: int, ef: int, backend: str = "scan",
 
 def bench_dist(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
     """Multi-device 1D-partition single-source BFS (TPU_BFS_BENCH_MODE=
-    dist) — the wire-format A/B stage (ISSUE 5). Knobs:
+    dist) — the wire-format A/B stage (ISSUES 5 + 7). Knobs:
     TPU_BFS_BENCH_DIST_DEVICES (device count, default all attached),
     TPU_BFS_BENCH_DIST_EXCHANGE (ring|allreduce|sparse, default ring),
     TPU_BFS_BENCH_WIRE_PACK (uint32 word packing, default OFF until
-    chip-measured — like the pull gate), TPU_BFS_BENCH_SOURCES (8).
+    chip-measured — like the pull gate), TPU_BFS_BENCH_SPARSE_DELTA /
+    TPU_BFS_BENCH_SPARSE_SIEVE / TPU_BFS_BENCH_SPARSE_PREDICT (the
+    exchange planner's three pieces, sparse exchange only, all default
+    OFF until chip-measured), TPU_BFS_BENCH_SOURCES (8).
 
     The verdict carries the modeled per-level exchange price list
     (``wire_bytes_per_level``, one entry per exchange branch — ascending
@@ -1142,17 +1168,26 @@ def bench_dist(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
     ndev_raw = os.environ.get("TPU_BFS_BENCH_DIST_DEVICES", "").strip()
     ndev = int(ndev_raw) if ndev_raw else None
     wire_pack = _env_wire_pack()
+    delta_bits, sieve, predict = _env_sparse_planner()
+    if exchange != "sparse" and (delta_bits or sieve or predict):
+        log("sparse planner knobs need TPU_BFS_BENCH_DIST_EXCHANGE=sparse; "
+            f"ignored on exchange={exchange!r}")
+        delta_bits, sieve, predict = (), False, False
     do_validate = os.environ.get("TPU_BFS_BENCH_VALIDATE", "1") == "1"
 
     t0 = time.perf_counter()
     engine = retry_transient(
         DistBfsEngine, g, make_mesh(ndev), exchange=exchange,
-        wire_pack=wire_pack, label="dist engine build",
+        wire_pack=wire_pack, delta_bits=delta_bits, sieve=sieve,
+        predict=predict, label="dist engine build",
     )
     per_level = [float(x) for x in engine.wire_bytes_per_level()]
     log(f"dist engine build {time.perf_counter()-t0:.1f}s: P={engine.p} "
         f"vloc={engine.part.vloc} exchange={exchange} "
-        f"wire_pack={'on' if wire_pack else 'off'} bytes/level={per_level}")
+        f"wire_pack={'on' if wire_pack else 'off'} "
+        f"delta={list(delta_bits) or 'off'} "
+        f"sieve={'on' if sieve else 'off'} "
+        f"predict={'on' if predict else 'off'} bytes/level={per_level}")
     rng = np.random.default_rng(7)
     candidates = np.flatnonzero(g.degrees > 0)
     sources = rng.choice(candidates, size=n_sources, replace=False)
@@ -1212,6 +1247,10 @@ def bench_dist(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
         "wire_pack": wire_pack,
         "wire_exchange": exchange,
         "wire_devices": engine.p,
+        "wire_sparse_delta": list(delta_bits),
+        "wire_sparse_sieve": sieve,
+        "wire_sparse_predict": predict,
+        "wire_branch_labels": engine.exchange_branch_labels(),
         "wire_bytes_per_level": per_level,
         "wire_level_counts": [int(x) for x in counts],
         "wire_bytes_total": total_bytes,

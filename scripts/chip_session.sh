@@ -160,6 +160,28 @@ for i in $(seq 1 "$attempts"); do
     stage "dist-packed-s20" "$out/dist_packed_s20.json" \
       TPU_BFS_BENCH_MODE=dist TPU_BFS_BENCH_SCALE=20 \
       TPU_BFS_BENCH_WIRE_PACK=1
+    # Sparse-format A/B (ISSUE 7): the queue-style exchange plain, with
+    # delta-encoded id chunks, and with the full planner (delta + the
+    # backward visited sieve + history-predictive selection). All three
+    # run wire-packed so the dense fallback is the PR 5 packed baseline
+    # the delta rungs must beat (the acceptance bar: >=2x lower
+    # wire_bytes_per_level on sparse-majority levels). New formats
+    # default OFF until these land, matching the pull-gate and wire-pack
+    # precedent; every line carries wire_branch_labels +
+    # wire_level_counts so the per-branch split is readable next to the
+    # byte totals.
+    stage "dist-sparse-s20" "$out/dist_sparse_s20.json" \
+      TPU_BFS_BENCH_MODE=dist TPU_BFS_BENCH_SCALE=20 \
+      TPU_BFS_BENCH_DIST_EXCHANGE=sparse TPU_BFS_BENCH_WIRE_PACK=1
+    stage "dist-delta-s20" "$out/dist_delta_s20.json" \
+      TPU_BFS_BENCH_MODE=dist TPU_BFS_BENCH_SCALE=20 \
+      TPU_BFS_BENCH_DIST_EXCHANGE=sparse TPU_BFS_BENCH_WIRE_PACK=1 \
+      TPU_BFS_BENCH_SPARSE_DELTA=1
+    stage "dist-sieve-s20" "$out/dist_sieve_s20.json" \
+      TPU_BFS_BENCH_MODE=dist TPU_BFS_BENCH_SCALE=20 \
+      TPU_BFS_BENCH_DIST_EXCHANGE=sparse TPU_BFS_BENCH_WIRE_PACK=1 \
+      TPU_BFS_BENCH_SPARSE_DELTA=1 TPU_BFS_BENCH_SPARSE_SIEVE=1 \
+      TPU_BFS_BENCH_SPARSE_PREDICT=1
     # The probe's completion-marker line satisfies got_value, so pstage
     # gives it the same idempotent restart + timeout envelope as the
     # other helper scripts.

@@ -223,3 +223,28 @@ def test_cli_rejects_wire_pack_single_chip():
     # config error, not a silent no-op.
     with pytest.raises(SystemExit):
         cli.main(["0", "random:n=100,m=300,seed=1", "--wire-pack"])
+
+
+def test_cli_sparse_delta_planner(capsys):
+    # The ISSUE 7 planner flags reach the 1D engine through the sparse
+    # exchange and results still validate (delta/sieve/predict are wire
+    # encoding + selection policy only).
+    rc = cli.main(["1", "random:n=250,m=1000,seed=8", "--devices", "4",
+                   "--exchange", "sparse", "--sparse-delta",
+                   "--sparse-sieve", "--sparse-predict"])
+    assert rc == 0
+    assert "Output OK" in capsys.readouterr().out
+
+
+def test_cli_rejects_planner_flag_misuse():
+    # Planner flags without the sparse exchange (or off-mesh) are config
+    # errors, not silent no-ops.
+    with pytest.raises(SystemExit):
+        cli.main(["0", "random:n=100,m=300,seed=1", "--sparse-delta"])
+    with pytest.raises(SystemExit):
+        cli.main(["0", "random:n=100,m=300,seed=1", "--devices", "2",
+                  "--sparse-delta"])  # exchange defaults to ring
+    with pytest.raises(SystemExit):
+        cli.main(["0", "random:n=100,m=300,seed=1", "--devices", "2",
+                  "--exchange", "sparse", "--multi-source", "5",
+                  "--sparse-sieve"])  # sieve is single-source only

@@ -149,7 +149,17 @@ def test_unknown_exchange_rejected(random_small):
     with pytest.raises(ValueError, match="unknown exchange"):
         DistBfsEngine(random_small, make_mesh(2), exchange="sprase")
     with pytest.raises(ValueError, match="unknown exchange"):
-        Dist2DBfsEngine(random_small, make_mesh_2d(2, 2), exchange="sparse")
+        Dist2DBfsEngine(random_small, make_mesh_2d(2, 2), exchange="sprase")
+    # The ISSUE 7 planner knobs only reshape the sparse exchange; a dense
+    # impl has no id buffers to compress and must reject loudly at build.
+    with pytest.raises(ValueError, match="planner"):
+        DistBfsEngine(random_small, make_mesh(2), delta_bits=(8,))
+    with pytest.raises(ValueError, match="planner"):
+        Dist2DBfsEngine(random_small, make_mesh_2d(2, 2), sieve=True)
+    with pytest.raises(ValueError, match="delta_bits"):
+        DistBfsEngine(
+            random_small, make_mesh(2), exchange="sparse", delta_bits=(7,)
+        )
 
 
 def test_dist_stats_match_single(toy_graph):

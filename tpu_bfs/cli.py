@@ -142,6 +142,12 @@ def _make_ms_engine(args, g, n_sources: int):
         # (vertex, lane); the flag is accepted for knob uniformity and
         # recorded (a validated no-op — see the engines' docstrings).
         lanes_kw["wire_pack"] = True
+    if args.devices > 1 and args.sparse_delta:
+        # Sparse row gather: the id stream delta-encodes (ISSUE 7); the
+        # lane-word payload is already bit-packed.
+        from tpu_bfs.parallel.collectives import DELTA_BITS_DEFAULT
+
+        lanes_kw["delta_bits"] = DELTA_BITS_DEFAULT
     if args.devices > 1:
         if engine == "packed":
             raise SystemExit(
@@ -484,6 +490,33 @@ def main(argv=None) -> int:
                     "--multi-source packed engines already exchange "
                     "bit-packed lane words; there the flag is a recorded "
                     "no-op")
+    ap.add_argument("--sparse-delta", action="store_true",
+                    help="delta-encode the sparse exchange's id buffers "
+                    "(ISSUE 7; experimental, default off until "
+                    "chip-measured): first-id + fixed-width 8/16-bit "
+                    "bit-packed deltas in uint32 words instead of 4-byte "
+                    "ids, width picked per level by the same mesh-uniform "
+                    "pmax discipline as the cap rungs. Needs --exchange "
+                    "sparse on a multi-device run; with --multi-source it "
+                    "compresses the sparse row gather's id stream. "
+                    "Bit-identical results (fuzz-pinned); "
+                    "utils/wirecheck proves the byte ratios from the "
+                    "compiled HLO (make wirecheck)")
+    ap.add_argument("--sparse-sieve", action="store_true",
+                    help="visited sieve for the sparse exchange (ISSUE 7, "
+                    "experimental): on high-reuse levels each receiver's "
+                    "packed vis chunk ships backward once (1 bit/vertex) "
+                    "so senders drop already-visited ids before "
+                    "compaction — taken only when the modeled id savings "
+                    "beat the transfer's own ~vloc/8 cost. Single-source "
+                    "--devices/--mesh runs with --exchange sparse")
+    ap.add_argument("--sparse-predict", action="store_true",
+                    help="history-predictive exchange selection (ISSUE 7, "
+                    "experimental): confidently-dense mid-BFS levels "
+                    "(previous biggest above every cap, frontier still "
+                    "growing) skip the per-level pmax entirely, "
+                    "direction-optimizing style. Single-source "
+                    "--devices/--mesh runs with --exchange sparse")
     ap.add_argument("--pull-gate", action="store_true",
                     help="frontier-aware pull expansion (experimental, "
                     "default off): settled rows' bucket blocks, state "
@@ -566,9 +599,17 @@ def main(argv=None) -> int:
     if args.wire_pack and args.devices == 1 and not args.mesh:
         ap.error("--wire-pack packs multi-device exchanges; add --devices N "
                  "or --mesh RxC (a single chip moves nothing over the wire)")
-    if args.mesh and args.exchange == "sparse":
-        ap.error("--exchange sparse pairs with 1D --devices meshes; the 2D "
-                 "engine's row/column collectives already move O(vp/dim) bits")
+    if args.sparse_delta or args.sparse_sieve or args.sparse_predict:
+        if args.devices == 1 and not args.mesh:
+            ap.error("--sparse-delta/--sparse-sieve/--sparse-predict reshape "
+                     "multi-device exchanges; add --devices N or --mesh RxC")
+        if args.exchange != "sparse":
+            ap.error("--sparse-delta/--sparse-sieve/--sparse-predict apply "
+                     "to the queue-style id exchange; add --exchange sparse")
+    if (args.sparse_sieve or args.sparse_predict) and args.multi_source:
+        ap.error("--sparse-sieve/--sparse-predict are single-source "
+                 "exchange-planner features (1D --devices or --mesh RxC); "
+                 "--multi-source row gathers support --sparse-delta only")
     if args.exchange == "sliced" and not (args.multi_source and args.devices > 1):
         ap.error("--exchange sliced is the packed hybrid engine's ring-"
                  "rotation layout; use it with --multi-source --devices N")
@@ -641,16 +682,23 @@ def main(argv=None) -> int:
                 r, c = (int(t) for t in args.mesh.lower().split("x"))
             except ValueError:
                 ap.error(f"--mesh must look like RxC (e.g. 2x4), got {args.mesh!r}")
+            from tpu_bfs.parallel.collectives import DELTA_BITS_DEFAULT
+
             return Dist2DBfsEngine(
                 g, make_mesh_2d(r, c), exchange=args.exchange,
                 backend=args.backend, wire_pack=args.wire_pack,
+                delta_bits=DELTA_BITS_DEFAULT if args.sparse_delta else (),
+                sieve=args.sparse_sieve, predict=args.sparse_predict,
             )
         if args.devices > 1:
+            from tpu_bfs.parallel.collectives import DELTA_BITS_DEFAULT
             from tpu_bfs.parallel.dist_bfs import DistBfsEngine, make_mesh
 
             return DistBfsEngine(
                 g, make_mesh(args.devices), exchange=args.exchange,
                 backend=args.backend, wire_pack=args.wire_pack,
+                delta_bits=DELTA_BITS_DEFAULT if args.sparse_delta else (),
+                sieve=args.sparse_sieve, predict=args.sparse_predict,
             )
         if args.backend == "tiled":
             from tpu_bfs.algorithms.bfs_tiled import TiledBfsEngine

@@ -73,10 +73,15 @@ def assemble_dist_trace(
     front = np.asarray(front_seq)
     branch = np.asarray(branch_seq)
     per_level = [float(x) for x in engine.wire_bytes_per_level()]
-    # The cap-ladder labels only apply to the sparse exchange; ring/
+    # Engines with a richer branch space (the ISSUE 7 planner: delta
+    # rungs, sieved variants, predicted-dense) publish their own
+    # index-aligned label list via exchange_branch_labels(); without the
+    # hook, the cap-ladder labels apply to the sparse exchange and ring/
     # allreduce runs have one branch, labeled by the impl itself (the
     # engines keep sparse_caps populated either way, so the caps alone
     # cannot distinguish the modes).
+    hook = getattr(engine, "exchange_branch_labels", None)
+    labels = hook() if callable(hook) else None
     mode = getattr(engine, "_exchange", None)
     caps = tuple(getattr(engine, "sparse_caps", ()) or ())
     if mode != "sparse":
@@ -86,7 +91,10 @@ def assemble_dist_trace(
     for lvl in range(n):
         b = int(branch[lvl])
         known = 0 <= b < len(per_level)
-        label = branch_label(b, caps) if known else None
+        if labels is not None:
+            label = labels[b] if known and b < len(labels) else None
+        else:
+            label = branch_label(b, caps) if known else None
         if label == "dense" and mode not in (None, "sparse"):
             label = mode
         rows.append({
@@ -119,6 +127,8 @@ def assemble_packed_trace(engine, levels: int) -> list[dict]:
         direction = "pull+adaptive-push"
     counts = getattr(engine, "last_exchange_level_counts", None)
     caps = tuple(getattr(engine, "sparse_caps", ()) or ())
+    hook = getattr(engine, "exchange_branch_labels", None)
+    labels = hook() if callable(hook) else None
     exchange = None
     wire_each = None
     if counts is not None:
@@ -128,7 +138,10 @@ def assemble_packed_trace(engine, levels: int) -> list[dict]:
         used = np.flatnonzero(counts)
         if len(used) == 1:
             b = int(used[0])
-            exchange = branch_label(b, caps) if len(counts) > 1 else "dense"
+            if labels is not None and b < len(labels):
+                exchange = labels[b]
+            else:
+                exchange = branch_label(b, caps) if len(counts) > 1 else "dense"
             if per_level is not None:
                 wire_each = per_level[b]
         elif len(used) > 1:

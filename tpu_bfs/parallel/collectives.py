@@ -33,6 +33,25 @@ Madduri format flip, arXiv:1104.4518) is the shared
 :func:`cap_ladder_select`; under ``wire_pack`` its dense fallback is the
 packed ring and the cap ladder is recalibrated against the packed dense
 cost (``default_sparse_caps``).
+
+Sparse wire format (ISSUE 7, "Compression and Sieve", arXiv:1208.5542):
+the id buffers themselves compress. The cumsum compaction already emits
+ascending ids per destination chunk, so :func:`delta_encode_ids` ships
+first-id + fixed-width bit-packed deltas (8/16-bit fields in uint32
+words — XLA-friendly static shapes, not varints), the width picked by
+the same mesh-uniform pmax discipline as the cap rungs (the max
+consecutive-id gap rides the SAME scalar all-reduce as the max bucket
+count, as an s32[2] pair). :func:`planned_sparse_exchange_or` composes
+that with a backward visited sieve (each receiver's packed ``vis``
+chunk all-gathered once — :func:`sieve_wire_bytes` — so senders drop
+already-visited ids before compaction) and a history-predictive
+selector: mesh-uniform carried scalars from prior levels (previous
+``biggest``, frontier growth) let confidently-dense mid-BFS levels skip
+the per-level pmax entirely, direction-optimizing style. The per-level
+choice becomes sparse-delta / sparse-plain / packed-dense / sieved
+(:func:`planned_branch_labels`), each priced exactly by
+:func:`planned_sparse_wire_bytes_per_level` and HLO-audited by
+utils/wirecheck.check_planned_sparse.
 """
 
 from __future__ import annotations
@@ -214,28 +233,41 @@ def dense_2d_wire_bytes(
     plus the row reduce-scatter over 'c' (same shapes as the 1D dense
     exchange, dense_or_wire_bytes). Modeled, like every wire-byte figure
     here."""
-    if rows > 1:
-        ag = float((rows - 1) * 4 * packed_words(w)) if wire_pack else float(
-            (rows - 1) * w
-        )
-    else:
-        ag = 0.0
-    return ag + dense_or_wire_bytes(cols, w, impl, wire_pack=wire_pack)
+    return column_gather_wire_bytes(
+        rows, w, wire_pack=wire_pack
+    ) + dense_or_wire_bytes(cols, w, impl, wire_pack=wire_pack)
 
 
-def default_sparse_caps(vloc: int, *, wire_pack: bool = False) -> tuple[int, ...]:
+def normalize_caps(caps) -> tuple[int, ...]:
+    """Canonical cap ladder: ascending and DEDUPLICATED. Every consumer of
+    a caps tuple (the `lax.cond` ladder, the per-branch byte models, the
+    engines' branch-count arrays) must agree on one rung list — a
+    caller-provided duplicate rung would otherwise build a dead cond
+    branch and skew the branch-index accounting between them."""
+    return tuple(sorted({int(c) for c in caps}))
+
+
+def default_sparse_caps(
+    vloc: int, *, wire_pack: bool = False, delta_bits: tuple[int, ...] = ()
+) -> tuple[int, ...]:
     """Two-tier cap ladder: a tight cap for trickle levels (BFS start/tail,
     high-diameter graphs) and a wide one that still undercuts the dense
-    bitmap's wire bytes by ~2x (ids cost 4 bytes each).
+    bitmap's wire bytes by ~2x.
 
-    Against the PACKED dense bitmap (vloc/8 bytes on the wire instead of
-    vloc) the break-even density falls 8x: ids only win below vloc/32
-    entries, so the packed ladder is the unpacked one shifted three
-    octaves down — wide rung vloc/64 (the same ~2x undercut of the packed
-    dense cost), tight rung vloc/512."""
-    if wire_pack:
-        return tuple(sorted({max(16, vloc // 512), max(16, vloc // 64)}))
-    return tuple(sorted({max(16, vloc // 64), max(16, vloc // 8)}))
+    The ladder calibrates against the dense fallback it competes with and
+    the per-entry cost of the id encoding it ships: the break-even entry
+    count is dense_bytes / entry_bytes, the wide rung half of it (the ~2x
+    undercut), the tight rung 1/16. Unpacked dense costs vloc bytes and
+    plain ids 4 bytes each -> rungs vloc/8 and vloc/64; the PACKED dense
+    bitmap (``wire_pack``) costs vloc/8, dropping break-even 8x (rungs
+    vloc/64, vloc/512); delta-encoded ids (ISSUE 7) cost
+    min(delta_bits)/8 bytes per entry, RAISING break-even by the same
+    ratio — at 8-bit deltas ids stay competitive to 4x denser frontiers
+    (the header word is ignored as a rounding term)."""
+    dense_bytes = vloc // 8 if wire_pack else vloc
+    entry_bits = min(delta_bits) if delta_bits else 32
+    be = dense_bytes * 8 // entry_bits
+    return tuple(sorted({max(16, be // 16), max(16, be // 2)}))
 
 
 def cap_ladder_select(biggest, caps: tuple[int, ...], make_sparse, dense_path):
@@ -248,15 +280,119 @@ def cap_ladder_select(biggest, caps: tuple[int, ...], make_sparse, dense_path):
     (arXiv:1104.4518) as one reusable `lax.cond` ladder: the scalar is
     identical on every chip, so all chips take the same branch and the
     collectives stay matched. ``make_sparse(cap, idx)`` returns the branch
-    body for one rung; branch index = rung position (ascending) or
-    ``len(caps)`` for dense."""
-    ladder = sorted(caps)
+    body for one rung; branch index = rung position (in the
+    :func:`normalize_caps` order — ascending, deduped) or
+    ``len(normalize_caps(caps))`` for dense."""
+    ladder = normalize_caps(caps)
     step = dense_path
     for idx in range(len(ladder) - 1, -1, -1):
         step = partial(
             lax.cond, biggest <= ladder[idx], make_sparse(ladder[idx], idx), step
         )
     return step(None)
+
+
+# --- delta-encoded sparse id chunks (ISSUE 7) -------------------------------
+
+#: The static delta bit-width ladder (ascending; each must divide 32 so
+#: fields never straddle word boundaries): 8-bit deltas cover gaps <= 255
+#: between consecutive frontier ids, 16-bit <= 65535; wider gaps fall back
+#: to plain 4-byte ids at the same cap rung.
+DELTA_BITS_DEFAULT = (8, 16)
+_DELTA_BITS_ALLOWED = (4, 8, 16)
+
+
+def check_delta_bits(delta_bits) -> tuple[int, ...]:
+    """Validate + canonicalize a delta bit-width ladder (ascending,
+    deduped, each dividing 32 — {4, 8, 16})."""
+    out = tuple(sorted({int(b) for b in delta_bits}))
+    bad = [b for b in out if b not in _DELTA_BITS_ALLOWED]
+    if bad:
+        raise ValueError(
+            f"delta_bits must be drawn from {_DELTA_BITS_ALLOWED} "
+            f"(fixed-width fields packed into uint32 words), got {bad}"
+        )
+    return out
+
+
+def delta_words(cap: int, bits: int) -> int:
+    """uint32 words one destination's delta-encoded id chunk ships: one
+    header word (the first id, full width) + ceil(cap*bits/32) words of
+    fixed-width bit-packed deltas."""
+    return 1 + -(-cap * bits // 32)
+
+
+def delta_encode_ids(buf, sentinel: int, bits: int):
+    """Delta-encode ascending id chunks into uint32 words.
+
+    ``buf`` is [..., cap] int32 with each chunk's valid ids STRICTLY
+    ascending in a contiguous prefix and ``sentinel`` after (the layout
+    the cumsum compaction in :func:`sparse_exchange_or` emits). Output
+    [..., delta_words(cap, bits)]: word 0 carries the first id verbatim
+    (``sentinel`` for an empty chunk), then cap ``bits``-wide deltas
+    packed LSB-first, 32//bits per word. Valid deltas are >= 1 (strict
+    ascent), tail positions pack 0 — so the decoder recovers validity
+    without a length field, and an all-zero payload round-trips the
+    empty chunk. The caller guarantees every valid delta fits ``bits``
+    bits (the pmax'd max-gap scalar picks the rung; see
+    :func:`max_id_gap`)."""
+    cap = buf.shape[-1]
+    valid = buf < sentinel
+    prev = jnp.concatenate([buf[..., :1], buf[..., :-1]], axis=-1)
+    prev_valid = jnp.concatenate(
+        [jnp.zeros_like(valid[..., :1]), valid[..., :-1]], axis=-1
+    )
+    d = jnp.where(valid & prev_valid, buf - prev, 0)
+    per = 32 // bits
+    pad = -cap % per
+    if pad:
+        d = jnp.concatenate(
+            [d, jnp.zeros(d.shape[:-1] + (pad,), d.dtype)], axis=-1
+        )
+    du = d.astype(jnp.uint32).reshape(d.shape[:-1] + (-1, per))
+    words = jnp.sum(
+        du << (jnp.arange(per, dtype=jnp.uint32) * bits), axis=-1,
+        dtype=jnp.uint32,
+    )
+    return jnp.concatenate([buf[..., :1].astype(jnp.uint32), words], axis=-1)
+
+
+def delta_decode_ids(words, cap: int, bits: int):
+    """Inverse of :func:`delta_encode_ids`: [..., delta_words(cap, bits)]
+    uint32 -> ([..., cap] int32 ids, [..., cap] bool valid). Tail
+    positions replicate the last valid id (their deltas are 0) and report
+    invalid; an empty chunk decodes every position to the encoder's
+    sentinel (also position 0, whose validity the CALLER must additionally
+    gate on ``ids < sentinel`` when it matters — OR-scatters with a drop
+    sentinel need neither mask, duplicates and the sentinel are both
+    harmless there)."""
+    first = words[..., :1].astype(jnp.int32)
+    per = 32 // bits
+    fields = (
+        words[..., 1:, None] >> (jnp.arange(per, dtype=jnp.uint32) * bits)
+    ) & jnp.uint32((1 << bits) - 1)
+    d = fields.reshape(words.shape[:-1] + (-1,))[..., :cap].astype(jnp.int32)
+    ids = first + jnp.cumsum(d, axis=-1)
+    valid = jnp.concatenate(
+        [jnp.ones_like(d[..., :1], dtype=bool), d[..., 1:] > 0], axis=-1
+    )
+    return ids, valid
+
+
+def max_id_gap(rem):
+    """Largest gap between consecutive set bits within each row of a
+    [..., n] boolean chunk matrix — the widest delta a delta-encoded id
+    stream of those rows must carry — maxed over every row. Rows with
+    fewer than two set bits contribute 0 (the first id rides the header
+    word, not a delta)."""
+    n = rem.shape[-1]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    last = lax.cummax(jnp.where(rem, idx, -1), axis=rem.ndim - 1)
+    prev = jnp.concatenate(
+        [jnp.full(rem.shape[:-1] + (1,), -1, jnp.int32), last[..., :-1]],
+        axis=-1,
+    )
+    return jnp.max(jnp.where(rem & (prev >= 0), idx - prev, 0))
 
 
 def sparse_exchange_or(
@@ -301,7 +437,7 @@ def sparse_exchange_or(
     """
     p = num_devices
     n = x_full.shape[0] // p
-    ladder = sorted(caps)
+    ladder = normalize_caps(caps)
     if p == 1:
         return x_full, jnp.int32(len(ladder))
     i = lax.axis_index(axis_name)
@@ -343,6 +479,239 @@ def sparse_exchange_or(
     return cap_ladder_select(biggest, caps, make_sparse, dense_path)
 
 
+# --- the ISSUE 7 exchange planner -------------------------------------------
+
+
+def planned_branch_count(caps, delta_bits) -> int:
+    """Flat branch-index space of :func:`planned_sparse_exchange_or`:
+    with K = len(normalize_caps(caps)) rungs and W = len(delta_bits)
+    encodings-per-rung-plus-plain, B = K*(W+1) sparse branches appear
+    twice (unsieved then sieved), plus unsieved-dense, sieved-dense, and
+    the history-predicted dense that skipped the pmax — 2B+3 total (see
+    :func:`planned_branch_labels` for the exact order)."""
+    b = len(normalize_caps(caps)) * (len(delta_bits) + 1)
+    return 2 * b + 3
+
+
+def _rung_names(caps, delta_bits) -> list[str]:
+    """The per-rung label list every branch layout is built from — per
+    cap c, each delta width then plain ids; index-aligned with the
+    encoding order the `lax.cond` ladders compile."""
+    names = []
+    for c in normalize_caps(caps):
+        names += [f"delta{b}[{c}]" for b in delta_bits]
+        names.append(f"sparse[{c}]")
+    return names
+
+
+def planned_branch_labels(caps, delta_bits) -> list[str]:
+    """Human labels of the planner's flat branch layout, index-aligned
+    with :func:`planned_sparse_wire_bytes_per_level` and the branch ids
+    :func:`planned_sparse_exchange_or` returns: per rung cap c, each
+    delta width then plain ids; the dense fallback; the same rungs
+    sieved; sieved-dense; and the predicted-dense branch that paid no
+    pmax at all."""
+    names = _rung_names(caps, delta_bits)
+    return (
+        names + ["dense"] + [f"sieved-{s}" for s in names]
+        + ["sieved-dense", "dense-predicted"]
+    )
+
+
+def sieve_wire_bytes(p: int, n: int) -> float:
+    """Per-chip wire bytes of the sieve's backward vis transfer: ONE
+    all-gather of each receiver's packed [ceil(n/32)] uint32 vis chunk —
+    the ~n/8-byte cost the selector's modeled id savings must beat
+    before the sieve branch is taken."""
+    return 0.0 if p == 1 else float((p - 1) * 4 * packed_words(n))
+
+
+def planned_sparse_wire_bytes_per_level(
+    p: int, n: int, caps, delta_bits, *, wire_pack: bool = False
+) -> list[float]:
+    """Host-side off-chip bytes per level for each planner branch, in
+    :func:`planned_branch_labels` order. Measured levels pay 8 bytes for
+    the phase-1 pmax PAIR (one s32[2] all-reduce: max bucket count + max
+    id gap); sieved levels pay it twice (post-sieve re-measure) plus the
+    vis transfer; the predicted-dense branch pays no scalar at all —
+    skipping it is the predictor's whole point."""
+    nb = planned_branch_count(caps, delta_bits)
+    if p == 1:
+        return [0.0] * nb
+    sparse = []
+    for c in normalize_caps(caps):
+        sparse += [float((p - 1) * 4 * delta_words(c, b)) for b in delta_bits]
+        sparse.append(float((p - 1) * 4 * c))
+    dense = dense_or_wire_bytes(p, n, "ring", wire_pack=wire_pack)
+    sv = sieve_wire_bytes(p, n)
+    return (
+        [s + 8.0 for s in sparse] + [dense + 8.0]
+        + [s + sv + 16.0 for s in sparse] + [dense + sv + 16.0]
+        + [dense]
+    )
+
+
+def planned_sparse_exchange_or(
+    x_full, axis_name: str, num_devices: int, *, caps: tuple[int, ...],
+    delta_bits: tuple[int, ...] = (), sieve: bool = False, visited=None,
+    visited_total=None, predict: bool = False, prev_biggest=None,
+    growing=None, wire_pack: bool = False,
+):
+    """:func:`sparse_exchange_or` generalized into the ISSUE 7 exchange
+    planner: per level the choice becomes sparse-delta / sparse-plain /
+    packed-dense / sieved, driven by mesh-uniform scalars so every chip
+    takes matching branches and the collectives stay paired.
+
+    Three cooperating pieces on top of the cap ladder:
+
+    - **delta-encoded ids** (``delta_bits``, ascending widths): the
+      compacted id chunks are already ascending, so each destination
+      ships first-id + ``b``-bit bit-packed deltas in uint32 words
+      (:func:`delta_encode_ids`) — ``delta_words(cap, b)`` words instead
+      of ``cap`` int32s. The width rung is picked by the max
+      consecutive-id gap, pmax'd as an s32[2] PAIR with the max bucket
+      count (one scalar all-reduce covers both ladders); gaps past the
+      widest ladder rung fall back to plain 4-byte ids at the same cap.
+    - **visited sieve** (``sieve=True``; needs ``visited`` — this chip's
+      own [n] bool chunk — and ``visited_total``, a mesh-uniform carried
+      scalar): when the modeled id savings (visited-density x biggest x
+      4 id bytes per destination) beat the vis transfer's own
+      ~n/8-byte cost (:func:`sieve_wire_bytes`) and a smaller rung is
+      even reachable, each receiver's packed vis chunk is all-gathered
+      backward ONCE and senders drop already-visited ids before
+      compaction. The sieved ``hit`` is therefore NOT the raw OR of
+      contributions: it agrees with it exactly on this chip's unvisited
+      positions (plus its own full contribution) — precisely what the
+      claim ``new = hit & ~visited`` consumes, so traversal results stay
+      bit-identical (fuzz-pinned).
+    - **history prediction** (``predict=True``; needs ``prev_biggest``
+      and ``growing``, mesh-uniform loop-carried scalars): when the
+      previous measured level overflowed every cap AND the frontier is
+      still growing, this level is confidently dense mid-BFS — take the
+      dense path immediately and skip the pmax entirely
+      (direction-optimizing-style prediction). The carry exits
+      prediction the first shrinking level, which re-measures.
+
+    Returns ``(hit [n] bool, branch int32, biggest int32)``: ``branch``
+    indexes :func:`planned_branch_labels`; ``biggest`` is the scalar to
+    carry into the next level's predictor (the measured pmax, or the
+    stale carry on predicted levels — still above every cap, which is
+    what keeps the prediction armed)."""
+    p = num_devices
+    n = x_full.shape[0] // p
+    ladder = normalize_caps(caps)
+    delta_bits = check_delta_bits(delta_bits)
+    K, W = len(ladder), len(delta_bits)
+    B = K * (W + 1)
+    if p == 1:
+        return x_full, jnp.int32(B), jnp.int32(0)
+    i = lax.axis_index(axis_name)
+    chunks = x_full.reshape(p, n)
+    self_row = jnp.arange(p, dtype=jnp.int32)[:, None] == i
+    remote = chunks & ~self_row
+    own = jnp.take(chunks, i, axis=0)
+    rows = jnp.broadcast_to(jnp.arange(p, dtype=jnp.int32)[:, None], (p, n))
+    local_ids = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[None, :], (p, n))
+
+    def dense_hit():
+        if wire_pack:
+            return _packed_reduce_scatter_or(x_full, axis_name, p, "ring")
+        return ring_reduce_scatter(x_full, axis_name, p, jnp.logical_or)
+
+    def measure(rem):
+        counts = jnp.sum(rem.astype(jnp.int32), axis=1)
+        mx = lax.pmax(jnp.stack([jnp.max(counts), max_id_gap(rem)]), axis_name)
+        return mx[0], mx[1]
+
+    def scatter_hit(ids):
+        # Drop-mode OR-scatter: the sentinel n (empty chunks) drops, tail
+        # positions replicate an already-set id — neither needs a mask.
+        return (
+            jnp.zeros((n,), jnp.bool_)
+            .at[ids.reshape(-1)]
+            .set(True, mode="drop")
+        )
+
+    def encode_ladder(rem, biggest, dmax, base):
+        """Cap rungs x encodings over one remote matrix; flat branch ids
+        start at ``base`` (0 unsieved, B+1 sieved)."""
+
+        def make_rung(cap, ri):
+            def rung(_):
+                pos = jnp.cumsum(rem.astype(jnp.int32), axis=1)
+                slot = jnp.where(rem, pos - 1, cap)
+                buf = jnp.full((p, cap), n, jnp.int32)
+                buf = buf.at[rows, slot].set(local_ids, mode="drop")
+
+                def plain(_):
+                    recv = lax.all_to_all(buf, axis_name, 0, 0, tiled=True)
+                    return (
+                        scatter_hit(recv),
+                        jnp.int32(base + ri * (W + 1) + W),
+                    )
+
+                step = plain
+                for e in range(W - 1, -1, -1):
+                    def enc(_, bits=delta_bits[e], e=e):
+                        words = delta_encode_ids(buf, n, bits)
+                        recv = lax.all_to_all(words, axis_name, 0, 0, tiled=True)
+                        ids, _ = delta_decode_ids(recv, cap, bits)
+                        return (
+                            scatter_hit(ids),
+                            jnp.int32(base + ri * (W + 1) + e),
+                        )
+
+                    step = partial(
+                        lax.cond, dmax <= (1 << delta_bits[e]) - 1, enc, step
+                    )
+                return step(None)
+
+            return rung
+
+        def dense_leaf(_):
+            return dense_hit(), jnp.int32(base + B)
+
+        return cap_ladder_select(biggest, ladder, make_rung, dense_leaf)
+
+    def measured(_):
+        biggest, dmax = measure(remote)
+
+        def unsieved(_):
+            h, br = encode_ladder(remote, biggest, dmax, 0)
+            return h, br, biggest
+
+        if not sieve:
+            return unsieved(None)
+
+        def sieved(_):
+            allv = lax.all_gather(pack_bits(visited), axis_name)  # [p, nw]
+            rem2 = remote & ~unpack_bits(allv, n)
+            b2, d2 = measure(rem2)
+            h, br = encode_ladder(rem2, b2, d2, B + 1)
+            return h, br, biggest
+
+        # Sieve when modeled id savings beat the vis transfer's own cost:
+        # visited-density rho x biggest x 4 id bytes per destination vs
+        # the packed vis chunk's 4*ceil(n/32) bytes — and only when a
+        # smaller rung is even reachable (biggest above the tightest
+        # cap). float32 over mesh-uniform ints stays mesh-uniform, so
+        # every chip takes the same cond branch.
+        rho = visited_total.astype(jnp.float32) / float(p * n)
+        gain = rho * biggest.astype(jnp.float32) * 4.0
+        sieve_on = (gain > 4.0 * packed_words(n)) & (biggest > ladder[0])
+        return lax.cond(sieve_on, sieved, unsieved, None)
+
+    if predict:
+        def predicted(_):
+            return dense_hit(), jnp.int32(2 * B + 2), prev_biggest
+
+        pred = (prev_biggest > ladder[-1]) & growing
+        hit, branch, biggest = lax.cond(pred, predicted, measured, None)
+    else:
+        hit, branch, biggest = measured(None)
+    return hit | own, branch, biggest
+
+
 def merge_exchange_counts(prev, counts, resumed_level: int):
     """Accumulate per-branch exchange level counts across the chunks of one
     checkpointed traversal. The consistency test is ``prev.sum() ==
@@ -352,10 +721,19 @@ def merge_exchange_counts(prev, counts, resumed_level: int):
     checkpoint's identity nonce, so counters left by an UNRELATED traversal
     can no longer merge by level-count coincidence; chains whose earlier
     chunks ran in another process simply restart the count (covering the
-    levels run here). Shared by every engine with exchange accounting."""
+    levels run here). Shared by every engine with exchange accounting.
+
+    A ``prev`` whose branch-count LENGTH differs from the current ladder's
+    (the caps / wire_pack / delta / sieve config changed across a
+    checkpoint resume, reshaping the branch space) cannot merge — the
+    indices no longer mean the same branches and ``counts + prev`` would
+    be a shape error; the count restarts instead, covering the levels run
+    under the current config."""
     counts = np.asarray(counts)
-    if resumed_level > 0 and prev is not None and prev.sum() == resumed_level:
-        return counts + prev
+    if resumed_level > 0 and prev is not None:
+        prev = np.asarray(prev)
+        if prev.shape == counts.shape and prev.sum() == resumed_level:
+            return counts + prev
     return counts
 
 
@@ -385,9 +763,24 @@ def gate_and_stamp_chain(engine, resumed_level: int, chain_nonce):
     return prev
 
 
+def rows_gather_branch_count(caps, delta_bits) -> int:
+    """Flat branch space of :func:`sparse_rows_gather`: per cap rung each
+    delta width then plain ids, plus the dense slab — K*(W+1)+1 (no sieve
+    or prediction on the row gather; the lane words ARE the payload)."""
+    return len(normalize_caps(caps)) * (len(delta_bits) + 1) + 1
+
+
+def rows_gather_branch_labels(caps, delta_bits) -> list[str]:
+    """Labels for the row-gather branch layout (index-aligned with
+    :func:`sparse_rows_wire_bytes_per_level`); with no delta ladder this
+    is the legacy ``sparse[c]``.. + ``dense`` list."""
+    return _rung_names(caps, delta_bits) + ["dense"]
+
+
 def sparse_rows_gather(
     nxt, axis_name: str, *, caps: tuple[int, ...],
     out_rows: int, gid_of, dense_fn,
+    delta_bits: tuple[int, ...] = (), gid_of_src=None,
 ):
     """Queue-style frontier gather for the packed MS engines, shared by the
     distributed wide and hybrid engines (which differ only in their
@@ -405,42 +798,121 @@ def sparse_rows_gather(
     sentinel afterwards, so the map must merely not crash on them (pure
     arithmetic maps are fine).
 
+    ``delta_bits`` (ISSUE 7): the nonzero-compacted row ids are ascending,
+    so each chip can ship first-id + fixed-width bit-packed deltas
+    (:func:`delta_encode_ids` over LOCAL ids — local gaps stay small where
+    global round-robin ids would stride by P) instead of 4-byte global
+    ids; the receiver decodes and applies ``gid_of_src(ids, src)`` (the
+    two-arg form of the row map, ``src`` = sender's mesh index — required
+    when delta_bits is set) per gathered chunk. Decoded tail duplicates
+    and empty chunks are masked to the drop sentinel — the value scatter
+    is a SET, so a duplicate id must not let a zeroed tail row clobber a
+    real one. The width rung rides the same pmax as the row count (one
+    s32[2] pair).
+
     Returns ``(table [out_rows, w], branch int32)`` — branch indexes the
-    taken rung (ascending caps order) or ``len(caps)`` for dense.
+    :func:`rows_gather_branch_labels` layout (with no delta ladder: the
+    taken rung in ascending caps order, or ``len(caps)`` for dense).
     """
     rows_loc, w = nxt.shape
     any_row = jnp.any(nxt != 0, axis=1)  # [rows_loc]
-    biggest = lax.pmax(jnp.sum(any_row.astype(jnp.int32)), axis_name)
+    if not delta_bits:
+        biggest = lax.pmax(jnp.sum(any_row.astype(jnp.int32)), axis_name)
 
-    def make_sparse(cap, idx):
-        def sparse_fn(_):
+        def make_sparse(cap, idx):
+            def sparse_fn(_):
+                (ids,) = jnp.nonzero(any_row, size=cap, fill_value=rows_loc)
+                ok = ids < rows_loc
+                vals = jnp.where(ok[:, None], nxt[jnp.where(ok, ids, 0)], 0)
+                gids = jnp.where(ok, gid_of(ids), out_rows)
+                ag_ids = lax.all_gather(gids, axis_name).reshape(-1)
+                ag_vals = lax.all_gather(vals, axis_name).reshape(-1, w)
+                table = (
+                    jnp.zeros((out_rows, w), jnp.uint32)
+                    .at[ag_ids]
+                    .set(ag_vals, mode="drop")  # sentinel out_rows drops
+                )
+                return table, jnp.int32(idx)
+
+            return sparse_fn
+
+        def dense_branch(_):
+            return dense_fn(), jnp.int32(len(normalize_caps(caps)))
+
+        return cap_ladder_select(biggest, caps, make_sparse, dense_branch)
+
+    if gid_of_src is None:
+        raise ValueError(
+            "delta-encoded sparse_rows_gather needs gid_of_src(ids, src) — "
+            "the receiver decodes LOCAL ids and must map them per sender"
+        )
+    delta_bits = check_delta_bits(delta_bits)
+    ladder = normalize_caps(caps)
+    K, W = len(ladder), len(delta_bits)
+    mx = lax.pmax(
+        jnp.stack([
+            jnp.sum(any_row.astype(jnp.int32)),
+            max_id_gap(any_row[None, :]),
+        ]),
+        axis_name,
+    )
+    biggest, dmax = mx[0], mx[1]
+
+    def make_rung(cap, ri):
+        def rung(_):
             (ids,) = jnp.nonzero(any_row, size=cap, fill_value=rows_loc)
             ok = ids < rows_loc
             vals = jnp.where(ok[:, None], nxt[jnp.where(ok, ids, 0)], 0)
-            gids = jnp.where(ok, gid_of(ids), out_rows)
-            ag_ids = lax.all_gather(gids, axis_name).reshape(-1)
             ag_vals = lax.all_gather(vals, axis_name).reshape(-1, w)
+
+            def plain(_):
+                gids = jnp.where(ok, gid_of(ids), out_rows)
+                ag_ids = lax.all_gather(gids, axis_name).reshape(-1)
+                return ag_ids, jnp.int32(ri * (W + 1) + W)
+
+            step = plain
+            for e in range(W - 1, -1, -1):
+                def enc(_, bits=delta_bits[e], e=e):
+                    words = delta_encode_ids(ids[None, :], rows_loc, bits)[0]
+                    ag_w = lax.all_gather(words, axis_name)  # [p, dw]
+                    dec, valid = delta_decode_ids(ag_w, cap, bits)
+                    src = jnp.arange(ag_w.shape[0], dtype=jnp.int32)[:, None]
+                    okd = valid & (dec < rows_loc)
+                    gids = jnp.where(okd, gid_of_src(dec, src), out_rows)
+                    return gids.reshape(-1), jnp.int32(ri * (W + 1) + e)
+
+                step = partial(
+                    lax.cond, dmax <= (1 << delta_bits[e]) - 1, enc, step
+                )
+            ag_ids, br = step(None)
             table = (
                 jnp.zeros((out_rows, w), jnp.uint32)
                 .at[ag_ids]
-                .set(ag_vals, mode="drop")  # sentinel out_rows drops
+                .set(ag_vals, mode="drop")
             )
-            return table, jnp.int32(idx)
+            return table, br
 
-        return sparse_fn
+        return rung
 
-    def dense_branch(_):
-        return dense_fn(), jnp.int32(len(caps))
+    def dense_leaf(_):
+        return dense_fn(), jnp.int32(K * (W + 1))
 
-    return cap_ladder_select(biggest, caps, make_sparse, dense_branch)
+    return cap_ladder_select(biggest, ladder, make_rung, dense_leaf)
 
 
-def default_row_gather_caps(rows_loc: int, w: int) -> tuple[int, ...]:
+def default_row_gather_caps(
+    rows_loc: int, w: int, delta_bits: tuple[int, ...] = ()
+) -> tuple[int, ...]:
     """Width-aware cap ladder for sparse_rows_gather: each gathered row
-    costs 4 id + 4w payload bytes vs the dense slab's 4w per row, so the
-    byte win holds below rows_loc*w/(w+1) rows; two tiers as in
-    default_sparse_caps (tight rung for trickle levels, half break-even)."""
-    be = (rows_loc * w) // (w + 1)
+    costs an id (4 bytes plain, min(delta_bits)/8 delta-encoded) + 4w
+    payload bytes vs the dense slab's 4w per row, so the byte win holds
+    below rows_loc*32w/(32w + id_bits) rows; two tiers as in
+    default_sparse_caps (tight rung for trickle levels, half break-even).
+    The payload dominates at serving widths, so the delta recalibration
+    barely moves the rungs — it exists so the ladder stays honest at
+    w=1."""
+    id_bits = min(delta_bits) if delta_bits else 32
+    be = (rows_loc * 32 * w) // (32 * w + id_bits)
     return tuple(sorted({max(1, be // 16), max(1, be // 2)}))
 
 
@@ -455,21 +927,37 @@ def dense_rows_wire_bytes(p: int, rows_loc: int, w: int) -> float:
 
 
 def sparse_rows_wire_bytes_per_level(
-    p: int, rows_loc: int, w: int, caps: tuple[int, ...]
+    p: int, rows_loc: int, w: int, caps: tuple[int, ...],
+    delta_bits: tuple[int, ...] = (),
 ) -> list[float]:
-    """Modeled off-chip bytes per level per sparse_rows_gather branch
-    (ascending caps, then the dense slab); every branch pays the 4-byte
-    pmax scalar. A 1-device mesh moves nothing."""
+    """Modeled off-chip bytes per level per sparse_rows_gather branch, in
+    :func:`rows_gather_branch_labels` order. With no delta ladder every
+    branch pays the 4-byte pmax scalar (legacy layout); with one, the
+    8-byte s32[2] pair (row count + max id gap) and each delta rung ships
+    ``delta_words(c, b)`` id words instead of ``c`` int32s (the 4w-byte
+    lane payload per row is encoding-invariant). A 1-device mesh moves
+    nothing."""
+    nb = rows_gather_branch_count(caps, delta_bits)
     if p == 1:
-        return [0.0] * (len(caps) + 1)
-    return [float((p - 1) * c * (4 + 4 * w) + 4) for c in sorted(caps)] + [
-        dense_rows_wire_bytes(p, rows_loc, w) + 4.0
-    ]
+        return [0.0] * nb
+    if not delta_bits:
+        return [
+            float((p - 1) * c * (4 + 4 * w) + 4) for c in normalize_caps(caps)
+        ] + [dense_rows_wire_bytes(p, rows_loc, w) + 4.0]
+    out = []
+    for c in normalize_caps(caps):
+        out += [
+            float((p - 1) * (4 * delta_words(c, b) + 4 * c * w) + 8)
+            for b in delta_bits
+        ]
+        out.append(float((p - 1) * c * (4 + 4 * w) + 8))
+    return out + [dense_rows_wire_bytes(p, rows_loc, w) + 8.0]
 
 
 def record_row_gather_exchange(
     prev, branch_counts, resumed_level: int, *, exchange: str, p: int,
     rows_loc: int, w: int, caps: tuple[int, ...],
+    delta_bits: tuple[int, ...] = (),
 ):  # ``prev`` is pre-gated by chained_prev_counts in the engine mixin.
     """The packed MS engines' complete exchange accounting step: merge the
     per-branch level counts into the chunked-traversal chain, then price
@@ -482,7 +970,7 @@ def record_row_gather_exchange(
     at most once per traversal, only when the plane cap was hit."""
     counts = merge_exchange_counts(prev, branch_counts, resumed_level)
     if exchange == "sparse":
-        per = sparse_rows_wire_bytes_per_level(p, rows_loc, w, caps)
+        per = sparse_rows_wire_bytes_per_level(p, rows_loc, w, caps, delta_bits)
     else:
         per = [dense_rows_wire_bytes(p, rows_loc, w)]
     return counts, float(np.dot(counts, per))
@@ -505,7 +993,17 @@ class RowGatherExchangeAccounting:
                 exchange=self._exchange, p=self._gather_p,
                 rows_loc=self._gather_rows_loc, w=self.w,
                 caps=self.sparse_caps,
+                delta_bits=getattr(self, "delta_bits", ()),
             )
+        )
+
+    def exchange_branch_labels(self) -> list[str] | None:
+        """Branch labels index-aligned with the engine's counters — the
+        engine-trace hook (obs/engine_trace reads this when present)."""
+        if self._exchange != "sparse":
+            return None
+        return rows_gather_branch_labels(
+            self.sparse_caps, getattr(self, "delta_bits", ())
         )
 
     def _core_from(self, arrs, fw, vis, planes, level0, max_levels):
@@ -525,11 +1023,25 @@ def sparse_wire_bytes_per_level(
     p: int, n: int, caps: tuple[int, ...], *, wire_pack: bool = False
 ) -> list[float]:
     """Host-side off-chip bytes per level for each sparse_exchange_or branch,
-    in branch-index order (ascending caps, then the dense ring fallback —
-    the bit-packed ring under ``wire_pack``). Each branch pays 4 bytes for
-    the phase-1 pmax scalar."""
+    in branch-index order (normalize_caps order, then the dense ring
+    fallback — the bit-packed ring under ``wire_pack``). Each branch pays
+    4 bytes for the phase-1 pmax scalar. (The ISSUE 7 planner's richer
+    branch space prices via :func:`planned_sparse_wire_bytes_per_level`.)"""
+    ladder = normalize_caps(caps)
     if p == 1:
-        return [0.0] * (len(caps) + 1)
-    return [float((p - 1) * c * 4 + 4) for c in sorted(caps)] + [
+        return [0.0] * (len(ladder) + 1)
+    return [float((p - 1) * c * 4 + 4) for c in ladder] + [
         dense_or_wire_bytes(p, n, "ring", wire_pack=wire_pack) + 4.0
     ]
+
+
+def column_gather_wire_bytes(rows: int, w: int, *, wire_pack: bool = False) -> float:
+    """Off-chip bytes one chip moves in the 2D engine's per-level column
+    all-gather over 'r' (each chip sends its [w] pred slice rows-1 times;
+    ceil(w/32) uint32 words packed). The single source for this term:
+    dense_2d_wire_bytes and the 2D sparse models both price from here."""
+    if rows <= 1:
+        return 0.0
+    return float((rows - 1) * 4 * packed_words(w)) if wire_pack else float(
+        (rows - 1) * w
+    )

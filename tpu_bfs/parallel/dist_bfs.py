@@ -42,12 +42,19 @@ from tpu_bfs.algorithms.frontier import (
 )
 from tpu_bfs.graph.csr import Graph, INF_DIST
 from tpu_bfs.parallel.collectives import (
+    check_delta_bits,
     default_sparse_caps,
     dense_or_wire_bytes,
     gate_and_stamp_chain,
     merge_exchange_counts,
+    normalize_caps,
+    planned_branch_count,
+    planned_branch_labels,
+    planned_sparse_exchange_or,
+    planned_sparse_wire_bytes_per_level,
     reduce_scatter_or,
     reduce_scatter_min,
+    rows_gather_branch_labels,
     sparse_exchange_or,
     sparse_wire_bytes_per_level,
 )
@@ -75,7 +82,8 @@ def make_mesh(num_devices: int | None = None, devices=None) -> Mesh:
 def _dist_bfs_fn(
     mesh: Mesh, p: int, vloc: int, exchange: str, backend: str,
     sparse_caps: tuple[int, ...], dopt_caps: tuple[int, ...] = (),
-    wire_pack: bool = False,
+    wire_pack: bool = False, delta_bits: tuple[int, ...] = (),
+    sieve: bool = False, predict: bool = False,
 ):
     """Build the shard_map'd BFS level loop for a fixed mesh/partition.
 
@@ -98,6 +106,16 @@ def _dist_bfs_fn(
     id rungs already move 4-byte ids. Same collective count, 1/8-1/32 the
     bytes (wirecheck.check_packed_exchange proves it from the HLO).
 
+    ``delta_bits`` / ``sieve`` / ``predict`` (ISSUE 7, sparse exchange
+    only) swap the cap ladder for the full exchange planner
+    (collectives.planned_sparse_exchange_or): delta-encoded id chunks, a
+    backward visited sieve, and history-predictive dense selection. The
+    loop carry gains three mesh-uniform scalars for it — the previous
+    measured ``biggest``, the previous frontier popcount (growth), and
+    the cumulative visited total (all derived from psum/pmax outputs, so
+    every chip carries identical values and the planner's branches stay
+    matched).
+
     The carry also records two tiny per-level arrays for the engine trace
     (tpu_bfs/obs/engine_trace, ISSUE 6): the new-frontier popcount and
     the exchange-branch index of each level, in [TRACE_LEVELS] int32
@@ -105,7 +123,11 @@ def _dist_bfs_fn(
     scalars the loop already computes — the termination psum and the
     ladder branch — so the recording is two dynamic-updates of 256-byte
     replicated arrays per level, collective-free."""
-    nb = len(sparse_caps) + 1 if exchange == "sparse" else 1
+    planned = exchange == "sparse" and bool(delta_bits or sieve or predict)
+    if planned:
+        nb = planned_branch_count(sparse_caps, delta_bits)
+    else:
+        nb = len(sparse_caps) + 1 if exchange == "sparse" else 1
     dopt = backend == "dopt"
 
     def local_loop(
@@ -139,14 +161,29 @@ def _dist_bfs_fn(
             expand_local = dense_fn
 
         def cond(state):
-            _, _, _, level, front_count, _, _, _ = state
+            front_count, level = state[4], state[3]
             return (front_count > 0) & (level < max_levels)
 
         def body(state):
-            (frontier, visited, dist, level, _, branch_counts,
-             front_seq, branch_seq) = state
+            # The planner's history scalars extend the carry ONLY when a
+            # planner feature is on — the legacy programs stay carry-for-
+            # carry identical (compile time and HLO unchanged).
+            if planned:
+                (frontier, visited, dist, level, front_count, branch_counts,
+                 front_seq, branch_seq, prev_biggest, prev_count,
+                 vis_total) = state
+            else:
+                (frontier, visited, dist, level, front_count, branch_counts,
+                 front_seq, branch_seq) = state
             contrib = expand_local(frontier)
-            if exchange == "sparse":
+            if planned:
+                hit, branch, biggest = planned_sparse_exchange_or(
+                    contrib, "v", p, caps=sparse_caps, delta_bits=delta_bits,
+                    sieve=sieve, visited=visited, visited_total=vis_total,
+                    predict=predict, prev_biggest=prev_biggest,
+                    growing=front_count >= prev_count, wire_pack=wire_pack,
+                )
+            elif exchange == "sparse":
                 hit, branch = sparse_exchange_or(
                     contrib, "v", p, caps=sparse_caps, wire_pack=wire_pack
                 )
@@ -171,19 +208,29 @@ def _dist_bfs_fn(
             slot = jnp.minimum(level - level0, TRACE_LEVELS - 1)
             front_seq = front_seq.at[slot].add(count)
             branch_seq = branch_seq.at[slot].set(branch)
-            return (new, visited, dist, level + 1, count, branch_counts,
-                    front_seq, branch_seq)
+            out = (new, visited, dist, level + 1, count, branch_counts,
+                   front_seq, branch_seq)
+            if planned:
+                out = out + (biggest, front_count, vis_total + count)
+            return out
 
         init_count = lax.psum(jnp.sum(frontier.astype(jnp.int32)), "v")
+        init = (frontier, visited, dist, jnp.int32(level0), init_count,
+                jnp.zeros(nb, jnp.int32),
+                jnp.zeros(TRACE_LEVELS, jnp.int32),
+                jnp.full(TRACE_LEVELS, -1, jnp.int32))
+        if planned:
+            # Planner history seeds: biggest unknown (-1 blocks prediction
+            # until the first measured level), no previous frontier, and
+            # the cumulative visited popcount (psum'd, so mesh-uniform
+            # like every carried planner scalar).
+            init = init + (
+                jnp.int32(-1), jnp.int32(0),
+                lax.psum(jnp.sum(visited.astype(jnp.int32)), "v"),
+            )
+        out = lax.while_loop(cond, body, init)
         (frontier, visited, dist, level, _, branch_counts, front_seq,
-         branch_seq) = lax.while_loop(
-            cond,
-            body,
-            (frontier, visited, dist, jnp.int32(level0), init_count,
-             jnp.zeros(nb, jnp.int32),
-             jnp.zeros(TRACE_LEVELS, jnp.int32),
-             jnp.full(TRACE_LEVELS, -1, jnp.int32)),
-        )
+         branch_seq) = out[:8]
         return frontier, visited, dist, level, branch_counts, front_seq, branch_seq
 
     aux_specs = (P("v", None), P("v", None)) if dopt else ()
@@ -328,11 +375,20 @@ class DistBfsEngine(VertexCheckpointMixin):
         sparse_caps: int | tuple[int, ...] | None = None,
         dopt_caps: tuple[int, ...] | None = None,
         wire_pack: bool = False,
+        delta_bits: tuple[int, ...] = (),
+        sieve: bool = False,
+        predict: bool = False,
     ):
         if exchange not in ("ring", "allreduce", "sparse"):
             # Before the partition/device_put work, so a typo fails instantly.
             raise ValueError(
                 f"unknown exchange {exchange!r}; have 'ring', 'allreduce', 'sparse'"
+            )
+        if (delta_bits or sieve or predict) and exchange != "sparse":
+            raise ValueError(
+                "delta_bits/sieve/predict reshape the SPARSE exchange "
+                f"(the ISSUE 7 planner); exchange={exchange!r} has no id "
+                "buffers to compress — use exchange='sparse'"
             )
         self._exchange = exchange
         #: bit-packed wire format (ISSUE 5): boolean exchanges ship uint32
@@ -340,6 +396,18 @@ class DistBfsEngine(VertexCheckpointMixin):
         #: (fuzz-pinned), only the wire encoding changes. Default OFF until
         #: chip-measured, like the pull gate.
         self.wire_pack = bool(wire_pack)
+        #: ISSUE 7 exchange planner knobs (sparse exchange only; all
+        #: default OFF until chip-measured, like wire_pack): delta-encoded
+        #: id chunks, the backward visited sieve, and history-predictive
+        #: dense selection. Results stay bit-identical to the plain sparse
+        #: exchange (fuzz-pinned); only wire encoding and scalar traffic
+        #: change.
+        self.delta_bits = check_delta_bits(delta_bits)
+        self.sieve = bool(sieve)
+        self.predict = bool(predict)
+        self._planned = exchange == "sparse" and bool(
+            self.delta_bits or self.sieve or self.predict
+        )
         self.mesh = mesh if mesh is not None else make_mesh(num_devices)
         self.p = self.mesh.devices.size
         self.graph_meta = (graph.num_input_edges, graph.undirected)
@@ -365,15 +433,21 @@ class DistBfsEngine(VertexCheckpointMixin):
         self.dopt_caps = tuple(sorted(set(dopt_caps))) if dopt_caps else ()
         if sparse_caps is None:
             # The ladder calibrates against the dense fallback it competes
-            # with: the packed bitmap costs 1/8, so the packed rungs sit
-            # three octaves lower (collectives.default_sparse_caps).
-            sparse_caps = default_sparse_caps(part.vloc, wire_pack=self.wire_pack)
+            # with AND the id encoding's per-entry cost: the packed bitmap
+            # costs 1/8 (rungs three octaves lower), delta-encoded ids
+            # cost min(delta_bits)/32 of plain (rungs shifted back up) —
+            # collectives.default_sparse_caps.
+            sparse_caps = default_sparse_caps(
+                part.vloc, wire_pack=self.wire_pack,
+                delta_bits=self.delta_bits,
+            )
         elif isinstance(sparse_caps, int):
             sparse_caps = (sparse_caps,)
-        self.sparse_caps = tuple(sorted(sparse_caps))
+        self.sparse_caps = normalize_caps(sparse_caps)
         self._loop = _dist_bfs_fn(
             self.mesh, self.p, part.vloc, exchange, backend, self.sparse_caps,
-            self.dopt_caps, self.wire_pack,
+            self.dopt_caps, self.wire_pack, self.delta_bits, self.sieve,
+            self.predict,
         )
         # Parent merge is a one-shot int32 MIN reduce-scatter — queue-style
         # exchange does not apply; 'sparse' rides the ring there.
@@ -396,10 +470,17 @@ class DistBfsEngine(VertexCheckpointMixin):
     def wire_bytes_per_level(self) -> list[float]:
         """Modeled off-chip bytes one chip moves per level, per exchange
         branch (ascending sparse caps then the dense fallback; the dense
-        impls have the single entry) — the price list behind
+        impls have the single entry; the ISSUE 7 planner's full layout
+        when delta/sieve/predict are on — ``exchange_branch_labels()``
+        names the entries) — the price list behind
         ``last_exchange_bytes``, and the feed for the bench verdict's
         ``wire_bytes_per_level`` key (TPU_BFS_BENCH_MODE=dist) and the
         BENCHMARKS.md "Exchange bytes" table."""
+        if self._planned:
+            return planned_sparse_wire_bytes_per_level(
+                self.p, self.part.vloc, self.sparse_caps, self.delta_bits,
+                wire_pack=self.wire_pack,
+            )
         if self._exchange == "sparse":
             return sparse_wire_bytes_per_level(
                 self.p, self.part.vloc, self.sparse_caps,
@@ -411,6 +492,17 @@ class DistBfsEngine(VertexCheckpointMixin):
                 wire_pack=self.wire_pack,
             )
         ]
+
+    def exchange_branch_labels(self) -> list[str] | None:
+        """Branch labels index-aligned with ``wire_bytes_per_level()`` /
+        ``last_exchange_level_counts`` — the engine-trace hook
+        (obs/engine_trace reads this when present); None for the dense
+        impls (one branch, labeled by the impl itself)."""
+        if self._planned:
+            return planned_branch_labels(self.sparse_caps, self.delta_bits)
+        if self._exchange == "sparse":
+            return rows_gather_branch_labels(self.sparse_caps, ())
+        return None
 
     def _record_exchange(
         self, branch_counts, *, resumed_level: int = 0, chain_nonce=None

@@ -35,12 +35,23 @@ from tpu_bfs.algorithms.frontier import (
 )
 from tpu_bfs.graph.csr import Graph, INF_DIST
 from tpu_bfs.parallel.collectives import (
+    check_delta_bits,
+    column_gather_wire_bytes,
+    default_sparse_caps,
     dense_2d_wire_bytes,
     gate_and_stamp_chain,
     merge_exchange_counts,
+    normalize_caps,
     pack_bits,
+    planned_branch_count,
+    planned_branch_labels,
+    planned_sparse_exchange_or,
+    planned_sparse_wire_bytes_per_level,
     reduce_scatter_min,
     reduce_scatter_or,
+    rows_gather_branch_labels,
+    sparse_exchange_or,
+    sparse_wire_bytes_per_level,
     unpack_bits,
 )
 from tpu_bfs.obs.engine_trace import TRACE_LEVELS, assemble_dist_trace
@@ -60,7 +71,10 @@ def make_mesh_2d(rows: int, cols: int, devices=None) -> Mesh:
 
 def _dist2d_bfs_fn(mesh: Mesh, rows: int, cols: int, w: int, exchange: str,
                    backend: str, dopt_caps: tuple[int, ...] = (),
-                   wire_pack: bool = False):
+                   wire_pack: bool = False,
+                   sparse_caps: tuple[int, ...] = (),
+                   delta_bits: tuple[int, ...] = (), sieve: bool = False,
+                   predict: bool = False):
     """2D level loop. ``backend='dopt'`` = the BASELINE scale-26 config
     ("2D edge partition + direction-optimizing BFS"): after the column
     all-gather, each chip independently runs the sparse top-down branch
@@ -71,10 +85,28 @@ def _dist2d_bfs_fn(mesh: Mesh, rows: int, cols: int, w: int, exchange: str,
     ``wire_pack=True`` bit-packs BOTH per-level collectives (ISSUE 5): the
     column all-gather over 'r' ships each chip's [w] slice as ceil(w/32)
     uint32 words, and the row reduce-scatter over 'c' runs the packed
-    dense exchange — same collective count, 1/8+ the bytes."""
+    dense exchange — same collective count, 1/8+ the bytes.
+
+    ``exchange='sparse'`` (ISSUE 7) runs the ROW exchange over 'c' as the
+    queue-style id exchange — the row contribution buffer has exactly the
+    1D exchange's [cols * w] per-destination-chunk shape, so the same
+    machinery applies chunk for chunk; ``delta_bits``/``sieve``/
+    ``predict`` upgrade it to the full planner
+    (collectives.planned_sparse_exchange_or). The column all-gather stays
+    dense (its [w] slices have no id form to win with). The carry counts
+    the per-branch levels exactly like the 1D loop; the history scalars
+    ride the termination psum, already mesh-global over ('r','c')."""
     row_block = cols * w
     col_block = rows * w
     dopt = backend == "dopt"
+    planned = exchange == "sparse" and bool(delta_bits or sieve or predict)
+    if exchange == "sparse":
+        nb = (
+            planned_branch_count(sparse_caps, delta_bits)
+            if planned else len(normalize_caps(sparse_caps)) + 1
+        )
+    else:
+        nb = 1
 
     def local_loop(
         src_g, dst_l, rp_l, aux, frontier, visited, dist, level0, max_levels
@@ -103,12 +135,28 @@ def _dist2d_bfs_fn(mesh: Mesh, rows: int, cols: int, w: int, exchange: str,
         else:
             expand_local = dense_fn
 
+        sparse_mode = exchange == "sparse"
+
         def cond(state):
-            _, _, _, level, count, _ = state
+            count, level = state[4], state[3]
             return (count > 0) & (level < max_levels)
 
         def body(state):
-            frontier, visited, dist, level, _, front_seq = state
+            # Dense impls keep the legacy 6-element carry (their single
+            # branch is synthesized after the loop); the sparse row
+            # exchange carries its branch arrays, and the planner its
+            # history scalars on top — legacy programs stay carry-for-
+            # carry identical.
+            if planned:
+                (frontier, visited, dist, level, front_count, front_seq,
+                 branch_counts, branch_seq, prev_biggest, prev_count,
+                 vis_total) = state
+            elif sparse_mode:
+                (frontier, visited, dist, level, front_count, front_seq,
+                 branch_counts, branch_seq) = state
+            else:
+                (frontier, visited, dist, level, front_count,
+                 front_seq) = state
             # Column exchange: assemble this mesh column's frontier slices.
             if wire_pack and rows > 1:
                 # Packed wire: gather uint32 words (one per 32 vertices of
@@ -121,28 +169,96 @@ def _dist2d_bfs_fn(mesh: Mesh, rows: int, cols: int, w: int, exchange: str,
                 col_frontier = lax.all_gather(frontier, "r", tiled=True)  # [R*w]
             contrib = expand_local(col_frontier)
             # Row exchange: combine row-block contributions, keep own chunk.
-            hit = reduce_scatter_or(
-                contrib, "c", cols, impl=exchange, wire_pack=wire_pack
-            )
+            if planned:
+                # The planner's selection scalars (biggest, max gap,
+                # sieve/predict decisions) are pmax'd over 'c' ONLY:
+                # uniform within each mesh row — which is all the row
+                # exchange's per-row collectives need to stay matched —
+                # but rows may take DIFFERENT branches at the same level.
+                # The sieve density normalizes by the planner's own
+                # [cols*w] row block; vis_total counts the whole
+                # rows*cols*w mesh, so scale it down by the row count
+                # (that one IS mesh-uniform — every chip divides the
+                # same psum).
+                hit, branch, biggest = planned_sparse_exchange_or(
+                    contrib, "c", cols, caps=sparse_caps,
+                    delta_bits=delta_bits, sieve=sieve, visited=visited,
+                    visited_total=vis_total // rows, predict=predict,
+                    prev_biggest=prev_biggest,
+                    growing=front_count >= prev_count, wire_pack=wire_pack,
+                )
+            elif exchange == "sparse":
+                hit, branch = sparse_exchange_or(
+                    contrib, "c", cols, caps=sparse_caps, wire_pack=wire_pack
+                )
+            else:
+                hit = reduce_scatter_or(
+                    contrib, "c", cols, impl=exchange, wire_pack=wire_pack
+                )
+                branch = None
             new = hit & ~visited
             dist = jnp.where(new, level + 1, dist)
             visited = visited | new
             count = lax.psum(jnp.sum(new.astype(jnp.int32)), ("r", "c"))
-            # Engine-trace slot (tpu_bfs/obs/engine_trace): the 2D loop
-            # has no exchange ladder, so only the frontier popcount —
-            # already paid by the termination psum — is recorded. ADD,
-            # not set: the clamp slot aggregates levels past the window.
+            # Engine-trace slots (tpu_bfs/obs/engine_trace): frontier
+            # popcount — already paid by the termination psum — and, in
+            # sparse mode, the row-exchange branch. ADD, not set, on the
+            # frontier so the clamp slot aggregates levels past the
+            # window.
             slot = jnp.minimum(level - level0, TRACE_LEVELS - 1)
             front_seq = front_seq.at[slot].add(count)
-            return new, visited, dist, level + 1, count, front_seq
+            out = (new, visited, dist, level + 1, count, front_seq)
+            if sparse_mode:
+                if rows > 1:
+                    # The recorded branch must be MESH-uniform (it leaves
+                    # through replicated out_specs — without this, the
+                    # host would read an arbitrary device's row-local
+                    # view): record the row-MAX branch index, a single
+                    # deterministic representative when rows split. Pure
+                    # telemetry, outside the wire-byte models' stated
+                    # scope like the termination psum.
+                    branch = lax.pmax(branch, "r")
+                branch_counts = branch_counts + (
+                    jnp.arange(nb, dtype=jnp.int32) == branch
+                )
+                branch_seq = branch_seq.at[slot].set(branch)
+                out = out + (branch_counts, branch_seq)
+            if planned:
+                # The planner's history scalars: the 2D visited total
+                # counts the WHOLE mesh's claims, but the sieve prices
+                # against this row's [cols*w] chunks — both mesh-uniform
+                # either way, and the density ratio is partition-
+                # invariant in expectation.
+                out = out + (biggest, front_count, vis_total + count)
+            return out
 
-        init = lax.psum(jnp.sum(frontier.astype(jnp.int32)), ("r", "c"))
-        frontier, visited, dist, level, _, front_seq = lax.while_loop(
-            cond, body,
-            (frontier, visited, dist, jnp.int32(level0), init,
-             jnp.zeros(TRACE_LEVELS, jnp.int32)),
-        )
-        return frontier, visited, dist, level, front_seq
+        init_count = lax.psum(jnp.sum(frontier.astype(jnp.int32)), ("r", "c"))
+        init = (frontier, visited, dist, jnp.int32(level0), init_count,
+                jnp.zeros(TRACE_LEVELS, jnp.int32))
+        if sparse_mode:
+            init = init + (
+                jnp.zeros(nb, jnp.int32),
+                jnp.full(TRACE_LEVELS, -1, jnp.int32),
+            )
+        if planned:
+            init = init + (
+                jnp.int32(-1), jnp.int32(0),
+                lax.psum(jnp.sum(visited.astype(jnp.int32)), ("r", "c")),
+            )
+        out = lax.while_loop(cond, body, init)
+        frontier, visited, dist, level, _, front_seq = out[:6]
+        if sparse_mode:
+            branch_counts, branch_seq = out[6], out[7]
+        else:
+            # Single dense branch: every run level took it — synthesized
+            # outside the loop so the legacy carry stays untouched.
+            levels_run = level - level0
+            branch_counts = levels_run[None].astype(jnp.int32)
+            branch_seq = jnp.where(
+                jnp.arange(TRACE_LEVELS) < jnp.minimum(levels_run, TRACE_LEVELS),
+                0, -1,
+            ).astype(jnp.int32)
+        return frontier, visited, dist, level, front_seq, branch_counts, branch_seq
 
     aux_specs = (P("r", "c", None), P("r", "c", None)) if dopt else ()
     return jax.jit(
@@ -160,7 +276,8 @@ def _dist2d_bfs_fn(mesh: Mesh, rows: int, cols: int, w: int, exchange: str,
                 P(),
                 P(),
             ),
-            out_specs=(P(("r", "c")), P(("r", "c")), P(("r", "c")), P(), P()),
+            out_specs=(P(("r", "c")), P(("r", "c")), P(("r", "c")), P(), P(),
+                       P(), P()),
             check_vma=False,
         )
     )
@@ -218,18 +335,27 @@ class Dist2DBfsEngine(VertexCheckpointMixin):
         backend: str = "scan",
         dopt_caps: tuple[int, ...] | None = None,
         wire_pack: bool = False,
+        sparse_caps: int | tuple[int, ...] | None = None,
+        delta_bits: tuple[int, ...] = (),
+        sieve: bool = False,
+        predict: bool = False,
     ):
         if mesh is None:
             mesh = make_mesh_2d(rows or 1, cols or 1)
         if tuple(mesh.axis_names) != ("r", "c"):
             raise ValueError("2D engine needs a mesh with axes ('r', 'c')")
-        if exchange not in ("ring", "allreduce"):
-            # Reject loudly at build time (not deep inside shard_map tracing):
-            # in particular 'sparse' is a 1D-engine feature — the 2D row/col
-            # collectives already move O(vp/dim) bits per chip.
+        if exchange not in ("ring", "allreduce", "sparse"):
+            # Reject loudly at build time (not deep inside shard_map tracing).
             raise ValueError(
                 f"unknown exchange {exchange!r} for the 2D engine; "
-                "have 'ring', 'allreduce'"
+                "have 'ring', 'allreduce', 'sparse' (the queue-style row "
+                "exchange, ISSUE 7)"
+            )
+        if (delta_bits or sieve or predict) and exchange != "sparse":
+            raise ValueError(
+                "delta_bits/sieve/predict reshape the SPARSE row exchange "
+                f"(the ISSUE 7 planner); exchange={exchange!r} has no id "
+                "buffers to compress — use exchange='sparse'"
             )
         self.mesh = mesh
         self.rows, self.cols = (
@@ -262,11 +388,37 @@ class Dist2DBfsEngine(VertexCheckpointMixin):
         #: (column all-gather, row reduce-scatter) ship uint32 words.
         #: Bit-identical results; default OFF until chip-measured.
         self.wire_pack = bool(wire_pack)
+        #: ISSUE 7 planner knobs for the sparse ROW exchange (same
+        #: contract as DistBfsEngine; all default OFF until chip-measured).
+        self.delta_bits = check_delta_bits(delta_bits)
+        self.sieve = bool(sieve)
+        self.predict = bool(predict)
+        self._planned = exchange == "sparse" and bool(
+            self.delta_bits or self.sieve or self.predict
+        )
+        if exchange == "sparse":
+            if sparse_caps is None:
+                sparse_caps = default_sparse_caps(
+                    part.w, wire_pack=self.wire_pack,
+                    delta_bits=self.delta_bits,
+                )
+            elif isinstance(sparse_caps, int):
+                sparse_caps = (sparse_caps,)
+            self.sparse_caps = normalize_caps(sparse_caps)
+        else:
+            self.sparse_caps = ()
         self._loop = _dist2d_bfs_fn(
             mesh, self.rows, self.cols, part.w, exchange, backend,
-            self.dopt_caps, self.wire_pack,
+            self.dopt_caps, self.wire_pack, self.sparse_caps,
+            self.delta_bits, self.sieve, self.predict,
         )
-        self._parents = _dist2d_parents_fn(mesh, self.rows, self.cols, part.w, exchange)
+        # The parent merge is a one-shot int32 MIN reduce-scatter over
+        # 'c' — queue-style ids don't apply; 'sparse' rides the ring
+        # there (the 1D engine's convention).
+        parent_impl = "ring" if exchange == "sparse" else exchange
+        self._parents = _dist2d_parents_fn(
+            mesh, self.rows, self.cols, part.w, parent_impl
+        )
         #: level count of the last traversal (one branch — the 2D loop has
         #: no cap ladder) and the modeled off-chip bytes one chip moved in
         #: it (column all-gather + row reduce-scatter per level) — the 2D
@@ -282,26 +434,56 @@ class Dist2DBfsEngine(VertexCheckpointMixin):
         self._warmed = False
 
     def wire_bytes_per_level(self) -> list[float]:
-        """Modeled off-chip bytes one chip moves per level (single entry —
-        the 2D loop has no cap ladder): column all-gather + row
-        reduce-scatter, packed or plain per ``wire_pack``. Same contract
-        as DistBfsEngine.wire_bytes_per_level."""
-        return [
-            dense_2d_wire_bytes(
-                self.rows, self.cols, self.part.w, self._exchange,
+        """Modeled off-chip bytes one chip moves per level, per
+        row-exchange branch (single entry for the dense impls; the sparse
+        ladder's branches — or the ISSUE 7 planner's full layout — each
+        plus the per-level column all-gather, which runs on EVERY branch).
+        Same contract as DistBfsEngine.wire_bytes_per_level — with the 2D
+        caveat that sparse branch selection is per mesh ROW (pmax over
+        'c'); when rows split at a level, the recorded branch is the
+        row-MAX index (the loop uniformizes it), so the priced bytes are
+        one deterministic representative rather than an exact per-chip
+        figure."""
+        if self._exchange != "sparse":
+            return [
+                dense_2d_wire_bytes(
+                    self.rows, self.cols, self.part.w, self._exchange,
+                    wire_pack=self.wire_pack,
+                )
+            ]
+        ag = column_gather_wire_bytes(
+            self.rows, self.part.w, wire_pack=self.wire_pack
+        )
+        if self._planned:
+            per = planned_sparse_wire_bytes_per_level(
+                self.cols, self.part.w, self.sparse_caps, self.delta_bits,
                 wire_pack=self.wire_pack,
             )
-        ]
+        else:
+            per = sparse_wire_bytes_per_level(
+                self.cols, self.part.w, self.sparse_caps,
+                wire_pack=self.wire_pack,
+            )
+        return [ag + x for x in per]
+
+    def exchange_branch_labels(self) -> list[str] | None:
+        """Branch labels for the sparse row exchange (engine-trace hook);
+        None for the dense impls."""
+        if self._planned:
+            return planned_branch_labels(self.sparse_caps, self.delta_bits)
+        if self._exchange == "sparse":
+            return rows_gather_branch_labels(self.sparse_caps, ())
+        return None
 
     def _record_exchange(
-        self, levels_run: int, *, resumed_level: int = 0, chain_nonce=None
+        self, branch_counts, *, resumed_level: int = 0, chain_nonce=None
     ) -> None:
         prev = gate_and_stamp_chain(self, resumed_level, chain_nonce)
-        counts = merge_exchange_counts(
-            prev, np.array([levels_run], dtype=np.int64), resumed_level
-        )
+        counts = merge_exchange_counts(prev, branch_counts, resumed_level)
         self.last_exchange_level_counts = counts
-        self.last_exchange_bytes = float(counts[0] * self.wire_bytes_per_level()[0])
+        self.last_exchange_bytes = float(
+            np.dot(counts, self.wire_bytes_per_level())
+        )
 
     def _init_state(self, source: int):
         part = self.part
@@ -316,12 +498,12 @@ class Dist2DBfsEngine(VertexCheckpointMixin):
     def distances_padded(self, source: int, *, max_levels: int | None = None):
         frontier0, visited0, dist0 = self._init_state(source)
         ml = jnp.int32(max_levels if max_levels is not None else self.part.vp)
-        _, _, dist, level, front_seq = self._loop(
+        _, _, dist, level, front_seq, branch_counts, branch_seq = self._loop(
             self.src_g, self.dst_l, self.rp, self._aux,
             frontier0, visited0, dist0, jnp.int32(0), ml,
         )
-        self._record_exchange(int(level))
-        self._record_trace(front_seq, int(level), 0)
+        self._record_exchange(branch_counts)
+        self._record_trace(front_seq, branch_seq, int(level), 0)
         return dist, level
 
     # --- checkpoint/resume: VertexCheckpointMixin (dist_bfs.py) provides
@@ -333,35 +515,36 @@ class Dist2DBfsEngine(VertexCheckpointMixin):
         return self.part.base.num_vertices
 
     def _advance_loop(self, f0, vis0, d0, level0: int, cap: int, *, chain_nonce=None):
-        frontier, visited, dist, level, front_seq = self._loop(
-            self.src_g, self.dst_l, self.rp, self._aux, f0, vis0, d0,
-            jnp.int32(level0), jnp.int32(cap),
+        frontier, visited, dist, level, front_seq, branch_counts, branch_seq = (
+            self._loop(
+                self.src_g, self.dst_l, self.rp, self._aux, f0, vis0, d0,
+                jnp.int32(level0), jnp.int32(cap),
+            )
         )
         self._record_exchange(
-            int(level) - level0, resumed_level=level0, chain_nonce=chain_nonce
+            branch_counts, resumed_level=level0, chain_nonce=chain_nonce
         )
-        self._record_trace(front_seq, int(level) - level0, level0)
+        self._record_trace(front_seq, branch_seq, int(level) - level0, level0)
         return frontier, visited, dist, level
 
-    def _record_trace(self, front_seq, levels_run: int, level0: int) -> None:
-        self._trace_pending = (front_seq, int(levels_run), int(level0))
+    def _record_trace(
+        self, front_seq, branch_seq, levels_run: int, level0: int
+    ) -> None:
+        self._trace_pending = (front_seq, branch_seq, int(levels_run),
+                               int(level0))
         self._trace_cache = None
 
     @property
     def last_run_trace(self) -> list[dict] | None:
         """Per-level rows of the last core invocation — assembled lazily
         (same contract and rationale as DistBfsEngine.last_run_trace;
-        tpu_bfs/obs/engine_trace)."""
+        tpu_bfs/obs/engine_trace). The branch column is the loop-carried
+        row-exchange branch (always 0 for the dense impls; the sparse
+        ladder / planner index otherwise)."""
         pend = self._trace_pending
         if pend is not None:
-            front_seq, levels_run, level0 = pend
+            front_seq, branch_seq, levels_run, level0 = pend
             self._trace_pending = None
-            # The 2D loop has one exchange branch (no cap ladder): every
-            # recorded level ran branch 0, levels past the trace window
-            # stay -1 so the assembler prices only what was recorded.
-            branch_seq = np.where(
-                np.arange(TRACE_LEVELS) < min(levels_run, TRACE_LEVELS), 0, -1
-            ).astype(np.int32)
             self._trace_cache = assemble_dist_trace(
                 self, levels_run, front_seq, branch_seq,
                 direction=self._direction, level0=level0,

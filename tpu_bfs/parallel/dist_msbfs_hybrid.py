@@ -84,7 +84,10 @@ from tpu_bfs.algorithms.msbfs_hybrid import fill_a_tiles, select_dense_tiles
 from tpu_bfs.ops.tile_spmm import AW, TILE, tile_spmm
 from tpu_bfs.parallel.collectives import (
     RowGatherExchangeAccounting,
+    check_delta_bits,
     default_row_gather_caps,
+    normalize_caps,
+    rows_gather_branch_count,
     sparse_rows_gather,
 )
 from tpu_bfs.parallel.dist_bfs import make_mesh
@@ -470,7 +473,7 @@ def build_dist_hybrid(
 def _make_dist_core(
     hd, w: int, num_planes: int, mesh: Mesh, interpret: bool,
     exchange: str = "dense", sparse_caps: tuple[int, ...] = (),
-    gate_levels: int = 0,
+    gate_levels: int = 0, delta_bits: tuple[int, ...] = (),
 ):
     p_count = mesh.devices.size
     rows = hd["rows"]
@@ -478,7 +481,10 @@ def _make_dist_core(
     rows_loc = nrt * TILE
     expand = make_fori_expand(hd["res_spec"], w)
     has_dense = hd["num_tiles"] > 0
-    nb = len(sparse_caps) + 1 if exchange == "sparse" else 1
+    nb = (
+        rows_gather_branch_count(sparse_caps, delta_bits)
+        if exchange == "sparse" else 1
+    )
     sliced = hd.get("layout", "gather") == "sliced"
     # Pull gate (ISSUE 1): gate_levels > 0 makes the cores take a trailing
     # replicated lane-mask argument and return a trailing per-chip
@@ -638,6 +644,10 @@ def _make_dist_core(
                 gid_of=lambda ids: ((ids // TILE) * p_count + p) * TILE
                 + ids % TILE,
                 dense_fn=lambda: dense_gather(fw_own),
+                delta_bits=delta_bits,
+                gid_of_src=lambda ids, src: (
+                    ((ids // TILE) * p_count + src) * TILE + ids % TILE
+                ),
             )
 
         def gather_frontier(fw_own):
@@ -798,9 +808,16 @@ class DistHybridMsBfsEngine(
         lanes: int = LANES,
         pull_gate: bool = False,
         wire_pack: bool = False,
+        delta_bits: tuple[int, ...] = (),
     ):
         if not (1 <= num_planes <= 8):
             raise ValueError("num_planes must be in [1, 8]")
+        if delta_bits and exchange != "sparse":
+            raise ValueError(
+                "delta_bits compresses the SPARSE row gather's id stream "
+                f"(ISSUE 7); exchange={exchange!r} ships whole slabs — "
+                "use exchange='sparse'"
+            )
         # Wire format (ISSUE 5): every exchange this engine runs — the
         # dense/sparse row gathers AND the sliced layout's rotating
         # source-contribution accumulators — already moves uint32 lane
@@ -866,12 +883,17 @@ class DistHybridMsBfsEngine(
             n_arrs["col_tile"] = hd["col_tile_s"]
             n_arrs["a_tiles"] = hd["a_tiles_s"]
         rows_loc = (hd["vt"] // hd["num_shards"]) * TILE
+        #: delta-encoded sparse row-gather ids (ISSUE 7; sparse exchange
+        #: only, default OFF until chip-measured).
+        self.delta_bits = check_delta_bits(delta_bits)
         if sparse_caps is None:
-            sparse_caps = default_row_gather_caps(rows_loc, self.w)
+            sparse_caps = default_row_gather_caps(
+                rows_loc, self.w, self.delta_bits
+            )
         elif isinstance(sparse_caps, int):
             sparse_caps = (sparse_caps,)
         self._exchange = exchange
-        self.sparse_caps = tuple(sorted(sparse_caps))
+        self.sparse_caps = normalize_caps(sparse_caps)
         # RowGatherExchangeAccounting host attributes (see collectives.py).
         self._gather_p = hd["num_shards"]
         self._gather_rows_loc = rows_loc
@@ -904,6 +926,7 @@ class DistHybridMsBfsEngine(
             hd, self.w, num_planes, self.mesh, interpret, exchange,
             self.sparse_caps,
             gate_levels=self.max_levels_cap if pull_gate else 0,
+            delta_bits=self.delta_bits,
         )
         if pull_gate:
             # The raw jitted resume loop takes the extra lane-mask arg and

@@ -46,7 +46,10 @@ from tpu_bfs.algorithms._packed_common import (
 )
 from tpu_bfs.parallel.collectives import (
     RowGatherExchangeAccounting,
+    check_delta_bits,
     default_row_gather_caps,
+    normalize_caps,
+    rows_gather_branch_count,
     sparse_rows_gather,
 )
 from tpu_bfs.parallel.dist_bfs import make_mesh
@@ -67,11 +70,15 @@ from tpu_bfs.algorithms.msbfs_wide import MAX_LANES  # noqa: E402
 def _make_dist_core(
     sell: ShardedEllGraph, w: int, num_planes: int, mesh: Mesh,
     exchange: str = "dense", sparse_caps: tuple[int, ...] = (),
+    delta_bits: tuple[int, ...] = (),
 ):
     p_count = sell.num_shards
     v_loc = sell.v_loc
     v_pad = sell.v_pad
-    nb = len(sparse_caps) + 1 if exchange == "sparse" else 1
+    nb = (
+        rows_gather_branch_count(sparse_caps, delta_bits)
+        if exchange == "sparse" else 1
+    )
     spec = ExpandSpec(
         kcap=sell.kcap,
         heavy=sell.heavy_per_shard > 0,
@@ -90,7 +97,9 @@ def _make_dist_core(
         # The MS-engine form of the reference's per-destination buckets
         # (bfs.cu:148-150): collectives.sparse_rows_gather with this
         # engine's round-robin row map (local row l on chip q holds global
-        # rank l*P + q).
+        # rank l*P + q). ``delta_bits`` ships the local row ids
+        # delta-encoded (ISSUE 7); the receiver then applies the same map
+        # per sender via the two-arg form.
         p = lax.axis_index("v")
         return sparse_rows_gather(
             nxt, "v",
@@ -98,6 +107,8 @@ def _make_dist_core(
             out_rows=v_pad,
             gid_of=lambda ids: ids * p_count + p,
             dense_fn=lambda: _dense_gather(nxt),
+            delta_bits=delta_bits,
+            gid_of_src=lambda ids, src: ids * p_count + src,
         )
 
     def _make_loop(arrs, max_levels):
@@ -245,12 +256,19 @@ class DistWideMsBfsEngine(PackedRunProtocol, RowGatherExchangeAccounting):
         exchange: str = "dense",
         sparse_caps: int | tuple[int, ...] | None = None,
         wire_pack: bool = False,
+        delta_bits: tuple[int, ...] = (),
     ):
         if not (1 <= num_planes <= 8):
             raise ValueError("num_planes must be in [1, 8]")
         if exchange not in ("dense", "sparse"):
             raise ValueError(
                 f"unknown exchange {exchange!r}; have 'dense', 'sparse'"
+            )
+        if delta_bits and exchange != "sparse":
+            raise ValueError(
+                "delta_bits compresses the SPARSE row gather's id stream "
+                f"(ISSUE 7); exchange={exchange!r} ships whole slabs — "
+                "use exchange='sparse'"
             )
         # Wire format (ISSUE 5): this engine's exchange already ships
         # uint32 lane words — one BIT per (vertex, source) pair, the
@@ -314,19 +332,25 @@ class DistWideMsBfsEngine(PackedRunProtocol, RowGatherExchangeAccounting):
             n_arrs["heavy_pick"] = sell.heavy_pick
         for i, (k, blocks) in enumerate(sell.light):
             n_arrs[f"light{i}_t"] = np.ascontiguousarray(blocks.transpose(0, 2, 1))
+        #: delta-encoded sparse row-gather ids (ISSUE 7; sparse exchange
+        #: only, default OFF until chip-measured).
+        self.delta_bits = check_delta_bits(delta_bits)
         if sparse_caps is None:
-            sparse_caps = default_row_gather_caps(sell.v_loc, self.w)
+            sparse_caps = default_row_gather_caps(
+                sell.v_loc, self.w, self.delta_bits
+            )
         elif isinstance(sparse_caps, int):
             sparse_caps = (sparse_caps,)
         self._exchange = exchange
-        self.sparse_caps = tuple(sorted(sparse_caps))
+        self.sparse_caps = normalize_caps(sparse_caps)
         # RowGatherExchangeAccounting host attributes (see collectives.py).
         self._gather_p = sell.num_shards
         self._gather_rows_loc = sell.v_loc
         self.last_exchange_level_counts: np.ndarray | None = None
         self.last_exchange_bytes: float | None = None
         build = _make_dist_core(
-            sell, w, num_planes, self.mesh, exchange, self.sparse_caps
+            sell, w, num_planes, self.mesh, exchange, self.sparse_caps,
+            self.delta_bits,
         )
         self._dist_core, self._core_from_jit, self.arrs = build(n_arrs)
         # Checkpoint-conversion metadata: _rank (below) is the chip-major

@@ -163,11 +163,15 @@ def phase_bytes(engine, *, nz_rows: int | None = None,
     Distributed MS engines (``_gather_p > 1``) add an ``exchange`` entry —
     per-level WIRE bytes, not HBM: the dense slab gather and the sliced
     ring rotation both move (P-1) x [rows/P, w] u32 per chip per level
-    (dist_msbfs_hybrid; the sparse row-gather rungs move less — this is
-    the dense ceiling). The packed MS wire format already carries one bit
-    per (vertex, lane), so ISSUE 5's ``wire_pack`` does not change this
-    entry; their HBM phases are the single-chip model's, per chip, and
-    are not re-derived here (``hg`` is absent on those engines).
+    (dist_msbfs_hybrid; the sparse row-gather rungs move less — and the
+    ISSUE 7 delta-encoded id stream less again; this is the dense
+    ceiling, the per-branch prices live in
+    collectives.sparse_rows_wire_bytes_per_level and the walk's trace
+    rows attribute the branch each level actually took). The packed MS
+    wire format already carries one bit per (vertex, lane), so ISSUE 5's
+    ``wire_pack`` does not change this entry; their HBM phases are the
+    single-chip model's, per chip, and are not re-derived here (``hg``
+    is absent on those engines).
     """
     from tpu_bfs.parallel.collectives import dense_rows_wire_bytes
 
@@ -234,6 +238,11 @@ class LevelAttribution:
     # Unsettled GATE_TILE blocks entering the level (pull-gated engines
     # only; sizes the gated byte model). None when the engine is ungated.
     active_tiles: int | None = None
+    # Exchange branch this level's step recorded (distributed MS engines
+    # stepping through _core_from — the diff of the chunk-chained
+    # per-branch counters; None when unobserved, e.g. the donating TPU
+    # step path, which bypasses the recording).
+    exchange_branch: int | None = None
 
 
 def roofline_hybrid(engine, sources, *, peak_gbs: float = V5E_PEAK_GBS,
@@ -326,6 +335,9 @@ def roofline_hybrid(engine, sources, *, peak_gbs: float = V5E_PEAK_GBS,
     # a million-iteration clamped scatter that could blow the pstage
     # timeout), so its first dispatch can land at any level.
     warmed: set[str] = set()
+    # Chunk-chained exchange counters of the previous step (per-level
+    # branch attribution below diffs against them).
+    prev_counts_walk = None
 
     def timed_slice(name, call):
         out, t = try_timed(call, name not in warmed)
@@ -399,11 +411,33 @@ def roofline_hybrid(engine, sources, *, peak_gbs: float = V5E_PEAK_GBS,
         (fw2, vis2, planes2, lvl2, alive2), t_full = run_timed(
             step, warm=step_warm
         )
+        # Which exchange branch did this one-level step take? Without a
+        # chain nonce each step RESTARTS the per-branch counters
+        # (collectives.chained_prev_counts), so they are usually a
+        # one-level one-hot; a chained engine instead accumulates, and
+        # the level's branch is the diff against the previous step's
+        # counters. The donating TPU path calls the raw core and records
+        # nothing — branch stays None.
+        step_branch = None
+        counts_now = getattr(engine, "last_exchange_level_counts", None)
+        if not donating and counts_now is not None:
+            counts_now = np.asarray(counts_now)
+            if counts_now.sum() == 1:
+                step_branch = int(np.argmax(counts_now))
+            elif (
+                prev_counts_walk is not None
+                and prev_counts_walk.shape == counts_now.shape
+            ):
+                hot = np.flatnonzero(counts_now - prev_counts_walk)
+                if len(hot) == 1 and counts_now[hot[0]] > prev_counts_walk[hot[0]]:
+                    step_branch = int(hot[0])
+            prev_counts_walk = counts_now
         levels.append(LevelAttribution(
             level=level, frontier_rows=nz, took=took, t_full_s=t_full,
             phases_s=phases,
             bytes_model=phase_bytes(engine, nz_rows=nz, active_tiles=at),
             active_tiles=at,
+            exchange_branch=step_branch,
         ))
         if log is not None:
             gate_msg = "" if at is None else f"active_tiles={at} "
@@ -440,10 +474,14 @@ def roofline_hybrid(engine, sources, *, peak_gbs: float = V5E_PEAK_GBS,
     # gate's input (active tiles) into the trace's skip count.
     trace_rows = []
     exch_bytes = getattr(engine, "wire_bytes_per_level", None)
-    exch_each = None
-    if exch_bytes is not None:
-        per = exch_bytes()
-        exch_each = float(per[0]) if len(per) == 1 else None
+    exch_per = [float(x) for x in exch_bytes()] if exch_bytes is not None else None
+    exch_each = (
+        exch_per[0] if exch_per is not None and len(exch_per) == 1 else None
+    )
+    # Per-branch labels (cap rungs, ISSUE 7 delta widths) for engines
+    # that publish them; the per-level branch came from the step diffs.
+    label_hook = getattr(engine, "exchange_branch_labels", None)
+    exch_labels = label_hook() if callable(label_hook) else None
     for la in levels:
         gated_tiles = None
         if la.active_tiles is not None:
@@ -451,6 +489,15 @@ def roofline_hybrid(engine, sources, *, peak_gbs: float = V5E_PEAK_GBS,
 
             total_tiles = engine._table_rows // GATE_TILE
             gated_tiles = max(total_tiles - la.active_tiles, 0)
+        b = la.exchange_branch
+        label = (
+            exch_labels[b]
+            if exch_labels is not None and b is not None
+            and b < len(exch_labels) else None
+        )
+        wire = exch_each
+        if b is not None and exch_per is not None and b < len(exch_per):
+            wire = exch_per[b]
         trace_rows.append({
             "level": la.level,
             "frontier": la.frontier_rows,
@@ -459,8 +506,8 @@ def roofline_hybrid(engine, sources, *, peak_gbs: float = V5E_PEAK_GBS,
                 else "pull-gated" if la.active_tiles is not None else "pull"
             ),
             "gated_tiles": gated_tiles,
-            "exchange": None,
-            "wire_bytes": exch_each,
+            "exchange": label,
+            "wire_bytes": wire,
         })
     engine.last_run_trace = trace_rows
     # Full degradation (every slice OOM'd) still emits the partial report

@@ -252,6 +252,256 @@ def check_2d(graph, rows: int = 2, cols: int = 4, exchange: str = "ring",
     }
 
 
+def check_planned_sparse(graph, p: int = 8, wire_pack: bool = False) -> dict:
+    """ISSUE 7 tentpole proof, from the compiled HLO: the exchange
+    planner's delta branches ship exactly ``delta_words(cap, b)`` =
+    1 + ceil(cap*b/32) uint32 words per destination (one header word +
+    the bit-packed deltas), the sieve path adds EXACTLY ONE packed vis
+    transfer (a u32[ceil(n/32)] all-gather — nothing else in the 1D loop
+    all-gathers), and the whole branch space prices to the model: every
+    entry of planned_sparse_wire_bytes_per_level is re-derived from the
+    collectives' own operand shapes.
+
+    Collective inventory audited (delta_bits=(8,16), sieve+predict on):
+    each (cap rung x {delta8, delta16, plain}) all-to-all appears TWICE —
+    once unsieved, once sieved (consumed pairwise, so a program missing a
+    sieved rung fails); the dense ring appears THREE times (unsieved
+    fallback, sieved fallback, predicted-dense) at P-1 permutes each; the
+    measured pmax is ONE s32[2] all-reduce per measure (two instances:
+    pre- and post-sieve) — the pair rides one scalar collective, which is
+    why measured levels model +8, sieved +16, predicted +0."""
+    from tpu_bfs.parallel.collectives import (
+        DELTA_BITS_DEFAULT,
+        delta_words,
+        packed_words,
+        planned_sparse_wire_bytes_per_level,
+    )
+    from tpu_bfs.parallel.dist_bfs import DistBfsEngine, make_mesh
+
+    delta_bits = DELTA_BITS_DEFAULT
+    eng = DistBfsEngine(
+        graph, make_mesh(p), exchange="sparse", wire_pack=wire_pack,
+        delta_bits=delta_bits, sieve=True, predict=True,
+    )
+    n = eng.part.vloc
+    nw = packed_words(n)
+    caps = eng.sparse_caps
+    colls = hlo_collectives(_lower_1d_loop(eng))
+    pool = list(colls)
+
+    def _take(pred) -> bool:
+        for idx, c in enumerate(pool):
+            if pred(c):
+                del pool[idx]
+                return True
+        return False
+
+    # Per-rung piece bytes, in branch order (delta widths then plain).
+    piece_bytes = []
+    for c in sorted(caps):
+        piece_bytes += [4 * delta_words(c, b) for b in delta_bits]
+        piece_bytes.append(4 * c)
+    found_pairs = []
+    for piece in piece_bytes:
+        # Consume the unsieved AND sieved instance of this rung/encoding.
+        got = sum(
+            _take(
+                lambda a: a.op == "all-to-all"
+                and a.pieces == p
+                and a.result_bytes == piece * p
+            )
+            for _ in range(2)
+        )
+        found_pairs.append(got == 2)
+    leftover_a2a = [c for c in pool if c.op == "all-to-all"]
+    # The sieve's vis transfer: exactly ONE all-gather in the whole loop.
+    ags = [c for c in pool if c.op == "all-gather"]
+    sieve_ok = len(ags) == 1 and ags[0].result_bytes == p * 4 * nw
+    # Dense ring: three instances (unsieved, sieved, predicted) of P-1
+    # permutes each, pred[n] chunks (u32[nw] under wire_pack).
+    chunk = 4 * nw if wire_pack else n
+    perms = [c for c in pool if c.op == "collective-permute"]
+    ring_ok = (
+        len(perms) == 3 * (p - 1)
+        and all(c.result_bytes == chunk for c in perms)
+    )
+    # Scalars: two s32[2] pmax pairs (pre/post-sieve measure), plus the
+    # 4-byte termination psum and visited-total seed.
+    pairs = [c for c in pool if c.op == "all-reduce" and c.result_bytes == 8]
+    singles = [c for c in pool if c.op == "all-reduce" and c.result_bytes == 4]
+
+    sparse_wire = [(p - 1) * piece for piece in piece_bytes]
+    ring_wire = float((p - 1) * chunk)
+    ag_wire = float((p - 1) * 4 * nw)
+    derived = (
+        [w + 8.0 for w in sparse_wire] + [ring_wire + 8.0]
+        + [w + ag_wire + 16.0 for w in sparse_wire]
+        + [ring_wire + ag_wire + 16.0] + [ring_wire]
+    )
+    modeled = planned_sparse_wire_bytes_per_level(
+        p, n, caps, delta_bits, wire_pack=wire_pack
+    )
+    return {
+        "config": (
+            f"planned sparse exchange, P={p}, vloc={n}, caps={caps}, "
+            f"delta_bits={delta_bits}, wire_pack={wire_pack}"
+        ),
+        "modeled_per_level": modeled,
+        "hlo_per_level": derived,
+        "rung_pairs_found": found_pairs,
+        "sieve_allgathers": len(ags),
+        "ring_permutes": len(perms),
+        "pair_pmaxes": len(pairs),
+        "scalar_allreduces": len(singles),
+        "agree": (
+            all(found_pairs)
+            and not leftover_a2a
+            and sieve_ok
+            and ring_ok
+            and len(pairs) == 2
+            and [float(x) for x in modeled] == [float(x) for x in derived]
+        ),
+    }
+
+
+def check_rows_delta(graph, p: int = 8, lanes: int = 64) -> dict:
+    """Delta-encoded sparse row gather (ISSUE 7, distributed wide engine —
+    the hybrid shares the code path): per rung, the id stream compresses
+    to ONE u32[delta_words(cap, b)] all-gather per width (plus the shared
+    [cap, w] lane-word gather, which the encoding cannot touch), and the
+    whole branch space prices to sparse_rows_wire_bytes_per_level."""
+    import jax.numpy as jnp
+
+    from tpu_bfs.parallel.collectives import (
+        DELTA_BITS_DEFAULT,
+        delta_words,
+        sparse_rows_wire_bytes_per_level,
+    )
+    from tpu_bfs.parallel.dist_bfs import make_mesh
+    from tpu_bfs.parallel.dist_msbfs_wide import DistWideMsBfsEngine
+
+    delta_bits = DELTA_BITS_DEFAULT
+    eng = DistWideMsBfsEngine(
+        graph, make_mesh(p), lanes=lanes, exchange="sparse",
+        delta_bits=delta_bits,
+    )
+    w = eng.w
+    rows_loc = eng._gather_rows_loc
+    caps = eng.sparse_caps
+    fw0 = eng._seed_dev(np.asarray([0]))
+    hlo = (
+        eng._dist_core.lower(eng.arrs, fw0, jnp.int32(32)).compile().as_text()
+    )
+    ags = [c for c in hlo_collectives(hlo) if c.op == "all-gather"]
+    pool = list(ags)
+
+    def _take(pred) -> bool:
+        for idx, a in enumerate(pool):
+            if pred(a):
+                del pool[idx]
+                return True
+        return False
+
+    derived = []
+    found = []
+    for c in sorted(caps):
+        vals_b = p * c * 4 * w
+        got_vals = _take(lambda a: a.result_bytes == vals_b and a.pieces == 1)
+        for b in delta_bits:
+            ids_b = p * 4 * delta_words(c, b)
+            got = _take(lambda a: a.result_bytes == ids_b and a.pieces == 1)
+            found.append(got)
+            derived.append(
+                None if not (got and got_vals)
+                else (ids_b + vals_b) * (p - 1) / p + 8.0
+            )
+        ids_plain = p * c * 4
+        got = _take(lambda a: a.result_bytes == ids_plain and a.pieces == 1)
+        found.append(got and got_vals)
+        derived.append(
+            None if not (got and got_vals)
+            else (ids_plain + vals_b) * (p - 1) / p + 8.0
+        )
+    dense_b = p * rows_loc * 4 * w
+    dense_got = _take(lambda a: a.result_bytes == dense_b)
+    found.append(dense_got)
+    derived.append(dense_b * (p - 1) / p + 8.0 if dense_got else None)
+
+    modeled = sparse_rows_wire_bytes_per_level(
+        p, rows_loc, w, caps, delta_bits
+    )
+    return {
+        "config": (
+            f"dist-wide delta rows, P={p}, rows_loc={rows_loc}, w={w}, "
+            f"caps={caps}, delta_bits={delta_bits}"
+        ),
+        "modeled_per_level": modeled,
+        "hlo_per_level": derived,
+        "all_gathers": len(ags),
+        "agree": (
+            all(found)
+            and [float(x) for x in modeled] == [float(x) for x in derived]
+        ),
+    }
+
+
+def check_2d_sparse(graph, rows: int = 2, cols: int = 4) -> dict:
+    """2D queue-style ROW exchange (ISSUE 7): the 2D engine's sparse mode
+    runs sparse_exchange_or over 'c' — the modeled per-branch bytes
+    (column all-gather + sparse rung / ring fallback) vs the compiled
+    loop's own collective shapes."""
+    import jax.numpy as jnp
+
+    from tpu_bfs.parallel.dist_bfs2d import Dist2DBfsEngine, make_mesh_2d
+
+    eng = Dist2DBfsEngine(
+        graph, make_mesh_2d(rows, cols), exchange="sparse"
+    )
+    w = eng.part.w
+    caps = eng.sparse_caps
+    f0, vis0, d0 = eng._init_state(0)
+    hlo = (
+        eng._loop.lower(
+            eng.src_g, eng.dst_l, eng.rp, eng._aux, f0, vis0, d0,
+            jnp.int32(0), jnp.int32(64),
+        )
+        .compile()
+        .as_text()
+    )
+    colls = hlo_collectives(hlo)
+    col_ags = [
+        c for c in colls
+        if c.op == "all-gather" and c.result_bytes == rows * w
+    ]
+    ag_wire = (rows - 1) * w if rows > 1 else 0
+    a2a_wire = sorted(
+        {(c.pieces - 1) * (c.result_bytes // c.pieces)
+         for c in colls if c.op == "all-to-all"}
+    )
+    ring = [
+        c for c in colls
+        if c.op == "collective-permute" and c.result_bytes == w
+    ]
+    derived = [ag_wire + x + 4.0 for x in a2a_wire] + [
+        ag_wire + sum(c.result_bytes for c in ring) + 4.0
+    ]
+    modeled = eng.wire_bytes_per_level()
+    return {
+        "config": (
+            f"2D sparse row exchange, mesh {rows}x{cols}, w={w}, caps={caps}"
+        ),
+        "modeled_per_level": modeled,
+        "hlo_per_level": derived,
+        "column_allgathers": len(col_ags),
+        "ring_steps": len(ring),
+        "agree": (
+            [float(x) for x in modeled] == [float(x) for x in derived]
+            and len(col_ags) == (1 if rows > 1 else 0)
+            and len(ring) == cols - 1
+        ),
+    }
+
+
 def check_rows_sparse(graph, p: int = 8, lanes: int = 64) -> dict:
     """Distributed wide engine, queue-style sparse row gather
     (collectives.sparse_rows_gather, shared with the distributed hybrid):

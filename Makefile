@@ -1,5 +1,5 @@
 # Convenience targets; everything also works without make (README).
-.PHONY: test native bench wirecheck serve-smoke chaos-smoke obs-smoke wheel clean
+.PHONY: test native bench analyze wirecheck serve-smoke chaos-smoke obs-smoke wheel clean
 
 # Full suite on 8 virtual CPU devices (tests/conftest.py forces the
 # platform; the axon TPU plugin is bypassed).
@@ -16,6 +16,21 @@ native:
 bench:
 	python bench.py
 
+# Static verification (README "Static analysis"; tpu_bfs/analysis): the
+# four-pass sweep over every distributed engine config — collective-
+# uniformity taint + compiled-HLO conditional signatures (a divergent
+# branch selection deadlocks a real mesh; invisible on single-host CPU
+# tests), the transfer/retrace guards (no host round-trips in hot loops,
+# no shape-driven recompiles on the serve path, lazy distance contract),
+# the guarded-by/lock-order AST lint over serve/ + obs/, and the 64-bit
+# dtype lint. Findings gate on the analysis-baseline.txt suppression
+# file; exit 1 on anything new. CPU-only, like wirecheck — and a
+# prerequisite OF wirecheck (and so of every smoke target): a program
+# that can deadlock the mesh must fail before its byte model is even
+# worth auditing.
+analyze:
+	env JAX_PLATFORMS=cpu python -m tpu_bfs.analysis --baseline analysis-baseline.txt
+
 # Byte-model vs compiled-HLO audit (fast, CPU-only, 8 virtual devices):
 # every wire-byte formula the framework prints is re-derived from the
 # compiled program's own collective shapes — the ISSUE 5 packed-exchange
@@ -27,7 +42,7 @@ bench:
 # and the codec/planner property tests. A model regression fails HERE,
 # before a chip session ever spends hardware time on it; hence it is
 # also a prerequisite of the smoke targets.
-wirecheck:
+wirecheck: analyze
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_wirecheck.py \
 	  tests/test_collectives_pack.py -q -p no:cacheprovider
 

@@ -84,6 +84,20 @@ pstage() {  # pstage <name> <json-out> <script> [ENV=VAL...] — one helper-scri
   return 1
 }
 
+# Pre-flight (ISSUE 8): the static analyzer runs on CPU BEFORE any A/B
+# stage burns chip time — a mesh program whose branch selection can
+# diverge across ranks would hang a real multi-chip stage mid-BFS (the
+# failure class single-host CPU tests cannot see), and a serve-path
+# retrace or hot-loop host sync would poison every timing the session
+# collects. Fail fast here, while the only cost is seconds of CPU.
+echo "=== analyze pre-flight $(date -u +%H:%M:%S) ==="
+if ! env JAX_PLATFORMS=cpu python -m tpu_bfs.analysis \
+    --baseline analysis-baseline.txt >"$out/analyze.log" 2>&1; then
+  echo "static analysis FAILED (see $out/analyze.log) — not burning chip time"
+  exit 1
+fi
+echo "analyze pre-flight OK"
+
 for i in $(seq 1 "$attempts"); do
   echo "=== attempt $i $(date -u +%H:%M:%S) ==="
   if stage "flagship" "$out/flagship.json"; then

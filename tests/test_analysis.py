@@ -1,0 +1,590 @@
+"""The static-analysis layer (tpu_bfs/analysis, ISSUE 8) — fast half.
+
+Unmarked here: the uniformity taint pass (trace-only, no XLA compile),
+the AST lock lint, the dtype walk, the baseline mechanics, and every
+seeded-violation fixture — the analyzer must fail RED on each planted
+defect before its green run on the real tree means anything. The
+compile-everything HLO sweeps live in test_analysis_sweep.py behind the
+``slow`` marker (the tier-1 budget note in ROADMAP.md); ``make analyze``
+runs the full sweep.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpu_bfs.analysis import Finding, apply_baseline, load_baseline
+from tpu_bfs.analysis import dtypes, uniformity
+from tpu_bfs.analysis.locks import find_cycles, lint_sources, lint_tree, repo_root
+from tpu_bfs.parallel.compat import shard_map
+
+
+def _mesh1d():
+    return Mesh(np.array(jax.devices()[:8]), ("v",))
+
+
+def _mesh2d():
+    return Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("r", "c"))
+
+
+def _smap(body, mesh, in_specs, out_specs):
+    return shard_map(body, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_vma=False)
+
+
+# --- uniformity taint: seeded fixtures --------------------------------------
+
+
+def test_divergent_branch_scalar_flagged():
+    """The tentpole RED case: a cond on a per-chip scalar whose arms
+    issue different collective schedules — the deadlock shape."""
+    mesh = _mesh1d()
+
+    def bad(x):
+        def body(xb):
+            m = jnp.max(xb)  # per-chip: NOT pmax'd
+
+            def a(_):
+                return lax.psum(xb, "v")
+
+            def b(_):
+                return xb * 2
+
+            return lax.cond(m > 3, a, b, None)
+
+        return _smap(body, mesh, (P("v"),), P("v"))(x)
+
+    rep = uniformity.analyze_program(
+        "seeded-divergent", bad, (np.arange(8.0, dtype=np.float32),)
+    )
+    assert len(rep.findings) == 1, rep.findings
+    f = rep.findings[0]
+    assert f.pass_name == "uniformity"
+    # Actionable: names the site and the missing axis.
+    assert "'v'" in f.message and "deadlock" in f.message
+    assert "seeded-divergent" in f.where
+
+
+def test_pmaxed_branch_scalar_certified():
+    """Same program with the scalar routed through pmax: clean, and the
+    differing-collective branch point is CERTIFIED uniform (the
+    certificate the HLO conditional audit consumes)."""
+    mesh = _mesh1d()
+
+    def good(x):
+        def body(xb):
+            m = lax.pmax(jnp.max(xb), "v")
+
+            def a(_):
+                return lax.psum(xb, "v")
+
+            def b(_):
+                return xb * 2
+
+            return lax.cond(m > 3, a, b, None)
+
+        return _smap(body, mesh, (P("v"),), P("v"))(x)
+
+    rep = uniformity.analyze_program(
+        "seeded-good", good, (np.arange(8.0, dtype=np.float32),)
+    )
+    assert rep.findings == []
+    assert rep.certified_divergent_safe >= 1
+
+
+def test_collective_free_divergence_is_safe():
+    """The dopt shape: per-chip branch choice with collective-free arms
+    must NOT be flagged — divergence without communication is legal (and
+    is exactly how the direction-optimizing expansion works)."""
+    mesh = _mesh1d()
+
+    def dopt_like(x):
+        def body(xb):
+            m = jnp.sum(xb)  # per-chip scalar
+
+            def a(_):
+                return xb * 2
+
+            def b(_):
+                return xb + 1
+
+            return lax.cond(m > 3, a, b, None)
+
+        return _smap(body, mesh, (P("v"),), P("v"))(x)
+
+    rep = uniformity.analyze_program(
+        "seeded-dopt", dopt_like, (np.arange(8.0, dtype=np.float32),)
+    )
+    assert rep.findings == []
+
+
+def test_axis_granular_uniformity_2d():
+    """The 2D planner's exact subtlety: a scalar pmax'd over 'c' only is
+    row-uniform — enough for branches whose collectives run over 'c',
+    NOT enough for branches communicating over 'r'."""
+    mesh = _mesh2d()
+
+    def row_ok(x):
+        def body(xb):
+            m = lax.pmax(jnp.max(xb), "c")  # uniform over 'c' only
+
+            def a(_):
+                return lax.psum(xb, "c")  # communicates over 'c': fine
+
+            def b(_):
+                return xb * 2
+
+            return lax.cond(m > 3, a, b, None)
+
+        return _smap(body, mesh, (P(("r", "c")),), P(("r", "c")))(x)
+
+    rep = uniformity.analyze_program(
+        "seeded-2d-ok", row_ok, (np.arange(8.0, dtype=np.float32),)
+    )
+    assert rep.findings == [] and rep.certified_divergent_safe >= 1
+
+    def row_bad(x):
+        def body(xb):
+            m = lax.pmax(jnp.max(xb), "c")
+
+            def a(_):
+                return lax.psum(xb, "r")  # 'r' collective: rows diverge
+
+            def b(_):
+                return xb * 2
+
+            return lax.cond(m > 3, a, b, None)
+
+        return _smap(body, mesh, (P(("r", "c")),), P(("r", "c")))(x)
+
+    rep = uniformity.analyze_program(
+        "seeded-2d-bad", row_bad, (np.arange(8.0, dtype=np.float32),)
+    )
+    assert len(rep.findings) == 1
+    assert "'r'" in rep.findings[0].message
+
+
+def test_all_to_all_output_is_not_uniform():
+    """all_to_all hands each rank a DIFFERENT chunk even from mesh-uniform
+    inputs (reduce_scatter likewise) — a branch scalar derived from one
+    must be flagged until re-reduced. Guards the taint rule that treats
+    these as diverging, not uniformity-preserving."""
+    mesh = _mesh1d()
+
+    def bad(x):
+        def body(xb):
+            g = lax.all_gather(xb, "v", tiled=True)  # uniform over 'v'
+            recv = lax.all_to_all(
+                g.reshape(8, -1), "v", 0, 0, tiled=True
+            )  # per-rank chunks: NOT uniform, despite the uniform input
+            m = jnp.max(recv)
+
+            def a(_):
+                return lax.psum(xb, "v")
+
+            def b(_):
+                return xb * 2
+
+            return lax.cond(m > 3, a, b, None)
+
+        return _smap(body, mesh, (P("v"),), P("v"))(x)
+
+    rep = uniformity.analyze_program(
+        "seeded-a2a", bad, (np.arange(8.0, dtype=np.float32),)
+    )
+    assert len(rep.findings) == 1, [f.render() for f in rep.findings]
+    assert "'v'" in rep.findings[0].message
+
+    def fixed(x):
+        def body(xb):
+            g = lax.all_gather(xb, "v", tiled=True)
+            recv = lax.all_to_all(g.reshape(8, -1), "v", 0, 0, tiled=True)
+            m = lax.pmax(jnp.max(recv), "v")  # re-reduced: uniform again
+
+            def a(_):
+                return lax.psum(xb, "v")
+
+            def b(_):
+                return xb * 2
+
+            return lax.cond(m > 3, a, b, None)
+
+        return _smap(body, mesh, (P("v"),), P("v"))(x)
+
+    rep = uniformity.analyze_program(
+        "seeded-a2a-fixed", fixed, (np.arange(8.0, dtype=np.float32),)
+    )
+    assert rep.findings == [] and rep.certified_divergent_safe >= 1
+
+
+def test_divergent_while_with_collectives_flagged():
+    """A while loop that communicates per iteration under a per-chip trip
+    count: ranks run different iteration counts and the collectives
+    unpair."""
+    mesh = _mesh1d()
+
+    def bad_loop(x):
+        def body(xb):
+            def cond(st):
+                return jnp.sum(st) < 100  # per-chip predicate
+
+            def step(st):
+                return st + lax.psum(st, "v")
+
+            return lax.while_loop(cond, step, xb)
+
+        return _smap(body, mesh, (P("v"),), P("v"))(x)
+
+    rep = uniformity.analyze_program(
+        "seeded-while", bad_loop, (np.arange(8.0, dtype=np.float32),)
+    )
+    assert len(rep.findings) == 1
+    assert "while" in rep.findings[0].message
+
+
+def test_uniformity_through_loop_carried_state():
+    """The planner's history-prediction shape: a pmax'd scalar carried
+    through a while loop stays uniform across iterations — the carry
+    fixed point must not decay it to divergent."""
+    mesh = _mesh1d()
+
+    def carried(x):
+        def body(xb):
+            def cond(st):
+                acc, u = st
+                return u < 100  # uniform carried scalar drives the loop
+
+            def step(st):
+                acc, u = st
+
+                def a(_):
+                    return lax.psum(acc, "v")
+
+                def b(_):
+                    return acc * 2
+
+                acc = lax.cond(u > 3, a, b, None)  # selected by the carry
+                return acc, u + lax.pmax(jnp.max(acc), "v")
+
+            acc, _ = lax.while_loop(
+                cond, step, (xb, lax.pmax(jnp.max(xb), "v"))
+            )
+            return acc
+
+        return _smap(body, mesh, (P("v"),), P("v"))(x)
+
+    rep = uniformity.analyze_program(
+        "seeded-carried", carried, (np.arange(8.0, dtype=np.float32),)
+    )
+    assert rep.findings == [], [f.render() for f in rep.findings]
+    assert rep.certified_divergent_safe >= 1
+
+
+# --- uniformity taint: the real planner programs ----------------------------
+
+
+def test_planner_programs_verify_uniform():
+    """ISSUE 8 acceptance (taint half): the richest real branch spaces —
+    the 1D exchange planner (delta/sieve/predict: 2B+3 branches) — prove
+    clean, with every differing-collective branch point certified by a
+    mesh-uniform selection scalar. Trace-only (no XLA compile); the full
+    config sweep is slow-marked / `make analyze`."""
+    from tpu_bfs.analysis.configs import iter_programs
+
+    for spec in iter_programs(("1d-sparse-planner",)):
+        rep = uniformity.analyze_program(spec.name, spec.fn, spec.args)
+        assert rep.findings == [], [f.render() for f in rep.findings]
+        assert rep.shard_maps >= 1
+        if spec.label == "level_loop":
+            # The cap/delta/sieve/predict cond ladder is really there and
+            # really certified — a trivially-empty walk must not pass.
+            assert rep.conds_checked >= 10
+            assert rep.certified_divergent_safe >= 10
+        # The dtype walk rides the same trace.
+        closed = jax.make_jaxpr(spec.fn)(*spec.args)
+        assert dtypes.check_jaxpr(spec.name, closed) == []
+
+
+# --- dtype pass -------------------------------------------------------------
+
+
+def test_dtype_pass_flags_f64():
+    with jax.experimental.enable_x64(True):
+        closed = jax.make_jaxpr(lambda x: x * 2.0)(np.float64(1.0))
+    findings = dtypes.check_jaxpr("seeded-f64", closed)
+    assert findings and findings[0].pass_name == "dtype"
+    assert "float64" in findings[0].message
+
+
+def test_hlo_wide_dtype_scan_flags_f64():
+    """The compiled-artifact half of the dtype pass: an f64 program's HLO
+    must be flagged (result shapes sit RIGHT of the '=' — a scan of the
+    instruction name side would be a permanent no-op)."""
+    from tpu_bfs.analysis.hlo import wide_dtype_lines
+
+    with jax.experimental.enable_x64(True):
+        hlo = (
+            jax.jit(lambda x: x * 2.0)
+            .lower(np.float64(1.0))
+            .compile()
+            .as_text()
+        )
+    hits = wide_dtype_lines(hlo)
+    assert hits and hits[0]["dtype"] == "f64", hlo[:400]
+    clean = jax.jit(lambda x: x * 2.0).lower(np.float32(1.0)).compile()
+    assert wide_dtype_lines(clean.as_text()) == []
+
+
+def test_dtype_pass_flags_i64_widening():
+    with jax.experimental.enable_x64(True):
+        closed = jax.make_jaxpr(
+            lambda x: jnp.cumsum(x.astype(jnp.int64))
+        )(np.arange(4, dtype=np.int32))
+    findings = dtypes.check_jaxpr("seeded-i64", closed)
+    assert findings and "int64" in findings[0].message
+
+
+# --- transfer pass: seeded host-op fixture ----------------------------------
+
+
+def test_host_callback_in_loop_flagged():
+    """A jax.debug.print left inside a compiled loop lowers to a host
+    callback custom-call — per-iteration device->host sync. The HLO scan
+    must name it; the clean twin must pass."""
+    from tpu_bfs.analysis.transfer import check_hlo_host_ops
+
+    @jax.jit
+    def leaky(x, n):
+        def body(i, a):
+            jax.debug.print("lvl {}", i)
+            return a + 1.0
+
+        return lax.fori_loop(0, n, body, x)
+
+    hlo = leaky.lower(jnp.ones(8), jnp.int32(3)).compile().as_text()
+    findings = check_hlo_host_ops("seeded-leaky", hlo)
+    assert findings, "host callback in a compiled loop must be flagged"
+    assert "host" in findings[0].message
+
+    @jax.jit
+    def clean(x, n):
+        return lax.fori_loop(0, n, lambda i, a: a + 1.0, x)
+
+    hlo = clean.lower(jnp.ones(8), jnp.int32(3)).compile().as_text()
+    assert check_hlo_host_ops("seeded-clean", hlo) == []
+
+
+def test_trace_sentinel_catches_retrace():
+    from tpu_bfs.analysis.transfer import TraceSentinel
+
+    @jax.jit
+    def f(x):
+        return x + 1
+
+    class Holder:
+        def __init__(self):
+            self.entry = f
+
+    h = Holder()
+    f(jnp.ones(4))
+    sentinel = TraceSentinel("toy", h)
+    sentinel.snapshot()
+    f(jnp.ones(4))  # same shape: no retrace
+    assert sentinel.check() == []
+    f(jnp.ones(5))  # new shape: retrace
+    bad = sentinel.check()
+    assert bad and bad[0].pass_name == "transfer/retrace"
+    assert "retraced" in bad[0].message
+
+
+# --- lock lint --------------------------------------------------------------
+
+
+def test_lock_lint_clean_on_tree():
+    """The annotated serve/obs tree lints clean, covers a real guarded
+    population, and its lock-order graph is the expected acyclic shape."""
+    findings, info = lint_tree(repo_root())
+    assert findings == [], [f.render() for f in findings]
+    assert info["guarded_attrs"] >= 30  # the annotation satellite landed
+    assert ("BfsService._lock", "EngineRegistry._lock") in info["edges"]
+    assert ("EngineRegistry._lock", "Recorder._lock") in info["edges"]
+
+
+_UNGUARDED_SRC = '''
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []  # guarded-by: _lock
+
+    def ok(self):
+        with self._lock:
+            return len(self.items)
+
+    def bad(self):
+        return len(self.items)
+'''
+
+
+def test_lock_lint_flags_unguarded_access():
+    findings, _ = lint_sources({"fix.py": _UNGUARDED_SRC})
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.where == "fix.py:Box.items@bad"
+    assert "guarded-by: _lock" in f.message and "items" in f.message
+
+
+_REQUIRES_SRC = '''
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0  # guarded-by: _lock
+
+    def _bump(self):  # requires-lock: _lock
+        self.n += 1
+
+    def ok(self):
+        with self._lock:
+            self._bump()
+
+    def bad(self):
+        self._bump()
+'''
+
+
+def test_lock_lint_flags_requires_lock_violation():
+    findings, _ = lint_sources({"fix.py": _REQUIRES_SRC})
+    assert len(findings) == 1
+    assert "requires-lock" in findings[0].message
+    assert "@bad" in findings[0].where
+
+
+_CYCLE_SRC = '''
+import threading
+
+class A:
+    def __init__(self, b):
+        self._lock = threading.Lock()
+        self.b = B()
+
+    def go(self):
+        with self._lock:
+            self.b.poke()
+
+class B:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.a = A(None)
+
+    def poke(self):
+        with self._lock:
+            pass
+
+    def back(self):
+        with self._lock:
+            self.a.go()
+'''
+
+
+def test_lock_lint_flags_order_cycle():
+    findings, info = lint_sources({"fix.py": _CYCLE_SRC})
+    cyc = [f for f in findings if f.where.startswith("lock-order:")]
+    assert len(cyc) == 1
+    assert "A._lock" in cyc[0].message and "B._lock" in cyc[0].message
+    assert ("A._lock", "B._lock") in info["edges"]
+    assert ("B._lock", "A._lock") in info["edges"]
+
+
+_IDIOM_SRC = '''
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.items = []  # guarded-by: _lock
+
+    def timed(self):
+        if not self._lock.acquire(timeout=0.05):
+            return None
+        try:
+            return list(self.items)
+        finally:
+            self._lock.release()
+
+    def nested(self):
+        with self._lock:
+            with self._lock:  # RLock: legal re-entry
+                return len(self.items)
+'''
+
+
+def test_lock_lint_accepts_acquire_release_idiom_and_rlock():
+    findings, _ = lint_sources({"fix.py": _IDIOM_SRC})
+    assert findings == [], [f.render() for f in findings]
+
+
+_NESTED_FN_SRC = '''
+import threading
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0  # guarded-by: _lock
+
+    def spawn(self):
+        with self._lock:
+            def worker():
+                self.n += 1  # runs later, on another thread: UNGUARDED
+            return worker
+'''
+
+
+def test_lock_lint_nested_function_does_not_inherit_locks():
+    findings, _ = lint_sources({"fix.py": _NESTED_FN_SRC})
+    assert len(findings) == 1 and "@spawn" in findings[0].where
+
+
+def test_find_cycles_simple():
+    assert find_cycles({("a", "b"), ("b", "a")})
+    assert not find_cycles({("a", "b"), ("b", "c")})
+
+
+# --- baseline mechanics -----------------------------------------------------
+
+
+def test_baseline_split_and_stale(tmp_path):
+    f1 = Finding("locks", "m.py:A.x@f", "msg one")
+    f2 = Finding("dtype", "prog:site", "msg two")
+    path = tmp_path / "baseline.txt"
+    path.write_text(
+        "# comment\n\n" + f1.fingerprint + "\nuniformity:gone/never\n"
+    )
+    base = load_baseline(str(path))
+    new, suppressed, stale = apply_baseline([f1, f2], base)
+    assert new == [f2]
+    assert suppressed == [f1]
+    assert stale == {"uniformity:gone/never"}
+    assert load_baseline(str(tmp_path / "missing.txt")) == set()
+
+
+def test_fingerprint_ignores_message():
+    a = Finding("locks", "m.py:A.x@f", "one wording")
+    b = Finding("locks", "m.py:A.x@f", "another wording")
+    assert a.fingerprint == b.fingerprint == "locks:m.py:A.x@f"
+
+
+# --- wirecheck stays a client of the shared core ----------------------------
+
+
+def test_wirecheck_reexports_hlo_core():
+    from tpu_bfs.analysis import hlo as core
+    from tpu_bfs.utils import wirecheck
+
+    assert wirecheck.Collective is core.Collective
+    assert wirecheck.hlo_collectives is core.hlo_collectives

@@ -1,0 +1,99 @@
+"""The static-analysis compile sweep (tpu_bfs/analysis, ISSUE 8) — slow
+half.
+
+Everything here compiles real engine programs (XLA on the 8-virtual-
+device mesh), so it is ``slow``-marked for the tier-1 wall clock; `make
+analyze` runs the same passes over the FULL config inventory as the CI
+gate, and the chip-session pre-flight runs it before any hardware stage
+burns chip time."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from tpu_bfs.analysis import dtypes, transfer, uniformity
+from tpu_bfs.analysis.configs import (
+    ALL_CONFIGS,
+    iter_programs,
+    packed_retrace_drive,
+)
+from tpu_bfs.analysis.hlo import wide_dtype_lines
+
+pytestmark = pytest.mark.slow
+
+
+def test_all_configs_taint_clean():
+    """Every distributed engine config in the inventory — 1D ring/
+    allreduce/sparse/planner/dopt, 2D dense/sparse/planner, the wide and
+    hybrid row gathers — proves uniform at the jaxpr level, with no
+    64-bit intermediates."""
+    checked = 0
+    for spec in iter_programs(ALL_CONFIGS):
+        closed = jax.make_jaxpr(spec.fn)(*spec.args)
+        rep = uniformity.analyze_jaxpr(spec.name, closed)
+        assert rep.findings == [], [f.render() for f in rep.findings]
+        assert rep.shard_maps >= 1, spec.name
+        assert dtypes.check_jaxpr(spec.name, closed) == []
+        checked += 1
+    assert checked >= len(ALL_CONFIGS)  # at least one program per config
+
+
+def test_planner_hlo_conditionals_certified():
+    """The compiled planner program's mismatched-arm conditionals are
+    accepted ONLY because the taint pass certified them — and the same
+    HLO run WITHOUT the certificate fails, naming the conditionals (the
+    collective-signature seeded case, on the real artifact)."""
+    (spec,) = [
+        s for s in iter_programs(("1d-sparse-planner",))
+        if s.label == "level_loop"
+    ]
+    hlo = spec.lower_hlo()
+    rep = uniformity.analyze_program(spec.name, spec.fn, spec.args)
+    assert uniformity.check_hlo_conditionals(spec.name, hlo, rep) == []
+    uncertified = uniformity.check_hlo_conditionals(spec.name, hlo, None)
+    assert uncertified, "planner arms differ; no certificate must fail red"
+    assert all(
+        f.pass_name == "uniformity/collective-signature" for f in uncertified
+    )
+    assert "deadlock" in uncertified[0].message
+
+
+def test_compiled_programs_no_host_ops_no_wide_dtypes():
+    """Representative compiled programs (the planner + the 2D sparse row
+    exchange) carry zero host-boundary instructions and zero 64-bit
+    results."""
+    for cfg in ("1d-sparse-planner", "2d-sparse"):
+        for spec in iter_programs((cfg,)):
+            hlo = spec.lower_hlo()
+            assert transfer.check_hlo_host_ops(spec.name, hlo) == []
+            assert wide_dtype_lines(hlo) == []
+
+
+def test_level_loops_clean_under_transfer_guard():
+    """The warmed level loops run under jax.transfer_guard('disallow')
+    with zero implicit host transfers — the hot path stays on device."""
+    for spec in iter_programs(("1d-ring",)):
+        assert transfer.check_loop_transfer_guard(
+            spec.name, spec.fn, spec.args
+        ) == []
+
+
+def test_packed_engine_retrace_and_lazy_distances():
+    """The serve-path sentinels on a real packed engine: same-shape
+    re-dispatch adds zero traces, and fetch materializes no distance
+    words until a lane is asked for."""
+    eng, drive = packed_retrace_drive()
+    assert transfer.check_engine_retrace("wide-sparse-rows", eng, drive) == []
+    sources = np.arange(eng.lanes, dtype=np.int64) % eng.num_vertices
+    assert transfer.check_lazy_distances(
+        "wide-sparse-rows", eng, sources
+    ) == []
+
+
+def test_analyze_cli_fast_clean():
+    """`tpu-bfs-analyze --fast` (the tier-1 shape) exits 0 on the current
+    tree."""
+    from tpu_bfs.analysis.cli import main
+
+    assert main(["--fast"]) == 0

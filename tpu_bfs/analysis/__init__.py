@@ -1,0 +1,96 @@
+"""Static verification of the mesh programs and the serve tier (ISSUE 8).
+
+Four passes, one shared HLO/jaxpr walking core (:mod:`tpu_bfs.analysis.hlo`
+— refactored out of ``utils/wirecheck.py``, which is now a client), a
+``tpu-bfs-analyze`` CLI (``make analyze``), and a baseline-suppression
+file so findings gate CI:
+
+- **uniformity** (:mod:`.uniformity`): the PR 7 exchange planner made
+  branch choice a per-level runtime decision whose safety rests on an
+  invariant nothing previously proved — every rank must select the same
+  branch wherever the branches' collective schedules differ, or the mesh
+  deadlocks mid-BFS. The pass is a mesh-uniformity taint analysis over
+  the traced jaxpr (branch-selection scalars may flow only through
+  mesh-uniform lineage: pmax/psum outputs, replicated inputs,
+  loop-carried uniform state) plus a compiled-HLO audit that every
+  ``conditional``'s arms carry an identical ordered collective signature,
+  are collective-free, or were certified uniform by the taint pass.
+- **transfer** (:mod:`.transfer`): zero device-to-host round-trips inside
+  hot loops — an HLO infeed/outfeed/host-callback scan over every
+  compiled level program, a ``jax.transfer_guard`` drive of the warmed
+  loops, a jit trace-count sentinel that fails on shape-driven recompiles
+  (protects the serve width ladder), and the lazy ``distance_u8``
+  contract (fetch materializes nothing until asked).
+- **locks** (:mod:`.locks`): an AST lint over ``serve/`` and ``obs/``
+  enforcing ``# guarded-by: <lock>`` annotations (annotated attributes
+  may only be touched inside the matching ``with`` block) plus a
+  cross-module lock-acquisition-order graph that must stay acyclic.
+- **dtype** (:mod:`.dtypes`): no f64 / accidental 64-bit widening in any
+  compiled hot program.
+
+Findings are stable-fingerprinted (``pass:where``); the baseline file
+(one fingerprint per line, ``#`` comments) suppresses known findings so
+the CLI can gate on NEW ones only. A baseline entry matching nothing is
+reported as stale — suppressions must not outlive their findings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+DEFAULT_BASELINE = "analysis-baseline.txt"
+
+#: Pass registry order — also the CLI's execution and report order.
+PASSES = ("uniformity", "transfer", "locks", "dtype")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One verified defect: which pass, a stable location key, and an
+    actionable message naming the offending module/branch/attribute."""
+
+    pass_name: str  # one of PASSES (plus sub-pass suffixes like
+    #                 "uniformity/collective-signature")
+    where: str  # stable location key, e.g. "serve/metrics.py:ServeMetrics.completed"
+    message: str
+
+    @property
+    def fingerprint(self) -> str:
+        """The baseline-suppression key: pass + location, message-free so
+        rewording a diagnostic does not un-suppress it."""
+        return f"{self.pass_name}:{self.where}"
+
+    def render(self) -> str:
+        return f"FINDING [{self.pass_name}] {self.where}: {self.message}"
+
+
+def load_baseline(path: str) -> set[str]:
+    """Fingerprints suppressed by the baseline file; a missing file is an
+    empty baseline (the common clean-tree case)."""
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except FileNotFoundError:
+        return set()
+    out = set()
+    for line in lines:
+        line = line.strip()
+        if line and not line.startswith("#"):
+            out.add(line)
+    return out
+
+
+def apply_baseline(
+    findings: list[Finding], baseline: set[str]
+) -> tuple[list[Finding], list[Finding], set[str]]:
+    """Split ``findings`` into (new, suppressed) and report the stale
+    baseline entries that matched nothing — a suppression whose finding
+    was fixed must be deleted, not carried forever."""
+    new, suppressed, hit = [], [], set()
+    for f in findings:
+        if f.fingerprint in baseline:
+            suppressed.append(f)
+            hit.add(f.fingerprint)
+        else:
+            new.append(f)
+    return new, suppressed, baseline - hit

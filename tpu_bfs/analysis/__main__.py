@@ -1,0 +1,8 @@
+"""``python -m tpu_bfs.analysis`` — the tpu-bfs-analyze entry point."""
+
+import sys
+
+from tpu_bfs.analysis.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

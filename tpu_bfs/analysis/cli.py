@@ -1,0 +1,231 @@
+"""``tpu-bfs-analyze`` — run the static-verification passes and gate on
+findings (``make analyze``).
+
+Exit status: 0 when every finding is baseline-suppressed (or none
+exist), 1 on new findings, so the target gates CI and the chip-session
+pre-flight. The baseline file holds one finding fingerprint per line
+(``pass:where``; ``#`` comments); stale entries — suppressions whose
+finding no longer exists — are reported so they get deleted, and
+``--write-baseline`` rewrites the file from the current findings when a
+known issue must be parked rather than fixed.
+
+``--fast`` runs the trace-only subset (the uniformity taint + dtype
+walks over the planner programs, and the whole AST lock lint) — seconds,
+no XLA compile. The default runs everything: all engine configs
+compiled, their HLO conditional/host-op/dtype audits, the
+transfer-guard drives, and the retrace/lazy-distance sentinels.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tpu_bfs.analysis import (
+    DEFAULT_BASELINE,
+    Finding,
+    apply_baseline,
+    load_baseline,
+)
+
+
+def _log(msg: str) -> None:
+    print(f"# {msg}", file=sys.stderr, flush=True)
+
+
+def run_locks() -> list[Finding]:
+    from tpu_bfs.analysis.locks import lint_tree, repo_root
+
+    findings, info = lint_tree(repo_root())
+    _log(
+        f"locks: {info['classes']} classes, {info['guarded_attrs']} "
+        f"guarded attrs, {len(info['edges'])} lock-order edges, "
+        f"{len(findings)} finding(s)"
+    )
+    return findings
+
+
+def _ensure_mesh() -> None:
+    """The engine sweep needs the 8-virtual-device CPU mesh the tests run
+    on (tests/conftest.py does this for pytest; the standalone CLI does
+    it here — same bootstrap, shared with __graft_entry__)."""
+    from tpu_bfs.utils.virtual_mesh import ensure_virtual_devices
+
+    ensure_virtual_devices(8)
+
+
+def run_program_passes(configs, skip: set, *, compiled: bool) -> list[Finding]:
+    """One sweep over the engine-program inventory, each engine built and
+    traced ONCE: the uniformity taint + dtype walks share the trace, and
+    in ``compiled`` mode the same spec is lowered once for the HLO
+    conditional/host-op/dtype audits plus the transfer-guard drive. Each
+    check family honors its entry in ``skip`` — a skipped pass emits no
+    findings (in particular, skipping uniformity also skips the HLO
+    conditional audit, which without taint certificates would flag the
+    planner's legitimately-differing arms)."""
+    import jax
+
+    from tpu_bfs.analysis import dtypes, transfer, uniformity
+    from tpu_bfs.analysis.configs import iter_programs
+    from tpu_bfs.analysis.hlo import wide_dtype_lines
+
+    do_uni = "uniformity" not in skip
+    do_dtype = "dtype" not in skip
+    do_transfer = compiled and "transfer" not in skip
+    findings: list[Finding] = []
+    for spec in iter_programs(configs):
+        closed = jax.make_jaxpr(spec.fn)(*spec.args)
+        rep = None
+        if do_uni:
+            rep = uniformity.analyze_jaxpr(spec.name, closed)
+            findings.extend(rep.findings)
+            _log(
+                f"uniformity[{spec.name}]: {rep.shard_maps} shard_map(s), "
+                f"{rep.conds_checked} cond(s), "
+                f"{rep.certified_divergent_safe} certified divergent-safe, "
+                f"{len(rep.findings)} finding(s)"
+            )
+        if do_dtype:
+            findings.extend(dtypes.check_jaxpr(spec.name, closed))
+        if not compiled:
+            continue
+        hlo = spec.lower_hlo()
+        cond_f = (
+            uniformity.check_hlo_conditionals(spec.name, hlo, rep)
+            if do_uni else []
+        )
+        host_f = (
+            transfer.check_hlo_host_ops(spec.name, hlo)
+            if do_transfer else []
+        )
+        dtype_f = [
+            Finding(
+                "dtype",
+                f"{spec.name}:{hit['source'] or hit['computation']}",
+                f"compiled program carries a {hit['dtype']} result: "
+                f"{hit['line']}",
+            )
+            for hit in wide_dtype_lines(hlo)
+        ] if do_dtype else []
+        guard_f = (
+            transfer.check_loop_transfer_guard(spec.name, spec.fn, spec.args)
+            if do_transfer else []
+        )
+        findings.extend(cond_f + host_f + dtype_f + guard_f)
+        _log(
+            f"hlo[{spec.name}]: {len(cond_f)} conditional, "
+            f"{len(host_f)} host-op, {len(dtype_f)} dtype, "
+            f"{len(guard_f)} transfer-guard finding(s)"
+        )
+    return findings
+
+
+def run_sentinels() -> list[Finding]:
+    from tpu_bfs.analysis import transfer
+    from tpu_bfs.analysis.configs import packed_retrace_drive
+
+    eng, drive = packed_retrace_drive()
+    findings = transfer.check_engine_retrace("wide-sparse-rows", eng, drive)
+    import numpy as np
+
+    sources = np.arange(eng.lanes, dtype=np.int64) % eng.num_vertices
+    findings += transfer.check_lazy_distances(
+        "wide-sparse-rows", eng, sources
+    )
+    _log(f"sentinels: retrace+lazy-distance, {len(findings)} finding(s)")
+    return findings
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpu-bfs-analyze",
+        description="Static verification of the mesh programs and the "
+        "serve tier: collective-uniformity taint + HLO signatures, "
+        "transfer/retrace guards, lock-discipline lint, dtype lint.",
+    )
+    ap.add_argument("--fast", action="store_true",
+                    help="trace-only subset (no XLA compiles): the "
+                    "uniformity/dtype walks over the planner programs "
+                    "plus the full AST lock lint — the tier-1 shape")
+    ap.add_argument("--configs", default=None, metavar="A,B",
+                    help="restrict the engine-config sweep (names from "
+                    "tpu_bfs/analysis/configs.py; default: all, or the "
+                    "fast subset under --fast)")
+    ap.add_argument("--skip", default="", metavar="PASS,..",
+                    help="skip passes: any of uniformity,transfer,"
+                    "locks,dtype (skipping uniformity also skips the "
+                    "HLO conditional audit, which needs its taint "
+                    "certificates)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help=f"suppression file (default {DEFAULT_BASELINE}; "
+                    "missing = empty)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline file from the current "
+                    "findings and exit 0")
+    args = ap.parse_args(argv)
+
+    skip = {tok.strip() for tok in args.skip.split(",") if tok.strip()}
+    if args.fast:
+        from tpu_bfs.analysis.configs import FAST_CONFIGS
+
+        configs = FAST_CONFIGS
+    else:
+        configs = None
+    if args.configs:
+        from tpu_bfs.analysis.configs import ALL_CONFIGS
+
+        configs = tuple(
+            tok.strip() for tok in args.configs.split(",") if tok.strip()
+        )
+        unknown = [c for c in configs if c not in ALL_CONFIGS]
+        if unknown:
+            _log(f"unknown config(s) {unknown}; have: "
+                 f"{', '.join(ALL_CONFIGS)}")
+            return 2
+
+    findings: list[Finding] = []
+    if "locks" not in skip:
+        findings += run_locks()
+    program_passes = {"uniformity", "dtype"} | (
+        set() if args.fast else {"transfer"}
+    )
+    if not (program_passes <= skip):
+        _ensure_mesh()
+        findings += run_program_passes(
+            configs, skip, compiled=not args.fast
+        )
+    if not args.fast and "transfer" not in skip:
+        findings += run_sentinels()
+
+    if args.write_baseline:
+        with open(args.baseline, "w") as f:
+            f.write("# tpu-bfs-analyze baseline: one suppressed finding "
+                    "fingerprint per line.\n")
+            for fp in sorted({x.fingerprint for x in findings}):
+                f.write(fp + "\n")
+        _log(f"baseline written: {len(findings)} fingerprint(s) -> "
+             f"{args.baseline}")
+        return 0
+
+    new, suppressed, stale = apply_baseline(
+        findings, load_baseline(args.baseline)
+    )
+    for f in new:
+        print(f.render())
+    for fp in sorted(stale):
+        _log(f"STALE baseline entry (no matching finding — delete it): {fp}")
+    _log(
+        f"analyze: {len(findings)} finding(s) total, "
+        f"{len(suppressed)} suppressed, {len(new)} new, "
+        f"{len(stale)} stale baseline entr{'y' if len(stale) == 1 else 'ies'}"
+    )
+    if new:
+        _log("FAIL: new findings above — fix them or (for a parked known "
+             "issue) add their fingerprints to the baseline")
+        return 1
+    _log("OK: all passes clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,147 @@
+"""The analyzed-program inventory: every distributed engine config whose
+compiled level program the static passes verify.
+
+Each engine family exposes ``analysis_programs()`` — its jit entry
+points with example device-resident arguments — so the passes never poke
+engine privates. The inventory mirrors the exchange configurations that
+exist in the tree (ISSUE 8: 1D ring/allreduce/sparse/planner, 2D
+dense/sparse, the dist-wide/hybrid row gathers), each built over one
+small shared graph on the 8-virtual-device CPU mesh (the same graph
+shapes the wirecheck audits compile).
+
+``FAST_CONFIGS`` is the trace-only tier-1 subset (the two planner
+programs — the richest branch spaces); the full list is the
+``make analyze`` sweep.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import lru_cache
+
+
+@dataclasses.dataclass
+class ProgramSpec:
+    config: str  # engine config, e.g. "1d-sparse-planner"
+    label: str  # program within it, e.g. "level_loop"
+    fn: object  # the jit entry
+    args: tuple  # device-resident example arguments
+    engine: object
+
+    @property
+    def name(self) -> str:
+        return f"{self.config}/{self.label}"
+
+    def lower_hlo(self) -> str:
+        return self.fn.lower(*self.args).compile().as_text()
+
+
+@lru_cache(maxsize=1)
+def _graph():
+    from tpu_bfs.graph.generate import random_graph
+
+    # The wirecheck calibration shape: small, connected, 8-chip partition
+    # still lands a real vloc.
+    return random_graph(96, 480, seed=3)
+
+
+def _mesh(p: int = 8):
+    from tpu_bfs.parallel.dist_bfs import make_mesh
+
+    return make_mesh(p)
+
+
+def _build_engine(config: str):
+    g = _graph()
+    if config.startswith("1d-"):
+        from tpu_bfs.parallel.dist_bfs import DistBfsEngine
+
+        kw: dict = {}
+        if config == "1d-ring":
+            kw = dict(exchange="ring")
+        elif config == "1d-allreduce":
+            kw = dict(exchange="allreduce")
+        elif config == "1d-sparse":
+            kw = dict(exchange="sparse")
+        elif config == "1d-sparse-planner":
+            kw = dict(exchange="sparse", delta_bits=(8, 16), sieve=True,
+                      predict=True)
+        elif config == "1d-dopt":
+            kw = dict(exchange="ring", backend="dopt")
+        else:
+            raise KeyError(config)
+        return DistBfsEngine(g, _mesh(), **kw)
+    if config.startswith("2d-"):
+        from tpu_bfs.parallel.dist_bfs2d import Dist2DBfsEngine, make_mesh_2d
+
+        mesh = make_mesh_2d(2, 4)
+        if config == "2d-ring":
+            kw = dict(exchange="ring")
+        elif config == "2d-allreduce":
+            kw = dict(exchange="allreduce")
+        elif config == "2d-dopt":
+            kw = dict(exchange="ring", backend="dopt")
+        elif config == "2d-sparse":
+            kw = dict(exchange="sparse")
+        elif config == "2d-sparse-planner":
+            kw = dict(exchange="sparse", delta_bits=(8, 16), sieve=True,
+                      predict=True)
+        else:
+            raise KeyError(config)
+        return Dist2DBfsEngine(g, mesh, **kw)
+    if config.startswith("wide-"):
+        from tpu_bfs.parallel.dist_msbfs_wide import DistWideMsBfsEngine
+
+        if config == "wide-sparse-rows":
+            kw = dict(exchange="sparse")
+        elif config == "wide-delta-rows":
+            kw = dict(exchange="sparse", delta_bits=(8, 16))
+        else:
+            raise KeyError(config)
+        return DistWideMsBfsEngine(g, _mesh(), lanes=64, **kw)
+    if config.startswith("hybrid-"):
+        from tpu_bfs.parallel.dist_msbfs_hybrid import DistHybridMsBfsEngine
+
+        exchange = config.split("-", 1)[1]
+        return DistHybridMsBfsEngine(g, _mesh(), exchange=exchange)
+    raise KeyError(config)
+
+
+#: Trace-only tier-1 subset: the two planner programs — the richest
+#: branch spaces, where a uniformity regression would actually land.
+FAST_CONFIGS = ("1d-sparse-planner", "2d-sparse-planner")
+
+ALL_CONFIGS = (
+    "1d-ring", "1d-allreduce", "1d-sparse", "1d-sparse-planner", "1d-dopt",
+    "2d-ring", "2d-allreduce", "2d-dopt", "2d-sparse", "2d-sparse-planner",
+    "wide-sparse-rows", "wide-delta-rows",
+    "hybrid-dense", "hybrid-sparse", "hybrid-sliced",
+)
+
+
+def iter_programs(configs=None):
+    """Yield :class:`ProgramSpec` for every program of every requested
+    config (engines built lazily, one at a time — the full sweep holds
+    one engine's tables resident, not fifteen)."""
+    for config in configs or ALL_CONFIGS:
+        eng = _build_engine(config)
+        for label, fn, args in eng.analysis_programs():
+            yield ProgramSpec(config, label, fn, args, eng)
+
+
+def packed_retrace_drive():
+    """(engine, drive) for the retrace sentinel: the dist-wide packed
+    engine driven twice with same-shape different-value batches — the
+    serve executor's padded-dispatch pattern."""
+    import numpy as np
+
+    eng = _build_engine("wide-sparse-rows")
+    n = eng.num_vertices
+    state = {"i": 0}
+
+    def drive(engine):
+        state["i"] += 1  # same shape, different sources each drive
+        sources = (np.arange(engine.lanes, dtype=np.int64) + state["i"]) % n
+        return engine.fetch(engine.dispatch(sources))
+
+    return eng, drive

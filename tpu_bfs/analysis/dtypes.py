@@ -1,0 +1,78 @@
+"""Pass 4: dtype lint — no f64 / accidental 64-bit widening in any
+compiled hot program.
+
+The engines are sized to 32-bit arithmetic end to end (distances u8/i32,
+frontiers pred/u32 words, ids s32); one accidental f64 (a Python float
+folding through an un-annotated op under x64) doubles a hot buffer and
+halves VPU throughput on chip. The jaxpr-level walk below is the primary
+scan (trace-only — no compile needed); :func:`tpu_bfs.analysis.hlo.
+wide_dtype_lines` re-checks the compiled artifact in the full sweep for
+widening XLA itself introduces."""
+
+from __future__ import annotations
+
+from tpu_bfs.analysis import Finding
+
+_WIDE = ("float64", "int64", "uint64", "complex128")
+
+
+def _is_wide(aval) -> str | None:
+    dt = getattr(aval, "dtype", None)
+    name = getattr(dt, "name", None)
+    return name if name in _WIDE else None
+
+
+def _sub_jaxprs(eqn):
+    for key in ("jaxpr", "call_jaxpr", "cond_jaxpr", "body_jaxpr"):
+        v = eqn.params.get(key)
+        if v is not None:
+            yield v.jaxpr if hasattr(v, "jaxpr") else v
+    for b in eqn.params.get("branches", ()):
+        yield b.jaxpr
+
+
+def scan_jaxpr(name: str, jaxpr, findings: list[Finding],
+               _seen: set | None = None) -> None:
+    from tpu_bfs.analysis.uniformity import _source_of
+
+    if _seen is None:
+        _seen = set()
+    if id(jaxpr) in _seen:
+        return
+    _seen.add(id(jaxpr))
+    for eqn in jaxpr.eqns:
+        for v in eqn.outvars:
+            wide = _is_wide(getattr(v, "aval", None))
+            if wide:
+                where = f"{name}:{_source_of(eqn)}"
+                if not any(f.where == where for f in findings):
+                    findings.append(Finding(
+                        "dtype",
+                        where,
+                        f"`{eqn.primitive.name}` produces a {wide} value "
+                        f"in a compiled hot program — 64-bit never "
+                        f"belongs on the device hot path (distances are "
+                        f"u8/i32, frontiers pred/u32). Cast explicitly "
+                        f"or fix the widening input.",
+                    ))
+                break
+        for sub in _sub_jaxprs(eqn):
+            scan_jaxpr(name, sub, findings, _seen)
+
+
+def check_program(name: str, fn, args) -> list[Finding]:
+    """Trace ``fn(*args)`` and flag every 64-bit intermediate."""
+    import jax
+
+    findings: list[Finding] = []
+    closed = jax.make_jaxpr(fn)(*args)
+    scan_jaxpr(name, closed.jaxpr, findings)
+    return findings
+
+
+def check_jaxpr(name: str, closed) -> list[Finding]:
+    """The same scan over an already-traced jaxpr (the runner traces each
+    program once and shares it across passes)."""
+    findings: list[Finding] = []
+    scan_jaxpr(name, closed.jaxpr, findings)
+    return findings

@@ -1,0 +1,301 @@
+"""The shared compiled-HLO walking core (ISSUE 8).
+
+Refactored out of ``utils/wirecheck.py`` (now a client): the shape/byte
+parsing and collective inventory every wirecheck audit was built on, plus
+the structural walkers the static-analysis passes need — computation
+graphs, per-computation transitive collective *signatures* (op kind,
+operand shape, replica/source-target grouping, in program order),
+``conditional`` arm comparison, host-transfer instruction scans, and
+wide-dtype scans.
+
+Everything here is pure text analysis of ``compiled().as_text()`` output:
+the same program XLA runs on TPU, modulo backend lowering, parsed on the
+8-virtual-device CPU mesh.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+import numpy as np
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "bf16": 2, "f16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+}
+
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+
+
+def shape_bytes(shape: str) -> int:
+    """Bytes of one 'dtype[d0,d1]' shape string."""
+    m = _SHAPE_RE.match(shape)
+    if not m or m.group(1) not in _DTYPE_BYTES:
+        raise ValueError(f"unparsable HLO shape {shape!r}")
+    dims = [int(d) for d in m.group(2).split(",") if d] or [1]
+    return _DTYPE_BYTES[m.group(1)] * int(np.prod(dims))
+
+
+@dataclass(frozen=True)
+class Collective:
+    op: str  # all-to-all | collective-permute | all-reduce | all-gather | reduce-scatter
+    # Bytes of the instruction's RESULT shape (the LHS — what the parser
+    # sees). Equal to the operand for permute/all-to-all/all-reduce, the
+    # ops audited by wirecheck; for all-gather the result is Px the
+    # operand and for reduce-scatter 1/Px, so a check over those must
+    # convert before deriving wire bytes.
+    result_bytes: int
+    pieces: int  # tuple arity (1 for array-shaped ops)
+
+
+_COLLECTIVE_OPS = (
+    "all-to-all", "collective-permute", "all-reduce", "all-gather",
+    "reduce-scatter",
+)
+_COLL_PAT = re.compile(
+    r"=\s+(\([^)]*\)|\S+)\s+(" + "|".join(_COLLECTIVE_OPS) + r")(-start)?\("
+)
+
+
+def hlo_collectives(hlo_text: str) -> list[Collective]:
+    """All communication instructions of a compiled HLO module, with the
+    byte sizes read from their own result shapes. Async ``-start`` forms
+    count once (their ``-done`` halves carry no new transfer)."""
+    out = []
+    for line in hlo_text.splitlines():
+        m = _COLL_PAT.search(line)
+        if not m:
+            continue
+        shape, op = m.group(1), m.group(2)
+        if shape.startswith("("):
+            # Tuple elements look like 's32[1,16]{1,0}' with commas both
+            # between elements AND inside the dims — token-scan for shape
+            # atoms instead of splitting on commas.
+            parts = [
+                t.group(0)
+                for t in _SHAPE_RE.finditer(shape)
+                if t.group(1) in _DTYPE_BYTES
+            ]
+            out.append(
+                Collective(op, sum(shape_bytes(p) for p in parts), len(parts))
+            )
+        else:
+            out.append(Collective(op, shape_bytes(shape), 1))
+    return out
+
+
+# --- computation graph ------------------------------------------------------
+
+# '%region_1.26 (Arg_0.27: s32[]) -> s32[] {' / 'ENTRY %main.42 (...) ... {'
+_COMP_HEADER = re.compile(r"^\s*(?:ENTRY\s+)?%?([\w.\-]+)\s*\(.*\)\s*->.*\{\s*$")
+_CALLEE_ATTRS = re.compile(
+    r"(?:branch_computations=\{([^}]*)\}"
+    r"|true_computation=%?([\w.\-]+)"
+    r"|false_computation=%?([\w.\-]+)"
+    r"|condition=%?([\w.\-]+)"
+    r"|body=%?([\w.\-]+)"
+    r"|calls=%?([\w.\-]+)"
+    r"|to_apply=%?([\w.\-]+))"
+)
+_SOURCE_META = re.compile(r'source_file="([^"]+)"(?:.*?source_line=(\d+))?')
+
+
+def hlo_computations(hlo_text: str) -> dict[str, list[str]]:
+    """Computation name -> its instruction lines, in program order."""
+    comps: dict[str, list[str]] = {}
+    current: list[str] | None = None
+    for line in hlo_text.splitlines():
+        m = _COMP_HEADER.match(line)
+        if m:
+            current = comps.setdefault(m.group(1), [])
+            continue
+        if line.strip().startswith("}"):
+            current = None
+            continue
+        if current is not None and line.strip():
+            current.append(line)
+    return comps
+
+
+def line_callees(line: str) -> list[str]:
+    """Computation names one instruction line calls into (conditional
+    branches, while condition/body, fusion calls, reducer to_apply)."""
+    out = []
+    for m in _CALLEE_ATTRS.finditer(line):
+        if m.group(1) is not None:  # branch_computations={%a, %b}
+            out.extend(
+                tok.strip().lstrip("%")
+                for tok in m.group(1).split(",") if tok.strip()
+            )
+        else:
+            out.append(next(g for g in m.groups()[1:] if g is not None))
+    return out
+
+
+def source_of_line(line: str) -> str | None:
+    """'file.py:123' from an instruction's metadata, when present."""
+    m = _SOURCE_META.search(line)
+    if not m:
+        return None
+    path = m.group(1).rsplit("/", 1)[-1]
+    return f"{path}:{m.group(2)}" if m.group(2) else path
+
+
+_INSTR = re.compile(r"=\s+(\([^)]*\)|\S+)\s+([\w\-]+)\(")
+_LAYOUT = re.compile(r"\{[\d,\s]*\}")
+
+
+def _strip_layout(shape: str) -> str:
+    return _LAYOUT.sub("", shape)
+
+
+_GROUP_ATTRS = re.compile(
+    r"(replica_groups=(?:\{\{[^}]*\}\}|\[[^\]]*\](?:<=\[[^\]]*\])?)"
+    r"|source_target_pairs=\{[^}]*\}"
+    r"|dimensions=\{[^}]*\})"
+)
+
+
+def collective_signature(
+    comp: str, comps: dict[str, list[str]], _memo: dict | None = None
+) -> tuple:
+    """The ordered collective schedule a computation executes, transitively
+    through everything it calls: one entry per collective — (op, result
+    shape sans layout, replica/source-target grouping attrs) — plus
+    structural markers for control flow whose schedule is iteration- or
+    branch-shaped (('while', cond_sig, body_sig), ('conditional',
+    (arm_sig, ...))). Two ``conditional`` arms are deadlock-compatible
+    under a divergent predicate iff their signatures are equal (channel
+    ids deliberately excluded — XLA numbers each instruction uniquely, so
+    ids never match across arms; ORDER is the signature)."""
+    if _memo is None:
+        _memo = {}
+    if comp in _memo:
+        return _memo[comp]
+    _memo[comp] = ()  # cycle guard (HLO call graphs are acyclic anyway)
+    sig: list = []
+    for line in comps.get(comp, ()):
+        m = _INSTR.search(line)
+        op = m.group(2) if m else ""
+        base = op[:-6] if op.endswith("-start") else op
+        if base in _COLLECTIVE_OPS:
+            groups = tuple(g.group(1) for g in _GROUP_ATTRS.finditer(line))
+            sig.append((base, _strip_layout(m.group(1)), groups))
+            continue
+        if op == "conditional":
+            arms = tuple(
+                collective_signature(c, comps, _memo)
+                for c in line_callees(line)
+            )
+            if any(arms):
+                sig.append(("conditional", arms))
+            continue
+        if op == "while":
+            callees = line_callees(line)
+            subs = tuple(
+                collective_signature(c, comps, _memo) for c in callees
+            )
+            if any(subs):
+                sig.append(("while", subs))
+            continue
+        for callee in line_callees(line):
+            sig.extend(collective_signature(callee, comps, _memo))
+    _memo[comp] = tuple(sig)
+    return _memo[comp]
+
+
+def mismatched_conditionals(hlo_text: str) -> list[dict]:
+    """Every ``conditional`` whose arms do NOT share one collective
+    signature (and are not all collective-free) — the instruction class
+    that deadlocks a mesh when its predicate diverges across ranks.
+    Each entry carries the source location (when XLA kept metadata) and
+    the per-arm signatures for the report."""
+    comps = hlo_computations(hlo_text)
+    memo: dict = {}
+    out = []
+    for comp, lines in comps.items():
+        for line in lines:
+            m = _INSTR.search(line)
+            if not m or m.group(2) != "conditional":
+                continue
+            arms = line_callees(line)
+            sigs = [collective_signature(a, comps, memo) for a in arms]
+            if len(set(sigs)) > 1:
+                out.append({
+                    "computation": comp,
+                    "arms": arms,
+                    "signatures": sigs,
+                    "source": source_of_line(line),
+                })
+    return out
+
+
+# --- host transfers ---------------------------------------------------------
+
+_HOST_OPS = re.compile(
+    r"=\s+(?:\([^)]*\)|\S+)\s+"
+    r"(outfeed|infeed|send|send-done|recv|recv-done|copy-to-host|"
+    r"copy-from-host)\("
+)
+# Host-callback custom-calls: jax.debug.print / io_callback / pure_callback
+# lower to these targets (CPU: xla_python_cpu_callback / xla_ffi_...;
+# TPU: tpu_host / host callback custom-calls).
+_HOST_CALLBACK = re.compile(
+    r'custom_call_target="[^"]*(callback|host)[^"]*"', re.IGNORECASE
+)
+
+
+def host_transfer_lines(hlo_text: str) -> list[dict]:
+    """Instructions that cross the device-host boundary inside a compiled
+    program: infeed/outfeed/send/recv/host copies, and custom-calls into
+    host callbacks (``jax.debug.print`` inside a level loop lands here).
+    A hot-loop program must have NONE — each is a per-invocation (or
+    per-iteration) host sync."""
+    comps = hlo_computations(hlo_text)
+    out = []
+    for comp, lines in comps.items():
+        for line in lines:
+            m = _HOST_OPS.search(line)
+            cb = _HOST_CALLBACK.search(line)
+            if not m and not cb:
+                continue
+            op = m.group(1) if m else "custom-call(host callback)"
+            out.append({
+                "computation": comp,
+                "op": op,
+                "source": source_of_line(line),
+                "line": line.strip()[:160],
+            })
+    return out
+
+
+# --- wide dtypes ------------------------------------------------------------
+
+_WIDE_SHAPE = re.compile(r"\b(f64|s64|u64|c128)\[")
+
+
+def wide_dtype_lines(hlo_text: str) -> list[dict]:
+    """Instructions whose result shape is 64-bit (f64/s64/u64/c128) — the
+    accidental-widening scan over a compiled hot program (the jaxpr-level
+    scan in :mod:`tpu_bfs.analysis.dtypes` is the primary; this catches
+    widening XLA itself introduces). The result shape sits RIGHT of the
+    ``=`` ('%x = f64[4]{0} multiply(...)'), captured by the same
+    instruction pattern the signature walker uses — tuple results
+    included."""
+    comps = hlo_computations(hlo_text)
+    out = []
+    for comp, lines in comps.items():
+        for line in lines:
+            instr = _INSTR.search(line)
+            if not instr:
+                continue
+            m = _WIDE_SHAPE.search(instr.group(1))
+            if m:
+                out.append({
+                    "computation": comp,
+                    "dtype": m.group(1),
+                    "source": source_of_line(line),
+                    "line": line.strip()[:160],
+                })
+    return out

@@ -1,0 +1,176 @@
+"""Pass 2: transfer/retrace guard — the hot loops must stay on device,
+compiled once per shape.
+
+Four checks, ordered cheapest first:
+
+- **HLO host-op scan** (:func:`check_hlo_host_ops`): the compiled level
+  program must contain NO infeed/outfeed/send/recv/host-callback
+  instruction — a ``jax.debug.print`` left inside the level loop lowers
+  to a host callback custom-call and syncs the mesh to the host every
+  level; this catches it from the artifact.
+- **transfer-guard drive** (:func:`check_loop_transfer_guard`): the
+  warmed loop, invoked with pre-device-put arguments under
+  ``jax.transfer_guard("disallow")`` — any implicit host round-trip the
+  driver slipped into the per-run path (a ``np.asarray`` on a device
+  array, a Python ``int()`` forcing a mid-pipeline pull) raises and
+  becomes a finding.
+- **trace-count sentinel** (:func:`TraceSentinel`): jit entry points are
+  enumerated generically (any engine attribute with a compilation
+  cache); after warm-up, re-driving with same-shape inputs must add ZERO
+  cache entries — a shape-driven retrace on the serve path means some
+  dispatch is not reusing the padded ladder shapes and will pay a
+  multi-second compile mid-traffic.
+- **lazy-distance contract** (:func:`check_lazy_distances`): a packed
+  dispatch+fetch must materialize no distance words and no ecc summary
+  until asked — the ``want_distances=false`` serve path depends on the
+  fetch half transferring only scalars.
+"""
+
+from __future__ import annotations
+
+from tpu_bfs.analysis import Finding
+from tpu_bfs.analysis.hlo import host_transfer_lines
+
+
+def check_hlo_host_ops(name: str, hlo_text: str) -> list[Finding]:
+    out = []
+    for hit in host_transfer_lines(hlo_text):
+        src = hit["source"] or hit["computation"]
+        out.append(Finding(
+            "transfer",
+            f"{name}:{src}",
+            f"compiled hot program contains a host-boundary instruction "
+            f"`{hit['op']}` (in {hit['computation']}): every invocation "
+            f"(or loop iteration) now syncs device->host. Remove the "
+            f"debug callback / host op from the compiled path: "
+            f"{hit['line']}",
+        ))
+    return out
+
+
+def check_loop_transfer_guard(name: str, fn, args) -> list[Finding]:
+    """Drive a (warmed) jit entry under ``jax.transfer_guard('disallow')``.
+    Arguments must already be on device (the configs pre-put them); the
+    warm call outside the guard absorbs compile-time constant placement,
+    so anything the guarded call trips on is a genuine per-run
+    transfer."""
+    import jax
+
+    out = fn(*args)  # warm (compile + constant placement) outside the guard
+    jax.block_until_ready(out)
+    try:
+        with jax.transfer_guard("disallow"):
+            jax.block_until_ready(fn(*args))
+    except Exception as exc:  # noqa: BLE001 — the guard raises RuntimeError-ish
+        return [Finding(
+            "transfer",
+            f"{name}:transfer-guard",
+            f"warmed hot-loop invocation performs an implicit host "
+            f"transfer per run: {str(exc)[:200]} — pre-place the "
+            f"offending operand (jax.device_put) or move the pull out "
+            f"of the per-run path.",
+        )]
+    return []
+
+
+def jit_entries(obj) -> dict[str, object]:
+    """Every jit entry point an engine object holds, found generically:
+    any attribute exposing a compilation-cache size (pjit functions do).
+    Works for every engine family without per-engine plumbing."""
+    out = {}
+    for attr, val in vars(obj).items():
+        if callable(getattr(val, "_cache_size", None)):
+            out[attr] = val
+    return out
+
+
+class TraceSentinel:
+    """Per-config trace-count sentinel on jit entry points.
+
+    Snapshot the compilation-cache sizes of every jit entry after warm-up,
+    drive the workload again, and fail on any growth: a shape-driven
+    recompile on the serving path is a multi-second stall the width
+    ladder exists to prevent (every dispatch pads to a resident rung's
+    exact shape)."""
+
+    def __init__(self, name: str, *objs):
+        self.name = name
+        self._entries = {}
+        for obj in objs:
+            label = type(obj).__name__
+            for attr, fn in jit_entries(obj).items():
+                self._entries[f"{label}.{attr}"] = fn
+        self._baseline: dict[str, int] | None = None
+
+    def snapshot(self) -> None:
+        self._baseline = {
+            k: fn._cache_size() for k, fn in self._entries.items()
+        }
+
+    def check(self) -> list[Finding]:
+        assert self._baseline is not None, "snapshot() before check()"
+        out = []
+        for k, fn in self._entries.items():
+            now = fn._cache_size()
+            was = self._baseline[k]
+            if now > was:
+                out.append(Finding(
+                    "transfer/retrace",
+                    f"{self.name}:{k}",
+                    f"jit entry `{k}` retraced under a same-shape "
+                    f"re-drive ({was} -> {now} cache entries): some "
+                    f"input's shape/dtype/static argument varies per "
+                    f"call. Pad to the fixed serving shape (pad_batch) "
+                    f"or hoist the varying value out of the traced "
+                    f"signature.",
+                ))
+        return out
+
+
+def check_engine_retrace(name: str, engine, drive) -> list[Finding]:
+    """``drive(engine)`` once to warm every shape, snapshot, drive again
+    (callers pass a drive that varies batch FILL but not shape), and fail
+    on any new trace."""
+    sentinel = TraceSentinel(name, engine)
+    drive(engine)
+    sentinel.snapshot()
+    drive(engine)
+    return sentinel.check()
+
+
+def check_lazy_distances(name: str, engine, sources) -> list[Finding]:
+    """Dispatch+fetch must transfer summaries only; the distance planes
+    stay on device until ``distances_int32`` (or the u8 path) is called —
+    the contract the serve tier's metadata-only queries depend on."""
+    out: list[Finding] = []
+    pend = engine.dispatch(sources)
+    res = engine.fetch(pend)
+    if getattr(res, "_word_cache", None):
+        out.append(Finding(
+            "transfer",
+            f"{name}:distance_u8",
+            "fetch materialized distance word-columns before any lane "
+            "was asked for — the lazy distance_u8 path must transfer "
+            "only when materialized (metadata-only serve queries pull "
+            "zero distance words).",
+        ))
+    if getattr(res, "_ecc_cache", None) is not None:
+        out.append(Finding(
+            "transfer",
+            f"{name}:ecc",
+            "fetch materialized the lane-ecc summary eagerly — ecc is "
+            "a lazy on-demand transfer.",
+        ))
+    # The lazy path must still WORK: materialize one lane and check the
+    # source's own distance decodes to 0.
+    d = res.distances_int32(0)
+    if int(d[int(sources[0])]) != 0:
+        out.append(Finding(
+            "transfer",
+            f"{name}:distance_u8-decode",
+            f"lazy materialization decoded distance "
+            f"{int(d[int(sources[0])])} for the source itself "
+            f"(expected 0) — the deferred transfer path is corrupting "
+            f"results.",
+        ))
+    return out

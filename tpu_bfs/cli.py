@@ -14,6 +14,12 @@ generator mode, bfs.cu:892-907).
 Usage:
     python -m tpu_bfs.cli 2 graph.txt
     python -m tpu_bfs.cli 0 rmat:scale=18 --devices 1 --stats
+
+Sibling entry points: ``tpu-bfs-serve`` (the query server),
+``tpu-bfs-graph500`` (the Graph500 harness), and ``tpu-bfs-analyze``
+(static verification of every distributed exchange program + the serve
+tier — `make analyze`; run it before any multi-chip session, it proves
+the branch-selection uniformity a real mesh deadlocks without).
 """
 
 from __future__ import annotations

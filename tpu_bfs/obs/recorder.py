@@ -60,12 +60,13 @@ class Recorder:
         self.window_s = float(window_s)
         self.dump_dir = dump_dir
         self.max_dumps = int(max_dumps)
+        self.capacity = int(capacity)  # immutable; lock-free reads OK
         self._lock = threading.Lock()
-        self._events: deque = deque(maxlen=capacity)
-        self._seq = itertools.count(1)
-        self.dropped = 0  # events pushed out of the full ring
-        self.dumps: list[str] = []  # flight-dump paths written
-        self._dumps_started = 0  # budget is reserved at trigger time
+        self._events: deque = deque(maxlen=capacity)  # guarded-by: _lock
+        self._seq = itertools.count(1)  # itertools.count is GIL-atomic
+        self.dropped = 0  # guarded-by: _lock — events pushed out of the ring
+        self.dumps: list[str] = []  # guarded-by: _lock — dump paths written
+        self._dumps_started = 0  # guarded-by: _lock — reserved at trigger
 
     # --- recording --------------------------------------------------------
 
@@ -153,6 +154,9 @@ class Recorder:
                 return None
             self._dumps_started += 1
             n = self._dumps_started
+            # Captured under the lock: the header below is built outside
+            # it (the lock lint in tpu_bfs/analysis pins the discipline).
+            dropped = self.dropped
         self.event("flight_dump", cat="obs", reason=reason, n=n)
         now = self._now()
         events = self.events_since(now - self.window_s)
@@ -171,7 +175,7 @@ class Recorder:
             "wall_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
             "pid": os.getpid(),
             "events": len(events),
-            "dropped": self.dropped,
+            "dropped": dropped,
         }
         try:
             os.makedirs(self.dump_dir or ".", exist_ok=True)

@@ -546,6 +546,24 @@ class DistBfsEngine(VertexCheckpointMixin):
         put = partial(jax.device_put, device=self._vec_sharding)
         return put(frontier0), put(frontier0.copy()), put(dist0)
 
+    def analysis_programs(self):
+        """Jit entry points + device-resident example args for the static
+        analyzer (tpu_bfs/analysis): the level loop whose branch
+        uniformity the taint pass proves, and the parent merge. Scalars
+        are pre-placed replicated so the transfer-guard drive sees only
+        what a real run transfers."""
+        f0, vis0, d0 = self._init_state(0)
+        rep = NamedSharding(self.mesh, P())
+        l0, ml = (
+            jax.device_put(jnp.int32(0), rep),
+            jax.device_put(jnp.int32(64), rep),
+        )
+        return [
+            ("level_loop", self._loop,
+             (self.src, self.dst, self.rp, self._aux, f0, vis0, d0, l0, ml)),
+            ("parents", self._parents, (self.src, self.dst, d0)),
+        ]
+
     def distances_padded(self, source: int, *, max_levels: int | None = None):
         """Device (padded-id, sharded) distance vector + level counter."""
         frontier0, visited0, dist0 = self._init_state(source)

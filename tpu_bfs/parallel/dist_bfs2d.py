@@ -495,6 +495,24 @@ class Dist2DBfsEngine(VertexCheckpointMixin):
         put = partial(jax.device_put, device=self._vec_sharding)
         return put(frontier0), put(frontier0.copy()), put(dist0)
 
+    def analysis_programs(self):
+        """Static-analyzer hook (tpu_bfs/analysis): the 2D level loop —
+        whose sparse row-exchange branches are uniform per mesh ROW (pmax
+        over 'c'), exactly what the taint pass verifies — and the parent
+        merge. Same contract as DistBfsEngine.analysis_programs."""
+        f0, vis0, d0 = self._init_state(0)
+        rep = NamedSharding(self.mesh, P())
+        l0, ml = (
+            jax.device_put(jnp.int32(0), rep),
+            jax.device_put(jnp.int32(64), rep),
+        )
+        return [
+            ("level_loop", self._loop,
+             (self.src_g, self.dst_l, self.rp, self._aux, f0, vis0, d0,
+              l0, ml)),
+            ("parents", self._parents, (self.src_g, self.dst_l, d0)),
+        ]
+
     def distances_padded(self, source: int, *, max_levels: int | None = None):
         frontier0, visited0, dist0 = self._init_state(source)
         ml = jnp.int32(max_levels if max_levels is not None else self.part.vp)

@@ -987,6 +987,20 @@ class DistHybridMsBfsEngine(
         tau = self.hd["tau_of_vertex"][np.asarray(sources, np.int64)]
         return self._seed_k(*seed_scatter_args(tau, self._act))
 
+    def analysis_programs(self):
+        """Static-analyzer hook (tpu_bfs/analysis): the distributed core
+        (gated form carries the lane-mask arg). Same contract as
+        DistBfsEngine.analysis_programs. The seed table is pre-replicated
+        (per-batch seed movement is inherent to dispatch; the transfer
+        guard watches the loop, not the input staging)."""
+        rep = NamedSharding(self.mesh, P())
+        fw0 = jax.device_put(self._seed_dev(np.asarray([0])), rep)
+        ml = jax.device_put(jnp.int32(32), rep)
+        args = (self.arrs, fw0, ml)
+        if self.pull_gate:
+            args = args + (jax.device_put(self._lane_mask_dev, rep),)
+        return [("dist_core", self._dist_core, args)]
+
     def _core(self, arrs, fw0, max_levels):
         if self.pull_gate:
             planes, vis, levels, alive, truncated, bc, gc = self._dist_core(

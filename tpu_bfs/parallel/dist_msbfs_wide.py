@@ -412,6 +412,18 @@ class DistWideMsBfsEngine(PackedRunProtocol, RowGatherExchangeAccounting):
             jnp.asarray(ranks), jnp.asarray(words), jnp.asarray(bits)
         )
 
+    def analysis_programs(self):
+        """Static-analyzer hook (tpu_bfs/analysis): the distributed core
+        whose sparse row-gather branch uniformity the taint pass proves.
+        Same contract as DistBfsEngine.analysis_programs. The seed table
+        is pre-replicated: per-batch seed movement is inherent to
+        dispatch (fresh sources every batch), so the analyzer's
+        transfer guard watches the LOOP, not the input staging."""
+        rep = NamedSharding(self.mesh, P())
+        fw0 = jax.device_put(self._seed_dev(np.asarray([0])), rep)
+        ml = jax.device_put(jnp.int32(32), rep)
+        return [("dist_core", self._dist_core, (self.arrs, fw0, ml))]
+
     def _src_bits_view(self, fw0):
         """Rank-order seed table -> chip-major view matching planes/vis."""
         sell = self.sell

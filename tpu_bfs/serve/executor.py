@@ -90,8 +90,9 @@ class CircuitBreaker:
         self._now = now
         self._log = log or (lambda msg: None)
         self._lock = threading.Lock()
-        self._state: dict = {}  # key -> [state, consecutive_fails, opened_at]
-        self.opens = 0
+        # key -> [state, consecutive_fails, opened_at]
+        self._state: dict = {}  # guarded-by: _lock
+        self.opens = 0  # guarded-by: _lock
 
     def allow(self, key) -> bool:
         """May a batch be routed to ``key`` right now? Open keys refuse
@@ -231,7 +232,7 @@ class BatchExecutor:
         # REFUSED with a deterministic error (feeding the breaker, which
         # then routes around the rung) instead of abandoning more state.
         self.max_abandoned = 8
-        self._abandoned = 0
+        self._abandoned = 0  # guarded-by: _abandon_lock
         self._abandon_lock = threading.Lock()
 
     # --- pipeline halves --------------------------------------------------
@@ -381,13 +382,16 @@ class BatchExecutor:
         if self.watchdog_s <= 0:
             return self._fetch(engine, pending.handle)
         with self._abandon_lock:
-            over_cap = self._abandoned >= self.max_abandoned
-        if over_cap:
+            # Captured under the lock: the refusal message reads the count
+            # too, and a trip on another thread must not race the read
+            # (the lock lint in tpu_bfs/analysis pins the discipline).
+            abandoned = self._abandoned
+        if abandoned >= self.max_abandoned:
             # Deterministic (no transient marker): resolves the batch's
             # queries with errors and feeds the breaker, instead of
             # abandoning yet another fetch on a wedged device.
             raise RuntimeError(
-                f"dispatch watchdog: {self._abandoned} abandoned fetches "
+                f"dispatch watchdog: {abandoned} abandoned fetches "
                 f"still running (cap {self.max_abandoned}); refusing to "
                 f"watch another fetch on this engine"
             )

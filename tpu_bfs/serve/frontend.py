@@ -154,8 +154,8 @@ class BfsService:
         # while the extraction worker may be shrinking the ladder after a
         # fetch-time OOM.
         self._width_lock = threading.Lock()
-        self._ladder = build_width_ladder(lanes, width_ladder)
-        self._max_lanes = self._ladder[-1]
+        self._ladder = build_width_ladder(lanes, width_ladder)  # guarded-by: _width_lock
+        self._max_lanes = self._ladder[-1]  # guarded-by: _width_lock
         # An internally-created registry must hold the WHOLE ladder
         # resident (plus one degrade-rung slot) or routing thrashes
         # rebuilds; a caller-supplied registry keeps its own policy.
@@ -201,10 +201,14 @@ class BfsService:
             _queue.Queue(maxsize=max(1, int(pipeline_depth)))
             if pipeline else None
         )
+        # _closed/_draining stay deliberately lock-free single-word flags
+        # (submit must never block behind start()'s minutes-long builds),
+        # hence unannotated; the thread handles are lifecycle state only
+        # ever touched under the service lock.
         self._closed = False
         self._draining = False
-        self._thread: threading.Thread | None = None
-        self._extract_thread: threading.Thread | None = None
+        self._thread: threading.Thread | None = None  # guarded-by: _lock
+        self._extract_thread: threading.Thread | None = None  # guarded-by: _lock
         self._lock = threading.Lock()
         if autostart:
             self.start()
@@ -215,7 +219,7 @@ class BfsService:
         return EngineSpec(
             graph_key=self._graph_key,
             engine=self._engine_kind,
-            lanes=self._max_lanes if width is None else width,
+            lanes=self.lanes if width is None else width,
             planes=self._planes,
             pull_gate=self._pull_gate,
             devices=self._devices,
@@ -234,7 +238,7 @@ class BfsService:
             if self._thread is not None:
                 return self
             for w in sorted(self.width_ladder, reverse=True):
-                if w <= self._max_lanes:  # rungs above a degraded cap died
+                if w <= self.lanes:  # rungs above a degraded cap died
                     self._acquire_engine(w)
             if self._pipe_q is not None:
                 self._extract_thread = threading.Thread(
@@ -292,7 +296,8 @@ class BfsService:
     @property
     def lanes(self) -> int:
         """Current maximum serving batch width (halves on OOM degrade)."""
-        return self._max_lanes
+        with self._width_lock:
+            return self._max_lanes
 
     @property
     def width_ladder(self) -> list:
@@ -367,7 +372,7 @@ class BfsService:
 
     def statsz(self) -> dict:
         out = self.metrics.snapshot(
-            queue_depth=self._queue.depth(), lanes=self._max_lanes,
+            queue_depth=self._queue.depth(), lanes=self.lanes,
             extra=self.statsz_extras(),
         )
         out["ladder"] = self.width_ladder
@@ -411,7 +416,7 @@ class BfsService:
         exactly like a dispatch)."""
         attempt = 0
         while True:
-            width = min(width, self._max_lanes)
+            width = min(width, self.lanes)
             try:
                 return self._registry.get(self._spec(width))
             except Exception as exc:  # noqa: BLE001 — gated by classifiers
@@ -461,7 +466,7 @@ class BfsService:
             if dying:
                 self._log(
                     f"OOM at the {at_width}-lane floor: ladder collapsed "
-                    f"to {self._max_lanes} (evicted {dying})"
+                    f"to {at_width} (evicted {dying})"
                 )
             return False
         self._log(f"OOM degrade: {at_width} -> {new} lanes (cap {new})")
@@ -593,7 +598,7 @@ class BfsService:
 
     def _loop(self) -> None:
         while True:
-            batch = self._queue.next_batch(self._max_lanes, self._linger_s)
+            batch = self._queue.next_batch(self.lanes, self._linger_s)
             if self._queue.stopped:
                 n = 0
                 for q in batch:
@@ -923,7 +928,7 @@ def run_server(args, stdin=None, stdout=None, stderr=None,
                                 getattr(args, "trace_out", None))
     if recorder is not None:
         log(f"telemetry recorder ARMED (capacity "
-            f"{recorder._events.maxlen}, flight window "
+            f"{recorder.capacity}, flight window "
             f"{recorder.window_s:.0f}s, dump dir {recorder.dump_dir!r})")
     statsz_interval = resolve_statsz_interval(args)
     xprof = getattr(args, "xprof_dir", None)

@@ -188,35 +188,35 @@ class ServeMetrics:
         self._now = now
         self._lock = threading.Lock()
         self._t0 = now()
-        self._latency_hist = Log2Histogram()
-        self._extract_hist = Log2Histogram()
+        self._latency_hist = Log2Histogram()  # guarded-by: _lock
+        self._extract_hist = Log2Histogram()  # guarded-by: _lock
         # [current, previous] window pair behind the percentile snapshot
         # keys; rotated in place at RECENT_WINDOW_S boundaries.
-        self._recent_t0 = self._t0
-        self._lat_recent = [Log2Histogram(), Log2Histogram()]
-        self._ext_recent = [Log2Histogram(), Log2Histogram()]
-        self.completed = 0
-        self.rejected = 0  # shed at admission (queue full / closed)
-        self.expired = 0  # deadline passed while queued
-        self.errors = 0
-        self.shutdown = 0  # resolved unserved at close
-        self.retries = 0  # transient-failure re-dispatches
-        self.oom_degrades = 0  # lane-count halvings after OOM
-        self.requeued = 0  # queries re-admitted after an OOM'd batch
-        self.watchdog_trips = 0  # dispatch-watchdog deadline firings
-        self.requeue_shed = 0  # queries shed at the requeue budget
-        self.batches = 0
-        self.lanes_used = 0  # real (non-pad) queries across all batches
+        self._recent_t0 = self._t0  # guarded-by: _lock
+        self._lat_recent = [Log2Histogram(), Log2Histogram()]  # guarded-by: _lock
+        self._ext_recent = [Log2Histogram(), Log2Histogram()]  # guarded-by: _lock
+        self.completed = 0  # guarded-by: _lock
+        self.rejected = 0  # guarded-by: _lock — shed at admission
+        self.expired = 0  # guarded-by: _lock — deadline passed while queued
+        self.errors = 0  # guarded-by: _lock
+        self.shutdown = 0  # guarded-by: _lock — resolved unserved at close
+        self.retries = 0  # guarded-by: _lock — transient re-dispatches
+        self.oom_degrades = 0  # guarded-by: _lock — lane halvings after OOM
+        self.requeued = 0  # guarded-by: _lock — re-admitted after OOM'd batch
+        self.watchdog_trips = 0  # guarded-by: _lock — watchdog firings
+        self.requeue_shed = 0  # guarded-by: _lock — shed at requeue budget
+        self.batches = 0  # guarded-by: _lock
+        self.lanes_used = 0  # guarded-by: _lock — real queries, all batches
         # Sum of DISPATCHED batch capacity: with the width ladder this is
         # the routed width per batch, so fill_ratio reports waste against
         # the width actually paid for, not the configured maximum.
-        self.lanes_offered = 0
-        self.padded_lanes_total = 0  # residual pad waste after routing
-        self.batches_by_width = Counter()  # routing histogram: width -> batches
-        self.extract_ms_total = 0.0  # host extraction time across batches
+        self.lanes_offered = 0  # guarded-by: _lock
+        self.padded_lanes_total = 0  # guarded-by: _lock — residual pad waste
+        self.batches_by_width = Counter()  # guarded-by: _lock — width -> batches
+        self.extract_ms_total = 0.0  # guarded-by: _lock
         # Interval bookkeeping for the statsz line's recent-QPS figure.
-        self._last_snap_t = self._t0
-        self._last_snap_completed = 0
+        self._last_snap_t = self._t0  # guarded-by: _lock
+        self._last_snap_completed = 0  # guarded-by: _lock
 
     def record_batch(self, used: int, capacity: int, latencies_ms, *,
                      extract_ms: float | None = None) -> None:
@@ -235,7 +235,7 @@ class ServeMetrics:
                 self._ext_recent[0].add(extract_ms)
                 self.extract_ms_total += extract_ms
 
-    def _rotate_recent(self) -> None:
+    def _rotate_recent(self) -> None:  # requires-lock: _lock
         """Age the percentile window pair (caller holds the lock): one
         elapsed window shifts current -> previous; two or more mean
         everything recorded is stale and both drop."""

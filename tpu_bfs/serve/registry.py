@@ -84,14 +84,14 @@ class EngineRegistry:
         self.capacity = capacity
         self._warm = warm
         self._log = log or (lambda msg: None)
-        self._graphs: dict = {}
-        self._engines: OrderedDict = OrderedDict()
+        self._graphs: dict = {}  # guarded-by: _lock
+        self._engines: OrderedDict = OrderedDict()  # guarded-by: _lock
         # One build at a time: engine builds allocate device tables, and
         # two concurrent builds of the same spec would double-build AND
         # double-allocate. RLock so get() -> _build() -> graph() nests.
         self._lock = threading.RLock()
-        self.builds = 0
-        self.evictions = 0
+        self.builds = 0  # guarded-by: _lock
+        self.evictions = 0  # guarded-by: _lock
         enable_compile_cache(log=self._log)
 
     # --- graphs -----------------------------------------------------------
@@ -140,7 +140,7 @@ class EngineRegistry:
                 self._log(f"evicted engine {old_spec}")
             return eng
 
-    def _build(self, spec: EngineSpec):
+    def _build(self, spec: EngineSpec):  # requires-lock: _lock
         rec = _obs.ACTIVE
         if rec is not None:
             # Registry lifecycle span: builds are the 30-second events a
@@ -161,7 +161,7 @@ class EngineRegistry:
                     width=spec.lanes)
         return eng
 
-    def _build_inner(self, spec: EngineSpec):
+    def _build_inner(self, spec: EngineSpec):  # requires-lock: _lock
         if _faults.ACTIVE is not None:
             # Chaos-harness injection site: a transient raised here runs
             # the service's engine-build retry; an OOM runs the width

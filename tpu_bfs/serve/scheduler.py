@@ -96,8 +96,8 @@ class PendingQuery:
         self.obs_batch = None  # serving batch id (telemetry; armed only)
         self._event = threading.Event()
         self._lock = threading.Lock()
-        self._result: QueryResult | None = None
-        self._callbacks: list = []
+        self._result: QueryResult | None = None  # guarded-by: _lock
+        self._callbacks: list = []  # guarded-by: _lock
         rec = _obs.ACTIVE
         if rec is not None:
             # The query's span opens at ADMISSION; resolve() closes it
@@ -143,7 +143,11 @@ class PendingQuery:
     def result(self, timeout: float | None = None) -> QueryResult:
         if not self._event.wait(timeout):
             raise TimeoutError(f"query {self.id!r} still pending after {timeout}s")
-        return self._result
+        # The event wait already orders this read after resolve()'s write;
+        # the lock keeps the access inside the attribute's stated
+        # discipline (tpu_bfs/analysis lock lint) at zero practical cost.
+        with self._lock:
+            return self._result
 
     def add_done_callback(self, cb) -> None:
         with self._lock:
@@ -166,9 +170,9 @@ class AdmissionQueue:
         if cap < 1:
             raise ValueError(f"queue cap must be >= 1, got {cap}")
         self.cap = cap
-        self._items: deque = deque()
+        self._items: deque = deque()  # guarded-by: _cond
         self._cond = threading.Condition()
-        self._stopped = False
+        self._stopped = False  # guarded-by: _cond
 
     def offer(self, q: PendingQuery) -> bool:
         """Admit, or False when the queue is full/stopped (caller sheds)."""
@@ -204,7 +208,8 @@ class AdmissionQueue:
 
     @property
     def stopped(self) -> bool:
-        return self._stopped
+        with self._cond:  # one mutex hop; callers poll at batch cadence
+            return self._stopped
 
     def next_batch(self, max_n: int, linger_s: float) -> list:
         """Block until work exists, then drain up to ``max_n`` queries.

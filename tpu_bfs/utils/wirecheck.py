@@ -29,68 +29,17 @@ Conventions (ring collectives over P devices):
 
 from __future__ import annotations
 
-import re
-from dataclasses import dataclass
-
 import numpy as np
 
-_DTYPE_BYTES = {
-    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "bf16": 2, "f16": 2,
-    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
-}
-
-_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
-
-
-def _shape_bytes(shape: str) -> int:
-    """Bytes of one 'dtype[d0,d1]' shape string."""
-    m = _SHAPE_RE.match(shape)
-    if not m or m.group(1) not in _DTYPE_BYTES:
-        raise ValueError(f"unparsable HLO shape {shape!r}")
-    dims = [int(d) for d in m.group(2).split(",") if d] or [1]
-    return _DTYPE_BYTES[m.group(1)] * int(np.prod(dims))
-
-
-@dataclass(frozen=True)
-class Collective:
-    op: str  # all-to-all | collective-permute | all-reduce | all-gather | reduce-scatter
-    # Bytes of the instruction's RESULT shape (the LHS — what the parser
-    # sees). Equal to the operand for permute/all-to-all/all-reduce, the
-    # ops audited here; for all-gather the result is Px the operand and
-    # for reduce-scatter 1/Px, so a future check over those must convert
-    # before deriving wire bytes.
-    result_bytes: int
-    pieces: int  # tuple arity (1 for array-shaped ops)
-
-
-def hlo_collectives(hlo_text: str) -> list[Collective]:
-    """All communication instructions of a compiled HLO module, with the
-    byte sizes read from their own result shapes."""
-    out = []
-    pat = re.compile(
-        r"=\s+(\([^)]*\)|\S+)\s+"
-        r"(all-to-all|collective-permute|all-reduce|all-gather|reduce-scatter)\("
-    )
-    for line in hlo_text.splitlines():
-        m = pat.search(line)
-        if not m:
-            continue
-        shape, op = m.group(1), m.group(2)
-        if shape.startswith("("):
-            # Tuple elements look like 's32[1,16]{1,0}' with commas both
-            # between elements AND inside the dims — token-scan for shape
-            # atoms instead of splitting on commas.
-            parts = [
-                t.group(0)
-                for t in _SHAPE_RE.finditer(shape)
-                if t.group(1) in _DTYPE_BYTES
-            ]
-            out.append(
-                Collective(op, sum(_shape_bytes(p) for p in parts), len(parts))
-            )
-        else:
-            out.append(Collective(op, _shape_bytes(shape), 1))
-    return out
+# The HLO walking core moved to tpu_bfs/analysis/hlo.py (ISSUE 8): the
+# shape/byte parsing and collective inventory are shared with the
+# static-analysis passes now; this module keeps the wire-byte AUDITS and
+# re-exports the core names its tests and clients import from here.
+from tpu_bfs.analysis.hlo import (  # noqa: F401 — re-exported API
+    Collective,
+    hlo_collectives,
+    shape_bytes as _shape_bytes,
+)
 
 
 def _lower_1d_loop(eng) -> str:

@@ -1,5 +1,5 @@
 # Convenience targets; everything also works without make (README).
-.PHONY: test native bench analyze wirecheck serve-smoke chaos-smoke obs-smoke wheel clean
+.PHONY: test native bench analyze wirecheck serve-smoke chaos-smoke obs-smoke preheat-smoke wheel clean
 
 # Full suite on 8 virtual CPU devices (tests/conftest.py forces the
 # platform; the axon TPU plugin is bypassed).
@@ -86,6 +86,18 @@ chaos-smoke: wirecheck
 # (tests/test_obs.py — including the disarmed-path zero-overhead spies).
 obs-smoke: wirecheck
 	env JAX_PLATFORMS=cpu python scripts/obs_smoke.py
+
+# The cold-start smoke (README "Cold start and preheat"): a warmed JSONL
+# server exports its compiled programs (--export-aot) into an artifact
+# store; a SECOND process preheats from it (--preheat) and must reach
+# READY with 10/10 artifact hits, answer bit-identically to the JIT
+# baseline, and show engine_adopt spans with ZERO engine_build spans in
+# its own Perfetto trace; then the warm-handoff driver
+# (scripts/warm_handoff.py) proves the old server is SIGTERM-drained
+# only AFTER the preheated successor reports ready. The pytest side
+# (tests/test_aot.py) runs the store/fingerprint/CRC arms in-process.
+preheat-smoke: wirecheck
+	env JAX_PLATFORMS=cpu python scripts/preheat_smoke.py
 
 wheel:
 	python -m pip wheel . --no-deps --no-build-isolation -w dist

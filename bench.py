@@ -1319,6 +1319,13 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
             log("obs recorder armed"
                 + (f" (spec {obs_spec!r})" if obs_spec else " (trace-out)"))
 
+    # Cold-start vs preheat A/B (ISSUE 9): TPU_BFS_BENCH_AOT_DIR points
+    # at an artifact store; the cold service's warmed programs are
+    # exported there after the closed loop, then a SECOND service spins
+    # up preheating from the store — serve_cold_start_s vs
+    # serve_preheat_s land side by side in one verdict.
+    aot_dir = os.environ.get("TPU_BFS_BENCH_AOT_DIR", "").strip()
+
     t0 = time.perf_counter()
     service = retry_transient(
         BfsService, g, engine=engine, lanes=lanes, planes=8,
@@ -1327,7 +1334,8 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
         watchdog_ms=watchdog_ms,
         log=log, label="serve engine build",
     )
-    log(f"service up in {time.perf_counter()-t0:.1f}s: engine={engine} "
+    cold_start_s = time.perf_counter() - t0
+    log(f"service up in {cold_start_s:.1f}s: engine={engine} "
         f"lanes={lanes} ladder={service.width_ladder} pipeline={pipeline} "
         f"clients={clients} queries={clients * per_client}")
     if fault_spec:
@@ -1387,7 +1395,55 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
         for r in flat[:: max(1, len(flat) // nv)][:nv]:
             np.testing.assert_array_equal(r.distances, bfs_scipy(g, r.source))
         log(f"validated {nv} serve responses in {time.perf_counter()-t0:.1f}s")
-    service.close()
+
+    aot_keys: dict = {}
+    if aot_dir:
+        # Export from the warmed service BEFORE closing it, then time a
+        # fresh preheated bring-up from the store (same in-process graph
+        # object, so the registry keys line up) and sanity-serve one
+        # query through the adopted executables.
+        from tpu_bfs.utils.aot import ArtifactStore
+
+        try:
+            store = ArtifactStore(aot_dir, log=log)
+            t0 = time.perf_counter()
+            exported = service.export_aot(store)
+            log(f"aot export -> {aot_dir}: {exported['programs']} programs "
+                f"from {exported['engines']} engines in "
+                f"{time.perf_counter()-t0:.1f}s")
+        finally:
+            # A disk-full/permission failure mid-export must not leak the
+            # warmed service (live worker threads hang interpreter exit).
+            service.close()
+        t0 = time.perf_counter()
+        pre = retry_transient(
+            BfsService, g, engine=engine, lanes=lanes, planes=8,
+            width_ladder=ladder, pipeline=pipeline,
+            linger_ms=2.0, queue_cap=max(1024, 2 * clients),
+            watchdog_ms=watchdog_ms, aot_dir=aot_dir,
+            log=log, label="serve preheat",
+        )
+        try:
+            preheat_s = time.perf_counter() - t0
+            r = pre.query(int(picks[0][0]), timeout=600.0)
+            counts = pre._registry.aot_store.counts()
+        finally:
+            pre.close()
+        log(f"preheat up in {preheat_s:.1f}s (cold {cold_start_s:.1f}s): "
+            f"hits={counts['aot_hits']} fallbacks={counts['aot_fallbacks']} "
+            f"query={'ok' if r.ok else r.status}")
+        if not r.ok:
+            raise RuntimeError(
+                f"preheated service failed its sanity query: {r.status}: "
+                f"{r.error}"
+            )
+        aot_keys = {
+            "serve_preheat_s": round(preheat_s, 2),
+            "aot_hits": counts["aot_hits"],
+            "aot_fallbacks": counts["aot_fallbacks"],
+        }
+    else:
+        service.close()
 
     obs_keys: dict = {}
     if recorder is not None:
@@ -1453,6 +1509,11 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
         "serve_watchdog_trips": snap["watchdog_trips"],
         "serve_breaker_opens": snap["breaker_opens"],
         "serve_requeue_shed": snap["requeue_shed"],
+        # Cold-start record (ISSUE 9): always emitted; the preheat side
+        # (serve_preheat_s + aot hit/fallback audit) rides along when
+        # TPU_BFS_BENCH_AOT_DIR armed the A/B.
+        "serve_cold_start_s": round(cold_start_s, 2),
+        **aot_keys,
         **({"serve_faults": fault_sched.counts()} if fault_sched else {}),
         **obs_keys,
     }

@@ -150,6 +150,18 @@ for i in $(seq 1 "$attempts"); do
       TPU_BFS_BENCH_MODE=serve TPU_BFS_BENCH_SCALE=20 \
       TPU_BFS_BENCH_FAULTS="seed=7:transient@serve_batch:n=2,slow_extract:ms=50:n=4" \
       TPU_BFS_BENCH_SERVE_WATCHDOG_MS=600000
+    # Cold-start arm (ISSUE 9): the same serve stage with an AOT
+    # artifact store armed — the cold service's warmed programs export
+    # to $out/aot_store after the closed loop, a SECOND service preheats
+    # from it, and serve_cold_start_s vs serve_preheat_s land side by
+    # side in one JSON line (plus the aot_hits/aot_fallbacks audit:
+    # fallbacks must be 0 on a same-chip rerun, and a jax/runtime
+    # upgrade shows up as fallbacks, not wrong answers). The store is
+    # per-session scratch; a stale one from an earlier software stack
+    # degrades to JIT by fingerprint.
+    stage "serve-preheat-s20" "$out/serve_preheat_s20.json" \
+      TPU_BFS_BENCH_MODE=serve TPU_BFS_BENCH_SCALE=20 \
+      TPU_BFS_BENCH_AOT_DIR="$out/aot_store"
     # Telemetry arm (ISSUE 6): the same serve stage with the obs
     # recorder on — the JSON line gains serve_obs_events/serve_trace and
     # a Perfetto trace of the whole on-chip serving session lands next to

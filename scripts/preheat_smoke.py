@@ -1,0 +1,172 @@
+"""The cold-start smoke (`make preheat-smoke`): AOT export -> preheat ->
+warm handoff, end to end on CPU against the REAL subprocess machinery
+(ISSUE 9).
+
+Three acts:
+
+1. EXPORT — a JSONL server warmed the normal (JIT) way serves 3 queries
+   and populates the artifact store (``--export-aot``); responses are
+   the bit-identity baseline.
+2. PREHEAT — a SECOND server process starts with ``--preheat`` over that
+   store and the obs recorder armed. Its READY line must report artifact
+   hits and zero fallbacks; its responses must be BIT-IDENTICAL to act
+   1's (decoded distance payloads compared elementwise); and its
+   Perfetto trace must contain ``engine_adopt`` spans and ZERO
+   ``engine_build`` spans — the "preheated service reaches
+   ready-to-serve with zero engine compiles" acceptance bar, checked
+   from the recorder's own record.
+3. HANDOFF — a long-lived server A holds an open pipe; the warm-handoff
+   driver (scripts/warm_handoff.py) starts successor B with
+   ``--preheat``, waits for B's READY, and only then SIGTERMs A, whose
+   graceful drain must exit rc=0. B answers a query correctly through
+   the driver's pass-through pipe.
+
+Prints one JSON line (value = preheated query count) so
+scripts/chip_session.sh's has_value gate can drive it as a stage.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+# Repo root onto the path (same as chaos_smoke.py): the smoke imports
+# the client-side decode helper from the package under test.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+GRAPH = "random:n=96,m=480,seed=3"
+SERVER = [sys.executable, "-m", "tpu_bfs.serve", GRAPH,
+          "--lanes", "64", "--ladder", "32,64", "--linger-ms", "1",
+          "--statsz-interval-s", "0"]
+ENV = dict(os.environ, JAX_PLATFORMS="cpu")
+REQUESTS = [{"id": i, "source": s} for i, s in enumerate((0, 3, 5), 1)]
+
+
+def log(msg):
+    print(f"[preheat-smoke] {msg}", file=sys.stderr, flush=True)
+
+
+def check(cond, msg):
+    if not cond:
+        raise SystemExit(f"FAIL: {msg}")
+    log(f"ok: {msg}")
+
+
+def run_server(extra_args, requests, *, timeout=600):
+    payload = "".join(json.dumps(r) + "\n" for r in requests)
+    proc = subprocess.run(
+        SERVER + extra_args, input=payload, capture_output=True,
+        text=True, env=ENV, timeout=timeout,
+    )
+    responses = [json.loads(l) for l in proc.stdout.splitlines() if l.strip()]
+    log(f"server exited rc={proc.returncode} with {len(responses)} responses")
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stderr)
+        raise SystemExit(f"FAIL: server rc={proc.returncode}")
+    return responses, proc.stderr
+
+
+def dist_of(resp):
+    from tpu_bfs.serve.frontend import decode_distances
+
+    return decode_distances(resp["distances_npy"])
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="preheat_smoke_")
+    store = os.path.join(tmp, "aot_store")
+    trace = os.path.join(tmp, "trace.json")
+
+    # --- act 1: export from a warmed (JIT) server -------------------------
+    log(f"act 1: EXPORT -> {store}")
+    base, stderr1 = run_server(["--export-aot", store], REQUESTS)
+    check(len(base) == len(REQUESTS)
+          and all(r["status"] == "ok" for r in base),
+          "baseline server answered every query ok")
+    check("aot export ->" in stderr1, "export ran on the warmed server")
+    arts = [f for f in os.listdir(store) if f.endswith(".aot")]
+    # 2 ladder rungs x 5 packed serving programs
+    check(len(arts) == 10, f"store holds 10 artifacts (got {len(arts)})")
+
+    # --- act 2: preheat a second process from the store -------------------
+    log("act 2: PREHEAT from the store, recorder armed")
+    warm, stderr2 = run_server(
+        ["--preheat", store, "--obs", "--trace-out", trace], REQUESTS,
+    )
+    ready = [l for l in stderr2.splitlines() if "# READY" in l]
+    check(len(ready) == 1, "preheated server emitted one READY line")
+    check("aot_hits=10" in ready[0] and "aot_fallbacks=0" in ready[0],
+          f"READY reports 10 artifact hits, 0 fallbacks ({ready[0]!r})")
+    base_by_id = {r["id"]: r for r in base}
+    import numpy as np
+
+    for r in sorted(warm, key=lambda r: r["id"]):
+        b = base_by_id[r["id"]]
+        check(r["status"] == "ok" and r["levels"] == b["levels"]
+              and r["reached"] == b["reached"],
+              f"query {r['id']} metadata matches the JIT baseline")
+        np.testing.assert_array_equal(dist_of(r), dist_of(b))
+    log("ok: every preheated distance payload is bit-identical")
+    with open(trace) as f:
+        events = json.load(f)["traceEvents"]
+    names = [e.get("name", "") for e in events]
+    check(names.count("engine_adopt") >= 2 and "engine_build" not in names,
+          f"trace shows engine_adopt spans and ZERO engine_build spans "
+          f"(adopt={names.count('engine_adopt')})")
+
+    # --- act 3: warm handoff ----------------------------------------------
+    log("act 3: HANDOFF — drain old only after successor READY")
+    old = subprocess.Popen(
+        SERVER, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, env=ENV,
+    )
+    # Wait until the old server is actually serving before handing off.
+    for line in old.stderr:
+        if "# READY" in line:
+            break
+    log(f"old server pid {old.pid} is up")
+    handoff = subprocess.Popen(
+        [sys.executable, "scripts/warm_handoff.py",
+         "--old-pid", str(old.pid), "--term-wait", "60", "--",
+         *SERVER, "--preheat", store],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True, env=ENV,
+    )
+    out, _ = handoff.communicate(
+        input=json.dumps({"id": 99, "source": 5}) + "\n", timeout=600,
+    )
+    lines = [json.loads(l) for l in out.splitlines() if l.strip()]
+    resp = [l for l in lines if l.get("id") == 99]
+    summary = [l for l in lines if "old_drained" in l]
+    check(handoff.returncode == 0, "handoff driver exited 0")
+    check(len(resp) == 1 and resp[0]["status"] == "ok"
+          and resp[0]["levels"] == base_by_id[3]["levels"],
+          "successor answered the handoff query correctly")
+    check(summary and summary[0]["old_drained"] is True,
+          "old server drained after successor READY")
+    try:
+        old_rc = old.wait(timeout=30)
+    except subprocess.TimeoutExpired:
+        old.kill()
+        raise SystemExit("FAIL: old server never exited after SIGTERM")
+    finally:
+        old.stdin.close()
+    check(old_rc == 0, f"old server drained gracefully (rc={old_rc})")
+
+    print(json.dumps({
+        "metric": "preheat smoke: export -> preheat (zero engine_build "
+                  "spans, bit-identical) -> warm handoff, CPU",
+        "value": len(warm),
+        "unit": "queries",
+        "aot_artifacts": len(arts),
+        "store": store,
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    t0 = time.time()
+    rc = main()
+    log(f"done in {time.time() - t0:.1f}s")
+    sys.exit(rc)

@@ -1,0 +1,357 @@
+"""AOT artifact store + engine export/adopt (ISSUE 9; utils/aot.py).
+
+Tier-1 arms stay lean (one small graph, one width — the suite runs near
+its budget): store plumbing against synthetic payloads, ONE wide-engine
+export -> fresh-engine adopt -> bit-identical round trip (shared via a
+module fixture), the registry's adopt-vs-build span naming, the analysis
+retrace sentinel over adopted executables, and the packed engine's
+custom inventory. The full-ladder service sweep, the gated-core round
+trip, and the sharded dist-core round trip are slow-marked.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tpu_bfs import faults, obs
+from tpu_bfs.graph.generate import random_graph
+from tpu_bfs.utils import aot
+
+SPEC = {"graph_key": "t", "engine": "wide", "lanes": 64, "planes": 4,
+        "pull_gate": False, "devices": 1}
+
+
+@pytest.fixture
+def store(tmp_path):
+    return aot.ArtifactStore(tmp_path / "store")
+
+
+@pytest.fixture(scope="module")
+def graph():
+    return random_graph(96, 480, seed=3)
+
+
+@pytest.fixture(scope="module")
+def exported_wide(graph, tmp_path_factory):
+    """One wide engine exported once for the whole module: (engine,
+    store, baseline result over a full-lane batch)."""
+    from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
+
+    eng = WidePackedMsBfsEngine(graph, lanes=64, num_planes=4)
+    store = aot.ArtifactStore(tmp_path_factory.mktemp("aot") / "store")
+    names = aot.export_engine_programs(eng, SPEC, store)
+    assert names == ["core", "seed", "lane_stats", "extract_word",
+                     "lane_ecc"]
+    res = eng.run(np.arange(64) % 96)
+    return eng, store, res
+
+
+# --- store plumbing (no engine) -------------------------------------------
+
+
+def test_store_round_trip_and_probe(store):
+    payload = b"payload-bytes" * 100
+    path = store.put(SPEC, "core", payload)
+    assert os.path.exists(path)
+    assert store.probe(SPEC)  # header + fingerprint + payload CRC
+    assert store.get(SPEC, "core") == payload
+    c = store.counts()
+    assert c["aot_hits"] == 1 and c["aot_fallbacks"] == 0
+    assert c["aot_exports"] == 1
+
+
+def test_missing_artifact_counts_fallback(store):
+    assert store.get(SPEC, "core") is None
+    assert not store.probe(SPEC)
+    assert store.counts()["aot_fallbacks"] == 1
+
+
+def test_stale_fingerprint_falls_back_without_quarantine(store, monkeypatch):
+    path = store.put(SPEC, "core", b"x" * 64)
+    monkeypatch.setattr(
+        aot, "env_fingerprint",
+        lambda: {"format": aot.FORMAT, "jax": "999.0", "backend": "cpu",
+                 "device_kind": "cpu", "device_count": 1},
+    )
+    assert store.get(SPEC, "core") is None
+    assert not store.probe(SPEC)
+    # Stale is NOT corrupt: the file may be valid for the fleet it was
+    # built on — it stays in place, un-quarantined.
+    assert os.path.exists(path) and not os.path.exists(path + ".corrupt")
+    assert store.counts()["aot_fallbacks"] == 1
+
+
+def test_corrupt_payload_quarantines(store):
+    path = store.put(SPEC, "core", b"y" * 256)
+    with open(path, "r+b") as f:
+        f.seek(-10, os.SEEK_END)
+        byte = f.read(1)
+        f.seek(-10, os.SEEK_END)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    # The probe must not read a torn payload as adoptable (the registry
+    # names its engine_adopt span — the no-compile signal — off it),
+    # and being read-only it must not quarantine either.
+    assert not store.probe(SPEC)
+    assert os.path.exists(path)
+    assert store.get(SPEC, "core") is None
+    assert not os.path.exists(path)
+    assert os.path.exists(path + ".corrupt")
+    assert store.counts()["aot_fallbacks"] == 1
+    # A later load of the quarantined key is a plain miss, not an error.
+    assert store.get(SPEC, "core") is None
+
+
+def test_corrupt_header_quarantines(store):
+    path = store.put(SPEC, "core", b"z" * 64)
+    with open(path, "r+b") as f:
+        f.write(b"NOTMAGIC")
+    assert not store.probe(SPEC)
+    assert store.get(SPEC, "core") is None
+    assert os.path.exists(path + ".corrupt")
+
+
+def test_corrupt_aot_fault_drives_quarantine(store):
+    """The chaos arm (ISSUE 9 satellite): a corrupt_aot rule flips one
+    payload byte at the aot_load site, so the CRC check fires and the
+    quarantine+fallback path runs deterministically — with the firing
+    audited in the schedule's event log."""
+    path = store.put(SPEC, "core", b"good" * 64)
+    sched = faults.arm_from_spec("corrupt_aot:n=1")
+    try:
+        assert store.get(SPEC, "core") is None
+        assert os.path.exists(path + ".corrupt")
+        assert store.counts()["aot_fallbacks"] == 1
+        assert [e["site"] for e in sched.events] == ["aot_load"]
+        assert sched.exhausted()
+    finally:
+        faults.disarm()
+    # Spec grammar round-trips the new kind (default site aot_load).
+    rt = faults.FaultSchedule.from_spec("seed=3:corrupt_aot:n=2")
+    assert rt.to_spec() == "seed=3:corrupt_aot:n=2"
+    assert rt.rules[0].site == "aot_load"
+
+
+# --- engine round trip ----------------------------------------------------
+
+
+def test_export_adopt_bit_identical(exported_wide, graph):
+    """Export -> fresh-process-like engine -> adopt -> served results
+    bit-identical to the JIT engine; the adopted core actually ran; a
+    narrower (non-serving-shape) batch falls back to JIT and stays
+    correct."""
+    from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
+
+    _, store, base = exported_wide
+    eng = WidePackedMsBfsEngine(graph, lanes=64, num_planes=4)
+    adopted = aot.adopt_engine_programs(eng, SPEC, store)
+    assert adopted == ["core", "seed", "lane_stats", "extract_word",
+                       "lane_ecc"]
+    assert eng._aot_adopted == tuple(adopted)
+    res = eng.run(np.arange(64) % 96)
+    np.testing.assert_array_equal(res.reached, base.reached)
+    np.testing.assert_array_equal(res.edges_traversed,
+                                  base.edges_traversed)
+    np.testing.assert_array_equal(res.ecc, base.ecc)
+    for i in (0, 7, 33, 63):
+        np.testing.assert_array_equal(
+            res.distances_int32(i), base.distances_int32(i)
+        )
+    assert eng._core.calls >= 1 and eng._core.fallback_calls == 0
+    # Narrow batch: the seed args are length-3, not the exported 64 —
+    # the wrapper must route to the original jit, not error.
+    narrow = eng.run(np.asarray([5, 9, 11]))
+    np.testing.assert_array_equal(
+        narrow.distances_int32(0), base.distances_int32(5)
+    )
+    assert eng._seed.fallback_calls >= 1
+
+
+@pytest.mark.slow
+def test_packed_engine_round_trip(graph, tmp_path):
+    """The 512-lane packed engine's custom inventory (host-side seed is
+    deliberately absent) round-trips bit-identically too. Slow-marked
+    for the tier-1 wall clock (8 fixed planes make it the priciest
+    single-chip compile here); the wide-engine arm covers the shared
+    adopt machinery in tier 1."""
+    from tpu_bfs.algorithms.msbfs_packed import PackedMsBfsEngine
+
+    spec = dict(SPEC, engine="packed", lanes=32, planes=8)
+    store = aot.ArtifactStore(tmp_path / "store")
+    eng = PackedMsBfsEngine(graph, lanes=32)
+    names = aot.export_engine_programs(eng, spec, store)
+    assert names == ["core", "extract", "lane_stats", "lane_ecc"]
+    base = eng.run(np.arange(8))
+    eng2 = PackedMsBfsEngine(graph, lanes=32)
+    assert aot.adopt_engine_programs(eng2, spec, store) == names
+    res = eng2.run(np.arange(8))
+    np.testing.assert_array_equal(res.reached, base.reached)
+    np.testing.assert_array_equal(res.ecc, base.ecc)
+    np.testing.assert_array_equal(res.distance_u8[3], base.distance_u8[3])
+    assert eng2._core.calls >= 1
+
+
+def test_registry_adopt_vs_build_spans(graph, tmp_path):
+    """The registry names its build span honestly: engine_build on a
+    cold build, engine_adopt when the store's core artifact probes
+    valid — the span-name contract `make preheat-smoke` asserts from
+    the Perfetto trace."""
+    from tpu_bfs.serve.registry import EngineRegistry, EngineSpec
+
+    store = aot.ArtifactStore(tmp_path / "store")
+    spec = EngineSpec(graph_key="g", engine="wide", lanes=64, planes=4)
+    rec = obs.arm(capacity=512)
+    try:
+        cold = EngineRegistry(warm=False, aot_store=store)
+        cold.add_graph("g", graph)
+        cold.get(spec)
+        counts = rec.counts_by_name()
+        assert counts.get("engine_build") and not counts.get("engine_adopt")
+        assert cold.adoptions == 0
+        cold.export_resident()
+        assert store.counts()["aot_exports"] == 5
+
+        obs.arm(capacity=512)  # fresh recorder for the preheated side
+        warm = EngineRegistry(warm=False, aot_store=store)
+        warm.add_graph("g", graph)
+        eng = warm.get(spec)
+        counts = obs.ACTIVE.counts_by_name()
+        assert counts.get("engine_adopt") and not counts.get("engine_build")
+        assert counts.get("aot_load", 0) >= 5
+        assert warm.adoptions == 1
+        assert len(eng._aot_adopted) == 5
+    finally:
+        obs.disarm()
+
+
+def test_adopted_retrace_sentinel(exported_wide, graph):
+    """PR 8 pass 2 wired over adopted executables: a same-shape re-drive
+    through deserialized dispatch adds ZERO jit cache entries; an
+    engine preheat failed to adopt is itself a finding."""
+    from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
+    from tpu_bfs.analysis.transfer import check_adopted_retrace
+
+    _, store, _ = exported_wide
+    eng = WidePackedMsBfsEngine(graph, lanes=64, num_planes=4)
+
+    def drive(e):
+        e.run(np.arange(64) % 96)
+
+    findings = check_adopted_retrace("unadopted", eng, drive)
+    assert len(findings) == 1 and "no AOT-adopted" in findings[0].message
+    aot.adopt_engine_programs(eng, SPEC, store)
+    assert check_adopted_retrace("adopted", eng, drive) == []
+
+
+# --- slow arms ------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_gated_core_round_trip(graph, tmp_path):
+    """The pull-gated core (extra lane-mask arg, installed on
+    _gate_core_jit) round-trips bit-identically."""
+    from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
+
+    spec = dict(SPEC, pull_gate=True)
+    store = aot.ArtifactStore(tmp_path / "store")
+    eng = WidePackedMsBfsEngine(graph, lanes=64, num_planes=4,
+                                pull_gate=True)
+    names = [n for n, *_ in eng.export_programs()]
+    assert aot.export_engine_programs(eng, spec, store) == names
+    base = eng.run(np.arange(64) % 96)
+    eng2 = WidePackedMsBfsEngine(graph, lanes=64, num_planes=4,
+                                 pull_gate=True)
+    assert "core" in aot.adopt_engine_programs(eng2, spec, store)
+    res = eng2.run(np.arange(64) % 96)
+    np.testing.assert_array_equal(res.ecc, base.ecc)
+    np.testing.assert_array_equal(
+        res.distances_int32(11), base.distances_int32(11)
+    )
+    assert eng2._gate_core_jit.calls >= 1
+
+
+@pytest.mark.slow
+def test_dist_core_round_trip(graph, tmp_path):
+    """The sharded dist core exports and adopts across a 2-device mesh
+    (the SNIPPETS pjit/sharding plumbing), bit-identically."""
+    from tpu_bfs.parallel.dist_bfs import make_mesh
+    from tpu_bfs.parallel.dist_msbfs_wide import DistWideMsBfsEngine
+
+    spec = dict(SPEC, engine="dist-wide", devices=2)
+    store = aot.ArtifactStore(tmp_path / "store")
+    mesh = make_mesh(2)
+    eng = DistWideMsBfsEngine(graph, mesh, num_planes=4, lanes=64)
+    assert aot.export_engine_programs(eng, spec, store) == ["dist_core"]
+    base = eng.run(np.arange(8))
+    eng2 = DistWideMsBfsEngine(graph, mesh, num_planes=4, lanes=64)
+    assert aot.adopt_engine_programs(eng2, spec, store) == ["dist_core"]
+    res = eng2.run(np.arange(8))
+    np.testing.assert_array_equal(res.ecc, base.ecc)
+    np.testing.assert_array_equal(
+        res.distances_int32(2), base.distances_int32(2)
+    )
+    assert eng2._dist_core.calls >= 1
+
+
+@pytest.mark.slow
+@pytest.mark.serve
+def test_service_full_ladder_preheat(graph, tmp_path):
+    """The full-ladder sweep: service 1 (JIT) exports every rung;
+    service 2 preheats the whole ladder from disk, answers
+    bit-identically, shows zero engine_build spans, and reports the
+    hit/fallback audit in statsz."""
+    from tpu_bfs.serve import BfsService
+
+    store_dir = str(tmp_path / "store")
+    svc = BfsService(graph, lanes=64, width_ladder="32,64", linger_ms=1.0)
+    try:
+        base = {s: svc.query(s, timeout=120.0) for s in (0, 3, 5)}
+        assert all(r.ok for r in base.values())
+        exported = svc.export_aot(store_dir)
+        assert exported == {"programs": 10, "engines": 2}
+    finally:
+        svc.close()
+
+    rec = obs.arm(capacity=2048)
+    try:
+        pre = BfsService(graph, lanes=64, width_ladder="32,64",
+                         linger_ms=1.0, aot_dir=store_dir)
+        try:
+            counts = rec.counts_by_name()
+            assert counts.get("engine_adopt", 0) >= 2
+            assert not counts.get("engine_build")
+            snap = pre.statsz()
+            assert snap["aot"]["aot_hits"] == 10
+            assert snap["aot"]["aot_fallbacks"] == 0
+            for s, b in base.items():
+                r = pre.query(s, timeout=120.0)
+                assert r.ok and r.levels == b.levels
+                assert r.reached == b.reached
+                np.testing.assert_array_equal(r.distances, b.distances)
+        finally:
+            pre.close()
+    finally:
+        obs.disarm()
+
+
+@pytest.mark.slow
+def test_exported_artifact_is_json_headed(exported_wide):
+    """Layout pin: MAGIC + u32 len + JSON header carrying the registry
+    key, fingerprint, and payload CRC — the on-disk contract README
+    documents."""
+    _, store, _ = exported_wide
+    path = store.path_for(SPEC, "core")
+    meta, off = store._read_header(path)
+    assert meta["key"] == aot.program_key(SPEC)
+    assert meta["name"] == "core"
+    assert meta["fingerprint"] == aot.env_fingerprint()
+    with open(path, "rb") as f:
+        f.seek(off)
+        payload = f.read()
+    assert meta["payload_crc32"] == aot._crc32(payload)
+    # The payload really is a deserializable jax.export artifact.
+    from jax import export as jexp
+
+    assert jexp.deserialize(payload).in_avals
+    json.dumps(meta)  # header is pure JSON

@@ -13,7 +13,17 @@ import os
 import jax
 import pytest
 
+from tpu_bfs.utils import compile_cache
 from tpu_bfs.utils.compile_cache import enable_compile_cache
+
+
+@pytest.fixture(autouse=True)
+def _fresh_resolution():
+    # Resolution is once-per-process (the idempotency satellite); every
+    # test here varies the env, so each starts unresolved.
+    compile_cache.reset_resolution()
+    yield
+    compile_cache.reset_resolution()
 
 
 @pytest.fixture
@@ -66,6 +76,40 @@ def test_degrades_when_jax_config_update_raises(monkeypatch, tmp_path):
     msgs = []
     assert enable_compile_cache(log=msgs.append) is None
     assert any("compile cache unavailable" in m for m in msgs)
+
+
+def test_idempotent_resolution(monkeypatch, tmp_path,
+                               _restore_jax_cache_config):
+    """Second call returns the first outcome WITHOUT re-running
+    jax.config.update or re-logging — every EngineRegistry() and bench
+    entry calls this, and a preheat run constructs several registries."""
+    monkeypatch.setenv("TPU_BFS_BENCH_XLA_CACHE", str(tmp_path / "once"))
+    msgs = []
+    updates = []
+    real_update = jax.config.update
+    monkeypatch.setattr(
+        jax.config, "update",
+        lambda *a: (updates.append(a), real_update(*a)),
+    )
+    first = enable_compile_cache(log=msgs.append)
+    assert first == str(tmp_path / "once") and len(updates) == 1
+    # A later call — even pointing the env somewhere else — returns the
+    # resolved path silently: one cache per process, logged once.
+    monkeypatch.setenv("TPU_BFS_BENCH_XLA_CACHE", str(tmp_path / "other"))
+    assert enable_compile_cache(log=msgs.append) == first
+    assert len(updates) == 1 and len(msgs) == 1
+    # force=True re-resolves (the escape hatch this file's fixture uses).
+    assert enable_compile_cache(force=True) == str(tmp_path / "other")
+    assert len(updates) == 2
+
+
+def test_idempotent_caches_disabled_outcome(monkeypatch, tmp_path):
+    monkeypatch.setenv("TPU_BFS_BENCH_XLA_CACHE", "")
+    assert enable_compile_cache() is None
+    # A later call with the env now set stays disabled: resolved once.
+    monkeypatch.setenv("TPU_BFS_BENCH_XLA_CACHE", str(tmp_path / "late"))
+    assert enable_compile_cache() is None
+    assert not os.path.exists(tmp_path / "late")
 
 
 def test_degrade_logs_nothing_without_logger(monkeypatch, tmp_path):

@@ -196,10 +196,16 @@ def test_stats_json_gains_gated_tiles(g_rmat):
     assert all('"gated_tiles"' not in line for line in st0.json_lines())
 
 
+@pytest.mark.slow
 def test_wirecheck_gated_moves_no_extra_collective_bytes():
     """ISSUE 1 acceptance: the gated distributed program's collective
     instruction multiset equals the ungated one's, for every exchange the
-    flag grows on (compile-only — no traversal runs)."""
+    flag grows on (compile-only — no traversal runs). Slow-marked for
+    the tier-1 wall clock (the PR 7 planner-proof precedent: six
+    dist-hybrid compiles, ~35 s — the single heaviest test in the
+    tier) — it still runs in the full `make test` / slow tier, and the
+    per-exchange gated bit-identity tests above keep the gate's tier-1
+    coverage."""
     from tpu_bfs.utils.wirecheck import check_gated_hybrid
 
     g = rmat_graph(9, 10, seed=103)

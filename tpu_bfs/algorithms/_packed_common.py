@@ -1716,6 +1716,58 @@ def run_packed_batch(
     )
 
 
+# Re-exported here so the packed engine family imports its whole shared
+# protocol surface from one module (the class itself lives in utils/aot
+# so msbfs_packed — which _packed_common imports at its top — can
+# inherit it without a cycle).
+from tpu_bfs.utils.aot import AotProgramProtocol  # noqa: E402
+
+
+def packed_aot_programs(engine):
+    """The serving-path program inventory shared by the single-chip
+    packed MS engines (wide + hybrid): the level-loop core (the
+    30-second compile a cold start is mostly made of), seeding, the
+    on-device lane reductions, and the lazy per-word distance
+    extraction. Example args are ShapeDtypeStructs derived from the
+    engine's own tables, so the export shapes are THE serving shapes
+    (the executor always pads dispatches to exactly ``lanes``
+    sources). The frontier-table shape comes from ``jax.eval_shape``
+    over the seed kernel — a trace, never a compile: this inventory is
+    enumerated on the preheat path, whose whole point is zero compiles
+    (and on an adopted engine the unwrapped original is shaped, so the
+    probe can't pollute the runtime-fallback audit)."""
+    import jax
+
+    def sds(x):
+        return jax.ShapeDtypeStruct(tuple(np.shape(x)), x.dtype)
+
+    one_i = jax.ShapeDtypeStruct((1,), jnp.int32)
+    one_u = jax.ShapeDtypeStruct((1,), jnp.uint32)
+    seed_fn = getattr(engine._seed, "_aot_original", engine._seed)
+    fw_s = sds(jax.eval_shape(seed_fn, one_i, one_i, one_u))
+    arrs_s = {k: sds(v) for k, v in engine.arrs.items()}
+    i32 = jax.ShapeDtypeStruct((), jnp.int32)
+    lanes = engine.lanes
+    planes_s = (fw_s,) * engine.num_planes
+    progs = []
+    if getattr(engine, "pull_gate", False):
+        lane_mask = jax.ShapeDtypeStruct((engine.w,), jnp.uint32)
+        progs.append(("core", "_gate_core_jit", engine._gate_core_jit,
+                      (arrs_s, fw_s, i32, lane_mask)))
+    else:
+        progs.append(("core", "_core", engine._core, (arrs_s, fw_s, i32)))
+    lane_i32 = jax.ShapeDtypeStruct((lanes,), jnp.int32)
+    lane_u32 = jax.ShapeDtypeStruct((lanes,), jnp.uint32)
+    progs += [
+        ("seed", "_seed", engine._seed, (lane_i32, lane_i32, lane_u32)),
+        ("lane_stats", "_lane_stats", engine._lane_stats, (fw_s,)),
+        ("extract_word", "_extract_word", engine._extract_word,
+         (planes_s, fw_s, fw_s, i32)),
+        ("lane_ecc", "_lane_ecc", engine._lane_ecc, (planes_s, fw_s, fw_s)),
+    ]
+    return progs
+
+
 class PackedRunProtocol:
     """The packed-family batch entry points, defined once for every engine
     built on the shared level-loop machinery (wide, hybrid, and their

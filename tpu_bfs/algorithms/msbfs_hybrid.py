@@ -52,6 +52,7 @@ from tpu_bfs.graph.ell import (
     rank_vertices,
 )
 from tpu_bfs.algorithms._packed_common import (
+    AotProgramProtocol,
     ExpandSpec,
     PullGateHost,
     advance_packed_batch,
@@ -67,6 +68,7 @@ from tpu_bfs.algorithms._packed_common import (
     make_gated_fori_expand,
     make_packed_loop,
     make_state_kernels,
+    packed_aot_programs,
     row_unsettled,
     seed_scatter_args,
     start_packed_batch,
@@ -379,7 +381,8 @@ def _make_core(hg: HybridGraph, w: int, num_planes: int, interpret: bool,
     return make_packed_loop(hit_of, num_planes)
 
 
-class HybridMsBfsEngine(PackedRunProtocol, PullGateHost):
+class HybridMsBfsEngine(PackedRunProtocol, PullGateHost,
+                        AotProgramProtocol):
     """Up to 8192 concurrent BFS sources by default (DEFAULT_MAX_LANES,
     the round-4 measured optimum; ``max_lanes`` moves the cap in 4096-lane
     steps up to MAX_LANES, and auto sizing walks down when the state
@@ -609,6 +612,12 @@ class HybridMsBfsEngine(PackedRunProtocol, PullGateHost):
         return lazy_full_parent_ell(self.host_graph, self.hg.kcap)
 
     # run/dispatch/fetch come from PackedRunProtocol (_packed_common).
+
+    def export_programs(self):
+        """AOT inventory (ISSUE 9; utils/aot.py): the shared packed
+        serving set — the MXU level-loop core (gated form carries the
+        lane-mask arg), seed, lane stats, word extraction, lane ecc."""
+        return packed_aot_programs(self)
 
     # --- checkpoint/resume (_packed_common; SURVEY.md §5: reference has none) ---
 

@@ -36,6 +36,7 @@ import numpy as np
 
 from tpu_bfs.graph.csr import Graph, INF_DIST
 from tpu_bfs.graph.ell import EllGraph, build_ell
+from tpu_bfs.utils.aot import AotProgramProtocol
 
 UNREACHED = np.uint8(255)  # uint8 sentinel; convert with distances_int32()
 MAX_LEVELS = 254  # bit-sliced counters are 8 planes wide
@@ -315,12 +316,37 @@ def _make_core(ell: EllGraph, w: int):
     return core, extract
 
 
-class PackedMsBfsEngine:
+class PackedMsBfsEngine(AotProgramProtocol):
     """Runs up to ``lanes`` BFS sources concurrently, bit-packed.
 
     ``lanes`` must be a multiple of 32; 256 (w=8 words) is the measured
     sweet spot on v5e — wider rows gather no faster, narrower waste lanes.
     """
+
+    def export_programs(self):
+        # AOT inventory (ISSUE 9; utils/aot.py): custom rather than the
+        # shared packed_aot_programs (this engine's ``_seed`` is a
+        # host-numpy pass, not a compiled program — deliberately absent).
+        import jax
+
+        act = self.ell.num_active
+        u32 = jnp.uint32
+        fw_s = jax.ShapeDtypeStruct((act + 1, self.w), u32)
+        vis_s = jax.ShapeDtypeStruct((act, self.w), u32)
+        arrs_s = {
+            k: jax.ShapeDtypeStruct(tuple(v.shape), v.dtype)
+            for k, v in self.arrs.items()
+        }
+        planes_s = (vis_s,) * 8
+        i32 = jax.ShapeDtypeStruct((), jnp.int32)
+        return [
+            ("core", "_core", self._core, (arrs_s, fw_s, vis_s, i32)),
+            ("extract", "_extract", self._extract,
+             (planes_s, vis_s, vis_s)),
+            ("lane_stats", "_lane_stats", self._lane_stats, (vis_s,)),
+            ("lane_ecc", "_lane_ecc", self._lane_ecc,
+             (planes_s, vis_s, vis_s)),
+        ]
 
     def __init__(
         self,

@@ -48,6 +48,7 @@ import numpy as np
 from tpu_bfs.graph.csr import Graph
 from tpu_bfs.graph.ell import EllGraph, build_ell, pad_gate_blocks
 from tpu_bfs.algorithms._packed_common import (
+    AotProgramProtocol,
     ExpandSpec,
     PackedRunProtocol,
     advance_packed_batch,
@@ -61,6 +62,7 @@ from tpu_bfs.algorithms._packed_common import (
     make_gated_fori_expand,
     make_packed_loop,
     make_state_kernels,
+    packed_aot_programs,
     row_unsettled,
     seed_scatter_args,
     start_packed_batch,
@@ -123,7 +125,8 @@ def _make_core(ell: EllGraph, w: int, num_planes: int, push_cfg=None,
     )
 
 
-class WidePackedMsBfsEngine(PackedRunProtocol, PullGateHost):
+class WidePackedMsBfsEngine(PackedRunProtocol, PullGateHost,
+                            AotProgramProtocol):
     """Runs up to 4096 BFS sources concurrently, bit-packed 128 words wide.
 
     ``num_planes`` bit-sliced counter planes bound the level count at
@@ -289,6 +292,12 @@ class WidePackedMsBfsEngine(PackedRunProtocol, PullGateHost):
         return self.ell, self.arrs
 
     # run/dispatch/fetch come from PackedRunProtocol (_packed_common).
+
+    def export_programs(self):
+        """AOT inventory (ISSUE 9; utils/aot.py): the shared packed
+        serving set — level-loop core (gated form carries the lane-mask
+        arg), seed, lane stats, lazy word extraction, lane ecc."""
+        return packed_aot_programs(self)
 
     # --- checkpoint/resume (_packed_common; SURVEY.md §5: reference has none) ---
 

@@ -24,6 +24,10 @@ Four checks, ordered cheapest first:
   dispatch+fetch must materialize no distance words and no ecc summary
   until asked — the ``want_distances=false`` serve path depends on the
   fetch half transferring only scalars.
+- **adopted-executable sentinel** (:func:`check_adopted_retrace`): the
+  trace-count sentinel applied to an AOT-preheated engine (ISSUE 9) —
+  the adoption must have actually installed deserialized programs, and
+  dispatching through them must add zero jit cache entries.
 """
 
 from __future__ import annotations
@@ -136,6 +140,28 @@ def check_engine_retrace(name: str, engine, drive) -> list[Finding]:
     sentinel.snapshot()
     drive(engine)
     return sentinel.check()
+
+
+def check_adopted_retrace(name: str, engine, drive) -> list[Finding]:
+    """The trace-count sentinel over ADOPTED executables (ISSUE 9): the
+    engine must actually hold AOT-installed programs (utils/aot's
+    AdoptedProgram wrappers expose ``_cache_size`` exactly like pjit
+    entries, so :func:`jit_entries` enumerates them with no extra
+    plumbing), and a same-shape re-drive after warm-up must add ZERO
+    jit cache entries — deserialized dispatch provably compiles nothing
+    new. A preheat whose adoption silently failed (empty ``_aot_adopted``)
+    is itself a finding: the service would pay the full JIT cold start
+    the artifact store exists to eliminate."""
+    adopted = getattr(engine, "_aot_adopted", ())
+    if not adopted:
+        return [Finding(
+            "transfer/retrace",
+            f"{name}:aot-adopt",
+            "engine holds no AOT-adopted programs — preheat did not "
+            "install deserialized executables (missing/stale/corrupt "
+            "store, or the engine family lacks export_programs).",
+        )]
+    return check_engine_retrace(name, engine, drive)
 
 
 def check_lazy_distances(name: str, engine, sources) -> list[Finding]:

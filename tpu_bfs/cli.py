@@ -294,6 +294,31 @@ def _run_multi_source(args, g, golden) -> int:
     with obs_mod.maybe_span("engine_build", "cli", cat="cli",
                             lanes=args.lanes, engine=args.engine):
         engine = _make_ms_engine(args, g, len(sources))
+    aot_store = aot_spec = None
+    if args.aot:
+        # One-shot AOT (ISSUE 9): adopt this engine's programs from the
+        # store when a previous run exported them (the compile-skipping
+        # preheat), and export them back after the run either way.
+        from tpu_bfs.utils import aot as aot_mod
+
+        def aot_log(msg):
+            print(f"[aot] {msg}", file=sys.stderr, flush=True)
+
+        aot_store = aot_mod.ArtifactStore(args.aot, log=aot_log)
+        aot_spec = {
+            "graph_key": args.graph,
+            "engine": type(engine).__name__,
+            "lanes": engine.lanes,
+            "planes": getattr(engine, "num_planes", 8),
+            "pull_gate": bool(getattr(engine, "pull_gate", False)),
+            "devices": args.devices,
+        }
+        adopted = aot_mod.adopt_engine_programs(
+            engine, aot_spec, aot_store, log=aot_log
+        )
+        if not adopted:
+            aot_log(f"no adoptable artifacts in {args.aot}; running JIT "
+                    f"(the store is populated after this run)")
     res = None
     if args.ckpt or args.resume:
         # Chunked batch traversal with durable packed state
@@ -355,6 +380,18 @@ def _run_multi_source(args, g, golden) -> int:
             raise SystemExit(
                 f"{exc}\nhint: rerun with --planes 8 (depth 254){alt}"
             )
+    if aot_store is not None:
+        # Export AFTER the run: the engine is warmed, and an engine
+        # rebuilt mid-run by the recovery path still exports its final
+        # (serving) programs. Adopted entries re-export their originals.
+        from tpu_bfs.utils import aot as aot_mod
+
+        names = aot_mod.export_engine_programs(
+            engine, aot_spec, aot_store,
+            log=lambda m: print(f"[aot] {m}", file=sys.stderr, flush=True),
+        )
+        print(f"[aot] exported {len(names)} programs -> {args.aot}",
+              file=sys.stderr, flush=True)
     if res.elapsed_s is not None:
         print(f"Elapsed time in milliseconds (device): "
               f"{res.elapsed_s * 1e3:.3f} ({len(sources)} sources)")
@@ -568,6 +605,14 @@ def main(argv=None) -> int:
                     "run here (host spans + a per-level engine-trace "
                     "track: frontier count, direction, gated tiles, "
                     "exchange choice, modeled wire bytes; implies --obs)")
+    ap.add_argument("--aot", default=None, metavar="DIR",
+                    help="AOT artifact store (utils/aot): install this "
+                    "run's engine programs from DIR when exported there "
+                    "before (skipping trace/lower/compile), and export "
+                    "them back after the run — the one-shot analog of "
+                    "tpu-bfs-serve --preheat/--export-aot (multi-source "
+                    "packed engines; stale/corrupt artifacts fall back "
+                    "to JIT)")
     args = ap.parse_args(argv)
     from tpu_bfs import faults as faults_mod
 
@@ -575,6 +620,10 @@ def main(argv=None) -> int:
     if sched is not None:
         print(f"[faults] schedule armed: {sched.to_spec()}", file=sys.stderr)
     recorder = _arm_obs(args)
+    if args.aot is not None and not args.multi_source:
+        ap.error("--aot pairs with --multi-source (the packed MS engines "
+                 "are the AOT-exportable family; single-source engines "
+                 "compile in seconds)")
     if args.adaptive_push is not None:
         if (
             args.engine not in ("wide", "hybrid")

@@ -19,6 +19,7 @@ engine_build serve/registry.EngineRegistry._build
 ckpt_save   utils/checkpoint._atomic_savez (corruption happens here)
 ckpt_load   utils/checkpoint load paths
 advance     utils/recovery.advance_with_recovery (chunk step)
+aot_load    utils/aot.ArtifactStore payload read (AOT preheat path)
 ========== =======================================================
 
 Production code never pays for this when disabled: every site guard is
@@ -37,7 +38,7 @@ Spec grammar (``--faults`` / ``TPU_BFS_FAULTS``)::
                 e.g. "oom@fetch@rung=64")
     param   := "p=" FLOAT | "n=" INT | "ms=" FLOAT | "skip=" INT
     kind    := "transient" | "oom" | "slow" | "slow_extract"
-             | "corrupt_ckpt"
+             | "corrupt_ckpt" | "corrupt_aot"
 
 Examples::
 
@@ -73,6 +74,7 @@ SITES = (
     "ckpt_save",
     "ckpt_load",
     "advance",
+    "aot_load",
 )
 
 # Where a clause lands when it names no "@site". slow_extract is the
@@ -83,6 +85,7 @@ DEFAULT_SITE = {
     "slow": "fetch",
     "slow_extract": "fetch",
     "corrupt_ckpt": "ckpt_save",
+    "corrupt_aot": "aot_load",
 }
 KINDS = tuple(DEFAULT_SITE)
 
@@ -426,6 +429,22 @@ def corruption_offset(path: str) -> int:
         return info.header_offset + 30 + nlen + elen
     except Exception:  # noqa: BLE001 — not a zip: best-effort midpoint
         return os.path.getsize(path) // 2
+
+
+def maybe_corrupt_payload(payload: bytes, **ctx) -> bytes:
+    """``aot_load`` site hook for ``corrupt_aot`` rules: flip one byte of
+    a just-read artifact payload IN MEMORY, so the load-side CRC check
+    fires and the store's quarantine + JIT-fallback arm runs — the
+    deterministic chaos drive of the AOT degrade path (the on-disk file
+    is quarantined by the store exactly as a genuinely-rotten one would
+    be). Returns the (possibly corrupted) payload."""
+    sched = ACTIVE
+    if sched is None or not sched.take("aot_load", "corrupt_aot", **ctx):
+        return payload
+    if not payload:
+        return b"\x00"  # an empty payload corrupts to a non-empty one
+    off = len(payload) // 2
+    return payload[:off] + bytes([payload[off] ^ 0xFF]) + payload[off + 1:]
 
 
 def maybe_corrupt_file(path: str) -> bool:

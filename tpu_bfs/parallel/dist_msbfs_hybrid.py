@@ -71,6 +71,7 @@ from tpu_bfs.graph.ell import (
 )
 from tpu_bfs.algorithms.msbfs_packed import ripple_increment
 from tpu_bfs.algorithms._packed_common import (
+    AotProgramProtocol,
     ExpandSpec,
     PackedRunProtocol,
     PullGateHost,
@@ -774,7 +775,8 @@ def _make_dist_core(
 
 
 class DistHybridMsBfsEngine(
-    PackedRunProtocol, RowGatherExchangeAccounting, PullGateHost
+    PackedRunProtocol, RowGatherExchangeAccounting, PullGateHost,
+    AotProgramProtocol,
 ):
     """Multi-chip 4096-lane hybrid MS-BFS: dense MXU tiles + gather residual.
 
@@ -1000,6 +1002,16 @@ class DistHybridMsBfsEngine(
         if self.pull_gate:
             args = args + (jax.device_put(self._lane_mask_dev, rep),)
         return [("dist_core", self._dist_core, args)]
+
+    def export_programs(self):
+        """AOT inventory (ISSUE 9; utils/aot.py): the sharded level-loop
+        core (gated form carries the lane-mask arg), reusing the
+        analysis hook's replicated example args."""
+        return [
+            ("dist_core", "_dist_core", fn, args)
+            for name, fn, args in self.analysis_programs()
+            if name == "dist_core"
+        ]
 
     def _core(self, arrs, fw0, max_levels):
         if self.pull_gate:

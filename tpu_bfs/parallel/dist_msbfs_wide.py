@@ -38,6 +38,7 @@ from tpu_bfs.graph.csr import Graph
 from tpu_bfs.graph.ell import ShardedEllGraph, build_ell_sharded
 from tpu_bfs.algorithms.msbfs_packed import ripple_increment
 from tpu_bfs.algorithms._packed_common import (
+    AotProgramProtocol,
     ExpandSpec,
     PackedRunProtocol,
     lazy_full_parent_ell,
@@ -237,7 +238,8 @@ def _make_dist_core(
     return build
 
 
-class DistWideMsBfsEngine(PackedRunProtocol, RowGatherExchangeAccounting):
+class DistWideMsBfsEngine(PackedRunProtocol, RowGatherExchangeAccounting,
+                          AotProgramProtocol):
     """Multi-chip 4096-lane packed MS-BFS: sharded ELL, replicated frontier.
 
     Per-chip HBM is O(V * W/8 * num_planes) for the packed state plus the
@@ -423,6 +425,17 @@ class DistWideMsBfsEngine(PackedRunProtocol, RowGatherExchangeAccounting):
         fw0 = jax.device_put(self._seed_dev(np.asarray([0])), rep)
         ml = jax.device_put(jnp.int32(32), rep)
         return [("dist_core", self._dist_core, (self.arrs, fw0, ml))]
+
+    def export_programs(self):
+        """AOT inventory (ISSUE 9; utils/aot.py): the sharded level-loop
+        core — THE multi-chip compile a preheat exists to skip — reusing
+        the analysis hook's replicated example args (the sharded-export
+        plumbing the Buluç & Madduri-style partitioned paths need)."""
+        return [
+            ("dist_core", "_dist_core", fn, args)
+            for name, fn, args in self.analysis_programs()
+            if name == "dist_core"
+        ]
 
     def _src_bits_view(self, fw0):
         """Rank-order seed table -> chip-major view matching planes/vis."""

@@ -146,6 +146,7 @@ class BfsService:
         distances: bool = True,
         registry: EngineRegistry | None = None,
         registry_capacity: int = 4,
+        aot_dir: str | None = None,
         autostart: bool = True,
         log=None,
     ):
@@ -159,9 +160,13 @@ class BfsService:
         # An internally-created registry must hold the WHOLE ladder
         # resident (plus one degrade-rung slot) or routing thrashes
         # rebuilds; a caller-supplied registry keeps its own policy.
+        # ``aot_dir`` arms the registry's artifact store (the --preheat
+        # path, ISSUE 9): every rung whose artifacts are present adopts
+        # deserialized executables instead of compiling.
         self._registry = registry or EngineRegistry(
             capacity=max(registry_capacity, len(self._ladder) + 1),
             log=self._log,
+            aot_store=aot_dir,
         )
         if isinstance(graph, str):
             self._graph_key = graph
@@ -364,11 +369,29 @@ class BfsService:
             "breaker_opens": self._breaker.opens,
             "draining": self._draining,
         }
+        store = self._registry.aot_store
+        if store is not None:
+            # AOT preheat visibility: artifact hits vs JIT fallbacks —
+            # the cold-start A/B's statsz-side record (BENCHMARKS.md
+            # "Cold start and preheat").
+            out["aot"] = store.counts()
         if _faults.ACTIVE is not None:
             # Chaos-harness visibility: per-kind injected-fault counts so
             # a soak can check every scheduled fault actually landed.
             out["faults"] = _faults.ACTIVE.counts()
         return out
+
+    def export_aot(self, store=None) -> dict:
+        """Export every resident (warmed) engine's compiled programs
+        into an artifact store (a path, an ArtifactStore, or None for
+        the registry's own) — the ``--export-aot`` path: this warmed
+        server populates the store a successor ``--preheat``s from.
+        Returns ``{"programs": total exported, "engines": count}``."""
+        out = self._registry.export_resident(store)
+        return {
+            "programs": sum(len(v) for v in out.values()),
+            "engines": len(out),
+        }
 
     def statsz(self) -> dict:
         out = self.metrics.snapshot(
@@ -816,6 +839,20 @@ def build_arg_parser() -> argparse.ArgumentParser:
                     help="LRU bound on resident warmed engines (default 4, "
                     "raised automatically to fit the width ladder's rungs "
                     "plus one degrade slot)")
+    ap.add_argument("--preheat", default=None, metavar="DIR",
+                    help="AOT artifact store to preheat from (utils/aot): "
+                    "every ladder rung whose exported programs are "
+                    "present installs deserialized executables instead "
+                    "of compiling, so the server reaches the READY line "
+                    "without paying trace/lower/compile per rung; "
+                    "stale or corrupt artifacts fall back to JIT "
+                    "per program (corrupt files are quarantined)")
+    ap.add_argument("--export-aot", default=None, metavar="DIR",
+                    help="after warm-up, export every resident engine's "
+                    "compiled programs into DIR so a successor started "
+                    "with --preheat DIR skips the cold start (the warm "
+                    "handoff pair — scripts/warm_handoff.py drives both "
+                    "ends)")
     return ap
 
 
@@ -959,8 +996,32 @@ def run_server(args, stdin=None, stdout=None, stderr=None,
         distances=not args.no_distances,
         registry=registry,
         registry_capacity=args.registry_cap,
+        aot_dir=getattr(args, "preheat", None),
         log=log,
     )
+    export_aot = getattr(args, "export_aot", None)
+    if export_aot:
+        # Populate the artifact store from THIS warmed server (every
+        # ladder rung is resident and compiled by now) so a successor
+        # started with --preheat skips the cold start entirely.
+        try:
+            counts = service.export_aot(export_aot)
+            log(f"aot export -> {export_aot}: {counts['programs']} "
+                f"programs from {counts['engines']} engines")
+        except Exception as exc:  # noqa: BLE001 — export is an optimization
+            log(f"aot export failed ({exc!r}); continuing without")
+    # The readiness line (stderr, like every non-protocol line): every
+    # ladder rung is warmed — from artifacts when preheating — and the
+    # service will now take traffic. The warm-handoff driver
+    # (scripts/warm_handoff.py) keys the old server's SIGTERM on this.
+    store = service._registry.aot_store
+    ready_extra = ""
+    if store is not None:
+        c = store.counts()
+        ready_extra = (f" aot_hits={c['aot_hits']}"
+                       f" aot_fallbacks={c['aot_fallbacks']}")
+    log(f"READY engine={args.engine} lanes={args.lanes} "
+        f"ladder={service.width_ladder}{ready_extra}")
     out_lock = threading.Lock()
     outstanding = [0]
     drained = threading.Condition(out_lock)

@@ -76,14 +76,29 @@ class EngineSpec:
 
 
 class EngineRegistry:
-    """LRU-bounded store of warmed engines over once-loaded graphs."""
+    """LRU-bounded store of warmed engines over once-loaded graphs.
 
-    def __init__(self, *, capacity: int = 4, warm: bool = True, log=None):
+    ``aot_store`` (an ``utils.aot.ArtifactStore`` or a directory path)
+    turns builds into ADOPTIONS where artifacts exist: ``_build`` still
+    constructs the graph tables, but installs deserialized executables
+    (``adopt_programs``) over the engine's jit entries instead of
+    compiling — the ``--preheat`` path (ISSUE 9). Stale or corrupt
+    artifacts fall back to JIT per program; the store's hit/fallback
+    counts surface in statsz.
+    """
+
+    def __init__(self, *, capacity: int = 4, warm: bool = True, log=None,
+                 aot_store=None):
         if capacity < 1:
             raise ValueError(f"registry capacity must be >= 1, got {capacity}")
         self.capacity = capacity
         self._warm = warm
         self._log = log or (lambda msg: None)
+        if isinstance(aot_store, str):
+            from tpu_bfs.utils.aot import ArtifactStore
+
+            aot_store = ArtifactStore(aot_store, log=self._log)
+        self.aot_store = aot_store
         self._graphs: dict = {}  # guarded-by: _lock
         self._engines: OrderedDict = OrderedDict()  # guarded-by: _lock
         # One build at a time: engine builds allocate device tables, and
@@ -91,6 +106,7 @@ class EngineRegistry:
         # double-allocate. RLock so get() -> _build() -> graph() nests.
         self._lock = threading.RLock()
         self.builds = 0  # guarded-by: _lock
+        self.adoptions = 0  # guarded-by: _lock
         self.evictions = 0  # guarded-by: _lock
         enable_compile_cache(log=self._log)
 
@@ -142,23 +158,53 @@ class EngineRegistry:
 
     def _build(self, spec: EngineSpec):  # requires-lock: _lock
         rec = _obs.ACTIVE
+        store = self.aot_store
+        # Span naming is honest about what the build will cost: a spec
+        # whose core artifact probes valid becomes an engine_adopt span
+        # (table construction + executable install, no compile); only a
+        # true from-scratch build emits engine_build — the span name the
+        # preheat smoke asserts is ABSENT from a preheated cold start.
+        adopting = store is not None and store.probe(spec)
+        span = "engine_adopt" if adopting else "engine_build"
         if rec is not None:
             # Registry lifecycle span: builds are the 30-second events a
             # trace of a cold start is mostly made of.
-            rec.begin("engine_build", f"w{spec.lanes}", cat="serve.registry",
+            rec.begin(span, f"w{spec.lanes}", cat="serve.registry",
                       engine=spec.engine, width=spec.lanes,
                       planes=spec.planes, devices=spec.devices)
+        adopted: list = []
         try:
             eng = self._build_inner(spec)
+            if store is not None:
+                from tpu_bfs.utils.aot import adopt_engine_programs
+
+                adopted = adopt_engine_programs(
+                    eng, spec, store, log=self._log
+                )
+                if adopted:
+                    with self._lock:
+                        self.adoptions += 1
+                elif adopting:
+                    # The probe said adoptable but nothing installed
+                    # (payload undeserializable here, or a concurrent
+                    # quarantine): the engine_adopt span would otherwise
+                    # read as a phantom no-compile — flag it loudly.
+                    self._log(
+                        f"aot adoption of {spec} installed nothing; "
+                        f"this build pays the full JIT path"
+                    )
+                    if rec is not None:
+                        rec.event("aot_adopt_failed", cat="serve.registry",
+                                  width=spec.lanes, engine=spec.engine)
         except Exception as exc:
             if rec is not None:
-                rec.end("engine_build", f"w{spec.lanes}",
+                rec.end(span, f"w{spec.lanes}",
                         cat="serve.registry", width=spec.lanes,
                         error=f"{type(exc).__name__}: {str(exc)[:120]}")
             raise
         if rec is not None:
-            rec.end("engine_build", f"w{spec.lanes}", cat="serve.registry",
-                    width=spec.lanes)
+            rec.end(span, f"w{spec.lanes}", cat="serve.registry",
+                    width=spec.lanes, adopted=len(adopted))
         return eng
 
     def _build_inner(self, spec: EngineSpec):  # requires-lock: _lock
@@ -258,3 +304,30 @@ class EngineRegistry:
             return list(self._engines.items())
         finally:
             self._lock.release()
+
+    def export_resident(self, store=None) -> dict:
+        """Export every resident engine's serving programs into
+        ``store`` (default: the registry's own) — the ``--export-aot``
+        path: a warmed server populates the artifact store a successor
+        preheats from. Returns ``{spec: [exported names]}``. Builds are
+        serialized by the registry lock as usual; the export itself
+        holds no registry state."""
+        from tpu_bfs.utils.aot import ArtifactStore, export_engine_programs
+
+        if isinstance(store, str):
+            store = ArtifactStore(store, log=self._log)
+        store = store or self.aot_store
+        if store is None:
+            raise ValueError(
+                "export_resident needs an artifact store (construct the "
+                "registry with aot_store=... or pass one here)"
+            )
+        out = {}
+        for spec, eng in self.resident_engines():
+            names = export_engine_programs(eng, spec, store, log=self._log)
+            self._log(
+                f"aot export {spec.engine}/w{spec.lanes}: "
+                f"{len(names)} programs -> {store.root}"
+            )
+            out[spec] = names
+        return out

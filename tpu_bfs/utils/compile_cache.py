@@ -7,19 +7,41 @@ wall-clock the bench's budget envelope cannot spare. One copy of the
 env-var resolution so the two callers cannot drift into writing separate
 caches (TPU_BFS_BENCH_XLA_CACHE, default <TPU_BFS_BENCH_CACHE>/xla_cache;
 empty disables).
+
+Resolution is ONCE PER PROCESS: every ``EngineRegistry()`` construction
+and every bench entry calls :func:`enable_compile_cache`, and before the
+idempotency guard each call re-ran ``jax.config.update`` and re-logged
+the path — a preheat run constructing registries per service spammed the
+log and re-pointed jax at a cache it was already using. The first call's
+outcome (path or disabled) is cached; later calls return it silently.
+``force=True`` re-resolves (tests that vary the env).
 """
 
 from __future__ import annotations
 
 import os
 
+# The first call's resolved outcome, kept as a 1-tuple so a resolved
+# "disabled" (None) is distinguishable from "never resolved".
+_RESOLVED: tuple | None = None
 
-def enable_compile_cache(log=None) -> str | None:
-    """Point jax at the persistent compile cache; best-effort.
+
+def reset_resolution() -> None:
+    """Forget the cached resolution (tests that vary the env vars)."""
+    global _RESOLVED
+    _RESOLVED = None
+
+
+def enable_compile_cache(log=None, *, force: bool = False) -> str | None:
+    """Point jax at the persistent compile cache; best-effort and
+    idempotent (resolved once per process — see module docstring).
 
     Returns the cache path when enabled, None when disabled or
     unavailable (a jax without the knob degrades to the status quo).
     """
+    global _RESOLVED
+    if _RESOLVED is not None and not force:
+        return _RESOLVED[0]
     path = os.environ.get(
         "TPU_BFS_BENCH_XLA_CACHE",
         os.path.join(
@@ -27,6 +49,7 @@ def enable_compile_cache(log=None) -> str | None:
         ),
     )
     if not path:
+        _RESOLVED = (None,)
         return None
     try:
         os.makedirs(path, exist_ok=True)
@@ -35,8 +58,10 @@ def enable_compile_cache(log=None) -> str | None:
         jax.config.update("jax_compilation_cache_dir", path)
         if log:
             log(f"persistent compile cache: {path}")
+        _RESOLVED = (path,)
         return path
     except Exception as exc:  # noqa: BLE001 — the cache is an optimization
         if log:
             log(f"compile cache unavailable ({exc!r}); continuing without")
+        _RESOLVED = (None,)
         return None

@@ -1,5 +1,5 @@
 # Convenience targets; everything also works without make (README).
-.PHONY: test native bench analyze wirecheck serve-smoke chaos-smoke obs-smoke preheat-smoke wheel clean
+.PHONY: test native bench analyze wirecheck serve-smoke serve-dist-smoke chaos-smoke obs-smoke preheat-smoke wheel clean
 
 # Full suite on 8 virtual CPU devices (tests/conftest.py forces the
 # platform; the axon TPU plugin is bypassed).
@@ -65,6 +65,32 @@ serve-smoke: wirecheck
 	meta = [r for r in rs if r['id'] == 4][0]; \
 	assert 'distances_npy' not in meta and meta['levels'] >= 1, rs; \
 	print('serve-smoke OK:', sorted(r['id'] for r in rs))"
+
+# Distributed-serving smoke (README "Distributed serving"; ISSUE 11):
+# a JSONL round trip against a MESH-backed service on the forced
+# 8-device CPU mesh — the frontend dispatches coalesced batches through
+# the distributed wide engine's dispatch/fetch halves, responses carry
+# the mesh keys (devices, per-query gteps, wire_bytes), distance
+# payloads decode, and a want_distances=false request answers
+# metadata-only straight off the on-device summaries.
+serve-dist-smoke: wirecheck
+	printf '{"id":1,"source":0}\n{"id":2,"source":3}\n{"id":3,"source":5}\n{"id":4,"source":5,"want_distances":false}\n' | \
+	env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	  python -m tpu_bfs.serve random:n=96,m=480,seed=3 \
+	  --engine wide --devices 8 --lanes 64 --ladder off --linger-ms 1 \
+	  --statsz-every 0 | \
+	python -c "import sys, json; \
+	from tpu_bfs.serve.frontend import decode_distances; \
+	rs = [json.loads(l) for l in sys.stdin if l.strip()]; \
+	assert len(rs) == 4 and all(r['status'] == 'ok' for r in rs), rs; \
+	assert all(r['devices'] == 8 for r in rs), rs; \
+	assert all(r['dispatched_lanes'] == 64 for r in rs), rs; \
+	assert all(r.get('gteps', 0) > 0 and r.get('wire_bytes', 0) > 0 for r in rs), rs; \
+	withd = [r for r in rs if r['id'] != 4]; \
+	assert all(int(decode_distances(r['distances_npy'])[r['source']]) == 0 for r in withd), rs; \
+	meta = [r for r in rs if r['id'] == 4][0]; \
+	assert 'distances_npy' not in meta and meta['levels'] >= 1, rs; \
+	print('serve-dist-smoke OK:', sorted(r['id'] for r in rs))"
 
 # The seeded chaos soak (README "Failure model"): a JSONL server under a
 # deterministic fault schedule (transient + OOM degrade + slow extract)

@@ -28,7 +28,14 @@ serve_routing/serve_extract_p50_ms with knobs TPU_BFS_BENCH_SERVE_CLIENTS
 (64) / TPU_BFS_BENCH_SERVE_QUERIES (8 per client) /
 TPU_BFS_BENCH_SERVE_LANES (256, the ladder max) /
 TPU_BFS_BENCH_SERVE_LADDER (auto|off|'32,128,...') /
-TPU_BFS_BENCH_SERVE_PIPELINE (1) / TPU_BFS_BENCH_SERVE_ENGINE (wide)),
+TPU_BFS_BENCH_SERVE_PIPELINE (1) / TPU_BFS_BENCH_SERVE_ENGINE
+(wide|hybrid|packed|dist2d) / TPU_BFS_BENCH_SERVE_DEVICES ('' = 1,
+'all' = every attached device — distributed serving, ISSUE 11) /
+TPU_BFS_BENCH_SERVE_EXCHANGE / TPU_BFS_BENCH_SERVE_PULL_GATE (0) plus
+the PR 5/7 wire knobs; mesh runs add serve_gteps_p50 /
+serve_gteps_hmean / serve_wire_bytes_per_query to the verdict, and
+TPU_BFS_BENCH_VALIDATE_MODE=structure swaps the SciPy oracle for
+Graph500-style tree-property checks at oracle-infeasible scales),
 TPU_BFS_BENCH_LANES (msbfs mode, 512), TPU_BFS_BENCH_MAX_LANES (hybrid/wide
 modes, 8192 = the measured default — sweep knob), TPU_BFS_BENCH_SOURCES (single
 modes, 8), TPU_BFS_BENCH_VALIDATE (1), TPU_BFS_BENCH_VALIDATE_LANES (4),
@@ -1290,6 +1297,50 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
     pipeline = os.environ.get("TPU_BFS_BENCH_SERVE_PIPELINE", "1") == "1"
     engine = os.environ.get("TPU_BFS_BENCH_SERVE_ENGINE", "wide")
     do_validate = os.environ.get("TPU_BFS_BENCH_VALIDATE", "1") == "1"
+    # Distributed serving (ISSUE 11): TPU_BFS_BENCH_SERVE_DEVICES shards
+    # the serving engines over the mesh ('all' = every attached device);
+    # TPU_BFS_BENCH_SERVE_ENGINE grows 'dist2d' (the 2D edge partition —
+    # the paper's scale-26 baseline config), TPU_BFS_BENCH_SERVE_EXCHANGE
+    # picks the exchange family, TPU_BFS_BENCH_SERVE_PULL_GATE gates the
+    # dist-hybrid pull expansion, and the PR 5/7 wire knobs
+    # (TPU_BFS_BENCH_WIRE_PACK / TPU_BFS_BENCH_SPARSE_*) apply to the
+    # serve path exactly as to the dist mode. The verdict then carries
+    # per-query GTEPS (p50 + harmonic mean under the batch time share)
+    # and modeled wire bytes per query — the Graph500 scale-26 stage's
+    # record (BENCHMARKS.md "Distributed serving").
+    ndev_raw = os.environ.get("TPU_BFS_BENCH_SERVE_DEVICES", "").strip()
+    if ndev_raw == "all":
+        import jax
+
+        devices = len(jax.devices())
+    else:
+        devices = int(ndev_raw) if ndev_raw else 1
+    serve_exchange = os.environ.get("TPU_BFS_BENCH_SERVE_EXCHANGE",
+                                    "").strip()
+    serve_pull_gate = os.environ.get("TPU_BFS_BENCH_SERVE_PULL_GATE",
+                                     "0") == "1"
+    if devices > 1:
+        wire_pack = _env_wire_pack()
+        delta_bits, sieve, predict = _env_sparse_planner()
+        if serve_exchange != "sparse" and (delta_bits or sieve or predict):
+            log("sparse planner knobs need TPU_BFS_BENCH_SERVE_EXCHANGE="
+                f"sparse; ignored on exchange={serve_exchange!r}")
+            delta_bits, sieve, predict = (), False, False
+        if engine != "dist2d" and (sieve or predict):
+            # Valid on the dist mode's 1D planner but only the 2D engine
+            # runs the full planner on the serve path (the MS row
+            # gathers take delta only) — drop, don't die, so a knob set
+            # reused from a dist sweep degrades gracefully.
+            log("sieve/predict apply to the dist2d serve engine only; "
+                f"ignored on engine={engine!r}")
+            sieve, predict = False, False
+    else:
+        wire_pack, delta_bits, sieve, predict = False, (), False, False
+    # Scale-26-class graphs are too big for the SciPy oracle; 'structure'
+    # validates the Graph500 way instead — BFS-tree properties checked
+    # directly on the answer (source at distance 0, every input edge's
+    # endpoint distances within 1, README "Distributed serving").
+    validate_mode = os.environ.get("TPU_BFS_BENCH_VALIDATE_MODE", "oracle")
     watchdog_ms = float(os.environ.get("TPU_BFS_BENCH_SERVE_WATCHDOG_MS",
                                        "0") or 0)
     # Chaos arm (scripts/chip_session.sh chaos-s20): a deterministic fault
@@ -1326,17 +1377,25 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
     # serve_preheat_s land side by side in one verdict.
     aot_dir = os.environ.get("TPU_BFS_BENCH_AOT_DIR", "").strip()
 
-    t0 = time.perf_counter()
-    service = retry_transient(
-        BfsService, g, engine=engine, lanes=lanes, planes=8,
+    svc_kw = dict(
+        engine=engine, lanes=lanes, planes=8,
+        devices=devices, exchange=serve_exchange, wire_pack=wire_pack,
+        delta_bits=delta_bits, sieve=sieve, predict=predict,
+        pull_gate=serve_pull_gate,
         width_ladder=ladder, pipeline=pipeline,
         linger_ms=2.0, queue_cap=max(1024, 2 * clients),
-        watchdog_ms=watchdog_ms,
-        log=log, label="serve engine build",
+        watchdog_ms=watchdog_ms, log=log,
+    )
+    t0 = time.perf_counter()
+    service = retry_transient(
+        BfsService, g, label="serve engine build", **svc_kw
     )
     cold_start_s = time.perf_counter() - t0
     log(f"service up in {cold_start_s:.1f}s: engine={engine} "
-        f"lanes={lanes} ladder={service.width_ladder} pipeline={pipeline} "
+        f"lanes={lanes} devices={devices} "
+        f"exchange={serve_exchange or 'default'} "
+        f"wire_pack={'on' if wire_pack else 'off'} "
+        f"ladder={service.width_ladder} pipeline={pipeline} "
         f"clients={clients} queries={clients * per_client}")
     if fault_spec:
         from tpu_bfs import faults as faults_mod
@@ -1388,13 +1447,32 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
         f"fill={snap['fill_ratio']} batches={snap['batches']}")
 
     if do_validate:
-        from tpu_bfs.reference import bfs_scipy
-
         t0 = time.perf_counter()
         nv = max(1, int(os.environ.get("TPU_BFS_BENCH_VALIDATE_LANES", "4")))
-        for r in flat[:: max(1, len(flat) // nv)][:nv]:
-            np.testing.assert_array_equal(r.distances, bfs_scipy(g, r.source))
-        log(f"validated {nv} serve responses in {time.perf_counter()-t0:.1f}s")
+        picks_v = flat[:: max(1, len(flat) // nv)][:nv]
+        if validate_mode == "structure":
+            from tpu_bfs import validate as _validate
+            from tpu_bfs.graph.csr import INF_DIST
+
+            for r in picks_v:
+                if int(r.distances[r.source]) != 0:
+                    raise _validate.ValidationError(
+                        f"source {r.source} not at distance 0"
+                    )
+                _validate.check_edge_levels(g, r.distances)
+                if int((r.distances != INF_DIST).sum()) != r.reached:
+                    raise _validate.ValidationError(
+                        f"reached count mismatch for source {r.source}"
+                    )
+        else:
+            from tpu_bfs.reference import bfs_scipy
+
+            for r in picks_v:
+                np.testing.assert_array_equal(
+                    r.distances, bfs_scipy(g, r.source)
+                )
+        log(f"validated {nv} serve responses ({validate_mode}) in "
+            f"{time.perf_counter()-t0:.1f}s")
 
     aot_keys: dict = {}
     if aot_dir:
@@ -1417,11 +1495,8 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
             service.close()
         t0 = time.perf_counter()
         pre = retry_transient(
-            BfsService, g, engine=engine, lanes=lanes, planes=8,
-            width_ladder=ladder, pipeline=pipeline,
-            linger_ms=2.0, queue_cap=max(1024, 2 * clients),
-            watchdog_ms=watchdog_ms, aot_dir=aot_dir,
-            log=log, label="serve preheat",
+            BfsService, g, aot_dir=aot_dir, label="serve preheat",
+            **svc_kw,
         )
         try:
             preheat_s = time.perf_counter() - t0
@@ -1450,7 +1525,9 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
         from tpu_bfs.obs.engine_trace import trace_summary
 
         level_traces = [
-            (f"{spec.engine}/w{spec.lanes}", eng.last_run_trace)
+            (f"{spec.engine}/w{spec.lanes}"
+             + (f"/d{spec.devices}" if spec.devices > 1 else ""),
+             eng.last_run_trace)
             for spec, eng in service._registry.resident_engines()
             if getattr(eng, "last_run_trace", None)
         ]
@@ -1462,7 +1539,10 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
             # The widest rung's trace (the batch shape the closed loop
             # mostly ran) stands in for "the" serve engine trace.
             label, trace = max(
-                level_traces, key=lambda lt: int(lt[0].rsplit("/w", 1)[1])
+                level_traces,
+                key=lambda lt: int(
+                    lt[0].rsplit("/w", 1)[1].split("/", 1)[0]
+                ),
             )
             obs_keys["serve_trace"] = trace_summary(trace)
             obs_keys["serve_trace_engine"] = label
@@ -1481,13 +1561,45 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
                 # run's verdict (the timed work is already done).
                 log(f"trace write failed ({exc!r})")
 
+    # Per-query traversal-rate record (ISSUE 11): mesh-served responses
+    # carry edges + the batch device time, so each query prices as GTEPS
+    # under the batch time share; p50 and the harmonic mean land in the
+    # verdict next to modeled wire bytes per query.
+    dist_keys: dict = {}
+    if devices > 1:
+        gteps = sorted(r.gteps for r in flat if r.gteps)
+        wires = [r.wire_bytes for r in flat if r.wire_bytes is not None]
+        dist_keys = {
+            "serve_devices": devices,
+            "serve_exchange": serve_exchange or "default",
+            "serve_wire_pack": wire_pack,
+            "serve_pull_gate": serve_pull_gate,
+            "serve_sparse_delta": list(delta_bits),
+            "serve_sparse_sieve": sieve,
+            "serve_sparse_predict": predict,
+        }
+        if gteps:
+            # 6 significant digits (CPU-mesh figures are ~1e-5 GTEPS and
+            # must not round to 0; chip figures keep full precision).
+            dist_keys["serve_gteps_p50"] = float(
+                f"{gteps[len(gteps) // 2]:.6g}")
+            dist_keys["serve_gteps_hmean"] = float(
+                f"{len(gteps) / sum(1.0 / t for t in gteps):.6g}")
+        if wires:
+            dist_keys["serve_wire_bytes_per_query"] = round(
+                sum(wires) / len(wires), 1)
+            dist_keys["serve_wire_bytes_total"] = round(sum(wires), 1)
+        log("dist serve record: "
+            + " ".join(f"{k}={v}" for k, v in dist_keys.items()))
+
+    chips = f"{devices} chips" if devices > 1 else "1 chip"
     return {
         "metric": (
             f"BFS serve throughput ({clients} closed-loop clients, "
             f"{lanes}-max-lane {engine} batches, ladder="
             f"{'-'.join(str(w) for w in snap['ladder'])}, "
             f"pipeline={'on' if pipeline else 'off'}, tpu_bfs/serve), "
-            f"{graph_desc or f'RMAT scale-{scale} ef={ef}'}, 1 chip"
+            f"{graph_desc or f'RMAT scale-{scale} ef={ef}'}, {chips}"
         ),
         "value": round(qps, 2),
         "unit": "qps",
@@ -1513,6 +1625,7 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
         # (serve_preheat_s + aot hit/fallback audit) rides along when
         # TPU_BFS_BENCH_AOT_DIR armed the A/B.
         "serve_cold_start_s": round(cold_start_s, 2),
+        **dist_keys,
         **aot_keys,
         **({"serve_faults": fault_sched.counts()} if fault_sched else {}),
         **obs_keys,

@@ -172,6 +172,49 @@ for i in $(seq 1 "$attempts"); do
       TPU_BFS_BENCH_MODE=serve TPU_BFS_BENCH_SCALE=20 \
       TPU_BFS_BENCH_OBS="dump_dir=$out" \
       TPU_BFS_BENCH_TRACE_OUT="$out/obs_s20_trace.json"
+    # Distributed serving (ISSUE 11): the serve frontend dispatching
+    # coalesced batches through the DISTRIBUTED engines across the full
+    # attached mesh. serve-dist-s20 is the hybrid-mesh baseline;
+    # serve-dist-pullgate-s20 is the pull-gate A/B arm ON THE SERVE PATH
+    # — together with pullgate-s21/s20 this is the slate that finally
+    # decides the pull_gate default (ON if the gated arms win both the
+    # one-shot and served shapes; it has defaulted OFF since PR 1
+    # awaiting exactly this measurement). serve-dist2d-s20 /
+    # serve-dist2d-packed-s20 run the 2D engine plain vs bit-packed on
+    # both its per-level collectives — the wire_pack decision pair (OFF
+    # since PR 5 awaiting chip measurement; the MS engines' lane words
+    # are already packed, so the 2D pair is where packing can actually
+    # move bytes on the serve path). Every line carries per-query GTEPS
+    # (p50 + hmean) and modeled wire bytes per query.
+    stage "serve-dist-s20" "$out/serve_dist_s20.json" \
+      TPU_BFS_BENCH_MODE=serve TPU_BFS_BENCH_SCALE=20 \
+      TPU_BFS_BENCH_SERVE_DEVICES=all TPU_BFS_BENCH_SERVE_ENGINE=hybrid \
+      TPU_BFS_BENCH_SERVE_LANES=4096
+    stage "serve-dist-pullgate-s20" "$out/serve_dist_pullgate_s20.json" \
+      TPU_BFS_BENCH_MODE=serve TPU_BFS_BENCH_SCALE=20 \
+      TPU_BFS_BENCH_SERVE_DEVICES=all TPU_BFS_BENCH_SERVE_ENGINE=hybrid \
+      TPU_BFS_BENCH_SERVE_LANES=4096 TPU_BFS_BENCH_SERVE_PULL_GATE=1
+    stage "serve-dist2d-s20" "$out/serve_dist2d_s20.json" \
+      TPU_BFS_BENCH_MODE=serve TPU_BFS_BENCH_SCALE=20 \
+      TPU_BFS_BENCH_SERVE_DEVICES=all TPU_BFS_BENCH_SERVE_ENGINE=dist2d \
+      TPU_BFS_BENCH_SERVE_LANES=64
+    stage "serve-dist2d-packed-s20" "$out/serve_dist2d_packed_s20.json" \
+      TPU_BFS_BENCH_MODE=serve TPU_BFS_BENCH_SCALE=20 \
+      TPU_BFS_BENCH_SERVE_DEVICES=all TPU_BFS_BENCH_SERVE_ENGINE=dist2d \
+      TPU_BFS_BENCH_SERVE_LANES=64 TPU_BFS_BENCH_WIRE_PACK=1
+    # THE exit demonstration (ROADMAP item 1 / PAPER.md target): a
+    # correct Graph500 scale-26 BFS answered by a serve frontend across
+    # the full mesh, per-query GTEPS on the line. Validation is the
+    # Graph500 structural check (source at 0, edge levels within 1) —
+    # the SciPy oracle cannot hold a scale-26 graph. Small closed loop:
+    # the point is the scale, not the QPS.
+    stage "graph500-s26" "$out/graph500_s26.json" \
+      TPU_BFS_BENCH_MODE=serve TPU_BFS_BENCH_SCALE=26 \
+      TPU_BFS_BENCH_SERVE_DEVICES=all TPU_BFS_BENCH_SERVE_ENGINE=hybrid \
+      TPU_BFS_BENCH_SERVE_LANES=4096 TPU_BFS_BENCH_SERVE_CLIENTS=16 \
+      TPU_BFS_BENCH_SERVE_QUERIES=2 TPU_BFS_BENCH_SERVE_EXCHANGE=sliced \
+      TPU_BFS_BENCH_VALIDATE_MODE=structure \
+      TPU_BFS_BENCH_VALIDATE_LANES=2
     # Wire-format A/B (ISSUE 5): the 1D distributed exchange bit-packed
     # (TPU_BFS_BENCH_WIRE_PACK=1: uint32 words, 1 bit/vertex on the wire
     # — wirecheck-proven 1/8 the ring bytes) vs plain (pred ring) at

@@ -294,7 +294,8 @@ def test_breaker_opens_and_routing_goes_around(fake_graph, monkeypatch):
         r = svc.query(1, timeout=60)
         assert r.status == "error" and "boom" in r.error
     snap = svc.statsz()
-    assert snap["breaker_open"] == [32] and snap["breaker_opens"] == 1
+    # Partition-aware breaker keys (ISSUE 11): (width, devices).
+    assert snap["breaker_open"] == [(32, 1)] and snap["breaker_opens"] == 1
     assert COUNTERS.as_dict()["breaker_opens"] == 1
     # The next singleton routes AROUND the open rung and succeeds.
     r = svc.query(2, timeout=60)
@@ -328,7 +329,7 @@ def test_breaker_half_open_probe_recovers(fake_graph, monkeypatch):
     )
     svc.start()
     assert svc.query(1, timeout=60).status == "error"  # opens at 32
-    assert svc.statsz()["breaker_open"] == [32]
+    assert svc.statsz()["breaker_open"] == [(32, 1)]
     eng32.fail = False  # the rung heals during the cooldown
     time.sleep(0.08)
     r = svc.query(2, timeout=60)  # the half-open probe

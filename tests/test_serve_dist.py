@@ -1,0 +1,439 @@
+"""Distributed serving (ISSUE 11): dist engines behind the serve
+frontend on the forced 8-device CPU mesh.
+
+- served answers are bit-identical to one-shot dist runs across batch
+  compositions (wide 1D mesh + the 2D adapter, oracle-checked);
+- the width ladder, OOM-degrade grid, and circuit breaker are
+  partition-aware: mesh ladders floor/quantize on the engine/mesh grid,
+  and breaker keys are (width, devices) so a single-chip rung tripping
+  never blackholes the same width on the mesh path (or vice versa);
+- the OOM-requeue ladder, requeue-budget shed, and drain arms hold on a
+  mesh-backed service under deterministic fault injection;
+- the registry adopts the sharded ``dist_core`` from an AOT store with
+  ZERO engine_build spans (the --preheat path on a mesh replica);
+- mesh-served responses carry the per-query traversal record (devices,
+  edges, gteps under the batch time share, wire-bytes share).
+
+Heavy sweeps (the hybrid mesh rung, multi-composition fuzz) are
+slow-marked to protect the tier-1 budget.
+"""
+
+import threading
+import types
+
+import numpy as np
+import pytest
+
+from tpu_bfs import faults, obs
+from tpu_bfs.graph.generate import random_graph
+from tpu_bfs.reference.cpu_bfs import bfs_python
+from tpu_bfs.serve import BfsService, CircuitBreaker, EngineSpec
+from tpu_bfs.serve.executor import (
+    BatchExecutor,
+    breaker_key,
+    engine_devices,
+)
+from tpu_bfs.serve.frontend import build_width_ladder, ladder_bounds
+from tpu_bfs.serve.metrics import ServeMetrics
+
+pytestmark = pytest.mark.serve
+
+P = 8  # the conftest-forced CPU mesh
+
+
+@pytest.fixture(scope="module")
+def dist_graph():
+    return random_graph(96, 480, seed=3)
+
+
+@pytest.fixture(scope="module")
+def dist_golden(dist_graph):
+    cand = np.flatnonzero(dist_graph.degrees > 0)[:8]
+    return {int(s): bfs_python(dist_graph, int(s))[0] for s in cand}
+
+
+@pytest.fixture(scope="module")
+def mesh_service(dist_graph):
+    """ONE warmed mesh-backed wide service shared by the module's read
+    arms (build+warm is the expensive part; the mutating arms build
+    their own)."""
+    svc = BfsService(
+        dist_graph, engine="wide", devices=P, lanes=64, width_ladder="off",
+        linger_ms=1.0,
+    )
+    yield svc
+    svc.close()
+
+
+# --- ladder bounds (satellite: mesh-scaled floor/quantum) ------------------
+
+
+def test_ladder_bounds_scale_with_mesh():
+    assert ladder_bounds(512) == (32, 32)  # single-chip: unchanged
+    assert ladder_bounds(512, devices=8) == (256, 32)
+    assert ladder_bounds(64, devices=8) == (64, 32)  # floor caps at lanes
+    # The hybrid engines' dense kernel takes whole 4096-lane steps,
+    # single-chip and mesh alike.
+    assert ladder_bounds(8192, engine="hybrid") == (4096, 4096)
+    assert ladder_bounds(8192, devices=8, engine="hybrid") == (4096, 4096)
+
+
+def test_auto_ladder_floors_at_mesh_scale():
+    # Single-chip behavior is pinned elsewhere; the mesh ladder must not
+    # warm widths below 32 * devices (no partition benefits from them).
+    assert build_width_ladder(512, "auto", devices=8) == [256, 512]
+    assert build_width_ladder(64, "auto", devices=8) == [64]
+    assert build_width_ladder(8192, "auto", devices=8, engine="hybrid") == [
+        4096, 8192,
+    ]
+    # The single-chip auto ladder still walks to the 32 floor.
+    assert build_width_ladder(512, "auto") == [32, 128, 512]
+
+
+def test_explicit_ladder_validates_against_mesh_grid():
+    with pytest.raises(ValueError, match=r"multiple of 32 in \[256"):
+        build_width_ladder(512, "32,512", devices=8)
+    with pytest.raises(ValueError, match="multiple of 4096"):
+        build_width_ladder(8192, "512,8192", devices=8, engine="hybrid")
+    assert build_width_ladder(512, "256,512", devices=8) == [256, 512]
+
+
+# --- spec validation (mesh keys) -------------------------------------------
+
+
+def test_engine_spec_mesh_key_validation():
+    ok = EngineSpec(graph_key="g", engine="dist2d", devices=8, lanes=32,
+                    exchange="sparse", delta_bits=[8, 16], sieve=True,
+                    predict=True, mesh_shape=[2, 4])
+    ok.validate()
+    assert ok.delta_bits == (8, 16)  # frozen/hashable
+    hash(ok)
+    with pytest.raises(ValueError, match="devices >= 2"):
+        EngineSpec(graph_key="g", engine="dist2d", devices=1).validate()
+    with pytest.raises(ValueError, match="single-chip engines"):
+        EngineSpec(graph_key="g", engine="wide", wire_pack=True).validate()
+    with pytest.raises(ValueError, match="not one of"):
+        EngineSpec(graph_key="g", engine="wide", devices=8,
+                   exchange="ring").validate()
+    with pytest.raises(ValueError, match="sparse"):
+        EngineSpec(graph_key="g", engine="wide", devices=8,
+                   delta_bits=(8,)).validate()
+    with pytest.raises(ValueError, match="planner"):
+        EngineSpec(graph_key="g", engine="wide", devices=8,
+                   exchange="sparse", sieve=True).validate()
+    with pytest.raises(ValueError, match="4096"):
+        EngineSpec(graph_key="g", engine="hybrid", devices=8,
+                   lanes=512).validate()
+    with pytest.raises(ValueError, match="does not cover"):
+        EngineSpec(graph_key="g", engine="dist2d", devices=8,
+                   mesh_shape=(3, 3)).validate()
+    with pytest.raises(ValueError, match="mesh_shape"):
+        EngineSpec(graph_key="g", engine="wide", devices=8,
+                   mesh_shape=(2, 4)).validate()
+
+
+# --- partition-aware breaker (satellite) -----------------------------------
+
+
+class _FakeMeshEngine:
+    """Minimal engine double with a mesh attribute: engine_devices and
+    the breaker key must read the mesh span, not assume one chip."""
+
+    def __init__(self, lanes, devices):
+        self.lanes = lanes
+        self.mesh = types.SimpleNamespace(devices=np.empty(devices))
+
+    def run(self, padded, time_it=False):
+        raise RuntimeError("deterministic: boom")
+
+
+def test_breaker_keys_are_partition_aware():
+    eng = _FakeMeshEngine(32, 8)
+    assert engine_devices(eng) == 8
+    assert engine_devices(types.SimpleNamespace(lanes=32)) == 1
+    br = CircuitBreaker(threshold=1, cooldown_s=3600.0)
+    ex = BatchExecutor(ServeMetrics(), max_retries=0, breaker=br)
+
+    class Q:
+        def __init__(self, s):
+            self.id = self.source = s
+            self.want_distances = True
+
+        def resolve_status(self, *a, **k):
+            return True
+
+    ex.run_batch(eng, [Q(1)])
+    # The mesh rung tripped; the SAME width on the single-chip path (and
+    # on any other mesh span) stays routable.
+    assert br.open_keys() == [breaker_key(32, 8)] == [(32, 8)]
+    assert not br.allow((32, 8))
+    assert br.allow((32, 1)) and br.allow((32, 4))
+
+
+# --- served == one-shot dist runs ------------------------------------------
+
+
+def test_serve_dist_wide_bit_identical_to_one_shot(
+    mesh_service, dist_graph, dist_golden
+):
+    from tpu_bfs.parallel.dist_bfs import make_mesh
+    from tpu_bfs.parallel.dist_msbfs_wide import DistWideMsBfsEngine
+
+    sources = sorted(dist_golden)[:4]
+    rs = {s: mesh_service.submit(s) for s in sources}
+    one_shot = DistWideMsBfsEngine(
+        dist_graph, make_mesh(P), num_planes=8, lanes=64
+    ).run(np.asarray(sources, dtype=np.int64))
+    for i, s in enumerate(sources):
+        r = rs[s].result(300.0)
+        assert r.ok, (r.status, r.error)
+        np.testing.assert_array_equal(r.distances, one_shot.distances_int32(i))
+        np.testing.assert_array_equal(r.distances, dist_golden[s])
+        assert r.levels == int(one_shot.ecc[i])
+        assert r.reached == int(one_shot.reached[i])
+
+
+def test_serve_dist_response_carries_traversal_record(mesh_service):
+    r = mesh_service.query(5, timeout=300.0)
+    assert r.ok and r.devices == P
+    assert r.edges and r.edges > 0
+    assert r.gteps and r.gteps > 0
+    assert r.wire_bytes and r.wire_bytes > 0
+    assert r.device_ms and r.device_ms > 0
+
+
+def test_serve_dist_metadata_only_pulls_no_distances(mesh_service):
+    r = mesh_service.query(5, want_distances=False, timeout=300.0)
+    assert r.ok and r.distances is None
+    assert r.levels is not None and r.reached == 96
+
+
+def test_serve_dist2d_matches_oracle(dist_graph, dist_golden):
+    svc = BfsService(
+        dist_graph, engine="dist2d", devices=P, lanes=32,
+        width_ladder="off", linger_ms=1.0,
+    )
+    try:
+        for s in sorted(dist_golden)[:3]:
+            r = svc.query(s, timeout=300.0)
+            assert r.ok, (r.status, r.error)
+            np.testing.assert_array_equal(r.distances, dist_golden[s])
+            assert r.devices == P and r.gteps and r.gteps > 0
+    finally:
+        svc.close()
+
+
+def test_dist2d_adapter_dedupes_padded_lanes(dist_graph):
+    """The executor pads a partial batch by repeating a real source; the
+    2D adapter must run one loop per UNIQUE source, not per lane."""
+    from tpu_bfs.parallel.dist_bfs2d import Dist2DServeEngine, make_mesh_2d
+
+    eng = Dist2DServeEngine(dist_graph, make_mesh_2d(2, 4), lanes=32)
+    inner = eng.engine
+    calls = []
+    orig = inner._loop
+
+    def counting_loop(*a, **k):
+        calls.append(1)
+        return orig(*a, **k)
+
+    inner._loop = counting_loop
+    padded = np.full(32, 5, dtype=np.int64)
+    padded[:3] = [0, 3, 5]
+    res = eng.run(padded)
+    assert len(calls) == 3  # unique sources, not 32 lanes
+    exp = bfs_python(dist_graph, 3)[0]
+    np.testing.assert_array_equal(res.distances_int32(1), exp)
+    assert int(res.ecc[1]) == int(exp[exp != np.iinfo(np.int32).max].max())
+
+
+# --- OOM degrade / requeue / drain on the mesh path ------------------------
+
+
+@pytest.mark.chaos
+def test_mesh_oom_degrades_on_partition_grid(dist_graph, dist_golden):
+    """A serve-dispatch OOM at the 512 mesh rung (skip=1 spares the
+    warm-up's visit) halves onto the mesh grid (floor 32*8=256),
+    re-admits the batch, and answers correctly at the narrower mesh
+    rung."""
+    faults.arm_from_spec("seed=7:oom@rung=512:n=1:skip=1")
+    try:
+        svc = BfsService(
+            dist_graph, engine="wide", devices=P, lanes=512,
+            width_ladder="off", linger_ms=1.0,
+        )
+        try:
+            s = sorted(dist_golden)[0]
+            r = svc.query(s, timeout=300.0)
+            assert r.ok, (r.status, r.error)
+            np.testing.assert_array_equal(r.distances, dist_golden[s])
+            assert r.dispatched_lanes == 256  # one halving, on the grid
+            assert svc.lanes == 256 and svc.width_ladder == [256]
+            snap = svc.statsz()
+            assert snap["oom_degrades"] == 1 and snap["requeued"] == 1
+        finally:
+            svc.close()
+    finally:
+        faults.disarm()
+
+
+@pytest.mark.chaos
+def test_mesh_oom_at_floor_resolves_errors(dist_graph):
+    """At the mesh floor (256 = 32 * devices) there is no narrower mesh
+    width — the query resolves with an explicit floor error, never a
+    sub-floor rebuild."""
+    faults.arm_from_spec("seed=7:oom@rung=256:n=2:skip=1")
+    try:
+        svc = BfsService(
+            dist_graph, engine="wide", devices=P, lanes=256,
+            width_ladder="off", linger_ms=1.0,
+        )
+        try:
+            r = svc.query(3, timeout=300.0)
+            assert r.status == "error"
+            assert "minimum lane count" in r.error
+            assert svc.lanes == 256  # never degraded below the mesh floor
+        finally:
+            svc.close()
+    finally:
+        faults.disarm()
+
+
+@pytest.mark.chaos
+def test_mesh_drain_and_shutdown(dist_graph):
+    svc = BfsService(
+        dist_graph, engine="wide", devices=P, lanes=64, width_ladder="off",
+        linger_ms=1.0,
+    )
+    ok = svc.query(5, timeout=300.0)
+    assert ok.ok
+    svc.drain()
+    shed = svc.submit(3)
+    assert shed.result(10.0).status == "rejected"
+    svc.close()
+    late = svc.submit(3)
+    assert late.result(10.0).status == "rejected"
+
+
+# --- AOT preheat of the sharded dist core ----------------------------------
+
+
+def test_registry_adopts_sharded_dist_core(dist_graph, tmp_path):
+    """A warmed mesh service exports the sharded dist_core; a successor
+    preheats from the store with ZERO engine_build spans and answers
+    bit-identically — the mesh replica's --preheat path."""
+    store = str(tmp_path / "store")
+    svc = BfsService(
+        dist_graph, engine="wide", devices=P, lanes=64, width_ladder="off",
+        linger_ms=1.0,
+    )
+    try:
+        base = svc.query(5, timeout=300.0)
+        assert base.ok
+        assert svc.export_aot(store) == {"programs": 1, "engines": 1}
+    finally:
+        svc.close()
+
+    rec = obs.arm(capacity=2048)
+    try:
+        pre = BfsService(
+            dist_graph, engine="wide", devices=P, lanes=64,
+            width_ladder="off", linger_ms=1.0, aot_dir=store,
+        )
+        try:
+            counts = rec.counts_by_name()
+            assert counts.get("engine_adopt", 0) >= 1
+            assert not counts.get("engine_build")
+            snap = pre.statsz()
+            assert snap["aot"]["aot_hits"] == 1
+            assert snap["aot"]["aot_fallbacks"] == 0
+            r = pre.query(5, timeout=300.0)
+            assert r.ok and r.levels == base.levels
+            np.testing.assert_array_equal(r.distances, base.distances)
+        finally:
+            pre.close()
+    finally:
+        obs.disarm()
+
+
+# --- heavy sweeps (slow-marked: tier-1 budget) -----------------------------
+
+
+@pytest.mark.slow
+def test_serve_dist_batch_composition_sweep(dist_graph, dist_golden):
+    """Served answers stay bit-identical to the oracle across batch
+    compositions: singletons, a part-filled batch, and a concurrent
+    full-width burst (coalesced compositions are scheduler-timing
+    dependent; every one must answer identically)."""
+    svc = BfsService(
+        dist_graph, engine="wide", devices=P, lanes=64,
+        width_ladder="auto", linger_ms=2.0,
+    )
+    try:
+        cand = sorted(dist_golden)
+        for s in cand[:2]:  # singletons
+            r = svc.query(s, timeout=300.0)
+            assert r.ok
+            np.testing.assert_array_equal(r.distances, dist_golden[s])
+        pending = [svc.submit(s) for s in cand]  # one coalesced burst
+        results = [p.result(300.0) for p in pending]
+        for s, r in zip(cand, results):
+            assert r.ok, (r.status, r.error)
+            np.testing.assert_array_equal(r.distances, dist_golden[s])
+        burst = []
+
+        def client(s):
+            burst.append((s, svc.query(s, timeout=300.0)))
+
+        threads = [
+            threading.Thread(target=client, args=(s,)) for s in cand * 4
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for s, r in burst:
+            assert r.ok, (r.status, r.error)
+            np.testing.assert_array_equal(r.distances, dist_golden[s])
+    finally:
+        svc.close()
+
+
+@pytest.mark.slow
+def test_serve_dist_hybrid_rung(dist_graph, dist_golden):
+    """The hybrid mesh rung (4096 lanes — the scale-26 stage's serving
+    config) behind the frontend: ladder pins to the 4096 grid and the
+    answers match the oracle."""
+    svc = BfsService(
+        dist_graph, engine="hybrid", devices=P, lanes=4096,
+        width_ladder="auto", linger_ms=1.0,
+    )
+    try:
+        assert svc.width_ladder == [4096]
+        s = sorted(dist_golden)[0]
+        r = svc.query(s, timeout=600.0)
+        assert r.ok, (r.status, r.error)
+        np.testing.assert_array_equal(r.distances, dist_golden[s])
+        assert r.devices == P and r.gteps and r.gteps > 0
+    finally:
+        svc.close()
+
+
+@pytest.mark.slow
+def test_serve_dist2d_planner_exchange(dist_graph, dist_golden):
+    """The 2D engine with the full ISSUE 7 planner exchange config (the
+    registry's spec axes: sparse + delta + sieve + predict + wire_pack)
+    serves correct answers through the frontend."""
+    svc = BfsService(
+        dist_graph, engine="dist2d", devices=P, lanes=32,
+        width_ladder="off", linger_ms=1.0, exchange="sparse",
+        wire_pack=True, delta_bits=(8, 16), sieve=True, predict=True,
+        mesh_shape=(2, 4),
+    )
+    try:
+        for s in sorted(dist_golden)[:2]:
+            r = svc.query(s, timeout=600.0)
+            assert r.ok, (r.status, r.error)
+            np.testing.assert_array_equal(r.distances, dist_golden[s])
+    finally:
+        svc.close()

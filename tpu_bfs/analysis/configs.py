@@ -104,6 +104,31 @@ def _build_engine(config: str):
 
         exchange = config.split("-", 1)[1]
         return DistHybridMsBfsEngine(g, _mesh(), exchange=exchange)
+    if config.startswith("serve-"):
+        # Distributed serving configs (ISSUE 11): built through the
+        # REGISTRY itself — the sweep then verifies the exact engine the
+        # serve tier constructs (mesh keys, exchange config, serving
+        # planes), not a hand-assembled twin.
+        from tpu_bfs.serve.registry import EngineRegistry, EngineSpec
+
+        kw = {
+            "serve-dist-wide": dict(
+                engine="wide", devices=8, lanes=64,
+                exchange="sparse", delta_bits=(8, 16),
+            ),
+            "serve-dist-hybrid": dict(
+                engine="hybrid", devices=8, lanes=4096, exchange="sparse",
+            ),
+            "serve-dist2d": dict(
+                engine="dist2d", devices=8, lanes=32, exchange="sparse",
+                delta_bits=(8, 16), sieve=True, predict=True,
+            ),
+        }.get(config)
+        if kw is None:
+            raise KeyError(config)
+        reg = EngineRegistry(capacity=1, warm=False)
+        key = reg.add_graph("g", g)
+        return reg.get(EngineSpec(graph_key=key, **kw))
     raise KeyError(config)
 
 
@@ -116,6 +141,7 @@ ALL_CONFIGS = (
     "2d-ring", "2d-allreduce", "2d-dopt", "2d-sparse", "2d-sparse-planner",
     "wide-sparse-rows", "wide-delta-rows",
     "hybrid-dense", "hybrid-sparse", "hybrid-sliced",
+    "serve-dist-wide", "serve-dist-hybrid", "serve-dist2d",
 )
 
 

@@ -56,6 +56,7 @@ utils/wirecheck.check_planned_sparse.
 
 from __future__ import annotations
 
+import threading
 from functools import partial, reduce as _reduce
 
 import jax.numpy as jnp
@@ -981,21 +982,123 @@ class RowGatherExchangeAccounting:
     bookkeeping shared by both (record + the checkpoint-resume core
     wrapper). Hosts set ``_exchange``, ``sparse_caps``, ``w``,
     ``_gather_p``, ``_gather_rows_loc``, ``_core_from_jit``, and the two
-    ``last_exchange_*`` attributes."""
+    ``last_exchange_*`` attributes.
+
+    Recording is DEFERRED (ISSUE 11): ``_record_exchange`` runs inside
+    the async dispatch half (``_core`` is called by
+    ``dispatch_packed_batch``), and the branch counters are while-loop
+    outputs — an eager ``np.asarray`` there would block dispatch on the
+    whole level loop, serializing the serve pipeline's overlap. The
+    record therefore stashes the device array and the chain bookkeeping;
+    the first reader of either ``last_exchange_*`` attribute (fetch-side
+    telemetry, engine traces, roofline) pays the transfer, by which time
+    the loop has long finished. Pending records flush strictly in
+    dispatch order, so chunked-traversal chains merge exactly as the
+    eager path did."""
+
+    def _exchange_state(self):
+        d = self.__dict__
+        if "_exchange_pending" not in d:
+            d["_exchange_pending"] = []
+            d["_exchange_flush_lock"] = threading.Lock()
+        return d
 
     def _record_exchange(
         self, branch_counts, resumed_level: int, chain_nonce=None
     ) -> None:
-        prev = gate_and_stamp_chain(self, resumed_level, chain_nonce)
-        self.last_exchange_level_counts, self.last_exchange_bytes = (
-            record_row_gather_exchange(
-                prev, branch_counts, resumed_level,
-                exchange=self._exchange, p=self._gather_p,
-                rows_loc=self._gather_rows_loc, w=self.w,
-                caps=self.sparse_caps,
-                delta_bits=getattr(self, "delta_bits", ()),
+        st = self._exchange_state()
+        with st["_exchange_flush_lock"]:
+            st["_exchange_pending"].append(
+                (branch_counts, int(resumed_level), chain_nonce)
             )
-        )
+
+    @staticmethod
+    def _counters_ready(bc) -> bool:
+        """Is a pending record's device counter array materialized (its
+        level loop finished)? Readers flush only the READY prefix: a
+        pipelined serve fetch of batch N must neither block on batch
+        N+1's still-running loop nor adopt its figures. Arrays without
+        an ``is_ready`` probe (older jax, plain numpy) count as ready —
+        the flush then blocks exactly like the pre-deferral path."""
+        probe = getattr(bc, "is_ready", None)
+        if probe is None:
+            return True
+        try:
+            return bool(probe())
+        except Exception:  # noqa: BLE001 — readiness is an optimization
+            return True
+
+    def _flush_exchange(self, *, ready_only: bool = False) -> None:
+        st = self._exchange_state()
+        with st["_exchange_flush_lock"]:
+            pending = st["_exchange_pending"]
+            if ready_only:
+                # In-order prefix: stop at the first record whose loop is
+                # still running — order is the chain-merge invariant.
+                take = 0
+                for bc, _lvl, _nonce in pending:
+                    if not self._counters_ready(bc):
+                        break
+                    take += 1
+                pending, st["_exchange_pending"] = (
+                    pending[:take], pending[take:]
+                )
+            else:
+                st["_exchange_pending"] = []
+            for bc, lvl, nonce in pending:
+                prev = chained_prev_counts(
+                    self.__dict__.get("_lec_raw"), lvl,
+                    self.__dict__.get("_exchange_chain_nonce"), nonce,
+                )
+                self.__dict__["_exchange_chain_nonce"] = nonce
+                counts, price = record_row_gather_exchange(
+                    prev, bc, lvl,
+                    exchange=self._exchange, p=self._gather_p,
+                    rows_loc=self._gather_rows_loc, w=self.w,
+                    caps=self.sparse_caps,
+                    delta_bits=getattr(self, "delta_bits", ()),
+                )
+                self.__dict__["_lec_raw"] = counts
+                self.__dict__["_leb_raw"] = price
+
+    def completed_exchange_record(self):
+        """``(counts, bytes)`` of the newest COMPLETED record, flushing
+        only pending records whose loops have finished — the serve
+        pipeline's reader: fetch of batch N must neither block on batch
+        N+1's still-running loop nor wait for it. NB when batches N and
+        N+1 both completed before the read, the newest wins — adjacent
+        batches on one engine share per-level prices, so the residual
+        misattribution is bounded telemetry noise, not a wrong model."""
+        self._flush_exchange(ready_only=True)
+        return self.__dict__.get("_lec_raw"), self.__dict__.get("_leb_raw")
+
+    @property
+    def last_exchange_level_counts(self):
+        self._flush_exchange()
+        return self.__dict__.get("_lec_raw")
+
+    @last_exchange_level_counts.setter
+    def last_exchange_level_counts(self, value) -> None:
+        # Hosts initialize to None; the roofline's trace overwrite and
+        # tests assign too. An assignment supersedes anything pending.
+        st = self._exchange_state()
+        with st["_exchange_flush_lock"]:
+            st["_exchange_pending"] = []
+            self.__dict__["_lec_raw"] = value
+
+    @property
+    def last_exchange_bytes(self):
+        self._flush_exchange()
+        return self.__dict__.get("_leb_raw")
+
+    @last_exchange_bytes.setter
+    def last_exchange_bytes(self, value) -> None:
+        # Same supersede contract as the counts setter: an assignment
+        # must not be silently overwritten by a later read's flush.
+        st = self._exchange_state()
+        with st["_exchange_flush_lock"]:
+            st["_exchange_pending"] = []
+            self.__dict__["_leb_raw"] = value
 
     def exchange_branch_labels(self) -> list[str] | None:
         """Branch labels index-aligned with the engine's counters — the

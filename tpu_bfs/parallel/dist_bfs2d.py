@@ -15,6 +15,7 @@ exchange's O(vp).
 
 from __future__ import annotations
 
+import dataclasses
 from functools import partial
 
 import jax
@@ -57,6 +58,7 @@ from tpu_bfs.parallel.collectives import (
 from tpu_bfs.obs.engine_trace import TRACE_LEVELS, assemble_dist_trace
 from tpu_bfs.parallel.dist_bfs import VertexCheckpointMixin
 from tpu_bfs.parallel.partition2d import out_csr_2d, partition_2d
+from tpu_bfs.utils.aot import AotProgramProtocol
 from tpu_bfs.utils.timing import run_timed
 
 
@@ -318,7 +320,7 @@ def _dist2d_parents_fn(mesh: Mesh, rows: int, cols: int, w: int, exchange: str):
     )
 
 
-class Dist2DBfsEngine(VertexCheckpointMixin):
+class Dist2DBfsEngine(VertexCheckpointMixin, AotProgramProtocol):
     """BFS over an R x C mesh with 2D edge partitioning.
 
     API mirrors DistBfsEngine; use for meshes large enough that the 1D
@@ -513,6 +515,19 @@ class Dist2DBfsEngine(VertexCheckpointMixin):
             ("parents", self._parents, (self.src_g, self.dst_l, d0)),
         ]
 
+    def export_programs(self):
+        """AOT inventory (ISSUE 9/11; utils/aot.py): the sharded 2D level
+        loop under the dist engines' shared ``dist_core`` name — the
+        compile a mesh replica's ``--preheat`` skips. The serve adapter
+        dispatches this exact signature (scalars included), so the
+        adopted executable's shape precheck passes on every serving
+        call."""
+        return [
+            ("dist_core", "_loop", fn, args)
+            for name, fn, args in self.analysis_programs()
+            if name == "level_loop"
+        ]
+
     def distances_padded(self, source: int, *, max_levels: int | None = None):
         frontier0, visited0, dist0 = self._init_state(source)
         ml = jnp.int32(max_levels if max_levels is not None else self.part.vp)
@@ -622,3 +637,214 @@ class Dist2DBfsEngine(VertexCheckpointMixin):
             edges_traversed=slots // 2 if undirected else slots,
             elapsed_s=elapsed,
         )
+
+
+# --- serving adapter (ISSUE 11) -------------------------------------------
+
+
+@dataclasses.dataclass
+class _Pending2D:
+    """An in-flight 2D serving batch: one async level-loop launch per
+    UNIQUE source (JAX dispatch is async; nothing host-side has blocked),
+    plus the lane -> unique-run map that rebuilds the padded batch."""
+
+    sources: np.ndarray  # [S] the padded lane sources
+    uniq: np.ndarray  # [U] unique sources actually launched
+    inv: np.ndarray  # [S] lane -> unique-run index
+    runs: list  # per-unique raw loop outputs (device)
+    stats: list  # per-unique (reached, ecc, edges) device scalars
+
+
+class Dist2DServeResult:
+    """Serving-protocol result over the unique 2D runs: lazy per-lane
+    distance extraction (one unshard per UNIQUE source, cached), with the
+    on-device ``reached``/``ecc``/``edges_traversed`` summaries the
+    executor's metadata-only path reads without ever pulling an O(V)
+    row."""
+
+    def __init__(self, part, uniq_dists, inv, sources, reached, ecc,
+                 edges):
+        self._part = part
+        self._uniq_dists = uniq_dists  # [U] device dist arrays
+        self._inv = inv
+        self.sources = sources
+        self.reached = reached  # [S] int64, lane-mapped
+        self.ecc = ecc  # [S] int32 eccentricity (levels) per lane
+        self.edges_traversed = edges  # [S] int64
+        self._cache: dict = {}
+
+    def _dist_of(self, u: int) -> np.ndarray:
+        d = self._cache.get(u)
+        if d is None:
+            d = self._part.unshard(np.asarray(self._uniq_dists[u]))
+            self._cache[u] = d
+        return d
+
+    def distances_int32(self, i: int) -> np.ndarray:
+        """[V] int32 distances of lane ``i`` (INF_DIST unreached) — the
+        2D loop labels int32 distances natively, so no plane decode."""
+        if not (0 <= i < len(self.sources)):
+            raise IndexError(i)
+        return self._dist_of(int(self._inv[i]))
+
+
+class Dist2DServeEngine:
+    """The 2D engine behind the serve executor's batch protocol.
+
+    The packed MS engines answer a ``lanes``-wide batch in ONE sharded
+    level loop; the 2D engine is single-source, so this adapter maps a
+    coalesced batch onto one async loop launch per UNIQUE source (the
+    executor pads partial batches by repeating a real source, so a
+    3-query batch padded to 32 lanes runs 3 loops, not 32). ``dispatch``
+    launches every run without blocking; ``fetch`` blocks, records the
+    exchange accounting per run, and assembles a result whose per-lane
+    views index the unique runs. ``backend='dopt'`` is the default — the
+    paper's baseline scale-26 configuration (2D edge partition +
+    direction-optimizing BFS)."""
+
+    def __init__(
+        self,
+        graph: Graph,
+        mesh: Mesh,
+        *,
+        lanes: int = 32,
+        exchange: str = "ring",
+        backend: str = "dopt",
+        wire_pack: bool = False,
+        delta_bits: tuple[int, ...] = (),
+        sieve: bool = False,
+        predict: bool = False,
+    ):
+        if lanes < 1:
+            raise ValueError(f"lanes must be >= 1, got {lanes}")
+        self.lanes = int(lanes)
+        self.engine = Dist2DBfsEngine(
+            graph, mesh, exchange=exchange, backend=backend,
+            wire_pack=wire_pack, delta_bits=delta_bits, sieve=sieve,
+            predict=predict,
+        )
+        eng = self.engine
+        self._undirected = graph.undirected
+        # Per-run on-device summaries: padded phantoms are never reached,
+        # so the reductions over the padded space equal the real-vertex
+        # figures; the sums ride GSPMD all-reduces, not host pulls.
+        part = eng.part
+        deg_pad = np.zeros(part.vp, dtype=np.uint32)
+        deg_pad[part.to_padded(np.arange(graph.num_vertices))] = (
+            graph.degrees.astype(np.uint32)
+        )
+        deg_dev = jax.device_put(deg_pad, eng._vec_sharding)
+
+        @jax.jit
+        def run_stats(dist):
+            # 32-bit on purpose (the analysis dtype lint bans 64-bit
+            # avals): reached <= V < 2^31 fits int32; the edge-slot sum
+            # rides uint32, which holds the Graph500 scale-26 slot count
+            # (2E ~ 2^31.1) — revisit past scale 27.
+            fin = dist != INT32_MAX
+            reached = jnp.sum(fin.astype(jnp.int32))
+            ecc = jnp.max(jnp.where(fin, dist, 0))
+            edges = jnp.sum(jnp.where(fin, deg_dev, jnp.uint32(0)))
+            return reached, ecc, edges
+
+        self._run_stats = run_stats
+        #: modeled off-chip bytes one chip moved for the LAST fetched
+        #: batch (summed over its unique runs) — the serve tier's
+        #: wire-bytes-per-query record.
+        self.last_exchange_bytes: float | None = None
+
+    # --- passthroughs the serve/obs/analysis layers read ------------------
+
+    @property
+    def mesh(self):
+        return self.engine.mesh
+
+    @property
+    def num_vertices(self) -> int:
+        return self.engine.part.base.num_vertices
+
+    @property
+    def last_run_trace(self):
+        return self.engine.last_run_trace
+
+    @property
+    def _aot_adopted(self):
+        return getattr(self.engine, "_aot_adopted", ())
+
+    def exchange_branch_labels(self):
+        return self.engine.exchange_branch_labels()
+
+    def wire_bytes_per_level(self):
+        return self.engine.wire_bytes_per_level()
+
+    def analysis_programs(self):
+        return self.engine.analysis_programs()
+
+    def export_programs(self):
+        return self.engine.export_programs()
+
+    def adopt_programs(self, programs: dict) -> list:
+        return self.engine.adopt_programs(programs)
+
+    # --- the dispatch/fetch serving protocol ------------------------------
+
+    def dispatch(self, sources, *, max_levels: int | None = None) -> _Pending2D:
+        eng = self.engine
+        sources = np.asarray(sources, dtype=np.int64)
+        if len(sources) > self.lanes:
+            raise ValueError(
+                f"batch of {len(sources)} exceeds {self.lanes} lanes"
+            )
+        nv = self.num_vertices
+        if sources.size and (sources.min() < 0 or sources.max() >= nv):
+            raise ValueError(f"source out of range [0, {nv})")
+        uniq, inv = np.unique(sources, return_inverse=True)
+        ml = jnp.int32(max_levels if max_levels is not None else eng.part.vp)
+        runs, stats = [], []
+        for s in uniq:
+            f0, vis0, d0 = eng._init_state(int(s))
+            out = eng._loop(
+                eng.src_g, eng.dst_l, eng.rp, eng._aux, f0, vis0, d0,
+                jnp.int32(0), ml,
+            )
+            runs.append(out)
+            stats.append(self._run_stats(out[2]))
+        return _Pending2D(sources=sources, uniq=uniq, inv=inv, runs=runs,
+                          stats=stats)
+
+    def fetch(self, pend: _Pending2D) -> Dist2DServeResult:
+        eng = self.engine
+        s_count = len(pend.sources)
+        u_count = len(pend.uniq)
+        reached_u = np.empty(u_count, dtype=np.int64)
+        ecc_u = np.empty(u_count, dtype=np.int32)
+        edges_u = np.empty(u_count, dtype=np.int64)
+        dists = []
+        wire = 0.0
+        for u, (out, st) in enumerate(zip(pend.runs, pend.stats)):
+            _, _, dist, level, front_seq, branch_counts, branch_seq = out
+            # Per-run accounting: the branch counters price this run's
+            # exchange; the LAST run's trace stands for the batch (the
+            # unified last_run_trace contract).
+            eng._record_exchange(branch_counts)
+            eng._record_trace(front_seq, branch_seq, int(level), 0)
+            wire += float(eng.last_exchange_bytes or 0.0)
+            reached_u[u] = int(st[0])
+            ecc_u[u] = int(st[1])
+            edges_u[u] = int(st[2])
+            dists.append(dist)
+        self.last_exchange_bytes = wire
+        inv = pend.inv
+        edges = edges_u[inv]
+        if self._undirected:
+            edges = edges // 2
+        return Dist2DServeResult(
+            eng.part, dists, inv, pend.sources,
+            reached_u[inv], ecc_u[inv], edges,
+        )
+
+    def run(self, sources, *, max_levels: int | None = None,
+            time_it: bool = False) -> Dist2DServeResult:
+        """Blocking batch entry (registry warm-up and one-shot callers);
+        ``time_it`` is accepted for protocol uniformity."""
+        return self.fetch(self.dispatch(sources, max_levels=max_levels))

@@ -1018,10 +1018,12 @@ class DistHybridMsBfsEngine(
             planes, vis, levels, alive, truncated, bc, gc = self._dist_core(
                 arrs, fw0, max_levels, self._lane_mask_dev
             )
-            # [P, L] per-chip skipped blocks; the chip-axis sum happens
-            # here on host — no collective was added for it (wirecheck
-            # check_gated_hybrid pins that).
-            self.last_gate_level_counts = np.asarray(gc).sum(axis=0)
+            # [P, L] per-chip skipped blocks; the chip-axis sum stays a
+            # DEVICE reduction (no collective was added for it — wirecheck
+            # check_gated_hybrid pins that) and, like the exchange
+            # counters, is not np.asarray'd here: _core runs inside the
+            # async dispatch half, and readers pay the transfer.
+            self.last_gate_level_counts = gc.sum(axis=0)
         else:
             planes, vis, levels, alive, truncated, bc = self._dist_core(
                 arrs, fw0, max_levels
@@ -1043,7 +1045,7 @@ class DistHybridMsBfsEngine(
         self._record_exchange(
             bc, int(level0), getattr(self, "_pending_chain_nonce", None)
         )
-        self.last_gate_level_counts = np.asarray(gc).sum(axis=0)
+        self.last_gate_level_counts = gc.sum(axis=0)
         return fw_f, vis_f, planes_f, level, alive
 
     def _full_parent_ell(self):

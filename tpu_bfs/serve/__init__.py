@@ -8,8 +8,11 @@ This package turns that into a long-lived query service instead of the
 one-shot CLI's fresh-process-per-query flow:
 
 - ``registry``  — load graphs once, build-and-warm engines keyed by
-  (graph, engine, lanes, pull_gate, devices) with an LRU bound, warm-up
-  hitting the persistent XLA cache (utils/compile_cache.py);
+  (graph, engine, lanes, pull_gate, devices, exchange config,
+  mesh_shape) with an LRU bound, warm-up hitting the persistent XLA
+  cache (utils/compile_cache.py); with devices > 1 the resident rungs
+  are the DISTRIBUTED engines spanning the mesh (ISSUE 11 — the 1D
+  packed MS engines and the 2D edge partition behind ``dist2d``);
 - ``scheduler`` — bounded admission queue coalescing pending single-source
   queries into one packed batch per dispatch (linger knob trades latency
   for batch fill; per-query deadlines; shed-on-overload);

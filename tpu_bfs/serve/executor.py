@@ -61,9 +61,27 @@ def pad_batch(sources: np.ndarray, lanes: int) -> tuple[np.ndarray, int]:
     return out, n
 
 
+def engine_devices(engine) -> int:
+    """The device count an engine's batches span — 1 for the single-chip
+    engines, the mesh size for the distributed ones. The breaker and the
+    degrade bookkeeping key on (width, devices): a single-chip rung
+    tripping must not blackhole the same width on the mesh path (and
+    vice versa), because the two are DIFFERENT compiled programs over
+    different device sets (ISSUE 11)."""
+    mesh = getattr(engine, "mesh", None)
+    if mesh is None:
+        return 1
+    return int(mesh.devices.size)
+
+
+def breaker_key(width: int, devices: int) -> tuple:
+    """The partition-aware breaker/degrade key: ``(width, devices)``."""
+    return (int(width), int(devices))
+
+
 class CircuitBreaker:
-    """Per-key (dispatch width) circuit breaker over DETERMINISTIC batch
-    failures.
+    """Per-key (dispatch width x device count) circuit breaker over
+    DETERMINISTIC batch failures.
 
     A rung whose every dispatch fails deterministically (wedged device
     state, a compiler bug tripped by one shape) would otherwise burn its
@@ -178,7 +196,8 @@ class PendingBatch:
     halves so the retry budget cannot double through the handoff."""
 
     __slots__ = ("engine", "queries", "n", "padded", "handle", "attempt",
-                 "lanes", "bid")
+                 "lanes", "bid", "devices", "t_dispatch", "device_ms",
+                 "wire_bytes")
 
     def __init__(self, engine, queries, n: int, padded: np.ndarray):
         self.engine = engine
@@ -191,6 +210,16 @@ class PendingBatch:
         # the device-table reference before a narrower rebuild, but the
         # service still needs the width the failure happened at.
         self.lanes = engine.lanes
+        # Mesh span of this batch's engine — half of the partition-aware
+        # breaker key, recorded here for the same clears-engine reason.
+        self.devices = engine_devices(engine)
+        # Dispatch stamp -> fetch-return duration: the batch's device
+        # occupancy, the denominator of the per-query GTEPS record.
+        self.t_dispatch: float | None = None
+        self.device_ms: float | None = None
+        # Modeled off-chip bytes the batch's traversal moved (mesh
+        # engines; None on single-chip — there is no wire).
+        self.wire_bytes: float | None = None
         # Process-wide batch ordinal: the span-correlation id every obs
         # event of this batch (and its queries) carries.
         self.bid = next(_BATCH_SEQ)
@@ -259,11 +288,14 @@ class BatchExecutor:
             for q in pending.queries:
                 if hasattr(q, "obs_batch"):
                     q.obs_batch = pending.bid
+            mesh_kw = (
+                {"devices": pending.devices} if pending.devices > 1 else {}
+            )
             rec.begin("batch", f"b{pending.bid}", cat="serve.batch",
                       batch=pending.bid, n=n, width=engine.lanes,
-                      queries=[q.id for q in pending.queries])
+                      queries=[q.id for q in pending.queries], **mesh_kw)
             rec.begin("dispatch", f"b{pending.bid}", cat="serve.batch",
-                      batch=pending.bid, width=engine.lanes)
+                      batch=pending.bid, width=engine.lanes, **mesh_kw)
         while True:
             try:
                 if _faults.ACTIVE is not None:
@@ -272,6 +304,7 @@ class BatchExecutor:
                     # engines; this one also covers test doubles).
                     _faults.ACTIVE.hit("serve_batch", lanes=engine.lanes,
                                        n=pending.n)
+                pending.t_dispatch = time.monotonic()
                 pending.handle = self._dispatch(engine, padded)
                 if rec is not None:
                     rec.end("dispatch", f"b{pending.bid}", cat="serve.batch",
@@ -311,8 +344,35 @@ class BatchExecutor:
         while True:
             try:
                 if pending.handle is None:  # re-dispatch after a retry
+                    pending.t_dispatch = time.monotonic()
                     pending.handle = self._dispatch(engine, pending.padded)
                 res = self._fetch_watched(engine, pending)
+                # The batch's device occupancy — the per-query GTEPS
+                # denominator. Under pipelining, dispatch time includes
+                # the wait behind the previous in-flight batch (one
+                # device stream), so the window is clamped to start no
+                # earlier than the previous batch's fetch-return on this
+                # engine: an approximation of the true compute window
+                # (slightly late on the start side), but it no longer
+                # double-counts the predecessor's whole runtime.
+                t_done = time.monotonic()
+                if pending.t_dispatch is not None:
+                    start = pending.t_dispatch
+                    prev_done = engine.__dict__.get("_serve_prev_fetch_done")
+                    if prev_done is not None and prev_done > start:
+                        start = prev_done
+                    pending.device_ms = (t_done - start) * 1e3
+                engine.__dict__["_serve_prev_fetch_done"] = t_done
+                # Modeled exchange bytes: the READY-only reader — fetch
+                # of batch N must not block on (or wait for) batch N+1's
+                # still-running loop. See completed_exchange_record for
+                # the bounded adjacent-batch attribution caveat.
+                taker = getattr(engine, "completed_exchange_record", None)
+                wb = (
+                    taker()[1] if taker is not None
+                    else getattr(engine, "last_exchange_bytes", None)
+                )
+                pending.wire_bytes = None if wb is None else float(wb)
                 break
             except Exception as exc:  # noqa: BLE001 — gated by the classifier
                 pending.handle = None
@@ -483,9 +543,13 @@ class BatchExecutor:
                       queries=[q.id for q in pending.queries])
         if self.breaker is not None:
             # Deterministic failures (exhausted transients included) feed
-            # the per-width breaker so routing stops paying this rung's
-            # full retry ladder per batch once it is provably broken.
-            opened = self.breaker.record_failure(pending.lanes)
+            # the per-(width, devices) breaker so routing stops paying
+            # this rung's full retry ladder per batch once it is provably
+            # broken — without blackholing the same width on a different
+            # mesh span.
+            opened = self.breaker.record_failure(
+                breaker_key(pending.lanes, pending.devices)
+            )
             if opened and rec is not None:
                 # Flight-recorder trigger: a rung going provably dark is
                 # an incident worth a replayable artifact.
@@ -499,7 +563,9 @@ class BatchExecutor:
 
     def _resolve_ok(self, pending: PendingBatch, res) -> None:
         if self.breaker is not None:
-            self.breaker.record_success(pending.engine.lanes)
+            self.breaker.record_success(
+                breaker_key(pending.engine.lanes, pending.devices)
+            )
         rec = _obs.ACTIVE
         if rec is not None:
             rec.begin("extract", f"b{pending.bid}", cat="serve.batch",
@@ -530,6 +596,15 @@ class BatchExecutor:
             if any(not getattr(q, "want_distances", True) for q in queries)
             else None
         )
+        # Per-query traversal record (ISSUE 11): the engines' on-device
+        # per-lane edge counts + the batch's device occupancy give each
+        # query its GTEPS under the batch time share; mesh engines add
+        # their modeled wire bytes, split evenly over the real queries.
+        edges_arr = getattr(res, "edges_traversed", None)
+        wire_share = (
+            pending.wire_bytes / n
+            if pending.wire_bytes is not None and n else None
+        )
         t_x0 = time.monotonic()
         latencies = []
         for i, q in enumerate(queries):
@@ -559,6 +634,12 @@ class BatchExecutor:
                 latency_ms=latency_ms,
                 batch_lanes=n,
                 dispatched_lanes=width,
+                devices=pending.devices,
+                edges=(
+                    int(edges_arr[i]) if edges_arr is not None else None
+                ),
+                device_ms=pending.device_ms,
+                wire_bytes=wire_share,
             ))
             latencies.append(latency_ms)
         extract_ms = (time.monotonic() - t_x0) * 1e3

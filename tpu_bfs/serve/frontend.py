@@ -71,15 +71,42 @@ MIN_LANES = 32
 LADDER_FACTOR = 4
 
 
-def build_width_ladder(lanes: int, ladder="auto") -> list:
+def ladder_bounds(lanes: int, *, devices: int = 1,
+                  engine: str = "wide") -> tuple[int, int]:
+    """``(floor, quantum)`` of the serving widths for this engine/mesh.
+
+    The single-chip defaults (floor 32, quantum 32) are sized for one
+    chip's lane budget; a mesh ladder must scale both (ISSUE 11):
+
+    - the HYBRID engines' dense MXU kernel takes whole 4096-lane steps
+      (single-chip and distributed alike), so both floor and quantum are
+      4096 — an auto ladder stops warming widths the engine cannot even
+      build;
+    - other mesh engines keep the 32-lane quantum but raise the floor to
+      ``32 * devices``: a whole mesh dispatching a 32-lane batch pays P
+      chips' collectives for work one chip holds in a single rung — no
+      partition benefits from rungs below that line, while the widest
+      rungs (the ones a mesh can actually hold) stay.
+    """
+    from tpu_bfs.serve.registry import HYBRID_LANE_QUANTUM
+
+    if engine == "hybrid":
+        return HYBRID_LANE_QUANTUM, HYBRID_LANE_QUANTUM
+    if devices > 1:
+        return min(lanes, MIN_LANES * devices), MIN_LANES
+    return MIN_LANES, MIN_LANES
+
+
+def build_width_ladder(lanes: int, ladder="auto", *, devices: int = 1,
+                       engine: str = "wide") -> list:
     """The service's resident widths, ascending, topped by ``lanes``.
 
     ``"auto"`` walks down from ``lanes`` by :data:`LADDER_FACTOR` to the
-    32-lane floor; ``"off"``/None serves one fixed width (the pre-ladder
+    engine/mesh floor (:func:`ladder_bounds` — 32 on one chip, scaled on
+    a mesh); ``"off"``/None serves one fixed width (the pre-ladder
     behavior, and the A/B baseline); an explicit sequence gives the rungs
-    directly (each a multiple of 32 in [32, lanes])."""
-    from tpu_bfs.algorithms._packed_common import floor_lanes
-
+    directly (each a multiple of the width quantum in [floor, lanes])."""
+    floor, quantum = ladder_bounds(lanes, devices=devices, engine=engine)
     if ladder in (None, "off"):
         return [lanes]
     if isinstance(ladder, str) and ladder != "auto":
@@ -87,16 +114,16 @@ def build_width_ladder(lanes: int, ladder="auto") -> list:
     if ladder == "auto":
         rungs = {lanes}
         w = lanes
-        while w > MIN_LANES:
-            w = floor_lanes(max(MIN_LANES, w // LADDER_FACTOR))
+        while w > floor:
+            w = max(floor, (w // LADDER_FACTOR) // quantum * quantum)
             rungs.add(w)
         return sorted(rungs)
     rungs = sorted({int(w) for w in ladder} | {lanes})
     for w in rungs:
-        if w % 32 or not (MIN_LANES <= w <= lanes):
+        if w % quantum or not (floor <= w <= lanes):
             raise ValueError(
-                f"ladder width {w} must be a multiple of 32 in "
-                f"[{MIN_LANES}, {lanes}]"
+                f"ladder width {w} must be a multiple of {quantum} in "
+                f"[{floor}, {lanes}]"
             )
     return rungs
 
@@ -109,7 +136,13 @@ class BfsService:
     thread are coalesced into packed batches of up to ``lanes`` sources
     by one scheduler thread; each batch is routed to the narrowest
     ``width_ladder`` rung that fits ("auto" builds the geometric ladder,
-    "off" pins the single fixed width). ``linger_ms`` bounds how long a
+    "off" pins the single fixed width). With ``devices > 1`` the rungs
+    are DISTRIBUTED engines spanning the mesh (ISSUE 11): wide/hybrid
+    run the 1D-partition packed MS engines, ``engine='dist2d'`` the 2D
+    edge partition; ``exchange``/``wire_pack``/``delta_bits``/``sieve``/
+    ``predict`` pick the exchange format (PRs 5/7), ``mesh_shape`` the
+    explicit RxC factorization, and the ladder floor, OOM halving grid,
+    and circuit-breaker keys all become partition-aware. ``linger_ms`` bounds how long a
     partial batch waits for fill; ``queue_cap`` bounds the backlog
     (overload sheds with REJECTED); ``deadline_ms`` (default: none)
     bounds each query's QUEUE wait — see scheduler.py for the semantics.
@@ -132,6 +165,12 @@ class BfsService:
         planes: int = DEFAULT_PLANES,
         pull_gate: bool = False,
         devices: int = 1,
+        exchange: str = "",
+        wire_pack: bool = False,
+        delta_bits=(),
+        sieve: bool = False,
+        predict: bool = False,
+        mesh_shape=(),
         width_ladder="auto",
         pipeline: bool = True,
         pipeline_depth: int = 2,
@@ -155,8 +194,17 @@ class BfsService:
         # while the extraction worker may be shrinking the ladder after a
         # fetch-time OOM.
         self._width_lock = threading.Lock()
-        self._ladder = build_width_ladder(lanes, width_ladder)  # guarded-by: _width_lock
+        self._ladder = build_width_ladder(  # guarded-by: _width_lock
+            lanes, width_ladder, devices=devices, engine=engine
+        )
         self._max_lanes = self._ladder[-1]  # guarded-by: _width_lock
+        # The engine/mesh width grid (ISSUE 11): the OOM halving ladder
+        # quantizes onto it and stops at its floor — a mesh service never
+        # degrades into widths no partition benefits from (or, for the
+        # hybrid engines, widths that cannot even build).
+        self._width_floor, self._width_quantum = ladder_bounds(
+            lanes, devices=devices, engine=engine
+        )
         # An internally-created registry must hold the WHOLE ladder
         # resident (plus one degrade-rung slot) or routing thrashes
         # rebuilds; a caller-supplied registry keeps its own policy.
@@ -178,6 +226,12 @@ class BfsService:
         self._planes = planes
         self._pull_gate = pull_gate
         self._devices = devices
+        self._exchange = exchange
+        self._wire_pack = wire_pack
+        self._delta_bits = tuple(delta_bits)
+        self._sieve = sieve
+        self._predict = predict
+        self._mesh_shape = tuple(mesh_shape)
         for w in self._ladder:
             self._spec(w).validate()  # fail at construction, not first dispatch
         self._linger_s = max(linger_ms, 0.0) / 1e3
@@ -228,6 +282,12 @@ class BfsService:
             planes=self._planes,
             pull_gate=self._pull_gate,
             devices=self._devices,
+            exchange=self._exchange,
+            wire_pack=self._wire_pack,
+            delta_bits=self._delta_bits,
+            sieve=self._sieve,
+            predict=self._predict,
+            mesh_shape=self._mesh_shape,
         )
 
     def start(self) -> "BfsService":
@@ -422,13 +482,18 @@ class BfsService:
     def _route_width(self, n: int) -> int:
         """The narrowest ladder rung that fits ``n`` queries (the cap when
         nothing does — the caller splits and re-admits the tail), skipping
-        rungs whose circuit breaker is open. When EVERY candidate is open
-        the narrowest fitting rung is used anyway — the breaker routes
-        around broken rungs, it must never wedge the service."""
+        rungs whose circuit breaker is open. Breaker keys are
+        (width, devices): this service's mesh span — a rung tripped by the
+        single-chip path never blackholes the same width here, and vice
+        versa. When EVERY candidate is open the narrowest fitting rung is
+        used anyway — the breaker routes around broken rungs, it must
+        never wedge the service."""
+        from tpu_bfs.serve.executor import breaker_key
+
         with self._width_lock:
             fits = [w for w in self._ladder if w >= n] or [self._max_lanes]
         for w in fits:
-            if self._breaker.allow(w):
+            if self._breaker.allow(breaker_key(w, self._devices)):
                 return w
         return fits[0]
 
@@ -465,10 +530,15 @@ class BfsService:
         next to the dying engines' tables, and wider rungs than an OOM'd
         width can only OOM harder. ``requeued`` is the query count the
         caller is about to re-admit, for the metrics record."""
-        from tpu_bfs.algorithms._packed_common import floor_lanes
-
         with self._width_lock:
-            new = floor_lanes(max(MIN_LANES, at_width // 2))
+            # Halve onto the engine/mesh width grid (ladder_bounds):
+            # quantized to the width quantum (4096 for the hybrid
+            # engines), floored at the mesh-scaled floor — the single-chip
+            # halving specialized to floor=quantum=32.
+            new = max(
+                self._width_floor,
+                (at_width // 2) // self._width_quantum * self._width_quantum,
+            )
             if new >= at_width:
                 # At the floor: no narrower width exists. Wider rungs can
                 # only OOM harder, so still collapse the ladder onto the
@@ -722,6 +792,20 @@ def result_to_response(r, *, with_distances: bool = True) -> dict:
         out["latency_ms"] = round(r.latency_ms, 3)
         out["batch_lanes"] = r.batch_lanes
         out["dispatched_lanes"] = r.dispatched_lanes
+        if r.devices is not None and r.devices > 1:
+            # Mesh-served responses carry the traversal-rate record
+            # (ISSUE 11): the mesh span, this query's edge count and
+            # GTEPS under the batch time share, and its share of the
+            # batch's modeled exchange bytes.
+            out["devices"] = r.devices
+            if r.edges is not None:
+                out["edges"] = r.edges
+            if r.gteps is not None:
+                # 6 significant digits, not fixed decimals: CPU-mesh
+                # figures live around 1e-5 GTEPS and must not round to 0.
+                out["gteps"] = float(f"{r.gteps:.6g}")
+            if r.wire_bytes is not None:
+                out["wire_bytes"] = round(r.wire_bytes, 1)
         if with_distances and r.distances is not None:
             out["distances_npy"] = _encode_distances(r.distances)
     else:
@@ -741,9 +825,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument("graph", help="graph file path or generator spec "
                     "(rmat:scale=20,ef=16 | random:n=...,m=...)")
     ap.add_argument("--engine", default="wide",
-                    choices=["wide", "hybrid", "packed"],
+                    choices=["wide", "hybrid", "packed", "dist2d"],
                     help="serving engine (default wide; hybrid needs "
-                    ">= 4096 lanes)")
+                    ">= 4096 lanes; dist2d is the 2D-partition mesh "
+                    "engine and needs --devices >= 2)")
     ap.add_argument("--lanes", type=int, default=512,
                     help="maximum batch width = max queries per dispatch "
                     "(multiple of 32; default 512)")
@@ -766,7 +851,30 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument("--pull-gate", action="store_true",
                     help="frontier-aware pull gate (wide/hybrid engines)")
     ap.add_argument("--devices", type=int, default=1,
-                    help="shard the engine over N devices (default 1)")
+                    help="shard the engine over N devices (default 1): "
+                    "wide/hybrid run the 1D-partition packed MS engines, "
+                    "dist2d the 2D edge partition")
+    ap.add_argument("--mesh", default=None, metavar="RxC",
+                    help="explicit 2D mesh shape for --engine dist2d "
+                    "(e.g. 2x4; default: the most-square factorization "
+                    "of --devices)")
+    ap.add_argument("--exchange", default="",
+                    help="mesh exchange family (engine default when "
+                    "omitted): dense|sparse (wide), dense|sparse|sliced "
+                    "(hybrid), ring|allreduce|sparse (dist2d)")
+    ap.add_argument("--wire-pack", action="store_true",
+                    help="bit-packed exchange wire format (ISSUE 5; mesh "
+                    "engines — a validated no-op on the packed MS "
+                    "engines, whose lane words already carry 1 bit)")
+    ap.add_argument("--sparse-delta", default=None, metavar="BITS",
+                    help="delta-encoded sparse-exchange ids (ISSUE 7), "
+                    "e.g. '8,16'; needs --exchange sparse")
+    ap.add_argument("--sparse-sieve", action="store_true",
+                    help="backward visited sieve on the dist2d sparse "
+                    "row exchange (ISSUE 7 planner)")
+    ap.add_argument("--sparse-predict", action="store_true",
+                    help="history-predictive dense selection on the "
+                    "dist2d sparse row exchange (ISSUE 7 planner)")
     ap.add_argument("--linger-ms", type=float, default=2.0,
                     help="max wait for batch fill before dispatching a "
                     "partial batch (default 2.0)")
@@ -975,6 +1083,27 @@ def run_server(args, stdin=None, stdout=None, stderr=None,
         jax.profiler.start_trace(xprof)
         log(f"jax.profiler trace started -> {xprof}")
 
+    mesh_shape = ()
+    if getattr(args, "mesh", None):
+        try:
+            r, c = (int(x) for x in str(args.mesh).lower().split("x"))
+            mesh_shape = (r, c)
+        except ValueError:
+            raise SystemExit(
+                f"--mesh must look like RxC (e.g. 2x4), got {args.mesh!r}"
+            ) from None
+    delta_raw = getattr(args, "sparse_delta", None)
+    delta_bits = ()
+    if delta_raw:
+        try:
+            delta_bits = tuple(
+                int(b) for b in str(delta_raw).replace(",", " ").split()
+            )
+        except ValueError:
+            raise SystemExit(
+                f"--sparse-delta must be comma-separated bit widths "
+                f"(e.g. 8,16), got {delta_raw!r}"
+            ) from None
     service = BfsService(
         args.graph,
         engine=args.engine,
@@ -982,6 +1111,12 @@ def run_server(args, stdin=None, stdout=None, stderr=None,
         planes=args.planes,
         pull_gate=args.pull_gate,
         devices=args.devices,
+        exchange=getattr(args, "exchange", "") or "",
+        wire_pack=getattr(args, "wire_pack", False),
+        delta_bits=delta_bits,
+        sieve=getattr(args, "sparse_sieve", False),
+        predict=getattr(args, "sparse_predict", False),
+        mesh_shape=mesh_shape,
         width_ladder=args.ladder,
         pipeline=not args.no_pipeline,
         pipeline_depth=args.pipeline_depth,
@@ -1203,7 +1338,13 @@ def run_server(args, stdin=None, stdout=None, stderr=None,
             for spec, eng in service._registry.resident_engines():
                 trace = getattr(eng, "last_run_trace", None)
                 if trace:
-                    level_traces.append((f"{spec.engine}/w{spec.lanes}", trace))
+                    # Mesh-labeled tracks: a dist rung's trace names its
+                    # device span so single-chip and mesh rungs of the
+                    # same width stay distinguishable in the viewer.
+                    label = f"{spec.engine}/w{spec.lanes}"
+                    if spec.devices > 1:
+                        label += f"/d{spec.devices}"
+                    level_traces.append((label, trace))
             try:
                 write_perfetto(
                     recorder.snapshot(), trace_out, t0=recorder.t0,

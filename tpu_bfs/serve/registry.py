@@ -3,8 +3,9 @@
 A fresh engine build costs an ELL/tile build plus an XLA compile of the
 packed level loop (~20-40 s first-compile on chip); a server cannot pay
 that per query. The registry keys resident engines by
-``(graph_key, engine, lanes, pull_gate, devices)`` — every axis that
-changes the compiled program — warms each build with one full-width
+``(graph_key, engine, lanes, pull_gate, devices, exchange config,
+mesh_shape)`` — every axis that changes the compiled program — warms
+each build with one full-width
 batch so serving dispatches never see the compile, and bounds residency
 with an LRU (each resident engine holds its packed tables in HBM, so
 "cache them all" is not an option).
@@ -28,7 +29,22 @@ from tpu_bfs import faults as _faults
 from tpu_bfs import obs as _obs
 from tpu_bfs.utils.compile_cache import enable_compile_cache
 
-ENGINE_KINDS = ("wide", "hybrid", "packed")
+ENGINE_KINDS = ("wide", "hybrid", "packed", "dist2d")
+
+# The distributed hybrid's dense MXU kernel runs on every shard, so its
+# serving widths come in whole 4096-lane steps (dist_msbfs_hybrid.LANES;
+# the single-chip hybrid shares the quantum). Kept as a literal here so
+# spec validation never imports the engine modules (they stay lazy).
+HYBRID_LANE_QUANTUM = 4096
+
+# Per-engine legal exchange families ("" = the engine's own default).
+# Mesh-only: single-chip engines run no exchange at all.
+ENGINE_EXCHANGES = {
+    "wide": ("", "dense", "sparse"),
+    "hybrid": ("", "dense", "sparse", "sliced"),
+    "dist2d": ("", "ring", "allreduce", "sparse"),
+    "packed": ("",),
+}
 
 # Serving engines default to 8 planes (254-level depth cap) where the
 # one-shot CLI defaults to 5 (32 levels): a server answers arbitrary
@@ -38,10 +54,33 @@ ENGINE_KINDS = ("wide", "hybrid", "packed")
 DEFAULT_PLANES = 8
 
 
+def mesh_shape_2d(devices: int, mesh_shape=()) -> tuple[int, int]:
+    """The (rows, cols) factorization the 2D engine serves on: an
+    explicit ``mesh_shape`` wins; otherwise the most-square factorization
+    of ``devices`` (Buluç & Madduri's 2D decomposition wants R ~ C — both
+    per-chip collective terms then shrink as O(vp/sqrt(P)))."""
+    if mesh_shape:
+        r, c = int(mesh_shape[0]), int(mesh_shape[1])
+        if r < 1 or c < 1 or r * c != devices:
+            raise ValueError(
+                f"mesh_shape {r}x{c} does not cover {devices} devices"
+            )
+        return r, c
+    r = int(np.sqrt(devices))
+    while devices % r:
+        r -= 1
+    return r, devices // r
+
+
 @dataclasses.dataclass(frozen=True)
 class EngineSpec:
     """One resident engine's identity — everything that changes the
-    compiled program or its tables."""
+    compiled program or its tables. The mesh axes (``devices``,
+    ``mesh_shape``) and the exchange configuration (``exchange``,
+    ``wire_pack``, ``delta_bits``, ``sieve``, ``predict``) are key fields
+    too: each changes the compiled collective program, so two configs can
+    never alias one resident engine (or one AOT artifact — utils/aot.py
+    keys off the same axes)."""
 
     graph_key: str
     engine: str = "wide"
@@ -49,6 +88,26 @@ class EngineSpec:
     planes: int = DEFAULT_PLANES
     pull_gate: bool = False
     devices: int = 1
+    #: exchange family ("" = engine default): wide/hybrid row gathers
+    #: (dense/sparse; hybrid also 'sliced'), dist2d row exchange
+    #: (ring/allreduce/sparse). Mesh engines only.
+    exchange: str = ""
+    #: ISSUE 5 bit-packed wire format (mesh engines; validated no-op on
+    #: the packed MS engines whose lane words already carry 1 bit).
+    wire_pack: bool = False
+    #: ISSUE 7 planner pieces (sparse exchanges only; sieve/predict are
+    #: the 1D/2D planner's — the MS row gathers take delta_bits alone).
+    delta_bits: tuple = ()
+    sieve: bool = False
+    predict: bool = False
+    #: explicit (rows, cols) for the dist2d engine; () = most-square.
+    mesh_shape: tuple = ()
+
+    def __post_init__(self):
+        # Hashability + registry-key stability: list-valued knobs arrive
+        # from argparse/env parsing; freeze them.
+        object.__setattr__(self, "delta_bits", tuple(self.delta_bits))
+        object.__setattr__(self, "mesh_shape", tuple(self.mesh_shape))
 
     def validate(self) -> None:
         if self.engine not in ENGINE_KINDS:
@@ -59,6 +118,11 @@ class EngineSpec:
             raise ValueError(
                 f"lanes must be a multiple of 32 >= 32, got {self.lanes}"
             )
+        if self.engine == "hybrid" and self.lanes % HYBRID_LANE_QUANTUM:
+            raise ValueError(
+                f"the hybrid engine's dense kernel takes whole "
+                f"{HYBRID_LANE_QUANTUM}-lane steps, got {self.lanes}"
+            )
         if self.engine == "packed" and self.pull_gate:
             raise ValueError(
                 "pull_gate applies to the wide/hybrid engines (the packed "
@@ -66,6 +130,12 @@ class EngineSpec:
             )
         if self.engine == "packed" and self.devices > 1:
             raise ValueError("the packed engine is single-device")
+        if self.engine == "dist2d" and self.devices < 2:
+            raise ValueError(
+                "the dist2d engine is the 2D-partition mesh path; "
+                "use devices >= 2 (single-chip serving has no exchange "
+                "to partition)"
+            )
         if self.engine == "wide" and self.devices > 1 and self.pull_gate:
             # Mirrors the CLI's rejection: the distributed wide engine has
             # no gate machinery — silently serving ungated would lie.
@@ -73,6 +143,46 @@ class EngineSpec:
                 "pull_gate on a mesh runs through the distributed hybrid "
                 "engine; use engine='hybrid' with devices > 1"
             )
+        if self.engine == "dist2d" and self.pull_gate:
+            raise ValueError(
+                "pull_gate gates the packed MS engines' pull expansion; "
+                "the 2D engine has no settled-mask machinery"
+            )
+        if self.devices == 1 and (
+            self.exchange or self.wire_pack or self.delta_bits
+            or self.sieve or self.predict
+        ):
+            raise ValueError(
+                "exchange/wire_pack/delta_bits/sieve/predict shape the "
+                "MESH exchanges; single-chip engines (devices=1) run none"
+            )
+        if self.exchange not in ENGINE_EXCHANGES[self.engine]:
+            raise ValueError(
+                f"exchange {self.exchange!r} is not one of "
+                f"{ENGINE_EXCHANGES[self.engine]} for engine "
+                f"{self.engine!r}"
+            )
+        if self.delta_bits and self.exchange != "sparse":
+            raise ValueError(
+                "delta_bits compresses the SPARSE exchanges' id streams; "
+                f"set exchange='sparse' (got {self.exchange!r})"
+            )
+        if (self.sieve or self.predict) and not (
+            self.engine == "dist2d" and self.exchange == "sparse"
+        ):
+            raise ValueError(
+                "sieve/predict are the 1D/2D exchange planner's pieces; "
+                "on the serve tier they apply to engine='dist2d' with "
+                "exchange='sparse' (the MS row gathers take delta_bits "
+                "only)"
+            )
+        if self.mesh_shape:
+            if self.engine != "dist2d":
+                raise ValueError(
+                    "mesh_shape picks the dist2d engine's (rows, cols); "
+                    f"engine {self.engine!r} runs a 1D mesh"
+                )
+            mesh_shape_2d(self.devices, self.mesh_shape)  # raises on mismatch
 
 
 class EngineRegistry:
@@ -215,7 +325,20 @@ class EngineRegistry:
             _faults.ACTIVE.hit("engine_build", lanes=spec.lanes)
         g = self.graph(spec.graph_key)
         t0 = time.perf_counter()
-        if spec.devices > 1:
+        if spec.engine == "dist2d":
+            from tpu_bfs.parallel.dist_bfs2d import (
+                Dist2DServeEngine,
+                make_mesh_2d,
+            )
+
+            r, c = mesh_shape_2d(spec.devices, spec.mesh_shape)
+            eng = Dist2DServeEngine(
+                g, make_mesh_2d(r, c), lanes=spec.lanes,
+                exchange=spec.exchange or "ring",
+                wire_pack=spec.wire_pack, delta_bits=spec.delta_bits,
+                sieve=spec.sieve, predict=spec.predict,
+            )
+        elif spec.devices > 1:
             from tpu_bfs.parallel.dist_bfs import make_mesh
 
             mesh = make_mesh(spec.devices)
@@ -223,7 +346,9 @@ class EngineRegistry:
                 from tpu_bfs.parallel.dist_msbfs_wide import DistWideMsBfsEngine
 
                 eng = DistWideMsBfsEngine(
-                    g, mesh, num_planes=spec.planes, lanes=spec.lanes
+                    g, mesh, num_planes=spec.planes, lanes=spec.lanes,
+                    exchange=spec.exchange or "dense",
+                    wire_pack=spec.wire_pack, delta_bits=spec.delta_bits,
                 )
             else:
                 from tpu_bfs.parallel.dist_msbfs_hybrid import (
@@ -233,6 +358,8 @@ class EngineRegistry:
                 eng = DistHybridMsBfsEngine(
                     g, mesh, num_planes=spec.planes, lanes=spec.lanes,
                     pull_gate=spec.pull_gate,
+                    exchange=spec.exchange or "dense",
+                    wire_pack=spec.wire_pack, delta_bits=spec.delta_bits,
                 )
         elif spec.engine == "packed":
             from tpu_bfs.algorithms.msbfs_packed import PackedMsBfsEngine

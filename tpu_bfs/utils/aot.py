@@ -126,10 +126,17 @@ def env_fingerprint() -> dict:
 
 def program_key(spec) -> dict:
     """Canonical store key from an EngineSpec-like (dataclass, mapping)
-    — the registry's own key axes, nothing else."""
+    — the registry's own key axes, nothing else.
+
+    The mesh-exchange axes (exchange/wire_pack/delta_bits/sieve/predict/
+    mesh_shape, ISSUE 11) enter the key ONLY when non-default: each
+    reshapes the compiled collective program, so two exchange configs
+    must never alias one artifact — while default-config keys (and their
+    digests, hence on-disk filenames) stay byte-identical to the PR 9
+    layout, so existing single-chip stores remain adoptable."""
     if dataclasses.is_dataclass(spec):
         spec = dataclasses.asdict(spec)
-    return {
+    key = {
         "graph_key": str(spec["graph_key"]),
         "engine": str(spec["engine"]),
         "lanes": int(spec["lanes"]),
@@ -137,6 +144,19 @@ def program_key(spec) -> dict:
         "pull_gate": bool(spec.get("pull_gate", False)),
         "devices": int(spec.get("devices", 1)),
     }
+    if spec.get("exchange"):
+        key["exchange"] = str(spec["exchange"])
+    if spec.get("wire_pack"):
+        key["wire_pack"] = True
+    if spec.get("delta_bits"):
+        key["delta_bits"] = [int(b) for b in spec["delta_bits"]]
+    if spec.get("sieve"):
+        key["sieve"] = True
+    if spec.get("predict"):
+        key["predict"] = True
+    if spec.get("mesh_shape"):
+        key["mesh_shape"] = [int(x) for x in spec["mesh_shape"]]
+    return key
 
 
 def _key_digest(key: dict) -> str:

@@ -1,5 +1,5 @@
 # Convenience targets; everything also works without make (README).
-.PHONY: test native bench analyze wirecheck serve-smoke serve-dist-smoke chaos-smoke obs-smoke preheat-smoke wheel clean
+.PHONY: test native bench analyze wirecheck serve-smoke serve-dist-smoke chaos-smoke mesh-chaos-smoke obs-smoke preheat-smoke wheel clean
 
 # Full suite on 8 virtual CPU devices (tests/conftest.py forces the
 # platform; the axon TPU plugin is bypassed).
@@ -101,6 +101,20 @@ serve-dist-smoke: wirecheck
 # (tests/test_chaos.py, tests/test_faults.py).
 chaos-smoke: wirecheck
 	env JAX_PLATFORMS=cpu python scripts/chaos_smoke.py
+
+# The MESH-chaos soak (README "Failure model", ISSUE 12): an injected
+# device_lost MID-QUERY on the forced 8-device CPU mesh must run the
+# degraded-mesh failover ladder (8 -> 4 devices), resume the faulted
+# queries from their level checkpoints (bounded recompute), and answer
+# every query bit-identically to the fault-free run with NO
+# client-visible error — mesh_faults/mesh_degrades/query_resumes
+# audited in the final statsz and the flight recorder dumping an
+# artifact that names the fault; plus a fleet-supervisor act (SIGKILL
+# one replica mid-stream -> requeue onto the sibling). The pytest
+# `chaos` marker runs the same machinery in-process
+# (tests/test_mesh_chaos.py, tests/test_warm_handoff.py).
+mesh-chaos-smoke: chaos-smoke
+	env JAX_PLATFORMS=cpu python scripts/mesh_chaos_smoke.py
 
 # The telemetry smoke (README "Observability"): a tracing-armed JSONL
 # server must emit a Perfetto trace holding the FULL span chain of every

@@ -31,9 +31,12 @@ TPU_BFS_BENCH_SERVE_LADDER (auto|off|'32,128,...') /
 TPU_BFS_BENCH_SERVE_PIPELINE (1) / TPU_BFS_BENCH_SERVE_ENGINE
 (wide|hybrid|packed|dist2d) / TPU_BFS_BENCH_SERVE_DEVICES ('' = 1,
 'all' = every attached device — distributed serving, ISSUE 11) /
-TPU_BFS_BENCH_SERVE_EXCHANGE / TPU_BFS_BENCH_SERVE_PULL_GATE (0) plus
-the PR 5/7 wire knobs; mesh runs add serve_gteps_p50 /
-serve_gteps_hmean / serve_wire_bytes_per_query to the verdict, and
+TPU_BFS_BENCH_SERVE_EXCHANGE / TPU_BFS_BENCH_SERVE_PULL_GATE (0) /
+TPU_BFS_BENCH_SERVE_RESUME (0 — dist2d level-checkpoint cadence K,
+ISSUE 12) plus the PR 5/7 wire knobs; mesh runs add serve_gteps_p50 /
+serve_gteps_hmean / serve_wire_bytes_per_query plus the mesh-fault
+record serve_mesh_faults/serve_mesh_degrades/serve_query_resumes/
+serve_devices_final to the verdict, and
 TPU_BFS_BENCH_VALIDATE_MODE=structure swaps the SciPy oracle for
 Graph500-style tree-property checks at oracle-infeasible scales),
 TPU_BFS_BENCH_LANES (msbfs mode, 512), TPU_BFS_BENCH_MAX_LANES (hybrid/wide
@@ -1377,11 +1380,23 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
     # serve_preheat_s land side by side in one verdict.
     aot_dir = os.environ.get("TPU_BFS_BENCH_AOT_DIR", "").strip()
 
+    # Mesh fault tolerance (ISSUE 12): TPU_BFS_BENCH_SERVE_RESUME arms
+    # the dist2d engine's level-checkpointed resume (snapshot cadence K);
+    # a device_lost injected via TPU_BFS_BENCH_FAULTS then exercises the
+    # degraded-mesh failover + resume path on chip, with the
+    # serve_mesh_faults/serve_mesh_degrades/serve_query_resumes verdict
+    # keys recording what fired.
+    resume_levels = int(os.environ.get("TPU_BFS_BENCH_SERVE_RESUME",
+                                       "0") or 0)
+    if resume_levels and engine != "dist2d":
+        log("level-checkpointed resume applies to the dist2d serve "
+            f"engine only; ignored on engine={engine!r}")
+        resume_levels = 0
     svc_kw = dict(
         engine=engine, lanes=lanes, planes=8,
         devices=devices, exchange=serve_exchange, wire_pack=wire_pack,
         delta_bits=delta_bits, sieve=sieve, predict=predict,
-        pull_gate=serve_pull_gate,
+        pull_gate=serve_pull_gate, resume_levels=resume_levels,
         width_ladder=ladder, pipeline=pipeline,
         linger_ms=2.0, queue_cap=max(1024, 2 * clients),
         watchdog_ms=watchdog_ms, log=log,
@@ -1621,6 +1636,14 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
         "serve_watchdog_trips": snap["watchdog_trips"],
         "serve_breaker_opens": snap["breaker_opens"],
         "serve_requeue_shed": snap["requeue_shed"],
+        # Mesh fault tolerance (ISSUE 12): mesh-death classifications,
+        # degraded-mesh failover rebuilds, and level-checkpointed
+        # mid-query resumes — plus the device count the stage ENDED on
+        # (< the configured mesh means a degrade happened and held).
+        "serve_mesh_faults": snap["mesh_faults"],
+        "serve_mesh_degrades": snap["mesh_degrades"],
+        "serve_query_resumes": snap.get("query_resumes", 0),
+        "serve_devices_final": snap.get("devices", devices),
         # Cold-start record (ISSUE 9): always emitted; the preheat side
         # (serve_preheat_s + aot hit/fallback audit) rides along when
         # TPU_BFS_BENCH_AOT_DIR armed the A/B.

@@ -150,6 +150,20 @@ for i in $(seq 1 "$attempts"); do
       TPU_BFS_BENCH_MODE=serve TPU_BFS_BENCH_SCALE=20 \
       TPU_BFS_BENCH_FAULTS="seed=7:transient@serve_batch:n=2,slow_extract:ms=50:n=4" \
       TPU_BFS_BENCH_SERVE_WATCHDOG_MS=600000
+    # Mesh-chaos arm (robustness, ISSUE 12): the dist2d serve stage
+    # across the full mesh with an injected device_lost MID-QUERY (the
+    # level=2 chunk of a level-checkpointed traversal; skip=1 spares the
+    # warm-up's visit). The service must run the failover ladder (full
+    # mesh -> half mesh), resume from the level checkpoints, and answer
+    # every query correctly — serve_mesh_faults/serve_mesh_degrades/
+    # serve_query_resumes ride the JSON line and serve_devices_final
+    # records the degraded width the stage ended on. ON CHIP this is the
+    # r03/r04 outage class replayed deliberately.
+    stage "mesh-chaos-s20" "$out/mesh_chaos_s20.json" \
+      TPU_BFS_BENCH_MODE=serve TPU_BFS_BENCH_SCALE=20 \
+      TPU_BFS_BENCH_SERVE_DEVICES=all TPU_BFS_BENCH_SERVE_ENGINE=dist2d \
+      TPU_BFS_BENCH_SERVE_LANES=64 TPU_BFS_BENCH_SERVE_RESUME=2 \
+      TPU_BFS_BENCH_FAULTS="seed=3:device_lost@fetch@level=2:n=1:skip=1"
     # Cold-start arm (ISSUE 9): the same serve stage with an AOT
     # artifact store armed — the cold service's warmed programs export
     # to $out/aot_store after the closed loop, a SECOND service preheats

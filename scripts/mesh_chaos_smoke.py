@@ -1,0 +1,241 @@
+"""The mesh-chaos soak (`make mesh-chaos-smoke`): an injected device
+loss MID-QUERY on the forced 8-device CPU mesh must degrade the mesh,
+resume from the level checkpoint, and answer every query correctly —
+with no client-visible error (ISSUE 12).
+
+Three acts against the real subprocess server (no monkeypatching —
+tpu_bfs/faults.py discipline):
+
+1. BASELINE — a fault-free dist2d server (8 devices, level-checkpointed
+   resume armed) answers the query set; responses are oracle-validated
+   in-process AND become the bit-identity reference. The act also pins
+   the depth assumption act 2's fault targeting rests on (levels >= 3).
+2. MESH CHAOS — the same server with ``device_lost@fetch@level=2``
+   scheduled (skip=1 spares the warm-up query's visit): the fault fires
+   mid-query at the chunk past level 2, the service degrades 8 -> 4
+   devices, the requeued queries RESUME from their snapshots, and every
+   response is bit-identical to the baseline. The final statsz must
+   show mesh_faults/mesh_degrades/query_resumes and devices=4; the
+   flight recorder must have dumped an artifact naming the mesh fault
+   and the injected device_lost.
+3. FLEET — the supervisor (scripts/fleet_supervisor.py) over two tiny
+   replicas: SIGKILL one mid-stream; every query must still answer
+   (requeue onto the sibling + health-gated replacement).
+
+Prints one JSON line (value = chaos-served query count) so
+scripts/chip_session.sh's has_value gate can drive it as a stage.
+"""
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+GRAPH = "random:n=96,m=480,seed=3"
+SOURCES = [0, 3, 5, 7, 11, 13]
+FAULTS = "seed=3:device_lost@fetch@level=2:n=1:skip=1"
+ENV = dict(
+    os.environ, JAX_PLATFORMS="cpu",
+    XLA_FLAGS="--xla_force_host_platform_device_count=8 "
+    + os.environ.get("XLA_FLAGS", ""),
+)
+
+
+def server_argv(extra):
+    return [
+        sys.executable, "-m", "tpu_bfs.serve", GRAPH,
+        "--engine", "dist2d", "--devices", "8", "--lanes", "32",
+        "--ladder", "off", "--linger-ms", "200", "--resume-levels", "1",
+        "--statsz-every", "0", *extra,
+    ]
+
+
+def log(msg):
+    print(f"[mesh-chaos-smoke] {msg}", file=sys.stderr, flush=True)
+
+
+def check(cond, msg):
+    if not cond:
+        raise SystemExit(f"FAIL: {msg}")
+    log(f"ok: {msg}")
+
+
+def run_server(extra_args, requests, *, timeout=600):
+    proc = subprocess.Popen(
+        server_argv(extra_args), stdin=subprocess.PIPE,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=ENV,
+    )
+    payload = "".join(json.dumps(r) + "\n" for r in requests)
+    t0 = time.monotonic()
+    try:
+        out, err = proc.communicate(input=payload, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        raise SystemExit(f"FAIL: server did not exit within {timeout}s")
+    responses = [json.loads(l) for l in out.splitlines() if l.strip()]
+    log(f"server exited rc={proc.returncode} in "
+        f"{time.monotonic() - t0:.1f}s with {len(responses)} responses")
+    return responses, err, proc.returncode
+
+
+def last_statsz(err: str) -> dict:
+    lines = [l for l in err.splitlines() if l.startswith("statsz ")]
+    check(lines, "final statsz line emitted")
+    return json.loads(lines[-1][len("statsz "):])
+
+
+def main() -> int:
+    from tpu_bfs.cli import load_graph
+    from tpu_bfs.reference.cpu_bfs import bfs_python
+    from tpu_bfs.serve.frontend import decode_distances
+
+    g = load_graph(GRAPH)
+    golden = {s: bfs_python(g, s)[0] for s in SOURCES}
+    reqs = [{"id": i, "source": s} for i, s in enumerate(SOURCES)]
+
+    log("act 1: fault-free baseline (dist2d, 8 devices, resume armed)")
+    base, err, rc = run_server([], reqs)
+    check(rc == 0, "baseline server exits 0")
+    check(len(base) == len(reqs) and all(r["status"] == "ok" for r in base),
+          "baseline answers every query ok")
+    for r in base:
+        import numpy as np
+
+        d = decode_distances(r["distances_npy"])
+        check(bool(np.array_equal(d, golden[r["source"]])),
+              f"baseline query {r['id']} matches the CPU oracle")
+    check(max(r["levels"] for r in base) >= 3,
+          "query set is deep enough for the level-2 fault targeting")
+    check(all(r["devices"] == 8 for r in base),
+          "baseline served from the full 8-device mesh")
+    base_by_id = {r["id"]: r for r in base}
+
+    with tempfile.TemporaryDirectory() as dump_dir:
+        log(f"act 2: device_lost mid-query ({FAULTS!r})")
+        chaos, err, rc = run_server(
+            ["--faults", FAULTS, "--obs", f"dump_dir={dump_dir}"], reqs,
+        )
+        check(rc == 0, "chaos server exits 0")
+        check(len(chaos) == len(reqs)
+              and all(r["status"] == "ok" for r in chaos),
+              "no client-visible error despite the mid-query device loss")
+        for r in chaos:
+            b = base_by_id[r["id"]]
+            check(r["distances_npy"] == b["distances_npy"]
+                  and r["levels"] == b["levels"]
+                  and r["reached"] == b["reached"],
+                  f"query {r['id']} bit-identical to the fault-free run")
+        check(any(r["devices"] == 4 for r in chaos),
+              "faulted queries were answered from the DEGRADED 4-device mesh")
+        snap = last_statsz(err)
+        check(snap.get("faults", {}).get("device_lost") == 1,
+              f"the injected device_lost is audited in statsz: "
+              f"{snap.get('faults')}")
+        check(snap.get("mesh_faults", 0) >= 1
+              and snap.get("mesh_degrades", 0) >= 1,
+              f"mesh fault + degrade counted "
+              f"(mesh_faults={snap.get('mesh_faults')}, "
+              f"mesh_degrades={snap.get('mesh_degrades')})")
+        check(snap.get("devices") == 4 and snap.get("mesh_degraded") is True,
+              "final statsz shows the degraded mesh")
+        check(snap.get("query_resumes", 0) >= 1,
+              f"level-checkpointed resume fired "
+              f"(query_resumes={snap.get('query_resumes')})")
+        dumps = sorted(glob.glob(os.path.join(dump_dir, "flightrec-*.jsonl")))
+        check(dumps, "the mesh fault triggered a flight-recorder dump")
+        blob = "".join(open(p).read() for p in dumps)
+        check("mesh_fault" in blob and "device_lost" in blob,
+              "the flight dump names the mesh fault and the injected kind")
+
+    log("act 3: fleet supervisor — SIGKILL one replica mid-stream")
+    import threading
+
+    fleet_reqs = [{"id": i, "source": SOURCES[i % len(SOURCES)]}
+                  for i in range(12)]
+    sup = subprocess.Popen(
+        [sys.executable, "scripts/fleet_supervisor.py", "--replicas", "2",
+         "--term-wait", "10", "--no-restart", "--",
+         *server_argv(["--no-distances"])],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, env=ENV,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    # Drain + forward the supervisor's stderr on a thread (an undrained
+    # pipe would wedge its log writer) and gate the kill on the fleet
+    # being READY — killing a replica mid-bring-up tests nothing.
+    fleet_ready = threading.Event()
+
+    def _pump_stderr():
+        for line in sup.stderr:
+            sys.stderr.write(line)
+            if "fleet READY" in line:
+                fleet_ready.set()
+
+    threading.Thread(target=_pump_stderr, daemon=True).start()
+    check(fleet_ready.wait(300), "fleet READY with 2 replicas")
+    # Feed half, kill one replica (a direct child of the supervisor),
+    # feed the rest: the supervisor must requeue the victim's in-flight
+    # queries onto its sibling and still answer everything.
+    for r in fleet_reqs[:6]:
+        sup.stdin.write(json.dumps(r) + "\n")
+    sup.stdin.flush()
+    try:
+        kids = subprocess.run(
+            ["pgrep", "-P", str(sup.pid), "-f", "tpu_bfs.serve"],
+            capture_output=True, text=True,
+        ).stdout.split()
+    except OSError:
+        kids = []
+    victim = int(kids[0]) if kids else None
+    check(victim is not None, "found a replica child to kill")
+    log(f"SIGKILL replica pid {victim}")
+    os.kill(victim, signal.SIGKILL)
+    for r in fleet_reqs[6:]:
+        sup.stdin.write(json.dumps(r) + "\n")
+    sup.stdin.flush()
+    out_lines = []
+
+    def _pump_stdout():
+        for line in sup.stdout:
+            out_lines.append(line)
+
+    out_t = threading.Thread(target=_pump_stdout, daemon=True)
+    out_t.start()
+    sup.stdin.close()  # EOF: the supervisor drains and exits
+    try:
+        sup.wait(timeout=600)
+    except subprocess.TimeoutExpired:
+        sup.kill()
+        raise SystemExit("FAIL: fleet supervisor hung")
+    out_t.join(timeout=10)
+    lines = [json.loads(l) for l in out_lines if l.strip()]
+    summary = [l for l in lines if "metric" in l]
+    answers = [l for l in lines if "metric" not in l]
+    check(summary and sup.returncode == 0, "fleet supervisor exits 0")
+    check(len(answers) == len(fleet_reqs),
+          f"every fleet query answered ({len(answers)}/{len(fleet_reqs)})")
+    ok = [r for r in answers if r["status"] == "ok"]
+    check(len(ok) == len(fleet_reqs),
+          "every fleet query answered OK across the replica kill")
+
+    print(json.dumps({
+        "metric": "mesh-chaos smoke (device_lost mid-query -> degraded-mesh "
+                  "failover + level-checkpointed resume + fleet kill, CPU)",
+        "value": len(chaos),
+        "unit": "queries",
+        "mesh_faults": snap.get("mesh_faults"),
+        "mesh_degrades": snap.get("mesh_degrades"),
+        "query_resumes": snap.get("query_resumes"),
+        "fleet_requeues": summary[0].get("requeues"),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

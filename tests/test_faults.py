@@ -115,6 +115,47 @@ def test_injected_errors_classify_like_the_real_thing():
     assert is_oom_failure(o.value) and not is_transient_failure(o.value)
 
 
+def test_mesh_kinds_carry_the_live_death_markers():
+    """Each ISSUE 12 mesh kind raises with its real jaxlib marker so the
+    shared classifier mesh-routes injections exactly like live slice
+    deaths (utils/recovery.is_mesh_fault)."""
+    from tpu_bfs.utils.recovery import is_mesh_fault
+
+    for kind, marker in [("device_lost", "DATA_LOSS"),
+                         ("collective_hang", "Program hung"),
+                         ("backend_restart", "slice health")]:
+        s = faults.FaultSchedule.from_spec(f"{kind}:n=1")
+        with pytest.raises(RuntimeError, match=marker) as ei:
+            s.hit("fetch", lanes=32, devices=8)
+        assert is_mesh_fault(ei.value), kind
+        assert is_transient_failure(ei.value), kind
+    assert faults.MESH_KINDS == (
+        "device_lost", "collective_hang", "backend_restart",
+    )
+
+
+def test_rank_qualifier_range_matches_meshes_containing_the_rank():
+    """``device_lost@rank=3`` follows the CHIP: any mesh with devices > 3
+    contains rank 3 and faults; a degraded 2-device mesh escapes; a site
+    with no devices context never matches."""
+    s = faults.FaultSchedule.from_spec("device_lost@fetch@rank=3:n=2")
+    s.hit("fetch", lanes=32, devices=2)  # rank 3 not in a 2-chip mesh
+    s.hit("fetch", lanes=32)  # no mesh context at all: no-op
+    with pytest.raises(RuntimeError, match="DATA_LOSS"):
+        s.hit("fetch", lanes=32, devices=8)
+    with pytest.raises(RuntimeError, match="DATA_LOSS"):
+        s.hit("fetch", lanes=32, devices=4)
+    assert s.counts() == {"device_lost": 2}
+
+
+def test_mesh_clause_round_trips():
+    spec = "seed=3:device_lost@rank=3:n=1,backend_restart@probe:n=1"
+    s = faults.FaultSchedule.from_spec(spec)
+    assert s.to_spec() == spec
+    assert s.rules[0].site == "fetch"  # mesh kinds default to fetch
+    assert s.rules[1].site == "probe"
+
+
 def test_slow_rule_sleeps_without_raising():
     s = faults.FaultSchedule.from_spec("slow_extract:ms=40:n=1")
     t0 = time.monotonic()

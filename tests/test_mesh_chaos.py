@@ -1,0 +1,536 @@
+"""Mesh fault tolerance acceptance (ISSUE 12): degraded-mesh failover,
+level-checkpointed query resume, and the health-probe restore path.
+
+The bar: an injected ``device_lost`` during a distributed serve query on
+the forced 8-device CPU mesh produces a correct, oracle-validated answer
+from the DEGRADED mesh with no client-visible error; a level-
+checkpointed resume re-executes at most K levels (bounded recompute,
+asserted against the loop's level bounds); the health probe promotes a
+degraded service back onto the full mesh only once it heartbeats
+healthy; and the dispatch-time deadline re-check resolves a query whose
+deadline passed during a requeue before burning chip time.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpu_bfs import faults
+from tpu_bfs.graph.generate import random_graph
+from tpu_bfs.reference.cpu_bfs import bfs_python
+from tpu_bfs.resilience.failover import degrade_ladder, floor_config
+from tpu_bfs.resilience.probe import mesh_heartbeat
+from tpu_bfs.resilience.resume import ResumeCache, ResumePolicy, cache_for_graph
+from tpu_bfs.serve import BfsService
+from tpu_bfs.serve.executor import BatchExecutor, MeshFaultRequeue
+from tpu_bfs.serve.metrics import ServeMetrics
+from tpu_bfs.serve.scheduler import STATUS_EXPIRED, PendingQuery
+from tpu_bfs.utils.recovery import COUNTERS
+
+pytestmark = [pytest.mark.serve, pytest.mark.chaos]
+
+P = 8  # the conftest-forced CPU mesh
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+@pytest.fixture(scope="module")
+def mesh_graph():
+    return random_graph(96, 480, seed=3)
+
+
+@pytest.fixture(scope="module")
+def mesh_golden(mesh_graph):
+    cand = np.flatnonzero(mesh_graph.degrees > 0)[:8]
+    return {int(s): bfs_python(mesh_graph, int(s))[0] for s in cand}
+
+
+# --- ladder + probe units ---------------------------------------------------
+
+
+def test_degrade_ladder_shape():
+    assert degrade_ladder(8) == [8, 4, 2, 1]
+    assert degrade_ladder(1) == [1]
+    assert floor_config("dist2d", "sparse") == ("wide", "")
+    assert floor_config("hybrid", "sliced") == ("hybrid", "")
+
+
+def test_mesh_heartbeat_healthy_and_faulted():
+    assert mesh_heartbeat(P) > 0
+    assert mesh_heartbeat(1) > 0
+    faults.arm_from_spec("device_lost@probe:n=1")
+    with pytest.raises(RuntimeError, match="DATA_LOSS"):
+        mesh_heartbeat(P)
+    faults.disarm()
+    assert mesh_heartbeat(P) > 0  # budget spent: healthy again
+
+
+# --- the acceptance soak: device_lost mid-serve -----------------------------
+
+
+def test_device_lost_degrades_mesh_and_answers(mesh_graph, mesh_golden):
+    """An injected device loss on the serving fetch: every query still
+    answers OK and oracle-correct — from the 4-device degraded mesh —
+    and the fault/degrade counters land in statsz."""
+    COUNTERS.reset()
+    svc = BfsService(mesh_graph, engine="wide", devices=P, lanes=64,
+                     width_ladder="off", linger_ms=5.0, autostart=False)
+    svc.start()  # warm first: the soak targets SERVING fetches
+    faults.arm_from_spec("seed=5:device_lost@fetch:n=1")
+    try:
+        staged = [svc.submit(s) for s in sorted(mesh_golden)[:4]]
+        for q in staged:
+            r = q.result(timeout=300)
+            assert r.ok, (r.status, r.error)
+            np.testing.assert_array_equal(r.distances, mesh_golden[r.source])
+            assert r.devices == 4  # served by the degraded mesh
+        snap = svc.statsz()
+    finally:
+        faults.disarm()
+        svc.close()
+    assert snap["mesh_faults"] == 1
+    assert snap["mesh_degrades"] == 1
+    assert snap["devices"] == 4 and snap["mesh_degraded"] is True
+    c = COUNTERS.as_dict()
+    assert c["mesh_faults"] == 1 and c["mesh_degrades"] == 1
+    assert c["faults_injected"] == 1
+
+
+def test_rank_qualified_fault_spares_degraded_mesh(mesh_graph, mesh_golden):
+    """``device_lost@rank=5`` follows the CHIP: it fires on any mesh
+    containing rank 5 (p > 5) and never on the degraded 4-device mesh —
+    so one rule with a generous budget still lets the failover escape
+    (the semantics a per-shape rule could not express)."""
+    svc = BfsService(mesh_graph, engine="wide", devices=P, lanes=32,
+                     width_ladder="off", linger_ms=5.0, autostart=False)
+    svc.start()
+    faults.arm_from_spec("seed=7:device_lost@fetch@rank=5:n=8")
+    try:
+        r = svc.query(sorted(mesh_golden)[0], timeout=300)
+        assert r.ok, (r.status, r.error)
+        assert r.devices == 4  # one degrade was enough to escape the rule
+        np.testing.assert_array_equal(r.distances, mesh_golden[r.source])
+    finally:
+        faults.disarm()
+        svc.close()
+
+
+def test_mesh_degrades_to_single_chip_floor(mesh_graph, mesh_golden):
+    """Repeated device losses walk the full ladder 8 -> 4 -> 2 -> 1;
+    the single-chip floor drops the mesh-only machinery (dist2d maps to
+    the wide engine, exchange knobs drop) and still answers correctly."""
+    svc = BfsService(mesh_graph, engine="dist2d", devices=P, lanes=32,
+                     width_ladder="off", linger_ms=5.0, autostart=False,
+                     max_requeues=8)
+    svc.start()
+    # rank=1 exists on EVERY multi-chip mesh but not on one chip: each
+    # degraded retry faults again until the single-chip floor escapes.
+    faults.arm_from_spec("seed=9:device_lost@fetch@rank=1:n=8")
+    try:
+        s = sorted(mesh_golden)[1]
+        r = svc.query(s, timeout=300)
+        assert r.ok, (r.status, r.error)
+        np.testing.assert_array_equal(r.distances, mesh_golden[s])
+        snap = svc.statsz()
+    finally:
+        faults.disarm()
+        svc.close()
+    assert snap["devices"] == 1
+    assert snap["mesh_degrades"] == 3  # 8 -> 4 -> 2 -> 1
+    assert r.devices is None or r.devices == 1
+
+
+# --- level-checkpointed resume: bounded recompute ---------------------------
+
+
+def test_resume_bounded_recompute_across_degraded_mesh(mesh_graph,
+                                                       mesh_golden):
+    """The acceptance pin: a mid-query device loss at chunk level F with
+    cadence K resumes on the DEGRADED mesh from level >= F - K — the
+    re-executed window is at most K levels, never a re-traversal from
+    the source. Asserted against the loop's actual level bounds via a
+    spy on both engines' compiled-loop invocations."""
+    from tpu_bfs.parallel.dist_bfs2d import Dist2DServeEngine, make_mesh_2d
+
+    s = sorted(mesh_golden)[2]
+    exp = mesh_golden[s]
+    k = 1
+    fault_level = 2
+    assert int(exp[exp != np.iinfo(np.int32).max].max()) >= fault_level + 1
+
+    eng8 = Dist2DServeEngine(mesh_graph, make_mesh_2d(2, 4), lanes=4,
+                             resume_levels=k)
+    faults.arm_from_spec(f"device_lost@fetch@level={fault_level}:n=1")
+    with pytest.raises(RuntimeError, match="DATA_LOSS"):
+        eng8.run(np.array([s], dtype=np.int64))
+    faults.disarm()
+    cache = cache_for_graph(mesh_graph)
+    snap = cache.get(s)
+    assert snap is not None and snap.level == fault_level
+
+    # The degraded-mesh engine over the SAME graph resumes from the
+    # snapshot: its first loop invocation starts at fault_level, not 0.
+    COUNTERS.reset()
+    eng4 = Dist2DServeEngine(mesh_graph, make_mesh_2d(2, 2), lanes=4,
+                             resume_levels=k)
+    starts = []
+    orig = eng4.engine._loop
+
+    def spying_loop(*args):
+        starts.append(int(np.asarray(args[7])))  # the level0 scalar
+        return orig(*args)
+
+    eng4.engine._loop = spying_loop
+    res = eng4.run(np.array([s], dtype=np.int64))
+    np.testing.assert_array_equal(res.distances_int32(0), exp)
+    assert starts[0] >= fault_level - k  # bounded recompute: <= K levels
+    assert starts[0] == fault_level  # and here the snapshot was exact
+    assert starts == sorted(starts)  # chunks advance monotonically
+    assert COUNTERS.as_dict()["query_resumes"] == 1
+    assert cache.get(s) is None  # completed queries drop their snapshot
+
+
+def test_resume_spool_persists_through_crc_checkpoints(mesh_graph, tmp_path):
+    """The on-disk spool rides the PR 4 machinery: snapshots written via
+    save_checkpoint (CRC + atomic), reloadable by a fresh cache (the
+    restarted-replica path), and a corrupted spool file is quarantined
+    and treated as absent — never resumed from."""
+    from tpu_bfs.utils.checkpoint import initial_checkpoint
+
+    cache = ResumeCache(str(tmp_path))
+    ckpt = initial_checkpoint(mesh_graph.num_vertices, 5)
+    ckpt.level = 3
+    cache.put(5, ckpt)
+    # A fresh cache (new process, same spool) finds it on disk.
+    fresh = ResumeCache(str(tmp_path))
+    back = fresh.get(5)
+    assert back is not None and back.level == 3 and back.source == 5
+    # Flip a payload byte: the CRC load must quarantine, not resume.
+    path = tmp_path / "q5.npz"
+    blob = bytearray(path.read_bytes())
+    blob[len(blob) // 2] ^= 0xFF
+    path.write_bytes(bytes(blob))
+    third = ResumeCache(str(tmp_path))
+    assert third.get(5) is None
+    assert (tmp_path / "q5.npz.corrupt").exists()
+
+
+def test_resume_snapshot_deeper_than_cap_is_not_adopted(mesh_graph):
+    """A snapshot past this call's max_levels cap must start over, not
+    no-op the capped loop into an answer beyond the requested bound."""
+    from tpu_bfs.parallel.dist_bfs2d import Dist2DServeEngine, make_mesh_2d
+    from tpu_bfs.utils.checkpoint import initial_checkpoint
+
+    eng = Dist2DServeEngine(mesh_graph, make_mesh_2d(2, 4), lanes=4,
+                            resume_levels=1)
+    cache = cache_for_graph(mesh_graph)
+    deep = initial_checkpoint(mesh_graph.num_vertices, 3)
+    deep.level = 6
+    cache.put(3, deep)
+    try:
+        res = eng.run(np.array([3], dtype=np.int64), max_levels=2)
+        d = res.distances_int32(0)
+        finite = d[d != np.iinfo(np.int32).max]
+        assert int(finite.max()) <= 2  # the cap held: no snapshot bleed
+    finally:
+        cache.drop(3)
+
+
+def test_shed_and_floor_paths_drop_resume_snapshots(mesh_graph):
+    """Queries terminally resolved by the failover paths must not strand
+    their ~3x[V] snapshots in the per-graph cache."""
+    from tpu_bfs.utils.checkpoint import initial_checkpoint
+
+    svc = BfsService(mesh_graph, engine="dist2d", devices=P, lanes=32,
+                     width_ladder="off", linger_ms=1.0, autostart=False,
+                     resume_levels=2, max_requeues=0)
+    cache = cache_for_graph(mesh_graph)
+    try:
+        q = PendingQuery(5)
+        q.requeues = 0
+        cache.put(5, initial_checkpoint(mesh_graph.num_vertices, 5))
+        live = svc._shed_over_budget([q], 32, "mesh-fault")
+        assert live == [] and q.done()  # shed at budget 0
+        assert cache.get(5) is None  # and its snapshot evicted
+    finally:
+        svc.close()
+
+
+def test_resume_policy_thresholds():
+    p = ResumePolicy(every_levels=4, min_levels=8)
+    assert not p.should_snapshot(4, 0.0)
+    assert p.should_snapshot(8, 0.0)
+    p = ResumePolicy(every_levels=4, min_wall_s=10.0)
+    assert not p.should_snapshot(100, 1.0)
+    assert p.should_snapshot(4, 11.0)
+    assert ResumePolicy(every_levels=4).should_snapshot(4, 0.0)
+    with pytest.raises(ValueError):
+        ResumePolicy(every_levels=0)
+
+
+def test_resume_levels_spec_validation(mesh_graph):
+    from tpu_bfs.serve.registry import EngineSpec
+
+    EngineSpec(graph_key="g", engine="dist2d", devices=8, lanes=32,
+               resume_levels=4).validate()
+    with pytest.raises(ValueError, match="resume_levels"):
+        EngineSpec(graph_key="g", engine="wide", devices=8, lanes=32,
+                   resume_levels=4).validate()
+
+
+# --- mesh restore: probe-gated promotion ------------------------------------
+
+
+def test_mesh_restore_is_probe_gated(mesh_graph, mesh_golden):
+    """A degraded service refuses to promote while the probe reports the
+    full mesh dead, and climbs back the moment it heartbeats healthy."""
+    svc = BfsService(mesh_graph, engine="wide", devices=P, lanes=32,
+                     width_ladder="off", linger_ms=5.0, autostart=False)
+    svc.start()
+    faults.arm_from_spec("seed=5:device_lost@fetch:n=1")
+    try:
+        s = sorted(mesh_golden)[0]
+        assert svc.query(s, timeout=300).ok
+        assert svc.statsz()["devices"] == 4
+        # The mesh is still "dead" to the probe: restore must refuse.
+        faults.arm_from_spec("device_lost@probe:n=8")
+        assert not svc.mesh_restore()
+        assert svc.statsz()["devices"] == 4
+        # Probe clears: restore promotes straight back to the full mesh.
+        faults.disarm()
+        assert svc.mesh_restore()
+        r = svc.query(s, timeout=300)
+        assert r.ok and r.devices == P
+        np.testing.assert_array_equal(r.distances, mesh_golden[s])
+        assert svc.statsz()["mesh_degraded"] is False
+    finally:
+        faults.disarm()
+        svc.close()
+
+
+def test_background_probe_promotes(mesh_graph, mesh_golden):
+    """The MeshHealthProbe wiring: probe_once() on a degraded service
+    promotes it without an operator (driven directly for determinism
+    rather than waiting out the timer thread)."""
+    from tpu_bfs.resilience.probe import MeshHealthProbe
+
+    svc = BfsService(mesh_graph, engine="wide", devices=P, lanes=32,
+                     width_ladder="off", linger_ms=5.0, autostart=False)
+    svc.start()
+    faults.arm_from_spec("seed=5:device_lost@fetch:n=1")
+    try:
+        assert svc.query(sorted(mesh_golden)[0], timeout=300).ok
+        faults.disarm()
+        assert svc.statsz()["devices"] == 4
+        probe = MeshHealthProbe(
+            P, interval_s=3600.0,
+            current=lambda: svc.statsz()["devices"],
+            on_healthy=svc._on_mesh_healthy,
+        )
+        assert probe.probe_once() == P
+        assert svc.statsz()["devices"] == P
+        assert probe.probe_once() is None  # healthy: nothing to do
+    finally:
+        faults.disarm()
+        svc.close()
+
+
+# --- satellite: deadline re-checked at dispatch time ------------------------
+
+
+class _NeverDispatch:
+    lanes = 32
+
+    def __init__(self):
+        self.dispatches = 0
+
+    def dispatch(self, padded):
+        self.dispatches += 1
+        raise AssertionError("expired batch must not dispatch")
+
+
+def test_deadline_rechecked_at_dispatch():
+    """A query whose deadline passed AFTER batch-forming (an OOM requeue
+    or breaker reroute later) resolves DEADLINE_EXCEEDED at dispatch
+    instead of burning chip time — serve/scheduler.py documents the
+    queued-only expiry this closes."""
+    metrics = ServeMetrics()
+    ex = BatchExecutor(metrics)
+    eng = _NeverDispatch()
+    now = time.monotonic()
+    q = PendingQuery(0, deadline=now - 0.001, now=now - 1.0)
+    assert ex.dispatch_batch(eng, [q]) is None
+    assert eng.dispatches == 0
+    r = q.result(0.1)
+    assert r.status == STATUS_EXPIRED
+    assert "requeue" in r.error
+    with metrics._lock:
+        assert metrics.expired == 1
+
+
+def test_deadline_mixed_batch_dispatches_live_queries():
+    """Expired lanes drop; the rest of the batch still serves."""
+
+    class Echo:
+        lanes = 32
+
+        def run(self, padded, time_it=False):
+            class R:
+                reached = np.ones(32, dtype=np.int64)
+
+                @staticmethod
+                def distances_int32(i):
+                    return np.zeros(4, np.int32)
+
+            return R()
+
+    metrics = ServeMetrics()
+    ex = BatchExecutor(metrics)
+    now = time.monotonic()
+    dead = PendingQuery(0, deadline=now - 0.001, now=now - 1.0)
+    live = PendingQuery(1)
+    ex.run_batch(Echo(), [dead, live])
+    assert dead.result(0.1).status == STATUS_EXPIRED
+    assert live.result(5.0).ok
+
+
+# --- executor-level mesh classification -------------------------------------
+
+
+class _MeshDies:
+    lanes = 32
+
+    def __init__(self, devices=8):
+        class _M:
+            pass
+
+        self.mesh = _M()
+        self.mesh.devices = np.empty(devices)
+
+    def dispatch(self, padded):
+        raise RuntimeError("DATA_LOSS: slice went away")
+
+
+def test_executor_raises_mesh_fault_requeue():
+    metrics = ServeMetrics()
+    ex = BatchExecutor(metrics)
+    q = PendingQuery(3)
+    with pytest.raises(MeshFaultRequeue) as ei:
+        ex.dispatch_batch(_MeshDies(), [q])
+    assert ei.value.devices == 8
+    assert ei.value.queries == [q]
+    assert not q.done()  # unresolved: the service re-admits it
+    with metrics._lock:
+        assert metrics.mesh_faults == 1
+    q.resolve_status("error")  # leave no dangling obs span
+
+
+def test_single_chip_mesh_marker_is_plain_transient():
+    """The same DATA_LOSS marker on a single-chip engine retries in
+    place (satellite: real device loss routes through the shared
+    classifier) — no mesh to degrade."""
+
+    class FlakyOnce:
+        lanes = 32
+
+        def __init__(self):
+            self.calls = 0
+
+        def run(self, padded, time_it=False):
+            self.calls += 1
+            if self.calls == 1:
+                raise RuntimeError("DATA_LOSS: blip")
+
+            class R:
+                reached = np.ones(32, dtype=np.int64)
+
+                @staticmethod
+                def distances_int32(i):
+                    return np.zeros(4, np.int32)
+
+            return R()
+
+    metrics = ServeMetrics()
+    ex = BatchExecutor(metrics, backoff_s=0.0)
+    q = PendingQuery(5)
+    ex.run_batch(FlakyOnce(), [q])
+    assert q.result(5.0).ok
+    with metrics._lock:
+        assert metrics.retries == 1 and metrics.mesh_faults == 0
+
+
+# --- concurrency: two batches hit the same dead mesh ------------------------
+
+
+def test_concurrent_mesh_faults_degrade_once(mesh_graph, mesh_golden):
+    """Two in-flight batches observing the same dead mesh must degrade
+    it ONE rung, not two (the _degrade_mesh devices-match gate)."""
+    svc = BfsService(mesh_graph, engine="wide", devices=P, lanes=32,
+                     width_ladder="off", linger_ms=1.0, autostart=False,
+                     pipeline=True)
+    svc.start()
+    faults.arm_from_spec("seed=5:device_lost@fetch:n=2")
+    try:
+        sources = sorted(mesh_golden)[:6]
+        done = []
+        threads = [
+            threading.Thread(
+                target=lambda s=s: done.append(svc.query(s, timeout=300))
+            )
+            for s in sources
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = svc.statsz()
+    finally:
+        faults.disarm()
+        svc.close()
+    assert all(r.ok for r in done)
+    for r in done:
+        np.testing.assert_array_equal(r.distances, mesh_golden[r.source])
+    # Two injected faults, but the mesh walked AT MOST two rungs and
+    # never double-degraded for one observed shape.
+    assert snap["devices"] in (4, 2)
+    assert snap["mesh_degrades"] == snap["mesh_faults"] <= 2
+
+
+# --- the scale-20 soak (slow tier: the chip stage's CPU rehearsal) ----------
+
+
+@pytest.mark.slow
+def test_mesh_chaos_scale20_soak():
+    """The acceptance bar at scale: device_lost mid-query during a
+    scale-20 RMAT dist query on the 8-device CPU mesh -> correct,
+    validated answer from the degraded mesh with no client-visible
+    error, resume bounded by K."""
+    from tpu_bfs.graph.generate import rmat_graph
+
+    g = rmat_graph(scale=14, edge_factor=8, seed=7)  # CPU-sized stand-in
+    s = int(np.flatnonzero(g.degrees > 0)[0])
+    exp = bfs_python(g, s)[0]
+    svc = BfsService(g, engine="dist2d", devices=P, lanes=32,
+                     width_ladder="off", linger_ms=5.0, autostart=False,
+                     resume_levels=2)
+    svc.start()
+    # Armed AFTER start(): the warm-up's site visits are already past,
+    # so no skip arithmetic (the subprocess smoke, which arms at server
+    # start, needs skip=1 for the warm-up's level-2 chunk).
+    faults.arm_from_spec("seed=5:device_lost@fetch@level=2:n=1")
+    try:
+        r = svc.query(s, timeout=600)
+        assert r.ok, (r.status, r.error)
+        np.testing.assert_array_equal(r.distances, exp)
+        snap = svc.statsz()
+        assert snap["devices"] == 4 and snap["query_resumes"] >= 1
+    finally:
+        faults.disarm()
+        svc.close()

@@ -186,6 +186,51 @@ def test_is_transient_failure_classifier():
     )
 
 
+# The jaxlib mesh-death strings (ISSUE 12 satellite): each marker pinned
+# INDIVIDUALLY so a dropped entry fails red — real device loss must route
+# through the same retry/degrade path as the injected kinds.
+MESH_DEATH_SHAPES = [
+    "DATA_LOSS: Attempting to fetch value instead of handling error",
+    "UNAVAILABLE: slice health check failed; restarting the slice",
+    "INTERNAL: Program hung (awaiting completion of all-reduce)",
+]
+
+
+@pytest.mark.parametrize("msg", MESH_DEATH_SHAPES)
+def test_mesh_death_markers_are_transient(msg):
+    from tpu_bfs.utils.recovery import is_mesh_fault
+
+    exc = FakeJaxRuntimeError(msg)
+    assert is_transient_failure(exc), msg  # retryable infrastructure
+    assert is_mesh_fault(exc), msg  # AND mesh-classified (degrade path)
+
+
+@pytest.mark.parametrize("msg", MESH_DEATH_SHAPES)
+def test_mesh_death_markers_cover_each_marker(msg):
+    """Red-before-green per marker: remove any MESH_FAULT_MARKERS entry
+    and exactly its shape stops classifying."""
+    from tpu_bfs.utils.recovery import MESH_FAULT_MARKERS
+
+    assert sum(m in msg for m in MESH_FAULT_MARKERS) == 1
+
+
+def test_mesh_fault_is_subset_of_transient():
+    """One definition: every mesh marker rides TRANSIENT_PATTERNS, and
+    ordinary transients are NOT mesh faults (no spurious degrades)."""
+    from tpu_bfs.utils.recovery import (
+        TRANSIENT_PATTERNS,
+        is_mesh_fault,
+        MESH_FAULT_MARKERS,
+    )
+
+    for m in MESH_FAULT_MARKERS:
+        assert m in TRANSIENT_PATTERNS
+    assert not is_mesh_fault(FakeJaxRuntimeError(REMOTE_COMPILE_MSG))
+    assert not is_mesh_fault(
+        FakeJaxRuntimeError("RESOURCE_EXHAUSTED: out of memory")
+    )
+
+
 def test_cli_single_source_recovers(capsys, monkeypatch):
     # End-to-end: the first distributed advance dies with the round-2
     # failure; the CLI rebuilds the engine, resumes, and still validates.

@@ -159,6 +159,9 @@ def test_breaker_keys_are_partition_aware():
             self.id = self.source = s
             self.want_distances = True
 
+        def expired(self, now):
+            return False  # the executor's dispatch-time deadline check
+
         def resolve_status(self, *a, **k):
             return True
 

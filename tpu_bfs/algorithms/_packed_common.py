@@ -1634,7 +1634,12 @@ def dispatch_packed_batch(
     if _faults.ACTIVE is not None:
         # Chaos-harness injection site (tpu_bfs/faults.py): the guard is
         # one attribute check, so the un-armed hot path pays nothing.
-        _faults.ACTIVE.hit("dispatch", lanes=engine.lanes)
+        # ``devices`` context lets mesh-qualified rules (device_lost@
+        # rank=K, ISSUE 12) target the distributed engines' dispatches.
+        _faults.ACTIVE.hit(
+            "dispatch", lanes=engine.lanes,
+            devices=_faults.mesh_devices(engine),
+        )
     sources = _check_batch_sources(engine, sources)
     cap = engine.max_levels_cap
     max_levels = cap if max_levels is None else min(max_levels, cap)
@@ -1665,9 +1670,13 @@ def fetch_packed_batch(
     """Block on a dispatched batch and assemble its result."""
     if _faults.ACTIVE is not None:
         # Chaos-harness injection site: slow_extract sleeps here; a
-        # transient/oom raised here surfaces on the blocking half exactly
-        # like a real async-dispatch failure (tpu_bfs/faults.py).
-        _faults.ACTIVE.hit("fetch", lanes=engine.lanes)
+        # transient/oom/mesh kind raised here surfaces on the blocking
+        # half exactly like a real async-dispatch failure
+        # (tpu_bfs/faults.py; devices context as at the dispatch site).
+        _faults.ACTIVE.hit(
+            "fetch", lanes=engine.lanes,
+            devices=_faults.mesh_devices(engine),
+        )
     levels = int(pend.levels)  # blocks until the loop finishes
     elapsed = (time.perf_counter() - pend.t0) if time_it else None
     engine._warmed = True

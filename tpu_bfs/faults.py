@@ -39,10 +39,27 @@ Spec grammar (``--faults`` / ``TPU_BFS_FAULTS``)::
     param   := "p=" FLOAT | "n=" INT | "ms=" FLOAT | "skip=" INT
     kind    := "transient" | "oom" | "slow" | "slow_extract"
              | "corrupt_ckpt" | "corrupt_aot"
+             | "device_lost" | "collective_hang" | "backend_restart"
 
 Examples::
 
     seed=7:transient@dispatch:p=0.05,oom@rung=512:n=2,slow_extract:ms=200,corrupt_ckpt:n=1
+    seed=3:device_lost@rank=3:n=1,backend_restart@probe:n=1
+
+MESH FAULT KINDS (ISSUE 12): ``device_lost`` / ``collective_hang`` /
+``backend_restart`` raise with the REAL jaxlib mesh-death markers
+(``DATA_LOSS``, "Program hung", "slice health") so the shared classifier
+(utils/recovery.is_mesh_fault) routes an injection exactly like a live
+TPU slice loss — the serve tier then runs its degraded-mesh failover
+ladder instead of a plain in-place retry. The ``rank`` qualifier is
+RANGE-matched against the site's ``devices`` context (``device_lost@
+rank=3`` fires at any mesh site whose mesh CONTAINS rank 3, i.e.
+``devices > 3``): losing chip 3 takes down every collective the 8-chip
+mesh runs, but a 2-chip mesh never had chip 3 to lose — which is exactly
+how a degraded re-dispatch escapes the same injected fault. The
+``probe`` site is the mesh health heartbeat (tpu_bfs/resilience/probe);
+a mesh kind scheduled there makes the heartbeat report the mesh dead,
+which keeps a degraded service from promoting back onto it.
 
 ``n`` bounds how many times a clause fires (default 1 when no ``p``
 given); ``p`` is a per-visit probability drawn from the schedule's own
@@ -75,10 +92,13 @@ SITES = (
     "ckpt_load",
     "advance",
     "aot_load",
+    "probe",
 )
 
 # Where a clause lands when it names no "@site". slow_extract is the
-# spec-friendly alias for slowing the blocking result half.
+# spec-friendly alias for slowing the blocking result half. The mesh
+# kinds default to fetch: async dispatch returns before any collective
+# runs, so a real mesh death surfaces at the blocking result half.
 DEFAULT_SITE = {
     "transient": "dispatch",
     "oom": "dispatch",
@@ -86,17 +106,31 @@ DEFAULT_SITE = {
     "slow_extract": "fetch",
     "corrupt_ckpt": "ckpt_save",
     "corrupt_aot": "aot_load",
+    "device_lost": "fetch",
+    "collective_hang": "fetch",
+    "backend_restart": "fetch",
 }
 KINDS = tuple(DEFAULT_SITE)
+
+#: The ISSUE 12 mesh fault kinds: injected errors carry the live jaxlib
+#: mesh-death markers (utils/recovery.MESH_FAULT_MARKERS) so detection,
+#: degrade, and resume run the exact path a real slice loss takes.
+MESH_KINDS = ("device_lost", "collective_hang", "backend_restart")
 
 # Raising kinds produce messages the shared classifier (utils/recovery.py)
 # routes like real infrastructure failures; the non-raising kinds act in
 # place (sleep / corrupt-after-write).
-_RAISING_KINDS = ("transient", "oom")
+_RAISING_KINDS = ("transient", "oom", *MESH_KINDS)
 
 # Context-qualifier aliases: "rung" reads the site's "lanes" context key
 # (the spec grammar talks about ladder rungs; the sites report widths).
 _QUAL_ALIASES = {"rung": "lanes"}
+
+# Range-matched qualifiers: "rank=K" matches when the site's mesh
+# CONTAINS rank K (ctx devices > K) — a lost chip fails every mesh that
+# includes it, while a degraded re-dispatch on a mesh too small to
+# include it escapes (the failover ladder's escape hatch).
+_QUAL_RANGES = {"rank": "devices"}
 
 
 @dataclasses.dataclass
@@ -136,6 +170,15 @@ class FaultRule:
         if site != self.site:
             return False
         for key, want in self.qual:
+            rng = _QUAL_RANGES.get(key)
+            if rng is not None:
+                # Range semantics: "rank=K" matches meshes CONTAINING
+                # rank K — the injected chip loss follows the chip, not
+                # one mesh shape, so a degraded (smaller) mesh escapes.
+                got = ctx.get(rng)
+                if got is None or int(got) <= want:
+                    return False
+                continue
             got = ctx.get(_QUAL_ALIASES.get(key, key))
             if got is None or int(got) != want:
                 return False
@@ -342,14 +385,32 @@ class FaultSchedule:
         where = f"site={site}" + "".join(
             f" {k}={v}" for k, v in sorted(ctx.items())
         )
+        tail = f"({where}, clause {raising.to_clause()!r}) [tpu_bfs.faults]"
         if raising.kind == "transient":
+            raise RuntimeError(f"INTERNAL: injected transient fault {tail}")
+        if raising.kind == "device_lost":
+            # The live jaxlib shape of a chip dropping out of the mesh
+            # (the r03/r04 bench outage class): DATA_LOSS status + the
+            # restart hint. utils/recovery.is_mesh_fault keys on it.
             raise RuntimeError(
-                f"INTERNAL: injected transient fault ({where}, "
-                f"clause {raising.to_clause()!r}) [tpu_bfs.faults]"
+                f"DATA_LOSS: injected device loss — a mesh participant "
+                f"disappeared mid-collective; the remaining replicas "
+                f"cannot complete the exchange {tail}"
+            )
+        if raising.kind == "collective_hang":
+            raise RuntimeError(
+                f"INTERNAL: injected collective hang — Program hung "
+                f"(awaiting completion of an all-reduce that a lost "
+                f"participant will never join) {tail}"
+            )
+        if raising.kind == "backend_restart":
+            raise RuntimeError(
+                f"UNAVAILABLE: injected backend restart — slice health "
+                f"check failed; the TPU runtime is restarting the slice "
+                f"{tail}"
             )
         raise RuntimeError(
-            f"RESOURCE_EXHAUSTED: injected out-of-memory fault ({where}, "
-            f"clause {raising.to_clause()!r}) [tpu_bfs.faults]"
+            f"RESOURCE_EXHAUSTED: injected out-of-memory fault {tail}"
         )
 
     def take(self, site: str, kind: str, **ctx) -> bool:
@@ -408,6 +469,16 @@ def arm_from_spec_or_env(spec: str | None,
 def disarm() -> None:
     global ACTIVE
     ACTIVE = None
+
+
+def mesh_devices(engine) -> int:
+    """Mesh span of an engine (1 when single-chip) — THE ``devices``
+    context every mesh fault site reports (rank qualifiers range-match
+    on it), and the partition-aware half of the serve breaker key
+    (serve/executor.engine_devices delegates here). One definition so
+    the rank-qualifier semantics cannot drift between sites."""
+    mesh = getattr(engine, "mesh", None)
+    return 1 if mesh is None else int(mesh.devices.size)
 
 
 def corruption_offset(path: str) -> int:

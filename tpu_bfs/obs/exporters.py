@@ -121,6 +121,7 @@ _COUNTER_KEYS = frozenset((
     "retries", "oom_degrades", "requeued", "watchdog_trips",
     "requeue_shed", "padded_lanes_total", "breaker_opens",
     "lanes_used", "lanes_offered",
+    "mesh_faults", "mesh_degrades", "query_resumes", "resume_snapshots",
 ))
 
 

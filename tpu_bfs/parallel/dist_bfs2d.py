@@ -16,6 +16,7 @@ exchange's O(vp).
 from __future__ import annotations
 
 import dataclasses
+import time
 from functools import partial
 
 import jax
@@ -646,13 +647,20 @@ class Dist2DBfsEngine(VertexCheckpointMixin, AotProgramProtocol):
 class _Pending2D:
     """An in-flight 2D serving batch: one async level-loop launch per
     UNIQUE source (JAX dispatch is async; nothing host-side has blocked),
-    plus the lane -> unique-run map that rebuilds the padded batch."""
+    plus the lane -> unique-run map that rebuilds the padded batch.
+
+    With level-checkpointed resume armed (ISSUE 12), ``cursors`` carries
+    each run's chunk state — the launched chunk's start level, the chain
+    nonce, and the drive's wall-clock origin — and ``stats`` holds None
+    until the final chunk completes in ``fetch``."""
 
     sources: np.ndarray  # [S] the padded lane sources
     uniq: np.ndarray  # [U] unique sources actually launched
     inv: np.ndarray  # [S] lane -> unique-run index
     runs: list  # per-unique raw loop outputs (device)
     stats: list  # per-unique (reached, ecc, edges) device scalars
+    cursors: list | None = None  # per-unique chunk state (resume mode)
+    total_cap: int = 0  # absolute level cap of the whole query
 
 
 class Dist2DServeResult:
@@ -700,7 +708,18 @@ class Dist2DServeEngine:
     exchange accounting per run, and assembles a result whose per-lane
     views index the unique runs. ``backend='dopt'`` is the default — the
     paper's baseline scale-26 configuration (2D edge partition +
-    direction-optimizing BFS)."""
+    direction-optimizing BFS).
+
+    ``resume_levels=K`` (ISSUE 12) arms LEVEL-CHECKPOINTED RESUME: each
+    run drives the SAME compiled loop K levels at a time (new level
+    bounds, no retrace) and snapshots its carry at every chunk boundary
+    into the process-wide per-graph resume cache
+    (tpu_bfs/resilience/resume — host real-id checkpoints through the
+    PR 4 CRC machinery, portable across mesh shapes). A later dispatch
+    of the same source — e.g. the service's re-admission after a mesh
+    fault, on an engine rebuilt over a DEGRADED mesh — starts from the
+    last intact level instead of the source: bounded recompute <= K
+    levels. Completed runs drop their snapshots."""
 
     def __init__(
         self,
@@ -714,10 +733,27 @@ class Dist2DServeEngine:
         delta_bits: tuple[int, ...] = (),
         sieve: bool = False,
         predict: bool = False,
+        resume_levels: int = 0,
     ):
         if lanes < 1:
             raise ValueError(f"lanes must be >= 1, got {lanes}")
+        if resume_levels < 0:
+            raise ValueError(
+                f"resume_levels must be >= 0, got {resume_levels}"
+            )
         self.lanes = int(lanes)
+        self.resume_levels = int(resume_levels)
+        if resume_levels:
+            from tpu_bfs.resilience.resume import (
+                ResumePolicy,
+                cache_for_graph,
+            )
+
+            self._resume = ResumePolicy(every_levels=int(resume_levels))
+            self._resume_cache = cache_for_graph(graph)
+        else:
+            self._resume = None
+            self._resume_cache = None
         self.engine = Dist2DBfsEngine(
             graph, mesh, exchange=exchange, backend=backend,
             wire_pack=wire_pack, delta_bits=delta_bits, sieve=sieve,
@@ -788,8 +824,23 @@ class Dist2DServeEngine:
 
     # --- the dispatch/fetch serving protocol ------------------------------
 
+    @property
+    def _devices_n(self) -> int:
+        from tpu_bfs.faults import mesh_devices
+
+        return mesh_devices(self)
+
     def dispatch(self, sources, *, max_levels: int | None = None) -> _Pending2D:
+        from tpu_bfs import faults as _faults
+
         eng = self.engine
+        if _faults.ACTIVE is not None:
+            # Mesh-site chaos consultation (ISSUE 12): device_lost /
+            # collective_hang / backend_restart rules target this
+            # engine's launches; devices context feeds rank qualifiers.
+            _faults.ACTIVE.hit(
+                "dispatch", lanes=self.lanes, devices=self._devices_n
+            )
         sources = np.asarray(sources, dtype=np.int64)
         if len(sources) > self.lanes:
             raise ValueError(
@@ -799,22 +850,131 @@ class Dist2DServeEngine:
         if sources.size and (sources.min() < 0 or sources.max() >= nv):
             raise ValueError(f"source out of range [0, {nv})")
         uniq, inv = np.unique(sources, return_inverse=True)
-        ml = jnp.int32(max_levels if max_levels is not None else eng.part.vp)
+        total_cap = int(max_levels if max_levels is not None else eng.part.vp)
         runs, stats = [], []
+        if self._resume is None:
+            for s in uniq:
+                f0, vis0, d0 = eng._init_state(int(s))
+                out = eng._loop(
+                    eng.src_g, eng.dst_l, eng.rp, eng._aux, f0, vis0, d0,
+                    jnp.int32(0), jnp.int32(total_cap),
+                )
+                runs.append(out)
+                stats.append(self._run_stats(out[2]))
+            return _Pending2D(sources=sources, uniq=uniq, inv=inv,
+                              runs=runs, stats=stats, total_cap=total_cap)
+        # Resume mode: launch each run's FIRST chunk async (K levels);
+        # fetch drives the remaining chunks. A source with an intact
+        # snapshot — typically left by a mesh-faulted predecessor engine
+        # over the same graph — starts from its last checkpointed level.
+        from tpu_bfs.utils.checkpoint import _new_nonce
+
+        k = self._resume.every_levels
+        cursors = []
         for s in uniq:
-            f0, vis0, d0 = eng._init_state(int(s))
+            s = int(s)
+            start, nonce = 0, _new_nonce()
+            f0 = vis0 = d0 = None
+            ckpt = self._resume_cache.get(s)
+            if (
+                ckpt is not None and ckpt.source == s
+                and len(ckpt.frontier) == nv
+                # A snapshot DEEPER than this call's level cap cannot be
+                # adopted: the capped loop would no-op and hand back
+                # levels/distances beyond the requested bound. Start
+                # over instead (max_levels-capped calls are the one-shot
+                # API's; the serve path always runs to termination).
+                and int(ckpt.level) <= total_cap
+            ):
+                fh, vh, dh = eng._pad_state(ckpt)
+                put = partial(jax.device_put, device=eng._vec_sharding)
+                f0, vis0, d0 = put(fh), put(vh), put(dh)
+                start = int(ckpt.level)
+                nonce = ckpt.nonce
+                self._resume_cache.mark_resumed(s)
+            if f0 is None:
+                f0, vis0, d0 = eng._init_state(s)
+            cap = min(start + k, total_cap)
             out = eng._loop(
                 eng.src_g, eng.dst_l, eng.rp, eng._aux, f0, vis0, d0,
-                jnp.int32(0), ml,
+                jnp.int32(start), jnp.int32(cap),
             )
             runs.append(out)
-            stats.append(self._run_stats(out[2]))
+            stats.append(None)  # final-chunk stats land in fetch
+            cursors.append({
+                "source": s, "start": start, "nonce": nonce,
+                "t0": time.monotonic(),
+            })
         return _Pending2D(sources=sources, uniq=uniq, inv=inv, runs=runs,
-                          stats=stats)
+                          stats=stats, cursors=cursors, total_cap=total_cap)
+
+    def _drive_chunks(self, pend: _Pending2D, u: int):
+        """Complete run ``u``: block each chunk, snapshot the carry at
+        chunk boundaries (the resume cache's CRC-checkpoint machinery),
+        relaunch from the DEVICE outputs (no host round trip for the
+        carry itself), and return the final ``(loop outputs, stats)``.
+        A mesh kind injected at the fetch site fires here mid-query —
+        after >= 1 snapshot — so the failover's re-dispatch proves the
+        bounded-recompute contract."""
+        from tpu_bfs import faults as _faults
+        from tpu_bfs.utils.checkpoint import BfsCheckpoint
+
+        eng = self.engine
+        cur = pend.cursors[u]
+        k = self._resume.every_levels
+        out = pend.runs[u]
+        clock0 = cur["t0"]
+        while True:
+            if _faults.ACTIVE is not None:
+                # ``level`` context = the in-flight chunk's start level,
+                # so a schedule can target "the chunk after level N"
+                # deterministically (scripts/mesh_chaos_smoke.py).
+                _faults.ACTIVE.hit(
+                    "fetch", lanes=self.lanes, devices=self._devices_n,
+                    level=cur["start"],
+                )
+            frontier, visited, dist, level, front_seq, bc, bs = out
+            level_i = int(level)  # blocks until the chunk finishes
+            eng._record_exchange(
+                bc, resumed_level=cur["start"], chain_nonce=cur["nonce"]
+            )
+            eng._record_trace(
+                front_seq, bs, level_i - cur["start"], cur["start"]
+            )
+            f_host = np.asarray(frontier)
+            if not f_host.any() or level_i >= pend.total_cap:
+                self._resume_cache.drop(cur["source"])
+                return out, self._run_stats(dist)
+            if self._resume.should_snapshot(
+                level_i, time.monotonic() - clock0
+            ):
+                part = eng.part
+                self._resume_cache.put(cur["source"], BfsCheckpoint(
+                    source=cur["source"], level=level_i,
+                    frontier=part.unshard(f_host),
+                    visited=part.unshard(np.asarray(visited)),
+                    distance=part.unshard(np.asarray(dist)),
+                    nonce=cur["nonce"],
+                ))
+            cur["start"] = level_i
+            out = eng._loop(
+                eng.src_g, eng.dst_l, eng.rp, eng._aux,
+                frontier, visited, dist,
+                jnp.int32(level_i),
+                jnp.int32(min(level_i + k, pend.total_cap)),
+            )
 
     def fetch(self, pend: _Pending2D) -> Dist2DServeResult:
+        from tpu_bfs import faults as _faults
+
+        if _faults.ACTIVE is not None:
+            # The blocking half's mesh-site consultation (no ``level``
+            # context here — the chunked drive below consults per chunk
+            # for level-targeted rules).
+            _faults.ACTIVE.hit(
+                "fetch", lanes=self.lanes, devices=self._devices_n
+            )
         eng = self.engine
-        s_count = len(pend.sources)
         u_count = len(pend.uniq)
         reached_u = np.empty(u_count, dtype=np.int64)
         ecc_u = np.empty(u_count, dtype=np.int32)
@@ -822,12 +982,19 @@ class Dist2DServeEngine:
         dists = []
         wire = 0.0
         for u, (out, st) in enumerate(zip(pend.runs, pend.stats)):
-            _, _, dist, level, front_seq, branch_counts, branch_seq = out
-            # Per-run accounting: the branch counters price this run's
-            # exchange; the LAST run's trace stands for the batch (the
-            # unified last_run_trace contract).
-            eng._record_exchange(branch_counts)
-            eng._record_trace(front_seq, branch_seq, int(level), 0)
+            if pend.cursors is not None:
+                # Chunked resume drive: accounting is recorded per chunk
+                # inside (chain-nonce-merged across chunks, so
+                # last_exchange_* covers the whole query).
+                out, st = self._drive_chunks(pend, u)
+                dist = out[2]
+            else:
+                _, _, dist, level, front_seq, branch_counts, branch_seq = out
+                # Per-run accounting: the branch counters price this
+                # run's exchange; the LAST run's trace stands for the
+                # batch (the unified last_run_trace contract).
+                eng._record_exchange(branch_counts)
+                eng._record_trace(front_seq, branch_seq, int(level), 0)
             wire += float(eng.last_exchange_bytes or 0.0)
             reached_u[u] = int(st[0])
             ecc_u[u] = int(st[1])

@@ -1,0 +1,40 @@
+"""Mesh-level fault tolerance (ISSUE 12).
+
+The 2D-partition serving design (Buluç & Madduri, arXiv:1104.4518)
+assumes a healthy mesh for every collective; this repo already lost
+bench rounds r03/r04 to exactly the outage class that breaks that
+assumption (utils/recovery.py records the live failure string). This
+package holds the pieces that turn a mesh death from a client-visible
+INTERNAL error + wedged replica into an automatic degrade-and-resume:
+
+- :mod:`tpu_bfs.resilience.probe` — the mesh health heartbeat (a tiny
+  all-reduce per replica) and the background prober that promotes a
+  degraded service back onto the full mesh once it heartbeats healthy;
+- :mod:`tpu_bfs.resilience.failover` — the degraded-mesh ladder (full
+  mesh -> half mesh -> single chip) and the engine-kind mapping each
+  rung serves with;
+- :mod:`tpu_bfs.resilience.resume` — level-checkpointed query resume:
+  long distributed queries snapshot their loop carry every K levels
+  through the PR 4 CRC checkpoint machinery, so a mid-query mesh fault
+  resumes from the last intact level on the degraded mesh instead of
+  re-traversing from the source.
+
+Detection lives with the shared classifier
+(``utils/recovery.is_mesh_fault`` over ``MESH_FAULT_MARKERS``); the
+serve-tier wiring (MeshFaultRequeue, the service's ``_degrade_mesh``)
+lives in ``tpu_bfs/serve``; injection (``device_lost`` /
+``collective_hang`` / ``backend_restart`` kinds) in ``tpu_bfs/faults``.
+"""
+
+from tpu_bfs.resilience.failover import degrade_ladder, next_mesh_rung
+from tpu_bfs.resilience.probe import MeshHealthProbe, mesh_heartbeat
+from tpu_bfs.resilience.resume import ResumeCache, ResumePolicy
+
+__all__ = [
+    "MeshHealthProbe",
+    "ResumeCache",
+    "ResumePolicy",
+    "degrade_ladder",
+    "mesh_heartbeat",
+    "next_mesh_rung",
+]

@@ -1,0 +1,59 @@
+"""The degraded-mesh failover ladder (ISSUE 12).
+
+A mesh fault invalidates every collective the dead mesh shape runs, but
+the graph tables, the registry, and the PR 9 AOT artifacts for SMALLER
+meshes are all intact — so the right response is not a restart but a
+rebuild one rung down: full mesh -> half mesh -> ... -> single chip.
+Each rung halves the device count, so the ladder composes with the
+serve tier's existing machinery unchanged:
+
+- the width ladder re-derives from ``serve.frontend.ladder_bounds`` at
+  the new device count (mesh floors shrink with the mesh);
+- the circuit breaker is already keyed ``(width, devices)``, so routing
+  around the dead mesh shape needs no new state — the fault feeds the
+  old keys, the degraded dispatches use new ones;
+- AOT artifacts are keyed on ``devices`` too (utils/aot.program_key),
+  so a fleet that exported the degraded shapes ahead of time makes the
+  degraded rebuild an ADOPT, not a 40 s recompile.
+
+The single-chip rung has no exchange to partition: ``floor_config``
+maps a mesh engine config onto its single-chip equivalent (the 2D
+serve engine becomes the wide packed MS engine; exchange knobs drop).
+"""
+
+from __future__ import annotations
+
+
+def degrade_ladder(devices: int) -> list[int]:
+    """The mesh rungs a ``devices``-wide service can fail over across,
+    descending: full mesh, then successive halvings, down to one chip.
+    ``degrade_ladder(8) == [8, 4, 2, 1]``; a single chip has nowhere
+    further to go (``[1]``)."""
+    if devices < 1:
+        raise ValueError(f"devices must be >= 1, got {devices}")
+    rungs = []
+    d = int(devices)
+    while d >= 1:
+        rungs.append(d)
+        if d == 1:
+            break
+        d //= 2
+    return rungs
+
+
+def next_mesh_rung(devices: int) -> int | None:
+    """The rung below ``devices`` (None at the single-chip floor)."""
+    ladder = degrade_ladder(devices)
+    return ladder[1] if len(ladder) > 1 else None
+
+
+def floor_config(engine: str, exchange: str) -> tuple[str, str]:
+    """``(engine, exchange)`` for a mesh engine config degraded to ONE
+    chip. The 1D-partition MS engines (wide/hybrid) have single-chip
+    twins under the same name; the 2D serve engine is mesh-only, so its
+    single-chip rung serves through the wide packed MS engine (any
+    engine over the same graph answers identically — the cross-engine
+    fuzz bar). Exchange families describe MESH collectives and drop."""
+    if engine == "dist2d":
+        return "wide", ""
+    return engine, ""

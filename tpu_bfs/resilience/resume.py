@@ -1,0 +1,205 @@
+"""Level-checkpointed query resume (ISSUE 12).
+
+The longest, most expensive queries run across the widest meshes —
+where a device loss is most likely and most costly. Re-traversing a
+deep scale-26 query from its source after a mid-query mesh fault throws
+away every completed level; instead, long distributed queries snapshot
+their loop carry every K levels through the PR 4 CRC checkpoint
+machinery (utils/checkpoint: atomic writes, payload CRC32, quarantine
+on corruption), so a fault resumes from the last intact level on the
+DEGRADED mesh — checkpoints are real-vertex-id [V] arrays, portable
+across mesh shapes and partition topologies by construction
+(parallel.dist_bfs.VertexCheckpointMixin), which is exactly what makes
+cross-mesh resume an array reshard instead of a migration.
+
+Bounded recompute: a query that faulted at level F with snapshot
+cadence K re-executes at most ``F - last_snapshot_level <= K`` levels
+(proven in tests/test_mesh_chaos.py).
+
+The cache is process-wide and keyed by GRAPH OBJECT (weakly — entries
+die with the graph) then source: the degraded rebuild constructs a new
+engine over the SAME registry-resident graph, so its dispatches find
+the old engine's snapshots without any handoff plumbing. With a spool
+directory configured (``set_default_dir`` / ``TPU_BFS_RESUME_DIR`` /
+``tpu-bfs-serve --resume-dir``) every snapshot is also persisted via
+``save_checkpoint`` — CRC-verified on load, corrupt files quarantined
+``.corrupt`` — so a replica restart (the fleet supervisor's drain path)
+can resume too, not just an in-process mesh degrade.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import weakref
+
+#: Process-wide spool directory for on-disk snapshot persistence
+#: (None = in-memory only). Read at ResumeCache construction.
+_DEFAULT_DIR: str | None = os.environ.get("TPU_BFS_RESUME_DIR") or None
+_DIR_LOCK = threading.Lock()
+
+
+def set_default_dir(path: str | None) -> None:
+    """Set the spool directory newly created caches persist through
+    (the ``--resume-dir`` flag's hook); None reverts to memory-only."""
+    global _DEFAULT_DIR
+    with _DIR_LOCK:
+        _DEFAULT_DIR = path or None
+
+
+@dataclasses.dataclass(frozen=True)
+class ResumePolicy:
+    """When and how often a long query snapshots its loop carry.
+
+    ``every_levels`` (K) is the snapshot cadence AND the level-loop
+    chunk size: the driving engine runs the loop K levels at a time and
+    snapshots at each boundary once the query qualifies as long —
+    ``min_levels`` completed levels OR ``min_wall_s`` elapsed wall time
+    (either threshold; 0 disables that arm). K bounds the recompute a
+    mid-query fault can cost; the chunking itself re-dispatches the
+    SAME compiled loop with new level bounds (no retrace)."""
+
+    every_levels: int
+    min_levels: int = 0
+    min_wall_s: float = 0.0
+
+    def __post_init__(self):
+        if self.every_levels < 1:
+            raise ValueError(
+                f"every_levels must be >= 1, got {self.every_levels}"
+            )
+
+    def should_snapshot(self, level: int, elapsed_s: float) -> bool:
+        """Snapshot at this chunk boundary? (The cadence itself is the
+        chunk size; this gates only the long-query thresholds.)"""
+        if self.min_levels and level >= self.min_levels:
+            return True
+        if self.min_wall_s and elapsed_s >= self.min_wall_s:
+            return True
+        return not self.min_levels and not self.min_wall_s
+
+
+class ResumeCache:
+    """Thread-safe source -> latest-checkpoint store for one graph.
+
+    ``put``/``get``/``drop`` are the engine-facing API; entries are
+    host ``BfsCheckpoint``s (real-id [V] arrays — mesh-portable). With
+    a spool ``root`` each put also writes ``q<source>.npz`` through the
+    PR 4 atomic+CRC save; ``get`` falls back to disk when memory has no
+    entry (a restarted replica), and a corrupt spool file is quarantined
+    by the loader and treated as absent — resume integrity must never
+    be worse than starting over."""
+
+    def __init__(self, root: str | None = None, *, log=None):
+        self._log = log or (lambda msg: None)
+        self.root = root
+        if root:
+            os.makedirs(root, exist_ok=True)
+        self._lock = threading.Lock()
+        self._entries: dict = {}  # guarded-by: _lock — source -> ckpt
+        self.snapshots = 0  # guarded-by: _lock
+        self.resumes = 0  # guarded-by: _lock
+
+    def _path(self, source: int) -> str:
+        return os.path.join(self.root, f"q{int(source)}.npz")
+
+    def put(self, source: int, ckpt) -> None:
+        with self._lock:
+            self._entries[int(source)] = ckpt
+            self.snapshots += 1
+        if self.root:
+            from tpu_bfs.utils.checkpoint import save_checkpoint
+
+            try:
+                save_checkpoint(self._path(source), ckpt)
+            except OSError as exc:
+                # Spool persistence is an optimization over the
+                # in-memory copy; a full disk must not fail the query.
+                self._log(f"resume spool write failed ({exc!r}); "
+                          f"keeping the in-memory snapshot only")
+
+    def get(self, source: int):
+        """The latest snapshot for ``source`` (None when there is none
+        or the only copy on disk failed its CRC)."""
+        with self._lock:
+            ckpt = self._entries.get(int(source))
+        if ckpt is not None or not self.root:
+            return ckpt
+        from tpu_bfs.utils.checkpoint import (
+            CorruptCheckpointError,
+            load_checkpoint,
+        )
+
+        path = self._path(source)
+        if not os.path.exists(path):
+            return None
+        try:
+            ckpt = load_checkpoint(path)
+        except CorruptCheckpointError as exc:
+            # Already quarantined (.corrupt) by the loader: resume from
+            # level 0 rather than from poisoned state.
+            self._log(f"resume spool entry corrupt ({exc}); starting over")
+            return None
+        except (OSError, ValueError) as exc:
+            self._log(f"resume spool read failed ({exc!r}); starting over")
+            return None
+        with self._lock:
+            self._entries[int(source)] = ckpt
+        return ckpt
+
+    def mark_resumed(self, source: int) -> None:
+        """Account one mid-query resume (the engine calls this when a
+        dispatch starts from a cached level instead of the source)."""
+        from tpu_bfs.utils.recovery import COUNTERS
+
+        with self._lock:
+            self.resumes += 1
+        COUNTERS.bump("query_resumes")
+
+    def drop(self, source: int) -> None:
+        """Forget ``source``'s snapshot (its query completed)."""
+        with self._lock:
+            self._entries.pop(int(source), None)
+        if self.root:
+            try:
+                os.unlink(self._path(source))
+            except OSError:
+                pass
+
+    def counts(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "snapshots": self.snapshots,
+                "resumes": self.resumes,
+            }
+
+
+# One cache per live graph object: the degraded rebuild's engine is
+# constructed over the same registry-resident graph, so it finds the
+# failed engine's snapshots here with no explicit handoff. Keyed by
+# id() with a weakref finalizer (Graph holds ndarrays and is not
+# hashable; the identity check below makes id reuse after gc harmless).
+_GRAPH_CACHES: dict = {}  # guarded-by: _CACHE_LOCK — id -> (ref, cache)
+# RLock: the weakref finalizer below may fire from a gc triggered while
+# this thread already holds the lock inside cache_for_graph.
+_CACHE_LOCK = threading.RLock()
+
+
+def cache_for_graph(graph, *, log=None) -> ResumeCache:
+    key = id(graph)
+    with _CACHE_LOCK:
+        ent = _GRAPH_CACHES.get(key)
+        if ent is not None and ent[0]() is graph:
+            return ent[1]
+        with _DIR_LOCK:
+            root = _DEFAULT_DIR
+        cache = ResumeCache(root, log=log)
+
+        def _drop(_ref, _key=key):
+            with _CACHE_LOCK:
+                _GRAPH_CACHES.pop(_key, None)
+
+        _GRAPH_CACHES[key] = (weakref.ref(graph, _drop), cache)
+        return cache

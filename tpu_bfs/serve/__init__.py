@@ -38,6 +38,14 @@ SIGTERM/SIGINT (admission stops, in-flight batches flush, queued queries
 resolve SHUTDOWN, final statsz emitted), and the whole failure surface is
 exercised by the deterministic chaos harness (tpu_bfs/faults.py,
 ``--faults`` / TPU_BFS_FAULTS) — see README "Failure model".
+
+Mesh fault tolerance (ISSUE 12, tpu_bfs/resilience): a mesh-death error
+on a multi-chip batch (``utils/recovery.is_mesh_fault``) runs the
+degraded-mesh failover ladder — the service rebuilds its rungs on a
+halved mesh, re-admits the batch's queries, and (``resume_levels=K``,
+dist2d) resumes them from their level checkpoints; the health probe
+promotes back onto the full mesh once it heartbeats healthy, and
+scripts/fleet_supervisor.py supervises N replicas of the whole thing.
 """
 
 from tpu_bfs.serve.executor import CircuitBreaker  # noqa: F401

@@ -33,9 +33,15 @@ import numpy as np
 
 from tpu_bfs import faults as _faults
 from tpu_bfs import obs as _obs
-from tpu_bfs.serve.scheduler import STATUS_ERROR, STATUS_OK, QueryResult
+from tpu_bfs.serve.scheduler import (
+    STATUS_ERROR,
+    STATUS_EXPIRED,
+    STATUS_OK,
+    QueryResult,
+)
 from tpu_bfs.utils.recovery import (
     COUNTERS,
+    is_mesh_fault,
     is_oom_failure,
     is_transient_failure,
 )
@@ -67,11 +73,10 @@ def engine_devices(engine) -> int:
     degrade bookkeeping key on (width, devices): a single-chip rung
     tripping must not blackhole the same width on the mesh path (and
     vice versa), because the two are DIFFERENT compiled programs over
-    different device sets (ISSUE 11)."""
-    mesh = getattr(engine, "mesh", None)
-    if mesh is None:
-        return 1
-    return int(mesh.devices.size)
+    different device sets (ISSUE 11). One definition shared with the
+    fault sites' ``devices`` context (faults.mesh_devices) so the
+    rank-qualifier semantics and the breaker keys cannot drift."""
+    return _faults.mesh_devices(engine)
 
 
 def breaker_key(width: int, devices: int) -> tuple:
@@ -176,14 +181,34 @@ class CircuitBreaker:
 _BATCH_SEQ = itertools.count(1)
 
 
-class OomRequeue(Exception):
-    """Internal signal: the batch OOM'd; its queries ride along for the
-    service to degrade the lane count and re-admit."""
+class BatchRequeue(Exception):
+    """Base of the internal batch-outcome signals that leave queries
+    UNRESOLVED and ride up to the service: re-admission policy (which
+    width, which mesh) is the service's call, not the executor's. Both
+    pipeline halves close their open spans on any subclass."""
 
     def __init__(self, queries, cause: BaseException):
         super().__init__(str(cause))
         self.queries = queries
         self.cause = cause
+
+
+class OomRequeue(BatchRequeue):
+    """The batch OOM'd; its queries ride along for the service to
+    degrade the lane count and re-admit."""
+
+
+class MeshFaultRequeue(BatchRequeue):
+    """The batch's MESH died under it (device loss / hung collective /
+    backend restart — utils/recovery.is_mesh_fault): retrying on the
+    same mesh shape would re-dispatch into the same dead collective, so
+    the queries ride up for the service to rebuild the ladder one mesh
+    rung down (ISSUE 12's failover ladder) and re-admit. ``devices``
+    records the mesh span the fault hit."""
+
+    def __init__(self, queries, cause: BaseException, devices: int):
+        super().__init__(queries, cause)
+        self.devices = devices
 
 
 class PendingBatch:
@@ -274,6 +299,29 @@ class BatchExecutor:
         a dispatch-time OOM — the only outcome that leaves the queries
         unresolved, because re-admission at a narrower width is the
         service's call, not the executor's."""
+        # Deadline re-check at DISPATCH time: batch-forming already
+        # expired queued queries, but a query can reach this point again
+        # long after that check — an OOM requeue, a breaker reroute, or
+        # a mesh-degrade re-admission — and burning chip time on an
+        # answer its client stopped waiting for helps nobody.
+        now = time.monotonic()
+        live = []
+        expired = 0
+        for q in queries:
+            if q.expired(now):
+                if q.resolve_status(
+                    STATUS_EXPIRED,
+                    error="deadline expired before dispatch "
+                          "(after requeue/reroute)",
+                ):
+                    expired += 1
+            else:
+                live.append(q)
+        if expired:
+            self.metrics.record_expired(expired)
+        if not live:
+            return None
+        queries = live
         sources = np.asarray([q.source for q in queries], dtype=np.int64)
         padded, n = pad_batch(sources, engine.lanes)
         pending = PendingBatch(engine, queries, n, padded)
@@ -313,14 +361,17 @@ class BatchExecutor:
             except Exception as exc:  # noqa: BLE001 — gated by the classifier
                 try:
                     retry = self._classify_failure(pending, exc)
-                except OomRequeue:
-                    # The OOM rides up to the service's requeue ladder;
-                    # the open dispatch span must not dangle in the trace
-                    # (the classifier already ended the batch span).
+                except BatchRequeue as brq:
+                    # The OOM/mesh-fault rides up to the service's
+                    # requeue ladder; the open dispatch span must not
+                    # dangle in the trace (the classifier already ended
+                    # the batch span).
                     if rec is not None:
                         rec.end("dispatch", f"b{pending.bid}",
                                 cat="serve.batch", batch=pending.bid,
-                                oom=True)
+                                **({"oom": True}
+                                   if isinstance(brq, OomRequeue)
+                                   else {"mesh_fault": True}))
                     raise
                 if not retry:
                     if rec is not None:
@@ -378,12 +429,15 @@ class BatchExecutor:
                 pending.handle = None
                 try:
                     retry = self._classify_failure(pending, exc)
-                except OomRequeue:
+                except BatchRequeue as brq:
                     # Same discipline as the dispatch half: close the
-                    # open fetch span before the OOM rides up.
+                    # open fetch span before the OOM/mesh-fault rides up.
                     if rec is not None:
                         rec.end("fetch", f"b{pending.bid}", cat="serve.batch",
-                                batch=pending.bid, oom=True)
+                                batch=pending.bid,
+                                **({"oom": True}
+                                   if isinstance(brq, OomRequeue)
+                                   else {"mesh_fault": True}))
                     raise
                 if not retry:
                     if rec is not None:
@@ -518,6 +572,40 @@ class BatchExecutor:
                 rec.end("batch", f"b{pending.bid}", cat="serve.batch",
                         batch=pending.bid, oom=True)
             raise OomRequeue(list(pending.queries), exc) from exc
+        if pending.devices > 1 and is_mesh_fault(exc):
+            # A mesh-death marker on a MESH-spanning batch (ISSUE 12):
+            # the whole mesh shape is suspect, so an in-place retry
+            # would re-dispatch into the same dead collective. Feed the
+            # (width, devices) breaker — routing stops offering the dead
+            # mesh shape while its probe half-opens — and hand the
+            # queries up for the degraded-mesh rebuild. Single-chip
+            # batches with the same markers fall through to the plain
+            # transient retry below (nothing to degrade).
+            err = f"{type(exc).__name__}: {str(exc)[:200]}"
+            COUNTERS.bump("mesh_faults")
+            self.metrics.record_mesh_fault()
+            self._log(
+                f"MESH FAULT on a {pending.devices}-device batch "
+                f"(width {pending.lanes}): {err} — degrading the mesh"
+            )
+            if self.breaker is not None:
+                self.breaker.record_failure(
+                    breaker_key(pending.lanes, pending.devices)
+                )
+            if rec is not None:
+                # Flight-recorder trigger (every mesh-fault firing):
+                # the run-up to a slice death is exactly what the ring
+                # buffer exists to replay.
+                rec.event("mesh_fault", cat="serve.batch",
+                          batch=pending.bid, width=pending.lanes,
+                          devices=pending.devices, error=err,
+                          queries=[q.id for q in pending.queries])
+                rec.end("batch", f"b{pending.bid}", cat="serve.batch",
+                        batch=pending.bid, mesh_fault=True)
+                rec.flight_dump("mesh_fault")
+            raise MeshFaultRequeue(
+                list(pending.queries), exc, pending.devices
+            ) from exc
         if is_transient_failure(exc) and pending.attempt < self.max_retries:
             pending.attempt += 1
             wait = min(self.backoff_s * pending.attempt, self.backoff_cap_s)

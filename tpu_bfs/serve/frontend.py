@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import argparse
 import base64
+import dataclasses
 import io
 import json
 import os
@@ -45,7 +46,13 @@ import numpy as np
 
 from tpu_bfs import faults as _faults
 from tpu_bfs import obs as _obs
-from tpu_bfs.serve.executor import BatchExecutor, CircuitBreaker, OomRequeue
+from tpu_bfs.resilience.failover import floor_config, next_mesh_rung
+from tpu_bfs.serve.executor import (
+    BatchExecutor,
+    CircuitBreaker,
+    MeshFaultRequeue,
+    OomRequeue,
+)
 from tpu_bfs.serve.metrics import ServeMetrics
 from tpu_bfs.serve.registry import DEFAULT_PLANES, EngineRegistry, EngineSpec
 from tpu_bfs.serve.scheduler import (
@@ -58,6 +65,7 @@ from tpu_bfs.serve.scheduler import (
 )
 from tpu_bfs.utils.recovery import (
     COUNTERS,
+    is_mesh_fault,
     is_oom_failure,
     is_transient_failure,
 )
@@ -128,6 +136,47 @@ def build_width_ladder(lanes: int, ladder="auto", *, devices: int = 1,
     return rungs
 
 
+@dataclasses.dataclass(frozen=True)
+class MeshServeConfig:
+    """The service's CURRENT engine/mesh configuration — everything
+    ``_spec`` stamps into a registry key. One immutable object swapped
+    atomically (the ``_closed``/``_draining`` lock-free-flag idiom):
+    the mesh failover ladder (ISSUE 12) replaces it wholesale when a
+    mesh fault degrades the service to a smaller device count, and the
+    health probe swaps it back, so every reader sees a consistent
+    config with no lock on the routing hot path."""
+
+    engine: str
+    devices: int
+    exchange: str
+    wire_pack: bool
+    delta_bits: tuple
+    sieve: bool
+    predict: bool
+    mesh_shape: tuple
+    resume_levels: int
+
+    def degraded(self, new_devices: int) -> "MeshServeConfig":
+        """This config one mesh rung down. At the single-chip floor the
+        exchange knobs drop and mesh-only engines map to their
+        single-chip equivalent (resilience.failover.floor_config); a
+        still-multi-chip rung keeps the exchange family (the compiled
+        collective program is rebuilt for the smaller mesh)."""
+        if new_devices == 1:
+            engine, exchange = floor_config(self.engine, self.exchange)
+            return MeshServeConfig(
+                engine=engine, devices=1, exchange=exchange,
+                wire_pack=False, delta_bits=(), sieve=False, predict=False,
+                mesh_shape=(), resume_levels=0,
+            )
+        return dataclasses.replace(
+            self, devices=new_devices,
+            # An explicit RxC factorization described the FULL mesh;
+            # the degraded shape re-derives most-square.
+            mesh_shape=(),
+        )
+
+
 class BfsService:
     """Long-lived lane-batching BFS query service over one graph.
 
@@ -142,7 +191,16 @@ class BfsService:
     edge partition; ``exchange``/``wire_pack``/``delta_bits``/``sieve``/
     ``predict`` pick the exchange format (PRs 5/7), ``mesh_shape`` the
     explicit RxC factorization, and the ladder floor, OOM halving grid,
-    and circuit-breaker keys all become partition-aware. ``linger_ms`` bounds how long a
+    and circuit-breaker keys all become partition-aware. A MESH FAULT
+    (device loss / hung collective / backend restart —
+    utils/recovery.is_mesh_fault) runs the failover ladder (ISSUE 12):
+    the service rebuilds its rungs on a halved mesh (down to one chip),
+    re-admits the failed batch's queries, and — with
+    ``mesh_probe_interval_s > 0`` — heartbeats the wider rungs in the
+    background, promoting back once the mesh is healthy again;
+    ``resume_levels=K`` (dist2d) adds level-checkpointed resume so the
+    re-admitted queries continue from their last snapshot instead of
+    the source. ``linger_ms`` bounds how long a
     partial batch waits for fill; ``queue_cap`` bounds the backlog
     (overload sheds with REJECTED); ``deadline_ms`` (default: none)
     bounds each query's QUEUE wait — see scheduler.py for the semantics.
@@ -171,6 +229,8 @@ class BfsService:
         sieve: bool = False,
         predict: bool = False,
         mesh_shape=(),
+        resume_levels: int = 0,
+        mesh_probe_interval_s: float = 0.0,
         width_ladder="auto",
         pipeline: bool = True,
         pipeline_depth: int = 2,
@@ -222,16 +282,25 @@ class BfsService:
             self._graph_key = f"graph@{id(graph):x}"
             self._registry.add_graph(self._graph_key, graph)
         self._graph = self._registry.graph(self._graph_key)
-        self._engine_kind = engine
         self._planes = planes
         self._pull_gate = pull_gate
-        self._devices = devices
-        self._exchange = exchange
-        self._wire_pack = wire_pack
-        self._delta_bits = tuple(delta_bits)
-        self._sieve = sieve
-        self._predict = predict
-        self._mesh_shape = tuple(mesh_shape)
+        # The CURRENT engine/mesh config: one immutable object swapped
+        # atomically by the mesh failover ladder (degrade) and the
+        # health probe (restore) — see MeshServeConfig. _cfg0 is the
+        # as-launched config a restore climbs back to; _ladder_arg lets
+        # the degraded width ladder re-derive from the operator's
+        # original intent at the new device count (topped by the
+        # current _max_lanes so an OOM cap survives the failover).
+        self._mesh_cfg = self._cfg0 = MeshServeConfig(
+            engine=engine, devices=devices, exchange=exchange,
+            wire_pack=bool(wire_pack), delta_bits=tuple(delta_bits),
+            sieve=bool(sieve), predict=bool(predict),
+            mesh_shape=tuple(mesh_shape),
+            resume_levels=int(resume_levels),
+        )
+        self._ladder_arg = width_ladder
+        self._mesh_probe_interval_s = max(mesh_probe_interval_s, 0.0)
+        self._mesh_probe = None  # guarded-by: _lock (lifecycle state)
         for w in self._ladder:
             self._spec(w).validate()  # fail at construction, not first dispatch
         self._linger_s = max(linger_ms, 0.0) / 1e3
@@ -274,20 +343,23 @@ class BfsService:
 
     # --- lifecycle --------------------------------------------------------
 
-    def _spec(self, width: int | None = None) -> EngineSpec:
+    def _spec(self, width: int | None = None,
+              cfg: MeshServeConfig | None = None) -> EngineSpec:
+        cfg = self._mesh_cfg if cfg is None else cfg
         return EngineSpec(
             graph_key=self._graph_key,
-            engine=self._engine_kind,
+            engine=cfg.engine,
             lanes=self.lanes if width is None else width,
             planes=self._planes,
             pull_gate=self._pull_gate,
-            devices=self._devices,
-            exchange=self._exchange,
-            wire_pack=self._wire_pack,
-            delta_bits=self._delta_bits,
-            sieve=self._sieve,
-            predict=self._predict,
-            mesh_shape=self._mesh_shape,
+            devices=cfg.devices,
+            exchange=cfg.exchange,
+            wire_pack=cfg.wire_pack,
+            delta_bits=cfg.delta_bits,
+            sieve=cfg.sieve,
+            predict=cfg.predict,
+            mesh_shape=cfg.mesh_shape,
+            resume_levels=cfg.resume_levels,
         )
 
     def start(self) -> "BfsService":
@@ -305,6 +377,22 @@ class BfsService:
             for w in sorted(self.width_ladder, reverse=True):
                 if w <= self.lanes:  # rungs above a degraded cap died
                     self._acquire_engine(w)
+            if (self._mesh_probe_interval_s > 0
+                    and self._cfg0.devices > 1
+                    and self._mesh_probe is None):
+                from tpu_bfs.resilience.probe import MeshHealthProbe
+
+                # Background mesh prober: heartbeats the rungs above a
+                # degraded service and promotes back onto the widest
+                # healthy one — the half-open side of the failover
+                # ladder (no-op while the service is at full width).
+                self._mesh_probe = MeshHealthProbe(
+                    self._cfg0.devices,
+                    interval_s=self._mesh_probe_interval_s,
+                    current=lambda: self._mesh_cfg.devices,
+                    on_healthy=self._on_mesh_healthy,
+                    log=self._log,
+                ).start()
             if self._pipe_q is not None:
                 self._extract_thread = threading.Thread(
                     target=self._extract_loop, name="bfs-serve-extract",
@@ -334,6 +422,9 @@ class BfsService:
             self._closed = True
             thread = self._thread
             extract_thread = self._extract_thread
+            probe, self._mesh_probe = self._mesh_probe, None
+        if probe is not None:
+            probe.stop()
         self._queue.stop()
         if thread is not None:
             thread.join()
@@ -424,11 +515,24 @@ class BfsService:
         """Service-level observations beyond the metrics counters —
         merged into both the statsz() snapshot and the JSONL server's
         periodic/final statsz lines."""
+        cfg = self._mesh_cfg
         out = {
             "breaker_open": self._breaker.open_keys(),
             "breaker_opens": self._breaker.opens,
             "draining": self._draining,
+            # Mesh failover state (ISSUE 12): the CURRENT device count
+            # (shrinks on degrade, recovers on restore) and the
+            # level-checkpointed resume audit when armed.
+            "devices": cfg.devices,
         }
+        if self._cfg0.devices > 1:
+            out["mesh_degraded"] = cfg.devices < self._cfg0.devices
+        if cfg.resume_levels:
+            from tpu_bfs.resilience.resume import cache_for_graph
+
+            counts = cache_for_graph(self._graph).counts()
+            out["query_resumes"] = counts["resumes"]
+            out["resume_snapshots"] = counts["snapshots"]
         store = self._registry.aot_store
         if store is not None:
             # AOT preheat visibility: artifact hits vs JIT fallbacks —
@@ -492,8 +596,9 @@ class BfsService:
 
         with self._width_lock:
             fits = [w for w in self._ladder if w >= n] or [self._max_lanes]
+        devices = self._mesh_cfg.devices
         for w in fits:
-            if self._breaker.allow(breaker_key(w, self._devices)):
+            if self._breaker.allow(breaker_key(w, devices)):
                 return w
         return fits[0]
 
@@ -510,6 +615,23 @@ class BfsService:
             except Exception as exc:  # noqa: BLE001 — gated by classifiers
                 if is_oom_failure(exc) and self._degrade(width):
                     continue
+                devices = self._mesh_cfg.devices
+                if devices > 1 and is_mesh_fault(exc):
+                    # A mesh death during the BUILD/warm-up itself (the
+                    # engine's first collectives run in the warm batch):
+                    # degrade the mesh and rebuild on the smaller shape
+                    # instead of retrying into the same dead collective.
+                    COUNTERS.bump("mesh_faults")
+                    self.metrics.record_mesh_fault()
+                    rec = _obs.ACTIVE
+                    if rec is not None:
+                        rec.event("mesh_fault", cat="serve.mesh",
+                                  site="engine_build", devices=devices,
+                                  error=f"{type(exc).__name__}: "
+                                        f"{str(exc)[:120]}")
+                        rec.flight_dump("mesh_fault")
+                    if self._degrade_mesh(devices, exc):
+                        continue
                 if is_transient_failure(exc) and attempt < self._max_retries:
                     attempt += 1
                     self.metrics.record_retry()
@@ -571,15 +693,26 @@ class BfsService:
                       to_width=new, requeued=requeued)
         return True
 
-    def _handle_batch_oom(self, queries, at_width: int, cause) -> None:
-        """Degrade below the OOM'd width and re-admit, or resolve with
-        explicit errors at the floor. Shared by the dispatch half (the
-        scheduler thread) and the fetch half (the extraction worker).
+    def _drop_resume_snapshots(self, queries) -> None:
+        """Evict resume snapshots for queries that will never complete a
+        resumable drive — terminally resolved (shed / floor errors) or
+        re-admitted onto a config without resume (the single-chip
+        floor). Without this their ~3x[V] host arrays (and spool files)
+        would pin the per-graph cache for the process lifetime; dropping
+        is always safe (resume degrades to starting over)."""
+        if not self._cfg0.resume_levels:
+            return
+        from tpu_bfs.resilience.resume import cache_for_graph
 
-        Re-admission carries a BOUNDED attempt budget (``max_requeues``):
-        a query whose every attempted rung keeps OOMing resolves with an
-        explicit error naming its attempt history instead of cycling
-        through the ladder forever."""
+        cache = cache_for_graph(self._graph)
+        for q in queries:
+            cache.drop(q.source)
+
+    def _shed_over_budget(self, queries, at_width: int, why: str) -> list:
+        """The bounded re-admission budget shared by the OOM and mesh
+        failover paths: count this attempt on every query, resolve the
+        over-budget ones with their attempt history, return the live
+        rest."""
         live = []
         shed = 0
         for q in queries:
@@ -589,7 +722,7 @@ class BfsService:
                 if q.resolve_status(
                     STATUS_ERROR,
                     error=(
-                        f"requeue budget exhausted: {q.requeues} OOM "
+                        f"requeue budget exhausted: {q.requeues} {why} "
                         f"re-admissions (attempted widths "
                         f"{q.attempt_widths}) — every remaining rung is "
                         f"failing"
@@ -599,6 +732,9 @@ class BfsService:
             else:
                 live.append(q)
         if shed:
+            self._drop_resume_snapshots(
+                [q for q in queries if q not in live]
+            )
             self._log(f"shed {shed} queries at the requeue budget "
                       f"({self._max_requeues})")
             COUNTERS.bump("requeue_sheds", shed)
@@ -612,7 +748,18 @@ class BfsService:
                 rec.event("requeue_shed", cat="serve.batch", shed=shed,
                           width=at_width)
                 rec.flight_dump("requeue_shed")
-        queries = live
+        return live
+
+    def _handle_batch_oom(self, queries, at_width: int, cause) -> None:
+        """Degrade below the OOM'd width and re-admit, or resolve with
+        explicit errors at the floor. Shared by the dispatch half (the
+        scheduler thread) and the fetch half (the extraction worker).
+
+        Re-admission carries a BOUNDED attempt budget (``max_requeues``):
+        a query whose every attempted rung keeps OOMing resolves with an
+        explicit error naming its attempt history instead of cycling
+        through the ladder forever."""
+        queries = self._shed_over_budget(queries, at_width, "OOM")
         if not queries:
             # Still account the degrade attempt below even when every
             # query shed: the rung DID fail, and routing must move off it.
@@ -637,12 +784,210 @@ class BfsService:
             f"({at_width}): {str(cause)[:200]}"
         )
         self._log(err)
+        self._drop_resume_snapshots(queries)
         n = 0
         for q in queries:
             if q.resolve_status(STATUS_ERROR, error=err):
                 n += 1
         if n:
             self.metrics.record_errors(n)
+
+    # --- mesh failover (ISSUE 12) -----------------------------------------
+
+    def _degrade_mesh(self, at_devices: int, cause,
+                      requeued: int = 0) -> bool:
+        """Rebuild the serving ladder one MESH rung down after a mesh
+        fault at ``at_devices`` (full -> half -> ... -> single chip).
+        True when the service now serves from a smaller (or
+        concurrently-degraded) mesh and re-admission makes sense; False
+        only at the single-chip floor. The rebuild is an eviction plus
+        a config swap: the next dispatch builds — or AOT-adopts, when
+        the store holds the degraded shape's artifacts (utils/aot keys
+        on ``devices``) — engines for the smaller mesh through the
+        ordinary registry path, while the (width, devices) breaker keys
+        the fault fed keep routing off the dead shape if anything
+        re-offers it."""
+        with self._width_lock:
+            cfg = self._mesh_cfg
+            if cfg.devices != at_devices:
+                # Another batch already degraded (or restored) the mesh
+                # out from under this fault: nothing to rebuild, but the
+                # caller's queries still re-admit onto the live config.
+                return True
+            new_devices = next_mesh_rung(at_devices)
+            if new_devices is None:
+                return False
+            new_cfg = cfg.degraded(new_devices)
+            old_specs = [self._spec(w, cfg) for w in self._ladder]
+            top = self._max_lanes  # keep any OOM degrade's width cap
+            try:
+                ladder = build_width_ladder(
+                    top, self._ladder_arg, devices=new_devices,
+                    engine=new_cfg.engine,
+                )
+            except ValueError:
+                # The operator's explicit ladder does not fit the
+                # degraded grid (e.g. an earlier OOM cap dropped its top
+                # rung): re-derive geometrically rather than refuse to
+                # fail over.
+                ladder = build_width_ladder(
+                    top, "auto", devices=new_devices, engine=new_cfg.engine,
+                )
+            self._mesh_cfg = new_cfg
+            self._ladder = ladder
+            self._max_lanes = ladder[-1]
+            self._width_floor, self._width_quantum = ladder_bounds(
+                top, devices=new_devices, engine=new_cfg.engine,
+            )
+        for spec in old_specs:
+            # Free the dead mesh shape's device tables BEFORE the
+            # degraded rebuilds (the OOM ladder's lesson).
+            self._registry.evict(spec)
+        COUNTERS.bump("mesh_degrades")
+        self.metrics.record_mesh_degrade(requeued)
+        self._log(
+            f"MESH DEGRADE: {at_devices} -> {new_devices} devices "
+            f"(engine {new_cfg.engine}, ladder {ladder}) after: "
+            f"{str(cause)[:200]}"
+        )
+        rec = _obs.ACTIVE
+        if rec is not None:
+            rec.event("mesh_degrade", cat="serve.mesh",
+                      from_devices=at_devices, to_devices=new_devices,
+                      engine=new_cfg.engine, ladder=list(ladder),
+                      requeued=requeued)
+        return True
+
+    def _handle_mesh_fault(self, queries, at_width: int, at_devices: int,
+                           cause) -> None:
+        """Degrade the MESH one rung and re-admit (the failover ladder),
+        sharing the OOM path's bounded requeue budget — a query bouncing
+        through repeated mesh faults resolves with its attempt history
+        instead of cycling forever. Reached only from mesh-spanning
+        batches (the executor classifies single-chip errors as plain
+        transients), so the floor branch is a never-expected backstop."""
+        queries = self._shed_over_budget(queries, at_width, "mesh-fault")
+        if self._degrade_mesh(at_devices, cause, requeued=len(queries)):
+            if not self._mesh_cfg.resume_levels:
+                # Degraded onto a config without resume (the single-chip
+                # floor): the re-admitted queries complete on an engine
+                # that never drops snapshots — evict theirs now.
+                self._drop_resume_snapshots(queries)
+            if queries:
+                self._queue.requeue(queries)
+                if self._queue.stopped:
+                    # Same exactly-once discipline as the OOM handler.
+                    n = 0
+                    for q in self._queue.next_batch(self._queue.cap, 0.0):
+                        if q.resolve_status(
+                            STATUS_SHUTDOWN, error="service closed"
+                        ):
+                            n += 1
+                    if n:
+                        self.metrics.record_shutdown(n)
+            return
+        err = (
+            f"mesh fault with no smaller mesh to fail over to "
+            f"({at_devices} devices): {str(cause)[:200]}"
+        )
+        self._log(err)
+        self._drop_resume_snapshots(queries)
+        n = 0
+        for q in queries:
+            if q.resolve_status(STATUS_ERROR, error=err):
+                n += 1
+        if n:
+            self.metrics.record_errors(n)
+
+    def mesh_restore(self, devices: int | None = None, *,
+                     probe: bool = True) -> bool:
+        """Promote a degraded service back onto a wider mesh: the widest
+        original-ladder rung that heartbeats healthy (or exactly
+        ``devices`` when given). Engines for the restored shape rebuild
+        lazily through the registry on the next dispatch. False when the
+        service is not degraded or nothing wider is healthy.
+        ``probe=False`` skips the heartbeat when the caller just ran it
+        (the background prober's path)."""
+        from tpu_bfs.resilience.failover import degrade_ladder
+        from tpu_bfs.resilience.probe import mesh_heartbeat
+
+        target0 = self._cfg0.devices
+        current = self._mesh_cfg.devices
+        if current >= target0:
+            return False
+        rungs = degrade_ladder(target0)
+        if devices and int(devices) not in rungs:
+            # Only the halving-ladder rungs are valid restore targets:
+            # the config walk below (and the ladders/breaker keys built
+            # from it) is defined rung by rung, so an off-ladder count
+            # would leave cfg.devices disagreeing with the width grid.
+            self._log(
+                f"mesh restore: {devices} is not a failover rung of the "
+                f"{target0}-device mesh ({rungs}); refusing"
+            )
+            return False
+        candidates = (
+            [int(devices)] if devices
+            else [d for d in rungs if d > current]
+        )
+        chosen = None
+        for d in candidates:
+            if not (current < d <= target0):
+                continue
+            if probe:
+                try:
+                    mesh_heartbeat(d)
+                except Exception as exc:  # noqa: BLE001 — dead mesh expected
+                    self._log(
+                        f"mesh restore: {d}-device heartbeat failed "
+                        f"({type(exc).__name__}: {str(exc)[:120]})"
+                    )
+                    continue
+            chosen = d
+            break
+        if chosen is None:
+            return False
+        with self._width_lock:
+            cfg = self._mesh_cfg
+            if cfg.devices >= chosen:
+                return False
+            new_cfg = self._cfg0
+            while new_cfg.devices > chosen:
+                new_cfg = new_cfg.degraded(next_mesh_rung(new_cfg.devices))
+            old_specs = [self._spec(w, cfg) for w in self._ladder]
+            top = self._max_lanes  # an OOM cap survives the restore
+            try:
+                ladder = build_width_ladder(
+                    top, self._ladder_arg, devices=chosen,
+                    engine=new_cfg.engine,
+                )
+            except ValueError:
+                ladder = build_width_ladder(
+                    top, "auto", devices=chosen, engine=new_cfg.engine,
+                )
+            self._mesh_cfg = new_cfg
+            self._ladder = ladder
+            self._max_lanes = ladder[-1]
+            self._width_floor, self._width_quantum = ladder_bounds(
+                top, devices=chosen, engine=new_cfg.engine,
+            )
+        for spec in old_specs:
+            self._registry.evict(spec)
+        self._log(
+            f"MESH RESTORE: {current} -> {chosen} devices "
+            f"(engine {new_cfg.engine}, ladder {ladder})"
+        )
+        rec = _obs.ACTIVE
+        if rec is not None:
+            rec.event("mesh_restore", cat="serve.mesh",
+                      from_devices=current, to_devices=chosen,
+                      engine=new_cfg.engine)
+        return True
+
+    def _on_mesh_healthy(self, devices: int) -> None:
+        """The background prober's promotion hook (it already ran the
+        heartbeat on ``devices``)."""
+        self.mesh_restore(devices, probe=False)
 
     def _finish(self, pending) -> None:
         """The extraction half, wherever it runs (inline or worker).
@@ -660,6 +1005,14 @@ class BfsService:
             pending.engine = None
             pending.handle = None
             self._handle_batch_oom(exc.queries, width, exc.cause)
+        except MeshFaultRequeue as exc:
+            width = pending.lanes
+            # Same reference discipline: the dead mesh shape's engines
+            # evict during the degrade and their tables must free.
+            pending.engine = None
+            pending.handle = None
+            self._handle_mesh_fault(exc.queries, width, exc.devices,
+                                    exc.cause)
         except Exception as exc:  # noqa: BLE001 — resolve, never strand
             err = f"{type(exc).__name__}: {str(exc)[:300]}"
             self._log(f"batch extraction failed: {err}")
@@ -744,6 +1097,12 @@ class BfsService:
                 width = engine.lanes
                 engine = None  # noqa: F841 — releases device tables
                 self._handle_batch_oom(exc.queries, width, exc.cause)
+                continue
+            except MeshFaultRequeue as exc:
+                width = engine.lanes
+                engine = None  # noqa: F841 — releases device tables
+                self._handle_mesh_fault(exc.queries, width, exc.devices,
+                                        exc.cause)
                 continue
             except Exception as exc:  # noqa: BLE001 — engine build failed
                 engine = None  # noqa: F841 — don't pin a half-built engine
@@ -875,6 +1234,25 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument("--sparse-predict", action="store_true",
                     help="history-predictive dense selection on the "
                     "dist2d sparse row exchange (ISSUE 7 planner)")
+    ap.add_argument("--resume-levels", type=int, default=0, metavar="K",
+                    help="level-checkpointed query resume (ISSUE 12, "
+                    "--engine dist2d): snapshot each query's loop carry "
+                    "every K levels so a mid-query mesh fault resumes "
+                    "from the last intact level on the degraded mesh "
+                    "(bounded recompute <= K); 0 disables (default)")
+    ap.add_argument("--resume-dir", default=None, metavar="DIR",
+                    help="also persist resume snapshots to DIR through "
+                    "the CRC checkpoint machinery (atomic writes, "
+                    "quarantine on corruption), so a restarted replica "
+                    "can resume too; default: in-memory only (or the "
+                    "TPU_BFS_RESUME_DIR env var)")
+    ap.add_argument("--mesh-probe-interval-s", type=float, default=0.0,
+                    metavar="S",
+                    help="background mesh health probe cadence: a "
+                    "degraded service (mesh failover, ISSUE 12) "
+                    "heartbeats the wider mesh rungs every S seconds "
+                    "and promotes back onto the widest healthy one; "
+                    "0 disables (default)")
     ap.add_argument("--linger-ms", type=float, default=2.0,
                     help="max wait for batch fill before dispatching a "
                     "partial batch (default 2.0)")
@@ -1104,6 +1482,11 @@ def run_server(args, stdin=None, stdout=None, stderr=None,
                 f"--sparse-delta must be comma-separated bit widths "
                 f"(e.g. 8,16), got {delta_raw!r}"
             ) from None
+    resume_dir = getattr(args, "resume_dir", None)
+    if resume_dir:
+        from tpu_bfs.resilience.resume import set_default_dir
+
+        set_default_dir(resume_dir)
     service = BfsService(
         args.graph,
         engine=args.engine,
@@ -1117,6 +1500,8 @@ def run_server(args, stdin=None, stdout=None, stderr=None,
         sieve=getattr(args, "sparse_sieve", False),
         predict=getattr(args, "sparse_predict", False),
         mesh_shape=mesh_shape,
+        resume_levels=getattr(args, "resume_levels", 0),
+        mesh_probe_interval_s=getattr(args, "mesh_probe_interval_s", 0.0),
         width_ladder=args.ladder,
         pipeline=not args.no_pipeline,
         pipeline_depth=args.pipeline_depth,

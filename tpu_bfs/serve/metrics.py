@@ -205,6 +205,8 @@ class ServeMetrics:
         self.requeued = 0  # guarded-by: _lock — re-admitted after OOM'd batch
         self.watchdog_trips = 0  # guarded-by: _lock — watchdog firings
         self.requeue_shed = 0  # guarded-by: _lock — shed at requeue budget
+        self.mesh_faults = 0  # guarded-by: _lock — mesh-death classifications
+        self.mesh_degrades = 0  # guarded-by: _lock — mesh failover rebuilds
         self.batches = 0  # guarded-by: _lock
         self.lanes_used = 0  # guarded-by: _lock — real queries, all batches
         # Sum of DISPATCHED batch capacity: with the width ladder this is
@@ -283,6 +285,15 @@ class ServeMetrics:
         with self._lock:
             self.requeue_shed += n
 
+    def record_mesh_fault(self) -> None:
+        with self._lock:
+            self.mesh_faults += 1
+
+    def record_mesh_degrade(self, requeued: int = 0) -> None:
+        with self._lock:
+            self.mesh_degrades += 1
+            self.requeued += requeued
+
     def _round(self, v: float | None) -> float | None:
         return None if v is None else round(v, 3)
 
@@ -340,6 +351,8 @@ class ServeMetrics:
                 "requeued": self.requeued,
                 "watchdog_trips": self.watchdog_trips,
                 "requeue_shed": self.requeue_shed,
+                "mesh_faults": self.mesh_faults,
+                "mesh_degrades": self.mesh_degrades,
             }
         if queue_depth is not None:
             out["queue_depth"] = queue_depth

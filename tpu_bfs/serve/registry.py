@@ -102,6 +102,16 @@ class EngineSpec:
     predict: bool = False
     #: explicit (rows, cols) for the dist2d engine; () = most-square.
     mesh_shape: tuple = ()
+    #: ISSUE 12 level-checkpointed resume cadence K (dist2d only; 0 =
+    #: off): the serving loop runs K levels per chunk and snapshots its
+    #: carry at each boundary (tpu_bfs/resilience/resume), so a
+    #: mid-query mesh fault resumes from the last intact level on the
+    #: degraded mesh. NOT part of the compiled program (the chunks
+    #: re-drive one compiled loop with new level bounds), but a key
+    #: field so a resuming and a non-resuming service never alias one
+    #: resident engine; utils/aot.program_key deliberately omits it, so
+    #: both adopt the same artifacts.
+    resume_levels: int = 0
 
     def __post_init__(self):
         # Hashability + registry-key stability: list-valued knobs arrive
@@ -183,6 +193,18 @@ class EngineSpec:
                     f"engine {self.engine!r} runs a 1D mesh"
                 )
             mesh_shape_2d(self.devices, self.mesh_shape)  # raises on mismatch
+        if self.resume_levels < 0:
+            raise ValueError(
+                f"resume_levels must be >= 0, got {self.resume_levels}"
+            )
+        if self.resume_levels and self.engine != "dist2d":
+            raise ValueError(
+                "resume_levels drives the dist2d serve adapter's chunked "
+                "level loop (one single-source loop per unique lane); the "
+                "packed MS engines answer a whole batch in one fused loop "
+                "with no per-query carry to snapshot — a mesh fault there "
+                "re-traverses the batch on the degraded mesh instead"
+            )
 
 
 class EngineRegistry:
@@ -337,6 +359,7 @@ class EngineRegistry:
                 exchange=spec.exchange or "ring",
                 wire_pack=spec.wire_pack, delta_bits=spec.delta_bits,
                 sieve=spec.sieve, predict=spec.predict,
+                resume_levels=spec.resume_levels,
             )
         elif spec.devices > 1:
             from tpu_bfs.parallel.dist_bfs import make_mesh

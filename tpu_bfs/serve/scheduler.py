@@ -11,10 +11,14 @@ bridging that impedance:
   one batch, lingering up to ``linger_s`` for stragglers when the batch
   is not yet full (latency <-> fill trade, the --linger-ms knob);
 - DEADLINES: a query whose deadline passes while queued resolves with
-  DEADLINE_EXCEEDED at batch-forming time. Deadlines bound queue WAIT,
-  not device execution — once dispatched, a batch runs to completion and
-  late results are still delivered (killing a running batch would punish
-  its 8000 batch-mates for one impatient client).
+  DEADLINE_EXCEEDED at batch-forming time, and ``expired()`` is checked
+  AGAIN at dispatch (serve/executor.dispatch_batch) — a query that
+  survived an OOM requeue, a breaker reroute, or a mesh-degrade
+  re-admission must not burn chip time after its client stopped
+  waiting. Deadlines bound time BEFORE dispatch, not device execution —
+  once dispatched, a batch runs to completion and late results are
+  still delivered (killing a running batch would punish its 8000
+  batch-mates for one impatient client).
 
 Every admitted query is resolved exactly once — completion, expiry,
 rejection, error, or shutdown — never silently dropped (the acceptance

@@ -38,6 +38,9 @@ class RecoveryCounters:
     breaker_opens: int = 0  # serve circuit-breaker open transitions
     requeue_sheds: int = 0  # queries shed at the serve requeue budget
     faults_injected: int = 0  # tpu_bfs/faults.py injections (chaos only)
+    mesh_faults: int = 0  # mesh-death classifications (is_mesh_fault fired)
+    mesh_degrades: int = 0  # degraded-mesh failover rebuilds (ISSUE 12)
+    query_resumes: int = 0  # level-checkpointed mid-query resumes
 
     _lock: threading.Lock = dataclasses.field(
         default_factory=threading.Lock, repr=False, compare=False
@@ -67,6 +70,31 @@ class RecoveryCounters:
 
 COUNTERS = RecoveryCounters()
 
+# The jaxlib mesh-death strings (ISSUE 12): a participant dropping out
+# of the slice surfaces as DATA_LOSS, a failed "slice health" check, or
+# a "Program hung" collective timeout (the r03/r04 bench-outage class —
+# see "Unable to initialize backend" below for the live failure string
+# that motivated this family). ONE definition: these feed the transient
+# patterns (a mesh fault is retryable infrastructure trouble) AND
+# is_mesh_fault, which multi-chip callers consult to degrade the mesh
+# instead of re-dispatching into the same dead collective.
+MESH_FAULT_MARKERS = (
+    "DATA_LOSS",
+    "slice health",
+    "Program hung",
+)
+
+
+def is_mesh_fault(exc: BaseException) -> bool:
+    """True when ``exc`` carries a jaxlib mesh-death marker — the whole
+    mesh's collectives are suspect, not just this dispatch. Callers with
+    a single-chip engine treat these like any transient (retry in
+    place); mesh-spanning callers run the degraded-mesh failover ladder
+    (serve/executor.MeshFaultRequeue -> BfsService mesh degrade)."""
+    msg = str(exc)
+    return any(m in msg for m in MESH_FAULT_MARKERS)
+
+
 # Substrings that mark an error as plausibly-transient infrastructure
 # trouble: compile-service/transport failures and XLA's INTERNAL/UNAVAILABLE
 # status codes. Bare "INTERNAL:" is included because infra errors don't
@@ -89,6 +117,7 @@ TRANSIENT_PATTERNS = (
     # is the common case, so this must be retryable (it killed a bench run
     # that round-2's retry machinery was specifically built to save).
     "Unable to initialize backend",
+    *MESH_FAULT_MARKERS,
 )
 
 # Out-of-HBM flavors (XLA compile- or run-time). Deterministic — never

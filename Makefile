@@ -17,14 +17,20 @@ bench:
 	python bench.py
 
 # Static verification (README "Static analysis"; tpu_bfs/analysis): the
-# four-pass sweep over every distributed engine config — collective-
+# seven-pass sweep over every distributed engine config — collective-
 # uniformity taint + compiled-HLO conditional signatures (a divergent
 # branch selection deadlocks a real mesh; invisible on single-host CPU
 # tests), the transfer/retrace guards (no host round-trips in hot loops,
 # no shape-driven recompiles on the serve path, lazy distance contract),
-# the guarded-by/lock-order AST lint over serve/ + obs/, and the 64-bit
-# dtype lint. Findings gate on the analysis-baseline.txt suppression
-# file; exit 1 on anything new. CPU-only, like wirecheck — and a
+# the guarded-by/lock-order AST lint over serve/ + obs/, the 64-bit
+# dtype lint, the static HBM budget (per-program peak estimates, the
+# strictly-monotone ladder model, the buffer-donation lint + HLO alias
+# certificates), the exception-path lifecycle walk (spans/locks/resume
+# snapshots closed on every path incl. raises), and the fault-site
+# coverage audit (faults.SITES vs consults vs test coverage). Findings
+# gate on the analysis-baseline.txt suppression file; exit 1 on
+# anything new (--json emits the machine-readable report the
+# chip-session pre-flight consumes). CPU-only, like wirecheck — and a
 # prerequisite OF wirecheck (and so of every smoke target): a program
 # that can deadlock the mesh must fail before its byte model is even
 # worth auditing.

@@ -1607,6 +1607,31 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
         log("dist serve record: "
             + " ".join(f"{k}={v}" for k, v in dist_keys.items()))
 
+    # Modeled per-rung HBM peaks over the service's ACTUAL width ladder
+    # (ISSUE 13 pass 5's ladder budget model; pure arithmetic, CPU-safe):
+    # the verdict records what each resident rung is modeled to occupy
+    # and whether the ladder is strictly monotone in width — the
+    # precondition the OOM halving and mesh-degrade walks rest on.
+    from tpu_bfs.analysis.memory import (
+        check_ladder_entries,
+        model_spec_peak_bytes,
+    )
+
+    hbm_entries = [
+        (
+            int(w),
+            model_spec_peak_bytes(
+                engine, int(w), planes=8, devices=devices,
+                num_vertices=g.num_vertices, num_edges=g.num_edges,
+            )["total_bytes"],
+        )
+        for w in snap["ladder"]
+    ]
+    hbm_monotone = not check_ladder_entries("serve", hbm_entries)
+    log("hbm model: " + " ".join(
+        f"w{w}={b/1e9:.2f}GB" for w, b in hbm_entries
+    ) + f" monotone={hbm_monotone}")
+
     chips = f"{devices} chips" if devices > 1 else "1 chip"
     return {
         "metric": (
@@ -1648,6 +1673,11 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
         # (serve_preheat_s + aot hit/fallback audit) rides along when
         # TPU_BFS_BENCH_AOT_DIR armed the A/B.
         "serve_cold_start_s": round(cold_start_s, 2),
+        # Static HBM budget (ISSUE 13): modeled peak bytes per resident
+        # ladder rung + the strict-monotonicity verdict the degrade
+        # ladders depend on (BENCHMARKS.md "Serve HBM model").
+        "serve_hbm_model_bytes": {str(w): b for w, b in hbm_entries},
+        "serve_hbm_ladder_monotone": hbm_monotone,
         **dist_keys,
         **aot_keys,
         **({"serve_faults": fault_sched.counts()} if fault_sched else {}),

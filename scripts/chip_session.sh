@@ -91,9 +91,32 @@ pstage() {  # pstage <name> <json-out> <script> [ENV=VAL...] — one helper-scri
 # retrace or hot-loop host sync would poison every timing the session
 # collects. Fail fast here, while the only cost is seconds of CPU.
 echo "=== analyze pre-flight $(date -u +%H:%M:%S) ==="
-if ! env JAX_PLATFORMS=cpu python -m tpu_bfs.analysis \
-    --baseline analysis-baseline.txt >"$out/analyze.log" 2>&1; then
-  echo "static analysis FAILED (see $out/analyze.log) — not burning chip time"
+# The analyzer's --json report (ISSUE 13) is the machine-readable
+# contract: the gate below reads verdicts and finding counts from
+# $out/analyze.json instead of scraping exit text, and the artifact
+# rides with the stage outputs (per-pass certificates included — the
+# per-program peak-HBM estimates and the ladder monotonicity proof).
+env JAX_PLATFORMS=cpu python -m tpu_bfs.analysis --json \
+    --baseline analysis-baseline.txt \
+    >"$out/analyze.json" 2>"$out/analyze.log"
+analyze_rc=$?
+analyze_verdict=$(python - "$out/analyze.json" <<'PYEOF'
+import json, sys
+try:
+    rep = json.load(open(sys.argv[1]))
+except Exception as exc:  # unparsable report = failed pre-flight
+    print(f"unreadable:{exc}")
+    raise SystemExit(0)
+print(
+    f"ok={rep.get('ok')} new={len(rep.get('findings', []))} "
+    f"suppressed={len(rep.get('suppressed', []))} "
+    f"stale={len(rep.get('stale_baseline', []))}"
+)
+PYEOF
+)
+echo "analyze: $analyze_verdict"
+if [ "$analyze_rc" -ne 0 ] || ! printf '%s' "$analyze_verdict" | grep -q '^ok=True'; then
+  echo "static analysis FAILED (see $out/analyze.json / analyze.log) — not burning chip time"
   exit 1
 fi
 echo "analyze pre-flight OK"

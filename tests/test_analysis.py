@@ -10,6 +10,7 @@ runs the full sweep.
 """
 
 import numpy as np
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -20,6 +21,13 @@ from tpu_bfs.analysis import Finding, apply_baseline, load_baseline
 from tpu_bfs.analysis import dtypes, uniformity
 from tpu_bfs.analysis.locks import find_cycles, lint_sources, lint_tree, repo_root
 from tpu_bfs.parallel.compat import shard_map
+
+
+@pytest.fixture(scope="module")
+def small_analysis_graph():
+    from tpu_bfs.graph.generate import random_graph
+
+    return random_graph(96, 480, seed=3)
 
 
 def _mesh1d():
@@ -588,3 +596,524 @@ def test_wirecheck_reexports_hlo_core():
 
     assert wirecheck.Collective is core.Collective
     assert wirecheck.hlo_collectives is core.hlo_collectives
+
+
+# --- memory pass (ISSUE 13, pass 5): donation lint + ladder model -----------
+
+
+_UNDONATED_CARRY_SRC = '''
+import jax
+from jax import lax
+
+@jax.jit
+def step_loop(tbl, fw, vis):
+    def body(st):
+        f, v = st
+        return f & tbl[0], v | f
+    f, v = lax.while_loop(lambda st: st[0].any(), body, (fw, vis))
+    return f, v
+'''
+
+_DONATED_CARRY_SRC = '''
+import jax
+from jax import lax
+from functools import partial
+
+@partial(jax.jit, donate_argnums=(1, 2))
+def step_loop(tbl, fw, vis):
+    def body(st):
+        f, v = st
+        return f & tbl[0], v | f
+    f, v = lax.while_loop(lambda st: st[0].any(), body, (fw, vis))
+    return f, v
+'''
+
+_DEAD_DONATE_SRC = '''
+import jax
+from functools import partial
+
+@partial(jax.jit, donate_argnums=())
+def plain(x):
+    return x + 1
+'''
+
+_NO_DONATE_ANNOTATED_SRC = '''
+import jax
+from jax import lax
+
+@jax.jit  # no-donate: the caller re-reads the carry for its probe
+def step_loop(tbl, fw, vis):
+    def body(st):
+        f, v = st
+        return f & tbl[0], v | f
+    return lax.while_loop(lambda st: st[0].any(), body, (fw, vis))
+'''
+
+
+def test_donation_lint_flags_undonated_carry():
+    """The seeded RED case: a jit whose params feed a while_loop carry
+    without donate_argnums — double state residency per call."""
+    from tpu_bfs.analysis.memory import lint_donation_sources
+
+    findings, info = lint_donation_sources({"fix.py": _UNDONATED_CARRY_SRC})
+    assert len(findings) == 1
+    assert findings[0].fingerprint == (
+        "memory/donation:fix.py:step_loop@undonated-carry"
+    )
+    assert "donate_argnums" in findings[0].message
+    assert info["carry_style"] == 1
+
+    clean, _ = lint_donation_sources({"fix.py": _DONATED_CARRY_SRC})
+    assert clean == [], [f.render() for f in clean]
+
+
+def test_donation_lint_flags_dead_annotation():
+    """donate_argnums=() satisfies a grep and donates nothing — the
+    bfs.py:31 defect this PR fixes, pinned as a fixture."""
+    from tpu_bfs.analysis.memory import lint_donation_sources
+
+    findings, _ = lint_donation_sources({"fix.py": _DEAD_DONATE_SRC})
+    assert len(findings) == 1
+    assert "dead-annotation" in findings[0].fingerprint
+    assert "donates nothing" in findings[0].message
+
+
+def test_donation_lint_accepts_no_donate_annotation():
+    from tpu_bfs.analysis.memory import lint_donation_sources
+
+    findings, info = lint_donation_sources(
+        {"fix.py": _NO_DONATE_ANNOTATED_SRC}
+    )
+    assert findings == [], [f.render() for f in findings]
+    assert info["no_donate"] == 1
+
+
+def test_donation_lint_clean_on_tree():
+    """The engine-core modules lint clean AFTER the donations landed:
+    the carries it found are donated (bfs core, packed core_from twins,
+    both dist loops) or annotated with the documented reason (the
+    packed core's fw0-doubles-as-src-bits contract)."""
+    from tpu_bfs.analysis.memory import lint_donation_tree
+
+    findings, info = lint_donation_tree(repo_root())
+    assert findings == [], [f.render() for f in findings]
+    assert info["carry_style"] >= 7  # the loops really are carry-style
+    assert info["donating"] >= 4  # bfs core + packed twins + dist loops
+    assert info["no_donate"] >= 4  # core/core_from annotations
+
+
+def test_ladder_model_monotone_for_registry_families():
+    """The acceptance check: every EngineSpec family the serve registry
+    can build has a modeled ladder strictly monotone in rung width."""
+    from tpu_bfs.analysis.memory import check_registry_ladders
+
+    findings, ladders = check_registry_ladders(
+        num_vertices=1 << 21, num_edges=1 << 25, device_count=8
+    )
+    assert findings == [], [f.render() for f in findings]
+    # Every registry engine kind appears, single-chip and mesh.
+    fams = set(ladders)
+    assert {"wide-d1", "packed-d1", "hybrid-d1", "wide-d8", "hybrid-d8",
+            "dist2d-d8"} <= fams
+    for fam, entries in ladders.items():
+        widths = [w for w, _ in entries]
+        bytes_ = [b for _, b in entries]
+        assert widths == sorted(widths)
+        assert bytes_ == sorted(bytes_), fam
+
+
+def test_non_monotone_two_rung_ladder_flagged():
+    """The seeded RED case: two rungs modeling identical (and inverted)
+    peaks — the degrade walk would free nothing."""
+    from tpu_bfs.analysis.memory import check_ladder_entries
+
+    flat = check_ladder_entries("fam", [(32, 100), (64, 100)])
+    assert len(flat) == 1 and "not strictly monotone" in flat[0].message
+    inverted = check_ladder_entries("fam", [(32, 200), (64, 100)])
+    assert len(inverted) == 1
+    assert check_ladder_entries("fam", [(32, 100), (64, 200)]) == []
+
+
+def test_check_program_donation_red_green():
+    """A donating-tagged program whose HLO carries no alias entry is a
+    finding (XLA silently drops unusable donations); one whose alias
+    landed is a certificate."""
+    import functools
+
+    from tpu_bfs.analysis.memory import check_program_donation
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def donates(x):
+        return x + 1
+
+    donates._donate_argnums = (0,)
+    hlo = donates.lower(jnp.ones(8, jnp.int32)).compile().as_text()
+    assert check_program_donation("toy", donates, hlo) == []
+    # Same tag over an alias-free artifact: the dropped-donation case.
+    @jax.jit
+    def copies(x):
+        return x + 1
+
+    copies._donate_argnums = (0,)
+    hlo2 = copies.lower(jnp.ones(8, jnp.int32)).compile().as_text()
+    bad = check_program_donation("toy2", copies, hlo2)
+    assert bad and "input-output-alias" in bad[0].where
+
+
+def test_bfs_core_donates_for_real(toy_graph):
+    """Satellite 1 pinned at runtime: the single-source core's carry is
+    consumed by the call (the donate_argnums=() era kept it alive), and
+    chunked resume over the donating loop stays bit-identical."""
+    from tpu_bfs.algorithms.bfs import BfsEngine, _bfs_core, bfs
+
+    eng = BfsEngine(toy_graph)
+    f0, v0, d0 = eng._init_state(0)
+    out = _bfs_core(
+        eng.edges, f0, v0, d0, jnp.int32(0), jnp.int32(4),
+        backend=eng.backend, caps=eng.caps,
+    )
+    with pytest.raises(RuntimeError, match="deleted"):
+        np.asarray(f0)  # donated: the buffer is gone
+    del out
+    # Chunked advance (start/advance to exhaustion) == one-shot run.
+    straight = bfs(toy_graph, 3, with_parents=False)
+    ckpt = eng.start(3)
+    while not ckpt.done:
+        ckpt = eng.advance(ckpt, levels=1)
+    np.testing.assert_array_equal(
+        eng.finish(ckpt, with_parents=False).distance, straight.distance
+    )
+
+
+def test_packed_advance_rides_donating_core(small_analysis_graph):
+    """The packed resume path uses the donating twin: chunked advance is
+    bit-identical to the uninterrupted run, and the twin really donates
+    (fresh carries handed to it are consumed)."""
+    from tpu_bfs.algorithms._packed_common import (
+        packed_real_to_table,
+        start_packed_batch,
+    )
+    from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
+
+    g = small_analysis_graph
+    eng = WidePackedMsBfsEngine(g, lanes=32, num_planes=4)
+    assert getattr(eng, "_core_from_donate", None) is not None
+    sources = np.arange(32, dtype=np.int64) % g.num_vertices
+    res = eng.run(sources)
+    ckpt = start_packed_batch(eng, sources)
+    from tpu_bfs.algorithms._packed_common import advance_packed_batch
+    while ckpt.alive:
+        ckpt = advance_packed_batch(eng, ckpt, levels=1)
+    from tpu_bfs.algorithms._packed_common import finish_packed_batch
+    fin = finish_packed_batch(eng, ckpt)
+    for i in (0, 7, 31):
+        np.testing.assert_array_equal(
+            fin.distances_int32(i), res.distances_int32(i)
+        )
+    # The twin consumes its carry: a fresh table handed in is deleted.
+    fw = packed_real_to_table(
+        eng, np.zeros((g.num_vertices, eng.w), np.uint32)
+    )
+    vis = packed_real_to_table(
+        eng, np.zeros((g.num_vertices, eng.w), np.uint32)
+    )
+    planes = tuple(
+        packed_real_to_table(
+            eng, np.zeros((g.num_vertices, eng.w), np.uint32)
+        )
+        for _ in range(eng.num_planes)
+    )
+    eng._core_from_donate(
+        eng.arrs, fw, vis, planes, jnp.int32(0), jnp.int32(1)
+    )
+    with pytest.raises(RuntimeError, match="deleted"):
+        np.asarray(fw)
+
+
+# --- lifecycle pass (ISSUE 13, pass 6) --------------------------------------
+
+
+_DANGLING_SPAN_SRC = '''
+class S:
+    def f(self, rec, bad):
+        rec.begin("dispatch", "b1")
+        if bad:
+            raise RuntimeError("x")
+        rec.end("dispatch", "b1")
+'''
+
+_CLOSED_SPAN_SRC = '''
+class S:
+    def f(self, rec, bad):
+        rec.begin("dispatch", "b1")
+        if bad:
+            rec.end("dispatch", "b1", failed=True)
+            raise RuntimeError("x")
+        rec.end("dispatch", "b1")
+'''
+
+_HANDLER_SPAN_SRC = '''
+class S:
+    def f(self, rec):
+        rec.begin("fetch", "b1")
+        try:
+            self.work()
+            rec.end("fetch", "b1")
+        except Exception:
+            rec.end("fetch", "b1", failed=True)
+            raise
+'''
+
+_OUTLIVES_SRC = '''
+class S:
+    def f(self, rec):
+        rec.begin("query", "q1")  # span-outlives: resolve() closes it
+        return 1
+'''
+
+_LOCK_BRANCH_SRC = '''
+class S:
+    def f(self, ok):
+        self._lock.acquire()
+        if ok:
+            self._lock.release()
+'''
+
+_LOCK_IDIOM_SRC = '''
+class S:
+    def f(self):
+        if not self._lock.acquire(timeout=0.05):
+            return None
+        try:
+            return 1
+        finally:
+            self._lock.release()
+'''
+
+_SNAPSHOT_LEAK_SRC = '''
+class C:
+    def __init__(self):
+        self._resume_cache = ResumeCache(None)
+
+    def save(self, s, ck):
+        self._resume_cache.put(s, ck)
+'''
+
+_SNAPSHOT_OK_SRC = '''
+class C:
+    def __init__(self):
+        self._resume_cache = ResumeCache(None)
+
+    def save(self, s, ck):
+        self._resume_cache.put(s, ck)
+
+    def done(self, s):
+        self._resume_cache.drop(s)
+'''
+
+
+def test_lifecycle_flags_dangling_span_across_raise():
+    """The PR 6 review class, pinned RED: a span begun, then an explicit
+    raise with no end on that path."""
+    from tpu_bfs.analysis.lifecycle import check_sources
+
+    findings, _ = check_sources({"fix.py": _DANGLING_SPAN_SRC})
+    assert len(findings) == 1
+    assert findings[0].fingerprint == "lifecycle:fix.py:S.f@span:dispatch"
+    assert "across a raise" in findings[0].message
+    clean, _ = check_sources({"fix.py": _CLOSED_SPAN_SRC})
+    assert clean == [], [f.render() for f in clean]
+    handler, _ = check_sources({"fix.py": _HANDLER_SPAN_SRC})
+    assert handler == [], [f.render() for f in handler]
+
+
+def test_lifecycle_span_outlives_annotation_transfers_ownership():
+    from tpu_bfs.analysis.lifecycle import check_sources
+
+    findings, info = check_sources({"fix.py": _OUTLIVES_SRC})
+    assert findings == []
+    assert info["span_outlives"] == 1
+
+
+def test_lifecycle_flags_unreleased_lock_branch():
+    """The lock half, RED: acquire with a release on one branch only;
+    the timeout-acquire/try/finally idiom stays green."""
+    from tpu_bfs.analysis.lifecycle import check_sources
+
+    findings, _ = check_sources({"fix.py": _LOCK_BRANCH_SRC})
+    assert len(findings) == 1
+    assert findings[0].fingerprint == "lifecycle:fix.py:S.f@lock:self._lock"
+    clean, _ = check_sources({"fix.py": _LOCK_IDIOM_SRC})
+    assert clean == [], [f.render() for f in clean]
+
+
+def test_lifecycle_flags_snapshot_without_drop():
+    """The PR 11 review class, RED: a class that puts resume snapshots
+    and never drops any pins ~3x[V] host arrays forever."""
+    from tpu_bfs.analysis.lifecycle import check_sources
+
+    findings, _ = check_sources({"fix.py": _SNAPSHOT_LEAK_SRC})
+    assert len(findings) == 1
+    assert "snapshot" in findings[0].fingerprint
+    clean, _ = check_sources({"fix.py": _SNAPSHOT_OK_SRC})
+    assert clean == [], [f.render() for f in clean]
+
+
+def test_lifecycle_clean_on_tree():
+    """serve/obs/resilience (+ the 2D serve adapter) verify clean, with
+    exactly the three documented cross-function span ownerships."""
+    from tpu_bfs.analysis.lifecycle import check_tree
+
+    findings, info = check_tree(repo_root())
+    assert findings == [], [f.render() for f in findings]
+    assert info["span_outlives"] == 3  # query, batch, extract
+    assert info["functions"] >= 150
+
+
+# --- faultcov pass (ISSUE 13, pass 7) ---------------------------------------
+
+
+def test_faultcov_flags_undeclared_consult():
+    """RED: a consultation naming a site the grammar does not declare
+    can never fire."""
+    from tpu_bfs.analysis.faultcov import check_sources
+
+    prod = {"m.py": 'ACTIVE.hit("nonexistent_site", lanes=4)\n'}
+    findings, _ = check_sources(prod, {}, sites=("dispatch",))
+    fps = [f.fingerprint for f in findings]
+    assert any("undeclared:nonexistent_site" in fp for fp in fps)
+
+
+def test_faultcov_flags_never_consulted_site():
+    from tpu_bfs.analysis.faultcov import check_sources
+
+    findings, _ = check_sources(
+        {"m.py": 'ACTIVE.hit("dispatch")\n'},
+        {"t.py": '"transient@dispatch:n=1"\n'},
+        sites=("dispatch", "ghost_site"),
+    )
+    fps = [f.fingerprint for f in findings]
+    assert fps == ["faultcov:faults.SITES@never-consulted:ghost_site"]
+
+
+def test_faultcov_flags_uncovered_site():
+    """RED: a consulted site no test spec ever targets — a new fault
+    site cannot land untested."""
+    from tpu_bfs.analysis.faultcov import check_sources
+
+    prod = {"m.py": 'ACTIVE.hit("dispatch")\nACTIVE.hit("fetch")\n'}
+    tests = {"t.py": 'spec = "transient@dispatch:n=1"\n'}
+    findings, info = check_sources(
+        prod, tests, sites=("dispatch", "fetch")
+    )
+    assert [f.fingerprint for f in findings] == [
+        "faultcov:tests@uncovered:fetch"
+    ]
+    assert info["coverage"]["dispatch"] == ["transient"]
+
+
+def test_faultcov_parses_spec_strings_with_default_sites():
+    """Coverage credits the DEFAULT_SITE of site-less clauses — the
+    common `seed=7:transient:p=0.05` shape lands on `dispatch`."""
+    from tpu_bfs.analysis.faultcov import coverage_from_source
+
+    cov = coverage_from_source(
+        'SPEC = "seed=7:transient:p=0.05,corrupt_ckpt:n=1"\n'
+    )
+    assert cov["dispatch"] == {"transient"}
+    assert cov["ckpt_save"] == {"corrupt_ckpt"}
+
+
+def test_faultcov_clean_on_tree():
+    """Every declared site is consulted, every consulted site is
+    drivable from tests/ or the chaos smokes."""
+    from tpu_bfs.analysis.faultcov import check_tree
+    from tpu_bfs.faults import SITES
+
+    findings, info = check_tree(repo_root())
+    assert findings == [], [f.render() for f in findings]
+    assert set(info["sites"]) == set(SITES)
+    for site in SITES:
+        assert info["coverage"][site], f"site {site} has no coverage"
+
+
+# --- the JSON report (ISSUE 13 satellite) -----------------------------------
+
+
+def test_cli_json_report_shape(capsys):
+    """`tpu-bfs-analyze --json` emits one machine-readable object the
+    chip-session pre-flight can gate on — verdict, per-pass info, and
+    the ladder certificates — without scraping exit text."""
+    import json as _json
+
+    from tpu_bfs.analysis.cli import main
+
+    rc = main(["--fast", "--json", "--skip", "uniformity,dtype,transfer"])
+    out = capsys.readouterr().out
+    rep = _json.loads(out)
+    assert rc == 0 and rep["ok"] is True
+    assert rep["findings"] == [] and rep["stale_baseline"] == []
+    assert {"locks", "memory", "lifecycle", "faultcov"} <= set(rep["passes"])
+    ladders = rep["passes"]["memory"]["ladders"]
+    assert "wide-d1" in ladders and ladders["wide-d1"][0]["model_bytes"] > 0
+    assert rep["passes"]["faultcov"]["coverage"]["dispatch"]
+
+
+def test_cli_rejects_unknown_skip(capsys):
+    from tpu_bfs.analysis.cli import main
+
+    assert main(["--fast", "--skip", "nosuchpass"]) == 2
+
+
+def test_donation_lint_accepts_bare_int_donate_argnums():
+    """jax accepts `donate_argnums=1`; the lint must read it as (1,),
+    not flag a correctly-donating carry (review catch)."""
+    from tpu_bfs.analysis.memory import lint_donation_sources
+
+    src = (
+        "import jax\n"
+        "from jax import lax\n"
+        "from functools import partial\n\n"
+        "@partial(jax.jit, donate_argnums=1)\n"
+        "def step_loop(tbl, fw):\n"
+        "    return lax.while_loop(lambda f: f.any(),\n"
+        "                          lambda f: f & tbl[0], fw)\n"
+    )
+    findings, info = lint_donation_sources({"fix.py": src})
+    assert findings == [], [f.render() for f in findings]
+    assert info["donating"] == 1
+
+
+def test_lifecycle_break_path_skips_loop_else():
+    """Python runs a loop's `else` only on non-break exhaustion: a span
+    closed ONLY in the else clause leaks on the break path (review
+    catch — the walker must not route break states through orelse)."""
+    from tpu_bfs.analysis.lifecycle import check_sources
+
+    leaky = (
+        "class S:\n"
+        "    def f(self, rec, items):\n"
+        "        rec.begin(\"scan\", \"s1\")\n"
+        "        for it in items:\n"
+        "            if it:\n"
+        "                break\n"
+        "        else:\n"
+        "            rec.end(\"scan\", \"s1\")\n"
+    )
+    findings, _ = check_sources({"fix.py": leaky})
+    assert [f.fingerprint for f in findings] == [
+        "lifecycle:fix.py:S.f@span:scan"
+    ]
+    closed = (
+        "class S:\n"
+        "    def f(self, rec, items):\n"
+        "        rec.begin(\"scan\", \"s1\")\n"
+        "        for it in items:\n"
+        "            if it:\n"
+        "                rec.end(\"scan\", \"s1\", early=True)\n"
+        "                break\n"
+        "        else:\n"
+        "            rec.end(\"scan\", \"s1\")\n"
+    )
+    clean, _ = check_sources({"fix.py": closed})
+    assert clean == [], [f.render() for f in clean]

@@ -97,3 +97,47 @@ def test_analyze_cli_fast_clean():
     from tpu_bfs.analysis.cli import main
 
     assert main(["--fast"]) == 0
+
+
+def test_memory_estimates_and_donation_certificates():
+    """Pass 5's compiled half on a real program: the peak estimate is
+    available (memory_analysis on this backend) and the 1D dist loop's
+    applied donation shows up as input_output_alias entries in its own
+    compiled HLO — the certificate check_program_donation keys on."""
+    from tpu_bfs.analysis.hlo import input_output_aliases
+    from tpu_bfs.analysis.memory import (
+        check_program_donation,
+        estimate_compiled,
+    )
+
+    for spec in iter_programs(("1d-ring",)):
+        comp = spec.lower_compiled()
+        est = estimate_compiled(spec.name, comp)
+        assert est["peak_bytes"] and est["peak_bytes"] > 0, est
+        hlo = comp.as_text()
+        assert check_program_donation(spec.name, spec.fn, hlo) == []
+        if spec.label == "level_loop":
+            assert input_output_aliases(hlo), (
+                "the dist loop's donate_argnums must land as HLO "
+                "input_output_alias entries"
+            )
+
+
+def test_analyze_cli_json_full_subset():
+    """`--json` over one compiled config: the report carries the
+    per-program memory certificates next to the verdict."""
+    import json
+
+    from tpu_bfs.analysis.cli import main
+
+    import contextlib
+    import io
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main(["--json", "--configs", "1d-ring",
+                   "--skip", "locks,lifecycle,faultcov"])
+    rep = json.loads(buf.getvalue())
+    assert rc == 0 and rep["ok"] is True
+    ests = rep["passes"]["memory"]["program_estimates"]
+    assert any(e["program"] == "1d-ring/level_loop" for e in ests)

@@ -355,3 +355,62 @@ def test_exported_artifact_is_json_headed(exported_wide):
 
     assert jexp.deserialize(payload).in_avals
     json.dumps(meta)  # header is pure JSON
+
+
+def test_donated_program_round_trips_with_donation(exported_wide, tmp_path):
+    """ISSUE 13 acceptance: a donation applied by analysis pass 5 (the
+    wide engine's donating resume core) survives the AOT export/adopt
+    round trip — the artifact header records donate_argnums, the
+    adopting wrapper re-applies it (jax.export strips donation by
+    itself), and the adopted call is bit-identical to the copying entry
+    while really consuming its carry."""
+    import jax
+    import jax.numpy as jnp
+    from jax import export as jexp
+
+    eng, _store, _res = exported_wide
+    fn = eng._core_from_donate
+    assert fn._donate_argnums == (1, 2, 3)
+    store = aot.ArtifactStore(tmp_path / "dstore")
+
+    def fresh_carry():
+        fw = eng._seed_dev(np.arange(64) % 96)
+        return fw, fw.copy(), tuple(
+            jnp.zeros_like(fw) for _ in range(eng.num_planes)
+        )
+
+    fw, vis, planes = fresh_carry()
+    args = (eng.arrs, fw, vis, planes, jnp.int32(0), jnp.int32(8))
+    sds = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype), args
+    )
+    exported = jexp.export(fn)(*sds)
+    store.put(SPEC, "core_from", exported.serialize(),
+              donate_argnums=fn._donate_argnums)
+    got = store.get(SPEC, "core_from", with_meta=True)
+    assert got is not None
+    payload, meta = got
+    assert meta["donate_argnums"] == [1, 2, 3]
+
+    adopted = aot.AdoptedProgram(
+        "core_from", jexp.deserialize(payload), eng._core_from,
+        store=store, donate_argnums=meta["donate_argnums"],
+    )
+    # Reference from the COPYING entry (reads its carry, donates nothing).
+    ref = eng._core_from(*args)
+    out = adopted(*args)  # consumes fw/vis/planes
+    for a, b in zip(
+        jax.tree_util.tree_leaves(ref), jax.tree_util.tree_leaves(out)
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    with pytest.raises(RuntimeError, match="deleted"):
+        np.asarray(fw)  # the adopted executable really donated
+
+    # And a header WITHOUT the key (a PR 9-era artifact) adopts as a
+    # plain copying wrapper — old stores stay valid.
+    plain = aot.AdoptedProgram(
+        "core_from", jexp.deserialize(payload), eng._core_from,
+    )
+    fw2, vis2, planes2 = fresh_carry()
+    plain(eng.arrs, fw2, vis2, planes2, jnp.int32(0), jnp.int32(8))
+    np.asarray(fw2)  # still alive: no donation without the header key

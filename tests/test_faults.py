@@ -239,3 +239,44 @@ def test_site_and_qualifier_targets_compose_and_round_trip():
         s.hit("fetch", lanes=64)
     with pytest.raises(ValueError, match="two sites"):
         faults.FaultSchedule.from_spec("oom@fetch@dispatch")
+
+
+def test_engine_build_site_fires_through_the_registry(line_graph):
+    """The `engine_build` site is drivable end-to-end (ISSUE 13 fault-
+    coverage audit): a transient armed at it fails the REAL registry
+    build once, and the spent budget lets the rebuild succeed."""
+    from tpu_bfs.serve.registry import EngineRegistry, EngineSpec
+
+    reg = EngineRegistry(capacity=1, warm=False)
+    key = reg.add_graph("g", line_graph)
+    spec = EngineSpec(graph_key=key, engine="wide", lanes=32, planes=5)
+    faults.arm_from_spec("transient@engine_build:n=1")
+    try:
+        with pytest.raises(RuntimeError, match="INTERNAL"):
+            reg.get(spec)
+        eng = reg.get(spec)  # budget spent: the retry path's rebuild
+    finally:
+        faults.disarm()
+    assert eng.lanes == 32
+    assert faults.ACTIVE is None
+
+
+def test_ckpt_load_site_fires_through_the_loader(line_graph, tmp_path):
+    """The `ckpt_load` site is drivable end-to-end: a transient armed at
+    it fails the REAL load once; the re-read returns the checkpoint."""
+    from tpu_bfs.utils.checkpoint import (
+        initial_checkpoint,
+        load_checkpoint,
+        save_checkpoint,
+    )
+
+    path = str(tmp_path / "q0.npz")
+    save_checkpoint(path, initial_checkpoint(line_graph.num_vertices, 0))
+    faults.arm_from_spec("transient@ckpt_load:n=1")
+    try:
+        with pytest.raises(RuntimeError, match="INTERNAL"):
+            load_checkpoint(path)
+        ckpt = load_checkpoint(path)  # budget spent
+    finally:
+        faults.disarm()
+    assert ckpt.source == 0 and ckpt.level == 0

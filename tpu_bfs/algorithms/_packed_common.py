@@ -19,6 +19,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
+from functools import partial
 from typing import NamedTuple
 
 import jax
@@ -388,10 +389,11 @@ class PullGateHost:
     jitted loop and record the per-level skipped-block counters
     (``last_gate_level_counts`` — same host-attribute idiom as the
     distributed engines' exchange accounting, collectives.py). Hosts set
-    ``pull_gate``, ``_gate_core_jit`` / ``_gate_core_from_jit``
-    (make_packed_loop gated entries), ``_lane_mask_dev`` (all-ones until
-    the first batch refines it — always safe, see host_lane_mask), and the
-    engine-protocol attributes ``_rank`` / ``_act`` / ``w``."""
+    ``pull_gate``, ``_gate_core_jit`` / ``_gate_core_from_jit`` /
+    ``_gate_core_from_donate_jit`` (make_packed_loop gated entries),
+    ``_lane_mask_dev`` (all-ones until the first batch refines it —
+    always safe, see host_lane_mask), and the engine-protocol attributes
+    ``_rank`` / ``_act`` / ``w``."""
 
     pull_gate = False
     last_gate_level_counts = None
@@ -416,6 +418,20 @@ class PullGateHost:
     def _gated_core_from(self, arrs, fw, vis, planes, level0, max_levels):
         fw_f, vis_f, planes_f, level, alive, gc = self._gate_core_from_jit(
             arrs, fw, vis, planes, level0, max_levels, self._lane_mask_dev
+        )
+        self.last_gate_level_counts = gc
+        return fw_f, vis_f, planes_f, level, alive
+
+    def _gated_core_from_donate(self, arrs, fw, vis, planes, level0,
+                                max_levels):
+        """The donating resume entry (ISSUE 13): same loop, carry
+        donated — advance_packed_batch's path, whose converted
+        checkpoint carries are dead after the call."""
+        fw_f, vis_f, planes_f, level, alive, gc = (
+            self._gate_core_from_donate_jit(
+                arrs, fw, vis, planes, level0, max_levels,
+                self._lane_mask_dev
+            )
         )
         self.last_gate_level_counts = gc
         return fw_f, vis_f, planes_f, level, alive
@@ -457,6 +473,16 @@ def make_packed_loop(hit_of, num_planes: int, *, gate_levels: int = 0,
     ``lane_mask`` argument (host_lane_mask), the state pass runs gated
     over unsettled GATE_TILE blocks (gated_state_update), and both return
     a trailing [gate_levels] int32 per-level skipped-block array.
+
+    Returns ``(core, core_from, core_from_donate)`` — the third is
+    ``core_from`` with the carry (fw/vis/planes) DONATED (ISSUE 13,
+    analysis pass 5): the resume path's outputs alias its inputs instead
+    of doubling the table residency per chunk. ``advance_packed_batch``
+    rides the donating entry (its converted checkpoint carries are dead
+    after the call by construction); ``core_from`` stays copying for the
+    callers that re-read their carries — the cap-boundary probe (which
+    must keep the pre-probe tables) and the roofline's CPU stepping
+    (which warms by double-calling the same arguments).
     """
     gated = gate_levels > 0
     if gated and act is None:
@@ -512,7 +538,7 @@ def make_packed_loop(hit_of, num_planes: int, *, gate_levels: int = 0,
 
     if gated:
 
-        @jax.jit
+        @jax.jit  # no-donate: fw0 doubles as the batch's src-bits view (fetch reads it after the loop)
         def core(arrs, fw0, max_levels, lane_mask):
             planes0 = tuple(jnp.zeros_like(fw0) for _ in range(num_planes))
             fw_f, vis_f, planes_f, levels, alive, gc = _run(
@@ -524,15 +550,23 @@ def make_packed_loop(hit_of, num_planes: int, *, gate_levels: int = 0,
             )
             return planes_f, vis_f, levels, alive, truncated, gc
 
-        @jax.jit
+        @jax.jit  # no-donate: the cap-boundary probe and roofline re-read their carries; advance rides core_from_donate
         def core_from(arrs, fw, vis, planes, level0, max_levels, lane_mask):
             return _run(
                 arrs, fw, vis, planes, level0, max_levels, lane_mask, _gc0()
             )
 
-        return core, core_from
+        @partial(jax.jit, donate_argnums=(1, 2, 3))
+        def core_from_donate(arrs, fw, vis, planes, level0, max_levels,
+                             lane_mask):
+            return _run(
+                arrs, fw, vis, planes, level0, max_levels, lane_mask, _gc0()
+            )
 
-    @jax.jit
+        core_from_donate._donate_argnums = (1, 2, 3)
+        return core, core_from, core_from_donate
+
+    @jax.jit  # no-donate: fw0 doubles as the batch's src-bits view (fetch reads it after the loop)
     def core(arrs, fw0, max_levels):
         planes0 = tuple(jnp.zeros_like(fw0) for _ in range(num_planes))
         fw_f, vis_f, planes_f, levels, alive, _ = _run(
@@ -543,12 +577,18 @@ def make_packed_loop(hit_of, num_planes: int, *, gate_levels: int = 0,
         )
         return planes_f, vis_f, levels, alive, truncated
 
-    @jax.jit
+    @jax.jit  # no-donate: the cap-boundary probe and roofline re-read their carries; advance rides core_from_donate
     def core_from(arrs, fw, vis, planes, level0, max_levels):
         out = _run(arrs, fw, vis, planes, level0, max_levels, None, _gc0())
         return out[:5]
 
-    return core, core_from
+    @partial(jax.jit, donate_argnums=(1, 2, 3))
+    def core_from_donate(arrs, fw, vis, planes, level0, max_levels):
+        out = _run(arrs, fw, vis, planes, level0, max_levels, None, _gc0())
+        return out[:5]
+
+    core_from_donate._donate_argnums = (1, 2, 3)
+    return core, core_from, core_from_donate
 
 
 class ExpandSpec(NamedTuple):
@@ -1460,7 +1500,13 @@ def advance_packed_batch(engine, ckpt, levels: int | None = None):
     vis = packed_real_to_table(engine, ckpt.visited)
     planes = tuple(packed_real_to_table(engine, p) for p in ckpt.planes)
     fw = to_fw(ckpt.frontier)
-    fw_f, vis_f, planes_f, level, alive = engine._core_from(
+    # The donating resume entry (ISSUE 13) where the engine provides one:
+    # fw/vis/planes are fresh conversions of the host checkpoint, dead
+    # after this call — donating them lets the loop's outputs alias their
+    # buffers instead of doubling the table residency per chunk. Engines
+    # without a donating twin (the 512-lane packed engine) keep copying.
+    core_from = getattr(engine, "_core_from_donate", None) or engine._core_from
+    fw_f, vis_f, planes_f, level, alive = core_from(
         engine.arrs, fw, vis, planes, jnp.int32(ckpt.level), jnp.int32(ml)
     )
     if bool(alive) and int(level) >= cap:

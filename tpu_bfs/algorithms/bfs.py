@@ -28,14 +28,23 @@ from tpu_bfs.algorithms.frontier import (
 from tpu_bfs.utils.timing import run_timed
 
 
-@partial(jax.jit, static_argnames=("backend", "caps"), donate_argnums=())
+@partial(jax.jit, static_argnames=("backend", "caps"), donate_argnums=(1, 2, 3))
 def _bfs_core(edges, frontier0, visited0, dist0, level0, max_levels, *, backend, caps=()):
     """The compiled level loop. All shapes static; source/levels traced.
 
     ``level0`` is the level counter of the incoming state (0 for a fresh
     traversal, >0 when resuming from a checkpoint); the loop stops when the
     frontier empties or the counter reaches ``max_levels``. Returns the full
-    state so callers can checkpoint and resume."""
+    state so callers can checkpoint and resume.
+
+    The carry (frontier/visited/dist) is DONATED: the outputs alias the
+    input buffers instead of doubling the state's residency for the call
+    (pass 5 of tpu_bfs/analysis verifies the aliasing from the compiled
+    HLO). Callers must treat those three arguments as consumed — both
+    call sites below construct them fresh per call, and ``_init_state``
+    materializes ``visited0`` as its own buffer (donating one array
+    through two donated parameters is rejected by PJRT at execute
+    time)."""
 
     def cond(state):
         frontier, _, _, level = state
@@ -52,6 +61,12 @@ def _bfs_core(edges, frontier0, visited0, dist0, level0, max_levels, *, backend,
         cond, body, (frontier0, visited0, dist0, jnp.int32(level0))
     )
     return frontier, visited, dist, level
+
+
+# Donation tag for the analysis layer (pass 5's HLO aliasing certificate)
+# and the AOT store (the adopting wrapper re-applies donation — jax.export
+# does not carry it through deserialization by itself).
+_bfs_core._donate_argnums = (1, 2, 3)
 
 
 @dataclasses.dataclass
@@ -137,7 +152,11 @@ class BfsEngine:
     def _init_state(self, source):
         vp = self.vp
         frontier0 = jnp.zeros((vp,), jnp.bool_).at[source].set(True)
-        visited0 = frontier0
+        # A distinct buffer, not an alias of frontier0: both flow into
+        # donated parameters of _bfs_core, and PJRT rejects one buffer
+        # donated through two parameters at execute time (the same rule
+        # utils/roofline.py documents for the packed step).
+        visited0 = frontier0.copy()
         dist0 = jnp.full((vp,), INT32_MAX, jnp.int32).at[source].set(0)
         return frontier0, visited0, dist0
 

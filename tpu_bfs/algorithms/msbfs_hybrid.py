@@ -560,14 +560,18 @@ class HybridMsBfsEngine(PackedRunProtocol, PullGateHost,
             self._lane_mask_dev = jnp.full(
                 (self.w,), 0xFFFFFFFF, jnp.uint32
             )
-            self._gate_core_jit, self._gate_core_from_jit = _make_core(
+            (
+                self._gate_core_jit, self._gate_core_from_jit,
+                self._gate_core_from_donate_jit,
+            ) = _make_core(
                 hg, self.w, num_planes, interpret,
                 gate_levels=self.max_levels_cap,
             )
             self._core = self._gated_core
             self._core_from = self._gated_core_from
+            self._core_from_donate = self._gated_core_from_donate
         else:
-            self._core, self._core_from = _make_core(
+            self._core, self._core_from, self._core_from_donate = _make_core(
                 hg, self.w, num_planes, interpret, adaptive_push
             )
         self.arrs = arrs

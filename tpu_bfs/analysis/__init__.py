@@ -27,11 +27,31 @@ file so findings gate CI:
   cross-module lock-acquisition-order graph that must stay acyclic.
 - **dtype** (:mod:`.dtypes`): no f64 / accidental 64-bit widening in any
   compiled hot program.
+- **memory** (:mod:`.memory`, ISSUE 13): the static HBM budget — a
+  peak-bytes estimate per compiled engine program (jax
+  ``memory_analysis()`` where available, HLO buffer walk fallback), an
+  analytic ladder model proving modeled peak STRICTLY monotone in rung
+  width for every EngineSpec family the serve registry can build (the
+  OOM/mesh-degrade ladders provably shrink memory), and a buffer-
+  donation lint (undonated loop carries, dead ``donate_argnums=()``)
+  with an HLO input-output-alias certificate for applied donations.
+- **lifecycle** (:mod:`.lifecycle`, ISSUE 13): path-sensitive
+  exception-flow verification over serve/obs/resilience — every span
+  ``begin`` reaches an ``end`` on all paths including raises
+  (``# span-outlives:`` documents deliberate cross-function ownership),
+  every bare lock acquire a release, every ResumeCache put a drop.
+- **faultcov** (:mod:`.faultcov`, ISSUE 13): ``faults.SITES`` vs the
+  actual consultation call sites (undeclared consults, never-consulted
+  declared sites) plus a site x kind coverage map over tests/ and the
+  chaos smokes — a new fault site cannot land untested.
 
 Findings are stable-fingerprinted (``pass:where``); the baseline file
 (one fingerprint per line, ``#`` comments) suppresses known findings so
 the CLI can gate on NEW ones only. A baseline entry matching nothing is
 reported as stale — suppressions must not outlive their findings.
+``tpu-bfs-analyze --json`` emits the whole report (per-pass findings,
+certificates, fingerprints) as machine-readable JSON — the
+chip-session pre-flight consumes that instead of scraping exit text.
 """
 
 from __future__ import annotations
@@ -41,7 +61,10 @@ import dataclasses
 DEFAULT_BASELINE = "analysis-baseline.txt"
 
 #: Pass registry order — also the CLI's execution and report order.
-PASSES = ("uniformity", "transfer", "locks", "dtype")
+PASSES = (
+    "uniformity", "transfer", "locks", "dtype",
+    "memory", "lifecycle", "faultcov",
+)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -9,16 +9,26 @@ finding no longer exists — are reported so they get deleted, and
 ``--write-baseline`` rewrites the file from the current findings when a
 known issue must be parked rather than fixed.
 
-``--fast`` runs the trace-only subset (the uniformity taint + dtype
-walks over the planner programs, and the whole AST lock lint) — seconds,
-no XLA compile. The default runs everything: all engine configs
-compiled, their HLO conditional/host-op/dtype audits, the
-transfer-guard drives, and the retrace/lazy-distance sentinels.
+``--fast`` runs the compile-free subset (the uniformity taint + dtype
+walks over the planner programs, the AST lock lint, the donation lint +
+ladder budget model, the lifecycle exception-flow walk, and the
+fault-coverage audit) — seconds, no XLA compile. The default runs
+everything: all engine configs compiled, their HLO conditional/host-op/
+dtype audits, the per-program peak-memory estimates and donation-alias
+certificates, the transfer-guard drives, and the retrace/lazy-distance
+sentinels.
+
+``--json`` writes the whole report to stdout as one JSON object
+(``ok``, per-finding pass/where/message/fingerprint, suppressed/stale
+lists, per-pass info, and the memory/fault-coverage certificates) — the
+chip-session pre-flight consumes this instead of scraping exit text.
+Exit status semantics are unchanged.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 
 from tpu_bfs.analysis import (
@@ -28,12 +38,21 @@ from tpu_bfs.analysis import (
     load_baseline,
 )
 
+#: Flagship modeling point for the ladder budget check: the scale-21
+#: RMAT shape the perf series runs (ROADMAP "Perf trajectory") — the
+#: monotonicity verdict is structural per family, not graph-specific,
+#: but the logged byte figures should be read at a real operating point.
+MODEL_VERTICES = 1 << 21
+MODEL_EDGES = 1 << 25
+#: The canonical virtual-mesh width every distributed test/config uses.
+MODEL_DEVICES = 8
+
 
 def _log(msg: str) -> None:
     print(f"# {msg}", file=sys.stderr, flush=True)
 
 
-def run_locks() -> list[Finding]:
+def run_locks() -> tuple[list[Finding], dict]:
     from tpu_bfs.analysis.locks import lint_tree, repo_root
 
     findings, info = lint_tree(repo_root())
@@ -42,7 +61,74 @@ def run_locks() -> list[Finding]:
         f"guarded attrs, {len(info['edges'])} lock-order edges, "
         f"{len(findings)} finding(s)"
     )
-    return findings
+    return findings, {
+        "classes": info["classes"],
+        "guarded_attrs": info["guarded_attrs"],
+        "edges": len(info["edges"]),
+    }
+
+
+def run_memory_static() -> tuple[list[Finding], dict]:
+    """Pass 5's compile-free half: the donation lint over the engine-core
+    modules and the ladder budget model over every registry-buildable
+    EngineSpec family."""
+    from tpu_bfs.analysis.locks import repo_root
+    from tpu_bfs.analysis.memory import (
+        check_registry_ladders,
+        lint_donation_tree,
+    )
+
+    findings, lint_info = lint_donation_tree(repo_root())
+    ladder_findings, ladders = check_registry_ladders(
+        num_vertices=MODEL_VERTICES, num_edges=MODEL_EDGES,
+        device_count=MODEL_DEVICES,
+    )
+    findings += ladder_findings
+    _log(
+        f"memory: {lint_info['jit_defs']} jit defs "
+        f"({lint_info['donating']} donating, "
+        f"{lint_info['carry_style']} carry-style, "
+        f"{lint_info['no_donate']} annotated no-donate), "
+        f"{len(ladders)} ladder families "
+        f"({sum(len(v) for v in ladders.values())} rungs), "
+        f"{len(findings)} finding(s)"
+    )
+    info = dict(lint_info)
+    info["ladders"] = {
+        fam: [{"lanes": w, "model_bytes": b} for w, b in entries]
+        for fam, entries in ladders.items()
+    }
+    return findings, info
+
+
+def run_lifecycle() -> tuple[list[Finding], dict]:
+    from tpu_bfs.analysis.lifecycle import check_tree
+    from tpu_bfs.analysis.locks import repo_root
+
+    findings, info = check_tree(repo_root())
+    _log(
+        f"lifecycle: {info['functions']} functions walked, "
+        f"{info['span_outlives']} annotated span escapes, "
+        f"{len(findings)} finding(s)"
+    )
+    return findings, info
+
+
+def run_faultcov() -> tuple[list[Finding], dict]:
+    from tpu_bfs.analysis.faultcov import check_tree
+    from tpu_bfs.analysis.locks import repo_root
+
+    findings, info = check_tree(repo_root())
+    _log(
+        f"faultcov: {len(info['sites'])} consulted sites, "
+        f"{sum(len(v) for v in info['coverage'].values())} covered "
+        f"site-kind pairs, {len(findings)} finding(s)"
+    )
+    info = {
+        "sites": info["sites"],
+        "coverage": info["coverage"],
+    }
+    return findings, info
 
 
 def _ensure_mesh() -> None:
@@ -54,25 +140,34 @@ def _ensure_mesh() -> None:
     ensure_virtual_devices(8)
 
 
-def run_program_passes(configs, skip: set, *, compiled: bool) -> list[Finding]:
+def run_program_passes(
+    configs, skip: set, *, compiled: bool
+) -> tuple[list[Finding], dict]:
     """One sweep over the engine-program inventory, each engine built and
     traced ONCE: the uniformity taint + dtype walks share the trace, and
     in ``compiled`` mode the same spec is lowered once for the HLO
-    conditional/host-op/dtype audits plus the transfer-guard drive. Each
-    check family honors its entry in ``skip`` — a skipped pass emits no
-    findings (in particular, skipping uniformity also skips the HLO
-    conditional audit, which without taint certificates would flag the
-    planner's legitimately-differing arms)."""
+    conditional/host-op/dtype audits, the peak-memory estimate +
+    donation-alias certificate (pass 5's compiled half), and the
+    transfer-guard drive. Each check family honors its entry in ``skip``
+    — a skipped pass emits no findings (in particular, skipping
+    uniformity also skips the HLO conditional audit, which without taint
+    certificates would flag the planner's legitimately-differing arms)."""
     import jax
 
     from tpu_bfs.analysis import dtypes, transfer, uniformity
     from tpu_bfs.analysis.configs import iter_programs
     from tpu_bfs.analysis.hlo import wide_dtype_lines
+    from tpu_bfs.analysis.memory import (
+        check_program_donation,
+        estimate_compiled,
+    )
 
     do_uni = "uniformity" not in skip
     do_dtype = "dtype" not in skip
     do_transfer = compiled and "transfer" not in skip
+    do_memory = compiled and "memory" not in skip
     findings: list[Finding] = []
+    estimates: list[dict] = []
     for spec in iter_programs(configs):
         closed = jax.make_jaxpr(spec.fn)(*spec.args)
         rep = None
@@ -89,7 +184,8 @@ def run_program_passes(configs, skip: set, *, compiled: bool) -> list[Finding]:
             findings.extend(dtypes.check_jaxpr(spec.name, closed))
         if not compiled:
             continue
-        hlo = spec.lower_hlo()
+        compiled_obj = spec.lower_compiled()
+        hlo = compiled_obj.as_text()
         cond_f = (
             uniformity.check_hlo_conditionals(spec.name, hlo, rep)
             if do_uni else []
@@ -111,13 +207,27 @@ def run_program_passes(configs, skip: set, *, compiled: bool) -> list[Finding]:
             transfer.check_loop_transfer_guard(spec.name, spec.fn, spec.args)
             if do_transfer else []
         )
-        findings.extend(cond_f + host_f + dtype_f + guard_f)
+        mem_f: list[Finding] = []
+        if do_memory:
+            est = estimate_compiled(spec.name, compiled_obj)
+            estimates.append(est)
+            mem_f = check_program_donation(spec.name, spec.fn, hlo)
+            peak = est.get("peak_bytes")
+            _log(
+                f"memory[{spec.name}]: peak~"
+                f"{peak / 1e6:.2f} MB ({est['source']}"
+                f"{', donated' if est.get('donated') else ''})"
+                if peak is not None
+                else f"memory[{spec.name}]: estimate unavailable"
+            )
+        findings.extend(cond_f + host_f + dtype_f + guard_f + mem_f)
         _log(
             f"hlo[{spec.name}]: {len(cond_f)} conditional, "
             f"{len(host_f)} host-op, {len(dtype_f)} dtype, "
-            f"{len(guard_f)} transfer-guard finding(s)"
+            f"{len(guard_f)} transfer-guard, {len(mem_f)} donation "
+            f"finding(s)"
         )
-    return findings
+    return findings, {"program_estimates": estimates}
 
 
 def run_sentinels() -> list[Finding]:
@@ -136,35 +246,59 @@ def run_sentinels() -> list[Finding]:
     return findings
 
 
+def _finding_json(f: Finding) -> dict:
+    return {
+        "pass": f.pass_name,
+        "where": f.where,
+        "message": f.message,
+        "fingerprint": f.fingerprint,
+    }
+
+
 def main(argv=None) -> int:
+    from tpu_bfs.analysis import PASSES
+
     ap = argparse.ArgumentParser(
         prog="tpu-bfs-analyze",
         description="Static verification of the mesh programs and the "
         "serve tier: collective-uniformity taint + HLO signatures, "
-        "transfer/retrace guards, lock-discipline lint, dtype lint.",
+        "transfer/retrace guards, lock-discipline lint, dtype lint, "
+        "HBM budget + donation lint, exception-path lifecycle "
+        "verification, fault-site coverage audit.",
     )
     ap.add_argument("--fast", action="store_true",
-                    help="trace-only subset (no XLA compiles): the "
-                    "uniformity/dtype walks over the planner programs "
-                    "plus the full AST lock lint — the tier-1 shape")
+                    help="compile-free subset (no XLA compiles): the "
+                    "uniformity/dtype walks over the planner programs, "
+                    "the AST lock + donation lints, the ladder budget "
+                    "model, the lifecycle walk, and the fault-coverage "
+                    "audit — the tier-1 shape")
     ap.add_argument("--configs", default=None, metavar="A,B",
                     help="restrict the engine-config sweep (names from "
                     "tpu_bfs/analysis/configs.py; default: all, or the "
                     "fast subset under --fast)")
     ap.add_argument("--skip", default="", metavar="PASS,..",
-                    help="skip passes: any of uniformity,transfer,"
-                    "locks,dtype (skipping uniformity also skips the "
-                    "HLO conditional audit, which needs its taint "
-                    "certificates)")
+                    help=f"skip passes: any of {','.join(PASSES)} "
+                    "(skipping uniformity also skips the HLO conditional "
+                    "audit, which needs its taint certificates)")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,
                     help=f"suppression file (default {DEFAULT_BASELINE}; "
                     "missing = empty)")
     ap.add_argument("--write-baseline", action="store_true",
                     help="rewrite the baseline file from the current "
                     "findings and exit 0")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="write the full machine-readable report (per-"
+                    "pass findings, certificates, fingerprints) to "
+                    "stdout as one JSON object; exit status unchanged "
+                    "(the chip-session pre-flight consumes this)")
     args = ap.parse_args(argv)
 
     skip = {tok.strip() for tok in args.skip.split(",") if tok.strip()}
+    unknown_skips = skip - set(PASSES)
+    if unknown_skips:
+        _log(f"unknown pass(es) in --skip: {sorted(unknown_skips)}; "
+             f"have: {', '.join(PASSES)}")
+        return 2
     if args.fast:
         from tpu_bfs.analysis.configs import FAST_CONFIGS
 
@@ -184,16 +318,32 @@ def main(argv=None) -> int:
             return 2
 
     findings: list[Finding] = []
+    pass_info: dict = {}
     if "locks" not in skip:
-        findings += run_locks()
+        lock_f, pass_info["locks"] = run_locks()
+        findings += lock_f
+    if "memory" not in skip:
+        mem_f, pass_info["memory"] = run_memory_static()
+        findings += mem_f
+    if "lifecycle" not in skip:
+        life_f, pass_info["lifecycle"] = run_lifecycle()
+        findings += life_f
+    if "faultcov" not in skip:
+        cov_f, pass_info["faultcov"] = run_faultcov()
+        findings += cov_f
     program_passes = {"uniformity", "dtype"} | (
-        set() if args.fast else {"transfer"}
+        set() if args.fast else {"transfer", "memory"}
     )
     if not (program_passes <= skip):
         _ensure_mesh()
-        findings += run_program_passes(
+        prog_f, prog_info = run_program_passes(
             configs, skip, compiled=not args.fast
         )
+        findings += prog_f
+        if "memory" not in skip:
+            # The report must not claim a skipped pass ran and found
+            # nothing — estimates only land when the pass was on.
+            pass_info.setdefault("memory", {}).update(prog_info)
     if not args.fast and "transfer" not in skip:
         findings += run_sentinels()
 
@@ -210,8 +360,17 @@ def main(argv=None) -> int:
     new, suppressed, stale = apply_baseline(
         findings, load_baseline(args.baseline)
     )
-    for f in new:
-        print(f.render())
+    if args.as_json:
+        print(json.dumps({
+            "ok": not new,
+            "findings": [_finding_json(f) for f in new],
+            "suppressed": [_finding_json(f) for f in suppressed],
+            "stale_baseline": sorted(stale),
+            "passes": pass_info,
+        }, indent=2, sort_keys=True))
+    else:
+        for f in new:
+            print(f.render())
     for fp in sorted(stale):
         _log(f"STALE baseline entry (no matching finding — delete it): {fp}")
     _log(

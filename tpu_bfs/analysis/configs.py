@@ -32,8 +32,13 @@ class ProgramSpec:
     def name(self) -> str:
         return f"{self.config}/{self.label}"
 
+    def lower_compiled(self):
+        """The compiled executable object — one compile shared by the
+        HLO text audits and the memory estimator (pass 5)."""
+        return self.fn.lower(*self.args).compile()
+
     def lower_hlo(self) -> str:
-        return self.fn.lower(*self.args).compile().as_text()
+        return self.lower_compiled().as_text()
 
 
 @lru_cache(maxsize=1)

@@ -270,6 +270,99 @@ def host_transfer_lines(hlo_text: str) -> list[dict]:
     return out
 
 
+# --- buffer donation (input/output aliasing) --------------------------------
+
+# HloModule header: 'input_output_alias={ {0}: (1, {}, may-alias), ... }' —
+# one entry per donated parameter the compiler actually aliased into an
+# output. The braces nest, so the body is extracted by brace counting.
+_ALIAS_ENTRY = re.compile(r"\{[\d,\s]*\}:\s*\((\d+)")
+
+
+def input_output_aliases(hlo_text: str) -> list[int]:
+    """Parameter numbers the compiled module aliases into outputs — the
+    proof a ``donate_argnums`` actually landed (XLA silently drops
+    donations it cannot use; a dropped donation doubles the carry's
+    footprint exactly where the donor expected it halved)."""
+    for line in hlo_text.splitlines():
+        start = line.find("input_output_alias={")
+        if start < 0:
+            continue
+        depth = 0
+        body = []
+        for ch in line[start + len("input_output_alias=") :]:
+            if ch == "{":
+                depth += 1
+            elif ch == "}":
+                depth -= 1
+                if depth == 0:
+                    break
+            body.append(ch)
+        return [int(x) for x in _ALIAS_ENTRY.findall("".join(body))]
+    return []
+
+
+# --- static buffer walk (peak-HBM fallback) ---------------------------------
+
+
+def hlo_buffer_estimate(hlo_text: str) -> dict:
+    """Static peak-memory MODEL from HLO text alone — the fallback when
+    ``compiled.memory_analysis()`` is unavailable on a backend.
+
+    The walk prices (a) the entry computation's parameters, (b) its root
+    shape, and (c) the largest per-computation live-set proxy: the sum of
+    distinct result shapes a single computation produces (an overestimate
+    of its live set — every buffer counted at once — which is the safe
+    direction for a budget check). Donated aliases are REPORTED
+    (``alias_count``) but deliberately not credited against the peak:
+    the text walk cannot see which temp the alias saved, and an
+    overestimate stays on the safe side of a budget gate — so for
+    donating cores this fallback reads systematically higher than
+    ``memory_analysis`` (which does credit ``alias_size_in_bytes``)."""
+    comps = hlo_computations(hlo_text)
+    entry = None
+    for line in hlo_text.splitlines():
+        m = re.match(r"^ENTRY\s+%?([\w.\-]+)", line.strip())
+        if m:
+            entry = m.group(1)
+            break
+
+    def _result_bytes(line: str) -> int:
+        m = _INSTR.search(line)
+        if not m:
+            return 0
+        total = 0
+        for t in _SHAPE_RE.finditer(m.group(1)):
+            if t.group(1) in _DTYPE_BYTES:
+                try:
+                    total += shape_bytes(t.group(0))
+                except ValueError:
+                    pass
+        return total
+
+    arg_bytes = 0
+    out_bytes = 0
+    if entry is not None:
+        for line in comps.get(entry, ()):
+            s = line.strip()
+            if re.search(r"=\s+(?:\([^)]*\)|\S+)\s+parameter\(", s):
+                arg_bytes += _result_bytes(s)
+            if s.startswith("ROOT"):
+                out_bytes = _result_bytes(s)
+    temp_proxy = 0
+    for comp, lines in comps.items():
+        total = sum(_result_bytes(ln) for ln in lines)
+        temp_proxy = max(temp_proxy, total)
+    aliased = input_output_aliases(hlo_text)
+    return {
+        "argument_bytes": arg_bytes,
+        "output_bytes": out_bytes,
+        "temp_bytes": temp_proxy,
+        "alias_count": len(aliased),
+        "peak_bytes": arg_bytes + max(temp_proxy, out_bytes),
+        "source": "hlo-walk",
+    }
+
+
 # --- wide dtypes ------------------------------------------------------------
 
 _WIDE_SHAPE = re.compile(r"\b(f64|s64|u64|c128)\[")

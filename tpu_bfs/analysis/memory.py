@@ -1,0 +1,567 @@
+"""Pass 5: static HBM budget + buffer-donation lint (ISSUE 13).
+
+The OOM halving ladder, the AOT preheat store, and the mesh-failover
+rungs all ASSUME narrower configs fit in less HBM; nothing proved it.
+Three checks make the assumption a theorem:
+
+- **per-program peak estimate** (:func:`estimate_compiled`): jax's
+  ``compiled.memory_analysis()`` where the backend provides it
+  (CompiledMemoryStats: argument/output/temp/alias bytes), the HLO
+  buffer walk (:func:`tpu_bfs.analysis.hlo.hlo_buffer_estimate`) as the
+  fallback — every engine program in the sweep gets a peak-bytes
+  certificate in the report.
+- **ladder budget model** (:func:`model_spec_peak_bytes` /
+  :func:`check_ladder_entries` / :func:`check_registry_ladders`): an
+  analytic per-engine-family peak model (the ``auto_lanes`` pricing the
+  engines already size themselves with, plus per-lane and fixed
+  residents) evaluated at every rung of every width ladder the serve
+  registry can build — modeled peak must be STRICTLY monotone in rung
+  width, so walking the OOM/mesh-degrade ladder down provably shrinks
+  memory. The model prices TPU-physical table widths
+  (``tpu_padded_words``: sub-128-word tables pad up), so the monotone
+  margin below 4096 lanes comes from the honest per-lane terms — the
+  model never credits a narrow rung with table savings TPU doesn't give.
+- **donation lint** (:func:`lint_donation_sources`): an AST pass over
+  the engine-core modules. A jit definition whose parameters feed a
+  ``lax.while_loop``/``fori_loop`` carry (directly or through one local
+  helper) is *carry-style*: without ``donate_argnums`` covering at least
+  one carried parameter, the old and new carries are simultaneously live
+  — the exact double-residency utils/roofline.py documents OOM'ing at
+  flagship scale. Findings: an undonated carry, and the dead
+  ``donate_argnums=()`` annotation (satisfies a grep, donates nothing).
+  A deliberate non-donating entry is annotated ``# no-donate: <why>`` on
+  its def/assignment line (e.g. the packed ``core``, whose seed table
+  doubles as the batch's src-bits view and MUST survive the call).
+  Applied donations are verified from the artifact:
+  :func:`check_program_donation` fails when a tagged-donating program's
+  compiled HLO carries no ``input_output_alias`` entry (XLA silently
+  drops unusable donations).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+
+from tpu_bfs.analysis import Finding
+
+NO_DONATE_RE = re.compile(r"#\s*no-donate:\s*(.+)")
+
+#: The engine-core modules the repo-level donation lint covers: every
+#: module defining a level-loop jit whose carry the serve/checkpoint
+#: paths hand back in (ISSUE 13 tentpole scope).
+DEFAULT_DONATION_MODULES = (
+    "tpu_bfs/algorithms/bfs.py",
+    "tpu_bfs/algorithms/_packed_common.py",
+    "tpu_bfs/parallel/dist_bfs.py",
+    "tpu_bfs/parallel/dist_bfs2d.py",
+    "tpu_bfs/utils/roofline.py",
+)
+
+
+# --- compiled-program peak estimate ----------------------------------------
+
+
+def estimate_compiled(name: str, compiled) -> dict:
+    """Peak-memory estimate of one compiled program: jax's own
+    ``memory_analysis()`` when the backend reports it, the HLO buffer
+    walk otherwise. Returns the certificate dict the JSON report
+    carries; never raises (an estimator must not fail the program it
+    measures)."""
+    from tpu_bfs.analysis.hlo import hlo_buffer_estimate, input_output_aliases
+
+    stats = None
+    try:
+        stats = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001 — backend-optional API
+        stats = None
+    text = None
+    if stats is not None:
+        try:
+            arg = int(stats.argument_size_in_bytes)
+            out = int(stats.output_size_in_bytes)
+            tmp = int(stats.temp_size_in_bytes)
+            alias = int(stats.alias_size_in_bytes)
+            return {
+                "program": name,
+                "argument_bytes": arg,
+                "output_bytes": out,
+                "temp_bytes": tmp,
+                "alias_bytes": alias,
+                "donated": alias > 0,
+                # Peak live set: arguments resident + temps + the output
+                # share not aliased back onto donated arguments.
+                "peak_bytes": arg + tmp + max(out - alias, 0),
+                "source": "memory_analysis",
+            }
+        except (AttributeError, TypeError):
+            stats = None
+    try:
+        text = compiled.as_text()
+    except Exception:  # noqa: BLE001 — estimator must not fail the sweep
+        return {"program": name, "peak_bytes": None, "source": "unavailable"}
+    est = hlo_buffer_estimate(text)
+    est["program"] = name
+    # The text walk knows which parameters aliased but not their bytes;
+    # the boolean `donated` is the signal both branches share (the CLI's
+    # "donated" label and any report consumer key on it, never on
+    # alias_bytes truthiness).
+    est["donated"] = est.get("alias_count", 0) > 0
+    return est
+
+
+def check_program_donation(name: str, fn, hlo_text: str) -> list[Finding]:
+    """A program tagged donating (``fn._donate_argnums``) must show at
+    least one ``input_output_alias`` entry in its compiled HLO — the
+    donation actually landed. XLA drops donations it cannot alias
+    (shape/layout mismatch) WITHOUT failing the compile, which would
+    silently re-inflate the carry's footprint."""
+    from tpu_bfs.analysis.hlo import input_output_aliases
+
+    donated = getattr(fn, "_donate_argnums", ())
+    if not donated:
+        return []
+    if input_output_aliases(hlo_text):
+        return []
+    return [Finding(
+        "memory/donation",
+        f"{name}:input-output-alias",
+        f"program is tagged donating (argnums {tuple(donated)}) but its "
+        f"compiled HLO carries no input_output_alias entry — XLA dropped "
+        f"the donation (shape/layout mismatch between the donated "
+        f"parameter and every output), so the carry is double-resident "
+        f"again. Align the donated parameter's shape with the output it "
+        f"should alias.",
+    )]
+
+
+# --- ladder budget model ----------------------------------------------------
+
+# Bytes per lane of lane-indexed host/device residents outside the packed
+# tables: the seed triplet (rows/words/bits, i32+i32+u32), the per-lane
+# reached/edges results (2 x i64), and the [w, 32] ecc summary's share.
+LANE_BYTES = 36
+# Bytes per edge slot of the resident graph structures (bucketed-ELL
+# index tables plus their transpose padding, ~2 x i32 per slot).
+EDGE_BYTES = 8
+# The hybrid engine's dense-tile budget (MXU tiles resident next to the
+# residual ELL — sized once, lane-independent).
+HYBRID_TILE_BYTES = 1 << 27
+# dist2d per-vertex loop state on one chip: frontier + visited (pred)
+# + distance (i32) per concurrently-launched single-source loop.
+DIST2D_STATE_BYTES = 6
+
+
+def model_spec_peak_bytes(
+    engine: str, lanes: int, *, planes: int = 8, devices: int = 1,
+    num_vertices: int, num_edges: int,
+) -> dict:
+    """Modeled peak HBM of one serving engine config on ONE chip.
+
+    The packed-table term is exactly the ``auto_lanes`` sizing model the
+    engines construct themselves with ((planes + 6) live [rows, w]
+    uint32 tables at TPU-physical width); the per-lane and fixed terms
+    make the model strictly monotone in lane count even where the
+    physical table width plateaus (below 128 words every width pads to
+    128 — the round-4 LJ OOM lesson, ``tpu_padded_words``). dist2d has
+    no packed table: its per-chip state is one (frontier, visited,
+    distance) vector triple per concurrently-launched source loop.
+    CPU-safe: pure arithmetic, no engine build, no compile."""
+    from tpu_bfs.algorithms._packed_common import tpu_padded_words
+
+    rows_local = -(-int(num_vertices) // max(int(devices), 1)) + 1
+    edges_local = -(-int(num_edges) // max(int(devices), 1))
+    fixed = EDGE_BYTES * edges_local
+    if engine == "hybrid":
+        fixed += HYBRID_TILE_BYTES
+    if engine == "dist2d":
+        state = DIST2D_STATE_BYTES * rows_local * int(lanes)
+    else:
+        w = max(int(lanes) // 32, 1)
+        state = (int(planes) + 6) * rows_local * tpu_padded_words(w) * 4
+    lane_term = LANE_BYTES * int(lanes)
+    return {
+        "engine": engine,
+        "lanes": int(lanes),
+        "devices": int(devices),
+        "state_bytes": int(state),
+        "lane_bytes": int(lane_term),
+        "fixed_bytes": int(fixed),
+        "total_bytes": int(state + lane_term + fixed),
+    }
+
+
+def check_ladder_entries(family: str, entries) -> list[Finding]:
+    """``entries`` = ``[(width, modeled_bytes), ...]``: modeled peak must
+    be STRICTLY monotone in rung width, or the OOM/mesh-degrade ladder
+    walks to a rung that frees nothing — the halving ladder's core
+    assumption, now checked instead of believed."""
+    entries = sorted(entries)
+    out: list[Finding] = []
+    for (w0, b0), (w1, b1) in zip(entries, entries[1:]):
+        if w0 == w1:
+            out.append(Finding(
+                "memory/ladder",
+                f"{family}:w{w0}",
+                f"ladder family {family} lists rung width {w0} twice — "
+                f"the degrade walk cannot make progress between equal "
+                f"rungs.",
+            ))
+        elif b1 <= b0:
+            out.append(Finding(
+                "memory/ladder",
+                f"{family}:w{w0}->w{w1}",
+                f"modeled peak is not strictly monotone in rung width for "
+                f"{family}: {w1} lanes models {b1} bytes <= {w0} lanes' "
+                f"{b0} bytes — degrading {w1} -> {w0} would free nothing. "
+                f"Check the family's per-lane terms (a width-independent "
+                f"model cannot justify a halving ladder).",
+            ))
+    return out
+
+
+def registry_ladder_families(
+    *, num_vertices: int, num_edges: int, device_count: int = 8,
+) -> dict:
+    """``{family: [(width, modeled_bytes), ...]}`` for every EngineSpec
+    family the serve registry can build (``ENGINE_KINDS`` x mesh), each
+    over the exact rung grid ``build_width_ladder`` would warm — the
+    same floors and quanta the OOM halving and the mesh degrade walk.
+    """
+    from tpu_bfs.serve.frontend import build_width_ladder
+    from tpu_bfs.serve.registry import DEFAULT_PLANES, HYBRID_LANE_QUANTUM
+
+    # (engine, devices, top width): the widest serving rung per family.
+    families = [
+        ("wide", 1, 4096),
+        ("packed", 1, 512),
+        ("hybrid", 1, 2 * HYBRID_LANE_QUANTUM),
+    ]
+    if device_count > 1:
+        families += [
+            ("wide", device_count, 4096),
+            ("hybrid", device_count, 2 * HYBRID_LANE_QUANTUM),
+            ("dist2d", device_count, 1024),
+        ]
+    out = {}
+    for engine, devices, lanes in families:
+        rungs = build_width_ladder(
+            lanes, "auto", devices=devices, engine=engine
+        )
+        out[f"{engine}-d{devices}"] = [
+            (
+                w,
+                model_spec_peak_bytes(
+                    engine, w, planes=DEFAULT_PLANES, devices=devices,
+                    num_vertices=num_vertices, num_edges=num_edges,
+                )["total_bytes"],
+            )
+            for w in rungs
+        ]
+    return out
+
+
+def check_registry_ladders(
+    *, num_vertices: int, num_edges: int, device_count: int = 8,
+) -> tuple[list[Finding], dict]:
+    """The acceptance check: every registry-buildable EngineSpec family's
+    modeled ladder is strictly monotone in rung width. Returns
+    ``(findings, {family: entries})`` — the entries double as the JSON
+    report's ladder certificates."""
+    ladders = registry_ladder_families(
+        num_vertices=num_vertices, num_edges=num_edges,
+        device_count=device_count,
+    )
+    findings: list[Finding] = []
+    for family, entries in ladders.items():
+        findings.extend(check_ladder_entries(family, entries))
+    return findings, ladders
+
+
+# --- donation lint ----------------------------------------------------------
+
+
+@dataclasses.dataclass
+class JitDef:
+    """One jit-wrapped program the lint located in source."""
+
+    module: str
+    name: str
+    lineno: int
+    donate: tuple | None  # literal donate_argnums, None when absent
+    no_donate: str | None  # reason text of a `# no-donate:` annotation
+    carry_argnums: tuple  # parameter indices feeding a loop carry
+
+    @property
+    def where(self) -> str:
+        return f"{self.module}:{self.name}"
+
+
+def _line_comments(source: str) -> dict[int, str]:
+    out: dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string
+    except tokenize.TokenizeError:
+        pass
+    return out
+
+
+def _is_jax_jit(node) -> bool:
+    """``jax.jit`` / bare ``jit`` as a Name/Attribute node."""
+    if isinstance(node, ast.Attribute):
+        return node.attr == "jit"
+    return isinstance(node, ast.Name) and node.id == "jit"
+
+
+def _jit_call_info(call: ast.Call):
+    """``(inner, donate)`` when ``call`` is ``jax.jit(inner, ...)`` or
+    ``partial(jax.jit, ...)``; None otherwise. ``donate`` is the literal
+    donate_argnums tuple, or None when the kwarg is absent."""
+    fn = call.func
+    target = None
+    if _is_jax_jit(fn):
+        target = call.args[0] if call.args else None
+    elif (
+        (isinstance(fn, ast.Name) and fn.id == "partial")
+        or (isinstance(fn, ast.Attribute) and fn.attr == "partial")
+    ) and call.args and _is_jax_jit(call.args[0]):
+        target = call.args[1] if len(call.args) > 1 else None
+    else:
+        return None
+    donate = None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            try:
+                donate = ast.literal_eval(kw.value)
+            except (ValueError, TypeError):
+                donate = None  # computed donation: not lintable
+            else:
+                if isinstance(donate, int):
+                    donate = (donate,)  # jax accepts a bare int
+                else:
+                    try:
+                        donate = tuple(donate)
+                    except TypeError:
+                        donate = None
+    return target, donate
+
+
+_LOOP_FNS = {"while_loop": 2, "fori_loop": 3}  # fn name -> init arg index
+
+
+def _carry_param_map(tree: ast.Module) -> dict[str, set[int]]:
+    """function name -> parameter indices that flow into a
+    ``lax.while_loop``/``fori_loop`` carry, directly or through one
+    level of local-call indirection (the ``core -> _run -> while_loop``
+    shape of the packed loop factory). Closed to a fixed point over the
+    module's local call graph."""
+    fns: dict[str, ast.FunctionDef] = {}
+
+    def collect(node):
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fns.setdefault(sub.name, sub)
+
+    collect(tree)
+    carry: dict[str, set[int]] = {name: set() for name in fns}
+    # Direct: names inside a loop call's init expression. An init bound
+    # to a local first (`init = (f, vis, d, ...); while_loop(c, b, init)`
+    # — the dist loop shape) resolves through one simple assignment.
+    for name, fn in fns.items():
+        params = [a.arg for a in fn.args.args]
+        assigns: dict[str, ast.expr] = {}
+        for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                assigns.setdefault(node.targets[0].id, node.value)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = node.func
+            attr = (
+                callee.attr if isinstance(callee, ast.Attribute)
+                else callee.id if isinstance(callee, ast.Name) else None
+            )
+            init_idx = _LOOP_FNS.get(attr)
+            if init_idx is None or len(node.args) <= init_idx:
+                continue
+            init = node.args[init_idx]
+            if isinstance(init, ast.Name) and init.id in assigns:
+                init = assigns[init.id]
+            names = {
+                n.id for n in ast.walk(init) if isinstance(n, ast.Name)
+            }
+            carry[name].update(
+                i for i, p in enumerate(params) if p in names
+            )
+    # One fixed point of indirection: a param passed positionally into a
+    # local function at a carry position carries too.
+    changed = True
+    while changed:
+        changed = False
+        for name, fn in fns.items():
+            params = [a.arg for a in fn.args.args]
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = node.func
+                cname = callee.id if isinstance(callee, ast.Name) else None
+                if cname not in carry or not carry[cname]:
+                    continue
+                for pos, arg in enumerate(node.args):
+                    if pos in carry[cname] and isinstance(arg, ast.Name):
+                        try:
+                            i = params.index(arg.id)
+                        except ValueError:
+                            continue
+                        if i not in carry[name]:
+                            carry[name].add(i)
+                            changed = True
+    return carry
+
+
+def collect_jit_defs(module: str, source: str) -> list[JitDef]:
+    """Every jit-wrapped program the lint can see in one module:
+    decorated defs, ``x = jax.jit(f, ...)`` assignments, and
+    ``return jax.jit(shard_map(f, ...))`` factory returns (the dist
+    loop shape — the shard_map wrapper is looked through)."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return []
+    comments = _line_comments(source)
+    carry = _carry_param_map(tree)
+    fns: dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fns.setdefault(node.name, node)
+
+    def annotation(lineno: int) -> str | None:
+        m = NO_DONATE_RE.search(comments.get(lineno, ""))
+        return m.group(1).strip() if m else None
+
+    def resolve_target(node) -> str | None:
+        """Function name a jit call wraps: a Name, or the first
+        positional arg of an intermediate wrapper call (shard_map)."""
+        if isinstance(node, ast.Name):
+            return node.id if node.id in fns else None
+        if isinstance(node, ast.Call) and node.args:
+            return resolve_target(node.args[0])
+        return None
+
+    out: list[JitDef] = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                info = None
+                if isinstance(dec, ast.Call):
+                    info = _jit_call_info(dec)
+                elif _is_jax_jit(dec):
+                    info = (None, None)
+                if info is None:
+                    continue
+                _, donate = info
+                out.append(JitDef(
+                    module=module, name=node.name, lineno=node.lineno,
+                    donate=donate,
+                    no_donate=annotation(node.lineno)
+                    or annotation(dec.lineno),
+                    carry_argnums=tuple(sorted(carry.get(node.name, ()))),
+                ))
+                break
+            continue
+        if not isinstance(node, (ast.Assign, ast.Return)):
+            continue
+        val = node.value
+        if not isinstance(val, ast.Call):
+            continue
+        info = _jit_call_info(val)
+        if info is None:
+            continue
+        target, donate = info
+        tname = resolve_target(target) if target is not None else None
+        if isinstance(node, ast.Assign) and node.targets and isinstance(
+            node.targets[0], (ast.Name, ast.Attribute)
+        ):
+            label = (
+                node.targets[0].id
+                if isinstance(node.targets[0], ast.Name)
+                else node.targets[0].attr
+            )
+        else:
+            label = tname or "<jit>"
+        out.append(JitDef(
+            module=module, name=label, lineno=node.lineno, donate=donate,
+            no_donate=annotation(node.lineno),
+            carry_argnums=tuple(sorted(carry.get(tname, ())))
+            if tname else (),
+        ))
+    return out
+
+
+def lint_donation_sources(
+    sources: dict[str, str]
+) -> tuple[list[Finding], dict]:
+    """The donation lint over ``{module_label: source}``: dead
+    ``donate_argnums=()`` annotations and carry-style jit programs that
+    donate none of their carried parameters (``# no-donate: <why>``
+    exempts a deliberate non-donating entry). Returns ``(findings,
+    info)`` with the per-module jit census for the report."""
+    findings: list[Finding] = []
+    defs: list[JitDef] = []
+    for module, src in sources.items():
+        defs.extend(collect_jit_defs(module, src))
+    donating = 0
+    for d in defs:
+        if d.donate == ():
+            findings.append(Finding(
+                "memory/donation",
+                f"{d.where}@dead-annotation",
+                f"`donate_argnums=()` on `{d.name}` (line {d.lineno}) "
+                f"donates nothing — it reads as a donation to a reviewer "
+                f"and as none to XLA. Donate the loop carry for real or "
+                f"drop the parameter.",
+            ))
+        if d.donate:
+            donating += 1
+        if not d.carry_argnums or d.no_donate:
+            continue
+        if d.donate and set(d.donate) & set(d.carry_argnums):
+            continue
+        findings.append(Finding(
+            "memory/donation",
+            f"{d.where}@undonated-carry",
+            f"jit program `{d.name}` (line {d.lineno}) loop-carries "
+            f"parameters {d.carry_argnums} but donates none of them: the "
+            f"old and new carries are simultaneously live — double the "
+            f"state residency at exactly the widths the HBM ladder is "
+            f"sized for. Add `donate_argnums` covering the carry (the "
+            f"caller must treat those arguments as consumed), or mark a "
+            f"deliberate copy `# no-donate: <why>`.",
+        ))
+    info = {
+        "jit_defs": len(defs),
+        "donating": donating,
+        "carry_style": sum(1 for d in defs if d.carry_argnums),
+        "no_donate": sum(1 for d in defs if d.no_donate),
+    }
+    return findings, info
+
+
+def lint_donation_tree(
+    root: str, modules=DEFAULT_DONATION_MODULES
+) -> tuple[list[Finding], dict]:
+    sources = {}
+    for rel in modules:
+        with open(os.path.join(root, rel)) as f:
+            sources[rel] = f.read()
+    return lint_donation_sources(sources)

@@ -52,19 +52,44 @@ def check_hlo_host_ops(name: str, hlo_text: str) -> list[Finding]:
     return out
 
 
+def donation_safe_args(fn, args) -> tuple:
+    """A fresh copy of every donated argument of ``fn`` (tagged
+    ``_donate_argnums`` — the ISSUE 13 donating cores), so an analyzer
+    that invokes the same program twice with one argument tuple does not
+    hand deleted buffers to the second call. Device-to-device copies
+    only (``Array.copy()`` preserves sharding) — legal under
+    ``jax.transfer_guard('disallow')``."""
+    donated = getattr(fn, "_donate_argnums", ())
+    if not donated:
+        return tuple(args)
+    import jax
+
+    out = list(args)
+    for i in donated:
+        if i < len(out):
+            out[i] = jax.tree_util.tree_map(
+                lambda x: x.copy() if isinstance(x, jax.Array) else x,
+                out[i],
+            )
+    return tuple(out)
+
+
 def check_loop_transfer_guard(name: str, fn, args) -> list[Finding]:
     """Drive a (warmed) jit entry under ``jax.transfer_guard('disallow')``.
     Arguments must already be on device (the configs pre-put them); the
     warm call outside the guard absorbs compile-time constant placement,
     so anything the guarded call trips on is a genuine per-run
-    transfer."""
+    transfer. Donating programs get a fresh carry per invocation
+    (:func:`donation_safe_args`) — the spec's example arguments survive
+    for the passes that run after this drive."""
     import jax
 
-    out = fn(*args)  # warm (compile + constant placement) outside the guard
+    # warm (compile + constant placement) outside the guard
+    out = fn(*donation_safe_args(fn, args))
     jax.block_until_ready(out)
     try:
         with jax.transfer_guard("disallow"):
-            jax.block_until_ready(fn(*args))
+            jax.block_until_ready(fn(*donation_safe_args(fn, args)))
     except Exception as exc:  # noqa: BLE001 — the guard raises RuntimeError-ish
         return [Finding(
             "transfer",

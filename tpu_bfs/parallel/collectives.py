@@ -1121,6 +1121,40 @@ class RowGatherExchangeAccounting:
         )
         return fw_f, vis_f, planes_f, level, alive
 
+    def _core_from_donate(self, arrs, fw, vis, planes, level0, max_levels):
+        """The donating resume entry (ISSUE 13, analysis pass 5): the
+        same sharded loop re-jitted lazily with the carry donated, plus
+        the exchange accounting of :meth:`_core_from`. advance's
+        converted checkpoint carries are dead after the call, so the
+        loop's outputs alias their buffers instead of doubling the
+        sharded table residency per chunk; the cap-boundary probe and
+        roofline keep the copying ``_core_from``/``_core_from_jit``
+        (they re-read their carries)."""
+        import jax
+
+        fn = self.__dict__.get("_core_from_donate_jit")
+        if fn is None:
+            # Gated dist engines have no plain _core_from_jit (their
+            # gated raw takes the lane-mask argument); they — and any
+            # test double without a raw traceable — keep the copying
+            # entry.
+            inner = getattr(self, "_core_from_jit", None)
+            raw = getattr(inner, "__wrapped__", None)
+            if raw is None:
+                return self._core_from(
+                    arrs, fw, vis, planes, level0, max_levels
+                )
+            fn = jax.jit(raw, donate_argnums=(1, 2, 3))
+            fn._donate_argnums = (1, 2, 3)
+            self.__dict__["_core_from_donate_jit"] = fn
+        fw_f, vis_f, planes_f, level, alive, bc = fn(
+            arrs, fw, vis, planes, level0, max_levels
+        )
+        self._record_exchange(
+            bc, int(level0), getattr(self, "_pending_chain_nonce", None)
+        )
+        return fw_f, vis_f, planes_f, level, alive
+
 
 def sparse_wire_bytes_per_level(
     p: int, n: int, caps: tuple[int, ...], *, wire_pack: bool = False

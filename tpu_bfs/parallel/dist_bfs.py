@@ -234,7 +234,15 @@ def _dist_bfs_fn(
         return frontier, visited, dist, level, branch_counts, front_seq, branch_seq
 
     aux_specs = (P("v", None), P("v", None)) if dopt else ()
-    return jax.jit(
+    # The carry (frontier/visited/dist, argnums 4-6) is DONATED (ISSUE
+    # 13, analysis pass 5): every call site constructs it fresh —
+    # _init_state and advance's device_put both materialize distinct
+    # buffers per call, and the serve adapter's chunked relaunch reads
+    # its snapshot BEFORE handing the carry back in — so the loop's
+    # outputs alias the inputs instead of doubling the sharded vectors'
+    # residency. The analyzer's transfer-guard drive copies donated args
+    # per invocation (analysis/transfer.py keys on _donate_argnums).
+    fn = jax.jit(
         shard_map(
             local_loop,
             mesh=mesh,
@@ -251,8 +259,11 @@ def _dist_bfs_fn(
             ),
             out_specs=(P("v"), P("v"), P("v"), P(), P(), P(), P()),
             check_vma=False,
-        )
+        ),
+        donate_argnums=(4, 5, 6),
     )
+    fn._donate_argnums = (4, 5, 6)
+    return fn
 
 
 def _dist_parents_fn(mesh: Mesh, p: int, vloc: int, exchange: str):

@@ -264,7 +264,12 @@ def _dist2d_bfs_fn(mesh: Mesh, rows: int, cols: int, w: int, exchange: str,
         return frontier, visited, dist, level, front_seq, branch_counts, branch_seq
 
     aux_specs = (P("r", "c", None), P("r", "c", None)) if dopt else ()
-    return jax.jit(
+    # Carry donation, same contract as the 1D loop (dist_bfs.py): every
+    # caller hands in fresh buffers — _init_state copies, advance
+    # device_puts, and the serve adapter's chunked drive reads its
+    # snapshot to host BEFORE relaunching from the device outputs — so
+    # argnums 4-6 alias out instead of doubling per-chunk residency.
+    fn = jax.jit(
         shard_map(
             local_loop,
             mesh=mesh,
@@ -282,8 +287,11 @@ def _dist2d_bfs_fn(mesh: Mesh, rows: int, cols: int, w: int, exchange: str,
             out_specs=(P(("r", "c")), P(("r", "c")), P(("r", "c")), P(), P(),
                        P(), P()),
             check_vma=False,
-        )
+        ),
+        donate_argnums=(4, 5, 6),
     )
+    fn._donate_argnums = (4, 5, 6)
+    return fn
 
 
 def _dist2d_parents_fn(mesh: Mesh, rows: int, cols: int, w: int, exchange: str):

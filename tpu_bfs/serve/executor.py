@@ -339,7 +339,8 @@ class BatchExecutor:
             mesh_kw = (
                 {"devices": pending.devices} if pending.devices > 1 else {}
             )
-            rec.begin("batch", f"b{pending.bid}", cat="serve.batch",
+            rec.begin("batch", f"b{pending.bid}",  # span-outlives: finish_batch/_extract/_classify_failure close it
+                      cat="serve.batch",
                       batch=pending.bid, n=n, width=engine.lanes,
                       queries=[q.id for q in pending.queries], **mesh_kw)
             rec.begin("dispatch", f"b{pending.bid}", cat="serve.batch",
@@ -656,7 +657,8 @@ class BatchExecutor:
             )
         rec = _obs.ACTIVE
         if rec is not None:
-            rec.begin("extract", f"b{pending.bid}", cat="serve.batch",
+            rec.begin("extract", f"b{pending.bid}",  # span-outlives: _extract ends it; the except arm below covers the failure path
+                      cat="serve.batch",
                       batch=pending.bid, n=pending.n)
         try:
             self._extract(pending, res, rec)

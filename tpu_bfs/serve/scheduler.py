@@ -125,7 +125,8 @@ class PendingQuery:
             # with the terminal status, batch id, and attempt history —
             # one span chain per query id across whichever threads serve
             # it (tpu_bfs/obs).
-            rec.begin("query", f"q{self.id}", cat="serve.query",
+            rec.begin("query", f"q{self.id}",  # span-outlives: resolve() closes it with the terminal status
+                      cat="serve.query",
                       query=self.id, source=self.source,
                       want_distances=self.want_distances)
 

@@ -231,15 +231,26 @@ class ArtifactStore:
 
     # --- write ------------------------------------------------------------
 
-    def put(self, key: dict, name: str, payload: bytes) -> str:
-        """Atomically write one program artifact; returns its path."""
+    def put(self, key: dict, name: str, payload: bytes, *,
+            donate_argnums=()) -> str:
+        """Atomically write one program artifact; returns its path.
+
+        ``donate_argnums`` records the program's buffer-donation contract
+        (ISSUE 13): ``jax.export`` does not carry donation through
+        deserialization, so the adopting wrapper re-applies it from the
+        header — an adopted resume core aliases its carry exactly like
+        the original. Written only when non-empty, so donation-free
+        artifacts stay byte-identical to the PR 9 layout."""
         key = program_key(key)
-        header = json.dumps({
+        meta = {
             "key": key,
             "name": name,
             "fingerprint": env_fingerprint(),
             "payload_crc32": _crc32(payload),
-        }, sort_keys=True).encode()
+        }
+        if donate_argnums:
+            meta["donate_argnums"] = [int(i) for i in donate_argnums]
+        header = json.dumps(meta, sort_keys=True).encode()
         path = self.path_for(key, name)
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
@@ -315,11 +326,11 @@ class ArtifactStore:
                 continue
         return False
 
-    def get(self, key: dict, name: str) -> bytes | None:
-        """The validated payload, or None with the degrade applied:
-        missing/stale -> fallback counted; corrupt -> quarantined +
-        fallback counted. Never raises on a bad artifact — the JIT path
-        always serves."""
+    def get(self, key: dict, name: str, *, with_meta: bool = False):
+        """The validated payload (or ``(payload, meta)`` under
+        ``with_meta``), or None with the degrade applied: missing/stale
+        -> fallback counted; corrupt -> quarantined + fallback counted.
+        Never raises on a bad artifact — the JIT path always serves."""
         key = program_key(key)
         path = self.path_for(key, name)
         if not os.path.exists(path):
@@ -358,7 +369,7 @@ class ArtifactStore:
             self._bump("fallbacks")
             return None
         self._bump("hits")
-        return payload
+        return (payload, meta) if with_meta else payload
 
 
 class AdoptedProgram:
@@ -375,7 +386,8 @@ class AdoptedProgram:
     without per-engine plumbing.
     """
 
-    def __init__(self, name: str, exported, original, store=None):
+    def __init__(self, name: str, exported, original, store=None,
+                 donate_argnums=()):
         import jax
 
         self.name = name
@@ -384,7 +396,14 @@ class AdoptedProgram:
         # original traceable (re-exporting from an adopted server).
         self._aot_original = original
         self._store = store
-        self._jit = jax.jit(exported.call)
+        # Donation re-applied from the artifact header (ISSUE 13):
+        # jax.export strips it, and an adopted resume core that copies
+        # its carry would double the residency the donation removed.
+        self._donate_argnums = tuple(donate_argnums)
+        self._jit = (
+            jax.jit(exported.call, donate_argnums=self._donate_argnums)
+            if self._donate_argnums else jax.jit(exported.call)
+        )
         self._in_shapes = tuple(tuple(a.shape) for a in exported.in_avals)
         self.calls = 0
         self.fallback_calls = 0
@@ -445,7 +464,10 @@ def export_engine_programs(engine, spec, store: ArtifactStore, *,
         ):
             try:
                 exported = jexp.export(fn)(*args)
-                store.put(key, name, exported.serialize())
+                store.put(
+                    key, name, exported.serialize(),
+                    donate_argnums=getattr(fn, "_donate_argnums", ()),
+                )
             except Exception as exc:  # noqa: BLE001 — per-program degrade
                 log(f"aot export of {name!r} failed "
                     f"({type(exc).__name__}: {str(exc)[:160]}); skipped")
@@ -471,9 +493,10 @@ def adopt_engine_programs(engine, spec, store: ArtifactStore, *,
             "aot_load", f"{key['engine']}-w{key['lanes']}-{name}",
             cat="aot", program=name, width=key["lanes"],
         ):
-            payload = store.get(key, name)
-            if payload is None:
+            got = store.get(key, name, with_meta=True)
+            if got is None:
                 continue
+            payload, meta = got
             try:
                 exported = jexp.deserialize(payload)
             except Exception as exc:  # noqa: BLE001 — CRC-clean but unloadable
@@ -484,7 +507,10 @@ def adopt_engine_programs(engine, spec, store: ArtifactStore, *,
                 )
                 store._bump("fallbacks")
                 continue
-        programs[name] = AdoptedProgram(name, exported, fn, store=store)
+        programs[name] = AdoptedProgram(
+            name, exported, fn, store=store,
+            donate_argnums=meta.get("donate_argnums", ()),
+        )
     adopted = engine.adopt_programs(programs)
     if adopted:
         log(f"aot adopted {adopted} for {key['engine']}/w{key['lanes']}")

@@ -1489,6 +1489,111 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
         log(f"validated {nv} serve responses ({validate_mode}) in "
             f"{time.perf_counter()-t0:.1f}s")
 
+    # Mixed-kind workload stage (ISSUE 14): TPU_BFS_BENCH_SERVE_KINDS
+    # ('all' / '1', or an explicit 'bfs,sssp,cc,khop,p2p' list) drives a
+    # second closed loop of interleaved query kinds through a
+    # single-chip wide service with the kind axis enabled (the workload
+    # adapters are single-chip in this release). The graph gains the
+    # deterministic weight plane in-place (same topology, weights are a
+    # pure hash of the endpoints) so sssp is servable; per-kind
+    # p50/p99/counts land under the 'serve_kinds' verdict key.
+    kinds_keys: dict = {}
+    kinds_raw = os.environ.get("TPU_BFS_BENCH_SERVE_KINDS", "").strip()
+    if kinds_raw:
+        import dataclasses as _dc
+
+        from tpu_bfs.graph.generate import edge_weights
+        from tpu_bfs.workloads import supported_kinds
+
+        gk = g
+        if gk.weights is None:
+            src, dst = gk.coo
+            gk = _dc.replace(
+                gk, weights=edge_weights(src, dst, seed=1, wmax=8)
+            )
+        avail = supported_kinds("wide", 1, gk)
+        want_kinds = (
+            avail if kinds_raw.lower() in ("1", "all")
+            else tuple(
+                k for k in kinds_raw.replace(",", " ").split()
+            )
+        )
+        bad_kinds = [k for k in want_kinds if k not in avail]
+        if bad_kinds:
+            raise RuntimeError(
+                f"TPU_BFS_BENCH_SERVE_KINDS names unservable kinds "
+                f"{bad_kinds} (servable: {avail})"
+            )
+        kinds_lanes = min(lanes, 256)
+        ksvc = retry_transient(
+            BfsService, gk, label="serve kinds engine build",
+            engine="wide", lanes=kinds_lanes, planes=8,
+            width_ladder=ladder, pipeline=pipeline, linger_ms=2.0,
+            queue_cap=max(1024, 2 * clients), kinds=want_kinds, log=log,
+        )
+        try:
+            kq = rng.choice(candidates, size=(clients, per_client),
+                            replace=clients * per_client > len(candidates))
+            tgt = rng.choice(candidates, size=(clients, per_client))
+            kres: list = [None] * clients
+            kerrs: list = []
+
+            def kind_client(ci: int) -> None:
+                got = []
+                try:
+                    for j, s in enumerate(kq[ci]):
+                        kind = want_kinds[(ci + j) % len(want_kinds)]
+                        got.append((kind, ksvc.query(
+                            int(s), kind=kind,
+                            k=3 if kind == "khop" else None,
+                            target=(int(tgt[ci][j])
+                                    if kind == "p2p" else None),
+                            timeout=600.0,
+                        )))
+                except Exception as exc:  # noqa: BLE001 — joined below
+                    kerrs.append(exc)
+                kres[ci] = got
+
+            kthreads = [
+                threading.Thread(target=kind_client, args=(i,), daemon=True)
+                for i in range(clients)
+            ]
+            t0 = time.perf_counter()
+            for t in kthreads:
+                t.start()
+            for t in kthreads:
+                t.join()
+            kind_elapsed = time.perf_counter() - t0
+            if kerrs:
+                raise kerrs[0]
+            kflat = [kr for per in kres if per for kr in per]
+            kbad = [r for _k, r in kflat if not r.ok]
+            if kbad:
+                raise RuntimeError(
+                    f"{len(kbad)}/{len(kflat)} mixed-kind queries failed; "
+                    f"first: {kbad[0].status}: {kbad[0].error}"
+                )
+            per_kind: dict = {}
+            for kind, r in kflat:
+                per_kind.setdefault(kind, []).append(r.latency_ms)
+            kinds_keys = {
+                "serve_kinds": {
+                    kind: {
+                        "count": len(ls),
+                        "p50_ms": round(float(np.percentile(ls, 50)), 2),
+                        "p99_ms": round(float(np.percentile(ls, 99)), 2),
+                    }
+                    for kind, ls in sorted(per_kind.items())
+                },
+                "serve_kinds_qps": round(len(kflat) / kind_elapsed, 2),
+            }
+            log("mixed-kind stage: " + " ".join(
+                f"{k}:p50={v['p50_ms']}ms/p99={v['p99_ms']}ms"
+                for k, v in kinds_keys["serve_kinds"].items()
+            ) + f" qps={kinds_keys['serve_kinds_qps']}")
+        finally:
+            ksvc.close()
+
     aot_keys: dict = {}
     if aot_dir:
         # Export from the warmed service BEFORE closing it, then time a
@@ -1679,6 +1784,7 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
         "serve_hbm_model_bytes": {str(w): b for w, b in hbm_entries},
         "serve_hbm_ladder_monotone": hbm_monotone,
         **dist_keys,
+        **kinds_keys,
         **aot_keys,
         **({"serve_faults": fault_sched.counts()} if fault_sched else {}),
         **obs_keys,

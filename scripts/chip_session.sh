@@ -164,6 +164,15 @@ for i in $(seq 1 "$attempts"); do
     stage "serve-fixed-s20" "$out/serve_fixed_s20.json" \
       TPU_BFS_BENCH_MODE=serve TPU_BFS_BENCH_SCALE=20 \
       TPU_BFS_BENCH_SERVE_LADDER=off TPU_BFS_BENCH_SERVE_PIPELINE=0
+    # Workload-kind arm (ISSUE 14): the mixed-kind closed loop — bfs +
+    # sssp (delta-stepping over the weighted tiles) + cc + khop + p2p
+    # interleaved through the kind-aware coalescer on chip. The graph
+    # gains its deterministic weight plane in-place; per-kind p50/p99
+    # land under serve_kinds and serve_kinds_qps prices the mixed
+    # stream (BENCHMARKS.md "Workload kinds").
+    stage "workloads-s20" "$out/workloads_s20.json" \
+      TPU_BFS_BENCH_MODE=serve TPU_BFS_BENCH_SCALE=20 \
+      TPU_BFS_BENCH_SERVE_KINDS=all
     # Chaos arm (robustness): the same closed-loop serve stage under a
     # seeded fault schedule (tpu_bfs/faults.py) — injected transients and
     # slowed extraction ON CHIP must not change a single answer (the

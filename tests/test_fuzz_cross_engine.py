@@ -484,3 +484,143 @@ def test_serve_chaos_matches_oracle(name, make):
         finally:
             svc.close()
             faults.disarm()
+
+
+@pytest.mark.serve
+def test_workload_kinds_served_equal_one_shot_and_oracle():
+    """ISSUE 14 fuzz arm: every workload kind's SERVED answer equals its
+    one-shot engine run AND its external oracle — SciPy dijkstra (sssp),
+    SciPy connected_components (cc), brute-force BFS prefixes (khop),
+    BFS distance + edge-walk path validity (p2p) — across batch
+    compositions (interleaved mixed-kind traffic vs staged same-kind
+    coalesced batches). The bidirectional p2p arm also pins the
+    acceptance bar: strictly fewer frontier levels expanded than a full
+    single-source BFS whenever d(s, t) >= 2."""
+    from scipy.sparse import csgraph
+
+    from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
+    from tpu_bfs.graph.csr import INF_DIST
+    from tpu_bfs.serve import BfsService
+    from tpu_bfs.workloads.cc import connected_components
+    from tpu_bfs.workloads.khop import KhopServeEngine
+    from tpu_bfs.workloads.p2p import P2pServeEngine
+    from tpu_bfs.workloads.sssp import SsspEngine
+
+    g = rmat_graph(8, 6, seed=107, weights=6)
+    rng = np.random.default_rng(43)
+    sources = _sources(g, rng, n=4)
+    base = WidePackedMsBfsEngine(g, lanes=64, num_planes=8)
+    golden = {s: bfs_scipy(g, s) for s in sources}
+
+    # --- one-shot answers, each oracle-checked first. ---
+    sssp_eng = SsspEngine(g, lanes=8)
+    one_sssp = {}
+    m = g.to_scipy(weighted=True).tocoo()
+    import scipy.sparse as sp
+    key = m.row.astype(np.int64) * g.num_vertices + m.col
+    order = np.lexsort((m.data, key))
+    k2, d2 = key[order], m.data[order]
+    first = np.ones(len(k2), bool)
+    first[1:] = k2[1:] != k2[:-1]
+    mm = sp.csr_matrix(
+        (d2[first],
+         (k2[first] // g.num_vertices, k2[first] % g.num_vertices)),
+        shape=(g.num_vertices, g.num_vertices),
+    )
+    res_s = sssp_eng.run(np.asarray(sources))
+    for i, s in enumerate(sources):
+        got = res_s.distances_int32(i).astype(float)
+        got[got == INF_DIST] = np.inf
+        np.testing.assert_array_equal(
+            got, csgraph.dijkstra(mm, directed=True, indices=s)
+        )
+        one_sssp[s] = res_s.distances_int32(i)
+
+    labels, ncomp, _sweeps = connected_components(base)
+    nc_oracle, lbl_oracle = csgraph.connected_components(
+        g.to_scipy(), directed=False
+    )
+    assert ncomp == nc_oracle
+    comp_sizes = {}
+    for v in range(g.num_vertices):
+        comp_sizes[labels[v]] = comp_sizes.get(labels[v], 0) + 1
+
+    K = 2
+    kh = KhopServeEngine(base)
+    res_k = kh.run(np.asarray(sources), k=K)
+    one_khop = {}
+    for i, s in enumerate(sources):
+        want = int(((golden[s] != INF_DIST) & (golden[s] <= K)).sum())
+        assert int(res_k.reached[i]) == want
+        one_khop[s] = want
+
+    p2p = P2pServeEngine(base)
+    pairs = []
+    for s in sources:
+        reach = np.flatnonzero(
+            (golden[s] != INF_DIST) & (golden[s] >= 2)
+        )
+        if len(reach):
+            pairs.append((s, int(reach[rng.integers(len(reach))])))
+    one_p2p = {}
+    for s, t in pairs:
+        r = p2p.run(np.asarray([s]), targets=np.asarray([t]))
+        ex = r.extras(0)
+        assert ex["distance"] == int(golden[s][t])
+        path = ex["path"]
+        assert path[0] == s and path[-1] == t
+        assert len(path) == ex["distance"] + 1
+        for a, b in zip(path, path[1:]):
+            assert g.has_edge(a, b)
+        # Strictly fewer levels than the full BFS's exhaustion depth.
+        full_levels = int(golden[s][golden[s] != INF_DIST].max())
+        assert int(r.ecc[0]) < full_levels
+        one_p2p[(s, t)] = (ex["distance"], ex["path"], int(r.ecc[0]))
+
+    # --- served answers across two batch compositions. ---
+    def check(svc, staged):
+        for q, (kind, s, t) in staged:
+            r = q.result(timeout=120)
+            assert r.ok, (kind, r.status, r.error)
+            if kind == "sssp":
+                np.testing.assert_array_equal(r.distances, one_sssp[s])
+            elif kind == "cc":
+                assert r.extras["components"] == ncomp
+                assert r.extras["component_size"] == comp_sizes[labels[s]]
+            elif kind == "khop":
+                assert r.reached == one_khop[s]
+            else:  # p2p
+                dist, path, lv = one_p2p[(s, t)]
+                assert r.extras["distance"] == dist
+                assert r.extras["path"] == path
+                # A served batch expands until EVERY pair meets, so its
+                # level count is the batch max: at least this pair's
+                # one-shot depth, still under the full-BFS exhaustion
+                # depth the one-shot arm pinned strictly above.
+                assert r.levels >= lv
+
+    with BfsService(g, lanes=64, width_ladder="32,64", linger_ms=1.0,
+                    autostart=False) as svc:
+        # Composition 1: staged same-kind groups (coalesce into one
+        # batch per kind once the scheduler starts).
+        staged = []
+        for s in sources:
+            staged.append((svc.submit(s, kind="sssp"), ("sssp", s, None)))
+        for s in sources:
+            staged.append((svc.submit(s, kind="khop", k=K),
+                           ("khop", s, None)))
+        svc.start()
+        check(svc, staged)
+        # Composition 2: interleaved mixed-kind traffic (the kind-aware
+        # coalescer must split it per batch key).
+        staged = []
+        for i, s in enumerate(sources):
+            staged.append((svc.submit(s, kind="cc"), ("cc", s, None)))
+            staged.append((svc.submit(s, kind="sssp"), ("sssp", s, None)))
+            if i < len(pairs):
+                ps, pt = pairs[i]
+                staged.append((svc.submit(ps, kind="p2p", target=pt),
+                               ("p2p", ps, pt)))
+            staged.append((svc.submit(s, kind="khop", k=K),
+                           ("khop", s, None)))
+        check(svc, staged)

@@ -224,3 +224,112 @@ def test_write_mtx_roundtrip(tmp_path):
     assert g.num_vertices == 256 and g.num_input_edges == 400
     np.testing.assert_array_equal(g.row_ptr, expect.row_ptr)
     np.testing.assert_array_equal(g.col_idx, expect.col_idx)
+
+
+# --- weighted graphs (ISSUE 14: the SSSP workload's weights plane) ----------
+
+
+def test_weighted_rmat_deterministic_and_symmetric():
+    from tpu_bfs.graph.generate import edge_weights, rmat_graph
+
+    g1 = rmat_graph(7, 8, seed=9, weights=8)
+    g2 = rmat_graph(7, 8, seed=9, weights=8)
+    assert g1.weights is not None
+    np.testing.assert_array_equal(g1.weights, g2.weights)
+    assert g1.weights.min() >= 1 and g1.weights.max() <= 8
+    # The weight is a pure function of the unordered endpoint pair, so
+    # the undirected double-insert agrees across directions (and across
+    # parallel edges of the multigraph).
+    src, dst = g1.coo
+    seen = {}
+    for s, d, w in zip(src, dst, g1.weights):
+        key = (min(int(s), int(d)), max(int(s), int(d)))
+        assert seen.setdefault(key, int(w)) == int(w)
+    # edge_weights itself is order-insensitive.
+    u = np.array([3, 7, 9]); v = np.array([7, 3, 9])
+    np.testing.assert_array_equal(
+        edge_weights(u, v, seed=1), edge_weights(v, u, seed=1)
+    )
+    # ...and seed-sensitive.
+    assert (edge_weights(u, v, seed=1) != edge_weights(u, v, seed=2)).any()
+
+
+def test_weighted_npz_roundtrip(tmp_path):
+    from tpu_bfs.graph.generate import random_graph
+    from tpu_bfs.graph.io import load_npz, save_npz
+
+    g = random_graph(64, 256, seed=4, weights=5)
+    path = str(tmp_path / "wg.npz")
+    save_npz(path, g)
+    g2 = load_npz(path)
+    np.testing.assert_array_equal(g.weights, g2.weights)
+    np.testing.assert_array_equal(g.col_idx, g2.col_idx)
+    # Unweighted graphs round-trip weightless (no phantom plane).
+    g0 = random_graph(64, 256, seed=4)
+    save_npz(path, g0)
+    assert load_npz(path).weights is None
+
+
+def test_csr_ell_weight_agreement():
+    """Satellite pin (ISSUE 14): the ELL weight planes must agree with
+    the CSR weights plane slot-for-slot — every bucket row's
+    (neighbor, weight) multiset equals the CSR's in-edge multiset."""
+    from tpu_bfs.graph.ell import build_ell, build_ell_weights
+    from tpu_bfs.graph.generate import rmat_graph
+
+    g = rmat_graph(7, 10, seed=3, weights=7)  # heavy rows + light ladder
+    ell = build_ell(g)
+    vw, lw = build_ell_weights(g, ell)
+    src, dst = g.coo
+    # CSR side: per-destination (source, weight) multisets.
+    want = {}
+    for s, d, w in zip(src, dst, g.weights):
+        want.setdefault(int(d), []).append((int(s), int(w)))
+    got = {}
+
+    def add(row, nbr_rank, w):
+        v = int(ell.old_of_new[row])
+        got.setdefault(v, []).append((int(ell.old_of_new[nbr_rank]), int(w)))
+
+    sent = ell.num_active
+    for b, wtab in zip(ell.light, lw):
+        assert wtab.shape == b.idx.shape
+        for r in range(b.n):
+            for j in range(b.k):
+                if b.idx[r, j] != sent:
+                    add(b.row_start + r, b.idx[r, j], wtab[r, j])
+    if ell.virtual is not None:
+        assert vw.shape == ell.virtual.idx.shape
+        # Heavy virtual rows: row r of the virtual bucket belongs to the
+        # heavy vertex whose virtual-row range contains it.
+        hlens = ell.in_degree[ell.old_of_new[: ell.num_heavy]]
+        r_per = -(-hlens // ell.kcap)
+        owner = np.repeat(np.arange(ell.num_heavy), r_per)
+        for r in range(ell.num_virtual):
+            for j in range(ell.kcap):
+                if ell.virtual.idx[r, j] != sent:
+                    add(int(owner[r]), ell.virtual.idx[r, j], vw[r, j])
+    for v, pairs in want.items():
+        assert sorted(pairs) == sorted(got.get(v, [])), v
+    assert set(got) == set(want)
+
+
+def test_weighted_dedup_keeps_min_weight():
+    from tpu_bfs.graph.io import from_edges
+
+    # Parallel input edges with different weights: dedup must keep the
+    # minimum (the shortest-path-relevant slot).
+    u = np.array([0, 0, 1]); v = np.array([1, 1, 2])
+    w = np.array([5, 2, 3])
+    g = from_edges(u, v, num_vertices=3, dedup=True, weights=w)
+    m = g.to_scipy(weighted=True).toarray()
+    assert m[0, 1] == 2 and m[1, 0] == 2 and m[1, 2] == 3
+
+
+def test_build_csr_rejects_bad_weights():
+    from tpu_bfs.graph.csr import build_csr
+
+    with pytest.raises(ValueError):
+        build_csr(np.array([0]), np.array([1]), 2, weights=np.array([0]))
+    with pytest.raises(ValueError):
+        build_csr(np.array([0]), np.array([1]), 2, weights=np.array([1, 2]))

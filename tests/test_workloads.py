@@ -1,0 +1,550 @@
+"""The workload-kind subsystem (ISSUE 14): SSSP / CC / k-hop / p2p on
+the MS-BFS substrate, and the serve tier's "kind" axis end to end.
+
+Oracles: SciPy ``csgraph.dijkstra`` (sssp), ``connected_components``
+(cc), brute-force BFS prefixes (khop), and BFS distance + edge-validity
+walks (p2p). The serve arms drive the real BfsService / JSONL frontend —
+kind-aware coalescing, per-kind engines, structured errors, chaos sites.
+"""
+
+import io
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from tpu_bfs.graph.csr import INF_DIST
+from tpu_bfs.graph.generate import random_graph, rmat_graph
+from tpu_bfs.reference import bfs_scipy
+
+pytestmark = pytest.mark.serve
+
+
+def _dijkstra_oracle(g, sources):
+    """SciPy dijkstra over the weighted graph, duplicate slots min-folded
+    (parallel edges hash to one weight, but keep the oracle honest)."""
+    import scipy.sparse as sp
+    from scipy.sparse import csgraph
+
+    m = g.to_scipy(weighted=True).tocoo()
+    key = m.row.astype(np.int64) * g.num_vertices + m.col
+    order = np.lexsort((m.data, key))
+    k2, d2 = key[order], m.data[order]
+    first = np.ones(len(k2), bool)
+    first[1:] = k2[1:] != k2[:-1]
+    mm = sp.csr_matrix(
+        (d2[first], (k2[first] // g.num_vertices, k2[first] % g.num_vertices)),
+        shape=(g.num_vertices, g.num_vertices),
+    )
+    return csgraph.dijkstra(mm, directed=True, indices=sources)
+
+
+# --- sssp -------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("name,make", [
+    ("random", lambda: random_graph(200, 900, seed=11, weights=7)),
+    ("rmat", lambda: rmat_graph(8, 8, seed=12, weights=5)),
+    ("directed", lambda: random_graph(
+        200, 800, seed=13, directed=True, weights=9)),
+])
+def test_sssp_matches_dijkstra(name, make):
+    from tpu_bfs.workloads.sssp import SsspEngine
+
+    g = make()
+    eng = SsspEngine(g, lanes=8)
+    srcs = np.flatnonzero(g.degrees > 0)[:8]
+    res = eng.run(srcs)
+    oracle = _dijkstra_oracle(g, srcs)
+    for i in range(len(srcs)):
+        got = res.distances_int32(i).astype(float)
+        got[got == INF_DIST] = np.inf
+        np.testing.assert_array_equal(got, oracle[i])
+        fin = oracle[i][np.isfinite(oracle[i])]
+        assert int(res.reached[i]) == len(fin)
+        assert int(res.ecc[i]) == int(fin.max())
+
+
+def test_sssp_delta_choices_agree():
+    from tpu_bfs.workloads.sssp import SsspEngine
+
+    g = random_graph(150, 600, seed=14, weights=8)
+    srcs = np.flatnonzero(g.degrees > 0)[:4]
+    base = SsspEngine(g, lanes=4, delta=1).run(srcs)
+    for delta in (2, 4, 16):
+        other = SsspEngine(g, lanes=4, delta=delta).run(srcs)
+        for i in range(len(srcs)):
+            np.testing.assert_array_equal(
+                base.distances_int32(i), other.distances_int32(i)
+            )
+
+
+def test_sssp_isolated_source_and_unweighted_rejection():
+    from tpu_bfs.workloads.sssp import SsspEngine
+
+    g = random_graph(64, 60, seed=15, weights=3)
+    iso = np.flatnonzero(g.degrees == 0)
+    if len(iso):
+        eng = SsspEngine(g, lanes=2)
+        res = eng.run(np.array([int(iso[0]), 0]))
+        d = res.distances_int32(0)
+        assert d[iso[0]] == 0 and int(res.reached[0]) == 1
+        assert (np.delete(d, iso[0]) == INF_DIST).all()
+    with pytest.raises(ValueError, match="weight"):
+        SsspEngine(random_graph(16, 32, seed=1), lanes=2)
+
+
+# --- cc ---------------------------------------------------------------------
+
+
+def _assert_same_partition(labels, oracle_labels):
+    m1, m2 = {}, {}
+    for a, b in zip(labels, oracle_labels):
+        assert m1.setdefault(a, len(m1)) == m2.setdefault(b, len(m2))
+
+
+def test_cc_matches_scipy_with_lane_recycling():
+    from scipy.sparse import csgraph
+
+    from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
+    from tpu_bfs.workloads.cc import connected_components
+
+    # Sparse graph: many components, and lanes=32 forces the re-seeding
+    # sweeps (lane recycling) to run more than once.
+    g = random_graph(400, 260, seed=21)
+    base = WidePackedMsBfsEngine(g, lanes=32)
+    labels, n, sweeps = connected_components(base)
+    nc, lbl_o = csgraph.connected_components(g.to_scipy(), directed=False)
+    assert n == nc
+    assert sweeps > 1  # recycling actually exercised
+    _assert_same_partition(labels, lbl_o)
+
+
+def test_cc_serve_adapter_caches_index():
+    from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
+    from tpu_bfs.workloads.cc import CcServeEngine
+
+    g = random_graph(120, 200, seed=22)
+    cs = CcServeEngine(WidePackedMsBfsEngine(g, lanes=32))
+    r1 = cs.run(np.array([0, 5, 9]))
+    idx1 = cs._index
+    r2 = cs.run(np.array([3]))
+    assert cs._index is idx1  # one labeling per residency
+    ex = r1.extras(0)
+    assert ex["components"] == r2.extras(0)["components"]
+    assert int(r1.reached[0]) == ex["component_size"]
+
+
+# --- khop -------------------------------------------------------------------
+
+
+def test_khop_counts_match_bfs_prefix():
+    from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
+    from tpu_bfs.workloads.khop import KhopServeEngine
+
+    g = rmat_graph(8, 6, seed=23)
+    kh = KhopServeEngine(WidePackedMsBfsEngine(g, lanes=32))
+    srcs = np.flatnonzero(g.degrees > 0)[:6]
+    for k in (0, 1, 2, 5):
+        res = kh.run(srcs, k=k)
+        for i, s in enumerate(srcs):
+            d = bfs_scipy(g, int(s))
+            want = int(((d != INF_DIST) & (d <= k)).sum())
+            assert int(res.reached[i]) == want, (k, int(s))
+            assert res.extras(i) == {"k": k}
+
+
+def test_khop_zero_distance_pull():
+    """The generalized want_distances=False fast path: a khop serve
+    answer must never materialize a distance word."""
+    from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
+    from tpu_bfs.workloads.khop import KhopServeEngine
+
+    g = rmat_graph(7, 6, seed=24)
+    base = WidePackedMsBfsEngine(g, lanes=32)
+    calls = []
+    orig = base._extract_word
+    base._extract_word = lambda *a, **kw: (calls.append(1), orig(*a, **kw))[1]
+    kh = KhopServeEngine(base)
+    res = kh.run(np.array([0, 1, 2]), k=2)
+    assert int(res.reached[0]) >= 1
+    assert int(np.asarray(res.ecc)[0]) >= 0  # on-device summary path
+    assert not calls  # zero distance words decoded
+
+
+# --- p2p --------------------------------------------------------------------
+
+
+def test_p2p_distance_path_and_fewer_levels():
+    from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
+    from tpu_bfs.workloads.p2p import P2pServeEngine
+
+    g = rmat_graph(8, 6, seed=25)
+    p2p = P2pServeEngine(WidePackedMsBfsEngine(g, lanes=64))
+    rng = np.random.default_rng(3)
+    cand = np.flatnonzero(g.degrees > 0)
+    checked_strict = 0
+    for _ in range(12):
+        s, t = (int(x) for x in rng.choice(cand, 2, replace=False))
+        d = bfs_scipy(g, s)
+        res = p2p.run(np.array([s]), targets=np.array([t]))
+        ex = res.extras(0)
+        want = int(d[t]) if d[t] != INF_DIST else None
+        assert ex["distance"] == want, (s, t)
+        if want is None:
+            assert not ex["met"] and ex["path"] is None
+            continue
+        path = ex["path"]
+        assert path[0] == s and path[-1] == t and len(path) == want + 1
+        for a, b in zip(path, path[1:]):
+            assert g.has_edge(a, b)
+        if want >= 2:
+            # The acceptance bar: bidirectional expansion runs strictly
+            # fewer frontier levels than a full single-source BFS from s
+            # (which must exhaust ecc(s) >= d(s,t) levels).
+            full_levels = int(d[d != INF_DIST].max())
+            assert int(res.ecc[0]) < full_levels
+            checked_strict += 1
+    assert checked_strict >= 1
+
+
+def test_p2p_trivial_and_batched_pairs():
+    from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
+    from tpu_bfs.workloads.p2p import P2pServeEngine
+
+    g = random_graph(150, 600, seed=26)
+    p2p = P2pServeEngine(WidePackedMsBfsEngine(g, lanes=64))
+    assert p2p.lanes == 32  # pairs, half the base lanes
+    srcs = np.array([7, 7, 0])
+    tgts = np.array([7, 9, 13])
+    res = p2p.run(srcs, targets=tgts)
+    assert res.extras(0) == {
+        "target": 7, "met": True, "distance": 0, "path": [7],
+    }
+    for i in (1, 2):
+        s, t = int(srcs[i]), int(tgts[i])
+        d = bfs_scipy(g, s)
+        want = int(d[t]) if d[t] != INF_DIST else None
+        assert res.extras(i)["distance"] == want
+
+
+# --- the serve tier's kind axis --------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def weighted_graph():
+    return random_graph(300, 900, seed=31, weights=6)
+
+
+@pytest.fixture(scope="module")
+def kind_service(weighted_graph):
+    from tpu_bfs.serve import BfsService
+
+    svc = BfsService(
+        weighted_graph, lanes=64, width_ladder="32,64", linger_ms=1.0,
+    )
+    yield svc
+    svc.close()
+
+
+def test_serve_all_kinds_oracle(kind_service, weighted_graph):
+    from scipy.sparse import csgraph
+
+    g = weighted_graph
+    svc = kind_service
+    assert set(svc.kinds) == {"bfs", "sssp", "cc", "khop", "p2p"}
+    r = svc.query(5, timeout=120)
+    np.testing.assert_array_equal(r.distances, bfs_scipy(g, 5))
+    r = svc.query(5, kind="sssp", timeout=120)
+    assert r.ok and r.kind == "sssp"
+    oracle = _dijkstra_oracle(g, 5)
+    got = r.distances.astype(float)
+    got[got == INF_DIST] = np.inf
+    np.testing.assert_array_equal(got, oracle)
+    d5 = bfs_scipy(g, 5)
+    r = svc.query(5, kind="khop", k=2, timeout=120)
+    assert r.ok and r.distances is None
+    assert r.reached == int(((d5 != INF_DIST) & (d5 <= 2)).sum())
+    r = svc.query(5, kind="cc", timeout=120)
+    nc, _ = csgraph.connected_components(g.to_scipy(), directed=False)
+    assert r.ok and r.extras["components"] == nc
+    assert r.extras["component_size"] == r.reached
+    t = int(np.flatnonzero(d5 != INF_DIST)[-1])
+    r = svc.query(5, kind="p2p", target=t, timeout=120)
+    assert r.ok and r.extras["distance"] == int(d5[t])
+    path = r.extras["path"]
+    assert path[0] == 5 and path[-1] == t
+
+
+def test_serve_kind_structured_errors(kind_service):
+    svc = kind_service
+    r = svc.query(5, kind="pagerank", timeout=30)
+    assert r.status == "error" and "unknown kind" in r.error
+    r = svc.query(5, kind="khop", timeout=30)
+    assert r.status == "error" and '"k"' in r.error
+    r = svc.query(5, kind="p2p", timeout=30)
+    assert r.status == "error" and "target" in r.error
+    r = svc.query(5, kind="p2p", target=10**9, timeout=30)
+    assert r.status == "error" and "out of range" in r.error
+
+
+def test_serve_kind_engine_mismatch_is_structured():
+    """A service over an UNWEIGHTED graph serves no sssp: the request
+    answers with a structured error naming the served kinds, never a
+    drop (ISSUE 14 satellite)."""
+    from tpu_bfs.serve import BfsService
+
+    svc = BfsService(
+        random_graph(96, 480, seed=3), lanes=32, width_ladder="off",
+        linger_ms=1.0,
+    )
+    try:
+        assert "sssp" not in svc.kinds
+        r = svc.query(3, kind="sssp", timeout=30)
+        assert r.status == "error"
+        assert "not served" in r.error and "weighted" in r.error
+    finally:
+        svc.close()
+
+
+def test_serve_mixed_kind_burst(kind_service, weighted_graph):
+    """Mixed-kind closed loop: every query of every kind resolves ok,
+    and the kind-aware coalescer never mixes kinds in one batch (pinned
+    by construction: a mixed batch would crash on the adapters'
+    incompatible dispatch signatures)."""
+    svc = kind_service
+    V = weighted_graph.num_vertices
+    pend = []
+    for i in range(60):
+        kind = ("bfs", "sssp", "cc", "khop", "p2p")[i % 5]
+        pend.append(svc.submit(
+            i % V, kind=kind,
+            k=2 if kind == "khop" else None,
+            target=(i + 7) % V if kind == "p2p" else None,
+        ))
+    res = [p.result(timeout=300) for p in pend]
+    bad = [(r.status, r.error) for r in res if not r.ok]
+    assert not bad, bad[:3]
+    assert {r.kind for r in res} == {"bfs", "sssp", "cc", "khop", "p2p"}
+
+
+def test_admission_queue_coalesces_same_kind_only():
+    from tpu_bfs.serve.scheduler import AdmissionQueue, PendingQuery
+
+    q = AdmissionQueue(64)
+    items = [
+        PendingQuery(1, kind="bfs"),
+        PendingQuery(2, kind="sssp"),
+        PendingQuery(3, kind="bfs"),
+        PendingQuery(4, kind="khop", k=2),
+        PendingQuery(5, kind="khop", k=3),
+        PendingQuery(6, kind="khop", k=2),
+    ]
+    for it in items:
+        assert q.offer(it)
+    b1 = q.next_batch(8, 0.0)
+    assert [x.source for x in b1] == [1, 3]  # bfs only, order kept
+    b2 = q.next_batch(8, 0.0)
+    assert [x.source for x in b2] == [2]
+    b3 = q.next_batch(8, 0.0)
+    assert [x.source for x in b3] == [4, 6]  # same-k khop coalesce
+    assert [x.source for x in q.next_batch(8, 0.0)] == [5]
+    assert q.depth() == 0
+
+
+def test_registry_kind_axis_and_aot_key():
+    from tpu_bfs.serve.registry import EngineSpec
+    from tpu_bfs.utils.aot import program_key
+
+    EngineSpec(graph_key="g", kind="khop", engine="wide").validate()
+    with pytest.raises(ValueError, match="runs on engines"):
+        EngineSpec(graph_key="g", kind="sssp", engine="hybrid",
+                   lanes=4096).validate()
+    with pytest.raises(ValueError, match="single-chip"):
+        EngineSpec(graph_key="g", kind="cc", devices=4).validate()
+    with pytest.raises(ValueError, match="pull_gate"):
+        EngineSpec(graph_key="g", kind="p2p", pull_gate=True).validate()
+    with pytest.raises(ValueError, match="kind must be"):
+        EngineSpec(graph_key="g", kind="pagerank").validate()
+    # AOT keys: default kind stays byte-identical to the PR 9 layout;
+    # non-default kinds never alias it.
+    k_bfs = program_key(EngineSpec(graph_key="g"))
+    assert "kind" not in k_bfs
+    k_sssp = program_key(EngineSpec(graph_key="g", kind="sssp"))
+    assert k_sssp["kind"] == "sssp"
+
+
+def test_breaker_key_kind_shape():
+    from tpu_bfs.serve.executor import breaker_key
+
+    assert breaker_key(64, 1) == (64, 1)  # PR 10/11 pins unchanged
+    assert breaker_key(64, 1, "bfs") == (64, 1)
+    assert breaker_key(64, 1, "sssp") == (64, 1, "sssp")
+
+
+# --- JSONL protocol ---------------------------------------------------------
+
+
+def test_jsonl_kind_round_trip(weighted_graph):
+    from tpu_bfs.serve import EngineRegistry
+    from tpu_bfs.serve.frontend import build_arg_parser, run_server
+
+    reg = EngineRegistry(capacity=8)
+    reg.add_graph("wg", weighted_graph)
+    reqs = "\n".join([
+        json.dumps({"id": 1, "source": 0}),
+        json.dumps({"id": 2, "source": 3, "kind": "sssp"}),
+        json.dumps({"id": 3, "source": 3, "kind": "cc"}),
+        json.dumps({"id": 4, "source": 3, "kind": "khop", "k": 2}),
+        json.dumps({"id": 5, "source": 3, "kind": "p2p", "target": 9}),
+        json.dumps({"id": 6, "source": 3, "kind": "nope"}),
+        json.dumps({"id": 7, "source": 3, "kind": ["sssp"]}),
+        json.dumps({"id": 8, "source": 3, "kind": "khop", "k": "two"}),
+        json.dumps({"id": 9, "source": 3, "kind": ""}),
+    ]) + "\n"
+    args = build_arg_parser().parse_args(
+        ["wg", "--lanes", "32", "--ladder", "off", "--linger-ms", "1",
+         "--statsz-every", "0"]
+    )
+    out, err = io.StringIO(), io.StringIO()
+    rc = run_server(args, stdin=io.StringIO(reqs), stdout=out, stderr=err,
+                    registry=reg)
+    assert rc == 0
+    lines = {r["id"]: r for l in out.getvalue().splitlines() if l.strip()
+             for r in [json.loads(l)]}
+    assert len(lines) == 9  # one response per line, none dropped
+    assert lines[1]["status"] == "ok" and "kind" not in lines[1]
+    assert lines[2]["status"] == "ok" and lines[2]["kind"] == "sssp"
+    assert lines[3]["status"] == "ok" and lines[3]["components"] >= 1
+    assert lines[4]["status"] == "ok" and lines[4]["k"] == 2
+    assert "distances_npy" not in lines[4]  # metadata-only kind
+    assert lines[5]["status"] == "ok" and lines[5]["target"] == 9
+    assert lines[6]["status"] == "error" and "unknown kind" in lines[6]["error"]
+    assert lines[7]["status"] == "error"  # non-string kind: bad request
+    assert lines[8]["status"] == "error"  # non-int k: bad request
+    # Review pin: an EMPTY kind string is an unknown kind, never
+    # silently served as bfs.
+    assert (lines[9]["status"] == "error"
+            and "unknown kind" in lines[9]["error"])
+    assert "READY" in err.getvalue() and "kinds=" in err.getvalue()
+
+
+# --- chaos: the sssp fault sites (faultcov coverage) ------------------------
+
+
+def test_sssp_fault_sites_drive_serve_retry(weighted_graph):
+    """The new injection sites (faults.SITES sssp_dispatch/sssp_fetch)
+    fire inside the SSSP engine's halves and ride the serve executor's
+    shared transient classifier — the answer stays oracle-correct with
+    the retries visible in the schedule's audit log."""
+    from tpu_bfs import faults
+    from tpu_bfs.serve import BfsService
+
+    sched = faults.arm_from_spec(
+        "seed=7:transient@sssp_dispatch:n=1,transient@sssp_fetch:n=1"
+    )
+    try:
+        svc = BfsService(
+            weighted_graph, lanes=32, width_ladder="off", linger_ms=1.0,
+        )
+        try:
+            r = svc.query(5, kind="sssp", timeout=120)
+            assert r.ok, (r.status, r.error)
+            oracle = _dijkstra_oracle(weighted_graph, 5)
+            got = r.distances.astype(float)
+            got[got == INF_DIST] = np.inf
+            np.testing.assert_array_equal(got, oracle)
+        finally:
+            svc.close()
+        fired = {e["site"] for e in sched.events}
+        assert fired == {"sssp_dispatch", "sssp_fetch"}
+    finally:
+        faults.disarm()
+
+
+def test_sssp_oom_site_runs_width_degrade(weighted_graph):
+    """An injected RESOURCE_EXHAUSTED at the sssp dispatch rides the
+    same OOM width-degrade ladder as a bfs batch (per-kind breaker keys
+    keep the bfs rungs untouched)."""
+    from tpu_bfs import faults
+    from tpu_bfs.serve import BfsService
+
+    faults.arm_from_spec("seed=3:oom@sssp_dispatch@rung=64:n=1")
+    try:
+        svc = BfsService(
+            weighted_graph, lanes=64, width_ladder="32,64", linger_ms=1.0,
+        )
+        try:
+            r = svc.query(5, kind="sssp", timeout=120)
+            assert r.ok, (r.status, r.error)
+            assert r.dispatched_lanes == 32  # re-admitted below the OOM
+        finally:
+            svc.close()
+    finally:
+        faults.disarm()
+
+
+def test_p2p_bookkeeping_uses_base_width(weighted_graph):
+    """Review pin: the p2p adapter's capacity counts PAIRS, but breaker
+    keys and the OOM-degrade walk run in base-lane ladder units
+    (ladder_lanes) — an injected OOM on a p2p batch at the 64 rung must
+    degrade the service onto the 32 rung, not off the width grid."""
+    from tpu_bfs import faults
+    from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
+    from tpu_bfs.serve import BfsService
+    from tpu_bfs.serve.executor import BatchExecutor, PendingBatch
+    from tpu_bfs.serve.scheduler import PendingQuery
+    from tpu_bfs.workloads.p2p import P2pServeEngine
+
+    eng = P2pServeEngine(WidePackedMsBfsEngine(weighted_graph, lanes=64))
+    assert eng.lanes == 32 and eng.ladder_lanes == 64
+    pb = PendingBatch(eng, [PendingQuery(0, kind="p2p", target=1)], 1,
+                      np.zeros(32, np.int64), kind="p2p")
+    assert pb.lanes == 64  # ladder units, not pair capacity
+    # Fixed 64-lane ladder so the lone p2p query actually dispatches at
+    # the 64 rung (with a ladder, its 2-lane demand would route to 32);
+    # the rung=64 qualifier then only fires if the batch's bookkeeping
+    # width is the BASE width — in pair units it would never match.
+    faults.arm_from_spec("seed=5:oom@serve_batch@rung=64:n=1")
+    try:
+        svc = BfsService(
+            weighted_graph, lanes=64, width_ladder="off", linger_ms=1.0,
+        )
+        try:
+            r = svc.query(5, kind="p2p", target=9, timeout=120)
+            assert r.ok, (r.status, r.error)
+            assert r.dispatched_lanes == 32  # degraded onto the grid
+            assert svc.lanes == 32
+        finally:
+            svc.close()
+    finally:
+        faults.disarm()
+
+
+def test_p2p_rejected_on_directed_graphs():
+    from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
+    from tpu_bfs.workloads import supported_kinds
+    from tpu_bfs.workloads.p2p import P2pServeEngine
+
+    g = random_graph(96, 400, seed=8, directed=True)
+    assert "p2p" not in supported_kinds("wide", 1, g)
+    with pytest.raises(ValueError, match="undirected"):
+        P2pServeEngine(WidePackedMsBfsEngine(g, lanes=32))
+
+
+def test_khop_truncation_at_cap_raises_not_undercounts():
+    """Review pin: a khop k clamped to the plane cap on a graph deeper
+    than the cap must raise (the base truncation guard), never report
+    the cap-radius ball as the k-hop count."""
+    from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
+    from tpu_bfs.graph.io import from_edges
+    from tpu_bfs.workloads.khop import KhopServeEngine
+
+    n = 40  # path graph: depth 39 > 2-plane cap of 4
+    g = from_edges(np.arange(n - 1), np.arange(1, n), num_vertices=n)
+    kh = KhopServeEngine(WidePackedMsBfsEngine(g, lanes=32, num_planes=2))
+    res = kh.run(np.array([0]), k=3)  # below the cap: exact
+    assert int(res.reached[0]) == 4
+    with pytest.raises(RuntimeError, match="truncated"):
+        kh.run(np.array([0]), k=100)  # clamped to the cap AND cut off

@@ -50,6 +50,15 @@ def _graph():
     return random_graph(96, 480, seed=3)
 
 
+@lru_cache(maxsize=1)
+def _graph_weighted():
+    from tpu_bfs.graph.generate import random_graph
+
+    # The same calibration shape with the deterministic weight plane —
+    # the sssp workload config's substrate (ISSUE 14).
+    return random_graph(96, 480, seed=3, weights=5)
+
+
 def _mesh(p: int = 8):
     from tpu_bfs.parallel.dist_bfs import make_mesh
 
@@ -110,10 +119,11 @@ def _build_engine(config: str):
         exchange = config.split("-", 1)[1]
         return DistHybridMsBfsEngine(g, _mesh(), exchange=exchange)
     if config.startswith("serve-"):
-        # Distributed serving configs (ISSUE 11): built through the
-        # REGISTRY itself — the sweep then verifies the exact engine the
-        # serve tier constructs (mesh keys, exchange config, serving
-        # planes), not a hand-assembled twin.
+        # Distributed serving configs (ISSUE 11) and the workload kinds
+        # (ISSUE 14): built through the REGISTRY itself — the sweep then
+        # verifies the exact engine the serve tier constructs (mesh
+        # keys, exchange config, serving planes, kind adapters), not a
+        # hand-assembled twin.
         from tpu_bfs.serve.registry import EngineRegistry, EngineSpec
 
         kw = {
@@ -128,9 +138,19 @@ def _build_engine(config: str):
                 engine="dist2d", devices=8, lanes=32, exchange="sparse",
                 delta_bits=(8, 16), sieve=True, predict=True,
             ),
+            # Workload-kind serving configs (ISSUE 14): the adapters'
+            # analysis_programs expose the delta-stepping core (dtype +
+            # donation certificate), the khop-bounded base core, the CC
+            # label fold, and the p2p pair reductions.
+            "serve-sssp": dict(kind="sssp", engine="wide", lanes=32),
+            "serve-khop": dict(kind="khop", engine="wide", lanes=64),
+            "serve-cc": dict(kind="cc", engine="wide", lanes=64),
+            "serve-p2p": dict(kind="p2p", engine="wide", lanes=64),
         }.get(config)
         if kw is None:
             raise KeyError(config)
+        if kw.get("kind") == "sssp":
+            g = _graph_weighted()
         reg = EngineRegistry(capacity=1, warm=False)
         key = reg.add_graph("g", g)
         return reg.get(EngineSpec(graph_key=key, **kw))
@@ -147,6 +167,7 @@ ALL_CONFIGS = (
     "wide-sparse-rows", "wide-delta-rows",
     "hybrid-dense", "hybrid-sparse", "hybrid-sliced",
     "serve-dist-wide", "serve-dist-hybrid", "serve-dist2d",
+    "serve-sssp", "serve-khop", "serve-cc", "serve-p2p",
 )
 
 

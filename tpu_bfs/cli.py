@@ -48,11 +48,15 @@ def load_graph(spec: str):
             kw.get("scale", 16),
             kw.get("ef", 16),
             seed=kw.get("seed", 1),
+            # weights=W attaches the deterministic per-edge weight plane
+            # (ISSUE 14: the sssp serving kind needs it).
+            weights=kw.get("weights") or None,
         )
     if spec.startswith("random:"):
         _, kw = _parse_spec(spec)
         return generate.random_graph(
-            kw.get("n", 1024), kw.get("m", 8192), seed=kw.get("seed", 12345)
+            kw.get("n", 1024), kw.get("m", 8192), seed=kw.get("seed", 12345),
+            weights=kw.get("weights") or None,
         )
     if spec == "-":
         return io.read_stdin()

@@ -20,6 +20,8 @@ ckpt_save   utils/checkpoint._atomic_savez (corruption happens here)
 ckpt_load   utils/checkpoint load paths
 advance     utils/recovery.advance_with_recovery (chunk step)
 aot_load    utils/aot.ArtifactStore payload read (AOT preheat path)
+sssp_dispatch workloads/sssp.SsspEngine.dispatch (weighted workload)
+sssp_fetch  workloads/sssp.SsspEngine.fetch (blocking result half)
 ========== =======================================================
 
 Production code never pays for this when disabled: every site guard is
@@ -93,6 +95,12 @@ SITES = (
     "advance",
     "aot_load",
     "probe",
+    # ISSUE 14: the SSSP workload engine's dispatch/fetch halves
+    # (tpu_bfs/workloads/sssp.py) — the delta-stepping twin of the
+    # packed engines' dispatch/fetch sites, so chaos schedules can
+    # target the weighted path without touching bfs traffic.
+    "sssp_dispatch",
+    "sssp_fetch",
 )
 
 # Where a clause lands when it names no "@site". slow_extract is the

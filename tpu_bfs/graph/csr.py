@@ -63,10 +63,16 @@ class Graph:
     col_idx: np.ndarray  # [E]   int32 — reference: adjacencyList (bfs.cu:23)
     num_input_edges: int  # m as given in the input (before direction doubling)
     undirected: bool = True  # True when edge slots are the double-insert of input edges
+    # Optional per-edge-slot weights aligned with col_idx (ISSUE 14: the
+    # SSSP workload's plane). int32, >= 1; an undirected double-insert
+    # stores the SAME weight on both directed slots of an input edge.
+    weights: np.ndarray | None = None
 
     def __post_init__(self):
         assert self.row_ptr.ndim == 1 and self.col_idx.ndim == 1
         assert self.row_ptr[0] == 0 and self.row_ptr[-1] == len(self.col_idx)
+        if self.weights is not None:
+            assert self.weights.shape == self.col_idx.shape
 
     @property
     def num_vertices(self) -> int:
@@ -99,10 +105,15 @@ class Graph:
         # Adjacency may be unsorted when built with sort_neighbors=False.
         return bool(np.any(sl == v))
 
-    def to_scipy(self):
+    def to_scipy(self, *, weighted: bool = False):
         import scipy.sparse as sp
 
-        data = np.ones(self.num_edges, dtype=np.int8)
+        if weighted:
+            if self.weights is None:
+                raise ValueError("graph has no weights plane")
+            data = self.weights.astype(np.int64)
+        else:
+            data = np.ones(self.num_edges, dtype=np.int8)
         return sp.csr_matrix(
             (data, self.col_idx, self.row_ptr),
             shape=(self.num_vertices, self.num_vertices),
@@ -117,13 +128,16 @@ def build_csr(
     num_input_edges: int | None = None,
     sort_neighbors: bool = True,
     undirected: bool = True,
+    weights: np.ndarray | None = None,
 ) -> Graph:
     """Build a CSR Graph from directed edge slots.
 
     The reference builds CSR by concatenating per-vertex adjacency vectors
     (readGraphFromFile, bfs.cu:866-872); here it is a vectorized counting sort.
     ``sort_neighbors`` additionally orders each adjacency list, enabling
-    O(log d) edge-existence checks in validation.
+    O(log d) edge-existence checks in validation. ``weights`` (per directed
+    edge slot, aligned with src/dst) ride the same permutation so the
+    stored plane stays slot-aligned with ``col_idx``.
     """
     src = np.asarray(src, dtype=np.int64)
     dst = np.asarray(dst, dtype=np.int64)
@@ -132,6 +146,14 @@ def build_csr(
         raise ValueError("src vertex id out of range")
     if len(dst) and (dst.min() < 0 or dst.max() >= num_vertices):
         raise ValueError("dst vertex id out of range")
+    if weights is not None:
+        weights = np.asarray(weights, dtype=np.int32)
+        if weights.shape != src.shape:
+            raise ValueError(
+                f"weights shape {weights.shape} != edge count {src.shape}"
+            )
+        if len(weights) and weights.min() < 1:
+            raise ValueError("edge weights must be >= 1")
 
     if sort_neighbors:
         order = _lexsort_pairs(src, dst, num_vertices)
@@ -147,6 +169,7 @@ def build_csr(
         col_idx=col_idx,
         num_input_edges=num_input_edges if num_input_edges is not None else len(src),
         undirected=undirected,
+        weights=None if weights is None else weights[order],
     )
 
 

@@ -458,6 +458,88 @@ def bucketize_rows(lens: np.ndarray, nbrs: np.ndarray, new_rp: np.ndarray,
     )
 
 
+def bucketize_values(lens: np.ndarray, vals: np.ndarray, new_rp: np.ndarray,
+                     kcap: int, pad: int):
+    """Per-bucket VALUE tables slot-aligned with :func:`bucketize_rows`'s
+    idx tables (ISSUE 14: the SSSP weights plane).
+
+    ``vals`` carries one value per edge slot in the same flat order as
+    ``nbrs`` (rank-row-major concatenated neighbor lists); the heavy
+    virtual-row split and the light width ladder replay bucketize_rows's
+    exact slicing, so slot (row, col) of each returned table is the value
+    of the neighbor ``idx[row, col]`` names. Unused slots hold ``pad``.
+    Returns ``(virtual_vals | None, [light value tables])``."""
+    num_heavy = int(np.searchsorted(-lens, -kcap, side="left"))
+    num_nonzero = int(np.searchsorted(-lens, 0, side="left"))
+
+    virtual_vals = None
+    if num_heavy:
+        hlens = lens[:num_heavy]
+        r_per = -(-hlens // kcap)
+        num_virtual = int(r_per.sum())
+        vlens = np.full(num_virtual, kcap, dtype=np.int64)
+        vr_last = np.cumsum(r_per) - 1
+        vlens[vr_last] = hlens - kcap * (r_per - 1)
+        heavy_flat = vals[: int(new_rp[num_heavy])]
+        virtual_vals = _ell_fill(vlens, heavy_flat, kcap, pad)
+
+    light_vals: list[np.ndarray] = []
+    row = num_heavy
+    k = kcap
+    while row < num_nonzero and k >= 1:
+        lo_deg = k // 2
+        hi = int(np.searchsorted(-lens, -(lo_deg + 1), side="right"))
+        if hi > row:
+            sl = slice(row, hi)
+            flat = vals[int(new_rp[row]) : int(new_rp[hi])]
+            light_vals.append(_ell_fill(lens[sl], flat, k, pad))
+            row = hi
+        k //= 2
+
+    return virtual_vals, light_vals
+
+
+def build_ell_weights(g: Graph, ell: EllGraph, *, pad: int = 0):
+    """The per-slot weight tables of ``ell``'s buckets (ISSUE 14).
+
+    ``ell`` must be ``build_ell(g)`` over the same graph, which must
+    carry a weights plane. Returns ``(virtual_w | None, [light_w])``:
+    each table has exactly the shape of the matching bucket's ``idx``,
+    with slot (row, col) holding the weight of the in-edge whose source
+    ``idx[row, col]`` names, and ``pad`` in unused slots (pad slots
+    gather the engines' all-INF sentinel row, so their weight is inert
+    under min-plus)."""
+    if g.weights is None:
+        raise ValueError("graph has no weights plane (build it with weights=W)")
+    v_count = g.num_vertices
+    src, dst = g.coo
+    order_ds = _lexsort_pairs(dst, src, v_count)
+    in_deg = np.bincount(dst, minlength=v_count).astype(np.int64)
+    in_rp = np.zeros(v_count + 1, dtype=np.int64)
+    np.cumsum(in_deg, out=in_rp[1:])
+    rank_order = ell.old_of_new
+    lens = in_deg[rank_order]
+    new_rp = np.zeros(v_count + 1, dtype=np.int64)
+    np.cumsum(lens, out=new_rp[1:])
+    # Same flat order as build_ell's nbrs: in-edge weights, dst-major,
+    # rows replayed in rank order.
+    wflat = g.weights[order_ds][_flat_positions(in_rp[rank_order], lens)]
+    virtual_w, light_w = bucketize_values(
+        lens, wflat, new_rp, ell.kcap, pad
+    )
+    # Shape pin: the value tables must be slot-aligned with the ell's own
+    # buckets or every downstream gather-add is silently wrong.
+    if (virtual_w is None) != (ell.virtual is None) or (
+        virtual_w is not None and virtual_w.shape != ell.virtual.idx.shape
+    ):
+        raise AssertionError("weight plane misaligned with ell heavy bucket")
+    if len(light_w) != len(ell.light) or any(
+        w.shape != b.idx.shape for w, b in zip(light_w, ell.light)
+    ):
+        raise AssertionError("weight plane misaligned with ell light buckets")
+    return virtual_w, light_w
+
+
 def build_ell(g: Graph, *, kcap: int = 64) -> EllGraph:
     """Build the bucketed in-neighbor ELL from a host CSR graph.
 

@@ -15,6 +15,36 @@ from tpu_bfs.graph.csr import Graph
 from tpu_bfs.graph.io import from_edges
 
 
+def edge_weights(
+    u: np.ndarray, v: np.ndarray, *, seed: int, wmax: int = 8, wmin: int = 1
+) -> np.ndarray:
+    """Deterministic per-edge int32 weights in [wmin, wmax] (ISSUE 14).
+
+    The weight is a pure splitmix-style hash of the UNORDERED endpoint
+    pair and the seed — not a position in any RNG stream — so: (a) the
+    same (graph seed, edge) always draws the same weight, regardless of
+    generator impl or batch order; (b) (u, v) and (v, u) agree, which the
+    undirected double-insert requires; (c) parallel edges of a multigraph
+    collapse to one weight, so min-dedup and keep-duplicates builds agree
+    on every shortest path."""
+    if not (1 <= wmin <= wmax):
+        raise ValueError(f"need 1 <= wmin <= wmax, got [{wmin}, {wmax}]")
+    u = np.asarray(u, dtype=np.uint64)
+    v = np.asarray(v, dtype=np.uint64)
+    a, b = np.minimum(u, v), np.maximum(u, v)
+    with np.errstate(over="ignore"):  # uint64 wraparound is the mixer
+        h = (
+            a * np.uint64(0x9E3779B97F4A7C15)
+            + b * np.uint64(0xC2B2AE3D27D4EB4F)
+            + np.uint64(seed & 0xFFFFFFFFFFFFFFFF) * np.uint64(0xD6E8FEB86659FD93)
+        )
+        h ^= h >> np.uint64(33)
+        h *= np.uint64(0xFF51AFD7ED558CCD)
+        h ^= h >> np.uint64(33)
+    span = np.uint64(wmax - wmin + 1)
+    return (np.uint64(wmin) + h % span).astype(np.int32)
+
+
 def random_graph(
     num_vertices: int,
     num_edges: int,
@@ -22,12 +52,14 @@ def random_graph(
     seed: int = 12345,
     directed: bool = False,
     drop_self_loops: bool = False,
+    weights: int | None = None,
 ) -> Graph:
     """Uniform random multigraph, seeded and reproducible.
 
     Mirrors readGraph's generator mode (bfs.cu:892-907): m uniform (u, v)
     pairs, undirected double-insert, self-loops allowed (the reference allows
-    them too).
+    them too). ``weights=W`` adds the deterministic per-edge weight plane
+    (:func:`edge_weights`, values in [1, W]).
     """
     rng = np.random.default_rng(seed)
     u = rng.integers(0, num_vertices, size=num_edges, dtype=np.int64)
@@ -35,8 +67,12 @@ def random_graph(
     if drop_self_loops:
         keep = u != v
         u, v = u[keep], v[keep]
+    w = None
+    if weights is not None:
+        w = edge_weights(u, v, seed=seed, wmax=int(weights))
     return from_edges(
-        u, v, num_vertices=num_vertices, directed=directed, num_input_edges=num_edges
+        u, v, num_vertices=num_vertices, directed=directed,
+        num_input_edges=num_edges, weights=w,
     )
 
 
@@ -191,13 +227,23 @@ def rmat_graph(
     drop_self_loops: bool = True,
     dedup: bool = False,
     impl: str = "numpy",
+    weights: int | None = None,
     **quadrants,
 ) -> Graph:
+    """``weights=W`` is the weighted-RMAT mode (ISSUE 14): the Graph500
+    topology plus the deterministic per-edge weight plane
+    (:func:`edge_weights`, values in [1, W]) — the same seed always
+    yields the same weighted graph, and dedup preserves shortest paths
+    because parallel edges hash to one weight."""
     u, v = rmat_edges(scale, edge_factor, seed=seed, impl=impl, **quadrants)
     m = len(u)
     if drop_self_loops:
         keep = u != v
         u, v = u[keep], v[keep]
+    w = None
+    if weights is not None:
+        w = edge_weights(u, v, seed=seed, wmax=int(weights))
     return from_edges(
-        u, v, num_vertices=1 << scale, directed=False, num_input_edges=m, dedup=dedup
+        u, v, num_vertices=1 << scale, directed=False, num_input_edges=m,
+        dedup=dedup, weights=w,
     )

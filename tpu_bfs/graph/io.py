@@ -87,20 +87,43 @@ def from_edges(
     directed: bool = False,
     num_input_edges: int | None = None,
     dedup: bool = False,
+    weights: np.ndarray | None = None,
 ) -> Graph:
-    """Build a Graph from input edge endpoints (undirected -> double-insert)."""
+    """Build a Graph from input edge endpoints (undirected -> double-insert).
+
+    ``weights`` (one int per INPUT edge, >= 1) stores a per-edge weight
+    plane: the undirected double-insert carries the same weight on both
+    directed slots. ``dedup`` with weights keeps each surviving slot's
+    MINIMUM weight (the shortest-path-relevant one for parallel edges)."""
     u = np.asarray(u, dtype=np.int64)
     v = np.asarray(v, dtype=np.int64)
     if num_vertices is None:
         num_vertices = int(max(u.max(initial=-1), v.max(initial=-1)) + 1)
+    if weights is not None:
+        weights = np.asarray(weights, dtype=np.int32)
+        if weights.shape != u.shape:
+            raise ValueError(
+                f"weights shape {weights.shape} != input edge count {u.shape}"
+            )
     if directed:
         src, dst = u, v
+        wts = weights
     else:
         src = np.concatenate([u, v])
         dst = np.concatenate([v, u])
+        wts = None if weights is None else np.concatenate([weights, weights])
     if dedup:
         packed = src * np.int64(num_vertices) + dst
-        packed = np.unique(packed)
+        if wts is None:
+            packed = np.unique(packed)
+        else:
+            # Keep each surviving slot's minimum weight: sort by (slot,
+            # weight), take the first of each slot run.
+            order = np.lexsort((wts, packed))
+            packed, wts = packed[order], wts[order]
+            first = np.ones(len(packed), dtype=bool)
+            first[1:] = packed[1:] != packed[:-1]
+            packed, wts = packed[first], wts[first]
         src, dst = packed // num_vertices, packed % num_vertices
     return build_csr(
         src,
@@ -108,6 +131,7 @@ def from_edges(
         num_vertices,
         num_input_edges=num_input_edges if num_input_edges is not None else len(u),
         undirected=not directed,
+        weights=wts,
     )
 
 
@@ -134,12 +158,14 @@ def read_stdin(stream=None, *, directed: bool = True) -> Graph:
 
 
 def save_npz(path: str, g: Graph) -> None:
+    extra = {} if g.weights is None else {"weights": g.weights}
     np.savez_compressed(
         path,
         row_ptr=g.row_ptr,
         col_idx=g.col_idx,
         num_input_edges=np.int64(g.num_input_edges),
         undirected=np.bool_(g.undirected),
+        **extra,
     )
 
 
@@ -150,4 +176,5 @@ def load_npz(path: str) -> Graph:
         col_idx=d["col_idx"],
         num_input_edges=int(d["num_input_edges"]),
         undirected=bool(d["undirected"]) if "undirected" in d else True,
+        weights=d["weights"] if "weights" in d else None,
     )

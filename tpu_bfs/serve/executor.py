@@ -79,9 +79,14 @@ def engine_devices(engine) -> int:
     return _faults.mesh_devices(engine)
 
 
-def breaker_key(width: int, devices: int) -> tuple:
-    """The partition-aware breaker/degrade key: ``(width, devices)``."""
-    return (int(width), int(devices))
+def breaker_key(width: int, devices: int, kind: str = "bfs") -> tuple:
+    """The partition-aware breaker/degrade key: ``(width, devices)``,
+    extended with the query kind when non-default (ISSUE 14) — a broken
+    sssp rung must not blackhole the same width's bfs engine (different
+    compiled programs), while default-kind keys keep the PR 10/11 tuple
+    shape existing pins and dashboards read."""
+    base = (int(width), int(devices))
+    return base if kind == "bfs" else base + (kind,)
 
 
 class CircuitBreaker:
@@ -222,19 +227,30 @@ class PendingBatch:
 
     __slots__ = ("engine", "queries", "n", "padded", "handle", "attempt",
                  "lanes", "bid", "devices", "t_dispatch", "device_ms",
-                 "wire_bytes")
+                 "wire_bytes", "kind", "params")
 
-    def __init__(self, engine, queries, n: int, padded: np.ndarray):
+    def __init__(self, engine, queries, n: int, padded: np.ndarray,
+                 kind: str = "bfs", params: dict | None = None):
         self.engine = engine
         self.queries = list(queries)
         self.n = n
         self.padded = padded
+        # The batch's query kind + its batch-uniform dispatch kwargs
+        # (ISSUE 14: khop's k, p2p's padded targets) — carried so a
+        # transient re-dispatch on either pipeline half replays the
+        # identical call.
+        self.kind = kind
+        self.params = params or {}
         self.handle = None
         self.attempt = 0
         # Recorded at dispatch: the OOM handler clears ``engine`` to drop
         # the device-table reference before a narrower rebuild, but the
-        # service still needs the width the failure happened at.
-        self.lanes = engine.lanes
+        # service still needs the width the failure happened at. In
+        # LADDER units: an adapter whose batch capacity differs from its
+        # registry width (p2p counts pairs) publishes ``ladder_lanes``
+        # so the breaker keys and the OOM-degrade walk stay on the
+        # service's width grid.
+        self.lanes = getattr(engine, "ladder_lanes", engine.lanes)
         # Mesh span of this batch's engine — half of the partition-aware
         # breaker key, recorded here for the same clears-engine reason.
         self.devices = engine_devices(engine)
@@ -324,7 +340,19 @@ class BatchExecutor:
         queries = live
         sources = np.asarray([q.source for q in queries], dtype=np.int64)
         padded, n = pad_batch(sources, engine.lanes)
-        pending = PendingBatch(engine, queries, n, padded)
+        # Per-kind dispatch kwargs (ISSUE 14): the scheduler only
+        # coalesces same-batch-key queries, so the first query's kind
+        # and parameters speak for the whole batch. p2p's targets pad
+        # exactly like the sources (pad pairs clone pair 0).
+        kind = getattr(queries[0], "kind", "bfs")
+        from tpu_bfs.workloads import batch_params
+
+        params = batch_params(queries)
+        if "targets" in params:
+            params["targets"], _ = pad_batch(
+                params["targets"], engine.lanes
+            )
+        pending = PendingBatch(engine, queries, n, padded, kind, params)
         rec = _obs.ACTIVE
         if rec is not None:
             # The batch span opens at dispatch and closes when every
@@ -341,20 +369,21 @@ class BatchExecutor:
             )
             rec.begin("batch", f"b{pending.bid}",  # span-outlives: finish_batch/_extract/_classify_failure close it
                       cat="serve.batch",
-                      batch=pending.bid, n=n, width=engine.lanes,
+                      batch=pending.bid, n=n, width=pending.lanes,
                       queries=[q.id for q in pending.queries], **mesh_kw)
             rec.begin("dispatch", f"b{pending.bid}", cat="serve.batch",
-                      batch=pending.bid, width=engine.lanes, **mesh_kw)
+                      batch=pending.bid, width=pending.lanes, **mesh_kw)
         while True:
             try:
                 if _faults.ACTIVE is not None:
                     # Chaos-harness injection site: engine-agnostic (the
                     # _packed_common dispatch/fetch sites cover real
                     # engines; this one also covers test doubles).
-                    _faults.ACTIVE.hit("serve_batch", lanes=engine.lanes,
+                    _faults.ACTIVE.hit("serve_batch", lanes=pending.lanes,
                                        n=pending.n)
                 pending.t_dispatch = time.monotonic()
-                pending.handle = self._dispatch(engine, padded)
+                pending.handle = self._dispatch(engine, padded,
+                                                pending.params)
                 if rec is not None:
                     rec.end("dispatch", f"b{pending.bid}", cat="serve.batch",
                             batch=pending.bid, attempt=pending.attempt)
@@ -397,7 +426,9 @@ class BatchExecutor:
             try:
                 if pending.handle is None:  # re-dispatch after a retry
                     pending.t_dispatch = time.monotonic()
-                    pending.handle = self._dispatch(engine, pending.padded)
+                    pending.handle = self._dispatch(
+                        engine, pending.padded, pending.params
+                    )
                 res = self._fetch_watched(engine, pending)
                 # The batch's device occupancy — the per-query GTEPS
                 # denominator. Under pipelining, dispatch time includes
@@ -465,10 +496,12 @@ class BatchExecutor:
     # --- internals --------------------------------------------------------
 
     @staticmethod
-    def _dispatch(engine, padded):
+    def _dispatch(engine, padded, params=None):
         dispatch = getattr(engine, "dispatch", None)
         if dispatch is not None:
-            return dispatch(padded)
+            return dispatch(padded, **params) if params else dispatch(padded)
+        if params:
+            return _Ready(engine.run(padded, time_it=False, **params))
         return _Ready(engine.run(padded, time_it=False))
 
     @staticmethod
@@ -591,7 +624,8 @@ class BatchExecutor:
             )
             if self.breaker is not None:
                 self.breaker.record_failure(
-                    breaker_key(pending.lanes, pending.devices)
+                    breaker_key(pending.lanes, pending.devices,
+                                pending.kind)
                 )
             if rec is not None:
                 # Flight-recorder trigger (every mesh-fault firing):
@@ -637,7 +671,7 @@ class BatchExecutor:
             # broken — without blackholing the same width on a different
             # mesh span.
             opened = self.breaker.record_failure(
-                breaker_key(pending.lanes, pending.devices)
+                breaker_key(pending.lanes, pending.devices, pending.kind)
             )
             if opened and rec is not None:
                 # Flight-recorder trigger: a rung going provably dark is
@@ -653,7 +687,8 @@ class BatchExecutor:
     def _resolve_ok(self, pending: PendingBatch, res) -> None:
         if self.breaker is not None:
             self.breaker.record_success(
-                breaker_key(pending.engine.lanes, pending.devices)
+                breaker_key(pending.lanes, pending.devices,
+                            pending.kind)
             )
         rec = _obs.ACTIVE
         if rec is not None:
@@ -677,7 +712,10 @@ class BatchExecutor:
         from tpu_bfs.graph.csr import INF_DIST
 
         engine, queries, n = pending.engine, pending.queries, pending.n
-        width = engine.lanes
+        # Ladder units (ladder_lanes where the adapter's capacity
+        # differs): the width responses/metrics report must match the
+        # routing histogram's rungs.
+        width = pending.lanes
         # The on-device ecc summary is only worth its kernel dispatch when
         # some query skips the distance decode; all-want_distances batches
         # derive levels from the rows they pull anyway.
@@ -691,6 +729,10 @@ class BatchExecutor:
         # query its GTEPS under the batch time share; mesh engines add
         # their modeled wire bytes, split evenly over the real queries.
         edges_arr = getattr(res, "edges_traversed", None)
+        # Kind-specific response fields (ISSUE 14): workload results
+        # expose per-query extras (p2p's path, cc's component record,
+        # khop's k); the base engines' results have none.
+        extras_fn = getattr(res, "extras", None)
         wire_share = (
             pending.wire_bytes / n
             if pending.wire_bytes is not None and n else None
@@ -718,6 +760,8 @@ class BatchExecutor:
                 id=q.id,
                 source=q.source,
                 status=STATUS_OK,
+                kind=pending.kind,
+                extras=extras_fn(i) if extras_fn is not None else None,
                 distances=d if want else None,
                 levels=levels,
                 reached=int(res.reached[i]),

@@ -69,6 +69,11 @@ from tpu_bfs.utils.recovery import (
     is_oom_failure,
     is_transient_failure,
 )
+from tpu_bfs.workloads import (
+    KINDS,
+    METADATA_ONLY_KINDS,
+    supported_kinds,
+)
 
 MIN_LANES = 32
 # Auto ladder spacing: each rung 4x the previous (32/128/512 at the
@@ -243,6 +248,7 @@ class BfsService:
         breaker_threshold: int = 3,
         breaker_cooldown_ms: float = 30_000.0,
         distances: bool = True,
+        kinds=None,
         registry: EngineRegistry | None = None,
         registry_capacity: int = 4,
         aot_dir: str | None = None,
@@ -301,6 +307,39 @@ class BfsService:
         self._ladder_arg = width_ladder
         self._mesh_probe_interval_s = max(mesh_probe_interval_s, 0.0)
         self._mesh_probe = None  # guarded-by: _lock (lifecycle state)
+        # Served query kinds (ISSUE 14): None = everything this engine/
+        # mesh/graph supports (workloads.supported_kinds — sssp needs a
+        # weights plane, non-bfs kinds the single-chip wide substrate).
+        # An explicit list is validated here, at construction.
+        auto_kinds = supported_kinds(engine, devices, self._graph)
+        if kinds is None:
+            self._kinds = auto_kinds
+        else:
+            kinds = tuple(kinds)
+            for kind in kinds:
+                if kind not in KINDS:
+                    raise ValueError(
+                        f"unknown kind {kind!r} (one of {KINDS})"
+                    )
+                if kind not in auto_kinds:
+                    raise ValueError(
+                        f"kind {kind!r} is not servable by this config "
+                        f"(engine={engine!r}, devices={devices}, "
+                        f"weighted={self._graph.weights is not None}); "
+                        f"servable: {auto_kinds}"
+                    )
+            self._kinds = kinds
+        if not self._kinds:
+            raise ValueError("service must serve at least one kind")
+        if registry is None and len(self._kinds) > 1:
+            # The internally-owned registry must hold the warmed primary
+            # ladder PLUS one resident engine per additional kind (their
+            # serving rungs build lazily) or multi-kind traffic thrashes
+            # rebuilds; a caller-supplied registry keeps its own policy.
+            self._registry.capacity = max(
+                self._registry.capacity,
+                len(self._ladder) + len(self._kinds),
+            )
         for w in self._ladder:
             self._spec(w).validate()  # fail at construction, not first dispatch
         self._linger_s = max(linger_ms, 0.0) / 1e3
@@ -344,10 +383,12 @@ class BfsService:
     # --- lifecycle --------------------------------------------------------
 
     def _spec(self, width: int | None = None,
-              cfg: MeshServeConfig | None = None) -> EngineSpec:
+              cfg: MeshServeConfig | None = None,
+              kind: str = "bfs") -> EngineSpec:
         cfg = self._mesh_cfg if cfg is None else cfg
         return EngineSpec(
             graph_key=self._graph_key,
+            kind=kind,
             engine=cfg.engine,
             lanes=self.lanes if width is None else width,
             planes=self._planes,
@@ -376,7 +417,7 @@ class BfsService:
                 return self
             for w in sorted(self.width_ladder, reverse=True):
                 if w <= self.lanes:  # rungs above a degraded cap died
-                    self._acquire_engine(w)
+                    self._acquire_engine(w, self._primary_kind)
             if (self._mesh_probe_interval_s > 0
                     and self._cfg0.devices > 1
                     and self._mesh_probe is None):
@@ -450,6 +491,19 @@ class BfsService:
         return self._graph.num_vertices
 
     @property
+    def kinds(self) -> tuple:
+        """Query kinds this service answers (ISSUE 14)."""
+        return self._kinds
+
+    @property
+    def _primary_kind(self) -> str:
+        """The kind whose ladder start() warms eagerly ("bfs" when
+        served). Other kinds' engines build lazily on first query and
+        stay resident per the registry LRU — per-kind correct because
+        EngineSpec.kind keys the residency."""
+        return "bfs" if "bfs" in self._kinds else self._kinds[0]
+
+    @property
     def lanes(self) -> int:
         """Current maximum serving batch width (halves on OOM degrade)."""
         with self._width_lock:
@@ -462,19 +516,33 @@ class BfsService:
             return list(self._ladder)
 
     def submit(self, source, *, id=None, deadline_ms: float | None = None,
-               want_distances: bool | None = None) -> PendingQuery:
+               want_distances: bool | None = None, kind: str = "bfs",
+               k: int | None = None,
+               target: int | None = None) -> PendingQuery:
         """Enqueue one query; returns a PendingQuery whose ``result()``
         always resolves (ok / rejected / deadline_exceeded / error /
         shutdown — never a hang, never a silent drop).
         ``want_distances=False`` asks for a metadata-only answer (levels/
         reached) that never pulls the distance row off the device; None
-        uses the service-wide ``distances`` default."""
+        uses the service-wide ``distances`` default.
+
+        ``kind`` picks the query family (ISSUE 14: bfs | sssp | cc |
+        khop | p2p; the kinds this service actually serves are in
+        ``self.kinds``); khop requires ``k`` (hop bound >= 0), p2p a
+        ``target`` vertex. An unknown or unserved kind, a missing/bad
+        parameter, or an out-of-range endpoint resolves the query with a
+        STRUCTURED error — never a dropped request."""
         now = time.monotonic()
         ddl_s = (
             self._default_deadline_s
             if deadline_ms is None
             else max(deadline_ms, 0.0) / 1e3
         )
+        kind = "bfs" if kind is None else kind
+        if kind in METADATA_ONLY_KINDS:
+            # cc/khop/p2p answer from summaries / the cached index; no
+            # distance table exists to pull.
+            want_distances = False
         q = PendingQuery(
             source, id=id, now=now,
             deadline=(now + ddl_s) if ddl_s > 0 else None,
@@ -482,13 +550,12 @@ class BfsService:
                 self._want_distances_default
                 if want_distances is None else want_distances
             ),
+            kind=kind if kind in KINDS else "bfs",
+            k=k, target=target,
         )
-        if not (0 <= q.source < self._graph.num_vertices):
-            q.resolve_status(
-                STATUS_ERROR,
-                error=f"source {q.source} out of range "
-                      f"[0, {self._graph.num_vertices})",
-            )
+        err = self._validate_query(kind, q, k, target)
+        if err is not None:
+            q.resolve_status(STATUS_ERROR, error=err)
             self.metrics.record_errors()
             return q
         if self._closed or self._draining or not self._queue.offer(q):
@@ -503,12 +570,49 @@ class BfsService:
             self.metrics.record_rejected()
         return q
 
+    def _validate_query(self, kind: str, q: PendingQuery,
+                        k, target) -> str | None:
+        """The per-kind admission contract (ISSUE 14 satellite): the
+        error string for a malformed query, None when admissible. Every
+        failure is a structured per-id response, never a drop."""
+        if kind not in KINDS:
+            return f"unknown kind {kind!r} (one of {KINDS})"
+        if kind not in self._kinds:
+            return (
+                f"kind {kind!r} is not served by this service "
+                f"(engine={self._mesh_cfg.engine!r}, serving "
+                f"{self._kinds}" + (
+                    "; sssp needs a weighted graph"
+                    if kind == "sssp"
+                    and self._graph.weights is None else ""
+                ) + ")"
+            )
+        if not (0 <= q.source < self._graph.num_vertices):
+            return (
+                f"source {q.source} out of range "
+                f"[0, {self._graph.num_vertices})"
+            )
+        if kind == "khop":
+            if k is None or int(k) < 0:
+                return f'khop needs "k" >= 0, got {k!r}'
+        if kind == "p2p":
+            if target is None:
+                return 'p2p needs a "target" vertex id'
+            if not (0 <= int(target) < self._graph.num_vertices):
+                return (
+                    f"target {target} out of range "
+                    f"[0, {self._graph.num_vertices})"
+                )
+        return None
+
     def query(self, source, *, timeout: float | None = None,
               deadline_ms: float | None = None,
-              want_distances: bool | None = None):
+              want_distances: bool | None = None, kind: str = "bfs",
+              k: int | None = None, target: int | None = None):
         """Blocking submit-and-wait convenience."""
         return self.submit(
             source, deadline_ms=deadline_ms, want_distances=want_distances,
+            kind=kind, k=k, target=target,
         ).result(timeout)
 
     def statsz_extras(self) -> dict:
@@ -563,6 +667,7 @@ class BfsService:
             extra=self.statsz_extras(),
         )
         out["ladder"] = self.width_ladder
+        out["kinds"] = list(self._kinds)
         out["pipeline"] = self._pipe_q is not None
         resident = self._registry.resident()
         # None: a build holds the registry lock right now (resident() is
@@ -583,35 +688,39 @@ class BfsService:
 
     # --- scheduler thread -------------------------------------------------
 
-    def _route_width(self, n: int) -> int:
+    def _route_width(self, n: int, kind: str = "bfs") -> int:
         """The narrowest ladder rung that fits ``n`` queries (the cap when
         nothing does — the caller splits and re-admits the tail), skipping
         rungs whose circuit breaker is open. Breaker keys are
-        (width, devices): this service's mesh span — a rung tripped by the
-        single-chip path never blackholes the same width here, and vice
-        versa. When EVERY candidate is open the narrowest fitting rung is
-        used anyway — the breaker routes around broken rungs, it must
-        never wedge the service."""
+        (width, devices[, kind]): this service's mesh span — a rung
+        tripped by the single-chip path never blackholes the same width
+        here, and a broken workload adapter never blackholes the width's
+        bfs engine. When EVERY candidate is open the narrowest fitting
+        rung is used anyway — the breaker routes around broken rungs, it
+        must never wedge the service. A p2p query occupies TWO base
+        lanes, so its demand doubles against the (base-lane) rung
+        widths."""
         from tpu_bfs.serve.executor import breaker_key
 
+        need = 2 * n if kind == "p2p" else n
         with self._width_lock:
-            fits = [w for w in self._ladder if w >= n] or [self._max_lanes]
+            fits = [w for w in self._ladder if w >= need] or [self._max_lanes]
         devices = self._mesh_cfg.devices
         for w in fits:
-            if self._breaker.allow(breaker_key(w, devices)):
+            if self._breaker.allow(breaker_key(w, devices, kind)):
                 return w
         return fits[0]
 
-    def _acquire_engine(self, width: int):
-        """The warmed engine for ``width`` (clamped to the degrade cap),
-        retrying transient build failures and degrading on build-time OOM
-        (an engine build allocates the packed tables, so it can OOM
-        exactly like a dispatch)."""
+    def _acquire_engine(self, width: int, kind: str = "bfs"):
+        """The warmed engine for ``width`` x ``kind`` (clamped to the
+        degrade cap), retrying transient build failures and degrading on
+        build-time OOM (an engine build allocates the packed tables, so
+        it can OOM exactly like a dispatch)."""
         attempt = 0
         while True:
             width = min(width, self.lanes)
             try:
-                return self._registry.get(self._spec(width))
+                return self._registry.get(self._spec(width, kind=kind))
             except Exception as exc:  # noqa: BLE001 — gated by classifiers
                 if is_oom_failure(exc) and self._degrade(width):
                     continue
@@ -676,7 +785,11 @@ class BfsService:
                     self._ladder.append(new)
                 self._max_lanes = new
         for w in dying:
-            self._registry.evict(self._spec(w))
+            # Every served kind's engine at a dying width frees: the
+            # kinds share one width ladder, and a width that OOM'd for
+            # one kind's tables leaves no headroom for another's.
+            for kind in self._kinds:
+                self._registry.evict(self._spec(w, kind=kind))
         if new >= at_width:
             if dying:
                 self._log(
@@ -1072,16 +1185,20 @@ class BfsService:
             if not live:
                 continue
             try:
-                width = self._route_width(len(live))
+                # The batch is kind-uniform by construction (the queue
+                # only coalesces same-batch-key queries, ISSUE 14).
+                kind = getattr(live[0], "kind", "bfs")
+                width = self._route_width(len(live), kind)
                 rec = _obs.ACTIVE
                 if rec is not None:
                     # The coalesce record: which queries formed this
                     # batch and which ladder rung routing picked — the
                     # span-chain link between admission and dispatch.
                     rec.event("coalesce", cat="serve.batch", n=len(live),
-                              width=width, queries=[q.id for q in live],
+                              width=width, kind=kind,
+                              queries=[q.id for q in live],
                               queue_depth=self._queue.depth())
-                engine = self._acquire_engine(width)
+                engine = self._acquire_engine(width, kind)
                 if len(live) > engine.lanes:
                     # An OOM degraded the cap AFTER this batch was popped
                     # at the old one: serve what fits, re-admit the tail
@@ -1094,12 +1211,13 @@ class BfsService:
                 # Drop this frame's reference to the OOM'd engine before
                 # the narrower rebuild (OomRequeue is only raised by
                 # dispatch_batch, so `engine` is always bound here).
-                width = engine.lanes
+                # Ladder units (p2p's capacity counts pairs).
+                width = getattr(engine, "ladder_lanes", engine.lanes)
                 engine = None  # noqa: F841 — releases device tables
                 self._handle_batch_oom(exc.queries, width, exc.cause)
                 continue
             except MeshFaultRequeue as exc:
-                width = engine.lanes
+                width = getattr(engine, "ladder_lanes", engine.lanes)
                 engine = None  # noqa: F841 — releases device tables
                 self._handle_mesh_fault(exc.queries, width, exc.devices,
                                         exc.cause)
@@ -1145,6 +1263,8 @@ def decode_distances(payload: str) -> np.ndarray:
 
 def result_to_response(r, *, with_distances: bool = True) -> dict:
     out = {"id": r.id, "source": r.source, "status": r.status}
+    if getattr(r, "kind", "bfs") != "bfs":
+        out["kind"] = r.kind
     if r.ok:
         out["levels"] = r.levels
         out["reached"] = r.reached
@@ -1165,6 +1285,12 @@ def result_to_response(r, *, with_distances: bool = True) -> dict:
                 out["gteps"] = float(f"{r.gteps:.6g}")
             if r.wire_bytes is not None:
                 out["wire_bytes"] = round(r.wire_bytes, 1)
+        if getattr(r, "extras", None):
+            # Kind-specific fields (ISSUE 14): khop's k, cc's component
+            # record, p2p's target/distance/path, sssp's weighted flag.
+            # Merged last-but-reserved: protocol keys always win.
+            for key, val in r.extras.items():
+                out.setdefault(key, val)
         if with_distances and r.distances is not None:
             out["distances_npy"] = _encode_distances(r.distances)
     else:
@@ -1286,6 +1412,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
                     "(tpu_bfs/faults.py), e.g. 'seed=7:transient@dispatch:"
                     "p=0.05,oom@rung=512:n=2,slow_extract:ms=200'; "
                     "default: the TPU_BFS_FAULTS env var, else disabled")
+    ap.add_argument("--kinds", default=None, metavar="K1,K2,...",
+                    help="query kinds to serve (ISSUE 14): any of "
+                    "bfs,sssp,cc,khop,p2p; default: every kind this "
+                    "engine/graph supports (sssp needs a weighted "
+                    "graph; non-bfs kinds need the single-chip wide "
+                    "substrate). Requests carry {\"kind\": ...} (+ "
+                    "\"k\" for khop, \"target\" for p2p); unknown or "
+                    "unserved kinds answer a structured per-id error")
     ap.add_argument("--no-distances", action="store_true",
                     help="metadata-only serving by default: responses "
                     "omit distances_npy AND the distance rows are never "
@@ -1342,10 +1476,30 @@ def build_arg_parser() -> argparse.ArgumentParser:
     return ap
 
 
+def _int_field(req: dict, name: str):
+    """Strict integer request field (None when absent): exactly ints and
+    integral floats — bool is an int subclass and json floats arrive for
+    "7.0"; a lenient int() would silently truncate 7.9 to vertex 7."""
+    val = req.get(name)
+    if val is None:
+        return None
+    if isinstance(val, bool) or not isinstance(val, (int, float)):
+        raise TypeError(f"{name} must be an integer, got {val!r}")
+    if isinstance(val, float):
+        if not val.is_integer():
+            raise TypeError(f"{name} must be an integer, got {val!r}")
+        val = int(val)
+    return val
+
+
 def _parse_request_line(line: str):
-    """Parse one JSONL request into (id, source, deadline_ms, want).
-    Raises on ANYTHING malformed — the caller answers with a structured
-    error line; nothing a client sends may kill the reader loop."""
+    """Parse one JSONL request into (id, source, deadline_ms, want,
+    kind, k, target). Raises on ANYTHING malformed — the caller answers
+    with a structured error line; nothing a client sends may kill the
+    reader loop. ``kind`` is only TYPE-checked here (a string); the
+    unknown-kind / kind-vs-engine / missing-parameter checks live in
+    ``BfsService.submit`` so the in-process API and the wire agree on
+    one contract (README protocol grammar)."""
     req = json.loads(line)
     if not isinstance(req, dict):
         raise TypeError("request must be a JSON object")
@@ -1353,21 +1507,17 @@ def _parse_request_line(line: str):
     try:
         if "source" not in req:
             raise KeyError("source")
-        source = req["source"]
-        # bool is an int subclass and json floats arrive for "7.0":
-        # accept exactly the integers (ints and integral floats), reject
-        # the rest — a lenient int() would silently truncate 7.9 to
-        # vertex 7.
-        if isinstance(source, bool) or not isinstance(source, (int, float)):
+        source = _int_field(req, "source")
+        if source is None:  # JSON null — absent-but-present
             raise TypeError(
-                f"source must be an integer vertex id, got {source!r}"
+                f"source must be an integer vertex id, got "
+                f"{req['source']!r}"
             )
-        if isinstance(source, float):
-            if not source.is_integer():
-                raise TypeError(
-                    f"source must be an integer vertex id, got {source!r}"
-                )
-            source = int(source)
+        kind = req.get("kind")
+        if kind is not None and not isinstance(kind, str):
+            raise TypeError(f"kind must be a string, got {kind!r}")
+        k = _int_field(req, "k")
+        target = _int_field(req, "target")
         ddl = req.get("deadline_ms")
         if ddl is not None:
             # Same strictness as source: float(True) == 1.0 and
@@ -1388,7 +1538,7 @@ def _parse_request_line(line: str):
     except Exception as exc:
         exc._request_id = qid  # the error line must still correlate
         raise
-    return qid, source, ddl, want
+    return qid, source, ddl, want, kind, k, target
 
 
 DEFAULT_STATSZ_INTERVAL_S = 10.0
@@ -1514,6 +1664,10 @@ def run_server(args, stdin=None, stdout=None, stderr=None,
         breaker_threshold=args.breaker_threshold,
         breaker_cooldown_ms=args.breaker_cooldown_ms,
         distances=not args.no_distances,
+        kinds=(
+            tuple(t for t in str(args.kinds).replace(",", " ").split())
+            if getattr(args, "kinds", None) else None
+        ),
         registry=registry,
         registry_capacity=args.registry_cap,
         aot_dir=getattr(args, "preheat", None),
@@ -1541,7 +1695,8 @@ def run_server(args, stdin=None, stdout=None, stderr=None,
         ready_extra = (f" aot_hits={c['aot_hits']}"
                        f" aot_fallbacks={c['aot_fallbacks']}")
     log(f"READY engine={args.engine} lanes={args.lanes} "
-        f"ladder={service.width_ladder}{ready_extra}")
+        f"ladder={service.width_ladder} "
+        f"kinds={','.join(service.kinds)}{ready_extra}")
     out_lock = threading.Lock()
     outstanding = [0]
     drained = threading.Condition(out_lock)
@@ -1633,7 +1788,8 @@ def run_server(args, stdin=None, stdout=None, stderr=None,
                 qid = None
                 try:
                     try:
-                        qid, source, ddl, want = _parse_request_line(line)
+                        (qid, source, ddl, want,
+                         kind, k, target) = _parse_request_line(line)
                     except Exception as exc:  # noqa: BLE001 — answered, never fatal
                         # Includes RecursionError from hostile nesting and
                         # any parser surprise: one bad line must get one
@@ -1650,6 +1806,11 @@ def run_server(args, stdin=None, stdout=None, stderr=None,
                         service.submit(
                             source, id=qid, deadline_ms=ddl,
                             want_distances=want,
+                            # None = absent = bfs; an empty or unknown
+                            # string flows through to submit's structured
+                            # unknown-kind error (never silently bfs).
+                            kind="bfs" if kind is None else kind,
+                            k=k, target=target,
                         ).add_done_callback(on_done)
                     except Exception:
                         # No response will ever fire for this query: the

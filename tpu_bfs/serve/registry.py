@@ -88,6 +88,15 @@ class EngineSpec:
     planes: int = DEFAULT_PLANES
     pull_gate: bool = False
     devices: int = 1
+    #: Query kind this residency serves (ISSUE 14): "bfs" (the base
+    #: engines themselves) or a tpu_bfs/workloads adapter over them
+    #: (sssp/cc/khop/p2p). A key field — per-kind engines hold
+    #: different device state (SSSP's weighted tiles, CC's cached
+    #: index) and answer through different programs, so kinds never
+    #: alias one resident engine; utils/aot.program_key carries the
+    #: axis the same way (only when non-default, so existing stores
+    #: stay adoptable).
+    kind: str = "bfs"
     #: exchange family ("" = engine default): wide/hybrid row gathers
     #: (dense/sparse; hybrid also 'sliced'), dist2d row exchange
     #: (ring/allreduce/sparse). Mesh engines only.
@@ -205,6 +214,32 @@ class EngineSpec:
                 "with no per-query carry to snapshot — a mesh fault there "
                 "re-traverses the batch on the degraded mesh instead"
             )
+        if self.kind != "bfs":
+            from tpu_bfs.workloads import KIND_ENGINES, KINDS
+
+            if self.kind not in KINDS:
+                raise ValueError(
+                    f"kind must be one of {KINDS}, got {self.kind!r}"
+                )
+            if self.engine not in KIND_ENGINES[self.kind]:
+                raise ValueError(
+                    f"kind {self.kind!r} runs on engines "
+                    f"{KIND_ENGINES[self.kind]}, not {self.engine!r}"
+                )
+            if self.devices > 1:
+                raise ValueError(
+                    f"kind {self.kind!r} is single-chip in this release "
+                    "(the workload adapters ride the single-chip wide "
+                    "substrate; the mesh generalization follows the "
+                    "partitioned tiles)"
+                )
+            if self.kind in ("p2p", "sssp") and self.pull_gate:
+                raise ValueError(
+                    f"kind {self.kind!r} does not compose with pull_gate "
+                    "(p2p steps the resumable core level by level under "
+                    "its own lane pairing; sssp runs min-plus tiles with "
+                    "no settled-mask machinery)"
+                )
 
 
 class EngineRegistry:
@@ -347,6 +382,17 @@ class EngineRegistry:
             _faults.ACTIVE.hit("engine_build", lanes=spec.lanes)
         g = self.graph(spec.graph_key)
         t0 = time.perf_counter()
+        if spec.kind == "sssp":
+            # SSSP builds its own weighted substrate (no base BFS engine
+            # to wrap): the delta-stepping tiles + weight planes.
+            from tpu_bfs.workloads import build_workload_engine
+
+            eng = build_workload_engine("sssp", None, g, spec)
+            self.builds += 1
+            self._log(
+                f"engine built {spec} in {time.perf_counter() - t0:.1f}s"
+            )
+            return eng
         if spec.engine == "dist2d":
             from tpu_bfs.parallel.dist_bfs2d import (
                 Dist2DServeEngine,
@@ -402,6 +448,13 @@ class EngineRegistry:
                 g, lanes=spec.lanes, num_planes=spec.planes,
                 pull_gate=spec.pull_gate,
             )
+        if spec.kind != "bfs":
+            # Workload adapter over the base engine (ISSUE 14): khop/cc/
+            # p2p reuse the packed substrate's compiled programs behind
+            # their kind's dispatch/fetch semantics.
+            from tpu_bfs.workloads import build_workload_engine
+
+            eng = build_workload_engine(spec.kind, eng, g, spec)
         self.builds += 1
         self._log(f"engine built {spec} in {time.perf_counter() - t0:.1f}s")
         return eng

@@ -51,9 +51,14 @@ class QueryResult:
     id: object
     source: int
     status: str
+    kind: str = "bfs"  # query kind (ISSUE 14: bfs|sssp|cc|khop|p2p)
     distances: np.ndarray | None = None  # [V] int32, INF_DIST unreached
     levels: int | None = None  # this source's eccentricity (max finite dist)
     reached: int | None = None
+    # Kind-specific response fields (ISSUE 14): e.g. p2p's target/
+    # distance/path, cc's component/size/count, khop's k. Merged into
+    # the JSONL response verbatim.
+    extras: dict | None = None
     latency_ms: float | None = None  # submit -> resolve (extraction included)
     batch_lanes: int | None = None  # real queries in the serving batch
     dispatched_lanes: int | None = None  # width the batch was routed to
@@ -101,14 +106,23 @@ class PendingQuery:
     and a query shed at the budget carries its attempt history in the
     error so the failure names every width that was tried."""
 
-    __slots__ = ("id", "source", "deadline", "t_submit", "want_distances",
+    __slots__ = ("id", "source", "kind", "k", "target", "deadline",
+                 "t_submit", "want_distances",
                  "requeues", "attempt_widths", "obs_batch",
                  "_event", "_lock", "_result", "_callbacks")
 
     def __init__(self, source: int, *, id=None, deadline: float | None = None,
-                 now: float | None = None, want_distances: bool = True):
+                 now: float | None = None, want_distances: bool = True,
+                 kind: str = "bfs", k: int | None = None,
+                 target: int | None = None):
         self.id = next(_QUERY_SEQ) if id is None else id
         self.source = int(source)
+        # Query kind (ISSUE 14) + its per-kind parameters: khop's hop
+        # bound k, p2p's target endpoint. Immutable after admission —
+        # the batch key below coalesces only compatible queries.
+        self.kind = kind
+        self.k = k if k is None else int(k)
+        self.target = target if target is None else int(target)
         self.deadline = deadline  # absolute time.monotonic() value, or None
         self.t_submit = time.monotonic() if now is None else now
         self.want_distances = bool(want_distances)
@@ -127,8 +141,18 @@ class PendingQuery:
             # it (tpu_bfs/obs).
             rec.begin("query", f"q{self.id}",  # span-outlives: resolve() closes it with the terminal status
                       cat="serve.query",
-                      query=self.id, source=self.source,
+                      query=self.id, source=self.source, kind=self.kind,
                       want_distances=self.want_distances)
+
+    @property
+    def batch_key(self):
+        """Coalescing compatibility class (ISSUE 14): only queries whose
+        one device dispatch can answer them together share a batch —
+        same kind, and for khop the same hop bound (one ``max_levels``
+        per dispatch)."""
+        if self.kind == "khop":
+            return ("khop", self.k)
+        return (self.kind,)
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now > self.deadline
@@ -156,6 +180,7 @@ class PendingQuery:
     def resolve_status(self, status: str, *, error: str | None = None) -> bool:
         return self.resolve(QueryResult(
             id=self.id, source=self.source, status=status, error=error,
+            kind=self.kind,
             latency_ms=(time.monotonic() - self.t_submit) * 1e3,
         ))
 
@@ -193,8 +218,20 @@ class AdmissionQueue:
             raise ValueError(f"queue cap must be >= 1, got {cap}")
         self.cap = cap
         self._items: deque = deque()  # guarded-by: _cond
+        # Per-batch-key pending counts, maintained incrementally so the
+        # kind-aware linger condition stays O(1) per wake (ISSUE 14) —
+        # and so pure single-kind traffic (the common case) keeps the
+        # original popleft fast path with no deque rebuild.
+        self._key_counts: dict = {}  # guarded-by: _cond
         self._cond = threading.Condition()
         self._stopped = False  # guarded-by: _cond
+
+    def _bump(self, key, d: int) -> None:  # requires-lock: _cond
+        c = self._key_counts.get(key, 0) + d
+        if c:
+            self._key_counts[key] = c
+        else:
+            self._key_counts.pop(key, None)
 
     def offer(self, q: PendingQuery) -> bool:
         """Admit, or False when the queue is full/stopped (caller sheds)."""
@@ -202,6 +239,7 @@ class AdmissionQueue:
             if self._stopped or len(self._items) >= self.cap:
                 return False
             self._items.append(q)
+            self._bump(self._key_of(q), 1)
             depth = len(self._items)
             self._cond.notify()
         rec = _obs.ACTIVE
@@ -218,6 +256,7 @@ class AdmissionQueue:
         with self._cond:
             for q in reversed(queries):
                 self._items.appendleft(q)
+                self._bump(self._key_of(q), 1)
             self._cond.notify()
         rec = _obs.ACTIVE
         if rec is not None:
@@ -233,26 +272,57 @@ class AdmissionQueue:
         with self._cond:  # one mutex hop; callers poll at batch cadence
             return self._stopped
 
-    def next_batch(self, max_n: int, linger_s: float) -> list:
-        """Block until work exists, then drain up to ``max_n`` queries.
+    @staticmethod
+    def _key_of(q) -> tuple:
+        return getattr(q, "batch_key", ("bfs",))
 
-        When fewer than ``max_n`` are pending, lingers up to ``linger_s``
-        from the moment the batch starts forming, returning early the
-        instant it fills. After ``stop()`` the remaining queries drain
-        immediately (no linger) so shutdown is prompt; returns [] only
-        when stopped AND empty."""
+    def next_batch(self, max_n: int, linger_s: float) -> list:
+        """Block until work exists, then drain up to ``max_n`` queries
+        COMPATIBLE with the head query's batch key (ISSUE 14: only
+        same-kind — and same-k for khop — queries can share a device
+        dispatch; other kinds keep their queue order for later batches).
+
+        When fewer than ``max_n`` compatible queries are pending, lingers
+        up to ``linger_s`` from the moment the batch starts forming,
+        returning early the instant it fills. After ``stop()`` the
+        remaining queries drain immediately (no linger, no kind filter —
+        the caller only resolves them as SHUTDOWN); returns [] only when
+        stopped AND empty."""
         with self._cond:
             while not self._items and not self._stopped:
                 self._cond.wait()
-            if not self._stopped and linger_s > 0 and len(self._items) < max_n:
+            if self._stopped:
+                taken = []
+                while self._items and len(taken) < max_n:
+                    q = self._items.popleft()
+                    self._bump(self._key_of(q), -1)
+                    taken.append(q)
+                return taken
+            key = self._key_of(self._items[0])
+            if linger_s > 0:
                 deadline = time.monotonic() + linger_s
-                while len(self._items) < max_n and not self._stopped:
+                while (self._key_counts.get(key, 0) < max_n
+                       and not self._stopped):
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         break
                     self._cond.wait(remaining)
-            n = min(max_n, len(self._items))
-            return [self._items.popleft() for _ in range(n)]
+            if len(self._key_counts) == 1:
+                # Single-kind traffic: the original O(batch) popleft path.
+                n = min(max_n, len(self._items))
+                taken = [self._items.popleft() for _ in range(n)]
+                self._bump(key, -n)
+                return taken
+            taken = []
+            rest: deque = deque()
+            for q in self._items:
+                if len(taken) < max_n and self._key_of(q) == key:
+                    taken.append(q)
+                else:
+                    rest.append(q)
+            self._items = rest
+            self._bump(key, -len(taken))
+            return taken
 
     def stop(self) -> None:
         with self._cond:

@@ -156,6 +156,13 @@ def program_key(spec) -> dict:
         key["predict"] = True
     if spec.get("mesh_shape"):
         key["mesh_shape"] = [int(x) for x in spec["mesh_shape"]]
+    if spec.get("kind", "bfs") != "bfs":
+        # The workload-kind axis (ISSUE 14): per-kind engines compile
+        # different programs (SSSP's min-plus tiles, khop's clamped
+        # loop shares the base core but its residency must not alias a
+        # bfs rung's artifacts). Non-default only, so every existing
+        # single-chip store stays adoptable byte-for-byte.
+        key["kind"] = str(spec["kind"])
     return key
 
 
@@ -452,6 +459,12 @@ def export_engine_programs(engine, spec, store: ArtifactStore, *,
     from jax import export as jexp
 
     log = log or (lambda msg: None)
+    if not hasattr(engine, "export_programs"):
+        # Workload adapters (ISSUE 14) carry no AOT inventory (their
+        # base substrate's programs export under the kind="bfs" key;
+        # the adapters' own state — weighted tiles, cached CC index —
+        # is data, not programs): nothing to export, JIT serves.
+        return []
     key = program_key(spec)
     done = []
     for name, _attr, fn, args in engine.export_programs():
@@ -486,6 +499,8 @@ def adopt_engine_programs(engine, spec, store: ArtifactStore, *,
     from jax import export as jexp
 
     log = log or (lambda msg: None)
+    if not hasattr(engine, "export_programs"):
+        return []  # workload adapter: no inventory, JIT serves (above)
     key = program_key(spec)
     programs = {}
     for name, _attr, fn, _args in engine.export_programs():

@@ -1,0 +1,169 @@
+"""tpu_bfs/workloads — the query-kind subsystem over the MS-BFS substrate
+(ISSUE 14).
+
+The packed lane machinery (dispatch/fetch protocol, on-device lane
+summaries, width ladder, fuzz oracle) is a general multi-source traversal
+substrate; this package widens what it answers from one query type to
+five, each served through the same coalescing/ladder/OOM/breaker path
+behind a ``"kind"`` axis:
+
+========  ==========================================================
+kind      semantics (and substrate)
+========  ==========================================================
+bfs       single-source BFS distances — the original query (the base
+          engines themselves; no adapter).
+sssp      single-source shortest paths over the WEIGHTED graph:
+          bucketed delta-stepping on int32 tentative distances over
+          the same ELL tiles (workloads/sssp.py), light edges relaxed
+          to a fixed point per bucket, heavy edges once at bucket
+          close — Buluç & Madduri's framing of SSSP as the same
+          frontier-expansion kernel as BFS (arXiv:1104.4518).
+cc        connected components: repeated MS-BFS sweeps with lane
+          recycling (finished lanes re-seeded from the unvisited
+          set), per-row labels folded ON DEVICE via a min-lane
+          reduction (workloads/cc.py); queries answer component
+          label/size/count from the cached index.
+khop      k-hop neighborhood count: the MS-BFS core capped at k
+          levels, the count read straight from the on-device lane
+          summaries — the ``want_distances=False`` fast path
+          generalized; ZERO distance words move (workloads/khop.py).
+p2p       point-to-point shortest path with bidirectional early
+          exit: source and target ride two lanes of one batch, the
+          level loop stops the moment the two visited sets meet
+          (~half the levels of a full BFS), and the path is
+          reconstructed via algorithms/parent_scan (workloads/p2p.py).
+========  ==========================================================
+
+The serve tier keys on the axis end to end: ``EngineSpec.kind``
+(registry residency + AOT artifact keys), kind-aware batch coalescing
+(only same-kind queries share a dispatch), per-kind breaker keys, and
+the JSONL protocol's ``"kind"`` field (README "Serving mode").
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+#: Every servable query kind. "bfs" is the default and the only kind the
+#: pre-ISSUE-14 protocol knew; requests without a "kind" field mean it.
+KINDS = ("bfs", "sssp", "cc", "khop", "p2p")
+
+#: Engines each non-bfs kind can ride. The wide engine is the common
+#: substrate (full-coverage ELL: the CC label fold and the p2p path
+#: reconstruction read its row space directly; the SSSP tiles reuse its
+#: bucket layout); khop is pure dispatch/fetch protocol and also runs on
+#: the hybrid/packed engines. All non-bfs kinds are single-chip in this
+#: PR (devices == 1) — the mesh generalization rides ROADMAP item 1's
+#: partitioned substrate.
+KIND_ENGINES = {
+    "bfs": ("wide", "hybrid", "packed", "dist2d"),
+    "sssp": ("wide",),
+    "cc": ("wide",),
+    "khop": ("wide", "hybrid", "packed"),
+    "p2p": ("wide",),
+}
+
+#: Kinds whose responses never carry (or even compute) a distance table:
+#: they answer from on-device summaries / the cached index alone, so the
+#: service forces ``want_distances=False`` on them.
+METADATA_ONLY_KINDS = ("cc", "khop", "p2p")
+
+
+def supported_kinds(engine: str, devices: int, graph) -> tuple:
+    """The kinds a service with this engine/mesh/graph can serve: every
+    kind whose engine family matches, minus sssp when the graph has no
+    weights plane."""
+    out = []
+    for kind in KINDS:
+        if engine not in KIND_ENGINES[kind]:
+            continue
+        if kind != "bfs" and devices > 1:
+            continue
+        if kind == "sssp" and getattr(graph, "weights", None) is None:
+            continue
+        if kind == "p2p" and not getattr(graph, "undirected", True):
+            # The bidirectional meet is exact on undirected graphs only
+            # (the target-side flood must equal the reverse search);
+            # P2pServeEngine enforces the same at construction.
+            continue
+        out.append(kind)
+    return tuple(out)
+
+
+def batch_params(queries) -> dict:
+    """The batch-uniform dispatch kwargs of one coalesced same-kind batch
+    (the scheduler only coalesces queries sharing a ``batch_key``, so the
+    first query speaks for all): ``{"k": K}`` for khop, the padded
+    ``targets`` array for p2p, ``{}`` otherwise."""
+    kind = getattr(queries[0], "kind", "bfs")
+    if kind == "khop":
+        return {"k": int(queries[0].k)}
+    if kind == "p2p":
+        return {"targets": np.asarray([int(q.target) for q in queries],
+                                      dtype=np.int64)}
+    return {}
+
+
+class ExtrasResult:
+    """A batch result wrapper adding per-query ``extras(i)`` response
+    fields over an inner result's protocol (reached/ecc/distances) —
+    how the khop adapter rides the base engine's own result object."""
+
+    def __init__(self, inner, extras_list):
+        self._inner = inner
+        self._extras = extras_list
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def extras(self, i: int) -> dict | None:
+        return self._extras[i] if i < len(self._extras) else None
+
+
+class WorkloadResult:
+    """A self-contained batch result for adapters that do not delegate to
+    a base engine result (cc, p2p): the executor's extraction protocol —
+    per-lane ``reached``, on-device-summary ``ecc`` (the ``levels``
+    source), optional ``edges_traversed``, per-query ``extras`` — with
+    no distance table at all (METADATA_ONLY_KINDS)."""
+
+    def __init__(self, *, reached, ecc, extras_list=None,
+                 edges_traversed=None):
+        self.reached = np.asarray(reached)
+        self.ecc = np.asarray(ecc, dtype=np.int32)
+        self.edges_traversed = edges_traversed
+        self._extras = extras_list
+
+    def extras(self, i: int) -> dict | None:
+        if self._extras is None:
+            return None
+        return self._extras[i] if i < len(self._extras) else None
+
+    def distances_int32(self, i: int):
+        raise ValueError(
+            "this workload kind answers from on-device summaries only "
+            "(no distance table exists to pull)"
+        )
+
+
+def build_workload_engine(kind: str, base, graph, spec):
+    """The serve adapter for ``kind`` over an already-built base engine
+    (``base`` is None for sssp, which builds its own weighted substrate).
+    Called by the registry's ``_build_inner`` after spec validation."""
+    if kind == "sssp":
+        from tpu_bfs.workloads.sssp import SsspEngine
+
+        return SsspEngine(graph, lanes=spec.lanes)
+    if kind == "khop":
+        from tpu_bfs.workloads.khop import KhopServeEngine
+
+        return KhopServeEngine(base)
+    if kind == "cc":
+        from tpu_bfs.workloads.cc import CcServeEngine
+
+        return CcServeEngine(base)
+    if kind == "p2p":
+        from tpu_bfs.workloads.p2p import P2pServeEngine
+
+        return P2pServeEngine(base)
+    raise ValueError(f"unknown workload kind {kind!r} (one of {KINDS})")

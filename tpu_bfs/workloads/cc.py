@@ -1,0 +1,170 @@
+"""Connected components via repeated MS-BFS sweeps with lane recycling
+(ISSUE 14).
+
+One MS-BFS sweep floods up to ``lanes`` components at once; lanes whose
+seeds share a component flood the same vertex set. The driver recycles
+finished lanes by re-seeding each next sweep from the still-unvisited
+set (ascending vertex id, so a component's label is the smallest seed
+that ever flooded it) until every vertex is labeled. Per sweep the
+per-row label fold runs ON DEVICE: a min-lane reduction over the visited
+bit table ([rows, w] uint32 -> [rows] int32 smallest visiting lane),
+one [act] transfer per sweep instead of decoding lane bits host-side.
+
+Undirected graphs only define the classic notion; on the repo's directed
+inputs the sweep computes reachability-closure classes of the seed order
+(documented, matching what repeated BFS gives — the fuzz oracle compares
+against ``scipy.sparse.csgraph.connected_components`` on undirected
+graphs).
+
+The serve adapter caches the index per engine residency: the first
+dispatch (or the registry's warm-up) pays the sweeps; every query after
+answers component label / size / total count from host arrays.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_bfs.workloads import WorkloadResult
+
+_NO_LANE = np.int32(1 << 30)
+
+
+def _make_min_lane(rows: int, act: int, w: int):
+    """[rows, w] visited table -> [act] smallest visiting lane (word-major
+    lane map, the wide engine's), _NO_LANE where no lane visited."""
+
+    @jax.jit
+    def min_lane(vis):
+        if act == 0:
+            return jnp.zeros((0,), jnp.int32)
+        shifts = jnp.arange(32, dtype=jnp.uint32)
+
+        def wbody(wi, acc):
+            col = jax.lax.dynamic_slice(vis, (0, wi), (rows, 1))[:act]
+            bits = ((col >> shifts) & 1) != 0  # [act, 32]
+            lid = wi * 32 + jnp.arange(32, dtype=jnp.int32)
+            cand = jnp.min(
+                jnp.where(bits, lid[None, :], _NO_LANE), axis=1
+            )
+            return jnp.minimum(acc, cand)
+
+        return jax.lax.fori_loop(
+            0, w, wbody, jnp.full((act,), _NO_LANE, jnp.int32)
+        )
+
+    return min_lane
+
+
+def connected_components(engine, *, max_sweeps: int | None = None):
+    """Full component labeling over ``engine``'s graph (a wide packed MS
+    engine). Returns ``(labels [V] int64, num_components, sweeps)`` —
+    ``labels[v]`` is the smallest vertex id that seeded v's component's
+    flood (a canonical representative under the ascending re-seed
+    order)."""
+    V = engine.num_vertices
+    act = engine._act
+    min_lane = _make_min_lane(act + 1, act, engine.w)
+    id_of_row = np.asarray(engine.ell.old_of_new[:act], dtype=np.int64)
+    labels = np.full(V, -1, np.int64)
+    unseen = np.ones(V, dtype=bool)
+    sweeps = 0
+    cap = max_sweeps if max_sweeps is not None else V + 1
+    while sweeps < cap:
+        pending = np.flatnonzero(unseen)
+        if not len(pending):
+            break
+        seeds = pending[: engine.lanes]
+        res = engine.run(seeds, time_it=False)
+        ml = np.asarray(min_lane(res._vis))
+        hit = ml < _NO_LANE
+        vids = id_of_row[hit]
+        labels[vids] = seeds[ml[hit]]
+        unseen[vids] = False
+        # Lane recycling: isolated seeds (no table row — their component
+        # is themselves) and any seed the fold missed label themselves;
+        # every seed lane is finished and free for the next sweep.
+        self_label = labels[seeds] < 0
+        labels[seeds[self_label]] = seeds[self_label]
+        unseen[seeds] = False
+        sweeps += 1
+    if unseen.any():
+        raise RuntimeError(
+            f"cc sweeps did not converge in {sweeps} sweeps "
+            f"({int(unseen.sum())} vertices unlabeled)"
+        )
+    num_components = len(np.unique(labels))
+    return labels, num_components, sweeps
+
+
+class CcIndex:
+    """The cached component index one labeling produces."""
+
+    def __init__(self, labels: np.ndarray, num_components: int, sweeps: int):
+        self.labels = labels
+        self.num_components = num_components
+        self.sweeps = sweeps
+        uniq, inv, counts = np.unique(
+            labels, return_inverse=True, return_counts=True
+        )
+        self.size_of = counts[inv]  # [V] component size per vertex
+
+
+class CcServeEngine:
+    """Serve adapter: kind="cc" queries answer component label / size /
+    total count from the cached index (built on first use — the
+    registry's warm-up run, so serving queries never pay the sweeps)."""
+
+    kind = "cc"
+
+    def __init__(self, base):
+        self.base = base
+        self.lanes = base.lanes
+        self.num_vertices = base.num_vertices
+        self._lock = threading.Lock()
+        self._index: CcIndex | None = None  # guarded-by: _lock
+
+    def _ensure_index(self) -> CcIndex:
+        with self._lock:
+            if self._index is None:
+                labels, n, sweeps = connected_components(self.base)
+                self._index = CcIndex(labels, n, sweeps)
+            return self._index
+
+    def dispatch(self, sources, **_ignored) -> np.ndarray:
+        return np.asarray(sources, dtype=np.int64)
+
+    def fetch(self, sources: np.ndarray, **_ignored) -> WorkloadResult:
+        idx = self._ensure_index()
+        labels = idx.labels[sources]
+        sizes = idx.size_of[sources]
+        extras = [
+            {
+                "component": int(lbl),
+                "component_size": int(sz),
+                "components": idx.num_components,
+            }
+            for lbl, sz in zip(labels, sizes)
+        ]
+        return WorkloadResult(
+            reached=sizes.astype(np.int64),
+            ecc=np.zeros(len(sources), np.int32),
+            extras_list=extras,
+        )
+
+    def run(self, sources, *, time_it: bool = False, **_ignored):
+        return self.fetch(self.dispatch(sources))
+
+    def analysis_programs(self):
+        """Static-analyzer hook: the on-device label fold (min-lane
+        reduction) over an example visited table."""
+        import numpy as np
+
+        base = self.base
+        ml = _make_min_lane(base._act + 1, base._act, base.w)
+        vis0 = base._seed_dev(np.asarray([0]))
+        return [("cc_min_lane", ml, (vis0,))]

@@ -1,0 +1,70 @@
+"""k-hop neighborhood counts straight off the on-device lane summaries
+(ISSUE 14).
+
+A k-hop query is the MS-BFS core capped at ``max_levels=k``: after k
+level bodies the visited table holds exactly the vertices within k hops,
+and the engines' on-device ``lane_stats`` reduction already counts them
+per lane — so the answer is the existing ``reached`` summary with ZERO
+distance words pulled (the ``want_distances=False`` fast path, now the
+whole query). The adapter is pure dispatch/fetch protocol, so it rides
+any packed MS engine (wide / hybrid / packed).
+
+Batches only coalesce same-k queries (the scheduler's batch key carries
+k), so one dispatch's level bound answers every lane.
+"""
+
+from __future__ import annotations
+
+from tpu_bfs.workloads import ExtrasResult
+
+
+class KhopServeEngine:
+    """Serve adapter: kind="khop" over a base packed MS engine."""
+
+    kind = "khop"
+
+    def __init__(self, base):
+        self.base = base
+        self.lanes = base.lanes
+        self.num_vertices = base.num_vertices
+
+    def dispatch(self, sources, *, k: int = 1, **_ignored):
+        k = int(k)
+        if k < 0:
+            raise ValueError(f"k must be >= 0, got {k}")
+        # A k at/above the plane cap clamps to it; fetch's cap check
+        # (below) then raises if the traversal was actually cut off, so
+        # the clamp can never silently undercount.
+        kk = min(k, self.base.max_levels_cap)
+        return self.base.dispatch(sources, max_levels=kk), k
+
+    def fetch(self, handle, **_ignored) -> ExtrasResult:
+        pend, k = handle
+        # check_cap=True is exactly the right guard here: for k below
+        # the plane cap it is a no-op (stopping AT the bound is this
+        # query's point — the base only flags truncation when the bound
+        # IS the cap), while a clamped k >= cap on a graph deeper than
+        # the cap raises instead of reporting the cap-radius ball as
+        # the k-hop count.
+        res = self.base.fetch(pend, check_cap=True)
+        n = len(res.sources)
+        extras = [{"k": k} for _ in range(n)]
+        return ExtrasResult(res, extras)
+
+    def run(self, sources, *, k: int = 1, time_it: bool = False,
+            **_ignored) -> ExtrasResult:
+        return self.fetch(self.dispatch(sources, k=k))
+
+    def analysis_programs(self):
+        """Static-analyzer hook (tpu_bfs/analysis): the base core under
+        a finite hop bound — the exact program a khop dispatch runs
+        (``max_levels`` is a traced scalar, so this IS the bfs core; the
+        sweep proves the kind adds no new compiled surface)."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        base = self.base
+        if getattr(base, "pull_gate", False):
+            return []
+        fw0 = base._seed_dev(np.asarray([0]))
+        return [("khop_core", base._core, (base.arrs, fw0, jnp.int32(2)))]

@@ -1,0 +1,307 @@
+"""Point-to-point shortest path with bidirectional early exit (ISSUE 14).
+
+A p2p query (s, t) rides TWO adjacent lanes of one packed batch: lane 2i
+floods from s, lane 2i+1 from t (undirected graphs — the repo's
+double-insert representation — make the reverse search the same
+expansion). The level loop advances ONE level per step through the base
+engine's resumable core (``_core_from``, the checkpoint entry — carries
+stay on device between steps) and stops the moment every pair's two
+visited sets intersect: if D = d(s, t), the meet happens after
+ceil(D / 2) levels, and the answer is EXACT at that point — every meet
+vertex v satisfies d_s(v) + d_t(v) >= D, while some vertex on a shortest
+path lands in the intersection with equality the moment it is nonempty
+(both searches ran L levels, so intersection nonempty implies D <= 2L,
+which puts a path midpoint inside both balls). So the loop expands
+~half the frontier levels a full single-source BFS would (strictly
+fewer whenever D >= 2 — the fuzz bar), and the serve response's
+``levels`` field reports the levels actually expanded.
+
+The meet check per level is one tiny on-device kernel over the visited
+words (no distance decode); the final per-pair distance/meet-vertex
+reduction decodes the bit-sliced planes once, on device. The path is
+reconstructed from the two lanes' deterministic min-parent trees
+(algorithms/parent_scan via PackedBatchResult.parents_int32) and
+validated edge-by-edge by the fuzz oracle.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from tpu_bfs.algorithms._packed_common import _assemble_packed_result
+
+#: "No meet" distance sentinel: far above any labelable distance (the
+#: plane cap is 254) and safe to double without overflow.
+_BIG = np.int32(1 << 20)
+
+
+def _make_pair_kernels(rows: int, act: int, w: int, num_planes: int):
+    """(pair_met, pair_dist) over the wide engine's word-major tables.
+
+    ``pair_met(vis) -> [w*16] bool``: pair p (lanes 2p, 2p+1) has a row
+    both lanes visited. ``pair_dist(planes, vis, src_bits) ->
+    (dist [w*16] i32, row [w*16] i32)``: min over rows of
+    d_s(row) + d_t(row) (both-visited rows only; _BIG-based when unmet)
+    and the argmin row — the meet vertex."""
+    npairs = w * 16
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+
+    @jax.jit
+    def pair_met(vis):
+        if act == 0:
+            return jnp.zeros((npairs,), bool)
+
+        def wbody(wi, acc):
+            col = jax.lax.dynamic_slice(vis, (0, wi), (rows, 1))[:act]
+            bits = ((col >> shifts) & 1) != 0  # [act, 32]
+            both = jnp.any(bits[:, 0::2] & bits[:, 1::2], axis=0)  # [16]
+            return jax.lax.dynamic_update_slice(acc, both, (wi * 16,))
+
+        return jax.lax.fori_loop(
+            0, w, wbody, jnp.zeros((npairs,), bool)
+        )
+
+    @jax.jit
+    def pair_dist(planes, vis, src_bits):
+        if act == 0:
+            return (
+                jnp.full((npairs,), 2 * _BIG, jnp.int32),
+                jnp.zeros((npairs,), jnp.int32),
+            )
+
+        def wbody(wi, acc):
+            dmin, rmin = acc
+            cnt = jnp.zeros((act, 32), jnp.int32)
+            for i, p in enumerate(planes):
+                col = jax.lax.dynamic_slice(p, (0, wi), (rows, 1))[:act]
+                cnt = cnt + (((col >> shifts) & 1) << i).astype(jnp.int32)
+            visw = (
+                (jax.lax.dynamic_slice(vis, (0, wi), (rows, 1))[:act]
+                 >> shifts) & 1
+            ) != 0
+            srcw = (
+                (jax.lax.dynamic_slice(src_bits, (0, wi), (rows, 1))[:act]
+                 >> shifts) & 1
+            ) != 0
+            d = jnp.where(srcw, 0, jnp.where(visw, cnt + 1, _BIG))
+            s = d[:, 0::2] + d[:, 1::2]  # [act, 16]
+            smin = jnp.min(s, axis=0)
+            srow = jnp.argmin(s, axis=0).astype(jnp.int32)
+            return (
+                jax.lax.dynamic_update_slice(dmin, smin, (wi * 16,)),
+                jax.lax.dynamic_update_slice(rmin, srow, (wi * 16,)),
+            )
+
+        return jax.lax.fori_loop(
+            0, w, wbody,
+            (jnp.full((npairs,), 2 * _BIG, jnp.int32),
+             jnp.zeros((npairs,), jnp.int32)),
+        )
+
+    return pair_met, pair_dist
+
+
+class P2pPending:
+    """A dispatched (seeded, not yet stepped) bidirectional batch."""
+
+    __slots__ = ("sources", "targets", "inter", "fw0", "n")
+
+    def __init__(self, sources, targets, inter, fw0):
+        self.sources = sources
+        self.targets = targets
+        self.inter = inter
+        self.fw0 = fw0
+        self.n = len(sources)
+
+
+class P2pResult:
+    """Per-pair outcomes with path reconstruction baked in.
+
+    ``ecc`` carries the LEVELS EXPANDED (same for every pair of the
+    batch) — the serve response's ``levels`` field, the number a full
+    single-source BFS strictly exceeds whenever d(s, t) >= 2."""
+
+    def __init__(self, *, reached, levels_expanded, extras_list):
+        n = len(extras_list)
+        self.reached = np.asarray(reached, dtype=np.int64)
+        self.ecc = np.full(n, int(levels_expanded), np.int32)
+        self.edges_traversed = None
+        self._extras = extras_list
+
+    def extras(self, i: int) -> dict | None:
+        return self._extras[i] if i < len(self._extras) else None
+
+    def distances_int32(self, i: int):
+        raise ValueError("p2p answers carry the path, not a distance table")
+
+
+class P2pServeEngine:
+    """Serve adapter: kind="p2p" over a base WIDE packed MS engine.
+
+    ``lanes`` here counts PAIRS — half the base engine's lane budget —
+    so the executor's padding and the service's routing stay in query
+    units."""
+
+    kind = "p2p"
+
+    def __init__(self, base):
+        if getattr(base, "pull_gate", False):
+            raise ValueError(
+                "p2p drives the resumable core level by level; the pull "
+                "gate's batch-scoped lane mask does not compose with "
+                "that (build the base engine ungated)"
+            )
+        if not base.undirected:
+            raise ValueError(
+                "p2p's bidirectional meet is exact on undirected graphs "
+                "only (the target-side flood must equal the reverse "
+                "search); serve directed graphs without the p2p kind"
+            )
+        self.base = base
+        self.pairs = base.lanes // 2
+        if self.pairs < 1:
+            raise ValueError(
+                "p2p needs a base engine of >= 2 lanes (one pair)"
+            )
+        self.lanes = self.pairs
+        # Bookkeeping width: the ladder/breaker/OOM-degrade machinery
+        # operates in BASE lane units (the registry spec's width); this
+        # adapter's ``lanes`` counts PAIRS (batch capacity), so it
+        # publishes the base width separately or a p2p failure would
+        # feed the wrong rung's breaker and over-degrade the service.
+        self.ladder_lanes = base.lanes
+        self.num_vertices = base.num_vertices
+        self._id_of_row = np.asarray(
+            base.ell.old_of_new[: base._act], dtype=np.int64
+        )
+        self._pair_met, self._pair_dist = _make_pair_kernels(
+            base._act + 1, base._act, base.w, base.num_planes
+        )
+
+    def dispatch(self, sources, *, targets=None, **_ignored) -> P2pPending:
+        sources = np.asarray(sources, dtype=np.int64)
+        if targets is None:
+            # Warm-up / convenience default: a fixed non-trivial target
+            # per lane so the level loop actually compiles and steps.
+            targets = (sources + 1) % self.num_vertices
+        targets = np.asarray(targets, dtype=np.int64)
+        if sources.shape != targets.shape or sources.ndim != 1:
+            raise ValueError("sources/targets must be equal-length 1-D")
+        if not (1 <= len(sources) <= self.pairs):
+            raise ValueError(
+                f"need 1..{self.pairs} pairs, got {len(sources)}"
+            )
+        for arr, what in ((sources, "source"), (targets, "target")):
+            if len(arr) and (arr.min() < 0 or arr.max() >= self.num_vertices):
+                raise ValueError(f"{what} out of range")
+        inter = np.empty(2 * len(sources), dtype=np.int64)
+        inter[0::2] = sources
+        inter[1::2] = targets
+        fw0 = self.base._seed_dev(inter)
+        return P2pPending(sources, targets, inter, fw0)
+
+    def fetch(self, pend: P2pPending, **_ignored) -> P2pResult:
+        base = self.base
+        n = pend.n
+        fw = vis = pend.fw0
+        planes = tuple(jnp.zeros_like(pend.fw0)
+                       for _ in range(base.num_planes))
+        level = 0
+        alive = True
+        # Level 0 can already be met (s == t, or s adjacent... no — only
+        # s == t: the visited sets start as the endpoints themselves).
+        met = np.asarray(self._pair_met(vis))[: n]
+        while not met.all() and alive and level < base.max_levels_cap:
+            fw, vis, planes, lv, alv = base._core_from(
+                base.arrs, fw, vis, planes, jnp.int32(level),
+                jnp.int32(level + 1),
+            )
+            level = int(lv)
+            alive = bool(alv)
+            met = np.asarray(self._pair_met(vis))[: n]
+        dist, row = self._pair_dist(planes, vis, pend.fw0)
+        dist = np.asarray(dist)[: n]
+        row = np.asarray(row)[: n]
+        iso = base._iso_of(pend.inter)
+        res = _assemble_packed_result(
+            base, pend.inter, planes, vis, pend.fw0, level, alive, None,
+        )
+        extras = []
+        reached = np.empty(n, np.int64)
+        for i in range(n):
+            s, t = int(pend.sources[i]), int(pend.targets[i])
+            reached[i] = int(res.reached[2 * i]) + int(res.reached[2 * i + 1])
+            if iso is not None and (iso[2 * i] or iso[2 * i + 1]):
+                # An isolated endpoint reaches nothing beyond itself.
+                found = s == t
+                extras.append({
+                    "target": t, "met": found,
+                    "distance": 0 if found else None,
+                    "path": [s] if found else None,
+                })
+                continue
+            if s == t:
+                extras.append({
+                    "target": t, "met": True, "distance": 0, "path": [s],
+                })
+                continue
+            if dist[i] >= _BIG:
+                extras.append({
+                    "target": t, "met": False, "distance": None,
+                    "path": None,
+                })
+                continue
+            vmeet = int(self._id_of_row[row[i]])
+            path = self._reconstruct(res, i, s, t, vmeet)
+            extras.append({
+                "target": t, "met": True, "distance": int(dist[i]),
+                "path": path,
+            })
+        return P2pResult(
+            reached=reached, levels_expanded=level, extras_list=extras,
+        )
+
+    def _reconstruct(self, res, i: int, s: int, t: int, vmeet: int):
+        """s -> meet -> t through the two lanes' deterministic min-parent
+        trees (parent_scan / host scatter-min — both bit-equal)."""
+        par_s = res.parents_int32(2 * i)
+        par_t = res.parents_int32(2 * i + 1)
+        half_s = _walk_to_root(par_s, vmeet, s)
+        half_t = _walk_to_root(par_t, vmeet, t)
+        if half_s is None or half_t is None:
+            return None  # defensive: a met pair always walks clean
+        return list(reversed(half_s)) + half_t[1:]
+
+    def run(self, sources, *, targets=None, time_it: bool = False,
+            **_ignored) -> P2pResult:
+        return self.fetch(self.dispatch(sources, targets=targets))
+
+    def analysis_programs(self):
+        """Static-analyzer hook: the per-level meet check and the final
+        per-pair distance/meet-vertex reduction."""
+        base = self.base
+        fw0 = base._seed_dev(np.asarray([0, 1]))
+        planes0 = tuple(
+            jnp.zeros_like(fw0) for _ in range(base.num_planes)
+        )
+        return [
+            ("p2p_pair_met", self._pair_met, (fw0,)),
+            ("p2p_pair_dist", self._pair_dist, (planes0, fw0, fw0)),
+        ]
+
+
+def _walk_to_root(parent: np.ndarray, frm: int, root: int):
+    """Parent-pointer walk frm -> root; None if the chain breaks."""
+    path = [frm]
+    v = frm
+    for _ in range(len(parent)):
+        if v == root:
+            return path
+        v = int(parent[v])
+        if v < 0:
+            return None
+        path.append(v)
+    return None

@@ -1,0 +1,397 @@
+"""Bucketed delta-stepping SSSP over the ELL tiles (ISSUE 14).
+
+The same degree-sorted bucketed-ELL layout the packed BFS engines expand
+(graph/ell.py) runs MIN-PLUS instead of OR: out[v] = min over in-edges
+(u, v) of dist[u] + w(u, v). Bitwise-OR over packed lane words becomes
+elementwise minimum over an int32 tentative-distance table [rows, L]
+(one column per SSSP lane; L is small — each lane costs 32x a BFS lane's
+bits), and the weights plane (graph/ell.build_ell_weights) rides the
+bucket tables slot-for-slot. The heavy fold pyramid works unchanged —
+min is associative-commutative with identity INF, the two properties the
+pyramid assumes (see make_fori_expand's combine/identity contract).
+
+The level loop is DELTA-STEPPING's light/heavy bucket loop (Meyer &
+Sanders via Buluç & Madduri, arXiv:1104.4518): distances settle in
+buckets of width ``delta`` — within the current bucket, only LIGHT edges
+(weight <= delta) relax, repeated to a fixed point (a light relaxation
+can keep landing inside the bucket); when the bucket stabilizes, one
+relaxation over ALL edges (the heavy close — a heavy edge always lands
+in a later bucket, so once per bucket suffices) and the bucket bound
+advances by delta. Termination: nothing changed AND no finite tentative
+distance sits at or above the bound — at that point every finite row has
+relaxed out through every edge, a fixed point of Bellman-Ford, which is
+exactly the SSSP solution for positive weights.
+
+Serve protocol: ``dispatch``/``fetch`` halves like every packed engine
+(the loop is one fused jitted while; JAX dispatch is async), on-device
+per-lane summaries (reached count + weighted eccentricity — the
+``levels`` a metadata-only query answers with), lazy per-lane distance
+columns. Chaos sites ``sssp_dispatch``/``sssp_fetch`` mirror the packed
+engines' dispatch/fetch sites (tpu_bfs/faults.py).
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_bfs import faults as _faults
+from tpu_bfs.graph.csr import INF_DIST, Graph
+from tpu_bfs.graph.ell import build_ell, build_ell_weights
+
+#: On-device "unreached" tentative distance. 2**29 keeps every sum the
+#: expansion forms (dist + weight, each <= INF_W) under 2**30, far from
+#: int32 overflow, while any true shortest distance (< V * wmax) stays
+#: far below it at every supported scale.
+INF_W = np.int32(1 << 29)
+
+
+def _make_min_plus_expand(spec_like, L: int, wsuf: str):
+    """Min-plus bucketed-ELL expansion over a [rows, L] int32 distance
+    table — make_fori_expand's shape with per-slot weight adds. ``wsuf``
+    picks the weight plane: ``"w"`` (all edges — the heavy close) or
+    ``"wl"`` (light-only: heavy slots hold INF_W, so their candidates
+    are absorbed by the min)."""
+    kcap = spec_like.kcap
+    heavy = spec_like.num_virtual > 0
+    num_virtual = spec_like.num_virtual
+    fold_steps = spec_like.fold_steps
+    light_meta = spec_like.light_meta
+    tail_rows = spec_like.tail_rows
+
+    def _full(shape):
+        return jnp.full(shape, INF_W, jnp.int32)
+
+    def expand(arrs, dist):
+        parts = []
+        if heavy:
+            vr_t = arrs["virtual_t"]  # [kcap, M]
+            vw = arrs["virtual_" + wsuf]  # [kcap, M]
+
+            def vbody(kk, acc):
+                return jnp.minimum(acc, dist[vr_t[kk]] + vw[kk][:, None])
+
+            acc = jax.lax.fori_loop(
+                0, kcap, vbody, _full((num_virtual, L))
+            )
+            vr_ext = jnp.concatenate([acc, _full((1, L))])
+            cur = vr_ext[arrs["fold_pad_map"]]
+            pyramid = [cur]
+            for _ in range(fold_steps):
+                pairs = cur.reshape(-1, 2, L)
+                cur = jnp.minimum(pairs[:, 0], pairs[:, 1])
+                pyramid.append(cur)
+            pyr = jnp.concatenate(pyramid) if len(pyramid) > 1 else pyramid[0]
+            parts.append(pyr[arrs["heavy_pick"]])
+        for i, (k, n) in enumerate(light_meta):
+            bt = arrs[f"light{i}_t"]  # [k, n]
+            bw = arrs[f"light{i}_{wsuf}"]  # [k, n]
+
+            def lbody(kk, acc, bt=bt, bw=bw):
+                return jnp.minimum(acc, dist[bt[kk]] + bw[kk][:, None])
+
+            parts.append(jax.lax.fori_loop(0, k, lbody, _full((n, L))))
+        if tail_rows:
+            parts.append(_full((tail_rows, L)))
+        return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+
+    return expand
+
+
+class _Spec:
+    """Shape metadata of the expansion (ExpandSpec's fields, local so the
+    module stays importable without the packed machinery)."""
+
+    def __init__(self, ell):
+        self.kcap = ell.kcap
+        self.num_virtual = ell.num_virtual
+        self.fold_steps = ell.fold_steps
+        self.light_meta = tuple((b.k, b.n) for b in ell.light)
+        self.tail_rows = ell.num_active - ell.num_nonzero + 1
+
+
+class SsspDispatch:
+    """An in-flight SSSP batch (async device references; fetch blocks)."""
+
+    __slots__ = ("sources", "dist", "rounds", "alive", "t0")
+
+    def __init__(self, sources, dist, rounds, alive, t0):
+        self.sources = sources
+        self.dist = dist
+        self.rounds = rounds
+        self.alive = alive
+        self.t0 = t0
+
+
+class SsspBatchResult:
+    """Batch result with lazy per-lane distance columns.
+
+    ``reached``/``ecc`` reduce on device ([L] each — one small transfer);
+    ``distances_int32(i)`` pulls ONE [rows] column, maps it to real
+    vertex ids, and caches it — the PackedBatchResult discipline, minus
+    the bit slicing (SSSP distances are already int32 words)."""
+
+    def __init__(self, engine, sources, dist, rounds, reached, ecc, iso,
+                 elapsed_s=None):
+        self._engine = engine
+        self.sources = np.asarray(sources, dtype=np.int32)
+        self._dist = dist  # device [rows, L] int32
+        self.rounds = rounds  # delta-stepping bodies run
+        n = len(self.sources)
+        self.reached = np.asarray(reached)[:n].astype(np.int64)
+        self.ecc = np.asarray(ecc)[:n].astype(np.int32)
+        self.edges_traversed = None
+        self.elapsed_s = elapsed_s
+        self._iso = iso
+        if iso is not None and iso.any():
+            self.reached[iso] = 1
+            self.ecc[iso] = 0
+        self._col_cache: dict = {}
+
+    @property
+    def num_levels(self) -> int:
+        """Max weighted distance over the batch (the BFS result's field
+        name, kept so generic consumers read one protocol)."""
+        return int(self.ecc.max()) if len(self.ecc) else 0
+
+    def extras(self, i: int) -> dict:
+        return {"weighted": True, "sssp_rounds": int(self.rounds)}
+
+    def distances_int32(self, i: int) -> np.ndarray:
+        if not (0 <= i < len(self.sources)):
+            raise IndexError(i)
+        eng = self._engine
+        if self._iso is not None and self._iso[i]:
+            d = np.full(eng.num_vertices, INF_DIST, np.int32)
+            d[self.sources[i]] = 0
+            return d
+        if i not in self._col_cache:
+            col = np.asarray(
+                jax.lax.dynamic_slice_in_dim(self._dist, i, 1, axis=1)
+            )[: eng._act, 0]
+            full = np.full(eng.num_vertices, INF_DIST, np.int32)
+            m = eng._rank < eng._act
+            vals = col[eng._rank[m]]
+            full[m] = np.where(vals >= INF_W, INF_DIST, vals)
+            self._col_cache[i] = full
+        return self._col_cache[i]
+
+
+class SsspEngine:
+    """Delta-stepping SSSP over the weighted bucketed ELL.
+
+    ``lanes`` concurrent sources per batch (each an int32 column — keep
+    it far below the BFS engines' bit-packed widths); ``delta`` is the
+    bucket width (0 = auto: the mean edge weight, delta-stepping's usual
+    operating point); ``max_rounds`` bounds the fused loop (a round is
+    one light sweep or one heavy close — generously above any real
+    bucket count; exceeding it raises rather than mislabeling)."""
+
+    kind = "sssp"
+
+    def __init__(self, graph: Graph, *, lanes: int = 32, kcap: int = 64,
+                 delta: int = 0, max_rounds: int = 4096):
+        if graph.weights is None:
+            raise ValueError(
+                "sssp needs a weighted graph (generate with weights=W or "
+                "attach a weights plane)"
+            )
+        if lanes < 1:
+            raise ValueError(f"lanes must be >= 1, got {lanes}")
+        self.host_graph = graph
+        self.ell = build_ell(graph, kcap=kcap)
+        self.lanes = int(lanes)
+        self.num_vertices = graph.num_vertices
+        self.undirected = graph.undirected
+        self.max_rounds = int(max_rounds)
+        self._act = self.ell.num_active
+        self._rank = self.ell.rank
+        self._table_rows = self._act + 1  # + the all-INF sentinel row
+        wmax = int(graph.weights.max()) if len(graph.weights) else 1
+        self.wmax = wmax
+        if delta <= 0:
+            delta = max(1, int(round(float(graph.weights.mean())))) \
+                if len(graph.weights) else 1
+        self.delta = int(delta)
+        # The weighted eccentricity cap: rounds bound the loop, but the
+        # distances themselves are only bounded by the graph.
+        spec = _Spec(self.ell)
+        self.arrs = self._build_arrays()
+        expand_light = _make_min_plus_expand(spec, self.lanes, "wl")
+        expand_full = _make_min_plus_expand(spec, self.lanes, "w")
+        self._core = _make_delta_core(
+            expand_light, expand_full, jnp.int32(self.delta)
+        )
+        self._seed = _make_seed(self._table_rows, self.lanes)
+        self._summaries = _make_summaries(self._act)
+        self._warmed = False
+
+    def _build_arrays(self) -> dict:
+        from tpu_bfs.algorithms._packed_common import expand_arrays
+
+        arrs = expand_arrays(self.ell)
+        vw, lw = build_ell_weights(self.host_graph, self.ell, pad=0)
+        delta = self.delta
+        if vw is not None:
+            vt = np.ascontiguousarray(vw.T).astype(np.int32)
+            arrs["virtual_w"] = jnp.asarray(vt)
+            arrs["virtual_wl"] = jnp.asarray(
+                np.where(vt <= delta, vt, INF_W)
+            )
+        for i, w in enumerate(lw):
+            wt = np.ascontiguousarray(w.T).astype(np.int32)
+            arrs[f"light{i}_w"] = jnp.asarray(wt)
+            # Light plane: heavy-edge slots absorb under min. Pad slots
+            # (weight 0) gather the all-INF sentinel row either way.
+            arrs[f"light{i}_wl"] = jnp.asarray(
+                np.where(wt <= delta, wt, INF_W)
+            )
+        return arrs
+
+    def _iso_of(self, sources: np.ndarray):
+        return self._rank[sources] >= self._act
+
+    def dispatch(self, sources, **_ignored) -> SsspDispatch:
+        if _faults.ACTIVE is not None:
+            # Chaos-harness injection site (tpu_bfs/faults.py): the
+            # workload twin of the packed engines' "dispatch" site.
+            _faults.ACTIVE.hit("sssp_dispatch", lanes=self.lanes)
+        sources = np.asarray(sources, dtype=np.int64)
+        if sources.ndim != 1 or not (1 <= len(sources) <= self.lanes):
+            raise ValueError(
+                f"need 1..{self.lanes} sources, got {sources.shape}"
+            )
+        if sources.min() < 0 or sources.max() >= self.num_vertices:
+            raise ValueError("source out of range")
+        rows = self._rank[sources].astype(np.int64)
+        keep = rows < self._act
+        lanes_idx = np.arange(len(sources), dtype=np.int32)
+        dist0 = self._seed(
+            jnp.asarray(np.where(keep, rows, 0).astype(np.int32)),
+            jnp.asarray(lanes_idx),
+            jnp.asarray(keep),
+        )
+        t0 = time.perf_counter()
+        dist, rounds, alive = self._core(
+            self.arrs, dist0, jnp.int32(self.max_rounds)
+        )
+        return SsspDispatch(sources, dist, rounds, alive, t0)
+
+    def fetch(self, pend: SsspDispatch, *, check_cap: bool = True,
+              time_it: bool = False) -> SsspBatchResult:
+        if _faults.ACTIVE is not None:
+            # Chaos site: the blocking result half (slow/transient/oom
+            # kinds here surface exactly like a real async failure).
+            _faults.ACTIVE.hit("sssp_fetch", lanes=self.lanes)
+        rounds = int(pend.rounds)  # blocks until the loop finishes
+        elapsed = (time.perf_counter() - pend.t0) if time_it else None
+        self._warmed = True
+        if check_cap and bool(pend.alive):
+            raise RuntimeError(
+                f"sssp still relaxing after {rounds} rounds "
+                f"(max_rounds={self.max_rounds}) — raise max_rounds or "
+                f"delta for this graph"
+            )
+        reached, ecc = self._summaries(pend.dist)
+        iso = self._iso_of(pend.sources)
+        return SsspBatchResult(
+            self, pend.sources, pend.dist, rounds, reached, ecc,
+            iso if iso.any() else None, elapsed_s=elapsed,
+        )
+
+    def run(self, sources, *, time_it: bool = False, check_cap: bool = True,
+            **_ignored) -> SsspBatchResult:
+        if time_it and not self._warmed:
+            int(self.dispatch(sources).rounds)
+        return self.fetch(
+            self.dispatch(sources), check_cap=check_cap, time_it=time_it
+        )
+
+    def analysis_programs(self):
+        """Static-analyzer hook (tpu_bfs/analysis): the delta-stepping
+        core over an example seeded table — the dtype walk proves the
+        loop stays 32-bit, the memory pass prices it, and the donation
+        certificate pins the donated carry's HLO alias."""
+        dist0 = self._seed(
+            jnp.zeros((1,), jnp.int32), jnp.zeros((1,), jnp.int32),
+            jnp.ones((1,), bool),
+        )
+        return [
+            ("sssp_core", self._core, (self.arrs, dist0, jnp.int32(64))),
+            ("sssp_summaries", self._summaries, (dist0,)),
+        ]
+
+
+def _make_seed(rows: int, L: int):
+    @jax.jit
+    def seed(rws, cols, keep):
+        # Isolated sources (no table row) scatter INF at row 0 — a no-op
+        # under min; their lanes patch host-side (SsspBatchResult._iso).
+        dist0 = jnp.full((rows, L), INF_W, jnp.int32)
+        vals = jnp.where(keep, jnp.int32(0), INF_W)
+        return dist0.at[rws, cols].min(vals)
+
+    return seed
+
+
+def _make_delta_core(expand_light, expand_full, delta):
+    @partial(jax.jit, donate_argnums=(1,))
+    def core(arrs, dist0, max_rounds):
+        def cond(carry):
+            _, _, alive, rounds = carry
+            return alive & (rounds < max_rounds)
+
+        def body(carry):
+            dist, hi, _, rounds = carry
+            # Current bucket + settled rows relax out; later buckets are
+            # masked to INF (their candidates could only be improved by
+            # the bucket rows anyway — the delta-stepping invariant).
+            masked = jnp.where(dist < hi, dist, INF_W)
+            new = jnp.minimum(dist, expand_light(arrs, masked))
+            changed_l = jnp.any(new < dist)
+
+            def close(d):
+                # Bucket stabilized: one relaxation over ALL edges (the
+                # heavy close) before the bound advances.
+                m = jnp.where(d < hi, d, INF_W)
+                return jnp.minimum(d, expand_full(arrs, m))
+
+            new2 = jax.lax.cond(changed_l, lambda d: d, close, new)
+            changed = changed_l | jnp.any(new2 < new)
+            hi2 = jnp.where(changed_l, hi, hi + delta)
+            # Finite distances at/above the bound still need bucketing;
+            # with none left and nothing changed, every finite row has
+            # relaxed through every edge — the Bellman-Ford fixed point.
+            unsettled = jnp.any((new2 < INF_W) & (new2 >= hi2))
+            return new2, hi2, changed | unsettled, rounds + 1
+
+        dist, _, alive, rounds = jax.lax.while_loop(
+            cond, body, (dist0, delta, jnp.bool_(True), jnp.int32(0))
+        )
+        return dist, rounds, alive
+
+    # The ISSUE 13 donation tag: the seeded table is dead after the call
+    # (every dispatch seeds afresh), so the loop's output aliases its
+    # buffer; the analyzer's HLO certificate pins the alias landed.
+    core._donate_argnums = (1,)
+    return core
+
+
+def _make_summaries(act: int):
+    @jax.jit
+    def summaries(dist):
+        if act == 0:
+            # Edgeless tables: every lane's component is its source.
+            L = dist.shape[1]
+            return jnp.zeros((L,), jnp.int32), jnp.zeros((L,), jnp.int32)
+        d = dist[:act]
+        fin = d < INF_W
+        reached = jnp.sum(fin.astype(jnp.int32), axis=0)
+        ecc = jnp.max(jnp.where(fin, d, 0), axis=0)
+        return reached, ecc
+
+    return summaries

@@ -1,5 +1,5 @@
 # Convenience targets; everything also works without make (README).
-.PHONY: test native bench analyze wirecheck serve-smoke serve-dist-smoke workloads-smoke chaos-smoke mesh-chaos-smoke obs-smoke preheat-smoke wheel clean
+.PHONY: test native bench analyze wirecheck serve-smoke serve-dist-smoke workloads-smoke chaos-smoke mesh-chaos-smoke integrity-smoke obs-smoke preheat-smoke wheel clean
 
 # Full suite on 8 virtual CPU devices (tests/conftest.py forces the
 # platform; the axon TPU plugin is bypassed).
@@ -145,6 +145,18 @@ chaos-smoke: wirecheck
 # (tests/test_mesh_chaos.py, tests/test_warm_handoff.py).
 mesh-chaos-smoke: chaos-smoke
 	env JAX_PLATFORMS=cpu python scripts/mesh_chaos_smoke.py
+
+# The integrity soak (README "Result integrity", ISSUE 15): a fully-
+# audited server (shadow rate 1.0 + structural tree checks + wire
+# checksums) must answer a clean mixed-kind stream with ZERO audit
+# findings; then, with corrupt_result armed, the audit tier must catch
+# the seeded bit-flip, quarantine the serving rung (eviction + forced-
+# open breaker), dump a flight-recorder artifact naming the corrupted
+# query, and serve every later query bit-identical to the oracle. The
+# pytest side runs the same machinery in-process (tests/test_integrity
+# .py + the per-kind corruption fuzz arm in test_fuzz_cross_engine.py).
+integrity-smoke: mesh-chaos-smoke
+	env JAX_PLATFORMS=cpu python scripts/integrity_smoke.py
 
 # The telemetry smoke (README "Observability"): a tracing-armed JSONL
 # server must emit a Perfetto trace holding the FULL span chain of every

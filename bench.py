@@ -33,7 +33,12 @@ TPU_BFS_BENCH_SERVE_PIPELINE (1) / TPU_BFS_BENCH_SERVE_ENGINE
 'all' = every attached device — distributed serving, ISSUE 11) /
 TPU_BFS_BENCH_SERVE_EXCHANGE / TPU_BFS_BENCH_SERVE_PULL_GATE (0) /
 TPU_BFS_BENCH_SERVE_RESUME (0 — dist2d level-checkpoint cadence K,
-ISSUE 12) plus the PR 5/7 wire knobs; mesh runs add serve_gteps_p50 /
+ISSUE 12) / TPU_BFS_BENCH_SERVE_AUDIT_RATE (0 — the online integrity
+tier's shadow-audit sampling fraction, ISSUE 15; > 0 also arms the
+structural tree checks) / TPU_BFS_BENCH_SERVE_AUDIT_CHECKSUM (0 — wire
+checksums on the audited transfers), emitting serve_audits_run /
+serve_audit_failures / serve_audit_p50_lag_ms / serve_quarantines,
+plus the PR 5/7 wire knobs; mesh runs add serve_gteps_p50 /
 serve_gteps_hmean / serve_wire_bytes_per_query plus the mesh-fault
 record serve_mesh_faults/serve_mesh_degrades/serve_query_resumes/
 serve_devices_final to the verdict, and
@@ -1392,11 +1397,24 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
         log("level-checkpointed resume applies to the dist2d serve "
             f"engine only; ignored on engine={engine!r}")
         resume_levels = 0
+    # Online integrity tier (ISSUE 15): AUDIT_RATE samples that fraction
+    # of resolved queries for shadow re-execution on a disjoint rung
+    # (and arms the structural tree checks); AUDIT_CHECKSUM adds the
+    # wire-checksum verification on the audited transfers. The verdict
+    # then carries the audit counters — the <5% p50 bar at rate 0.1 is
+    # the chip-session integrity stage's acceptance line.
+    audit_rate = float(os.environ.get("TPU_BFS_BENCH_SERVE_AUDIT_RATE",
+                                      "0") or 0)
+    audit_checksum = os.environ.get("TPU_BFS_BENCH_SERVE_AUDIT_CHECKSUM",
+                                    "0") == "1"
     svc_kw = dict(
         engine=engine, lanes=lanes, planes=8,
         devices=devices, exchange=serve_exchange, wire_pack=wire_pack,
         delta_bits=delta_bits, sieve=sieve, predict=predict,
         pull_gate=serve_pull_gate, resume_levels=resume_levels,
+        audit_rate=audit_rate,
+        audit_structural=audit_rate > 0 or audit_checksum,
+        audit_checksum=audit_checksum,
         width_ladder=ladder, pipeline=pipeline,
         linger_ms=2.0, queue_cap=max(1024, 2 * clients),
         watchdog_ms=watchdog_ms, log=log,
@@ -1455,6 +1473,11 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
             f"{len(bad)}/{len(flat)} serve queries failed; first: "
             f"{bad[0].status}: {bad[0].error}"
         )
+    if audit_rate > 0 or audit_checksum:
+        # Audit-counter barrier: the background shadow replays must
+        # land before the snapshot or the verdict under-reports them.
+        if not service.flush_audits(300.0):
+            log("WARNING: audit flush timed out; audit keys may be low")
     snap = service.statsz()
     qps = len(flat) / elapsed
     log(f"{len(flat)} queries in {elapsed:.2f}s: qps={qps:.1f} "
@@ -1774,6 +1797,13 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
         "serve_mesh_degrades": snap["mesh_degrades"],
         "serve_query_resumes": snap.get("query_resumes", 0),
         "serve_devices_final": snap.get("devices", devices),
+        # Online integrity tier (ISSUE 15): audits completed, confirmed
+        # corruption findings, audit lag behind resolve, and rung
+        # quarantines (all zero when the tier is disarmed).
+        "serve_audits_run": snap["audits_run"],
+        "serve_audit_failures": snap["audit_failures"],
+        "serve_audit_p50_lag_ms": snap["audit_p50_lag_ms"],
+        "serve_quarantines": snap["quarantines"],
         # Cold-start record (ISSUE 9): always emitted; the preheat side
         # (serve_preheat_s + aot hit/fallback audit) rides along when
         # TPU_BFS_BENCH_AOT_DIR armed the A/B.

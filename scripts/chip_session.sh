@@ -196,6 +196,19 @@ for i in $(seq 1 "$attempts"); do
       TPU_BFS_BENCH_SERVE_DEVICES=all TPU_BFS_BENCH_SERVE_ENGINE=dist2d \
       TPU_BFS_BENCH_SERVE_LANES=64 TPU_BFS_BENCH_SERVE_RESUME=2 \
       TPU_BFS_BENCH_FAULTS="seed=3:device_lost@fetch@level=2:n=1:skip=1"
+    # Integrity arm (robustness, ISSUE 15): the same closed-loop serve
+    # stage with the online audit tier armed at the production operating
+    # point — shadow re-execution of 10% of resolved queries on a
+    # disjoint ladder rung, structural tree checks on every batch, wire
+    # checksums on the audited transfers. Acceptance: ZERO
+    # serve_audit_failures on clean hardware and <5% serve_p50_ms
+    # regression vs serve-adaptive-s20 (the audits ride the extraction
+    # worker and a background thread, never the dispatch path);
+    # serve_audits_run / serve_audit_p50_lag_ms price the tier ON CHIP.
+    stage "integrity-s20" "$out/integrity_s20.json" \
+      TPU_BFS_BENCH_MODE=serve TPU_BFS_BENCH_SCALE=20 \
+      TPU_BFS_BENCH_SERVE_AUDIT_RATE=0.1 \
+      TPU_BFS_BENCH_SERVE_AUDIT_CHECKSUM=1
     # Cold-start arm (ISSUE 9): the same serve stage with an AOT
     # artifact store armed — the cold service's warmed programs export
     # to $out/aot_store after the closed loop, a SECOND service preheats

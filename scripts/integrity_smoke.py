@@ -1,0 +1,178 @@
+"""The integrity soak (`make integrity-smoke`): the online audit tier
+(ISSUE 15) proven end to end against the real subprocess server.
+
+Two acts, no monkeypatching (tpu_bfs/faults.py discipline):
+
+1. CLEAN SOAK — a fully-audited server (shadow rate 1.0 + structural
+   tree checks + wire checksums) answers a mixed-kind stream (bfs,
+   sssp, cc, khop, p2p over a weighted graph); every response is
+   oracle-checked in-process, and the final statsz must show audits run
+   with ZERO findings — the false-positive bar.
+2. CORRUPTION — the same server with ``corrupt_result`` armed and the
+   flight recorder dumping to disk: the FIRST query's answer is
+   corrupted at the fetch boundary (the client receives a provably
+   wrong distance row — detection is deliberately async), the audit
+   tier catches it (structural + shadow), quarantines the serving rung,
+   and every query submitted AFTER the quarantine answers bit-identical
+   to the oracle. The final statsz must show the findings and the
+   quarantine; the flight-recorder dump must name the corrupted query.
+
+Prints one JSON line (value = clean-act audited query count) so
+scripts/chip_session.sh's has_value gate can drive it as a stage.
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+GRAPH = "random:n=96,m=480,seed=3,weights=5"
+FAULTS = "seed=5:corrupt_result:n=1"
+ENV = dict(os.environ, JAX_PLATFORMS="cpu")
+
+
+def log(msg):
+    print(f"[integrity-smoke] {msg}", file=sys.stderr, flush=True)
+
+
+def check(cond, msg):
+    if not cond:
+        raise SystemExit(f"FAIL: {msg}")
+    log(f"ok: {msg}")
+
+
+def server_argv(extra):
+    return [
+        sys.executable, "-m", "tpu_bfs.serve", GRAPH,
+        "--lanes", "64", "--ladder", "32,64", "--linger-ms", "5",
+        "--statsz-every", "0",
+        "--audit-rate", "1", "--audit-structural", "--audit-checksum",
+        *extra,
+    ]
+
+
+def last_statsz(err: str) -> dict:
+    lines = [l for l in err.splitlines() if l.startswith("statsz ")]
+    check(lines, "final statsz line emitted")
+    return json.loads(lines[-1][len("statsz "):])
+
+
+def main() -> int:
+    import numpy as np
+
+    from tpu_bfs.cli import load_graph
+    from tpu_bfs.reference import bfs_scipy
+    from tpu_bfs.serve.frontend import decode_distances
+
+    g = load_graph(GRAPH)
+    sources = [0, 3, 5, 7]
+    golden = {s: bfs_scipy(g, s) for s in sources}
+
+    # ---- act 1: clean mixed-kind soak, zero findings --------------------
+    log("act 1: clean fully-audited mixed-kind soak")
+    reqs = []
+    rid = 0
+    for s in sources:
+        for kind in ("bfs", "sssp", "cc", "khop", "p2p"):
+            req = {"id": rid, "source": s, "kind": kind}
+            if kind == "khop":
+                req["k"] = 2
+            if kind == "p2p":
+                req["target"] = (s + 7) % g.num_vertices
+            reqs.append(req)
+            rid += 1
+    proc = subprocess.Popen(
+        server_argv([]), stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, env=ENV,
+    )
+    out, err = proc.communicate(
+        input="".join(json.dumps(r) + "\n" for r in reqs), timeout=900
+    )
+    check(proc.returncode == 0, "clean server exits 0")
+    resp = {r["id"]: r for l in out.splitlines() if l.strip()
+            for r in [json.loads(l)]}
+    check(len(resp) == len(reqs)
+          and all(r["status"] == "ok" for r in resp.values()),
+          "every mixed-kind query answers ok")
+    for req in reqs:
+        r = resp[req["id"]]
+        if req["kind"] == "bfs":
+            d = decode_distances(r["distances_npy"])
+            check(bool(np.array_equal(d, golden[req["source"]])),
+                  f"bfs query {req['id']} matches the CPU oracle")
+    snap = last_statsz(err)
+    check(snap["audits_run"] > 0, f"audits ran ({snap['audits_run']})")
+    check(snap["audit_failures"] == 0 and snap["quarantines"] == 0,
+          "clean soak: ZERO audit findings, zero quarantines")
+    check(snap["audit"] == {"rate": 1.0, "structural": True,
+                            "checksum": True},
+          "audit config echoed on statsz")
+    audited = snap["audits_run"]
+
+    # ---- act 2: corrupt_result -> detect -> quarantine -> clean ---------
+    with tempfile.TemporaryDirectory() as dump_dir:
+        log(f"act 2: corrupt_result armed ({FAULTS!r})")
+        proc = subprocess.Popen(
+            server_argv([
+                "--faults", FAULTS,
+                "--obs", f"dump_dir={dump_dir},window=120",
+            ]),
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True, env=ENV,
+        )
+        # The first query's answer is corrupted at fetch; send it alone,
+        # read its response, give the async audit time to quarantine,
+        # THEN send the rest — those must be oracle-exact.
+        proc.stdin.write(json.dumps({"id": 0, "source": 0}) + "\n")
+        proc.stdin.flush()
+        first = json.loads(proc.stdout.readline())
+        check(first["status"] == "ok", "corrupted query still answers ok")
+        d0 = decode_distances(first["distances_npy"])
+        check(not np.array_equal(d0, golden[0]),
+              "first answer IS corrupted (client-visible, pre-detection)")
+        time.sleep(5.0)  # detection + quarantine are async by design
+        for i, s in enumerate(sources[1:], start=1):
+            proc.stdin.write(json.dumps({"id": i, "source": s}) + "\n")
+        proc.stdin.flush()
+        proc.stdin.close()
+        proc.stdin = None  # communicate() must not flush a closed pipe
+        out, err = proc.communicate(timeout=900)
+        check(proc.returncode == 0, "chaos server exits 0")
+        resp = {r["id"]: r for l in out.splitlines() if l.strip()
+                for r in [json.loads(l)]}
+        for i, s in enumerate(sources[1:], start=1):
+            d = decode_distances(resp[i]["distances_npy"])
+            check(bool(np.array_equal(d, golden[s])),
+                  f"post-quarantine query {i} is bit-identical to oracle")
+        snap = last_statsz(err)
+        check(snap["audit_failures"] >= 1,
+              f"auditor caught the corruption "
+              f"({snap['audit_failures']} findings)")
+        check(snap["quarantines"] >= 1,
+              f"suspect rung quarantined ({snap['quarantines']})")
+        check(snap.get("faults", {}).get("corrupt_result") == 1,
+              "exactly the scheduled corrupt_result fired")
+        dumps = sorted(glob.glob(os.path.join(dump_dir, "*.jsonl")))
+        check(dumps, "flight recorder dumped an incident artifact")
+        dumped = "\n".join(open(p).read() for p in dumps)
+        check('"corruption"' in dumped,
+              "dump holds the corruption event")
+        check('"query": 0' in dumped.replace('"query":0', '"query": 0'),
+              "dump names the corrupted query")
+
+    print(json.dumps({
+        "metric": "integrity smoke (clean mixed-kind soak + corrupt_result "
+                  "detect/quarantine/flight-dump, tpu_bfs/integrity)",
+        "value": audited,
+        "unit": "audits",
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

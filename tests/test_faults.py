@@ -156,6 +156,52 @@ def test_mesh_clause_round_trips():
     assert s.rules[1].site == "probe"
 
 
+def test_corruption_clause_round_trips():
+    """ISSUE 15 grammar: the corruption kinds default to the fetch site,
+    the audit sites parse as explicit targets, and every clause survives
+    the canonical round trip."""
+    spec = ("seed=9:corrupt_result:n=1,corrupt_wire:n=2,"
+            "transient@audit_shadow:n=1,slow@audit_structural:n=1:ms=5")
+    s = faults.FaultSchedule.from_spec(spec)
+    assert s.to_spec() == spec
+    assert s.rules[0].site == "fetch"  # corrupt_result defaults to fetch
+    assert s.rules[1].site == "fetch"
+    assert s.rules[2].site == "audit_shadow"
+    assert s.rules[3].site == "audit_structural"
+    assert {"corrupt_result", "corrupt_wire"} <= set(faults.KINDS)
+    assert {"audit_structural", "audit_shadow"} <= set(faults.SITES)
+
+
+def test_corrupt_result_hook_mutates_exactly_one_answer():
+    """maybe_corrupt_result: budget-bounded, copies (never mutates the
+    caller's array), flips a finite distance bit — or bumps an extras
+    int / the reached count for table-free kinds."""
+    import numpy as np
+
+    from tpu_bfs.graph.csr import INF_DIST
+
+    faults.arm_from_spec("seed=1:corrupt_result:n=3")
+    try:
+        dist = np.asarray([0, 1, INF_DIST, 2], np.int32)
+        orig = dist.copy()
+        d2, ex2, r2, fired = faults.maybe_corrupt_result(dist, None, 3)
+        assert fired and not np.array_equal(d2, orig)
+        assert np.array_equal(dist, orig)  # caller's array untouched
+        assert (d2 != orig).sum() == 1  # exactly one element flipped
+        # Table-free kind: the first numeric extras field bumps.
+        _, ex2, _, fired = faults.maybe_corrupt_result(
+            None, {"met": True, "distance": 4}, 7)
+        assert fired and ex2 == {"met": True, "distance": 5}
+        # No extras at all: the reached count bumps.
+        _, _, r2, fired = faults.maybe_corrupt_result(None, None, 7)
+        assert fired and r2 == 8
+        # Budget spent: the next consult is a no-op.
+        d3, _, _, fired = faults.maybe_corrupt_result(dist, None, 3)
+        assert not fired and d3 is dist
+    finally:
+        faults.disarm()
+
+
 def test_slow_rule_sleeps_without_raising():
     s = faults.FaultSchedule.from_spec("slow_extract:ms=40:n=1")
     t0 = time.monotonic()

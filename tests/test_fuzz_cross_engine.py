@@ -487,6 +487,73 @@ def test_serve_chaos_matches_oracle(name, make):
 
 
 @pytest.mark.serve
+@pytest.mark.chaos
+def test_corruption_at_fetch_caught_for_every_kind():
+    """ISSUE 15 fuzz arm: a seeded ``corrupt_result`` bit-flip at the
+    fetch boundary is CAUGHT by the audit tier for every query kind
+    (bfs/sssp/cc/khop/p2p — the flip lands in the distance row or the
+    kind's extras payload), each catch quarantining the serving rung;
+    and an uncorrupted mixed-kind soak through the same fully-audited
+    service produces ZERO false positives."""
+    from tpu_bfs import faults
+    from tpu_bfs.graph.csr import INF_DIST
+    from tpu_bfs.serve import BfsService
+
+    g = rmat_graph(8, 6, seed=107, weights=6)
+    rng = np.random.default_rng(51)
+    sources = _sources(g, rng, n=3)
+    golden = {s: bfs_scipy(g, s) for s in sources}
+    # A p2p pair at distance >= 2 so the path is non-trivial.
+    pair = None
+    for s in sources:
+        reach = np.flatnonzero((golden[s] != INF_DIST) & (golden[s] >= 2))
+        if len(reach):
+            pair = (s, int(reach[0]))
+            break
+    assert pair is not None
+
+    svc = BfsService(g, lanes=64, width_ladder="32,64", linger_ms=1.0,
+                     audit_rate=1.0, audit_structural=True)
+
+    def ask(kind, s):
+        if kind == "khop":
+            return svc.submit(s, kind=kind, k=2)
+        if kind == "p2p":
+            return svc.submit(pair[0], kind=kind, target=pair[1])
+        return svc.submit(s, kind=kind)
+
+    try:
+        failures = 0
+        for i, kind in enumerate(("bfs", "sssp", "cc", "khop", "p2p")):
+            faults.arm_from_spec(f"seed={10 + i}:corrupt_result:n=1")
+            r = ask(kind, sources[i % len(sources)]).result(timeout=240)
+            assert r.ok, (kind, r.status, r.error)
+            assert svc.flush_audits(240), kind
+            faults.disarm()
+            snap = svc.statsz()
+            assert snap["audit_failures"] > failures, (
+                f"{kind}: corruption not caught "
+                f"(failures still {snap['audit_failures']})"
+            )
+            assert snap["quarantines"] >= snap["audit_failures"] > 0
+            failures = snap["audit_failures"]
+        # Uncorrupted soak: every kind, interleaved, zero new findings.
+        staged = []
+        for s in sources:
+            for kind in ("bfs", "sssp", "cc", "khop", "p2p"):
+                staged.append(ask(kind, s))
+        for q in staged:
+            assert q.result(timeout=240).ok
+        assert svc.flush_audits(240)
+        snap = svc.statsz()
+        assert snap["audit_failures"] == failures, "false positive"
+        assert snap["audits_run"] > failures
+    finally:
+        svc.close()
+        faults.disarm()
+
+
+@pytest.mark.serve
 def test_workload_kinds_served_equal_one_shot_and_oracle():
     """ISSUE 14 fuzz arm: every workload kind's SERVED answer equals its
     one-shot engine run AND its external oracle — SciPy dijkstra (sssp),

@@ -19,7 +19,18 @@ from tpu_bfs.utils.wirecheck import (
     check_rows_delta,
     check_rows_sparse,
     check_sliced_hybrid,
+    check_wire_checksum,
 )
+
+
+def test_wire_checksum_byte_proof():
+    """ISSUE 15: the per-hop chunk checksum costs EXACTLY 4 bytes per
+    chunk per hop (one uint32 word) with an identical collective
+    instruction count — the fold is pure compute, framing never adds a
+    collective."""
+    rep = check_wire_checksum(p=8, words=64)
+    assert rep["agree"], rep
+    assert rep["checksum_overhead_bytes"] == 4 * 7, rep
 
 
 def test_1d_sparse_model_matches_hlo(random_small):

@@ -69,6 +69,12 @@ DEFAULT_MODULES = (
     "tpu_bfs/resilience/probe.py",
     "tpu_bfs/resilience/resume.py",
     "tpu_bfs/parallel/dist_bfs2d.py",
+    # ISSUE 15: the integrity tier (audit worker lifecycle, quarantine
+    # flight-dump path) — exception flow here must never leave a lock
+    # held or a span open on the serving threads it observes.
+    "tpu_bfs/integrity/__init__.py",
+    "tpu_bfs/integrity/shadow.py",
+    "tpu_bfs/integrity/structural.py",
 )
 
 

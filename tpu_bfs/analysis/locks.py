@@ -486,6 +486,12 @@ DEFAULT_MODULES = (
     "tpu_bfs/serve/metrics.py",
     "tpu_bfs/serve/registry.py",
     "tpu_bfs/obs/recorder.py",
+    # ISSUE 15: the integrity tier's threaded pieces — the shadow
+    # auditor's queue/worker, the structural auditor's lazy device
+    # tables, and the quarantine escalation counters.
+    "tpu_bfs/integrity/__init__.py",
+    "tpu_bfs/integrity/shadow.py",
+    "tpu_bfs/integrity/structural.py",
 )
 
 

@@ -22,6 +22,8 @@ advance     utils/recovery.advance_with_recovery (chunk step)
 aot_load    utils/aot.ArtifactStore payload read (AOT preheat path)
 sssp_dispatch workloads/sssp.SsspEngine.dispatch (weighted workload)
 sssp_fetch  workloads/sssp.SsspEngine.fetch (blocking result half)
+audit_structural integrity/structural.StructuralAuditor.audit
+audit_shadow integrity/shadow.ShadowAuditor replay (background)
 ========== =======================================================
 
 Production code never pays for this when disabled: every site guard is
@@ -41,6 +43,7 @@ Spec grammar (``--faults`` / ``TPU_BFS_FAULTS``)::
     param   := "p=" FLOAT | "n=" INT | "ms=" FLOAT | "skip=" INT
     kind    := "transient" | "oom" | "slow" | "slow_extract"
              | "corrupt_ckpt" | "corrupt_aot"
+             | "corrupt_result" | "corrupt_wire"
              | "device_lost" | "collective_hang" | "backend_restart"
 
 Examples::
@@ -101,6 +104,13 @@ SITES = (
     # target the weighted path without touching bfs traffic.
     "sssp_dispatch",
     "sssp_fetch",
+    # ISSUE 15: the integrity tier's own consultation points
+    # (tpu_bfs/integrity) — chaos schedules targeting the AUDITORS
+    # (a transient during a shadow replay, a slow structural kernel)
+    # prove the tier degrades to audit errors, never to serving
+    # failures or false corruption findings.
+    "audit_structural",
+    "audit_shadow",
 )
 
 # Where a clause lands when it names no "@site". slow_extract is the
@@ -114,6 +124,12 @@ DEFAULT_SITE = {
     "slow_extract": "fetch",
     "corrupt_ckpt": "ckpt_save",
     "corrupt_aot": "aot_load",
+    # ISSUE 15 corruption kinds: seeded bit-flips at the RESULT
+    # boundary (corrupt_result flips a just-extracted answer in the
+    # serve executor; corrupt_wire flips the audited copy between the
+    # two checksum folds) — every integrity detector's red-before-green.
+    "corrupt_result": "fetch",
+    "corrupt_wire": "fetch",
     "device_lost": "fetch",
     "collective_hang": "fetch",
     "backend_restart": "fetch",
@@ -524,6 +540,39 @@ def maybe_corrupt_payload(payload: bytes, **ctx) -> bytes:
         return b"\x00"  # an empty payload corrupts to a non-empty one
     off = len(payload) // 2
     return payload[:off] + bytes([payload[off] ^ 0xFF]) + payload[off + 1:]
+
+
+def maybe_corrupt_result(dist, extras, reached, **ctx):
+    """``fetch``-site hook for ``corrupt_result`` rules (ISSUE 15): flip
+    one low bit of a finite distance of a just-extracted per-query
+    answer — or, for table-free kinds, bump the first numeric extras
+    field (falling back to the reached count) — so the CLIENT-VISIBLE
+    result is wrong by exactly one seeded mutation. The integrity tier's
+    detectors (structural tree checks, shadow bit-compare) must then go
+    red: this is every auditor's red-before-green drive, and the
+    corruption the quarantine path attributes to the serving rung.
+    Returns ``(dist, extras, reached, fired)``; the inputs are never
+    mutated in place (the distance row is copied before the flip)."""
+    sched = ACTIVE
+    if sched is None or not sched.take("fetch", "corrupt_result", **ctx):
+        return dist, extras, reached, False
+    import numpy as np
+
+    from tpu_bfs.graph.csr import INF_DIST
+
+    if dist is not None:
+        dist = np.array(dist, copy=True)
+        fin = np.flatnonzero(dist != INF_DIST)
+        i = int(fin[len(fin) // 2]) if len(fin) else 0
+        dist[i] ^= 1
+        return dist, extras, reached, True
+    if extras:
+        extras = dict(extras)
+        for key, val in extras.items():
+            if isinstance(val, int) and not isinstance(val, bool):
+                extras[key] = val + 1
+                return dist, extras, reached, True
+    return dist, extras, (reached if reached is None else reached + 1), True
 
 
 def maybe_corrupt_file(path: str) -> bool:

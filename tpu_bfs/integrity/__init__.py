@@ -1,0 +1,301 @@
+"""tpu_bfs/integrity — the online result-integrity tier (ISSUE 15).
+
+The reference validates every run against a CPU golden (checkOutput,
+bfs.cu:374-384); the Graph500 discipline (Buluç & Madduri,
+arXiv:1104.4518) validates by tree properties at scales where no oracle
+fits. Until this package, BOTH only ran in bench/one-shot mode — the
+serve tier shipped answers to clients with zero in-band verification,
+so a silent corruption (bad HBM word, miscompiled rung, wire bit-flip)
+between engine and client was undetectable. The integrity tier audits
+continuously, in the serve path, without touching serving latency:
+
+- **structural audits** (structural.py): the validate.py/graph500.py
+  tree predicates as fused device kernels, run on sampled lanes of
+  every served batch — parent-edge/level properties for bfs, weighted
+  relaxation for sssp, path validity for p2p, consistency for cc/khop.
+- **shadow re-execution** (shadow.py): a deterministic sample of
+  resolved queries replayed on a DISJOINT engine config (another width
+  rung, or the alternate exchange family on a mesh) and bit-compared.
+- **wire checksums** (wire.py): an order-sensitive uint32 fold shared
+  by the exchange frame codec (HLO byte cost proven in wirecheck) and
+  the extraction-transfer check behind the ``audit_checksum`` flag.
+- **quarantine** (this module): a confirmed finding evicts the suspect
+  rung from the registry (the rebuild clears wedged device state),
+  force-opens its (width, devices, kind) circuit breaker so routing
+  stops offering it, dumps the flight recorder naming the corrupted
+  query chain, and — on repeated device-attributed findings on a mesh —
+  escalates to the PR 11 degraded-mesh failover ladder.
+
+Everything here runs on the extraction worker or the dedicated audit
+thread; the scheduler's dispatch hot path and client-visible latency
+pay only the per-batch sampling decision. Audit failures are CONFIRMED
+corruption (exact property violations / exact replays disagreeing);
+audit-infrastructure errors count separately and never quarantine.
+New fault kinds ``corrupt_result``/``corrupt_wire`` (tpu_bfs/faults.py)
+drive every detector red-before-green; ``make integrity-smoke`` is the
+end-to-end proof.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from tpu_bfs import obs as _obs
+from tpu_bfs.integrity.shadow import (  # noqa: F401 — package API
+    AuditSampler,
+    ShadowAuditor,
+    ShadowJob,
+    compare_payloads,
+)
+from tpu_bfs.integrity.structural import (  # noqa: F401 — package API
+    StructuralAuditor,
+    StructuralFinding,
+)
+
+
+class QuarantineManager:
+    """Corruption findings -> rung eviction + breaker + escalation.
+
+    The service binds the three actions (``quarantine_rung``,
+    ``escalate_mesh`` and its metrics); this class owns only the
+    policy: every confirmed finding quarantines its rung, and
+    ``escalate_after`` device-attributed findings on the same mesh span
+    (devices > 1) escalate to the mesh-degrade ladder — a whole mesh
+    corrupting repeatedly is a hardware incident, not a bad compile."""
+
+    def __init__(self, *, quarantine_rung, escalate_mesh, metrics, log=None,
+                 escalate_after: int = 3):
+        self._quarantine_rung = quarantine_rung  # (width, kind) -> None
+        self._escalate_mesh = escalate_mesh  # (devices, cause) -> None
+        self._metrics = metrics
+        self._log = log or (lambda msg: None)
+        self._escalate_after = max(int(escalate_after), 1)
+        self._lock = threading.Lock()
+        self._mesh_findings: dict = {}  # guarded-by: _lock — devices -> count
+
+    def report(self, *, width: int, devices: int, kind: str, query_id,
+               detail: str, source: str) -> None:
+        """One CONFIRMED corruption finding from ``source`` (structural |
+        shadow | checksum) against the rung that served ``query_id``."""
+        from tpu_bfs.utils.recovery import COUNTERS
+
+        self._metrics.record_quarantine()
+        COUNTERS.bump("quarantines")
+        self._log(
+            f"CORRUPTION ({source}) on query {query_id!r}: {detail[:300]} "
+            f"— quarantining the {width}-lane {kind} rung "
+            f"(devices={devices})"
+        )
+        rec = _obs.ACTIVE
+        if rec is not None:
+            # Flight-recorder trigger: a corruption finding is exactly
+            # the incident whose run-up (the serving batch's span chain,
+            # the fault injection if chaos is armed) the ring buffer
+            # holds; the dump names the corrupted query, and its label
+            # names the DETECTOR that fired — a real-hardware corruption
+            # must not masquerade as a chaos fault kind.
+            rec.event("corruption", cat="serve.integrity", query=query_id,
+                      kind=kind, width=width, devices=devices,
+                      source=source, detail=detail[:300])
+            rec.flight_dump(f"corruption_{source}")
+        self._quarantine_rung(width, kind)
+        if devices > 1:
+            with self._lock:
+                n = self._mesh_findings.get(devices, 0) + 1
+                self._mesh_findings[devices] = n
+            if n >= self._escalate_after:
+                with self._lock:
+                    self._mesh_findings[devices] = 0
+                self._log(
+                    f"ESCALATING: {n} corruption findings attributed to "
+                    f"the {devices}-device mesh — running the mesh "
+                    f"degrade ladder"
+                )
+                self._escalate_mesh(devices, RuntimeError(
+                    f"repeated result corruption on the {devices}-device "
+                    f"mesh ({source}: {detail[:200]})"
+                ))
+
+
+class IntegrityTier:
+    """The serve-side composition: sampling, structural checks, shadow
+    replays, and quarantine, bound to one :class:`BfsService`.
+
+    Constructed (and started) only when armed — ``audit_rate > 0`` or a
+    structural/checksum flag — so un-audited services pay nothing."""
+
+    def __init__(self, service, *, rate: float = 0.0,
+                 structural: bool = False, checksum: bool = False,
+                 seed: int = 0, structural_lanes: int = 1,
+                 escalate_after: int = 3, max_pending: int = 64):
+        self._service = service
+        self.rate = float(rate)
+        self.checksum = bool(checksum)
+        self._structural_lanes = max(int(structural_lanes), 0)
+        self._sampler = AuditSampler(rate, seed)
+        self._structural = (
+            StructuralAuditor(service._graph, checksum=checksum)
+            if structural or checksum else None
+        )
+        self.quarantine = QuarantineManager(
+            quarantine_rung=service._quarantine_rung,
+            escalate_mesh=service._escalate_mesh,
+            metrics=service.metrics,
+            log=service._log,
+            escalate_after=escalate_after,
+        )
+        self._shadow = (
+            ShadowAuditor(
+                acquire_engine=service._acquire_shadow_engine,
+                on_mismatch=self._on_shadow_mismatch,
+                metrics=service.metrics,
+                log=service._log,
+                max_pending=max_pending,
+            )
+            if self.rate > 0 else None
+        )
+
+    # --- lifecycle --------------------------------------------------------
+
+    def start(self) -> "IntegrityTier":
+        """Start the audit worker AND pay the tier's one-time costs here,
+        on the cold-start path, instead of lazily at the first audit:
+
+        - the structural auditor's device edge tables (a host->device
+          transfer plus kernel compiles that would otherwise stall the
+          extraction worker mid-traffic);
+        - the shadow rung, when it is not already a warm serving rung
+          (single-rung ladders / the mesh alternate-exchange fallback):
+          ``registry.get`` holds the global registry lock for the whole
+          build, and a mid-traffic build there would freeze dispatch —
+          exactly the hot path the tier promises never to touch. With a
+          multi-rung ladder the disjoint rung IS a serving rung and
+          this is a cache hit; non-primary kinds' shadow engines still
+          build lazily on their first sampled audit (documented)."""
+        svc = self._service
+        if self._structural is not None:
+            try:
+                self._structural.prepare()
+            except Exception as exc:  # noqa: BLE001 — degrade, don't block serving
+                svc._log(f"structural-audit prepare failed "
+                         f"({type(exc).__name__}: {str(exc)[:200]}); "
+                         f"kernels will build on first audit")
+        if self._shadow is not None:
+            if len(svc.width_ladder) == 1:
+                try:
+                    svc._acquire_shadow_engine(
+                        svc.width_ladder[0], svc._primary_kind
+                    )
+                except Exception as exc:  # noqa: BLE001 — lazy fallback
+                    svc._log(f"shadow-rung prewarm failed "
+                             f"({type(exc).__name__}: {str(exc)[:200]}); "
+                             f"building on first audit")
+            self._shadow.start()
+        return self
+
+    def close(self) -> None:
+        if self._shadow is not None:
+            self._shadow.close()
+
+    def flush(self, timeout: float = 60.0) -> bool:
+        """Barrier: every batch already handed to the extraction path
+        has finished its finish+observe window, the pipeline handoff is
+        empty, and every enqueued shadow audit has been processed — the
+        point after which the audit counters are complete for all
+        RESOLVED queries (the bench and the smokes read them here)."""
+        svc = self._service
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            pipe = svc._pipe_q
+            with svc._audit_quiesce:
+                busy = svc._finishing
+            if busy == 0 and (pipe is None or pipe.empty()):
+                break
+            time.sleep(0.005)
+        else:
+            return False
+        if self._shadow is None:
+            return True
+        return self._shadow.flush(max(deadline - time.monotonic(), 0.01))
+
+    def config_summary(self) -> dict:
+        return {
+            "rate": self.rate,
+            "structural": self._structural is not None,
+            "checksum": self.checksum,
+        }
+
+    # --- the per-batch hook (extraction worker) ---------------------------
+
+    def observe_batch(self, pending) -> None:
+        """Audit one successfully-finished batch: structural checks on up
+        to ``structural_lanes`` sampled ok-lanes, shadow enqueue for the
+        sampled fraction of resolutions. Runs AFTER every query resolved
+        — audits never add client-visible latency — and must never let
+        an exception reach the serving path (the caller treats any
+        escape as a bug; everything is caught and counted here)."""
+        now = time.monotonic()
+        structural_left = self._structural_lanes
+        for q in pending.queries:
+            try:
+                r = q.result(0)
+                if not r.ok:
+                    continue
+                if self._structural is not None and structural_left > 0:
+                    structural_left -= 1
+                    self._audit_structural(pending, q, r)
+                if self._shadow is not None and self._sampler.should_sample():
+                    job = ShadowJob(
+                        query_id=q.id, kind=r.kind, source=q.source,
+                        k=getattr(q, "k", None),
+                        target=getattr(q, "target", None),
+                        width=pending.lanes, devices=pending.devices,
+                        distances=r.distances, levels=r.levels,
+                        reached=r.reached,
+                        extras=dict(r.extras) if r.extras else None,
+                        t_resolved=now,
+                    )
+                    self._shadow.offer(job)
+            except Exception as exc:  # noqa: BLE001 — the seal: audits never
+                # become serving incidents. This catches what the inner
+                # handlers can't — a quarantine action itself failing
+                # (flight dump on a full disk, a mesh escalation's
+                # rebuild erroring) — and files it as an audit error
+                # instead of letting _finish's executor-error path
+                # misattribute a SERVED batch as failed.
+                self._service.metrics.record_audit_error()
+                self._service._log(
+                    f"audit pipeline errored (query "
+                    f"{getattr(q, 'id', None)!r}): "
+                    f"{type(exc).__name__}: {str(exc)[:200]}"
+                )
+
+    def _audit_structural(self, pending, q, r) -> None:
+        svc = self._service
+        t0 = time.monotonic()
+        try:
+            self._structural.audit(r.kind, r)
+        except StructuralFinding as exc:
+            svc.metrics.record_audit(
+                (time.monotonic() - t0) * 1e3, failed=True
+            )
+            self.quarantine.report(
+                width=pending.lanes, devices=pending.devices, kind=r.kind,
+                query_id=q.id, detail=str(exc), source="structural",
+            )
+            return
+        except Exception as exc:  # noqa: BLE001 — audit infra, not corruption
+            svc.metrics.record_audit_error()
+            svc._log(
+                f"structural audit errored (query {q.id!r}): "
+                f"{type(exc).__name__}: {str(exc)[:200]}"
+            )
+            return
+        svc.metrics.record_audit((time.monotonic() - t0) * 1e3)
+
+    def _on_shadow_mismatch(self, job: ShadowJob, detail: str) -> None:
+        self.quarantine.report(
+            width=job.width, devices=job.devices, kind=job.kind,
+            query_id=job.query_id, detail=detail, source="shadow",
+        )

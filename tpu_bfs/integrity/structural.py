@@ -1,0 +1,311 @@
+"""Structural result audits: the validate.py / graph500.py tree
+predicates as fused device kernels, run in-band on served batches.
+
+The one-shot paths validate against a CPU golden (``tpu_bfs/validate``)
+or the Graph500 property checks (``graph500.py``) — both host-side,
+O(E) NumPy passes that only ever run in bench/one-shot mode. The serve
+tier needs the same predicates CONTINUOUSLY and cheaply: this module
+compiles them as one fused gather-compare-reduce over the graph's edge
+list held on device, so auditing a lane costs one [V] host->device
+transfer plus a scalar readback — no O(E) host arithmetic on the
+extraction worker, and the device copy doubles as the far side of the
+``audit_checksum`` wire check (integrity/wire.py: the host and device
+folds over the same row must agree, or the transfer corrupted it).
+
+Per kind:
+
+- **bfs** — ``dist[source] == 0``, reached-count agreement, and the
+  Graph500 edge-level property (``dist[v] <= dist[u] + 1`` over every
+  directed edge slot with ``u`` reached — validate.check_edge_levels,
+  fused).
+- **sssp** — the weighted relaxation property ``dist[v] <= dist[u] +
+  w(u, v)`` (the Bellman-Ford fixed-point certificate), plus the source
+  row.
+- **p2p** — path validity on host (paths are O(levels), not O(E)):
+  endpoints, length == distance, every consecutive pair an edge of the
+  graph (binary search over the packed sorted edge keys, built lazily
+  once).
+- **cc / khop** — range/consistency sanity over the extras (label in
+  range, component size == reached, k echoed; these kinds answer from
+  reductions with no per-vertex table to check structurally).
+
+A finding means the SERVED ANSWER violates a property every correct
+answer satisfies — corruption, not noise; the quarantine path treats it
+as confirmed. Audit-infrastructure failures (the kernel itself erroring)
+are reported separately and never quarantine.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from tpu_bfs.graph.csr import INF_DIST
+
+
+class StructuralFinding(Exception):
+    """One confirmed structural violation in a served answer."""
+
+
+class StructuralAuditor:
+    """Fused device-side structure checks over one graph.
+
+    Thread-safe for the single extraction-worker caller the serve tier
+    has; the lazy device tables are built under a lock so a second
+    auditor thread (tests) cannot double-transfer the edge list."""
+
+    def __init__(self, graph, *, checksum: bool = False):
+        self._g = graph
+        self._checksum = bool(checksum)
+        self._lock = threading.Lock()
+        self._dev = None  # guarded-by: _lock — lazy device edge tables
+        self._kern = {}  # guarded-by: _lock — jitted check kernels
+        self._csum = None  # guarded-by: _lock — device checksum kernel
+        self._edge_keys = None  # guarded-by: _lock — sorted int64 edge keys
+
+    # --- lazy device state ------------------------------------------------
+
+    def prepare(self) -> None:
+        """Pay the one-time costs NOW (the integrity tier calls this on
+        the cold-start path): the device edge tables (a 2-3 x E x 4-byte
+        host->device transfer that must not stall the extraction worker
+        mid-traffic — and a real HBM cost next to the engines' own
+        tables, documented in README "Result integrity") and the check/
+        checksum kernel compiles for the kinds that use them."""
+        import jax.numpy as jnp
+
+        self._edges_dev()
+        # One dummy row through each kernel: jax.jit compiles at first
+        # CALL, so constructing alone would still leave the compile on
+        # the first audited batch.
+        zero = jnp.zeros(self._g.num_vertices, jnp.int32)
+        self._kernel("bfs")(zero)
+        if self._g.weights is not None:
+            self._kernel("sssp")(zero)
+        if self._checksum:
+            self._checksum_kernel()(zero)
+
+    def _edges_dev(self):
+        import jax.numpy as jnp
+
+        with self._lock:
+            if self._dev is None:
+                src, dst = self._g.coo
+                w = self._g.weights
+                self._dev = (
+                    jnp.asarray(src.astype(np.int32)),
+                    jnp.asarray(dst.astype(np.int32)),
+                    None if w is None else jnp.asarray(w.astype(np.int32)),
+                )
+            return self._dev
+
+    def _kernel(self, kind: str):
+        import jax
+        import jax.numpy as jnp
+
+        with self._lock:
+            k = self._kern.get(kind)
+        if k is not None:
+            return k
+        srcv, dstv, wv = self._edges_dev()
+
+        if kind == "sssp":
+            @jax.jit
+            def check(dist):
+                du = dist[srcv]
+                dv = dist[dstv]
+                bad = (du != INF_DIST) & (dv > du + wv)
+                return jnp.sum(bad.astype(jnp.int32))
+        else:
+            @jax.jit
+            def check(dist):
+                du = dist[srcv]
+                dv = dist[dstv]
+                bad = (du != INF_DIST) & (dv > du + 1)
+                return jnp.sum(bad.astype(jnp.int32))
+
+        with self._lock:
+            self._kern[kind] = check
+        return check
+
+    def _checksum_kernel(self):
+        from tpu_bfs.integrity.wire import make_i32_checksum
+
+        with self._lock:
+            if self._csum is None:
+                self._csum = make_i32_checksum(self._g.num_vertices)
+            return self._csum
+
+    def _edge_key_set(self) -> np.ndarray:
+        with self._lock:
+            if self._edge_keys is None:
+                src, dst = self._g.coo
+                n = np.int64(self._g.num_vertices)
+                self._edge_keys = np.sort(
+                    src.astype(np.int64) * n + dst.astype(np.int64)
+                )
+            return self._edge_keys
+
+    def _has_edge(self, u: int, v: int) -> bool:
+        keys = self._edge_key_set()
+        q = np.int64(u) * np.int64(self._g.num_vertices) + np.int64(v)
+        j = np.searchsorted(keys, q)
+        return j < len(keys) and keys[j] == q
+
+    # --- the audit --------------------------------------------------------
+
+    def audit(self, kind: str, result) -> None:
+        """Check one served :class:`~tpu_bfs.serve.scheduler.QueryResult`.
+        Raises :class:`StructuralFinding` on a confirmed violation;
+        returns quietly when the answer satisfies every checkable
+        property. Any other exception is an audit-infrastructure error
+        (the caller counts it; it never quarantines)."""
+        from tpu_bfs import faults as _faults
+
+        if _faults.ACTIVE is not None:
+            # Chaos site: a transient/slow kind scheduled here targets
+            # the audit tier itself — the tier must degrade to an audit
+            # error, never to a serving failure (tests pin it).
+            _faults.ACTIVE.hit("audit_structural", lanes=0)
+        if kind in ("bfs", "sssp") and result.distances is not None:
+            self._audit_distances(kind, result)
+        elif kind == "p2p":
+            self._audit_p2p(result)
+        elif kind == "cc":
+            self._audit_cc(result)
+        elif kind == "khop":
+            self._audit_khop(result)
+        else:
+            # Metadata-only bfs/sssp (no distance table to check):
+            # range sanity is all that exists.
+            self._sanity(result)
+
+    def _wire_verify(self, dist_np: np.ndarray, dev) -> None:
+        """The audit_checksum half (integrity/wire.py): the device copy
+        just transferred and the host row it came from must fold to the
+        same checksum, or the host->device wire corrupted the audit's
+        input. ``corrupt_wire`` fault rules flip a bit of the host copy
+        between the two folds, driving this red deterministically."""
+        from tpu_bfs import faults as _faults
+        from tpu_bfs.integrity.wire import words_checksum_np
+
+        host = dist_np
+        if _faults.ACTIVE is not None and _faults.ACTIVE.take(
+            "fetch", "corrupt_wire", n=len(dist_np)
+        ):
+            host = dist_np.copy()
+            fin = np.flatnonzero(host != INF_DIST)
+            i = fin[len(fin) // 2] if len(fin) else 0
+            host[i] ^= 1
+        dev_sum = int(self._checksum_kernel()(dev))
+        host_sum = words_checksum_np(host.astype(np.int32))
+        if dev_sum != host_sum:
+            raise StructuralFinding(
+                f"wire checksum mismatch on the audited distance row: "
+                f"device fold {dev_sum:#010x} != host fold "
+                f"{host_sum:#010x} — the transfer corrupted the data"
+            )
+
+    def _audit_distances(self, kind: str, result) -> None:
+        import jax.numpy as jnp
+
+        dist = np.asarray(result.distances)
+        if dist.shape != (self._g.num_vertices,):
+            raise StructuralFinding(
+                f"distance row is {dist.shape}, graph has "
+                f"{self._g.num_vertices} vertices"
+            )
+        if int(dist[result.source]) != 0:
+            raise StructuralFinding(
+                f"source {result.source} at distance "
+                f"{int(dist[result.source])}, not 0"
+            )
+        reached = int((dist != INF_DIST).sum())
+        if result.reached is not None and reached != int(result.reached):
+            raise StructuralFinding(
+                f"reached count {result.reached} disagrees with the "
+                f"distance row's {reached} finite entries"
+            )
+        dev = jnp.asarray(dist.astype(np.int32))
+        if self._checksum:
+            self._wire_verify(dist, dev)
+        bad = int(self._kernel(kind)(dev))
+        if bad:
+            raise StructuralFinding(
+                f"{bad} edge(s) violate the "
+                + ("weighted relaxation property (dist[v] > dist[u] + w)"
+                   if kind == "sssp"
+                   else "level property (dist[v] > dist[u] + 1)")
+                + f" for {kind} from source {result.source}"
+            )
+
+    def _audit_p2p(self, result) -> None:
+        ex = result.extras or {}
+        met = ex.get("met")
+        distance = ex.get("distance")
+        path = ex.get("path")
+        target = ex.get("target")
+        if not met:
+            if distance is not None or path is not None:
+                raise StructuralFinding(
+                    "unmet p2p answer carries a distance/path"
+                )
+            return
+        if path is None or distance is None:
+            raise StructuralFinding("met p2p answer without a path")
+        if len(path) != distance + 1:
+            raise StructuralFinding(
+                f"p2p path length {len(path)} disagrees with distance "
+                f"{distance}"
+            )
+        if path[0] != result.source or (
+            target is not None and path[-1] != target
+        ):
+            raise StructuralFinding(
+                f"p2p path endpoints ({path[0]}, {path[-1]}) are not "
+                f"(source={result.source}, target={target})"
+            )
+        for u, v in zip(path, path[1:]):
+            if not self._has_edge(int(u), int(v)):
+                raise StructuralFinding(
+                    f"p2p path edge ({u}, {v}) is not in the graph"
+                )
+
+    def _audit_cc(self, result) -> None:
+        ex = result.extras or {}
+        label = ex.get("component")
+        size = ex.get("component_size")
+        total = ex.get("components")
+        v = self._g.num_vertices
+        if label is None or not (0 <= int(label) < v):
+            raise StructuralFinding(f"cc label {label!r} out of range")
+        if size is None or not (1 <= int(size) <= v):
+            raise StructuralFinding(f"cc component size {size!r} out of range")
+        if result.reached is not None and int(size) != int(result.reached):
+            raise StructuralFinding(
+                f"cc component size {size} disagrees with reached "
+                f"{result.reached}"
+            )
+        if total is None or not (1 <= int(total) <= v):
+            raise StructuralFinding(f"cc component count {total!r} invalid")
+
+    def _audit_khop(self, result) -> None:
+        ex = result.extras or {}
+        k = ex.get("k")
+        if k is None or int(k) < 0:
+            raise StructuralFinding(f"khop answer with invalid k={k!r}")
+        self._sanity(result)
+
+    def _sanity(self, result) -> None:
+        v = self._g.num_vertices
+        if result.reached is not None and not (
+            1 <= int(result.reached) <= v
+        ):
+            raise StructuralFinding(
+                f"reached count {result.reached} outside [1, {v}]"
+            )
+        # levels is hop-count for bfs/khop but WEIGHTED eccentricity for
+        # sssp (legitimately > V); only negativity is universally wrong.
+        if result.levels is not None and int(result.levels) < 0:
+            raise StructuralFinding(f"negative levels {result.levels}")

@@ -1,0 +1,169 @@
+"""Wire checksums: an order-sensitive uint32 fold over packed words.
+
+The exchanges (PR 5/7) move uint32 word streams; result extraction moves
+distance words device -> host. Neither path carries any in-band
+integrity: a flipped bit on the interconnect (or in an HBM word between
+kernel and DMA) arrives as a perfectly well-formed word and serves as a
+wrong answer. This module is the shared checksum codec the integrity
+tier folds over both:
+
+- :func:`words_checksum_np` / :func:`make_words_checksum` — a
+  multiply-accumulate fold with per-position odd multipliers
+  (splitmix-derived). Position-dependent, so swapped words are caught;
+  every multiplier is odd, so flipping ANY single bit of ANY word
+  changes the fold (odd x 2^b is never 0 mod 2^32 — the
+  single-bit-flip guarantee the unit tests pin exhaustively). One
+  definition, two implementations that agree bit-for-bit: the jit
+  kernel (device side of a transfer) and the NumPy fold (host side).
+- :func:`append_checksum` / :func:`split_verify` — the +1-word wire
+  frame for exchange chunks: sender appends the fold, receiver strips
+  and recomputes. Cost is exactly 4 bytes per chunk per hop, proven
+  from the compiled HLO in ``utils/wirecheck.check_wire_checksum``.
+- :func:`checksummed_ring_or` — the reference checksummed exchange: a
+  packed ring reduce-scatter-OR (the PR 5 wire shape) with every hop's
+  chunk framed, returning ``(result, bad_hops)`` so an engine can
+  surface wire corruption at fetch time. This is the flag-gated form
+  the HLO byte proof compiles; engines adopt it as their exchanges
+  migrate (the serve tier's ``audit_checksum`` flag meanwhile folds the
+  same codec over the extraction transfer — integrity/structural.py).
+
+int32/uint32 only throughout (the analysis pass 4 dtype lint bans
+64-bit device words); the host fold uses a uint64 accumulator off
+device, masked back to 32 bits.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _mults_np(n: int) -> np.ndarray:
+    """Per-position odd multipliers: a splitmix32-style hash of the word
+    index, forced odd. Host reference; the device fold reuses this exact
+    table as a compile-time constant, so the two stay bit-identical."""
+    x = (np.arange(n, dtype=np.uint64) * np.uint64(0x9E3779B9)) & np.uint64(
+        0xFFFFFFFF
+    )
+    x = x.astype(np.uint32)
+    x ^= x >> np.uint32(16)
+    x = (
+        (x.astype(np.uint64) * np.uint64(0x85EBCA6B)) & np.uint64(0xFFFFFFFF)
+    ).astype(np.uint32)
+    x ^= x >> np.uint32(13)
+    return x | np.uint32(1)
+
+
+def words_checksum_np(arr: np.ndarray) -> int:
+    """Host fold: uint32 checksum of ``arr``'s bytes (any integer dtype;
+    the flat byte view is zero-padded to whole uint32 words)."""
+    raw = np.ascontiguousarray(arr).view(np.uint8).reshape(-1)
+    pad = (-len(raw)) % 4
+    if pad:
+        raw = np.concatenate([raw, np.zeros(pad, np.uint8)])
+    w = raw.view(np.uint32)
+    m = _mults_np(len(w))
+    return int((w.astype(np.uint64) * m.astype(np.uint64)).sum()
+               & np.uint64(0xFFFFFFFF))
+
+
+def _fold(w, mults):
+    """Traced uint32 multiply-accumulate, 32-bit end to end: lo/hi
+    16-bit partial products in wraparound uint32 (the dtype lint bans a
+    64-bit accumulator on device; wraparound sums commute, so the split
+    matches the host's masked 64-bit fold exactly)."""
+    w = w.astype(jnp.uint32)
+    lo = (w & jnp.uint32(0xFFFF)) * mults
+    hi = ((w >> jnp.uint32(16)) * mults) << jnp.uint32(16)
+    return jnp.sum(lo + hi, dtype=jnp.uint32)
+
+
+def make_words_checksum(n_words: int):
+    """Device twin of :func:`words_checksum_np` over a flat uint32
+    ``[n_words]`` array -> uint32 scalar. Built per length so the
+    multiplier table is a baked constant."""
+    mults = jnp.asarray(_mults_np(n_words))
+
+    @jax.jit
+    def checksum(words):
+        return _fold(words, mults)
+
+    return checksum
+
+
+def make_i32_checksum(n: int):
+    """Device checksum over an int32 ``[n]`` array (distance rows): the
+    int32 bits reinterpreted as uint32 words, same fold — so the host
+    side simply calls :func:`words_checksum_np` on the int32 array."""
+    mults = jnp.asarray(_mults_np(n))
+
+    @jax.jit
+    def checksum(arr):
+        return _fold(jax.lax.bitcast_convert_type(arr, jnp.uint32), mults)
+
+    return checksum
+
+
+def append_checksum(words):
+    """Frame one exchange chunk: ``[n] uint32 -> [n+1]`` with the fold in
+    the last word. Traceable; the +1 word is the whole wire cost
+    (4 bytes/chunk/hop, HLO-pinned in wirecheck)."""
+    n = int(words.shape[-1])
+    mults = jnp.asarray(_mults_np(n))
+    w = words.astype(jnp.uint32)
+    return jnp.concatenate([w, _fold(w, mults)[None]])
+
+
+def split_verify(framed):
+    """Strip one frame: ``[n+1] -> ([n] payload, ok bool scalar)``. The
+    receiver recomputes the fold over the payload it actually received;
+    ``ok`` is False exactly when the wire changed any bit of the frame
+    (payload or checksum word)."""
+    payload = framed[:-1]
+    n = int(payload.shape[-1])
+    mults = jnp.asarray(_mults_np(n))
+    return payload, _fold(payload, mults) == framed[-1]
+
+
+def checksummed_ring_or(chunks, axis_name: str, *, wire_check: bool = True):
+    """Packed ring reduce-scatter-OR with per-hop chunk checksums.
+
+    ``chunks``: ``[P, words] uint32`` — this shard's per-destination
+    pieces. Returns ``(own [words] uint32, bad_hops int32 scalar)``:
+    ``own`` is the OR over all shards of their piece for this shard,
+    ``bad_hops`` counts hops whose received frame failed verification
+    (0 on a healthy wire — a nonzero count at fetch is the corruption
+    finding the serve tier quarantines on). With ``wire_check=False``
+    the frames are skipped entirely — byte-identical to the plain
+    packed ring, the A/B ``check_wire_checksum`` compiles.
+
+    The ring is the standard one: the piece for destination ``d``
+    starts at shard ``d+1`` and accumulates each visited shard's chunk
+    over ``P-1`` hops (unrolled, so the HLO proof counts the permutes
+    individually). Cost with checksums: ``(P-1) * 4`` extra bytes per
+    shard per exchange — one word per hop."""
+    p = int(chunks.shape[0])
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % p) for i in range(p)]
+    # Before any hop this shard holds the partial for destination idx-1.
+    buf = jax.lax.dynamic_index_in_dim(
+        chunks, jnp.mod(idx - 1, p), keepdims=False
+    )
+    bad = jnp.int32(0)
+    for k in range(p - 1):
+        if wire_check:
+            framed = jax.lax.ppermute(append_checksum(buf), axis_name, perm)
+            received, ok = split_verify(framed)
+            bad = bad + jnp.where(ok, jnp.int32(0), jnp.int32(1))
+        else:
+            received = jax.lax.ppermute(buf, axis_name, perm)
+        # Received: the partial for destination idx-k-2; fold in this
+        # shard's own piece for it and keep forwarding. At the last hop
+        # the destination is idx itself and the fold completes.
+        d = jnp.mod(idx - k - 2, p)
+        buf = received | jax.lax.dynamic_index_in_dim(
+            chunks, d, keepdims=False
+        )
+    return buf, bad

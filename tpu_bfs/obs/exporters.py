@@ -122,6 +122,8 @@ _COUNTER_KEYS = frozenset((
     "requeue_shed", "padded_lanes_total", "breaker_opens",
     "lanes_used", "lanes_offered",
     "mesh_faults", "mesh_degrades", "query_resumes", "resume_snapshots",
+    "audits_run", "audit_failures", "audit_errors", "audit_dropped",
+    "quarantines",
 ))
 
 

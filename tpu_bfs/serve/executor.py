@@ -172,6 +172,23 @@ class CircuitBreaker:
             )
         return opened
 
+    def trip(self, key) -> None:
+        """Force-open ``key`` immediately — the integrity tier's
+        corruption quarantine (ISSUE 15): a rung whose AUDITED answer
+        was provably corrupt must stop taking traffic now, not after
+        ``threshold`` more batches of wrong answers. Half-opens on the
+        ordinary cooldown timer like any open breaker (the evicted
+        rung's rebuild gets its probe batch)."""
+        with self._lock:
+            st = self._state.setdefault(key, [self.CLOSED, 0, 0.0])
+            st[0] = self.OPEN
+            st[1] = max(st[1], self.threshold)
+            st[2] = self._now()
+            self.opens += 1
+        COUNTERS.bump("breaker_opens")
+        self._log(f"circuit breaker FORCED OPEN for {key} (corruption "
+                  f"quarantine; cooldown {self.cooldown_s:.1f}s)")
+
     def open_keys(self) -> list:
         """Keys currently open/half-open (for statsz)."""
         with self._lock:
@@ -752,6 +769,16 @@ class BatchExecutor:
             else:
                 finite = d[d != INF_DIST]
                 levels = int(finite.max()) if finite.size else 0
+            extras_i = extras_fn(i) if extras_fn is not None else None
+            reached_i = int(res.reached[i])
+            if _faults.ACTIVE is not None:
+                # Chaos hook (ISSUE 15): corrupt_result rules flip one
+                # bit of THIS query's just-extracted answer — the
+                # client-visible corruption every integrity detector
+                # must catch (red-before-green for the audit tier).
+                d, extras_i, reached_i, _fired = _faults.maybe_corrupt_result(
+                    d, extras_i, reached_i, lanes=width, batch=pending.bid,
+                )
             # Stamp at RESOLVE time, per query: extraction cost is real
             # client-visible latency (the old shared pre-extraction stamp
             # hid it, and hid the pipelining win with it).
@@ -761,10 +788,10 @@ class BatchExecutor:
                 source=q.source,
                 status=STATUS_OK,
                 kind=pending.kind,
-                extras=extras_fn(i) if extras_fn is not None else None,
+                extras=extras_i,
                 distances=d if want else None,
                 levels=levels,
-                reached=int(res.reached[i]),
+                reached=reached_i,
                 latency_ms=latency_ms,
                 batch_lanes=n,
                 dispatched_lanes=width,

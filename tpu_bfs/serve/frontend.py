@@ -247,6 +247,10 @@ class BfsService:
         watchdog_ms: float = 0.0,
         breaker_threshold: int = 3,
         breaker_cooldown_ms: float = 30_000.0,
+        audit_rate: float = 0.0,
+        audit_structural: bool = False,
+        audit_checksum: bool = False,
+        audit_seed: int = 0,
         distances: bool = True,
         kinds=None,
         registry: EngineRegistry | None = None,
@@ -363,6 +367,32 @@ class BfsService:
         # many times resolves with an explicit error carrying its attempt
         # history instead of looping forever when every rung is broken.
         self._max_requeues = max(int(max_requeues), 0)
+        # Online integrity tier (ISSUE 15, tpu_bfs/integrity): armed by
+        # any audit knob — structural tree checks on every served batch,
+        # sampled shadow re-execution on a disjoint rung, wire checksums
+        # on the audited transfers, and corruption quarantine. Disarmed
+        # services hold None and pay nothing anywhere.
+        # Audit-flush barrier state: how many batches are inside the
+        # finish+observe window right now (flush_audits waits for zero
+        # with an empty pipeline, so counters read complete).
+        self._audit_quiesce = threading.Lock()
+        self._finishing = 0  # guarded-by: _audit_quiesce
+        if audit_rate > 0 or audit_structural or audit_checksum:
+            from tpu_bfs.integrity import IntegrityTier
+
+            self._integrity = IntegrityTier(
+                self, rate=audit_rate,
+                structural=bool(audit_structural) or bool(audit_checksum),
+                checksum=audit_checksum, seed=audit_seed,
+            )
+            if registry is None:
+                # The shadow replays keep one disjoint rung (plus a
+                # rebuild slot) resident next to the serving ladder; an
+                # internally-owned registry must fit it or audits thrash
+                # the warm rungs they exist to check.
+                self._registry.capacity = self._registry.capacity + 2
+        else:
+            self._integrity = None
         self._want_distances_default = bool(distances)
         self._pipe_q: _queue.Queue | None = (
             _queue.Queue(maxsize=max(1, int(pipeline_depth)))
@@ -444,6 +474,8 @@ class BfsService:
                 target=self._loop, name="bfs-serve-scheduler", daemon=True
             )
             self._thread.start()
+            if self._integrity is not None:
+                self._integrity.start()
         return self
 
     def drain(self) -> None:
@@ -472,6 +504,11 @@ class BfsService:
             if extract_thread is not None:
                 self._pipe_q.put(None)  # after scheduler exit: no more puts
                 extract_thread.join()
+            if self._integrity is not None:
+                # After both serving threads: no more observe_batch
+                # calls; close() drains every queued audit first, so the
+                # final statsz carries complete audit counts.
+                self._integrity.close()
         else:
             # Never started: drain staged queries here instead.
             for q in self._queue.next_batch(self._queue.cap, 0.0):
@@ -637,6 +674,10 @@ class BfsService:
             counts = cache_for_graph(self._graph).counts()
             out["query_resumes"] = counts["resumes"]
             out["resume_snapshots"] = counts["snapshots"]
+        if self._integrity is not None:
+            # Integrity-tier config echo (ISSUE 15): what the audit
+            # counters on this line were produced under.
+            out["audit"] = self._integrity.config_summary()
         store = self._registry.aot_store
         if store is not None:
             # AOT preheat visibility: artifact hits vs JIT fallbacks —
@@ -1102,14 +1143,91 @@ class BfsService:
         heartbeat on ``devices``)."""
         self.mesh_restore(devices, probe=False)
 
+    # --- integrity tier (ISSUE 15) ----------------------------------------
+
+    def _quarantine_rung(self, width: int, kind: str) -> None:
+        """Corruption quarantine: evict the suspect rung (the rebuild
+        clears wedged device state and recompiles) and force-open its
+        (width, devices, kind) breaker so routing stops offering it until
+        the cooldown's probe batch. The breaker's existing
+        every-candidate-open backstop still applies — a single-rung
+        service keeps serving through the rebuilt engine rather than
+        wedging."""
+        from tpu_bfs.serve.executor import breaker_key
+
+        devices = self._mesh_cfg.devices
+        self._registry.evict(self._spec(width, kind=kind))
+        self._breaker.trip(breaker_key(width, devices, kind))
+
+    def _escalate_mesh(self, devices: int, cause) -> None:
+        """Repeated device-attributed corruption -> the PR 11 mesh
+        degrade ladder: a mesh whose answers keep failing audits after
+        rung rebuilds is a hardware incident, handled exactly like a
+        mesh death (smaller mesh, re-warmed engines, probe-gated
+        restore)."""
+        if devices > 1:
+            self._degrade_mesh(devices, cause)
+
+    def _shadow_spec(self, width: int, kind: str) -> EngineSpec:
+        """The DISJOINT engine config a shadow replay of a ``width``-lane
+        ``kind`` answer runs on — a different compiled program, so a
+        miscompiled or corrupted serving rung cannot re-produce its own
+        wrong answer: another ladder rung when one exists, else the
+        alternate exchange family on a mesh (a different collective
+        program over the same devices), else a width off the ladder."""
+        others = [w for w in self.width_ladder if w != width]
+        if others:
+            return self._spec(others[0], kind=kind)
+        cfg = self._mesh_cfg
+        if cfg.devices > 1:
+            alt = {
+                "": "allreduce", "ring": "allreduce", "allreduce": "ring",
+            }.get(cfg.exchange) if cfg.engine == "dist2d" else {
+                "": "sparse", "dense": "sparse", "sparse": "dense",
+                "sliced": "dense",
+            }.get(cfg.exchange)
+            if alt:
+                return dataclasses.replace(
+                    self._spec(width, kind=kind), exchange=alt,
+                    wire_pack=False, delta_bits=(), sieve=False,
+                    predict=False,
+                )
+        floor, quantum = self._width_floor, self._width_quantum
+        w2 = max(floor, (width // 2) // quantum * quantum)
+        if w2 == width:
+            w2 = width + quantum
+        return self._spec(w2, kind=kind)
+
+    def _acquire_shadow_engine(self, width: int, kind: str):
+        """The shadow auditor's engine hook: warm (and keep resident) the
+        disjoint rung through the ordinary registry path."""
+        return self._registry.get(self._shadow_spec(width, kind))
+
+    def flush_audits(self, timeout: float = 60.0) -> bool:
+        """Barrier: every enqueued shadow audit processed (bench/smoke
+        callers read the audit counters after this). True when armed and
+        fully flushed, or trivially when disarmed."""
+        if self._integrity is None:
+            return True
+        return self._integrity.flush(timeout)
+
     def _finish(self, pending) -> None:
         """The extraction half, wherever it runs (inline or worker).
         Never lets an exception escape with queries unresolved: an error
         the executor's classifier didn't translate (e.g. a device failure
         inside result extraction itself) still resolves the batch with
         explicit errors — the exactly-once bar."""
+        with self._audit_quiesce:
+            self._finishing += 1
         try:
             self._executor.finish_batch(pending)
+            tier = self._integrity
+            if tier is not None:
+                # The audit hook (ISSUE 15): every query of this batch is
+                # already resolved, so audits add zero client latency;
+                # observe_batch catches everything internally — an audit
+                # bug must never turn a served batch into an incident.
+                tier.observe_batch(pending)
         except OomRequeue as exc:
             width = pending.lanes
             # Drop the references to the OOM'd engine before the narrower
@@ -1144,6 +1262,9 @@ class BfsService:
                     n += 1  # idempotent: count only queries WE resolved
             if n:
                 self.metrics.record_errors(n)
+        finally:
+            with self._audit_quiesce:
+                self._finishing -= 1
 
     def _extract_loop(self) -> None:
         while True:
@@ -1407,6 +1528,30 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument("--breaker-cooldown-ms", type=float, default=30000.0,
                     help="how long an open breaker waits before admitting "
                     "one half-open probe batch (default 30000)")
+    ap.add_argument("--audit-rate", type=float, default=0.0, metavar="R",
+                    help="online integrity tier (tpu_bfs/integrity): "
+                    "replay this fraction of resolved queries on a "
+                    "DISJOINT engine config (another ladder rung / the "
+                    "alternate exchange family) and bit-compare; a "
+                    "mismatch quarantines the serving rung (eviction + "
+                    "forced-open breaker + flight dump) and repeated "
+                    "device-attributed findings escalate to the mesh "
+                    "degrade ladder. 0 disables (default); sampling is "
+                    "deterministic in --audit-seed")
+    ap.add_argument("--audit-structural", action="store_true",
+                    help="structural tree checks on every served batch "
+                    "(sampled lanes): the Graph500 edge-level property "
+                    "for bfs, weighted relaxation for sssp, path "
+                    "validity for p2p, consistency for cc/khop — the "
+                    "validate.py predicates as fused device kernels")
+    ap.add_argument("--audit-checksum", action="store_true",
+                    help="wire checksums on the audited transfers "
+                    "(integrity/wire.py): the host and device folds "
+                    "over each audited distance row must agree, or the "
+                    "transfer corrupted it (implies --audit-structural)")
+    ap.add_argument("--audit-seed", type=int, default=0,
+                    help="seed of the deterministic audit sampler "
+                    "(default 0)")
     ap.add_argument("--faults", default=None, metavar="SPEC",
                     help="arm a deterministic fault-injection schedule "
                     "(tpu_bfs/faults.py), e.g. 'seed=7:transient@dispatch:"
@@ -1663,6 +1808,10 @@ def run_server(args, stdin=None, stdout=None, stderr=None,
         watchdog_ms=args.watchdog_ms,
         breaker_threshold=args.breaker_threshold,
         breaker_cooldown_ms=args.breaker_cooldown_ms,
+        audit_rate=getattr(args, "audit_rate", 0.0),
+        audit_structural=getattr(args, "audit_structural", False),
+        audit_checksum=getattr(args, "audit_checksum", False),
+        audit_seed=getattr(args, "audit_seed", 0),
         distances=not args.no_distances,
         kinds=(
             tuple(t for t in str(args.kinds).replace(",", " ").split())

@@ -207,6 +207,17 @@ class ServeMetrics:
         self.requeue_shed = 0  # guarded-by: _lock — shed at requeue budget
         self.mesh_faults = 0  # guarded-by: _lock — mesh-death classifications
         self.mesh_degrades = 0  # guarded-by: _lock — mesh failover rebuilds
+        # Integrity tier (ISSUE 15): audits completed, CONFIRMED
+        # corruption findings, audit-infrastructure errors (replay/kernel
+        # failures — never corruption), audits shed at the bounded
+        # backlog, and rung quarantines. The lag histogram prices how far
+        # behind the served answer its verdict lands (audit_p50_lag_ms).
+        self.audits_run = 0  # guarded-by: _lock
+        self.audit_failures = 0  # guarded-by: _lock
+        self.audit_errors = 0  # guarded-by: _lock
+        self.audit_dropped = 0  # guarded-by: _lock
+        self.quarantines = 0  # guarded-by: _lock
+        self._audit_lag_hist = Log2Histogram()  # guarded-by: _lock
         self.batches = 0  # guarded-by: _lock
         self.lanes_used = 0  # guarded-by: _lock — real queries, all batches
         # Sum of DISPATCHED batch capacity: with the width ladder this is
@@ -294,6 +305,25 @@ class ServeMetrics:
             self.mesh_degrades += 1
             self.requeued += requeued
 
+    def record_audit(self, lag_ms: float, *, failed: bool = False) -> None:
+        with self._lock:
+            self.audits_run += 1
+            if failed:
+                self.audit_failures += 1
+            self._audit_lag_hist.add(lag_ms)
+
+    def record_audit_error(self) -> None:
+        with self._lock:
+            self.audit_errors += 1
+
+    def record_audit_dropped(self) -> None:
+        with self._lock:
+            self.audit_dropped += 1
+
+    def record_quarantine(self) -> None:
+        with self._lock:
+            self.quarantines += 1
+
     def _round(self, v: float | None) -> float | None:
         return None if v is None else round(v, 3)
 
@@ -353,6 +383,14 @@ class ServeMetrics:
                 "requeue_shed": self.requeue_shed,
                 "mesh_faults": self.mesh_faults,
                 "mesh_degrades": self.mesh_degrades,
+                "audits_run": self.audits_run,
+                "audit_failures": self.audit_failures,
+                "audit_errors": self.audit_errors,
+                "audit_dropped": self.audit_dropped,
+                "audit_p50_lag_ms": self._round(
+                    self._audit_lag_hist.percentile(50)
+                ),
+                "quarantines": self.quarantines,
             }
         if queue_depth is not None:
             out["queue_depth"] = queue_depth

@@ -470,6 +470,15 @@ class EngineRegistry:
                              cat="serve.registry", width=spec.lanes,
                              engine=spec.engine):
             eng.run(np.zeros(eng.lanes, dtype=np.int64), time_it=False)
+            # Residency warm-up hook (ISSUE 15 satellite / ROADMAP 3b):
+            # engines with per-residency caches beyond the compiled
+            # programs build them HERE, inside the warm span, so the
+            # first real query never pays a cold path — the p2p adapter
+            # builds its cached parent scanner (without it, every first
+            # path reconstruction paid the O(E) host scatter-min).
+            warm = getattr(eng, "warm_residency", None)
+            if warm is not None:
+                warm()
         self._log(f"engine warmed {spec} in {time.perf_counter() - t0:.1f}s")
 
     def evict(self, spec: EngineSpec) -> bool:

@@ -41,6 +41,7 @@ class RecoveryCounters:
     mesh_faults: int = 0  # mesh-death classifications (is_mesh_fault fired)
     mesh_degrades: int = 0  # degraded-mesh failover rebuilds (ISSUE 12)
     query_resumes: int = 0  # level-checkpointed mid-query resumes
+    quarantines: int = 0  # corruption-audit rung quarantines (ISSUE 15)
 
     _lock: threading.Lock = dataclasses.field(
         default_factory=threading.Lock, repr=False, compare=False

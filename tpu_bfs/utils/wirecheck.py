@@ -626,6 +626,75 @@ def check_packed_exchange(graph, p: int = 8) -> dict:
     }
 
 
+def check_wire_checksum(p: int = 8, words: int = 64) -> dict:
+    """ISSUE 15 wire-checksum byte proof, from the compiled HLO: the
+    per-hop chunk checksum (integrity/wire.checksummed_ring_or) costs
+    EXACTLY one uint32 word — 4 bytes — per chunk per hop, with an
+    identical collective instruction count (the fold is pure compute;
+    framing never adds a collective). Compiles the checksummed packed
+    ring reduce-scatter-OR both ways over the real ``p``-device mesh and
+    derives everything from the permutes' own result shapes:
+
+    - both variants emit exactly ``p - 1`` collective-permutes;
+    - plain chunks are ``u32[words]`` (4 * words bytes), framed chunks
+      ``u32[words + 1]`` — the delta is 4 bytes per hop, total
+      ``4 * (p - 1)`` per shard per exchange;
+    - the two programs' results are bit-identical on clean wires (the
+      OR semantics are untouched; pinned separately in
+      tests/test_integrity.py)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from tpu_bfs.integrity.wire import checksummed_ring_or
+    from tpu_bfs.parallel.compat import shard_map
+
+    devs = jax.devices()[:p]
+    mesh = Mesh(np.array(devs), ("x",))
+    chunks = jnp.zeros((p, p, words), jnp.uint32)
+
+    def lower(wire_check: bool) -> str:
+        def body(c):
+            out, bad = checksummed_ring_or(
+                c[0], "x", wire_check=wire_check
+            )
+            return out[None], bad[None]
+
+        fn = shard_map(
+            body, mesh=mesh, in_specs=P("x"), out_specs=(P("x"), P("x")),
+        )
+        return jax.jit(fn).lower(chunks).compile().as_text()
+
+    colls = {
+        checked: [
+            c for c in hlo_collectives(lower(checked))
+            if c.op == "collective-permute"
+        ]
+        for checked in (False, True)
+    }
+    plain_bytes = sum(c.result_bytes for c in colls[False])
+    checked_bytes = sum(c.result_bytes for c in colls[True])
+    counts = {
+        checked: len(hlo_collectives(lower(checked)))
+        for checked in (False, True)
+    }
+    return {
+        "config": f"checksummed packed ring, P={p}, {words} words/chunk",
+        "permutes": {c: len(v) for c, v in colls.items()},
+        "plain_permute_bytes": plain_bytes,
+        "checked_permute_bytes": checked_bytes,
+        "checksum_overhead_bytes": checked_bytes - plain_bytes,
+        "collective_counts": counts,
+        "agree": (
+            len(colls[False]) == len(colls[True]) == p - 1
+            and counts[True] == counts[False]
+            and checked_bytes - plain_bytes == 4 * (p - 1)
+            and all(c.result_bytes == 4 * words for c in colls[False])
+            and all(c.result_bytes == 4 * (words + 1) for c in colls[True])
+        ),
+    }
+
+
 def check_gated_hybrid(graph, p: int = 8, exchange: str = "dense") -> dict:
     """Pull-gated distributed hybrid (ISSUE 1): the gate must move ZERO
     extra collective bytes — its settled mask is chip-resident, and its

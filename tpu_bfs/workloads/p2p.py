@@ -181,6 +181,19 @@ class P2pServeEngine:
             base._act + 1, base._act, base.w, base.num_planes
         )
 
+    def warm_residency(self) -> None:
+        """Registry warm-up hook (ROADMAP item 3b): build and cache the
+        base engine's device parent scanner now, while the residency is
+        being warmed, so the FIRST p2p path reconstruction runs the
+        cached-scanner fast path instead of paying a cold O(E) host
+        scatter-min per lane. The wide base engine's scanner BORROWS its
+        existing ELL arrays (zero extra HBM — parent_scanner_of's
+        caching policy); unavailability is cached too, so this is a
+        no-op on engines that cannot scan."""
+        from tpu_bfs.algorithms._packed_common import parent_scanner_of
+
+        parent_scanner_of(self.base)
+
     def dispatch(self, sources, *, targets=None, **_ignored) -> P2pPending:
         sources = np.asarray(sources, dtype=np.int64)
         if targets is None:

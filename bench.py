@@ -573,6 +573,23 @@ def _env_pull_gate() -> bool:
     return _env_bool("TPU_BFS_BENCH_PULL_GATE", "pull gate", "gate")
 
 
+def _env_expand_impl() -> str:
+    """TPU_BFS_BENCH_EXPAND_IMPL -> 'xla' (default) or 'pallas' (the
+    fused bucketed-ELL expansion kernel, ISSUE 16 — default off until
+    chip-measured, like the pull gate it composes with). Pallas runs are
+    bit-identical to xla (fuzz-pinned), so the A/B pair isolates the
+    kernel tier's win; malformed values log and run the default tier."""
+    raw = os.environ.get("TPU_BFS_BENCH_EXPAND_IMPL", "").strip().lower()
+    if not raw or raw == "xla":
+        return "xla"
+    if raw == "pallas":
+        log("pallas expansion tier enabled (TPU_BFS_BENCH_EXPAND_IMPL)")
+        return "pallas"
+    log(f"TPU_BFS_BENCH_EXPAND_IMPL={raw!r} not one of xla|pallas; "
+        f"xla tier")
+    return "xla"
+
+
 def _env_wire_pack() -> bool:
     """TPU_BFS_BENCH_WIRE_PACK -> bool (default off until chip-measured,
     like the pull gate — ISSUE 5). Applies to the dist mode's exchange;
@@ -870,6 +887,40 @@ def _bench_batch_packed(g, graph_desc, engine, in_degree, build_log: str, label:
         result["gate_level_counts"] = [
             int(x) for x in np.asarray(gc)[: res.num_levels + 1]
         ]
+    if getattr(engine, "expand_impl", "xla") != "xla":
+        # Kernel-tier verdict keys (ISSUE 16): which tier ran, the
+        # per-kernel VMEM-resident byte bound of one ungated level
+        # (ops/ell_expand.ell_expand_hbm_bytes), and per-level modeled
+        # kernel bytes. Gated runs scale each level by its skipped-tile
+        # count, assuming skips distribute across kernels in proportion
+        # to their tile counts (the counter is bucket-aggregated); the
+        # floor is the all-skipped identity-write cost.
+        from tpu_bfs.utils.roofline import pallas_expand_bytes
+
+        result["expand_impl"] = engine.expand_impl
+        pal = pallas_expand_bytes(engine)
+        full = sum(pal.values())
+        result["expand_kernel_bytes"] = {
+            **{k: int(v) for k, v in pal.items()},
+            "level_total": int(full),
+        }
+        levels = res.num_levels + 1
+        gcl = result.get("gate_level_counts")
+        if gcl:
+            zero = sum(pallas_expand_bytes(engine, active_tiles=0).values())
+            from tpu_bfs.ops.ell_expand import TILE as KTILE
+
+            nb_tot = sum(
+                int(t.shape[-1]) // KTILE
+                for n, t in engine.arrs.items()
+                if n.endswith("_gt") and "_w" not in n
+            )
+            save = (full - zero) / max(nb_tot, 1)
+            result["expand_kernel_bytes_per_level"] = [
+                int(max(full - s * save, zero)) for s in gcl
+            ]
+        else:
+            result["expand_kernel_bytes_per_level"] = [int(full)] * levels
     return result
 
 
@@ -940,6 +991,9 @@ def bench_hybrid(g, scale: int, ef: int, graph_desc: str | None = None,
     # state does not fit next to the tiles; whatever width is chosen
     # appears in the metric label via engine.lanes.
     max_lanes = _env_max_lanes(default=DEFAULT_MAX_LANES)
+    expand_impl = _env_expand_impl()
+    if expand_impl != "xla":
+        kw["expand_impl"] = expand_impl
     pull_gate = _env_pull_gate()
     if pull_gate:
         kw["pull_gate"] = True
@@ -980,7 +1034,8 @@ def bench_hybrid(g, scale: int, ef: int, graph_desc: str | None = None,
             f"a_mem={hg.a_tiles.nbytes/2**30:.2f}GiB",
             "hybrid MXU+gather"
             + ("" if adaptive is None else "+adaptive-push")
-            + ("+pull-gate" if pull_gate else ""),
+            + ("+pull-gate" if pull_gate else "")
+            + ("+pallas-expand" if expand_impl != "xla" else ""),
         )
 
     return _with_adaptive_shed(
@@ -1003,6 +1058,7 @@ def bench_wide(g, scale: int, ef: int, graph_desc: str | None = None,
 
     t0 = time.perf_counter()
     max_lanes = _env_max_lanes(default=WIDE_DEFAULT_MAX_LANES)
+    expand_impl = _env_expand_impl()
     pull_gate = _env_pull_gate()
     if pull_gate:
         log("adaptive push off (pull gate active — A/B arms stay clean)")
@@ -1010,6 +1066,8 @@ def bench_wide(g, scale: int, ef: int, graph_desc: str | None = None,
     else:
         adaptive = None if _shed_adaptive else _env_adaptive()
         kw = {} if adaptive is None else {"adaptive_push": adaptive}
+    if expand_impl != "xla":
+        kw["expand_impl"] = expand_impl
 
     def run_once():
         try:
@@ -1033,7 +1091,8 @@ def bench_wide(g, scale: int, ef: int, graph_desc: str | None = None,
             f"(x{ell.total_slots/max(g.num_edges,1):.2f}) heavy={ell.num_heavy}",
             "wide packed"
             + ("" if adaptive is None else "+adaptive-push")
-            + ("+pull-gate" if pull_gate else ""),
+            + ("+pull-gate" if pull_gate else "")
+            + ("+pallas-expand" if expand_impl != "xla" else ""),
         )
 
     return _with_adaptive_shed(
@@ -1327,6 +1386,15 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
                                     "").strip()
     serve_pull_gate = os.environ.get("TPU_BFS_BENCH_SERVE_PULL_GATE",
                                      "0") == "1"
+    # One knob for the serve and batch arms (ISSUE 16): the kernel tier
+    # is a program-key axis, so preheat stores keep tiers separate.
+    serve_expand_impl = _env_expand_impl()
+    if serve_expand_impl != "xla" and engine in ("packed", "dist2d"):
+        # Drop, don't die (the registry's validate would reject): the
+        # kernel tier fuses the wide/hybrid engines' ELL pull loop only.
+        log("pallas expansion tier applies to the wide/hybrid serve "
+            f"engines only; ignored on engine={engine!r}")
+        serve_expand_impl = "xla"
     if devices > 1:
         wire_pack = _env_wire_pack()
         delta_bits, sieve, predict = _env_sparse_planner()
@@ -1411,7 +1479,8 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
         engine=engine, lanes=lanes, planes=8,
         devices=devices, exchange=serve_exchange, wire_pack=wire_pack,
         delta_bits=delta_bits, sieve=sieve, predict=predict,
-        pull_gate=serve_pull_gate, resume_levels=resume_levels,
+        pull_gate=serve_pull_gate, expand_impl=serve_expand_impl,
+        resume_levels=resume_levels,
         audit_rate=audit_rate,
         audit_structural=audit_rate > 0 or audit_checksum,
         audit_checksum=audit_checksum,
@@ -1808,6 +1877,9 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
         # (serve_preheat_s + aot hit/fallback audit) rides along when
         # TPU_BFS_BENCH_AOT_DIR armed the A/B.
         "serve_cold_start_s": round(cold_start_s, 2),
+        # Kernel tier (ISSUE 16): which expansion tier the packed MS
+        # engines served with (a program-key axis of the AOT store).
+        "serve_expand_impl": serve_expand_impl,
         # Static HBM budget (ISSUE 13): modeled peak bytes per resident
         # ladder rung + the strict-monotonicity verdict the degrade
         # ladders depend on (BENCHMARKS.md "Serve HBM model").

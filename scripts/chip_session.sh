@@ -152,6 +152,17 @@ for i in $(seq 1 "$attempts"); do
       TPU_BFS_BENCH_ADAPTIVE=0
     stage "plain-s20" "$out/plain_s20.json" \
       TPU_BFS_BENCH_SCALE=20 TPU_BFS_BENCH_ADAPTIVE=0
+    # Pallas expansion-tier A/B (ISSUE 16, default OFF until these land):
+    # the fused bucketed-ELL kernel vs the fori form XLA fuses, at scale
+    # 21 and 20 against the same no-adaptive baselines as the pull-gate
+    # pairs (flagship-noadaptive / plain-s20). Bit-identical output
+    # (fuzz-pinned); the JSON lines carry expand_impl and the modeled
+    # per-level kernel bytes the roofline's VMEM-resident bound prices.
+    stage "pallas-expand-s21" "$out/pallas_expand_s21.json" \
+      TPU_BFS_BENCH_EXPAND_IMPL=pallas TPU_BFS_BENCH_ADAPTIVE=0
+    stage "pallas-expand-s20" "$out/pallas_expand_s20.json" \
+      TPU_BFS_BENCH_SCALE=20 TPU_BFS_BENCH_EXPAND_IMPL=pallas \
+      TPU_BFS_BENCH_ADAPTIVE=0
     # Serve-throughput A/B (ISSUE 3): the closed-loop lane-batching
     # query server at scale 20, adaptive (width ladder + pipelined
     # extraction — the defaults) vs fixed (one width, inline extraction

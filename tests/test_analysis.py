@@ -355,6 +355,38 @@ def test_dtype_pass_flags_i64_widening():
     assert findings and "int64" in findings[0].message
 
 
+def test_dtype_pass_sees_inside_pallas_kernel():
+    """ISSUE 16 red-before-green fixture: an f64 seeded INSIDE a pallas
+    kernel body — where the walk only reaches through ``pallas_call``'s
+    'jaxpr' param, a key the old scan/while/cond-specific key list never
+    visited — must be flagged like any other hot-path widening; the same
+    kernel without the widening is clean."""
+    from jax.experimental import pallas as pl
+
+    def call(body):
+        def fn(x):
+            return pl.pallas_call(
+                body,
+                out_shape=jax.ShapeDtypeStruct((8, 8), jnp.float32),
+                interpret=True,
+            )(x)
+        return fn
+
+    def bad(x_ref, o_ref):
+        o_ref[:] = (x_ref[:].astype(jnp.float64) * 2.0).astype(jnp.float32)
+
+    def good(x_ref, o_ref):
+        o_ref[:] = x_ref[:] * 2.0
+
+    x = np.ones((8, 8), np.float32)
+    with jax.experimental.enable_x64(True):
+        seeded = jax.make_jaxpr(call(bad))(x)
+        clean = jax.make_jaxpr(call(good))(x)
+    findings = dtypes.check_jaxpr("seeded-kernel-f64", seeded)
+    assert findings and "float64" in findings[0].message
+    assert dtypes.check_jaxpr("clean-kernel", clean) == []
+
+
 # --- transfer pass: seeded host-op fixture ----------------------------------
 
 

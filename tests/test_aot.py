@@ -244,6 +244,50 @@ def test_adopted_retrace_sentinel(exported_wide, graph):
     assert check_adopted_retrace("adopted", eng, drive) == []
 
 
+def test_program_key_expand_impl_axis():
+    """ISSUE 16 store-compat contract: ``expand_impl`` joins the program
+    key ONLY when non-default — every PR 9-era artifact (keyed without
+    the field) keeps adopting byte-for-byte, while a pallas engine can
+    never adopt an XLA-tier executable or vice versa."""
+    assert "expand_impl" not in aot.program_key(SPEC)
+    assert aot.program_key(dict(SPEC, expand_impl="xla")) == \
+        aot.program_key(SPEC)
+    pal = aot.program_key(dict(SPEC, expand_impl="pallas"))
+    assert pal["expand_impl"] == "pallas"
+    assert pal != aot.program_key(SPEC)
+
+
+@pytest.mark.slow
+def test_pallas_core_round_trip(graph, tmp_path):
+    """ISSUE 16: the kernel-tier core (an interpret-mode ``pallas_call``
+    in the exported artifact) export -> fresh-engine adopt round trip is
+    bit-identical and passes the adopted-retrace sentinel — the serve
+    path can preheat pallas engines from disk like any other."""
+    from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
+    from tpu_bfs.analysis.transfer import check_adopted_retrace
+
+    spec = dict(SPEC, lanes=32, expand_impl="pallas")
+    store = aot.ArtifactStore(tmp_path / "store")
+    eng = WidePackedMsBfsEngine(graph, lanes=32, num_planes=4,
+                                expand_impl="pallas")
+    names = aot.export_engine_programs(eng, spec, store)
+    assert "core" in names
+    base = eng.run(np.arange(32) % 96)
+    eng2 = WidePackedMsBfsEngine(graph, lanes=32, num_planes=4,
+                                 expand_impl="pallas")
+    assert aot.adopt_engine_programs(eng2, spec, store) == names
+    res = eng2.run(np.arange(32) % 96)
+    np.testing.assert_array_equal(res.ecc, base.ecc)
+    for i in (0, 7, 31):
+        np.testing.assert_array_equal(
+            res.distances_int32(i), base.distances_int32(i)
+        )
+    assert eng2._core.calls >= 1 and eng2._core.fallback_calls == 0
+    assert check_adopted_retrace(
+        "pallas-wide", eng2, lambda e: e.run(np.arange(32) % 96)
+    ) == []
+
+
 # --- slow arms ------------------------------------------------------------
 
 

@@ -1,0 +1,157 @@
+"""The fused gated bucketed-ELL expansion kernel (ISSUE 16;
+ops/ell_expand.py) vs its NumPy oracle, in interpret mode — plus the
+call-boundary width contract the kernels share (ops/tile_spmm.py lifts
+its old w=128-only restriction onto the same validator)."""
+
+import numpy as np
+import pytest
+
+from tpu_bfs.ops.ell_expand import (
+    KERNEL_OPS,
+    MINPLUS_IDENT,
+    TILE,
+    KernelWidthError,
+    ell_expand,
+    ell_expand_hbm_bytes,
+    ell_expand_reference,
+    validate_kernel_width,
+)
+
+
+def _case(rng, *, k, nb, rows, w, op):
+    """A seeded random bucket: gt indices over [0, rows), fw table of the
+    op's dtype (minplus distances stay < MINPLUS_IDENT so sums cannot
+    overflow), optional per-slot weights."""
+    gt = rng.integers(0, rows, size=(k, nb * TILE)).astype(np.int32)
+    if op == "minplus":
+        fw = rng.integers(0, MINPLUS_IDENT, size=(rows, w)).astype(np.int32)
+        fw[rows - 1] = MINPLUS_IDENT  # the engines' sentinel identity row
+        wt = rng.integers(0, 64, size=(k, nb * TILE)).astype(np.int32)
+    else:
+        fw = rng.integers(0, 2**32, size=(rows, w), dtype=np.uint64).astype(
+            np.uint32
+        )
+        fw[rows - 1] = 0 if op == "or" else 0xFFFFFFFF
+        wt = None
+    return gt, fw, wt
+
+
+@pytest.mark.parametrize("op", sorted(KERNEL_OPS))
+@pytest.mark.parametrize("w", [1, 8])
+def test_kernel_matches_oracle_ungated(op, w):
+    rng = np.random.default_rng(5)
+    k, nb, rows = 4, 3, 2 * TILE
+    gt, fw, wt = _case(rng, k=k, nb=nb, rows=rows, w=w, op=op)
+    need = np.ones(nb, np.int32)
+    got = np.asarray(
+        ell_expand(need, gt, fw, wt, w=w, op=op, interpret=True)
+    )
+    want = ell_expand_reference(need, gt, fw, wt, w=w, op=op)
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("op", sorted(KERNEL_OPS))
+def test_kernel_matches_oracle_gated(op):
+    """Gated-out tiles produce exactly the op identity (the in-kernel
+    settled-mask skip is bit-identical to the XLA masked path); computed
+    tiles are untouched by their gated neighbors."""
+    rng = np.random.default_rng(7)
+    k, nb, rows, w = 3, 5, 3 * TILE, 4
+    gt, fw, wt = _case(rng, k=k, nb=nb, rows=rows, w=w, op=op)
+    need = np.array([1, 0, 1, 0, 0], np.int32)
+    got = np.asarray(
+        ell_expand(need, gt, fw, wt, w=w, op=op, interpret=True)
+    )
+    want = ell_expand_reference(need, gt, fw, wt, w=w, op=op)
+    np.testing.assert_array_equal(got, want)
+    ident, _ = KERNEL_OPS[op]
+    for j in np.flatnonzero(need == 0):
+        assert (got[j * TILE : (j + 1) * TILE] == ident).all()
+    # The all-gated call never touches the tables at all.
+    dark = np.asarray(
+        ell_expand(np.zeros(nb, np.int32), gt, fw, wt, w=w, op=op,
+                   interpret=True)
+    )
+    assert (dark == ident).all()
+
+
+def test_kernel_k1_single_slab():
+    # k=1 exercises the no-lookahead edge of the double-buffer schedule.
+    rng = np.random.default_rng(9)
+    gt, fw, _ = _case(rng, k=1, nb=2, rows=TILE, w=2, op="or")
+    need = np.ones(2, np.int32)
+    np.testing.assert_array_equal(
+        np.asarray(ell_expand(need, gt, fw, w=2, op="or", interpret=True)),
+        ell_expand_reference(need, gt, fw, w=2, op="or"),
+    )
+
+
+def test_call_boundary_validation():
+    rng = np.random.default_rng(11)
+    gt, fw, wt = _case(rng, k=2, nb=1, rows=TILE, w=2, op="minplus")
+    need = np.ones(1, np.int32)
+    with pytest.raises(ValueError, match="op must be one of"):
+        ell_expand(need, gt, fw.astype(np.uint32), w=2, op="xor",
+                   interpret=True)
+    with pytest.raises(ValueError, match="minplus requires wt"):
+        ell_expand(need, gt, fw, w=2, op="minplus", interpret=True)
+    with pytest.raises(ValueError, match="minplus requires wt"):
+        ell_expand(need, gt, fw.astype(np.uint32), wt, w=2, op="or",
+                   interpret=True)
+    with pytest.raises(ValueError, match="not a multiple of 128"):
+        ell_expand(need, gt[:, :100], fw, wt, w=2, op="minplus",
+                   interpret=True)
+    with pytest.raises(ValueError, match="fw must be"):
+        ell_expand(need, gt, fw.astype(np.uint32), wt, w=2, op="minplus",
+                   interpret=True)
+
+
+def test_width_contract_shared_by_kernels():
+    """The shared validator: any w >= 1 under interpret; on TPU only
+    128-multiples — rejected AT THE CALL with the legal widths named,
+    not deep inside Mosaic lowering. ops/tile_spmm routes through the
+    same check, which LIFTS its former de-facto w=128-only contract
+    (any width in interpret mode) and turns the hardware restriction
+    into this clean error."""
+    validate_kernel_width(1, True, kernel="t")
+    validate_kernel_width(97, True, kernel="t")
+    validate_kernel_width(128, False, kernel="t")
+    validate_kernel_width(384, False, kernel="t")
+    for bad in (0, -4, 2.5, "128", None):
+        with pytest.raises(KernelWidthError, match="positive word count"):
+            validate_kernel_width(bad, True, kernel="t")
+    with pytest.raises(KernelWidthError) as ei:
+        validate_kernel_width(64, False, kernel="ell_expand")
+    msg = str(ei.value)
+    assert "multiples of 128" in msg and "interpret=True" in msg
+    assert "ell_expand" in msg  # names the kernel asked for
+
+    # tile_spmm enforces the identical contract at ITS boundary.
+    from tpu_bfs.ops.tile_spmm import tile_spmm
+
+    with pytest.raises(KernelWidthError, match="multiples of 128"):
+        tile_spmm(
+            np.zeros(2, np.int32), np.zeros(1, np.int32),
+            np.zeros((1, TILE // 32, TILE), np.uint32),
+            np.zeros((TILE, 64), np.uint32),
+            num_row_tiles=1, w=64, interpret=False,
+        )
+
+
+def test_hbm_bytes_model():
+    """The roofline attribution model: gated-out tiles pay only their
+    identity output write; the gate can never make a pass cost more."""
+    k, n, w = 4, 6 * TILE, 8
+    full = ell_expand_hbm_bytes(k, n, w)
+    assert full == 6 * (k * TILE * 4 + k * TILE * w * 4 + TILE * w * 4)
+    dark = ell_expand_hbm_bytes(k, n, w, active_tiles=0)
+    assert dark == 6 * TILE * w * 4
+    assert dark < ell_expand_hbm_bytes(k, n, w, active_tiles=3) < full
+    # Weighted adds exactly the weight slab per active tile.
+    assert (
+        ell_expand_hbm_bytes(k, n, w, weighted=True) - full
+        == 6 * k * TILE * 4
+    )
+    # Ragged n rounds up to whole tiles; oversized active_tiles clamps.
+    assert ell_expand_hbm_bytes(k, 5 * TILE + 1, w) == full
+    assert ell_expand_hbm_bytes(k, n, w, active_tiles=99) == full

@@ -332,6 +332,102 @@ def test_wire_pack_noop_on_packed_ms_engines():
         assert plain.last_exchange_bytes == packed.last_exchange_bytes
 
 
+def test_expand_impl_bit_identical():
+    """ISSUE 16 acceptance (tier-1 arm): the Pallas expansion tier is a
+    KERNEL substitution, never a semantic change — expand_impl='pallas'
+    (interpret mode on CPU) produces bit-identical distances AND parents
+    to the XLA fori tier on the wide engine, ungated and pull-gated,
+    and on the SSSP min-plus substrate; the gated kernel's skipped-tile
+    accounting matches the XLA gate's ``last_gate_level_counts`` exactly
+    (the in-kernel skip fires for precisely the tiles the mask names).
+    The hybrid/distributed sweep is the slow arm below."""
+    from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
+    from tpu_bfs.workloads.sssp import SsspEngine
+
+    g = random_graph(96, 480, seed=3)
+    rng = np.random.default_rng(17)
+    sources = np.asarray(_sources(g, rng, n=3))
+    golden = {int(s): bfs_scipy(g, int(s)) for s in sources}
+
+    kw = dict(lanes=32, num_planes=4)
+    xla = WidePackedMsBfsEngine(g, **kw)
+    pal = WidePackedMsBfsEngine(g, expand_impl="pallas", **kw)
+    assert pal.expand_impl == "pallas" and pal._interpret
+    xla_g = WidePackedMsBfsEngine(g, pull_gate=True, **kw)
+    pal_g = WidePackedMsBfsEngine(
+        g, pull_gate=True, expand_impl="pallas", **kw
+    )
+    r_x, r_p = xla.run(sources), pal.run(sources)
+    r_xg, r_pg = xla_g.run(sources), pal_g.run(sources)
+    for i, s in enumerate(sources):
+        validate.check_distances(r_p.distances_int32(i), golden[int(s)])
+        for ref, got in ((r_x, r_p), (r_xg, r_pg), (r_x, r_pg)):
+            np.testing.assert_array_equal(
+                ref.distances_int32(i), got.distances_int32(i)
+            )
+            np.testing.assert_array_equal(
+                ref.parents_int32(i), got.parents_int32(i)
+            )
+    np.testing.assert_array_equal(
+        xla_g.last_gate_level_counts, pal_g.last_gate_level_counts
+    )
+
+    # SSSP: the min-plus kernel against the XLA delta-stepping core.
+    gw = random_graph(96, 480, seed=3, weights=5)
+    s_x = SsspEngine(gw, lanes=8).run(sources)
+    s_p = SsspEngine(gw, lanes=8, expand_impl="pallas").run(sources)
+    for i in range(len(sources)):
+        np.testing.assert_array_equal(
+            s_x.distances_int32(i), s_p.distances_int32(i)
+        )
+
+
+@pytest.mark.slow
+def test_expand_impl_bit_identical_full():
+    """ISSUE 16 slow arm: the same bit-identity bar across the rest of
+    the packed family — hybrid (residual tier under both pull_gate
+    modes, on the RMAT shape its dense tiles exist for), dist-wide, and
+    dist-hybrid sliced on a 2-device mesh."""
+    from tpu_bfs.algorithms.msbfs_hybrid import HybridMsBfsEngine
+    from tpu_bfs.parallel.dist_bfs import make_mesh
+    from tpu_bfs.parallel.dist_msbfs_hybrid import DistHybridMsBfsEngine
+    from tpu_bfs.parallel.dist_msbfs_wide import DistWideMsBfsEngine
+
+    g = rmat_graph(8, 10, seed=103)
+    rng = np.random.default_rng(19)
+    sources = np.asarray(_sources(g, rng, n=3))
+    golden = {int(s): bfs_scipy(g, int(s)) for s in sources}
+
+    kw = dict(lanes=64, num_planes=8, tile_thr=4)
+    pairs = [
+        (HybridMsBfsEngine(g, **kw),
+         HybridMsBfsEngine(g, expand_impl="pallas", **kw)),
+        (HybridMsBfsEngine(g, pull_gate=True, **kw),
+         HybridMsBfsEngine(g, pull_gate=True, expand_impl="pallas", **kw)),
+        (DistWideMsBfsEngine(g, make_mesh(2), lanes=32, num_planes=8),
+         DistWideMsBfsEngine(g, make_mesh(2), lanes=32, num_planes=8,
+                             expand_impl="pallas")),
+        (DistHybridMsBfsEngine(g, make_mesh(2), tile_thr=4,
+                               exchange="sliced"),
+         DistHybridMsBfsEngine(g, make_mesh(2), tile_thr=4,
+                               exchange="sliced", expand_impl="pallas")),
+    ]
+    for xla_eng, pal_eng in pairs:
+        r_x, r_p = xla_eng.run(sources), pal_eng.run(sources)
+        for i, s in enumerate(sources):
+            validate.check_distances(
+                r_p.distances_int32(i), golden[int(s)]
+            )
+            np.testing.assert_array_equal(
+                r_x.distances_int32(i), r_p.distances_int32(i)
+            )
+        gate = getattr(xla_eng, "last_gate_level_counts", None)
+        if gate is not None:
+            np.testing.assert_array_equal(
+                gate, pal_eng.last_gate_level_counts
+            )
+
+
 # Serving must be batch-composition-invariant: a query's answer can
 # never depend on which batch-mates the scheduler happened to coalesce
 # it with (lanes are independent by construction; this arm pins the

@@ -127,6 +127,45 @@ def test_byte_model_covers_attributed_phases(small_graph, adaptive_engine):
     assert phase_bytes(adaptive_engine, nz_rows=20)["push"] > b["push"]
 
 
+@pytest.mark.slow  # two extra engine builds + interpret-mode stepping
+def test_pallas_tier_attribution(small_graph):
+    """ISSUE 16: on a kernel-tier engine the roofline (a) steps the
+    engine's ACTUAL residual slice (distances stay oracle-correct after
+    instrumentation), (b) attributes modeled HBM bytes per kernel with
+    a consistent level_total, and (c) reports the VMEM-resident bound;
+    an XLA-tier engine reports none of it."""
+    from tpu_bfs.reference import bfs_scipy
+    from tpu_bfs.utils.roofline import pallas_expand_bytes
+
+    sources = _sources(small_graph, 64)
+    eng = HybridMsBfsEngine(
+        small_graph, lanes=64, num_planes=4, expand_impl="pallas"
+    )
+    report = roofline_hybrid(eng, sources, measured_gteps=1.0)
+    assert report["expand_impl"] == "pallas"
+    kb = report["expand_kernel_bytes"]
+    assert kb["level_total"] == sum(
+        v for k, v in kb.items() if k != "level_total"
+    ) > 0
+    assert report["expand_kernel_t_at_peak_bw_s"] > 0
+    assert report["hbm_bytes_total"] > 0
+    res = eng.run(sources)
+    for i in (0, 63):
+        np.testing.assert_array_equal(
+            res.distances_int32(i), bfs_scipy(small_graph, int(sources[i]))
+        )
+    # The XLA tier carries no kernel attribution (and the helper is
+    # explicitly empty for it — bench keys can never lie about the tier).
+    xla = HybridMsBfsEngine(small_graph, lanes=64, num_planes=4)
+    assert pallas_expand_bytes(xla) == {}
+    assert "expand_kernel_bytes" not in roofline_hybrid(xla, sources)
+    # Gated-out tiles cost only their output writes: the all-gated model
+    # is strictly below the full one.
+    full = sum(pallas_expand_bytes(eng).values())
+    dark = sum(pallas_expand_bytes(eng, active_tiles=0).values())
+    assert 0 < dark < full
+
+
 def test_distributed_ms_exchange_entry(small_graph):
     # Distributed MS engines get a per-level WIRE-bytes 'exchange' entry
     # (the dense slab-gather ceiling), priced by the SAME

@@ -677,6 +677,234 @@ def expand_arrays(ell_like) -> dict:
     return arrs
 
 
+#: Legal ``expand_impl`` values (ISSUE 16). ``xla`` is the fori-loop jnp
+#: form XLA fuses; ``pallas`` is the hand-written gather-combine kernel
+#: (ops/ell_expand.py) — bit-identical by construction, selected per
+#: engine and A/B-priced by the roofline before any default flips.
+EXPAND_IMPLS = ("xla", "pallas")
+
+#: jnp combine per symbolic kernel op (the fold pyramid runs outside the
+#: kernel and needs the callable form back).
+_OP_COMBINE = {"or": jnp.bitwise_or, "min": jnp.minimum,
+               "minplus": jnp.minimum}
+
+
+def validate_expand_impl(impl: str, *, who: str = "expand_impl") -> str:
+    if impl not in EXPAND_IMPLS:
+        raise ValueError(
+            f"{who} must be one of {EXPAND_IMPLS}, got {impl!r}"
+        )
+    return impl
+
+
+def _pallas_op_of(combine, identity: int) -> str:
+    """Map make_fori_expand's combine/identity callable contract onto the
+    kernel's symbolic op names (a Pallas kernel cannot close over a jnp
+    callable, so the contract goes symbolic at this boundary)."""
+    if (combine is None or combine is jnp.bitwise_or) and identity == 0:
+        return "or"
+    if combine is jnp.minimum and identity == 0xFFFFFFFF:
+        return "min"
+    raise ValueError(
+        "expand_impl='pallas' supports combine/identity pairs "
+        "(bitwise_or, 0), (minimum, 0xFFFFFFFF) and the SSSP min-plus "
+        f"form; got ({combine}, {identity:#x})"
+    )
+
+
+def pallas_expand_arrays(ell_like, sentinel: int) -> dict:
+    """Host-side sentinel-padded whole-block index tables for the Pallas
+    expansion tier (numpy int32; callers device-put/stack as their layout
+    needs). Same pad_gate_blocks layout the pull gate's light tables use
+    — when both tiers are on, the ``light{i}_gt`` tables are shared —
+    plus ``virtual_gt`` so the heavy section runs through the kernel too.
+    ``sentinel`` must gather the engine's identity frontier row."""
+    from tpu_bfs.graph.ell import pad_gate_blocks
+
+    arrs = {}
+    if ell_like.virtual is not None:
+        arrs["virtual_gt"] = pad_gate_blocks(
+            np.ascontiguousarray(ell_like.virtual.idx.T), sentinel
+        )
+    for i, b in enumerate(ell_like.light):
+        arrs[f"light{i}_gt"] = pad_gate_blocks(
+            np.ascontiguousarray(b.idx.T), sentinel
+        )
+    return arrs
+
+
+def make_pallas_expand(spec: "ExpandSpec", w: int, *, op: str = "or",
+                       interpret: bool = False, wsuf: str | None = None):
+    """make_fori_expand's drop-in built on the fused Pallas kernel
+    (ops/ell_expand.py): per bucket, ONE kernel launch whose accumulator
+    stays VMEM-resident across all k ELL slots with double-buffered row
+    gathers — each output row tile hits HBM once per level. The heavy
+    fold pyramid and heavy_pick stay jnp (cheap permutation work over the
+    kernel's virtual-row output). Requires the ``virtual_gt``/
+    ``light{i}_gt`` tables (pallas_expand_arrays); ``wsuf`` selects the
+    SSSP min-plus weight planes (``{name}_{wsuf}_gt``) when op='minplus'.
+
+    Returns ``expand(arrs, fw)`` — same signature, bit-identical output.
+    """
+    from tpu_bfs.ops.ell_expand import KERNEL_OPS, TILE, ell_expand
+
+    combine = _OP_COMBINE[op]
+    ident_val, dt = KERNEL_OPS[op]
+    T = TILE
+
+    def _full(shape):
+        return jnp.full(shape, ident_val, dt)
+
+    def _bucket(arrs, fw, name, k, n, need_blk=None):
+        gt = arrs[f"{name}_gt"]  # [k, nb*T]
+        nb = gt.shape[1] // T
+        if need_blk is None:
+            need_blk = jnp.ones((nb,), jnp.int32)
+        wt = arrs[f"{name}_{wsuf}_gt"] if op == "minplus" else None
+        out = ell_expand(
+            need_blk, gt, fw, wt, w=w, op=op, interpret=interpret
+        )
+        return out[:n]
+
+    def expand(arrs, fw):
+        parts = []
+        if spec.heavy:
+            acc = _bucket(
+                arrs, fw, "virtual", spec.kcap, spec.num_virtual
+            )
+            vr_ext = jnp.concatenate([acc, _full((1, w))])
+            cur = vr_ext[arrs["fold_pad_map"]]
+            pyramid = [cur]
+            for _ in range(spec.fold_steps):
+                pairs = cur.reshape(-1, 2, w)
+                cur = combine(pairs[:, 0], pairs[:, 1])
+                pyramid.append(cur)
+            pyr = jnp.concatenate(pyramid) if len(pyramid) > 1 else pyramid[0]
+            parts.append(pyr[arrs["heavy_pick"]])
+        for i, (k, n) in enumerate(spec.light_meta):
+            parts.append(_bucket(arrs, fw, f"light{i}", k, n))
+        if spec.tail_rows:
+            parts.append(_full((spec.tail_rows, w)))
+        return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+
+    return expand
+
+
+def make_gated_pallas_expand(spec: "ExpandSpec", w: int, *, op: str = "or",
+                             interpret: bool = False):
+    """make_gated_fori_expand's drop-in on the Pallas tier: the PR 1
+    settled-mask gate moves INSIDE the kernel — the per-GATE_TILE block
+    mask rides the scalar-prefetch channel and a gated-out tile skips its
+    index DMA and gathers entirely, writing the combine identity. The
+    gate POLICY is unchanged and computed outside in jnp (same block
+    mask, same GATE_DENSE_DEN dense fallback — expressed as an all-ones
+    mask rather than a lax.cond branch — and the same whole-section heavy
+    skip), so ``skipped_blocks`` matches the XLA tier count-for-count and
+    ``last_gate_level_counts`` stays comparable across impls.
+
+    Returns ``expand(arrs, fw, needed) -> (outputs, skipped_blocks)``.
+    """
+    from tpu_bfs.ops.ell_expand import KERNEL_OPS, TILE, ell_expand
+
+    combine = _OP_COMBINE[op]
+    ident_val, dt = KERNEL_OPS[op]
+    T = TILE
+
+    def _full(shape):
+        return jnp.full(shape, ident_val, dt)
+
+    heavy_blocks = -(-spec.num_virtual // T) if spec.heavy else 0
+
+    def expand(arrs, fw, needed):
+        parts = []
+        skipped = jnp.int32(0)
+        off = 0
+        if spec.heavy:
+            nh = arrs["heavy_pick"].shape[0]
+            gt = arrs["virtual_gt"]
+            nvb = gt.shape[1] // T
+
+            def heavy_section():
+                acc = ell_expand(
+                    jnp.ones((nvb,), jnp.int32), gt, fw,
+                    w=w, op=op, interpret=interpret,
+                )[: spec.num_virtual]
+                vr_ext = jnp.concatenate([acc, _full((1, w))])
+                cur = vr_ext[arrs["fold_pad_map"]]
+                pyramid = [cur]
+                for _ in range(spec.fold_steps):
+                    pairs = cur.reshape(-1, 2, w)
+                    cur = combine(pairs[:, 0], pairs[:, 1])
+                    pyramid.append(cur)
+                pyr = (
+                    jnp.concatenate(pyramid) if len(pyramid) > 1 else pyramid[0]
+                )
+                return pyr[arrs["heavy_pick"]]
+
+            h_need = jnp.any(needed[:nh])
+            parts.append(
+                jax.lax.cond(h_need, heavy_section, lambda: _full((nh, w)))
+            )
+            skipped = skipped + jnp.where(h_need, 0, heavy_blocks)
+            off = nh
+        for i, (k, n) in enumerate(spec.light_meta):
+            gt = arrs[f"light{i}_gt"]  # [k, nb*T] sentinel-padded
+            nb = gt.shape[1] // T
+            need = needed[off : off + n]
+            pad = nb * T - n
+            if pad:
+                need = jnp.concatenate([need, jnp.zeros((pad,), bool)])
+            blk = jnp.any(need.reshape(nb, T), axis=1)
+            nzb = jnp.sum(blk.astype(jnp.int32))
+            take_gated = nzb * GATE_DENSE_DEN <= nb
+            # Dense fallback = an all-ones mask: the kernel computes every
+            # tile, which IS the dense pass (identical combines, one
+            # output write either way) — no second code path to diverge.
+            mask = jnp.where(take_gated, blk, True).astype(jnp.int32)
+            out = ell_expand(
+                mask, gt, fw, w=w, op=op, interpret=interpret
+            )
+            parts.append(out[:n])
+            skipped = skipped + jnp.where(take_gated, nb - nzb, 0)
+            off += n
+        if spec.tail_rows:
+            parts.append(_full((spec.tail_rows, w)))
+        out = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+        return out, skipped
+
+    return expand
+
+
+def make_expand(spec: "ExpandSpec", w: int, *, combine=None,
+                identity: int = 0, impl: str = "xla",
+                interpret: bool = False):
+    """The expand_impl dispatcher every packed engine builds through:
+    ``xla`` -> make_fori_expand (ignores ``interpret``), ``pallas`` ->
+    make_pallas_expand with the combine contract mapped to a kernel op.
+    Same ``expand(arrs, fw)`` either way."""
+    validate_expand_impl(impl)
+    if impl == "xla":
+        return make_fori_expand(spec, w, combine=combine, identity=identity)
+    return make_pallas_expand(
+        spec, w, op=_pallas_op_of(combine, identity), interpret=interpret
+    )
+
+
+def make_gated_expand(spec: "ExpandSpec", w: int, *, combine=None,
+                      identity: int = 0, impl: str = "xla",
+                      interpret: bool = False):
+    """Gated twin of make_expand: ``expand(arrs, fw, needed) ->
+    (outputs, skipped_blocks)`` with identical gate policy across impls."""
+    validate_expand_impl(impl)
+    if impl == "xla":
+        return make_gated_fori_expand(
+            spec, w, combine=combine, identity=identity
+        )
+    return make_gated_pallas_expand(
+        spec, w, op=_pallas_op_of(combine, identity), interpret=interpret
+    )
+
+
 def build_push_table(host_graph, rank: np.ndarray, act: int, deg_cap: int):
     """Out-CSR push table in rank space for the level-adaptive expansion:
     ``([act+1, deg_cap] int32 out-neighbor rows (pad/sentinel = act),
@@ -1821,6 +2049,26 @@ def packed_aot_programs(engine):
         ("lane_ecc", "_lane_ecc", engine._lane_ecc, (planes_s, fw_s, fw_s)),
     ]
     return progs
+
+
+def packed_analysis_programs(engine):
+    """Static-analyzer inventory for the single-chip packed engines
+    (tpu_bfs/analysis/configs.iter_programs contract): the level-loop
+    core under the engine's ACTUAL expansion tier, so a pallas-tier
+    core exposes its fused ``pallas_call`` body to the jaxpr walks and
+    compiled audits (ISSUE 16). Unlike the AOT inventory above, the
+    example args must be REAL device-resident arrays — the analyzer's
+    transfer-guard pass EXECUTES each program under
+    ``jax.transfer_guard('disallow')``, it does not just trace it."""
+    sources = np.arange(engine.lanes, dtype=np.int64) % engine.num_vertices
+    fw0 = engine._seed_dev(sources)
+    ml = jnp.int32(8)
+    if getattr(engine, "pull_gate", False):
+        rows = np.asarray(engine._rank)[sources]
+        mask = jnp.asarray(host_lane_mask(rows, engine._act, engine.w))
+        return [("core", engine._gate_core_jit,
+                 (engine.arrs, fw0, ml, mask))]
+    return [("core", engine._core, (engine.arrs, fw0, ml))]
 
 
 class PackedRunProtocol:

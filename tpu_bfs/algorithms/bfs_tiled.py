@@ -15,10 +15,14 @@ boolean frontier expansion
 
 as u32 AND + OR-reduce over contiguous words — measured ~1.3 ns per dense
 edge on v5e (10x the gather path) because the only indexed access is one
-[TILE]-row lookup per tile. (The Pallas MXU kernel itself is w=128-only:
-Mosaic rejects narrower frontier slabs, measured round 3 — so the narrow-
-batch MXU variant VERDICT r2 #2 proposed is closed off at the compiler,
-and this bitset pass is the working replacement on the same tiles.)
+[TILE]-row lookup per tile. (The Pallas MXU kernel needs w to be a
+multiple of 128 on hardware: Mosaic rejects narrower frontier slabs,
+measured round 3 — so the narrow-batch MXU variant VERDICT r2 #2
+proposed is closed off at the compiler, and this bitset pass is the
+working replacement on the same tiles. The restriction is now enforced
+at the call boundary with the legal widths named —
+ops/ell_expand.validate_kernel_width, shared with the ISSUE 16
+expansion kernel; any width still runs under interpret=True.)
 
 Level structure = direction-optimizing ladder (frontier.level_step_dopt's
 shape): light levels run sparse_topdown over the FULL adjacency; heavy

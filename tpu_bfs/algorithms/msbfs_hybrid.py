@@ -64,10 +64,13 @@ from tpu_bfs.algorithms._packed_common import (
     finish_packed_batch,
     floor_lanes,
     make_adaptive_hit,
-    make_fori_expand,
-    make_gated_fori_expand,
+    make_expand,
+    make_gated_expand,
     make_packed_loop,
     make_state_kernels,
+    pallas_expand_arrays,
+    validate_expand_impl,
+    packed_analysis_programs,
     packed_aot_programs,
     row_unsettled,
     seed_scatter_args,
@@ -330,7 +333,8 @@ def expand_spec(hg: HybridGraph) -> ExpandSpec:
 
 
 def _make_core(hg: HybridGraph, w: int, num_planes: int, interpret: bool,
-               push_cfg=None, gate_levels: int = 0):
+               push_cfg=None, gate_levels: int = 0,
+               expand_impl: str = "xla"):
     has_dense = hg.num_tiles > 0
 
     def dense_pass(arrs, fw):
@@ -346,7 +350,9 @@ def _make_core(hg: HybridGraph, w: int, num_planes: int, interpret: bool,
         # The dense MXU pass stays ungated — its tiles are already the
         # compacted hot set, and the Pallas grid takes no dynamic tile
         # list; its hits on settled rows are claim-masked like any other.
-        gated_residual = make_gated_fori_expand(expand_spec(hg), w)
+        gated_residual = make_gated_expand(
+            expand_spec(hg), w, impl=expand_impl, interpret=interpret
+        )
 
         def hit_of(arrs, fw, vis, lane_mask):
             need = row_unsettled(vis, hg.num_active, lane_mask)
@@ -363,7 +369,9 @@ def _make_core(hg: HybridGraph, w: int, num_planes: int, interpret: bool,
             hit_of, num_planes, gate_levels=gate_levels, act=hg.num_active
         )
 
-    expand_residual = make_fori_expand(expand_spec(hg), w)
+    expand_residual = make_expand(
+        expand_spec(hg), w, impl=expand_impl, interpret=interpret
+    )
 
     def hit_of(arrs, fw):
         hit = expand_residual(arrs, fw)[arrs["inv_perm_ext"]]
@@ -410,7 +418,10 @@ class HybridMsBfsEngine(PackedRunProtocol, PullGateHost,
         max_lanes: int = DEFAULT_MAX_LANES,
         adaptive_push: tuple[int, int] | None = None,
         pull_gate: bool = False,
+        expand_impl: str = "xla",
     ):
+        validate_expand_impl(expand_impl)
+        self.expand_impl = expand_impl
         if num_planes != "auto" and not (1 <= num_planes <= 8):
             # Validate the explicit case before the minutes-long build.
             raise ValueError("num_planes must be in [1, 8]")
@@ -526,6 +537,15 @@ class HybridMsBfsEngine(PackedRunProtocol, PullGateHost,
         self.w = lanes // 32
         self.lanes = lanes
         self.interpret = interpret
+        if expand_impl == "pallas":
+            from tpu_bfs.ops.ell_expand import validate_kernel_width
+
+            # The residual kernel shares the dense kernel's width law
+            # (w % 128 on real TPUs) but applies even on tile-free
+            # graphs, where the LanesDontFitError check above doesn't.
+            validate_kernel_width(
+                self.w, interpret, kernel="hybrid expand_impl='pallas'"
+            )
         self.adaptive_push = adaptive_push
         self.undirected = hg.undirected if undirected is None else undirected
         arrs = expand_arrays(hg)
@@ -542,6 +562,15 @@ class HybridMsBfsEngine(PackedRunProtocol, PullGateHost,
             arrs["push_inelig"] = jnp.asarray(inelig)
         self._act = hg.num_active
         self._table_rows = hg.vt * TILE
+        if expand_impl == "pallas":
+            # Kernel-side whole-block index tables for the residual
+            # buckets (sentinel = the all-zero pad row vt*TILE-1; the
+            # pull-gate branch below rebuilds the light tables
+            # identically when both tiers are on).
+            for name, tbl in pallas_expand_arrays(
+                hg, hg.vt * TILE - 1
+            ).items():
+                arrs[name] = jnp.asarray(tbl)
         self.pull_gate = pull_gate
         if pull_gate:
             # Gate tables: sentinel-padded whole-block bucket indices (the
@@ -565,14 +594,15 @@ class HybridMsBfsEngine(PackedRunProtocol, PullGateHost,
                 self._gate_core_from_donate_jit,
             ) = _make_core(
                 hg, self.w, num_planes, interpret,
-                gate_levels=self.max_levels_cap,
+                gate_levels=self.max_levels_cap, expand_impl=expand_impl,
             )
             self._core = self._gated_core
             self._core_from = self._gated_core_from
             self._core_from_donate = self._gated_core_from_donate
         else:
             self._core, self._core_from, self._core_from_donate = _make_core(
-                hg, self.w, num_planes, interpret, adaptive_push
+                hg, self.w, num_planes, interpret, adaptive_push,
+                expand_impl=expand_impl,
             )
         self.arrs = arrs
         in_deg_ranked = hg.in_degree[hg.old_of_new].astype(np.int32)
@@ -622,6 +652,14 @@ class HybridMsBfsEngine(PackedRunProtocol, PullGateHost,
         serving set — the MXU level-loop core (gated form carries the
         lane-mask arg), seed, lane stats, word extraction, lane ecc."""
         return packed_aot_programs(self)
+
+    def analysis_programs(self):
+        """Static-analyzer inventory (tpu_bfs/analysis): the level-loop
+        core with REAL example args, under the engine's ACTUAL
+        residual-expansion tier, so a pallas-tier core exposes its
+        ``pallas_call`` body to the jaxpr walks and compiled audits
+        (ISSUE 16)."""
+        return packed_analysis_programs(self)
 
     # --- checkpoint/resume (_packed_common; SURVEY.md §5: reference has none) ---
 

@@ -58,10 +58,13 @@ from tpu_bfs.algorithms._packed_common import (
     finish_packed_batch,
     PullGateHost,
     make_adaptive_hit,
-    make_fori_expand,
-    make_gated_fori_expand,
+    make_expand,
+    make_gated_expand,
     make_packed_loop,
+    pallas_expand_arrays,
+    validate_expand_impl,
     make_state_kernels,
+    packed_analysis_programs,
     packed_aot_programs,
     row_unsettled,
     seed_scatter_args,
@@ -87,7 +90,8 @@ from tpu_bfs.algorithms._packed_common import PackedBatchResult as WideBfsResult
 
 
 def _make_core(ell: EllGraph, w: int, num_planes: int, push_cfg=None,
-               gate_levels: int = 0):
+               gate_levels: int = 0, expand_impl: str = "xla",
+               interpret: bool = False):
     act = ell.num_active
     spec = ExpandSpec(
         kcap=ell.kcap,
@@ -103,7 +107,9 @@ def _make_core(ell: EllGraph, w: int, num_planes: int, push_cfg=None,
         # Pull gate (ISSUE 1): bucket outputs are table rows in order here
         # (no permutation), so the per-row unsettled mask IS the per-
         # bucket-output-row needed vector, no forward map required.
-        gated_expand = make_gated_fori_expand(spec, w)
+        gated_expand = make_gated_expand(
+            spec, w, impl=expand_impl, interpret=interpret
+        )
 
         def hit_of(arrs, fw, vis, lane_mask):
             need = row_unsettled(vis, act, lane_mask)
@@ -114,7 +120,7 @@ def _make_core(ell: EllGraph, w: int, num_planes: int, push_cfg=None,
         )
     # fw is [act+1, w]: frontier bits; sentinel row act is all-zero and is
     # never written (expand emits zero there, and `& ~vis` keeps it zero).
-    expand = make_fori_expand(spec, w)
+    expand = make_expand(spec, w, impl=expand_impl, interpret=interpret)
     if push_cfg is None:
         return make_packed_loop(expand, num_planes)
     # Level-adaptive expansion (experimental): see
@@ -154,9 +160,18 @@ class WidePackedMsBfsEngine(PackedRunProtocol, PullGateHost,
         max_lanes: int = DEFAULT_MAX_LANES,
         adaptive_push: tuple[int, int] | None = None,
         pull_gate: bool = False,
+        expand_impl: str = "xla",
+        interpret: bool | None = None,
     ):
         if not (1 <= num_planes <= 8):
             raise ValueError("num_planes must be in [1, 8]")
+        validate_expand_impl(expand_impl)
+        if interpret is None:
+            # Same resolution as the hybrid engine's tile kernel: emulate
+            # the Pallas tier off-TPU so CPU tests drive the real kernel.
+            interpret = jax.default_backend() != "tpu"
+        self.expand_impl = expand_impl
+        self._interpret = bool(interpret)
         if pull_gate and adaptive_push is not None:
             # Both gate the same per-level scan, by different keys (settled
             # destinations vs light frontiers); composing them is a
@@ -214,6 +229,19 @@ class WidePackedMsBfsEngine(PackedRunProtocol, PullGateHost,
         self.undirected = self.ell.undirected if undirected is None else undirected
         ell = self.ell
         self.arrs = expand_arrays(ell)
+        if expand_impl == "pallas":
+            from tpu_bfs.ops.ell_expand import validate_kernel_width
+
+            # Fail at build with the legal widths named, not at first
+            # dispatch inside Mosaic lowering.
+            validate_kernel_width(
+                self.w, self._interpret, kernel="wide expand_impl='pallas'"
+            )
+            # Sentinel-padded whole-block tables the kernel DMAs (shared
+            # layout with the pull gate's light tables; sentinel = the
+            # all-zero row act).
+            for name, tbl in pallas_expand_arrays(ell, self._act).items():
+                self.arrs[name] = jnp.asarray(tbl)
         if adaptive_push is not None:
             self._build_push_table(adaptive_push)
         self._table_rows = self._act + 1  # + the all-zero sentinel row
@@ -235,14 +263,16 @@ class WidePackedMsBfsEngine(PackedRunProtocol, PullGateHost,
                 self._gate_core_jit, self._gate_core_from_jit,
                 self._gate_core_from_donate_jit,
             ) = _make_core(
-                ell, self.w, num_planes, gate_levels=self.max_levels_cap
+                ell, self.w, num_planes, gate_levels=self.max_levels_cap,
+                expand_impl=expand_impl, interpret=self._interpret,
             )
             self._core = self._gated_core
             self._core_from = self._gated_core_from
             self._core_from_donate = self._gated_core_from_donate
         else:
             self._core, self._core_from, self._core_from_donate = _make_core(
-                ell, self.w, num_planes, adaptive_push
+                ell, self.w, num_planes, adaptive_push,
+                expand_impl=expand_impl, interpret=self._interpret,
             )
         in_deg_ranked = ell.in_degree[ell.old_of_new].astype(np.int32)
         (
@@ -302,6 +332,14 @@ class WidePackedMsBfsEngine(PackedRunProtocol, PullGateHost,
         serving set — level-loop core (gated form carries the lane-mask
         arg), seed, lane stats, lazy word extraction, lane ecc."""
         return packed_aot_programs(self)
+
+    def analysis_programs(self):
+        """Static-analyzer hook (tpu_bfs/analysis): the level-loop core
+        with REAL example args, under the engine's ACTUAL expansion tier
+        — a pallas engine's core carries the fused ``pallas_call``, so
+        the dtype/uniformity jaxpr walks and the compiled audits see
+        inside the kernel body (ISSUE 16)."""
+        return packed_analysis_programs(self)
 
     # --- checkpoint/resume (_packed_common; SURVEY.md §5: reference has none) ---
 

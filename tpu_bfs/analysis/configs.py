@@ -142,6 +142,18 @@ def _build_engine(config: str):
             # analysis_programs expose the delta-stepping core (dtype +
             # donation certificate), the khop-bounded base core, the CC
             # label fold, and the p2p pair reductions.
+            # Pallas kernel-tier configs (ISSUE 16): the SAME serve specs
+            # with expand_impl='pallas' — the analyzed core then carries
+            # the fused bucketed-ELL ``pallas_call`` (interpret mode on
+            # the CPU mesh), so every pass walks the kernel body: 'or'
+            # accumulate via serve-wide-pallas, min-plus via
+            # serve-sssp-pallas.
+            "serve-wide-pallas": dict(
+                engine="wide", lanes=64, expand_impl="pallas",
+            ),
+            "serve-sssp-pallas": dict(
+                kind="sssp", engine="wide", lanes=32, expand_impl="pallas",
+            ),
             "serve-sssp": dict(kind="sssp", engine="wide", lanes=32),
             "serve-khop": dict(kind="khop", engine="wide", lanes=64),
             "serve-cc": dict(kind="cc", engine="wide", lanes=64),
@@ -168,6 +180,7 @@ ALL_CONFIGS = (
     "hybrid-dense", "hybrid-sparse", "hybrid-sliced",
     "serve-dist-wide", "serve-dist-hybrid", "serve-dist2d",
     "serve-sssp", "serve-khop", "serve-cc", "serve-p2p",
+    "serve-wide-pallas", "serve-sssp-pallas",
 )
 
 

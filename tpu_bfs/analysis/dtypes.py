@@ -22,13 +22,27 @@ def _is_wide(aval) -> str | None:
     return name if name in _WIDE else None
 
 
+def _jaxprs_in(v):
+    """Yield every (possibly nested) jaxpr inside a param value: bare
+    Jaxprs, ClosedJaxprs, and tuples/lists of either."""
+    if hasattr(v, "eqns"):
+        yield v
+    elif hasattr(v, "jaxpr") and hasattr(v.jaxpr, "eqns"):
+        yield v.jaxpr
+    elif isinstance(v, (tuple, list)):
+        for x in v:
+            yield from _jaxprs_in(x)
+
+
 def _sub_jaxprs(eqn):
-    for key in ("jaxpr", "call_jaxpr", "cond_jaxpr", "body_jaxpr"):
-        v = eqn.params.get(key)
-        if v is not None:
-            yield v.jaxpr if hasattr(v, "jaxpr") else v
-    for b in eqn.params.get("branches", ()):
-        yield b.jaxpr
+    # Generic param walk, not a fixed key list: the lint must see INSIDE
+    # every sub-program — scan/while/cond carry theirs under jaxpr/
+    # cond_jaxpr/body_jaxpr/branches, ``pallas_call`` carries the kernel
+    # body under 'jaxpr' (ISSUE 16: an f64 seeded inside a kernel must
+    # be flagged like any other hot-path widening), and future
+    # primitives pick their own names.
+    for v in eqn.params.values():
+        yield from _jaxprs_in(v)
 
 
 def scan_jaxpr(name: str, jaxpr, findings: list[Finding],

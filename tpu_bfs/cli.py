@@ -147,6 +147,8 @@ def _make_ms_engine(args, g, n_sources: int):
     lanes_kw = {} if args.lanes is None else {"lanes": args.lanes}
     if args.pull_gate:
         lanes_kw["pull_gate"] = True
+    if args.expand_impl != "xla":
+        lanes_kw["expand_impl"] = args.expand_impl
     if args.devices > 1 and args.wire_pack:
         # The packed MS engines' wire format is already one bit per
         # (vertex, lane); the flag is accepted for knob uniformity and
@@ -207,8 +209,9 @@ def _make_ms_engine(args, g, n_sources: int):
         if engine == "packed" and (args.ckpt or args.resume):
             # Checkpointing needs resumable packed state (wide/hybrid).
             engine = "wide"
-        if engine == "packed" and args.pull_gate:
-            # The gate lives in the wide/hybrid machinery only.
+        if engine == "packed" and (args.pull_gate or args.expand_impl != "xla"):
+            # The gate and the kernel tier live in the wide/hybrid
+            # machinery only.
             engine = "hybrid"
     if engine == "packed":
         from tpu_bfs.algorithms.msbfs_packed import PackedMsBfsEngine
@@ -218,6 +221,12 @@ def _make_ms_engine(args, g, n_sources: int):
                 "--pull-gate applies to the wide/hybrid engines (the "
                 "512-lane packed engine keeps no settled-mask state); use "
                 "--engine wide or hybrid"
+            )
+        if args.expand_impl != "xla":
+            raise SystemExit(
+                "--expand-impl pallas applies to the wide/hybrid engines "
+                "(the 512-lane packed engine runs no bucketed-ELL pull "
+                "loop); use --engine wide or hybrid"
             )
         lanes = (
             args.lanes
@@ -572,6 +581,17 @@ def main(argv=None) -> int:
                     "scan. Applies to --multi-source wide/hybrid engines "
                     "(single device or --devices N hybrid) and --backend "
                     "tiled; --stats adds per-level gated_tiles counts")
+    ap.add_argument("--expand-impl", default="xla",
+                    choices=("xla", "pallas"),
+                    help="pull-expansion tier for the packed MS engines "
+                    "(default xla): 'xla' keeps the fori-loop gather the "
+                    "compiler fuses; 'pallas' runs the fused bucketed-ELL "
+                    "kernel (ops/ell_expand) — double-buffered index-slab "
+                    "DMA, VMEM-resident accumulator, one HBM write per "
+                    "128-row tile per level, settled-mask gating inside "
+                    "the kernel under --pull-gate. Bit-identical output; "
+                    "--multi-source wide/hybrid engines (single device or "
+                    "--devices N)")
     ap.add_argument("--adaptive-push", default=None, metavar="ROWS,DEG",
                     help="experimental level-adaptive expansion for "
                     "--engine wide|hybrid (single device): levels with "
@@ -647,6 +667,10 @@ def main(argv=None) -> int:
     if args.pull_gate and args.adaptive_push is not None:
         ap.error("--pull-gate and --adaptive-push cannot combine (both "
                  "gate the per-level scan; measure them separately)")
+    if args.expand_impl != "xla" and not args.multi_source:
+        ap.error("--expand-impl pallas fuses the packed MS engines' "
+                 "bucketed-ELL pull expansion; pair it with --multi-source "
+                 "(single-source backends run no ELL pull loop)")
     if args.pull_gate and not args.multi_source and (
         args.backend != "tiled" or args.mesh or args.devices > 1
     ):

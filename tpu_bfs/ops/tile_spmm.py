@@ -42,6 +42,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from tpu_bfs.ops.ell_expand import validate_kernel_width
+
 TILE = 128  # tile edge (rows and cols) == MXU systolic dimension
 AW = TILE // 32  # u32 words per packed A-tile row
 
@@ -152,7 +154,16 @@ def tile_spmm(
     w: int = 128,
     interpret: bool = False,
 ):
-    """hit contribution [NR*TILE, w] u32 of all dense tiles (bit-major lanes)."""
+    """hit contribution [NR*TILE, w] u32 of all dense tiles (bit-major lanes).
+
+    Width contract at the call boundary (shared with ops/ell_expand):
+    any ``w >= 1`` under ``interpret=True``; on a real TPU ``w`` must be
+    a multiple of 128 (the Mosaic lane tiling the VMEM blocks and DMA
+    slices are laid out in). A bad width raises ``KernelWidthError``
+    naming the legal widths HERE instead of a Mosaic lowering error
+    from inside the compiled program.
+    """
+    validate_kernel_width(w, interpret, kernel="tile_spmm")
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(num_row_tiles,),

@@ -76,10 +76,11 @@ from tpu_bfs.algorithms._packed_common import (
     PackedRunProtocol,
     PullGateHost,
     lazy_full_parent_ell,
-    make_fori_expand,
-    make_gated_fori_expand,
+    make_expand,
+    make_gated_expand,
     make_state_kernels,
     seed_scatter_args,
+    validate_expand_impl,
 )
 from tpu_bfs.algorithms.msbfs_hybrid import fill_a_tiles, select_dense_tiles
 from tpu_bfs.ops.tile_spmm import AW, TILE, tile_spmm
@@ -475,12 +476,15 @@ def _make_dist_core(
     hd, w: int, num_planes: int, mesh: Mesh, interpret: bool,
     exchange: str = "dense", sparse_caps: tuple[int, ...] = (),
     gate_levels: int = 0, delta_bits: tuple[int, ...] = (),
+    expand_impl: str = "xla",
 ):
     p_count = mesh.devices.size
     rows = hd["rows"]
     nrt = hd["vt"] // p_count
     rows_loc = nrt * TILE
-    expand = make_fori_expand(hd["res_spec"], w)
+    expand = make_expand(
+        hd["res_spec"], w, impl=expand_impl, interpret=interpret
+    )
     has_dense = hd["num_tiles"] > 0
     nb = (
         rows_gather_branch_count(sparse_caps, delta_bits)
@@ -503,8 +507,10 @@ def _make_dist_core(
     # would deadlock chips that disagree — that is the "where legal" line.
     gated = gate_levels > 0
     gated_expand = (
-        make_gated_fori_expand(hd["res_spec"], w) if gated and not sliced
-        else None
+        make_gated_expand(
+            hd["res_spec"], w, impl=expand_impl, interpret=interpret
+        )
+        if gated and not sliced else None
     )
 
     def _global_any(x):
@@ -524,7 +530,8 @@ def _make_dist_core(
         still processed exactly once per level."""
         res_keys = [
             k for k in arrs
-            if k.startswith("light") or k in ("virtual_t", "fold_pad_map", "heavy_pick")
+            if k.startswith("light")
+            or k in ("virtual_t", "virtual_gt", "fold_pad_map", "heavy_pick")
         ]
         step_keys = res_keys + ["perm"] + (
             ["row_start", "col_tile", "a_tiles"] if has_dense else []
@@ -811,9 +818,12 @@ class DistHybridMsBfsEngine(
         pull_gate: bool = False,
         wire_pack: bool = False,
         delta_bits: tuple[int, ...] = (),
+        expand_impl: str = "xla",
     ):
         if not (1 <= num_planes <= 8):
             raise ValueError("num_planes must be in [1, 8]")
+        validate_expand_impl(expand_impl)
+        self.expand_impl = expand_impl
         if delta_bits and exchange != "sparse":
             raise ValueError(
                 "delta_bits compresses the SPARSE row gather's id stream "
@@ -924,11 +934,40 @@ class DistHybridMsBfsEngine(
             ])
         if pull_gate:
             self._lane_mask_dev = jnp.full((self.w,), 0xFFFFFFFF, jnp.uint32)
+        if expand_impl == "pallas":
+            # Kernel-side whole-block index tables, per shard (gather
+            # layout: [P, k, nb*T], sentinel = the gathered table's pad
+            # row rows-1) or per (shard, ring step) (sliced layout:
+            # [P, P, k, nb*T], sentinel = the appended zero row rows_loc).
+            # The pull-gate block above builds the gather layout's light
+            # tables identically when both tiers are on.
+            spec = hd["res_spec"]
+            sentinel = rows_loc if layout == "sliced" else rows - 1
+
+            def _gt_stack(tbl):
+                if layout == "sliced":
+                    return np.stack([
+                        np.stack([
+                            pad_gate_blocks(tbl[p, s], sentinel)
+                            for s in range(p_count)
+                        ])
+                        for p in range(p_count)
+                    ])
+                return np.stack([
+                    pad_gate_blocks(tbl[p], sentinel) for p in range(p_count)
+                ])
+
+            if spec.heavy:
+                n_arrs["virtual_gt"] = _gt_stack(hd["res_arrs"]["virtual_t"])
+            for i, (_k, _n) in enumerate(spec.light_meta):
+                n_arrs[f"light{i}_gt"] = _gt_stack(
+                    hd["res_arrs"][f"light{i}_t"]
+                )
         build = _make_dist_core(
             hd, self.w, num_planes, self.mesh, interpret, exchange,
             self.sparse_caps,
             gate_levels=self.max_levels_cap if pull_gate else 0,
-            delta_bits=self.delta_bits,
+            delta_bits=self.delta_bits, expand_impl=expand_impl,
         )
         if pull_gate:
             # The raw jitted resume loop takes the extra lane-mask arg and

@@ -42,8 +42,9 @@ from tpu_bfs.algorithms._packed_common import (
     ExpandSpec,
     PackedRunProtocol,
     lazy_full_parent_ell,
-    make_fori_expand,
+    make_expand,
     make_state_kernels,
+    validate_expand_impl,
 )
 from tpu_bfs.parallel.collectives import (
     RowGatherExchangeAccounting,
@@ -72,6 +73,7 @@ def _make_dist_core(
     sell: ShardedEllGraph, w: int, num_planes: int, mesh: Mesh,
     exchange: str = "dense", sparse_caps: tuple[int, ...] = (),
     delta_bits: tuple[int, ...] = (),
+    expand_impl: str = "xla", interpret: bool = False,
 ):
     p_count = sell.num_shards
     v_loc = sell.v_loc
@@ -88,7 +90,7 @@ def _make_dist_core(
         light_meta=tuple((k, blocks.shape[1]) for k, blocks in sell.light),
         tail_rows=sell.tail_rows,
     )
-    expand = make_fori_expand(spec, w)
+    expand = make_expand(spec, w, impl=expand_impl, interpret=interpret)
 
     def _dense_gather(nxt):
         gathered = lax.all_gather(nxt, "v")  # [P, v_loc, W]
@@ -259,9 +261,19 @@ class DistWideMsBfsEngine(PackedRunProtocol, RowGatherExchangeAccounting,
         sparse_caps: int | tuple[int, ...] | None = None,
         wire_pack: bool = False,
         delta_bits: tuple[int, ...] = (),
+        expand_impl: str = "xla",
+        interpret: bool | None = None,
     ):
         if not (1 <= num_planes <= 8):
             raise ValueError("num_planes must be in [1, 8]")
+        validate_expand_impl(expand_impl)
+        self.expand_impl = expand_impl
+        if interpret is None:
+            # Same resolution as the hybrid engines' kernels: emulate the
+            # Pallas tier off-TPU so the CPU fuzz drives the real kernel
+            # inside shard_map.
+            interpret = jax.default_backend() != "tpu"
+        self._interpret = bool(interpret)
         if exchange not in ("dense", "sparse"):
             raise ValueError(
                 f"unknown exchange {exchange!r}; have 'dense', 'sparse'"
@@ -334,6 +346,26 @@ class DistWideMsBfsEngine(PackedRunProtocol, RowGatherExchangeAccounting,
             n_arrs["heavy_pick"] = sell.heavy_pick
         for i, (k, blocks) in enumerate(sell.light):
             n_arrs[f"light{i}_t"] = np.ascontiguousarray(blocks.transpose(0, 2, 1))
+        if expand_impl == "pallas":
+            from tpu_bfs.graph.ell import pad_gate_blocks
+            from tpu_bfs.ops.ell_expand import validate_kernel_width
+
+            validate_kernel_width(
+                w, self._interpret, kernel="dist-wide expand_impl='pallas'"
+            )
+            # Per-shard sentinel-padded whole-block tables (stacked on the
+            # shard axis like every other n_arrs entry; sentinel = the
+            # replicated frontier's all-zero row v_pad).
+            if sell.heavy_per_shard > 0:
+                n_arrs["virtual_gt"] = np.stack([
+                    pad_gate_blocks(n_arrs["virtual_t"][p], sell.v_pad)
+                    for p in range(sell.num_shards)
+                ])
+            for i, (k, blocks) in enumerate(sell.light):
+                n_arrs[f"light{i}_gt"] = np.stack([
+                    pad_gate_blocks(n_arrs[f"light{i}_t"][p], sell.v_pad)
+                    for p in range(sell.num_shards)
+                ])
         #: delta-encoded sparse row-gather ids (ISSUE 7; sparse exchange
         #: only, default OFF until chip-measured).
         self.delta_bits = check_delta_bits(delta_bits)
@@ -352,7 +384,8 @@ class DistWideMsBfsEngine(PackedRunProtocol, RowGatherExchangeAccounting,
         self.last_exchange_bytes: float | None = None
         build = _make_dist_core(
             sell, w, num_planes, self.mesh, exchange, self.sparse_caps,
-            self.delta_bits,
+            self.delta_bits, expand_impl=expand_impl,
+            interpret=self._interpret,
         )
         self._dist_core, self._core_from_jit, self.arrs = build(n_arrs)
         # Checkpoint-conversion metadata: _rank (below) is the chip-major

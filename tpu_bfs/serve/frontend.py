@@ -227,6 +227,7 @@ class BfsService:
         lanes: int = 512,
         planes: int = DEFAULT_PLANES,
         pull_gate: bool = False,
+        expand_impl: str = "xla",
         devices: int = 1,
         exchange: str = "",
         wire_pack: bool = False,
@@ -294,6 +295,7 @@ class BfsService:
         self._graph = self._registry.graph(self._graph_key)
         self._planes = planes
         self._pull_gate = pull_gate
+        self._expand_impl = expand_impl
         # The CURRENT engine/mesh config: one immutable object swapped
         # atomically by the mesh failover ladder (degrade) and the
         # health probe (restore) — see MeshServeConfig. _cfg0 is the
@@ -423,6 +425,7 @@ class BfsService:
             lanes=self.lanes if width is None else width,
             planes=self._planes,
             pull_gate=self._pull_gate,
+            expand_impl=self._expand_impl,
             devices=cfg.devices,
             exchange=cfg.exchange,
             wire_pack=cfg.wire_pack,
@@ -666,6 +669,10 @@ class BfsService:
             # level-checkpointed resume audit when armed.
             "devices": cfg.devices,
         }
+        if self._expand_impl != "xla":
+            # Kernel-tier config echo (ISSUE 16): which expansion tier
+            # every resident engine on this line was built with.
+            out["expand_impl"] = self._expand_impl
         if self._cfg0.devices > 1:
             out["mesh_degraded"] = cfg.devices < self._cfg0.devices
         if cfg.resume_levels:
@@ -1456,6 +1463,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
                     f"{DEFAULT_PLANES} — serving favors depth headroom)")
     ap.add_argument("--pull-gate", action="store_true",
                     help="frontier-aware pull gate (wide/hybrid engines)")
+    ap.add_argument("--expand-impl", default="xla",
+                    choices=("xla", "pallas"),
+                    help="pull-expansion tier (default xla): 'pallas' "
+                    "serves the fused bucketed-ELL kernel "
+                    "(ops/ell_expand) on the wide/hybrid engines — "
+                    "bit-identical answers, one HBM write per 128-row "
+                    "tile per level; a program-key axis, so --preheat/"
+                    "--export-aot stores keep tiers separate")
     ap.add_argument("--devices", type=int, default=1,
                     help="shard the engine over N devices (default 1): "
                     "wide/hybrid run the 1D-partition packed MS engines, "
@@ -1788,6 +1803,7 @@ def run_server(args, stdin=None, stdout=None, stderr=None,
         lanes=args.lanes,
         planes=args.planes,
         pull_gate=args.pull_gate,
+        expand_impl=getattr(args, "expand_impl", "xla"),
         devices=args.devices,
         exchange=getattr(args, "exchange", "") or "",
         wire_pack=getattr(args, "wire_pack", False),

@@ -87,6 +87,12 @@ class EngineSpec:
     lanes: int = 512
     planes: int = DEFAULT_PLANES
     pull_gate: bool = False
+    #: ISSUE 16 expansion tier: "xla" (the fori-loop form XLA fuses) or
+    #: "pallas" (the fused gather-combine kernel, ops/ell_expand.py).
+    #: A key field — the tiers compile different programs over different
+    #: table sets — carried by utils/aot.program_key only when
+    #: non-default, so existing stores stay adoptable.
+    expand_impl: str = "xla"
     devices: int = 1
     #: Query kind this residency serves (ISSUE 14): "bfs" (the base
     #: engines themselves) or a tpu_bfs/workloads adapter over them
@@ -149,6 +155,17 @@ class EngineSpec:
             )
         if self.engine == "packed" and self.devices > 1:
             raise ValueError("the packed engine is single-device")
+        if self.expand_impl not in ("xla", "pallas"):
+            raise ValueError(
+                "expand_impl must be one of ('xla', 'pallas'), got "
+                f"{self.expand_impl!r}"
+            )
+        if self.expand_impl != "xla" and self.engine in ("packed", "dist2d"):
+            raise ValueError(
+                "expand_impl='pallas' fuses the bucketed-ELL pull "
+                "expansion of the wide/hybrid engines; the packed and "
+                "dist2d engines run no ELL pull loop to lower"
+            )
         if self.engine == "dist2d" and self.devices < 2:
             raise ValueError(
                 "the dist2d engine is the 2D-partition mesh path; "
@@ -418,6 +435,7 @@ class EngineRegistry:
                     g, mesh, num_planes=spec.planes, lanes=spec.lanes,
                     exchange=spec.exchange or "dense",
                     wire_pack=spec.wire_pack, delta_bits=spec.delta_bits,
+                    expand_impl=spec.expand_impl,
                 )
             else:
                 from tpu_bfs.parallel.dist_msbfs_hybrid import (
@@ -429,6 +447,7 @@ class EngineRegistry:
                     pull_gate=spec.pull_gate,
                     exchange=spec.exchange or "dense",
                     wire_pack=spec.wire_pack, delta_bits=spec.delta_bits,
+                    expand_impl=spec.expand_impl,
                 )
         elif spec.engine == "packed":
             from tpu_bfs.algorithms.msbfs_packed import PackedMsBfsEngine
@@ -439,14 +458,14 @@ class EngineRegistry:
 
             eng = HybridMsBfsEngine(
                 g, lanes=spec.lanes, num_planes=spec.planes,
-                pull_gate=spec.pull_gate,
+                pull_gate=spec.pull_gate, expand_impl=spec.expand_impl,
             )
         else:
             from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
 
             eng = WidePackedMsBfsEngine(
                 g, lanes=spec.lanes, num_planes=spec.planes,
-                pull_gate=spec.pull_gate,
+                pull_gate=spec.pull_gate, expand_impl=spec.expand_impl,
             )
         if spec.kind != "bfs":
             # Workload adapter over the base engine (ISSUE 14): khop/cc/

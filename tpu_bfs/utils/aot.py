@@ -163,6 +163,12 @@ def program_key(spec) -> dict:
         # bfs rung's artifacts). Non-default only, so every existing
         # single-chip store stays adoptable byte-for-byte.
         key["kind"] = str(spec["kind"])
+    if spec.get("expand_impl", "xla") != "xla":
+        # The kernel-tier axis (ISSUE 16): expand_impl='pallas' compiles
+        # the fused ell_expand kernel over the padded gt tables — a
+        # different program than the fori tier. Non-default only, so
+        # xla-tier stores keep their PR 9 digests.
+        key["expand_impl"] = str(spec["expand_impl"])
     return key
 
 

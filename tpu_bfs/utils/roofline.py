@@ -43,7 +43,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpu_bfs.algorithms._packed_common import make_fori_expand
+from tpu_bfs.algorithms._packed_common import make_expand
 from tpu_bfs.algorithms.msbfs_hybrid import expand_spec
 from tpu_bfs.algorithms.msbfs_packed import ripple_increment
 from tpu_bfs.obs.engine_trace import trace_summary as _trace_summary
@@ -68,7 +68,15 @@ def phase_fns(engine) -> dict:
     hg, w = engine.hg, engine.w
     act = hg.num_active
     out_rows = hg.vt * TILE
-    expand_residual = make_fori_expand(expand_spec(hg), w)
+    # The residual slice runs THE SAME expansion tier as the fused loop
+    # (ISSUE 16): a pallas-tier engine's attribution must time the fused
+    # kernel, not the fori form it replaced. The engine's arrs already
+    # carry the tier's tables (padded gt slabs on the pallas tier).
+    expand_residual = make_expand(
+        expand_spec(hg), w,
+        impl=getattr(engine, "expand_impl", "xla"),
+        interpret=engine.interpret,
+    )
     fns = {}
 
     def residual(arrs, fw):
@@ -140,6 +148,60 @@ def phase_fns(engine) -> dict:
     return fns
 
 
+def pallas_expand_bytes(engine, *, active_tiles: int | None = None) -> dict:
+    """Per-kernel HBM bytes of ONE pallas-tier expansion level (ISSUE 16).
+
+    One entry per kernel launch ('virtual', 'light0', ...), derived from
+    the engine's padded gt slabs and priced by
+    ``ops.ell_expand.ell_expand_hbm_bytes``: per computed 128-row tile,
+    the index slab + k gathered frontier rows per row (+ the weight slab
+    on min-plus kernels) + ONE output write. The VMEM-resident
+    accumulator is what separates this from the fori tier's model
+    (``phase_bytes``), which pays the accumulator round-trip on every
+    bucket step — this dict is the bound the kernel is built to meet.
+
+    Distributed engines hold per-shard gt stacks (leading axes); bytes
+    count across shards. ``active_tiles`` (gated engines: unsettled
+    GATE_TILE blocks this level) caps each light kernel's computed
+    tiles; the heavy kernel is all-or-nothing, exactly like the gated
+    program (gated-out tiles still pay their identity write). Returns
+    ``{}`` when the engine runs the xla tier.
+    """
+    if getattr(engine, "expand_impl", "xla") != "pallas":
+        return {}
+    from tpu_bfs.ops.ell_expand import TILE as KTILE, ell_expand_hbm_bytes
+
+    arrs = getattr(engine, "arrs", None) or {}
+    w = engine.w
+    out = {}
+    for name in sorted(arrs):
+        if not name.endswith("_gt"):
+            continue
+        base = name[: -len("_gt")]
+        # Index slabs only: 'virtual' / 'light<i>'. Weight slabs
+        # ('<base>_w'/'<base>_wl', sssp) ride their index kernel's
+        # launch via the ``weighted`` flag below.
+        if base != "virtual" and not (
+            base.startswith("light") and "_" not in base
+        ):
+            continue
+        t = arrs[name]
+        k, pn = int(t.shape[-2]), int(t.shape[-1])
+        shards = 1
+        for d in t.shape[:-2]:
+            shards *= int(d)
+        if active_tiles is None:
+            at = None
+        elif base == "virtual":
+            at = None if active_tiles > 0 else 0
+        else:
+            at = min(pn // KTILE, int(active_tiles))
+        out[base] = shards * ell_expand_hbm_bytes(
+            k, pn, w, active_tiles=at, weighted=f"{base}_w_gt" in arrs
+        )
+    return out
+
+
 def phase_bytes(engine, *, nz_rows: int | None = None,
                 active_tiles: int | None = None) -> dict:
     """Analytic HBM bytes per phase for ONE level (lower bounds: bytes the
@@ -186,20 +248,34 @@ def phase_bytes(engine, *, nz_rows: int | None = None,
     tb = rows * w * 4  # one [rows, w] u32 table
     gated = bool(getattr(engine, "pull_gate", False)) and active_tiles is not None
     at_rows = min(int(active_tiles or 0) * TILE, rows) if gated else rows
-    # residual: per light bucket, k fori steps each gathering n rows
-    # (n*w*4 read) and accumulating (acc read+write) + index table; the
-    # virtual/heavy bucket adds its fold pyramid and pick gathers.
-    res = 0
-    if hg.res_heavy and (not gated or at_rows > 0):
-        m = hg.res_virtual.idx.shape[0]  # rows per virtual gather
-        res += hg.kcap * (3 * hg.res_num_virtual * w * 4) + hg.kcap * m * 4
-        # fold pyramid: halving read+write chain ~ 2 * 2*num_virtual rows,
-        # then the heavy_pick gather back out.
-        res += 4 * hg.res_num_virtual * w * 4 + hg.res_heavy * w * 4
-    for b in hg.res_light:
-        n, k = b.idx.shape
-        ne = min(n, at_rows) if gated else n
-        res += k * (3 * ne * w * 4) + ne * k * 4
+    pal = pallas_expand_bytes(
+        engine, active_tiles=active_tiles if gated else None
+    )
+    if pal:
+        # Pallas tier (ISSUE 16): per-kernel attribution — the
+        # VMEM-resident accumulator drops the fori tier's per-step
+        # accumulator round-trip, so the residual bound shrinks to the
+        # kernel model. The heavy fold pyramid + pick gather still run
+        # in jnp after the kernel.
+        res = sum(pal.values())
+        if hg.res_heavy and (not gated or at_rows > 0):
+            res += 4 * hg.res_num_virtual * w * 4 + hg.res_heavy * w * 4
+    else:
+        # residual: per light bucket, k fori steps each gathering n rows
+        # (n*w*4 read) and accumulating (acc read+write) + index table;
+        # the virtual/heavy bucket adds its fold pyramid and pick
+        # gathers.
+        res = 0
+        if hg.res_heavy and (not gated or at_rows > 0):
+            m = hg.res_virtual.idx.shape[0]  # rows per virtual gather
+            res += hg.kcap * (3 * hg.res_num_virtual * w * 4) + hg.kcap * m * 4
+            # fold pyramid: halving read+write chain ~ 2 * 2*num_virtual
+            # rows, then the heavy_pick gather back out.
+            res += 4 * hg.res_num_virtual * w * 4 + hg.res_heavy * w * 4
+        for b in hg.res_light:
+            n, k = b.idx.shape
+            ne = min(n, at_rows) if gated else n
+            res += k * (3 * ne * w * 4) + ne * k * 4
     # permutation back to rank0: read bucket rows + write the rank0 table.
     res += 2 * tb
     out["residual"] = res
@@ -540,6 +616,21 @@ def roofline_hybrid(engine, sources, *, peak_gbs: float = V5E_PEAK_GBS,
         # time the whole byte model would take at peak bandwidth.
         "t_at_peak_bw_s": total_bytes / (peak_gbs * 1e9),
     }
+    # Expansion-tier attribution (ISSUE 16): which tier ran, and — on the
+    # pallas tier — the per-kernel VMEM-resident byte bound of one
+    # ungated level with its time at peak bandwidth (the BLEST-style
+    # floor the fused kernel chases; compare against the residual
+    # phase's achieved figure above).
+    report["expand_impl"] = getattr(engine, "expand_impl", "xla")
+    pal = pallas_expand_bytes(engine)
+    if pal:
+        report["expand_kernel_bytes"] = {
+            **{k: int(v) for k, v in pal.items()},
+            "level_total": int(sum(pal.values())),
+        }
+        report["expand_kernel_t_at_peak_bw_s"] = (
+            sum(pal.values()) / (peak_gbs * 1e9)
+        )
     if measured_gteps is not None:
         # The fused batch measured `measured_gteps`; if every attributed
         # phase ran at peak HBM bandwidth, the same byte model implies:

@@ -153,7 +153,10 @@ def build_workload_engine(kind: str, base, graph, spec):
     if kind == "sssp":
         from tpu_bfs.workloads.sssp import SsspEngine
 
-        return SsspEngine(graph, lanes=spec.lanes)
+        return SsspEngine(
+            graph, lanes=spec.lanes,
+            expand_impl=getattr(spec, "expand_impl", "xla"),
+        )
     if kind == "khop":
         from tpu_bfs.workloads.khop import KhopServeEngine
 

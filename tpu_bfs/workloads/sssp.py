@@ -50,6 +50,15 @@ from tpu_bfs.graph.ell import build_ell, build_ell_weights
 INF_W = np.int32(1 << 29)
 
 
+def _check_kernel_ident():
+    # The Pallas min-plus kernel bakes its identity as a symbolic
+    # constant (ops/ell_expand.MINPLUS_IDENT); the two definitions must
+    # agree or the kernel's gated/pad rows would not absorb under min.
+    from tpu_bfs.ops.ell_expand import MINPLUS_IDENT
+
+    assert MINPLUS_IDENT == int(INF_W), (MINPLUS_IDENT, int(INF_W))
+
+
 def _make_min_plus_expand(spec_like, L: int, wsuf: str):
     """Min-plus bucketed-ELL expansion over a [rows, L] int32 distance
     table — make_fori_expand's shape with per-slot weight adds. ``wsuf``
@@ -108,6 +117,7 @@ class _Spec:
 
     def __init__(self, ell):
         self.kcap = ell.kcap
+        self.heavy = ell.num_virtual > 0
         self.num_virtual = ell.num_virtual
         self.fold_steps = ell.fold_steps
         self.light_meta = tuple((b.k, b.n) for b in ell.light)
@@ -194,7 +204,15 @@ class SsspEngine:
     kind = "sssp"
 
     def __init__(self, graph: Graph, *, lanes: int = 32, kcap: int = 64,
-                 delta: int = 0, max_rounds: int = 4096):
+                 delta: int = 0, max_rounds: int = 4096,
+                 expand_impl: str = "xla", interpret: bool | None = None):
+        from tpu_bfs.algorithms._packed_common import validate_expand_impl
+
+        validate_expand_impl(expand_impl)
+        self.expand_impl = expand_impl
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        self._interpret = bool(interpret)
         if graph.weights is None:
             raise ValueError(
                 "sssp needs a weighted graph (generate with weights=W or "
@@ -221,8 +239,30 @@ class SsspEngine:
         # distances themselves are only bounded by the graph.
         spec = _Spec(self.ell)
         self.arrs = self._build_arrays()
-        expand_light = _make_min_plus_expand(spec, self.lanes, "wl")
-        expand_full = _make_min_plus_expand(spec, self.lanes, "w")
+        if expand_impl == "pallas":
+            from tpu_bfs.algorithms._packed_common import make_pallas_expand
+            from tpu_bfs.ops.ell_expand import validate_kernel_width
+
+            _check_kernel_ident()
+            # The min-plus kernel DMAs [1, L] distance rows: L is the
+            # kernel width, so real TPUs need L % 128 (int32 lanes are
+            # 32x wider than a BFS lane — 128 is a deliberately big
+            # batch here, hence interpret-first until chip-measured).
+            validate_kernel_width(
+                self.lanes, self._interpret,
+                kernel="sssp expand_impl='pallas'",
+            )
+            expand_light = make_pallas_expand(
+                spec, self.lanes, op="minplus", wsuf="wl",
+                interpret=self._interpret,
+            )
+            expand_full = make_pallas_expand(
+                spec, self.lanes, op="minplus", wsuf="w",
+                interpret=self._interpret,
+            )
+        else:
+            expand_light = _make_min_plus_expand(spec, self.lanes, "wl")
+            expand_full = _make_min_plus_expand(spec, self.lanes, "w")
         self._core = _make_delta_core(
             expand_light, expand_full, jnp.int32(self.delta)
         )
@@ -233,22 +273,48 @@ class SsspEngine:
     def _build_arrays(self) -> dict:
         from tpu_bfs.algorithms._packed_common import expand_arrays
 
+        pallas = self.expand_impl == "pallas"
+        if pallas:
+            from tpu_bfs.algorithms._packed_common import (
+                pallas_expand_arrays,
+            )
+            from tpu_bfs.graph.ell import pad_gate_blocks
+
         arrs = expand_arrays(self.ell)
+        if pallas:
+            # Whole-block index tables the kernel DMAs (sentinel = the
+            # all-INF row act) ...
+            for name, tbl in pallas_expand_arrays(
+                self.ell, self._act
+            ).items():
+                arrs[name] = jnp.asarray(tbl)
         vw, lw = build_ell_weights(self.host_graph, self.ell, pad=0)
         delta = self.delta
-        if vw is not None:
-            vt = np.ascontiguousarray(vw.T).astype(np.int32)
-            arrs["virtual_w"] = jnp.asarray(vt)
-            arrs["virtual_wl"] = jnp.asarray(
-                np.where(vt <= delta, vt, INF_W)
-            )
-        for i, w in enumerate(lw):
-            wt = np.ascontiguousarray(w.T).astype(np.int32)
-            arrs[f"light{i}_w"] = jnp.asarray(wt)
+
+        def _weight_planes(prefix, wt):
+            arrs[f"{prefix}_w"] = jnp.asarray(wt)
             # Light plane: heavy-edge slots absorb under min. Pad slots
             # (weight 0) gather the all-INF sentinel row either way.
-            arrs[f"light{i}_wl"] = jnp.asarray(
-                np.where(wt <= delta, wt, INF_W)
+            wl = np.where(wt <= delta, wt, INF_W)
+            arrs[f"{prefix}_wl"] = jnp.asarray(wl)
+            if pallas:
+                # ... and the weight planes padded slot-for-slot with
+                # them (pad weight 0: the padded index slot gathers the
+                # INF sentinel row, INF + 0 = the min identity).
+                arrs[f"{prefix}_w_gt"] = jnp.asarray(
+                    pad_gate_blocks(wt, 0)
+                )
+                arrs[f"{prefix}_wl_gt"] = jnp.asarray(
+                    pad_gate_blocks(wl, 0)
+                )
+
+        if vw is not None:
+            _weight_planes(
+                "virtual", np.ascontiguousarray(vw.T).astype(np.int32)
+            )
+        for i, w in enumerate(lw):
+            _weight_planes(
+                f"light{i}", np.ascontiguousarray(w.T).astype(np.int32)
             )
         return arrs
 

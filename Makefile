@@ -1,5 +1,5 @@
 # Convenience targets; everything also works without make (README).
-.PHONY: test native bench analyze wirecheck serve-smoke serve-dist-smoke workloads-smoke chaos-smoke mesh-chaos-smoke integrity-smoke obs-smoke preheat-smoke wheel clean
+.PHONY: test native bench analyze wirecheck serve-smoke serve-dist-smoke workloads-smoke chaos-smoke mesh-chaos-smoke integrity-smoke cache-smoke obs-smoke preheat-smoke wheel clean
 
 # Full suite on 8 virtual CPU devices (tests/conftest.py forces the
 # platform; the axon TPU plugin is bypassed).
@@ -157,6 +157,20 @@ mesh-chaos-smoke: chaos-smoke
 # .py + the per-kind corruption fuzz arm in test_fuzz_cross_engine.py).
 integrity-smoke: mesh-chaos-smoke
 	env JAX_PLATFORMS=cpu python scripts/integrity_smoke.py
+
+# The answer-tier soak (README "Answer cache and landmarks", ISSUE 18):
+# a cache+landmark-armed server must serve repeated queries without
+# re-traversing (cache hits / single-flight collapses, bit-identical to
+# the first traversal and the CPU oracle) and answer landmark-exact p2p
+# queries in the submit path; with corrupt_cache_entry armed the CRC32
+# check must evict the rotten entry and fall back to a clean traversal;
+# with stale_cache armed the shadow audit must quarantine the cache
+# GENERATION (never a rung) and the repeat must miss and traverse
+# oracle-exact. The pytest side runs the same machinery in-process
+# (tests/test_answercache.py + the Zipfian cache-on-vs-off arm in
+# test_fuzz_cross_engine.py).
+cache-smoke: wirecheck
+	env JAX_PLATFORMS=cpu python scripts/cache_smoke.py
 
 # The telemetry smoke (README "Observability"): a tracing-armed JSONL
 # server must emit a Perfetto trace holding the FULL span chain of every

@@ -37,8 +37,13 @@ ISSUE 12) / TPU_BFS_BENCH_SERVE_AUDIT_RATE (0 — the online integrity
 tier's shadow-audit sampling fraction, ISSUE 15; > 0 also arms the
 structural tree checks) / TPU_BFS_BENCH_SERVE_AUDIT_CHECKSUM (0 — wire
 checksums on the audited transfers), emitting serve_audits_run /
-serve_audit_failures / serve_audit_p50_lag_ms / serve_quarantines,
-plus the PR 5/7 wire knobs; mesh runs add serve_gteps_p50 /
+serve_audit_failures / serve_audit_p50_lag_ms / serve_quarantines /
+TPU_BFS_BENCH_SERVE_CACHE (0 — the answer cache, ISSUE 18: '1' = the
+64 MB default byte budget, else a raw byte budget) /
+TPU_BFS_BENCH_SERVE_LANDMARKS (0 — K landmark distance columns);
+either arms a second Zipf(s=1.0) closed loop emitting
+serve_cache_hit_rate / serve_landmark_hit_rate / serve_hit_p50_ms /
+serve_traversal_p50_ms, plus the PR 5/7 wire knobs; mesh runs add serve_gteps_p50 /
 serve_gteps_hmean / serve_wire_bytes_per_query plus the mesh-fault
 record serve_mesh_faults/serve_mesh_degrades/serve_query_resumes/
 serve_devices_final to the verdict, and
@@ -1475,7 +1480,22 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
                                       "0") or 0)
     audit_checksum = os.environ.get("TPU_BFS_BENCH_SERVE_AUDIT_CHECKSUM",
                                     "0") == "1"
+    # Answer tier (ISSUE 18): TPU_BFS_BENCH_SERVE_CACHE arms the result
+    # cache ('1' = the 64 MB default budget, any other value = a raw
+    # byte budget) and TPU_BFS_BENCH_SERVE_LANDMARKS the K-column
+    # landmark index; armed, a second ZIPFIAN closed loop (s=1.0 over
+    # the degree-ranked hot set — the traffic shape the tier exists
+    # for) runs after the uniform loop and the verdict gains
+    # serve_cache_hit_rate / serve_landmark_hit_rate plus the split
+    # hit-vs-traversal p50s.
+    cache_raw = os.environ.get("TPU_BFS_BENCH_SERVE_CACHE", "0").strip()
+    cache_bytes = 0
+    if cache_raw and cache_raw != "0":
+        cache_bytes = (64 << 20) if cache_raw == "1" else int(cache_raw)
+    landmark_k = int(os.environ.get("TPU_BFS_BENCH_SERVE_LANDMARKS",
+                                    "0") or 0)
     svc_kw = dict(
+        cache_bytes=cache_bytes, landmarks=landmark_k,
         engine=engine, lanes=lanes, planes=8,
         devices=devices, exchange=serve_exchange, wire_pack=wire_pack,
         delta_bits=delta_bits, sieve=sieve, predict=predict,
@@ -1686,6 +1706,109 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
         finally:
             ksvc.close()
 
+    # Zipfian answer-tier stage (ISSUE 18): with the cache and/or the
+    # landmark index armed, drive a second closed loop whose sources
+    # follow a Zipf(s=1.0) law over the degree-ranked hot set (rank 1 =
+    # the highest-degree vertex = the first landmark) — the skewed
+    # traffic the answer tier exists for. bfs repeats must resolve from
+    # the cache (or collapse into an in-flight leader); p2p queries
+    # sourced at the hubs resolve exactly from the landmark columns.
+    # The verdict splits hit vs traversal latency client-side.
+    cache_keys: dict = {}
+    if cache_bytes or landmark_k:
+        zn = int(min(len(candidates), 256))
+        order = np.argsort(-g.degrees[candidates], kind="stable")
+        universe = candidates[order[:zn]]
+        pz = 1.0 / np.arange(1, zn + 1, dtype=np.float64)
+        pz /= pz.sum()
+        zs = rng.choice(universe, size=(clients, per_client), p=pz)
+        zt = rng.choice(universe, size=(clients, per_client), p=pz)
+        do_p2p = landmark_k > 0 and "p2p" in service.kinds
+        snap0 = service.statsz()
+        zres: list = [None] * clients
+        zerrs: list = []
+
+        def zipf_client(ci: int) -> None:
+            got = []
+            try:
+                for j, s in enumerate(zs[ci]):
+                    if do_p2p and j % 4 == 3:
+                        got.append(service.query(
+                            int(s), kind="p2p", target=int(zt[ci][j]),
+                            timeout=600.0,
+                        ))
+                    else:
+                        got.append(service.query(int(s), timeout=600.0))
+            except Exception as exc:  # noqa: BLE001 — joined below
+                zerrs.append(exc)
+            zres[ci] = got
+
+        zthreads = [
+            threading.Thread(target=zipf_client, args=(i,), daemon=True)
+            for i in range(clients)
+        ]
+        t0 = time.perf_counter()
+        for t in zthreads:
+            t.start()
+        for t in zthreads:
+            t.join()
+        zipf_elapsed = time.perf_counter() - t0
+        if zerrs:
+            raise zerrs[0]
+        zflat = [r for per in zres if per for r in per]
+        zbad = [r for r in zflat if not r.ok]
+        if zbad:
+            raise RuntimeError(
+                f"{len(zbad)}/{len(zflat)} Zipfian queries failed; "
+                f"first: {zbad[0].status}: {zbad[0].error}"
+            )
+        snap2 = service.statsz()
+
+        def zdelta(key: str) -> int:
+            return int(snap2.get(key, 0)) - int(snap0.get(key, 0))
+
+        hit_lat = [
+            r.latency_ms for r in zflat
+            if (r.extras or {}).get("cache_hit")
+            or (r.extras or {}).get("landmark")
+        ]
+        trav_lat = [
+            r.latency_ms for r in zflat
+            if not ((r.extras or {}).get("cache_hit")
+                    or (r.extras or {}).get("landmark"))
+        ]
+        cache_resolved = zdelta("cache_hits") + zdelta(
+            "single_flight_collapses")
+        lm_resolved = zdelta("landmark_exact")
+        cache_keys = {
+            "serve_zipf_queries": len(zflat),
+            "serve_zipf_qps": round(len(zflat) / zipf_elapsed, 2),
+            "serve_cache_hit_rate": round(cache_resolved / len(zflat), 4),
+            "serve_landmark_hit_rate": round(lm_resolved / len(zflat), 4),
+            "serve_cache_bytes": snap2["cache_bytes"],
+            "serve_cache_evictions": snap2["cache_evictions"],
+            "serve_single_flight_collapses": snap2[
+                "single_flight_collapses"],
+            "serve_cache_quarantines": snap2["cache_quarantines"],
+        }
+        if hit_lat:
+            cache_keys["serve_hit_p50_ms"] = round(
+                float(np.percentile(hit_lat, 50)), 4)
+        if trav_lat:
+            cache_keys["serve_traversal_p50_ms"] = round(
+                float(np.percentile(trav_lat, 50)), 3)
+        if snap2.get("landmarks"):
+            cache_keys["serve_landmarks_k"] = snap2["landmarks"]["k"]
+            cache_keys["serve_landmark_warm_ms"] = snap2["landmarks"][
+                "warm_ms"]
+        log(
+            f"zipf stage: {len(zflat)} queries "
+            f"cache_hit_rate={cache_keys['serve_cache_hit_rate']} "
+            f"landmark_hit_rate={cache_keys['serve_landmark_hit_rate']} "
+            f"hit_p50={cache_keys.get('serve_hit_p50_ms')}ms "
+            f"traversal_p50={cache_keys.get('serve_traversal_p50_ms')}ms"
+        )
+
     aot_keys: dict = {}
     if aot_dir:
         # Export from the warmed service BEFORE closing it, then time a
@@ -1887,6 +2010,7 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
         "serve_hbm_ladder_monotone": hbm_monotone,
         **dist_keys,
         **kinds_keys,
+        **cache_keys,
         **aot_keys,
         **({"serve_faults": fault_sched.counts()} if fault_sched else {}),
         **obs_keys,

@@ -1,0 +1,228 @@
+"""The answer-tier soak (`make cache-smoke`): the result cache +
+landmark distance tier (ISSUE 18) proven end to end against the real
+subprocess server.
+
+Three acts, no monkeypatching (tpu_bfs/faults.py discipline):
+
+1. HIT PATH — a cache+landmark-armed server answers a repeated
+   mixed stream: the repeats must come back ``cache_hit`` (or collapse
+   into the in-flight leader) and be BIT-IDENTICAL to the first
+   traversal and to the CPU oracle; p2p queries sourced AT a landmark
+   vertex are provably exact (d(l,s)=0 collapses the bracket) and must
+   resolve through the landmark tier without traversing.
+2. CORRUPT ENTRY — ``corrupt_cache_entry`` rots a stored blob; the
+   CRC32 verification catches it AT LOOKUP, evicts the entry, degrades
+   the hit to a miss, and the query falls back to a clean traversal —
+   the client never sees the rotten payload.
+3. STALE ENTRY — ``stale_cache`` serves a CRC-valid wrong answer (the
+   client-visible lie); the shadow audit (rate 1.0) replays it, the
+   mismatch quarantines the cache GENERATION (cache_quarantines, with
+   the rung ``quarantines`` counter untouched), and the same query
+   afterwards misses the new generation and traverses oracle-exact.
+
+Prints one JSON line (value = act-1 cache+landmark resolutions) so
+scripts/chip_session.sh's has_value gate can drive it as a stage.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+GRAPH = "random:n=96,m=480,seed=3,weights=5"
+ENV = dict(os.environ, JAX_PLATFORMS="cpu")
+
+
+def log(msg):
+    print(f"[cache-smoke] {msg}", file=sys.stderr, flush=True)
+
+
+def check(cond, msg):
+    if not cond:
+        raise SystemExit(f"FAIL: {msg}")
+    log(f"ok: {msg}")
+
+
+def server_argv(extra):
+    return [
+        sys.executable, "-m", "tpu_bfs.serve", GRAPH,
+        "--lanes", "64", "--ladder", "64", "--linger-ms", "5",
+        "--statsz-every", "0",
+        "--cache-bytes", str(8 << 20), "--landmarks", "8",
+        *extra,
+    ]
+
+
+def last_statsz(err: str) -> dict:
+    lines = [l for l in err.splitlines() if l.startswith("statsz ")]
+    check(lines, "final statsz line emitted")
+    return json.loads(lines[-1][len("statsz "):])
+
+
+def run_server(extra, reqs, timeout=900):
+    proc = subprocess.Popen(
+        server_argv(extra), stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, env=ENV,
+    )
+    out, err = proc.communicate(
+        input="".join(json.dumps(r) + "\n" for r in reqs), timeout=timeout
+    )
+    check(proc.returncode == 0, "server exits 0")
+    resp = {r["id"]: r for l in out.splitlines() if l.strip()
+            for r in [json.loads(l)]}
+    check(len(resp) == len(reqs), "every query answered")
+    return resp, last_statsz(err)
+
+
+def main() -> int:
+    import numpy as np
+
+    from tpu_bfs.cli import load_graph
+    from tpu_bfs.reference import bfs_scipy
+    from tpu_bfs.serve.frontend import decode_distances
+    from tpu_bfs.workloads.landmarks import select_landmarks
+
+    g = load_graph(GRAPH)
+    sources = [0, 3, 5, 7]
+    golden = {s: bfs_scipy(g, s) for s in sources}
+    lm = int(select_landmarks(g, 8)[0])  # p2p FROM a landmark is exact
+    golden_lm = bfs_scipy(g, lm)
+
+    # ---- act 1: repeats hit, landmarks answer p2p exactly ---------------
+    log("act 1: repeated mixed stream against cache + landmarks")
+    reqs, rid = [], 0
+    for _round in range(3):  # round 0 traverses, rounds 1-2 must not
+        for s in sources:
+            reqs.append({"id": rid, "source": s})
+            rid += 1
+    p2p_ids = []
+    for t in (11, 23, 42):
+        reqs.append({"id": rid, "source": lm, "kind": "p2p", "target": t})
+        p2p_ids.append(rid)
+        rid += 1
+    resp, snap = run_server([], reqs)
+    check(all(r["status"] == "ok" for r in resp.values()),
+          "every query answers ok")
+    for req in reqs:
+        if "kind" in req:
+            continue
+        d = decode_distances(resp[req["id"]]["distances_npy"])
+        check(bool(np.array_equal(d, golden[req["source"]])),
+              f"bfs query {req['id']} matches the CPU oracle")
+    hits = sum(1 for r in resp.values() if r.get("cache_hit"))
+    collapsed = snap["single_flight_collapses"]
+    check(hits + collapsed >= len(sources) * 2,
+          f"all {len(sources) * 2} repeats avoided traversal "
+          f"({hits} cache hits + {collapsed} single-flight collapses)")
+    check(snap["cache_hits"] == hits and snap["cache_misses"] >= 1,
+          f"statsz counters agree ({snap['cache_hits']} hits, "
+          f"{snap['cache_misses']} misses)")
+    check(snap["cache_bytes"] > 0 and snap["cache"]["entries"] >= 1,
+          f"payloads resident ({snap['cache_bytes']} bytes)")
+    for i in p2p_ids:
+        r = resp[i]
+        check(r.get("landmark") and r.get("exact"),
+              f"p2p query {i} resolved by the landmark tier, exact")
+        want = int(golden_lm[r["target"]])
+        check(r["distance"] == want,
+              f"landmark p2p distance {r['distance']} == oracle {want}")
+    check(snap["landmark_exact"] >= len(p2p_ids),
+          f"landmark_exact counted ({snap['landmark_exact']})")
+    check(snap["landmarks"]["k"] == 8 and snap["landmarks"]["warmed"],
+          "landmark index warmed at K=8")
+    check(snap["hit_p50_ms"] is not None, "hit-latency histogram populated")
+    resolved = hits + collapsed + snap["landmark_exact"]
+
+    # ---- act 2: corrupt_cache_entry -> CRC evicts, clean fallback -------
+    # Sequential send-read (a pipelined repeat would collapse into the
+    # in-flight leader and never consult the cache), with a settle for
+    # the extraction worker's async populate.
+    log("act 2: corrupt_cache_entry armed (CRC catches at lookup)")
+    proc = subprocess.Popen(
+        server_argv(["--faults", "seed=5:corrupt_cache_entry:n=1",
+                     "--linger-ms", "0"]),
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, env=ENV,
+    )
+    proc.stdin.write(json.dumps({"id": 0, "source": 0}) + "\n")
+    proc.stdin.flush()
+    json.loads(proc.stdout.readline())  # the traversal that populates
+    time.sleep(1.0)
+    proc.stdin.write(json.dumps({"id": 1, "source": 0}) + "\n")
+    proc.stdin.flush()
+    proc.stdin.close()
+    proc.stdin = None  # communicate() must not flush a closed pipe
+    out, err = proc.communicate(timeout=900)
+    check(proc.returncode == 0, "chaos server exits 0")
+    resp = {r["id"]: r for l in out.splitlines() if l.strip()
+            for r in [json.loads(l)]}
+    snap = last_statsz(err)
+    d1 = decode_distances(resp[1]["distances_npy"])
+    check(bool(np.array_equal(d1, golden[0])),
+          "post-corruption answer fell back to a clean traversal")
+    check(not resp[1].get("cache_hit"),
+          "rotten entry did NOT serve as a hit")
+    check(snap.get("faults", {}).get("corrupt_cache_entry") == 1,
+          "exactly the scheduled corrupt_cache_entry fired")
+    check(snap["cache_evictions"] >= 1,
+          f"corrupt entry evicted ({snap['cache_evictions']})")
+
+    # ---- act 3: stale_cache -> shadow audit -> generation quarantine ----
+    log("act 3: stale_cache armed, shadow audit rate 1.0")
+    proc = subprocess.Popen(
+        server_argv(["--faults", "seed=7:stale_cache:n=1",
+                     "--audit-rate", "1", "--linger-ms", "0"]),
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, env=ENV,
+    )
+    proc.stdin.write(json.dumps({"id": 0, "source": 0}) + "\n")
+    proc.stdin.flush()
+    json.loads(proc.stdout.readline())  # the traversal that populates
+    time.sleep(1.0)  # the extraction worker's populate is async
+    proc.stdin.write(json.dumps({"id": 1, "source": 0}) + "\n")
+    proc.stdin.flush()
+    stale = json.loads(proc.stdout.readline())
+    d_stale = decode_distances(stale["distances_npy"])
+    check(stale.get("cache_hit")
+          and not np.array_equal(d_stale, golden[0]),
+          "stale hit IS wrong (client-visible, pre-detection)")
+    time.sleep(5.0)  # detection + generation quarantine are async
+    proc.stdin.write(json.dumps({"id": 2, "source": 0}) + "\n")
+    proc.stdin.flush()
+    proc.stdin.close()
+    proc.stdin = None  # communicate() must not flush a closed pipe
+    out, err = proc.communicate(timeout=900)
+    check(proc.returncode == 0, "chaos server exits 0")
+    resp = {r["id"]: r for l in out.splitlines() if l.strip()
+            for r in [json.loads(l)]}
+    d2 = decode_distances(resp[2]["distances_npy"])
+    check(bool(np.array_equal(d2, golden[0])),
+          "post-quarantine repeat traverses oracle-exact")
+    check(not resp[2].get("cache_hit"),
+          "post-quarantine repeat missed the new generation")
+    snap = last_statsz(err)
+    check(snap.get("faults", {}).get("stale_cache") == 1,
+          "exactly the scheduled stale_cache fired")
+    check(snap["audit_failures"] >= 1,
+          f"shadow audit caught the stale answer "
+          f"({snap['audit_failures']} findings)")
+    check(snap["cache_quarantines"] >= 1,
+          f"cache GENERATION quarantined ({snap['cache_quarantines']})")
+    check(snap["quarantines"] == 0,
+          "no rung was indicted for the cache's lie")
+
+    print(json.dumps({
+        "metric": "answer-tier smoke (hit/landmark correctness + "
+                  "corrupt-entry CRC degrade + stale-entry generation "
+                  "quarantine, tpu_bfs/serve/answercache)",
+        "value": resolved,
+        "unit": "bypass resolutions",
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -220,6 +220,19 @@ for i in $(seq 1 "$attempts"); do
       TPU_BFS_BENCH_MODE=serve TPU_BFS_BENCH_SCALE=20 \
       TPU_BFS_BENCH_SERVE_AUDIT_RATE=0.1 \
       TPU_BFS_BENCH_SERVE_AUDIT_CHECKSUM=1
+    # Answer-tier arm (perf, ISSUE 18): the same serve stage with the
+    # result cache (64 MB default budget) and the 16-column landmark
+    # index armed; after the uniform loop a Zipf(s=1.0) closed loop
+    # over the degree-ranked hot set measures how much of a skewed
+    # stream resolves WITHOUT traversing. Acceptance:
+    # serve_cache_hit_rate + serve_landmark_hit_rate > 0.5 and
+    # serve_hit_p50_ms at least 10x below serve_traversal_p50_ms (the
+    # hit path is a dict probe + CRC check / a NumPy column gather —
+    # microseconds against the batch pipeline's milliseconds).
+    stage "cache-s20" "$out/cache_s20.json" \
+      TPU_BFS_BENCH_MODE=serve TPU_BFS_BENCH_SCALE=20 \
+      TPU_BFS_BENCH_SERVE_CACHE=1 \
+      TPU_BFS_BENCH_SERVE_LANDMARKS=16
     # Cold-start arm (ISSUE 9): the same serve stage with an AOT
     # artifact store armed — the cold service's warmed programs export
     # to $out/aot_store after the closed loop, a SECOND service preheats

@@ -1,0 +1,561 @@
+"""The answer tier (ISSUE 18): the byte-budgeted result cache
+(tpu_bfs/serve/answercache), the landmark distance index
+(tpu_bfs/workloads/landmarks), single-flight collapsing
+(serve/scheduler.InflightIndex), and their serve-path integration —
+hits bypass the scheduler with provenance stamped, chaos kinds drive
+the CRC/quarantine paths red-before-green, and a confirmed stale entry
+quarantines the cache GENERATION, never a rung.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpu_bfs import faults
+from tpu_bfs.graph.csr import INF_DIST
+from tpu_bfs.graph.generate import random_graph
+from tpu_bfs.reference import bfs_scipy
+from tpu_bfs.serve import BfsService
+from tpu_bfs.serve.answercache import (
+    DEFAULT_MAX_BYTES,
+    PROVENANCE_EXTRAS,
+    AnswerCache,
+)
+from tpu_bfs.serve.scheduler import (
+    STATUS_OK,
+    InflightIndex,
+    PendingQuery,
+    QueryResult,
+)
+from tpu_bfs.workloads.landmarks import (
+    INF,
+    LandmarkIndex,
+    select_landmarks,
+)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.disarm()
+
+
+GRAPH = lambda: random_graph(96, 480, seed=3)  # noqa: E731
+
+
+@pytest.fixture(scope="module")
+def svc_reg():
+    """ONE warmed registry shared by every service in this module (the
+    test_serve_service idiom) — each fresh engine build costs seconds
+    and the answer tier under test lives entirely in the frontend."""
+    from tpu_bfs.serve.registry import EngineRegistry
+
+    reg = EngineRegistry(capacity=8)
+    reg.add_graph("ac-graph", GRAPH())
+    return reg
+
+
+# --- AnswerCache unit -------------------------------------------------------
+
+
+def _mk_cache(**kw):
+    kw.setdefault("graph_key", "g")
+    return AnswerCache(**kw)
+
+
+def test_put_get_round_trips_the_payload_bit_identically():
+    c = _mk_cache()
+    d = np.asarray([0, 1, 2, INF_DIST, 3], np.int32)
+    c.put(kind="bfs", source=4, distances=d, levels=3, reached=4,
+          extras={"weighted": False}, width=32, devices=1)
+    hit = c.get(kind="bfs", source=4)
+    assert hit is not None
+    np.testing.assert_array_equal(hit["distances"], d)
+    assert hit["levels"] == 3 and hit["reached"] == 4
+    assert hit["extras"] == {"weighted": False}
+    assert hit["width"] == 32 and hit["devices"] == 1
+    assert hit["generation"] == 0
+
+
+def test_key_covers_kind_params_and_distance_appetite():
+    c = _mk_cache()
+    c.put(kind="bfs", source=4, levels=1, reached=2)
+    assert c.get(kind="bfs", source=5) is None  # other source
+    assert c.get(kind="sssp", source=4) is None  # other kind
+    assert c.get(kind="bfs", source=4, k=2) is None  # other params
+    assert c.get(kind="bfs", source=4, target=7) is None
+    assert c.get(kind="bfs", source=4, want_distances=False) is None
+    assert c.get(kind="bfs", source=4) is not None
+
+
+def test_graph_generation_field_invalidates_by_key():
+    """ROADMAP item 2 prerequisite: flipping the graph generation makes
+    every resident entry unreachable without a scan."""
+    c = _mk_cache(graph_generation=0)
+    c.put(kind="bfs", source=1, levels=1, reached=2)
+    assert c.get(kind="bfs", source=1) is not None
+    c.graph_generation = 1
+    assert c.get(kind="bfs", source=1) is None
+
+
+def test_provenance_extras_are_stripped_at_put():
+    c = _mk_cache()
+    c.put(kind="p2p", source=1, target=2, want_distances=False,
+          extras={"target": 2, "met": True, "distance": 3,
+                  "cache_hit": True, "landmark": True, "exact": True})
+    hit = c.get(kind="p2p", source=1, target=2, want_distances=False)
+    assert hit is not None
+    assert not (set(hit["extras"]) & PROVENANCE_EXTRAS)
+    assert hit["extras"] == {"target": 2, "met": True, "distance": 3}
+
+
+def test_lru_evicts_cold_entries_under_the_byte_budget():
+    d = np.zeros(64, np.int32)  # 256-byte blob + 64 overhead
+    c = _mk_cache(max_bytes=3 * (256 + 64))
+    for s in range(3):
+        c.put(kind="bfs", source=s, distances=d, levels=1, reached=64)
+    assert len(c) == 3
+    assert c.get(kind="bfs", source=0) is not None  # touch: 0 now hot
+    c.put(kind="bfs", source=3, distances=d, levels=1, reached=64)
+    assert len(c) == 3
+    assert c.get(kind="bfs", source=1) is None  # the cold entry went
+    assert c.get(kind="bfs", source=0) is not None  # the touched survived
+    assert c.stats()["bytes"] <= c.max_bytes
+
+
+def test_oversized_payload_is_skipped_not_destructive():
+    c = _mk_cache(max_bytes=128)
+    c.put(kind="bfs", source=0, levels=1, reached=2)  # fits (blob-free)
+    big = np.zeros(4096, np.int32)
+    c.put(kind="bfs", source=1, distances=big, levels=1, reached=4096)
+    assert c.get(kind="bfs", source=1) is None
+    assert c.get(kind="bfs", source=0) is not None  # survivor
+
+
+def test_crc_catches_a_rotted_blob_and_degrades_to_a_miss():
+    c = _mk_cache()
+    d = np.arange(32, dtype=np.int32)
+    c.put(kind="bfs", source=0, distances=d, levels=1, reached=32)
+    [entry] = c._entries.values()
+    blob = bytearray(entry.blob)
+    blob[7] ^= 0x20  # storage rot
+    entry.blob = bytes(blob)
+    assert c.get(kind="bfs", source=0) is None
+    assert len(c) == 0  # evicted, not re-servable
+
+
+def test_crc_covers_the_metadata_fields_too():
+    c = _mk_cache()
+    c.put(kind="cc", source=0, want_distances=False, levels=None,
+          reached=41, extras={"components": 3})
+    [entry] = c._entries.values()
+    entry.reached = 42  # a lie in a blob-free field
+    assert c.get(kind="cc", source=0, want_distances=False) is None
+
+
+def test_quarantine_generation_drops_the_store_and_rolls_the_keys():
+    c = _mk_cache()
+    c.put(kind="bfs", source=0, levels=1, reached=2)
+    assert c.quarantine_generation(detail="test") == 1
+    assert len(c) == 0
+    assert c.get(kind="bfs", source=0) is None
+    # The NEW generation serves normally.
+    c.put(kind="bfs", source=0, levels=1, reached=2)
+    hit = c.get(kind="bfs", source=0)
+    assert hit is not None and hit["generation"] == 1
+    assert c.stats()["quarantines"] == 1
+
+
+def test_corrupt_cache_entry_fault_drives_the_crc_path():
+    """Red-before-green for the ``cache_lookup`` site: the chaos kind
+    rots the STORED blob, the CRC catches it at the next hit, and the
+    entry is gone — no monkeypatching."""
+    c = _mk_cache()
+    d = np.arange(16, dtype=np.int32)
+    c.put(kind="bfs", source=0, distances=d, levels=1, reached=16)
+    sched = faults.arm_from_spec("seed=1:corrupt_cache_entry:n=1")
+    assert c.get(kind="bfs", source=0) is None
+    assert sched.counts()["corrupt_cache_entry"] == 1
+    assert len(c) == 0
+    faults.disarm()
+    c.put(kind="bfs", source=0, distances=d, levels=1, reached=16)
+    hit = c.get(kind="bfs", source=0)
+    np.testing.assert_array_equal(hit["distances"], d)
+
+
+def test_stale_cache_fault_serves_a_crc_valid_lie():
+    """The detection hole the shadow audit exists for: ``stale_cache``
+    mutates the SERVED copy of a CRC-valid hit — the cache itself
+    cannot notice, and the stored entry stays intact."""
+    c = _mk_cache()
+    d = np.arange(16, dtype=np.int32)
+    c.put(kind="bfs", source=0, distances=d, levels=1, reached=16)
+    sched = faults.arm_from_spec("seed=1:stale_cache:n=1")
+    hit = c.get(kind="bfs", source=0)
+    assert hit is not None
+    assert not np.array_equal(hit["distances"], d)  # the lie
+    assert sched.counts()["stale_cache"] == 1
+    faults.disarm()
+    hit2 = c.get(kind="bfs", source=0)  # the stored truth survived
+    np.testing.assert_array_equal(hit2["distances"], d)
+
+
+def test_cache_fault_grammar_round_trips():
+    spec = "seed=3:corrupt_cache_entry:n=1,stale_cache:n=2"
+    s = faults.FaultSchedule.from_spec(spec)
+    assert s.to_spec() == spec
+    assert all(r.site == "cache_lookup" for r in s.rules)
+    assert {"corrupt_cache_entry", "stale_cache"} <= set(faults.KINDS)
+    assert "cache_lookup" in faults.SITES
+
+
+# --- LandmarkIndex unit -----------------------------------------------------
+
+
+def _warm_index(g, k):
+    """Warm a LandmarkIndex from the SciPy oracle — the unit tests pin
+    the math; the engine-driven warm-up is covered by the service
+    integration below and the cache smoke."""
+    idx = LandmarkIndex(g, k)
+    cols = {int(l): bfs_scipy(g, int(l)) for l in idx.landmarks}
+
+    class _Res:
+        def distances_int32(self, i):
+            return cols[int(idx.landmarks[i])]
+
+    idx.warm(lambda sources: _Res())
+    return idx
+
+
+def test_select_landmarks_is_top_degree_and_deterministic():
+    g = GRAPH()
+    lm = select_landmarks(g, 8)
+    assert len(lm) == 8
+    cut = np.sort(g.degrees)[::-1][7]
+    assert all(g.degrees[v] >= cut for v in lm)
+    np.testing.assert_array_equal(lm, select_landmarks(g, 8))
+
+
+def test_bounds_bracket_the_true_distance_everywhere():
+    """The triangle-bound contract over EVERY pair of a sampled set:
+    lo <= d(s,t) <= hi always, and exact means equality."""
+    g = GRAPH()
+    idx = _warm_index(g, 8)
+    dist = {s: bfs_scipy(g, s) for s in range(0, 96, 7)}
+    for s, ds in dist.items():
+        for t in range(0, 96, 5):
+            lo, hi, exact = idx.bounds(s, t)
+            true = int(ds[t])
+            true = INF if true == int(INF_DIST) else true
+            assert lo <= true <= hi, (s, t, lo, hi, true)
+            if exact:
+                assert lo == hi == true, (s, t)
+
+
+def test_landmark_source_pairs_are_always_exact():
+    """d(l, s) = 0 collapses the bracket — the property the Zipfian
+    bench stage leans on (hub traffic IS landmark traffic)."""
+    g = GRAPH()
+    idx = _warm_index(g, 8)
+    oracle = {int(l): bfs_scipy(g, int(l)) for l in idx.landmarks}
+    for l in idx.landmarks:
+        for t in (2, 17, 40, 95):
+            ans = idx.answer_p2p(int(l), t)
+            assert ans is not None and ans["exact"] and ans["landmark"]
+            assert ans["distance"] == int(oracle[int(l)][t])
+            assert ans["met"] is True
+
+
+def test_disconnected_pairs_prove_unreachability_exactly():
+    g = random_graph(300, 150, seed=7)  # sparse: isolated components
+    idx = _warm_index(g, 8)
+    truth = bfs_scipy(g, int(idx.landmarks[0]))
+    s = int(idx.landmarks[0])
+    t = int(np.flatnonzero(truth == INF_DIST)[0])
+    lo, hi, exact = idx.bounds(s, t)
+    assert (lo, hi, exact) == (INF, INF, True)
+    ans = idx.answer_p2p(s, t)
+    assert ans["met"] is False and ans["distance"] is None
+    assert ans["exact"] is True
+
+
+def test_self_pair_is_zero_and_inexact_pairs_return_none():
+    g = GRAPH()
+    idx = _warm_index(g, 4)
+    assert idx.bounds(5, 5) == (0, 0, True)
+    stats0 = idx.stats()
+    for s in range(96):
+        for t in range(0, 96, 9):
+            ans = idx.answer_p2p(s, t)
+            lo, hi, exact = idx.bounds(s, t)
+            assert (ans is None) == (not exact)
+    st = idx.stats()
+    assert st["exact"] > stats0["exact"]
+    assert st["exact"] + st["bounded"] + st["fallback"] > 0
+
+
+def test_directed_graphs_are_rejected():
+    import dataclasses
+
+    g = dataclasses.replace(GRAPH(), undirected=False)
+    with pytest.raises(ValueError, match="undirected"):
+        LandmarkIndex(g, 4)
+
+
+def test_bounds_before_warm_raises():
+    with pytest.raises(RuntimeError, match="warm"):
+        LandmarkIndex(GRAPH(), 4).bounds(0, 1)
+
+
+# --- single-flight (scheduler) ----------------------------------------------
+
+
+def _result_for(q, *, distances=None):
+    return QueryResult(
+        id=q.id, source=q.source, status=STATUS_OK, kind=q.kind,
+        distances=distances, levels=2, reached=9, extras=None,
+        latency_ms=1.0, batch_lanes=1, dispatched_lanes=32,
+    )
+
+
+def test_inflight_index_fans_the_leader_result_to_every_follower():
+    idx = InflightIndex()
+    leader = PendingQuery(7)
+    followers = [PendingQuery(7) for _ in range(4)]
+    assert idx.attach(leader) is None  # first in leads
+    for f in followers:
+        assert idx.attach(f) is leader
+    assert idx.depth() == 1
+    d = np.arange(8, dtype=np.int32)
+    leader.resolve(_result_for(leader, distances=d))
+    for f in followers:
+        r = f.result(0)
+        assert r.ok and r.id == f.id  # own id, shared payload
+        assert r.distances is d
+    assert idx.depth() == 0  # self-released: the next duplicate leads
+    late = PendingQuery(7)
+    assert idx.attach(late) is None
+
+
+def test_inflight_index_separates_non_interchangeable_queries():
+    idx = InflightIndex()
+    assert idx.attach(PendingQuery(7)) is None
+    assert idx.attach(PendingQuery(8)) is None  # other source
+    assert idx.attach(PendingQuery(7, kind="sssp")) is None
+    assert idx.attach(PendingQuery(7, want_distances=False)) is None
+    assert idx.attach(PendingQuery(7, kind="khop", k=2)) is None
+    assert idx.depth() == 5
+
+
+def test_failed_leader_fans_its_failure_out():
+    idx = InflightIndex()
+    leader = PendingQuery(3)
+    follower = PendingQuery(3)
+    idx.attach(leader)
+    assert idx.attach(follower) is leader
+    leader.resolve_status("rejected", error="queue full")
+    r = follower.result(0)
+    assert r.status == "rejected" and r.id == follower.id
+
+
+# --- serve-path integration -------------------------------------------------
+
+
+@pytest.mark.serve
+def test_one_dispatch_serves_all_n_duplicates(svc_reg):
+    """The single-flight spy (cache OFF): N identical queries submitted
+    inside one linger window admit exactly ONE traversal — one batch,
+    one used lane — and every follower gets the leader's bits."""
+    g = GRAPH()
+    svc = BfsService("ac-graph", registry=svc_reg, lanes=32,
+                     width_ladder="off", linger_ms=150.0)
+    try:
+        n = 5
+        qs = [svc.submit(7) for _ in range(n)]
+        rs = [q.result(60.0) for q in qs]
+        assert all(r.ok for r in rs)
+        for r in rs[1:]:
+            assert np.array_equal(r.distances, rs[0].distances)
+        assert len({r.id for r in rs}) == n  # own ids
+        snap = svc.statsz()
+        assert snap["single_flight_collapses"] == n - 1
+        assert snap["batches"] == 1  # ONE dispatch for all five
+        assert snap["completed"] == n  # followers still count
+        assert snap["cache_hits"] == 0  # no cache armed: pure dedupe
+        np.testing.assert_array_equal(rs[0].distances, bfs_scipy(g, 7))
+    finally:
+        svc.close()
+
+
+@pytest.mark.serve
+def test_cache_hit_bypasses_the_scheduler_and_stamps_provenance(svc_reg):
+    g = GRAPH()
+    svc = BfsService("ac-graph", registry=svc_reg, lanes=32,
+                     width_ladder="off", linger_ms=0.0,
+                     cache_bytes=DEFAULT_MAX_BYTES)
+    try:
+        r1 = svc.query(3, timeout=60)
+        assert r1.ok and not (r1.extras or {}).get("cache_hit")
+        deadline = time.monotonic() + 30
+        r2 = None
+        while time.monotonic() < deadline:
+            r2 = svc.query(3, timeout=60)
+            assert r2.ok
+            if (r2.extras or {}).get("cache_hit"):
+                break  # the async populate landed
+        assert (r2.extras or {}).get("cache_hit") is True
+        np.testing.assert_array_equal(r2.distances, r1.distances)
+        assert r2.batch_lanes == 0 and r2.dispatched_lanes == 0
+        snap = svc.statsz()
+        assert snap["cache_hits"] >= 1
+        assert snap["hit_p50_ms"] is not None
+        assert snap["cache"]["entries"] >= 1
+        assert snap["cache_bytes"] > 0
+    finally:
+        svc.close()
+
+
+@pytest.mark.serve
+def test_landmark_exact_p2p_resolves_without_traversing(svc_reg):
+    g = GRAPH()
+    svc = BfsService("ac-graph", registry=svc_reg, lanes=32,
+                     width_ladder="off", linger_ms=0.0, landmarks=4)
+    try:
+        lm = int(select_landmarks(g, 4)[0])
+        oracle = bfs_scipy(g, lm)
+        batches0 = svc.statsz()["batches"]
+        r = svc.query(lm, kind="p2p", target=50, timeout=60)
+        assert r.ok
+        ex = r.extras or {}
+        assert ex.get("landmark") and ex.get("exact")
+        assert ex["distance"] == int(oracle[50])
+        assert svc.statsz()["batches"] == batches0  # no dispatch paid
+        assert svc.statsz()["landmark_exact"] >= 1
+        assert svc.statsz()["landmarks"]["warmed"]
+    finally:
+        svc.close()
+
+
+@pytest.mark.serve
+@pytest.mark.chaos
+def test_stale_cache_hit_quarantines_the_generation_not_a_rung(svc_reg):
+    """The tentpole's audit integration, in-process: a CRC-valid stale
+    hit is caught by the sampled shadow re-execution and the CACHE
+    GENERATION is quarantined — the rung quarantine counter stays 0,
+    no breaker opens, and the repeat query misses the new generation
+    and traverses oracle-exact."""
+    g = GRAPH()
+    svc = BfsService("ac-graph", registry=svc_reg, lanes=64,
+                     width_ladder="32,64", linger_ms=0.0,
+                     cache_bytes=DEFAULT_MAX_BYTES, audit_rate=1.0)
+    try:
+        r1 = svc.query(0, timeout=120)
+        assert r1.ok
+        deadline = time.monotonic() + 30  # async populate
+        while time.monotonic() < deadline:
+            if svc.statsz()["cache"]["entries"]:
+                break
+            time.sleep(0.01)
+        faults.arm_from_spec("seed=7:stale_cache:n=1")
+        r2 = svc.query(0, timeout=120)
+        assert r2.ok and (r2.extras or {}).get("cache_hit")
+        assert not np.array_equal(r2.distances, bfs_scipy(g, 0))  # the lie
+        assert svc.flush_audits(120)
+        deadline = time.monotonic() + 30  # mismatch -> quarantine is async
+        while time.monotonic() < deadline:
+            if svc.statsz()["cache_quarantines"]:
+                break
+            time.sleep(0.01)
+        faults.disarm()
+        snap = svc.statsz()
+        assert snap["audit_failures"] >= 1
+        assert snap["cache_quarantines"] >= 1
+        assert snap["quarantines"] == 0  # NOT a rung incident
+        assert not snap["breaker_open"]
+        r3 = svc.query(0, timeout=120)
+        assert r3.ok and not (r3.extras or {}).get("cache_hit")
+        np.testing.assert_array_equal(r3.distances, bfs_scipy(g, 0))
+    finally:
+        svc.close()
+
+
+@pytest.mark.serve
+@pytest.mark.chaos
+def test_corrupt_cache_entry_degrades_to_a_clean_traversal(svc_reg):
+    g = GRAPH()
+    svc = BfsService("ac-graph", registry=svc_reg, lanes=32,
+                     width_ladder="off", linger_ms=0.0,
+                     cache_bytes=DEFAULT_MAX_BYTES)
+    try:
+        assert svc.query(0, timeout=120).ok
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if svc.statsz()["cache"]["entries"]:
+                break
+            time.sleep(0.01)
+        faults.arm_from_spec("seed=5:corrupt_cache_entry:n=1")
+        r = svc.query(0, timeout=120)
+        faults.disarm()
+        assert r.ok and not (r.extras or {}).get("cache_hit")
+        np.testing.assert_array_equal(r.distances, bfs_scipy(g, 0))
+        snap = svc.statsz()
+        assert snap["cache_evictions"] >= 1
+    finally:
+        svc.close()
+
+
+@pytest.mark.serve
+def test_clean_audited_cache_soak_has_zero_findings(svc_reg):
+    """Hits replayed by the shadow auditor on clean hardware must never
+    produce a finding — the provenance extras are stripped before the
+    compare, so ``cache_hit: True`` is not read as corruption."""
+    g = GRAPH()
+    svc = BfsService("ac-graph", registry=svc_reg, lanes=64,
+                     width_ladder="32,64", linger_ms=0.0,
+                     cache_bytes=DEFAULT_MAX_BYTES, landmarks=4,
+                     audit_rate=1.0)
+    try:
+        for _ in range(3):
+            for s in (0, 3, 5):
+                assert svc.query(s, timeout=120).ok
+        lm = int(select_landmarks(g, 4)[0])
+        assert svc.query(lm, kind="p2p", target=40, timeout=120).ok
+        assert svc.flush_audits(120)
+        snap = svc.statsz()
+        assert snap["audits_run"] >= 4
+        assert snap["audit_failures"] == 0
+        assert snap["quarantines"] == 0
+        assert snap["cache_quarantines"] == 0
+    finally:
+        svc.close()
+
+
+@pytest.mark.serve
+def test_cache_off_by_default_and_statsz_shape(svc_reg):
+    g = GRAPH()
+    svc = BfsService("ac-graph", registry=svc_reg, lanes=32,
+                     width_ladder="off", linger_ms=0.0)
+    try:
+        assert svc.query(0, timeout=60).ok
+        snap = svc.statsz()
+        assert "cache" not in snap  # config echo only when armed
+        assert "landmarks" not in snap
+        assert snap["cache_hits"] == 0 and snap["cache_misses"] == 0
+    finally:
+        svc.close()
+
+
+def test_exporter_renders_the_new_counters_as_counters():
+    from tpu_bfs.obs.exporters import prometheus_text
+
+    text = prometheus_text({
+        "cache_hits": 3, "cache_misses": 2, "cache_bytes": 1024,
+        "single_flight_collapses": 4, "landmark_exact": 5,
+        "cache": {"entries": 1, "bytes": 1024},
+    })
+    assert "# TYPE tpu_bfs_serve_cache_hits counter" in text
+    assert "# TYPE tpu_bfs_serve_cache_bytes gauge" in text  # gauge!
+    assert "# TYPE tpu_bfs_serve_single_flight_collapses counter" in text
+    assert 'tpu_bfs_serve_cache{key="entries"} 1' in text

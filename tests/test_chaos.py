@@ -73,8 +73,11 @@ def test_chaos_soak_serve_bit_identical(chaos_graph, chaos_golden):
     reg.add_graph("soak", chaos_graph)
     COUNTERS.reset()
     sources = list(chaos_golden) * 4  # 40 queries: fills the 64 rung
+    # single_flight off: the soak repeats 10 sources x4 to FILL the 64
+    # rung — collapsed duplicates would shrink the batch under the
+    # rung=64 fault's target and the schedule would never fire.
     svc = BfsService("soak", registry=reg, lanes=64, width_ladder="32,64",
-                     linger_ms=5.0, autostart=False)
+                     linger_ms=5.0, autostart=False, single_flight=False)
     svc.start()  # warm BEFORE arming: the soak targets serving dispatches
     sched = faults.arm_from_spec(
         "seed=9:transient@serve_batch:n=1,oom@rung=64:n=1,"
@@ -357,6 +360,7 @@ def test_requeue_budget_sheds_with_attempt_history(fake_graph, monkeypatch):
     svc = _svc_with_engines(
         fake_graph, monkeypatch, engines, lanes=128,
         width_ladder="32,64,128", linger_ms=20.0, max_requeues=1,
+        single_flight=False,  # 100 queries over 8 sources must all admit
     )
     staged = [svc.submit(i % 8) for i in range(100)]  # fills the 128 rung
     svc.start()

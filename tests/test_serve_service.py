@@ -78,7 +78,10 @@ def test_closed_loop_64_clients_saturates_batches(serve_registry,
     oracle-validated."""
     sources = list(serve_golden)
     clients, per_client = 64, 3
-    with _svc(serve_registry, linger_ms=20.0, queue_cap=256) as svc:
+    # single_flight off: 64 clients over 16 sources collapse otherwise,
+    # and this test is ABOUT saturating lanes with duplicate traffic.
+    with _svc(serve_registry, linger_ms=20.0, queue_cap=256,
+              single_flight=False) as svc:
         results = [None] * clients
 
         def client(ci):
@@ -216,7 +219,10 @@ def test_build_oom_degrade_splits_popped_batch(serve_registry, serve_golden,
     served at the degraded 32-lane width (head now, tail re-admitted) —
     never resolved as errors (the build-OOM twin of the dispatch-OOM
     requeue path)."""
-    svc = _svc(serve_registry, lanes=64, autostart=False)
+    # single_flight off: the 40-query burst repeats 16 sources and must
+    # stay 40 admitted lanes for the split arithmetic below.
+    svc = _svc(serve_registry, lanes=64, autostart=False,
+               single_flight=False)
     real_get = svc._registry.get
     calls = []
 
@@ -265,7 +271,10 @@ def test_adaptive_width_routes_low_load_to_narrow_rung(serve_registry,
     with every response still oracle-validated, and fill is reported
     against the DISPATCHED width."""
     sources = list(serve_golden)
-    svc = _svc(serve_registry, lanes=64, linger_ms=5.0, autostart=False)
+    # single_flight off: the staged 40-query burst repeats 16 sources
+    # and must coalesce into one 40-lane batch, not collapse to 16.
+    svc = _svc(serve_registry, lanes=64, linger_ms=5.0, autostart=False,
+               single_flight=False)
     assert svc.width_ladder == [32, 64]
     # Stage a 40-query burst: it must coalesce into one 64-routed batch.
     staged = [svc.submit(sources[i % len(sources)]) for i in range(40)]

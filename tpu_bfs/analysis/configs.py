@@ -158,6 +158,12 @@ def _build_engine(config: str):
             "serve-khop": dict(kind="khop", engine="wide", lanes=64),
             "serve-cc": dict(kind="cc", engine="wide", lanes=64),
             "serve-p2p": dict(kind="p2p", engine="wide", lanes=64),
+            # The landmark warm-up program (ISSUE 18): the flagship
+            # MS-BFS batch that computes the K distance columns rides
+            # the wide bfs engine at the rung the warm-up routes K
+            # onto (K=16 -> the 32 rung) — analyze the exact compile
+            # the serve warm-up dispatches.
+            "serve-landmark-warm": dict(engine="wide", lanes=32),
         }.get(config)
         if kw is None:
             raise KeyError(config)
@@ -180,6 +186,7 @@ ALL_CONFIGS = (
     "hybrid-dense", "hybrid-sparse", "hybrid-sliced",
     "serve-dist-wide", "serve-dist-hybrid", "serve-dist2d",
     "serve-sssp", "serve-khop", "serve-cc", "serve-p2p",
+    "serve-landmark-warm",
     "serve-wide-pallas", "serve-sssp-pallas",
 )
 

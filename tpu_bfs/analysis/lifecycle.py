@@ -75,6 +75,10 @@ DEFAULT_MODULES = (
     "tpu_bfs/integrity/__init__.py",
     "tpu_bfs/integrity/shadow.py",
     "tpu_bfs/integrity/structural.py",
+    # ISSUE 18: the answer tier — the landmark warm-up opens an obs
+    # span that must close on the warm-up failure path too.
+    "tpu_bfs/serve/answercache.py",
+    "tpu_bfs/workloads/landmarks.py",
 )
 
 

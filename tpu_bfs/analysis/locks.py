@@ -492,6 +492,10 @@ DEFAULT_MODULES = (
     "tpu_bfs/integrity/__init__.py",
     "tpu_bfs/integrity/shadow.py",
     "tpu_bfs/integrity/structural.py",
+    # ISSUE 18: the answer tier — LRU cache state and the landmark hit
+    # counters are mutated from every client thread at once.
+    "tpu_bfs/serve/answercache.py",
+    "tpu_bfs/workloads/landmarks.py",
 )
 
 

@@ -24,6 +24,7 @@ sssp_dispatch workloads/sssp.SsspEngine.dispatch (weighted workload)
 sssp_fetch  workloads/sssp.SsspEngine.fetch (blocking result half)
 audit_structural integrity/structural.StructuralAuditor.audit
 audit_shadow integrity/shadow.ShadowAuditor replay (background)
+cache_lookup serve/answercache.AnswerCache.get (hit verification)
 ========== =======================================================
 
 Production code never pays for this when disabled: every site guard is
@@ -44,6 +45,7 @@ Spec grammar (``--faults`` / ``TPU_BFS_FAULTS``)::
     kind    := "transient" | "oom" | "slow" | "slow_extract"
              | "corrupt_ckpt" | "corrupt_aot"
              | "corrupt_result" | "corrupt_wire"
+             | "stale_cache" | "corrupt_cache_entry"
              | "device_lost" | "collective_hang" | "backend_restart"
 
 Examples::
@@ -111,6 +113,13 @@ SITES = (
     # failures or false corruption findings.
     "audit_structural",
     "audit_shadow",
+    # ISSUE 18: the answer cache's hit path (serve/answercache.py) —
+    # corrupt_cache_entry flips a stored payload byte so the CRC32
+    # verification fires (hit degrades to a miss + eviction);
+    # stale_cache serves a CRC-valid but WRONG answer so only the
+    # sampled shadow audit can catch it (the generation-quarantine
+    # drive).
+    "cache_lookup",
 )
 
 # Where a clause lands when it names no "@site". slow_extract is the
@@ -130,6 +139,10 @@ DEFAULT_SITE = {
     # two checksum folds) — every integrity detector's red-before-green.
     "corrupt_result": "fetch",
     "corrupt_wire": "fetch",
+    # ISSUE 18 cache kinds: in-place mutations of a cache hit, consulted
+    # at the answer cache's lookup site only.
+    "stale_cache": "cache_lookup",
+    "corrupt_cache_entry": "cache_lookup",
     "device_lost": "fetch",
     "collective_hang": "fetch",
     "backend_restart": "fetch",
@@ -555,6 +568,53 @@ def maybe_corrupt_result(dist, extras, reached, **ctx):
     mutated in place (the distance row is copied before the flip)."""
     sched = ACTIVE
     if sched is None or not sched.take("fetch", "corrupt_result", **ctx):
+        return dist, extras, reached, False
+    import numpy as np
+
+    from tpu_bfs.graph.csr import INF_DIST
+
+    if dist is not None:
+        dist = np.array(dist, copy=True)
+        fin = np.flatnonzero(dist != INF_DIST)
+        i = int(fin[len(fin) // 2]) if len(fin) else 0
+        dist[i] ^= 1
+        return dist, extras, reached, True
+    if extras:
+        extras = dict(extras)
+        for key, val in extras.items():
+            if isinstance(val, int) and not isinstance(val, bool):
+                extras[key] = val + 1
+                return dist, extras, reached, True
+    return dist, extras, (reached if reached is None else reached + 1), True
+
+
+def maybe_corrupt_cache_blob(blob: bytes, **ctx) -> tuple[bytes, bool]:
+    """``cache_lookup`` site hook for ``corrupt_cache_entry`` rules
+    (ISSUE 18): flip one byte of a cache entry's stored payload blob at
+    hit time, so the entry's CRC32 verification fires and the hit
+    degrades to a miss + eviction — the cache's storage-rot
+    red-before-green. Returns ``(blob, fired)``."""
+    sched = ACTIVE
+    if sched is None or not sched.take("cache_lookup",
+                                       "corrupt_cache_entry", **ctx):
+        return blob, False
+    if not blob:
+        return b"\x00", True
+    off = len(blob) // 2
+    return (blob[:off] + bytes([blob[off] ^ 0xFF]) + blob[off + 1:]), True
+
+
+def maybe_stale_cache(dist, extras, reached, **ctx):
+    """``cache_lookup`` site hook for ``stale_cache`` rules (ISSUE 18):
+    mutate a CRC-VALID cache hit the same way ``maybe_corrupt_result``
+    mutates a fresh answer — the checksum discipline cannot catch a
+    stale-but-intact entry, so this is the drive that proves the sampled
+    shadow audit quarantines the cache GENERATION. Returns
+    ``(dist, extras, reached, fired)``; inputs are never mutated in
+    place."""
+    sched = ACTIVE
+    if sched is None or not sched.take("cache_lookup", "stale_cache",
+                                       **ctx):
         return dist, extras, reached, False
     import numpy as np
 

@@ -294,7 +294,60 @@ class IntegrityTier:
             return
         svc.metrics.record_audit((time.monotonic() - t0) * 1e3)
 
+    # --- the answer-tier hook (ISSUE 18, client thread) -------------------
+
+    def observe_answer(self, q, *, origin: str) -> None:
+        """Audit one answer served WITHOUT a traversal (cache hit or
+        exact landmark bound): the same deterministic shadow sample as
+        batch resolutions, replayed on the disjoint rung. The ShadowJob
+        carries ``origin`` so a confirmed mismatch quarantines the cache
+        generation / landmark index — the replay rung told the truth."""
+        if self._shadow is None:
+            return
+        svc = self._service
+        try:
+            if not self._sampler.should_sample():
+                return
+            r = q.result(0)
+            if not r.ok:
+                return
+            job = ShadowJob(
+                query_id=q.id, kind=r.kind, source=q.source,
+                k=getattr(q, "k", None),
+                target=getattr(q, "target", None),
+                width=svc.width_ladder[0],
+                devices=svc._mesh_cfg.devices,
+                distances=r.distances, levels=r.levels,
+                reached=r.reached,
+                extras=dict(r.extras) if r.extras else None,
+                t_resolved=time.monotonic(),
+                origin=origin,
+            )
+            self._shadow.offer(job)
+        except Exception as exc:  # noqa: BLE001 — audits never become
+            # serving incidents (same seal as observe_batch).
+            svc.metrics.record_audit_error()
+            svc._log(
+                f"answer-tier audit errored (query "
+                f"{getattr(q, 'id', None)!r}): "
+                f"{type(exc).__name__}: {str(exc)[:200]}"
+            )
+
     def _on_shadow_mismatch(self, job: ShadowJob, detail: str) -> None:
+        origin = getattr(job, "origin", "serve")
+        if origin in ("cache", "landmark"):
+            # The replay ran on a healthy rung and disagreed with a
+            # bypass answer: the stale/corrupt thing is the CACHED
+            # payload (or the landmark columns), not the rung — indict
+            # the answer tier's generation, never the replay rung (the
+            # ``quarantines`` counter stays rung-only; the cache tier
+            # counts its own ``cache_quarantines``).
+            self._service._log(
+                f"CORRUPTION (shadow/{origin}) on query {job.query_id!r}: "
+                f"{detail[:300]} — quarantining the {origin} tier"
+            )
+            self._service.quarantine_answer_tier(origin, detail=detail)
+            return
         self.quarantine.report(
             width=job.width, devices=job.devices, kind=job.kind,
             query_id=job.query_id, detail=detail, source="shadow",

@@ -103,12 +103,22 @@ class ShadowJob:
     reached: int | None
     extras: dict | None
     t_resolved: float
+    # Where the served answer came from (ISSUE 18): "serve" for a batch
+    # resolution, "cache"/"landmark" for the answer tier's bypass paths.
+    # The quarantine routing keys on this — a stale cached answer
+    # indicts the cache generation, never the replay rung.
+    origin: str = "serve"
 
 
 #: Extras keys that legitimately vary with batch composition (the sssp
 #: round count is the WHOLE batch's fixed-point iteration count) — the
-#: shadow compare must not read them as corruption.
-_BATCH_DEPENDENT_EXTRAS = frozenset(("sssp_rounds",))
+#: shadow compare must not read them as corruption. The answer tier's
+#: provenance stamps (ISSUE 18: cache_hit/landmark/exact/bounds) are
+#: metadata about HOW the answer was served, not part of the payload,
+#: so a replay legitimately lacks them.
+from tpu_bfs.serve.answercache import PROVENANCE_EXTRAS  # noqa: E402
+
+_BATCH_DEPENDENT_EXTRAS = frozenset(("sssp_rounds",)) | PROVENANCE_EXTRAS
 
 
 def compare_payloads(job: ShadowJob, res) -> str | None:

@@ -124,6 +124,11 @@ _COUNTER_KEYS = frozenset((
     "mesh_faults", "mesh_degrades", "query_resumes", "resume_snapshots",
     "audits_run", "audit_failures", "audit_errors", "audit_dropped",
     "quarantines",
+    # Answer cache + landmark tier (ISSUE 18). cache_bytes is the
+    # resident-payload gauge and deliberately absent here.
+    "cache_hits", "cache_misses", "cache_evictions", "cache_quarantines",
+    "single_flight_collapses", "landmark_exact", "landmark_bounded",
+    "landmark_fallback",
 ))
 
 

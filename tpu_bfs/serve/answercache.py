@@ -1,0 +1,279 @@
+"""Byte-budgeted answer cache for the serve hot path (ISSUE 18).
+
+Production traffic at scale is Zipfian: the same hub sources are asked
+constantly, yet before this tier every served query paid a full
+traversal. The :class:`AnswerCache` resolves the popular head without
+touching the scheduler at all:
+
+- **bounded LRU, byte-budgeted**: entries are whole terminal payloads
+  (distance row, levels, reached, extras) keyed
+  ``(graph_key, graph_generation, cache_generation, kind, source,
+  k, target, want_distances)``; inserting past ``max_bytes`` evicts
+  from the cold end. The graph-generation field is constant today and
+  exists so ROADMAP item 2's dynamic-graph generation flip invalidates
+  every entry by key, not by scan.
+- **CRC32 discipline** (the PR 4 checkpoint rule, applied in memory):
+  each entry's payload blob is checksummed at ``put`` and re-verified
+  at every hit; a mismatch — storage rot, or the ``corrupt_cache_entry``
+  chaos kind flipping a byte at the ``cache_lookup`` fault site —
+  degrades the hit to a miss and evicts the entry. The ``stale_cache``
+  kind mutates a CRC-VALID hit instead, which only the sampled shadow
+  audit can catch (tpu_bfs/integrity): a confirmed stale entry
+  quarantines the cache GENERATION, not a serving rung.
+- **population at resolve time**: the extraction worker calls ``put``
+  after a batch resolves (serve/frontend._finish) — the dispatch path
+  never writes the cache, so a cache stall cannot delay a dispatch.
+
+Single-flight collapsing of identical in-flight queries lives with the
+admission machinery (serve/scheduler.InflightIndex) — it dedupes
+traversals whether or not this cache is armed; the cache then keeps the
+answer around after the flight lands.
+
+Thread-safe: client threads hit ``get`` concurrently with the
+extraction worker's ``put`` and the audit thread's
+``quarantine_generation``; one lock guards the store.
+"""
+
+from __future__ import annotations
+
+import threading
+import zlib
+from collections import OrderedDict
+
+import numpy as np
+
+from tpu_bfs import faults
+from tpu_bfs import obs as _obs
+
+#: Default payload budget: ~64 MB holds ~4000 scale-12 distance rows —
+#: far past the Zipfian head a serving replica actually sees.
+DEFAULT_MAX_BYTES = 64 << 20
+
+#: Extras keys this tier STAMPS onto responses (provenance + bound
+#: metadata). The shadow auditor strips them before comparing a cached
+#: answer against its replay (integrity/shadow.compare_payloads), and
+#: the fuzz arms ignore them when pinning cache-on == cache-off.
+PROVENANCE_EXTRAS = frozenset(
+    ("cache_hit", "landmark", "exact", "bound_lo", "bound_hi")
+)
+
+
+class _Entry:
+    __slots__ = ("key", "blob", "levels", "reached", "extras", "crc",
+                 "nbytes", "width", "devices")
+
+    def __init__(self, key, blob, levels, reached, extras, crc, nbytes,
+                 width, devices):
+        self.key = key
+        self.blob = blob  # distance row bytes, or None (metadata kinds)
+        self.levels = levels
+        self.reached = reached
+        self.extras = extras
+        self.crc = crc
+        self.nbytes = nbytes
+        self.width = width
+        self.devices = devices
+
+
+def _payload_crc(blob: bytes | None, levels, reached, extras) -> int:
+    """CRC32 over the full terminal payload — the distance blob plus a
+    canonical rendering of the metadata fields, so a mutation of ANY
+    served field (not just the distance row) trips verification."""
+    crc = zlib.crc32(blob) if blob is not None else zlib.crc32(b"\x00")
+    meta = repr((levels, reached,
+                 sorted(extras.items()) if extras else None))
+    return zlib.crc32(meta.encode(), crc)
+
+
+class AnswerCache:
+    """The serve tier's resolved-answer store. See the module docstring
+    for the contract; :class:`~tpu_bfs.serve.metrics.ServeMetrics` hooks
+    (when provided) keep hits/misses/evictions/bytes on statsz."""
+
+    def __init__(self, *, graph_key: str = "", graph_generation: int = 0,
+                 max_bytes: int = DEFAULT_MAX_BYTES, metrics=None,
+                 log=None):
+        if max_bytes < 1:
+            raise ValueError(f"cache byte budget must be >= 1, got "
+                             f"{max_bytes}")
+        self.graph_key = graph_key
+        self.graph_generation = int(graph_generation)
+        self.max_bytes = int(max_bytes)
+        self.metrics = metrics
+        self.log = log or (lambda *_a, **_k: None)
+        self._lock = threading.Lock()
+        self._entries: OrderedDict = OrderedDict()  # guarded-by: _lock
+        self._bytes = 0  # guarded-by: _lock
+        self._generation = 0  # guarded-by: _lock
+        self._quarantines = 0  # guarded-by: _lock
+
+    # --- keys -------------------------------------------------------------
+
+    def _key(self, kind, source, k, target, want_distances,
+             generation) -> tuple:
+        return (self.graph_key, self.graph_generation, generation,
+                kind, int(source),
+                None if k is None else int(k),
+                None if target is None else int(target),
+                bool(want_distances))
+
+    def generation(self) -> int:
+        with self._lock:
+            return self._generation
+
+    # --- store ------------------------------------------------------------
+
+    def put(self, *, kind: str, source: int, k=None, target=None,
+            want_distances: bool = True, distances=None, levels=None,
+            reached=None, extras=None, width=None, devices=None) -> None:
+        """Insert one resolved payload (extraction-worker path). Extras
+        are stored without this tier's own provenance keys, so a
+        re-served hit stamps fresh provenance instead of echoing stale
+        ones."""
+        if extras:
+            extras = {k2: v for k2, v in extras.items()
+                      if k2 not in PROVENANCE_EXTRAS}
+        blob = None
+        if distances is not None:
+            blob = np.ascontiguousarray(distances, dtype=np.int32).tobytes()
+        nbytes = (len(blob) if blob else 64) + 64
+        if nbytes > self.max_bytes:
+            return  # one oversized row must not wipe the whole cache
+        crc = _payload_crc(blob, levels, reached, extras)
+        evicted = 0
+        with self._lock:
+            key = self._key(kind, source, k, target, want_distances,
+                            self._generation)
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old.nbytes
+            self._entries[key] = _Entry(
+                key, blob, levels, reached, extras, crc, nbytes,
+                width, devices,
+            )
+            self._bytes += nbytes
+            while self._bytes > self.max_bytes and len(self._entries) > 1:
+                _, cold = self._entries.popitem(last=False)
+                self._bytes -= cold.nbytes
+                evicted += 1
+            nbytes_now = self._bytes
+        if self.metrics is not None:
+            if evicted:
+                self.metrics.record_cache_eviction(evicted)
+            self.metrics.set_cache_bytes(nbytes_now)
+
+    def get(self, *, kind: str, source: int, k=None, target=None,
+            want_distances: bool = True):
+        """One lookup on the submit path. Returns a payload dict
+        (``distances``/``levels``/``reached``/``extras``/``width``/
+        ``devices``/``generation``) or None on miss — including the
+        degraded-to-miss path where CRC verification caught a corrupt
+        entry (the entry is evicted and the miss is counted)."""
+        with self._lock:
+            gen = self._generation
+            key = self._key(kind, source, k, target, want_distances, gen)
+            e = self._entries.get(key)
+            if e is not None:
+                self._entries.move_to_end(key)
+                blob = e.blob
+        if e is None:
+            if self.metrics is not None:
+                self.metrics.record_cache_miss()
+            return None
+        if faults.ACTIVE is not None:
+            # Chaos: corrupt_cache_entry rots the STORED blob so the
+            # verification below fires exactly as on real storage rot.
+            if blob is not None:
+                blob, fired = faults.maybe_corrupt_cache_blob(
+                    blob, query_kind=kind, source=source,
+                )
+                if fired:
+                    with self._lock:
+                        e.blob = blob
+        if _payload_crc(e.blob, e.levels, e.reached, e.extras) != e.crc:
+            self._evict_corrupt(key, e)
+            return None
+        dist = None
+        if e.blob is not None:
+            dist = np.frombuffer(e.blob, dtype=np.int32)
+        extras = dict(e.extras) if e.extras else None
+        reached = e.reached
+        if faults.ACTIVE is not None:
+            # Chaos: stale_cache serves a CRC-valid wrong answer — the
+            # shadow audit's generation-quarantine red-before-green.
+            dist, extras, reached, _fired = faults.maybe_stale_cache(
+                dist, extras, reached, query_kind=kind, source=source,
+            )
+        return {
+            "distances": dist,
+            "levels": e.levels,
+            "reached": reached,
+            "extras": extras,
+            "width": e.width,
+            "devices": e.devices,
+            "generation": gen,
+        }
+
+    def _evict_corrupt(self, key, e) -> None:
+        with self._lock:
+            if self._entries.get(key) is e:
+                self._entries.pop(key)
+                self._bytes -= e.nbytes
+            nbytes_now = self._bytes
+        self.log(f"answer cache: CRC mismatch on {key!r} — entry "
+                 f"evicted, hit degraded to a miss")
+        rec = _obs.ACTIVE
+        if rec is not None:
+            rec.event("cache_corrupt_entry", cat="serve.cache",
+                      kind=key[3], source=key[4])
+        if self.metrics is not None:
+            self.metrics.record_cache_eviction()
+            self.metrics.record_cache_miss()
+            self.metrics.set_cache_bytes(nbytes_now)
+
+    # --- quarantine -------------------------------------------------------
+
+    def quarantine_generation(self, *, detail: str = "") -> int:
+        """A confirmed stale/corrupt CACHED answer poisons trust in the
+        whole resident generation, not one entry and not a serving rung:
+        bump the generation (every old key becomes unreachable) and drop
+        the store. Returns the new generation."""
+        with self._lock:
+            self._generation += 1
+            self._quarantines += 1
+            self._entries.clear()
+            self._bytes = 0
+            gen = self._generation
+        self.log(f"answer cache QUARANTINED -> generation {gen}"
+                 + (f" ({detail})" if detail else ""))
+        rec = _obs.ACTIVE
+        if rec is not None:
+            rec.event("cache_quarantine", cat="serve.cache",
+                      generation=gen, detail=detail)
+            rec.flight_dump("cache_quarantine")
+        if self.metrics is not None:
+            self.metrics.record_cache_quarantine()
+            self.metrics.set_cache_bytes(0)
+        return gen
+
+    # --- introspection ----------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes": self._bytes,
+                "max_bytes": self.max_bytes,
+                "generation": self._generation,
+                "quarantines": self._quarantines,
+            }
+
+    def config_summary(self) -> dict:
+        """The statsz config echo (mirrors IntegrityTier's)."""
+        out = self.stats()
+        out["graph_generation"] = self.graph_generation
+        return out
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)

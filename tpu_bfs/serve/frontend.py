@@ -53,15 +53,19 @@ from tpu_bfs.serve.executor import (
     MeshFaultRequeue,
     OomRequeue,
 )
+from tpu_bfs.serve.answercache import AnswerCache
 from tpu_bfs.serve.metrics import ServeMetrics
 from tpu_bfs.serve.registry import DEFAULT_PLANES, EngineRegistry, EngineSpec
 from tpu_bfs.serve.scheduler import (
     STATUS_ERROR,
     STATUS_EXPIRED,
+    STATUS_OK,
     STATUS_REJECTED,
     STATUS_SHUTDOWN,
     AdmissionQueue,
+    InflightIndex,
     PendingQuery,
+    QueryResult,
 )
 from tpu_bfs.utils.recovery import (
     COUNTERS,
@@ -252,6 +256,9 @@ class BfsService:
         audit_structural: bool = False,
         audit_checksum: bool = False,
         audit_seed: int = 0,
+        cache_bytes: int = 0,
+        landmarks: int = 0,
+        single_flight: bool = True,
         distances: bool = True,
         kinds=None,
         registry: EngineRegistry | None = None,
@@ -395,6 +402,23 @@ class BfsService:
                 self._registry.capacity = self._registry.capacity + 2
         else:
             self._integrity = None
+        # Answer tier (ISSUE 18). Single-flight collapsing is on by
+        # default (N concurrent identical queries admit one traversal)
+        # and independent of the cache knobs; ``single_flight=False``
+        # exists for saturation/load harnesses that hammer one source
+        # to fill lanes on purpose. The result cache and the landmark
+        # distance columns are armed by their knobs. Hits bypass the
+        # scheduler entirely and stamp cache_hit/landmark provenance.
+        self._inflight = InflightIndex() if single_flight else None
+        self._cache = (
+            AnswerCache(
+                graph_key=self._graph_key, max_bytes=int(cache_bytes),
+                metrics=self.metrics, log=self._log,
+            )
+            if cache_bytes else None
+        )
+        self._landmark_k = max(int(landmarks), 0)
+        self._landmarks = None  # built by start()'s warm-up when armed
         self._want_distances_default = bool(distances)
         self._pipe_q: _queue.Queue | None = (
             _queue.Queue(maxsize=max(1, int(pipeline_depth)))
@@ -451,6 +475,11 @@ class BfsService:
             for w in sorted(self.width_ladder, reverse=True):
                 if w <= self.lanes:  # rungs above a degraded cap died
                     self._acquire_engine(w, self._primary_kind)
+            if self._landmark_k > 0:
+                # Landmark warm-up (ISSUE 18): one flagship MS-BFS
+                # batch on the cold-start path, before READY — the K
+                # distance columns then answer p2p in microseconds.
+                self._warm_landmarks()
             if (self._mesh_probe_interval_s > 0
                     and self._cfg0.devices > 1
                     and self._mesh_probe is None):
@@ -598,6 +627,22 @@ class BfsService:
             q.resolve_status(STATUS_ERROR, error=err)
             self.metrics.record_errors()
             return q
+        # Answer tier (ISSUE 18), ahead of admission: a cache or
+        # landmark hit resolves here — microseconds of host work, no
+        # scheduler, no lane — and a duplicate of an in-flight query
+        # becomes a single-flight follower that rides the leader's
+        # dispatch. Order matters: the cache is consulted first (exact
+        # stored payloads beat recomputed bounds), and single-flight
+        # last (only queries that will actually admit need a leader).
+        if not (self._closed or self._draining):
+            if self._try_answer_tier(q):
+                return q
+            leader = (self._inflight.attach(q)
+                      if self._inflight is not None else None)
+            if leader is not None:
+                self.metrics.record_single_flight()
+                q.add_done_callback(self._account_follower)
+                return q
         if self._closed or self._draining or not self._queue.offer(q):
             q.resolve_status(
                 STATUS_REJECTED,
@@ -645,6 +690,143 @@ class BfsService:
                 )
         return None
 
+    # --- answer tier (ISSUE 18) -------------------------------------------
+
+    def _try_answer_tier(self, q: PendingQuery) -> bool:
+        """Resolve ``q`` from the answer cache or the landmark columns
+        without traversing; False sends it on to single-flight and
+        admission. Only EXACT landmark answers are served — a bounded
+        bracket falls back to traversal so an armed service stays
+        bit-identical to a disarmed one."""
+        cache = self._cache
+        if cache is not None:
+            hit = cache.get(
+                kind=q.kind, source=q.source, k=q.k, target=q.target,
+                want_distances=q.want_distances,
+            )
+            if hit is not None:
+                self._resolve_hit(q, hit)
+                return True
+        lm = self._landmarks
+        if lm is not None and q.kind == "p2p" and lm.warmed:
+            extras = lm.answer_p2p(q.source, q.target)
+            if extras is not None:
+                self._resolve_landmark(q, extras)
+                return True
+        return False
+
+    def _resolve_hit(self, q: PendingQuery, hit: dict) -> None:
+        extras = dict(hit["extras"]) if hit["extras"] else {}
+        extras["cache_hit"] = True
+        lat = (time.monotonic() - q.t_submit) * 1e3
+        if q.resolve(QueryResult(
+            id=q.id, source=q.source, status=STATUS_OK, kind=q.kind,
+            distances=hit["distances"] if q.want_distances else None,
+            levels=hit["levels"], reached=hit["reached"], extras=extras,
+            latency_ms=lat,
+            # No batch existed: 0/0 says "no lane was paid for", and the
+            # gteps property correctly reports None.
+            batch_lanes=0, dispatched_lanes=0, devices=hit["devices"],
+        )):
+            self.metrics.record_cache_hit(lat)
+            self._audit_answer(q, origin="cache")
+
+    def _resolve_landmark(self, q: PendingQuery, extras: dict) -> None:
+        lat = (time.monotonic() - q.t_submit) * 1e3
+        if q.resolve(QueryResult(
+            id=q.id, source=q.source, status=STATUS_OK, kind=q.kind,
+            extras=extras, latency_ms=lat,
+            batch_lanes=0, dispatched_lanes=0,
+        )):
+            self.metrics.record_cache_hit(lat, landmark=True)
+            self._audit_answer(q, origin="landmark")
+
+    def _audit_answer(self, q: PendingQuery, *, origin: str) -> None:
+        """Sampled shadow audit of a cache/landmark-resolved answer
+        (ISSUE 18 x PR 15): the same deterministic sampler and disjoint
+        replay rung as served batches, with the job tagged by origin so
+        a confirmed mismatch quarantines the cache GENERATION (or drops
+        the landmark tier), never a serving rung."""
+        tier = self._integrity
+        if tier is not None:
+            tier.observe_answer(q, origin=origin)
+
+    def _account_follower(self, q: PendingQuery) -> None:
+        """Metrics for a single-flight follower's resolution: followers
+        never enter the queue or a batch, so the batch-side counters
+        never see them — account by terminal status here (the
+        completed/rejected/... totals must still sum to submissions)."""
+        r = q.result(0)
+        if r.ok:
+            self.metrics.record_follower_completed()
+        elif r.status == STATUS_REJECTED:
+            self.metrics.record_rejected()
+        elif r.status == STATUS_EXPIRED:
+            self.metrics.record_expired()
+        elif r.status == STATUS_SHUTDOWN:
+            self.metrics.record_shutdown()
+        else:
+            self.metrics.record_errors()
+
+    def _warm_landmarks(self) -> None:
+        """Build + warm the landmark distance columns with ONE flagship
+        batch on a ladder rung (landmarks are just lanes). Degrades to
+        disarmed on any failure — the tier is an optimization, and a
+        service that cannot warm it must still reach READY."""
+        if "p2p" not in self._kinds:
+            # The tier only answers p2p (the symmetric triangle bound
+            # needs an undirected graph — the same gate as the p2p
+            # workload itself, so "p2p unserved" covers directed too).
+            self._log(
+                "landmark tier requested but p2p is not served by this "
+                "config; skipping warm-up"
+            )
+            return
+        from tpu_bfs.workloads.landmarks import LandmarkIndex
+
+        k = min(self._landmark_k, self.lanes)
+        try:
+            index = LandmarkIndex(self._graph, k, metrics=self.metrics)
+            engine = self._acquire_engine(
+                self._route_width(index.k), "bfs"
+            )
+            ms = index.warm(
+                lambda sources: engine.run(
+                    np.asarray(sources, dtype=np.int64), time_it=False
+                )
+            )
+            self._landmarks = index
+            self._log(
+                f"landmark tier warmed: K={index.k} columns in {ms:.0f}ms"
+            )
+        except Exception as exc:  # noqa: BLE001 — optimization, not liveness
+            self._log(
+                f"landmark warm-up failed ({type(exc).__name__}: "
+                f"{str(exc)[:200]}); serving without the landmark tier"
+            )
+
+    def quarantine_answer_tier(self, origin: str, detail: str = "") -> None:
+        """A CONFIRMED stale/corrupt cached or landmark answer (the
+        shadow audit's finding). The suspect is stored state, not a
+        rung: quarantine the cache generation (every resident entry
+        becomes unreachable at the key level), or drop the landmark
+        columns entirely — they are one batch to recompute and a wrong
+        column poisons every bound it touches."""
+        if origin == "landmark":
+            self._landmarks = None
+            self._log(
+                f"landmark tier DROPPED after a confirmed stale answer"
+                + (f" ({detail[:200]})" if detail else "")
+            )
+            rec = _obs.ACTIVE
+            if rec is not None:
+                rec.event("landmark_quarantine", cat="serve.cache",
+                          detail=detail[:300])
+                rec.flight_dump("landmark_quarantine")
+            return
+        if self._cache is not None:
+            self._cache.quarantine_generation(detail=detail)
+
     def query(self, source, *, timeout: float | None = None,
               deadline_ms: float | None = None,
               want_distances: bool | None = None, kind: str = "bfs",
@@ -685,6 +867,13 @@ class BfsService:
             # Integrity-tier config echo (ISSUE 15): what the audit
             # counters on this line were produced under.
             out["audit"] = self._integrity.config_summary()
+        if self._cache is not None:
+            # Answer-cache residency echo (ISSUE 18): what the cache_*
+            # counters on this line were produced under.
+            out["cache"] = self._cache.config_summary()
+        lm = self._landmarks
+        if lm is not None:
+            out["landmarks"] = lm.config_summary()
         store = self._registry.aot_store
         if store is not None:
             # AOT preheat visibility: artifact hits vs JIT fallbacks —
@@ -1228,6 +1417,7 @@ class BfsService:
             self._finishing += 1
         try:
             self._executor.finish_batch(pending)
+            self._populate_cache(pending)
             tier = self._integrity
             if tier is not None:
                 # The audit hook (ISSUE 15): every query of this batch is
@@ -1272,6 +1462,36 @@ class BfsService:
         finally:
             with self._audit_quiesce:
                 self._finishing -= 1
+
+    def _populate_cache(self, pending) -> None:
+        """Cache-population half of the ISSUE 18 tier: AFTER a batch's
+        queries resolved (extraction worker — the dispatch path never
+        writes the cache), store every ok payload under the current
+        generation. Best-effort by contract: a cache failure must never
+        turn a served batch into an incident."""
+        cache = self._cache
+        if cache is None:
+            return
+        for q in pending.queries:
+            try:
+                r = q.result(0)
+            except TimeoutError:  # a racing path owns this query
+                continue
+            if not r.ok:
+                continue
+            try:
+                cache.put(
+                    kind=r.kind, source=r.source, k=q.k, target=q.target,
+                    want_distances=q.want_distances,
+                    distances=r.distances, levels=r.levels,
+                    reached=r.reached, extras=r.extras,
+                    width=r.dispatched_lanes, devices=r.devices,
+                )
+            except Exception as exc:  # noqa: BLE001 — cache is best-effort
+                self._log(
+                    f"cache put failed (query {q.id!r}): "
+                    f"{type(exc).__name__}: {str(exc)[:200]}"
+                )
 
     def _extract_loop(self) -> None:
         while True:
@@ -1567,6 +1787,21 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument("--audit-seed", type=int, default=0,
                     help="seed of the deterministic audit sampler "
                     "(default 0)")
+    ap.add_argument("--cache-bytes", type=int, default=0, metavar="N",
+                    help="answer cache (ISSUE 18): byte-budgeted LRU of "
+                    "resolved payloads, CRC32-verified at every hit; "
+                    "hits bypass the scheduler and stamp cache_hit "
+                    "provenance. N is the payload budget in bytes "
+                    "(e.g. 67108864 for 64 MB); 0 disables (default). "
+                    "Single-flight dedupe of identical in-flight "
+                    "queries is always on, independent of this knob")
+    ap.add_argument("--landmarks", type=int, default=0, metavar="K",
+                    help="landmark distance tier (ISSUE 18): warm K "
+                    "high-degree landmark distance columns with one "
+                    "flagship MS-BFS batch; p2p queries whose triangle "
+                    "bounds meet answer exactly in microseconds, the "
+                    "rest fall back to traversal. 0 disables (default); "
+                    "needs p2p served (undirected graph)")
     ap.add_argument("--faults", default=None, metavar="SPEC",
                     help="arm a deterministic fault-injection schedule "
                     "(tpu_bfs/faults.py), e.g. 'seed=7:transient@dispatch:"
@@ -1828,6 +2063,8 @@ def run_server(args, stdin=None, stdout=None, stderr=None,
         audit_structural=getattr(args, "audit_structural", False),
         audit_checksum=getattr(args, "audit_checksum", False),
         audit_seed=getattr(args, "audit_seed", 0),
+        cache_bytes=getattr(args, "cache_bytes", 0),
+        landmarks=getattr(args, "landmarks", 0),
         distances=not args.no_distances,
         kinds=(
             tuple(t for t in str(args.kinds).replace(",", " ").split())

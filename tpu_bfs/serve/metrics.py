@@ -218,6 +218,21 @@ class ServeMetrics:
         self.audit_dropped = 0  # guarded-by: _lock
         self.quarantines = 0  # guarded-by: _lock
         self._audit_lag_hist = Log2Histogram()  # guarded-by: _lock
+        # Answer cache + landmark tier (ISSUE 18). cache_bytes is a
+        # GAUGE (resident payload bytes, set by the cache after every
+        # mutation); everything else is monotonic. The hit histogram
+        # prices the bypass path separately from the traversal
+        # latencies above — the split the bench's >=10x claim reads.
+        self.cache_hits = 0  # guarded-by: _lock
+        self.cache_misses = 0  # guarded-by: _lock
+        self.cache_evictions = 0  # guarded-by: _lock
+        self.cache_bytes = 0  # guarded-by: _lock — gauge
+        self.cache_quarantines = 0  # guarded-by: _lock
+        self.single_flight_collapses = 0  # guarded-by: _lock
+        self.landmark_exact = 0  # guarded-by: _lock
+        self.landmark_bounded = 0  # guarded-by: _lock
+        self.landmark_fallback = 0  # guarded-by: _lock
+        self._hit_hist = Log2Histogram()  # guarded-by: _lock
         self.batches = 0  # guarded-by: _lock
         self.lanes_used = 0  # guarded-by: _lock — real queries, all batches
         # Sum of DISPATCHED batch capacity: with the width ladder this is
@@ -324,6 +339,61 @@ class ServeMetrics:
         with self._lock:
             self.quarantines += 1
 
+    def record_cache_hit(self, latency_ms: float, *,
+                         landmark: bool = False) -> None:
+        """One query resolved WITHOUT a traversal. Counts toward
+        ``completed`` (it is a served query) but its latency lands in
+        the hit histogram, not the batch-latency one, so ``p50_ms``
+        keeps meaning the traversal path. Landmark hits are already
+        counted by ``record_landmark`` — only plain cache hits bump
+        ``cache_hits`` here."""
+        with self._lock:
+            self.completed += 1
+            if not landmark:
+                self.cache_hits += 1
+            self._hit_hist.add(latency_ms)
+
+    def record_follower_completed(self) -> None:
+        """A single-flight follower resolved ok off its leader's result
+        — a served query that never occupied a lane, so no batch counter
+        (or latency histogram) ever sees it."""
+        with self._lock:
+            self.completed += 1
+
+    def record_cache_miss(self) -> None:
+        with self._lock:
+            self.cache_misses += 1
+
+    def record_cache_eviction(self, n: int = 1) -> None:
+        with self._lock:
+            self.cache_evictions += n
+
+    def set_cache_bytes(self, nbytes: int) -> None:
+        with self._lock:
+            self.cache_bytes = int(nbytes)
+
+    def record_cache_quarantine(self) -> None:
+        with self._lock:
+            self.cache_quarantines += 1
+
+    def record_single_flight(self, n: int = 1) -> None:
+        with self._lock:
+            self.single_flight_collapses += n
+
+    def record_landmark(self, *, exact: bool,
+                        informative: bool = True) -> None:
+        """One landmark consult: ``exact`` answered the query;
+        otherwise the bracket existed but did not meet (``bounded``) or
+        no landmark was informative at all (``fallback``) — both fall
+        back to traversal."""
+        with self._lock:
+            if exact:
+                self.landmark_exact += 1
+            elif informative:
+                self.landmark_bounded += 1
+            else:
+                self.landmark_fallback += 1
+
     def _round(self, v: float | None) -> float | None:
         return None if v is None else round(v, 3)
 
@@ -391,6 +461,19 @@ class ServeMetrics:
                     self._audit_lag_hist.percentile(50)
                 ),
                 "quarantines": self.quarantines,
+                "cache_hits": self.cache_hits,
+                "cache_misses": self.cache_misses,
+                "cache_evictions": self.cache_evictions,
+                "cache_bytes": self.cache_bytes,
+                "cache_quarantines": self.cache_quarantines,
+                "single_flight_collapses": self.single_flight_collapses,
+                "landmark_exact": self.landmark_exact,
+                "landmark_bounded": self.landmark_bounded,
+                "landmark_fallback": self.landmark_fallback,
+                # Hit-path latency is all-time (hits are microsecond
+                # NumPy work — there is no cold-batch-haunts-p99 problem
+                # to age out), keeping the split p50 pair comparable.
+                "hit_p50_ms": self._round(self._hit_hist.percentile(50)),
             }
         if queue_depth is not None:
             out["queue_depth"] = queue_depth
@@ -412,6 +495,7 @@ class ServeMetrics:
             return {
                 "latency_ms": Log2Histogram().merge(self._latency_hist),
                 "extract_ms": Log2Histogram().merge(self._extract_hist),
+                "hit_ms": Log2Histogram().merge(self._hit_hist),
             }
 
     def prometheus_text(self, snapshot: dict | None = None, **kw) -> str:

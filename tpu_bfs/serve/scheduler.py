@@ -204,6 +204,73 @@ class PendingQuery:
         cb(self)
 
 
+def dedupe_key(q) -> tuple:
+    """The identity class single-flight collapses on (ISSUE 18): two
+    queries whose terminal payloads are interchangeable — same kind,
+    source, per-kind params, and distance appetite. Deadlines and ids
+    deliberately excluded: a follower rides the leader's dispatch and
+    keeps its own id/latency."""
+    return (q.kind, q.source, q.k, q.target, q.want_distances)
+
+
+def _fanout(leader: PendingQuery, follower: PendingQuery) -> None:
+    """Resolve a single-flight follower from its leader's terminal
+    result: same payload (arrays shared read-only), the follower's own
+    id and submit-to-now latency."""
+    r = leader.result(0)
+    follower.resolve(dataclasses.replace(
+        r, id=follower.id,
+        latency_ms=(time.monotonic() - follower.t_submit) * 1e3,
+    ))
+
+
+class InflightIndex:
+    """Single-flight collapsing of identical in-flight queries
+    (ISSUE 18): the FIRST submission of a ``dedupe_key`` becomes the
+    LEADER and proceeds to admission; every concurrent duplicate becomes
+    a FOLLOWER that never enters the queue — it resolves the moment the
+    leader does, from a per-follower copy of the leader's result. N
+    duplicate submissions occupy ONE lane instead of N, independent of
+    whether the answer cache is armed.
+
+    Thread-safe; leaders self-release on resolution (any terminal
+    status, including REJECTED/ERROR — a failed leader fans its failure
+    out rather than leaving followers hanging)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._leaders: dict = {}  # guarded-by: _lock
+
+    def attach(self, q: PendingQuery) -> PendingQuery | None:
+        """Register ``q`` as leader (returns None: caller admits it) or
+        attach it as a follower to the in-flight leader (returns the
+        leader: caller must NOT admit ``q`` — it is already wired to
+        resolve)."""
+        key = dedupe_key(q)
+        with self._lock:
+            leader = self._leaders.get(key)
+            if leader is None:
+                self._leaders[key] = q
+        if leader is None:
+            # Self-release on ANY terminal status; a later identical
+            # query then leads its own dispatch (resolved results are
+            # the cache's business, not the inflight index's).
+            q.add_done_callback(lambda _p, k=key: self._release(k))
+            return None
+        leader.add_done_callback(
+            lambda lead, fq=q: _fanout(lead, fq)
+        )
+        return leader
+
+    def _release(self, key) -> None:
+        with self._lock:
+            self._leaders.pop(key, None)
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._leaders)
+
+
 class AdmissionQueue:
     """Bounded FIFO of PendingQuery with batch-draining semantics.
 

@@ -1,5 +1,5 @@
 # Convenience targets; everything also works without make (README).
-.PHONY: test native bench analyze wirecheck serve-smoke serve-dist-smoke workloads-smoke chaos-smoke mesh-chaos-smoke integrity-smoke cache-smoke obs-smoke preheat-smoke wheel clean
+.PHONY: test native bench analyze wirecheck serve-smoke serve-dist-smoke workloads-smoke chaos-smoke mesh-chaos-smoke integrity-smoke cache-smoke obs-smoke preheat-smoke mutation-smoke wheel clean
 
 # Full suite on 8 virtual CPU devices (tests/conftest.py forces the
 # platform; the axon TPU plugin is bypassed).
@@ -171,6 +171,22 @@ integrity-smoke: mesh-chaos-smoke
 # test_fuzz_cross_engine.py).
 cache-smoke: wirecheck
 	env JAX_PLATFORMS=cpu python scripts/cache_smoke.py
+
+# The dynamic-graph soak (README "Dynamic graphs", ISSUE 19): a
+# mutation-armed server with the full audit battery live must answer a
+# query stream interleaved with edge-update batches bit-identically to
+# a from-scratch rebuild of every generation (bfs AND sssp, zero
+# dropped queries, zero audit findings); with compaction_crash armed
+# the dead compactor's uncommitted artifact must be quarantined
+# .corrupt, the flight recorder must name it, and the previous
+# generation must keep serving until the retried batch compacts clean;
+# with torn_flip armed the staleness auditor's oracle replay must
+# confirm the over-bound answer, quarantine the stale generation, heal
+# by restaging, and indict NO rung. The pytest side runs the same
+# machinery in-process (tests/test_dynamic.py + the interleaved
+# mutate/query fuzz arm in test_fuzz_cross_engine.py).
+mutation-smoke: cache-smoke
+	env JAX_PLATFORMS=cpu python scripts/mutation_smoke.py
 
 # The telemetry smoke (README "Observability"): a tracing-armed JSONL
 # server must emit a Perfetto trace holding the FULL span chain of every

@@ -43,7 +43,11 @@ TPU_BFS_BENCH_SERVE_CACHE (0 — the answer cache, ISSUE 18: '1' = the
 TPU_BFS_BENCH_SERVE_LANDMARKS (0 — K landmark distance columns);
 either arms a second Zipf(s=1.0) closed loop emitting
 serve_cache_hit_rate / serve_landmark_hit_rate / serve_hit_p50_ms /
-serve_traversal_p50_ms, plus the PR 5/7 wire knobs; mesh runs add serve_gteps_p50 /
+serve_traversal_p50_ms / TPU_BFS_BENCH_MUTATIONS (0 — dynamic graphs,
+ISSUE 19: N streaming edge-update flips applied under a closed loop;
+TPU_BFS_BENCH_MUTATIONS_OVERLAY 'DxK' sizes the overlay, default
+256x32), emitting serve_flip_p50_ms / serve_overlay_occupancy /
+serve_mutation_dropped, plus the PR 5/7 wire knobs; mesh runs add serve_gteps_p50 /
 serve_gteps_hmean / serve_wire_bytes_per_query plus the mesh-fault
 record serve_mesh_faults/serve_mesh_degrades/serve_query_resumes/
 serve_devices_final to the verdict, and
@@ -1494,6 +1498,27 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
         cache_bytes = (64 << 20) if cache_raw == "1" else int(cache_raw)
     landmark_k = int(os.environ.get("TPU_BFS_BENCH_SERVE_LANDMARKS",
                                     "0") or 0)
+    # Dynamic graphs (ISSUE 19): TPU_BFS_BENCH_MUTATIONS=N applies N
+    # streaming edge-update flips under a dedicated closed loop after
+    # the uniform stage; TPU_BFS_BENCH_MUTATIONS_OVERLAY ('DxK',
+    # default 256x32) sizes the bounded delta overlay. The verdict
+    # gains serve_flip_p50_ms / serve_overlay_occupancy /
+    # serve_mutation_dropped (the zero-dropped-queries acceptance).
+    mutations_n = int(os.environ.get("TPU_BFS_BENCH_MUTATIONS", "0") or 0)
+    overlay_cap = ()
+    if mutations_n > 0:
+        if engine != "wide" or devices > 1 or serve_pull_gate:
+            # Drop, don't die (registry validate would reject): the
+            # overlay rides the single-chip wide substrate only.
+            log("mutation soak needs the single-chip wide engine "
+                f"without pull_gate; ignored on engine={engine!r} "
+                f"devices={devices}")
+            mutations_n = 0
+        else:
+            cap_raw = os.environ.get("TPU_BFS_BENCH_MUTATIONS_OVERLAY",
+                                     "256x32")
+            rows_s, _, ko_s = cap_raw.partition("x")
+            overlay_cap = (int(rows_s), int(ko_s))
     svc_kw = dict(
         cache_bytes=cache_bytes, landmarks=landmark_k,
         engine=engine, lanes=lanes, planes=8,
@@ -1507,6 +1532,7 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
         width_ladder=ladder, pipeline=pipeline,
         linger_ms=2.0, queue_cap=max(1024, 2 * clients),
         watchdog_ms=watchdog_ms, log=log,
+        **({"dynamic": overlay_cap} if mutations_n else {}),
     )
     t0 = time.perf_counter()
     service = retry_transient(
@@ -1809,6 +1835,91 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
             f"traversal_p50={cache_keys.get('serve_traversal_p50_ms')}ms"
         )
 
+    # Mutation soak (ISSUE 19): N generation flips applied while a
+    # closed loop keeps querying — every response must resolve ok
+    # across the flips, and each flip's latency is measured at the
+    # mutation caller (the atomic between-batches hand-off price).
+    mut_keys: dict = {}
+    if mutations_n > 0:
+        rows_cap, ko_cap = overlay_cap
+        # v1 overlay limit: an override row carries a vertex's FULL
+        # current adjacency, so only vertices whose degree clears the
+        # slot capacity are mutable — and isolated vertices have no
+        # base table row to override at all. Distinct endpoints per
+        # flip keep every touched row within ko across the whole soak.
+        mutable = np.flatnonzero(
+            (g.degrees > 0) & (g.degrees <= ko_cap - 2)
+        )
+        if len(mutable) < 2 * mutations_n:
+            log(f"only {len(mutable)} vertices mutable under ko={ko_cap}; "
+                f"capping mutation soak at {len(mutable) // 2} flips")
+            mutations_n = len(mutable) // 2
+    if mutations_n > 0:
+        mrng = np.random.default_rng(23)
+        ends = mrng.choice(mutable, size=(mutations_n, 2), replace=False)
+        m_clients = min(clients, 16)
+        picks_m = rng.choice(candidates, size=(m_clients, 64),
+                             replace=True)
+        stop = threading.Event()
+        mflat: list = []
+        merrs: list = []
+
+        def mut_client(ci: int) -> None:
+            got = []
+            try:
+                i = 0
+                while not stop.is_set():
+                    got.append(service.query(
+                        int(picks_m[ci][i % picks_m.shape[1]]),
+                        timeout=600.0))
+                    i += 1
+            except Exception as exc:  # noqa: BLE001 — surfaced after join
+                merrs.append(exc)
+            mflat.extend(got)
+
+        mthreads = [
+            threading.Thread(target=mut_client, args=(i,), daemon=True)
+            for i in range(m_clients)
+        ]
+        flip_lat: list = []
+        occupancy = 0
+        for t in mthreads:
+            t.start()
+        try:
+            for u, v in ends:
+                out = service.apply_edge_updates(add=[(int(u), int(v))])
+                flip_lat.append(out["flip_ms"])
+                occupancy = max(occupancy, out["overlay_rows"])
+                time.sleep(0.05)  # let queries land between flips
+        finally:
+            stop.set()
+            for t in mthreads:
+                t.join()
+        if merrs:
+            raise merrs[0]
+        dropped = sum(1 for r in mflat if not r.ok)
+        dmeta = service.statsz().get("dynamic", {})
+        mut_keys = {
+            "serve_mutation_flips": len(flip_lat),
+            "serve_flip_p50_ms": round(
+                float(np.percentile(flip_lat, 50)), 3),
+            "serve_flip_max_ms": round(float(max(flip_lat)), 3),
+            "serve_overlay_occupancy": round(occupancy / rows_cap, 4),
+            "serve_mutation_queries": len(mflat),
+            "serve_mutation_dropped": dropped,
+            "serve_generation_final": dmeta.get("generation"),
+            "serve_compactions": dmeta.get("compactions", 0),
+        }
+        log(f"mutation soak: {len(flip_lat)} flips under {len(mflat)} "
+            f"queries, flip_p50={mut_keys['serve_flip_p50_ms']}ms "
+            f"occupancy={mut_keys['serve_overlay_occupancy']} "
+            f"dropped={dropped}")
+        if dropped:
+            raise RuntimeError(
+                f"{dropped}/{len(mflat)} queries dropped across "
+                f"{len(flip_lat)} generation flips"
+            )
+
     aot_keys: dict = {}
     if aot_dir:
         # Export from the warmed service BEFORE closing it, then time a
@@ -2011,6 +2122,7 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
         **dist_keys,
         **kinds_keys,
         **cache_keys,
+        **mut_keys,
         **aot_keys,
         **({"serve_faults": fault_sched.counts()} if fault_sched else {}),
         **obs_keys,

@@ -233,6 +233,16 @@ for i in $(seq 1 "$attempts"); do
       TPU_BFS_BENCH_MODE=serve TPU_BFS_BENCH_SCALE=20 \
       TPU_BFS_BENCH_SERVE_CACHE=1 \
       TPU_BFS_BENCH_SERVE_LANDMARKS=16
+    # Dynamic-graph arm (robustness, ISSUE 19): the same serve stage
+    # with the bounded delta overlay armed — 16 streaming edge-update
+    # flips land WHILE the closed loop keeps querying. Acceptance:
+    # serve_mutation_dropped == 0 across every generation flip,
+    # serve_flip_p50_ms well under the batch latency (the flip is a
+    # lock-guarded metadata swap, not a rebuild), and the overlay
+    # occupancy/compaction record rides the same JSON line.
+    stage "mutations-s20" "$out/mutations_s20.json" \
+      TPU_BFS_BENCH_MODE=serve TPU_BFS_BENCH_SCALE=20 \
+      TPU_BFS_BENCH_MUTATIONS=16
     # Cold-start arm (ISSUE 9): the same serve stage with an AOT
     # artifact store armed — the cold service's warmed programs export
     # to $out/aot_store after the closed loop, a SECOND service preheats

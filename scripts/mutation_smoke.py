@@ -1,0 +1,268 @@
+"""The dynamic-graph soak (`make mutation-smoke`): streaming edge
+updates, versioned generation flips, and the crash/staleness story
+(ISSUE 19) proven end to end against the real subprocess server.
+
+Three acts, no monkeypatching (tpu_bfs/faults.py discipline):
+
+1. MUTATE UNDER TRAFFIC — a mutation-armed server with the FULL audit
+   battery live answers a query stream interleaved with 3 edge-update
+   batches: every generation's answers (bfs AND sssp) must be
+   BIT-IDENTICAL to a from-scratch rebuild of that generation's graph,
+   with zero dropped queries and zero audit findings across the flips.
+2. CRASH MID-COMPACTION — an overflowing batch forces a compaction and
+   ``compaction_crash`` kills the compactor mid-fold: the previous
+   generation stays served (answers still exact), the dead compactor's
+   uncommitted artifact is quarantined ``.corrupt``, the flight
+   recorder names it, and the retried batch compacts clean.
+3. STALE GENERATION — ``torn_flip`` advances the metadata without the
+   overlay tables (the client-visible lie: a stale answer stamped with
+   the new generation); the staleness auditor's oracle replay confirms
+   the over-bound answer, quarantines the stale generation (flight dump
+   naming it), heals by restaging, and the next query is exact — with
+   NO rung indicted.
+
+Prints one JSON line (value = generation flips proven across the acts)
+so scripts/chip_session.sh's has_value gate can drive it as a stage.
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+GRAPH = "random:n=96,m=480,seed=3,weights=5"
+ENV = dict(os.environ, JAX_PLATFORMS="cpu")
+
+
+def log(msg):
+    print(f"[mutation-smoke] {msg}", file=sys.stderr, flush=True)
+
+
+def check(cond, msg):
+    if not cond:
+        raise SystemExit(f"FAIL: {msg}")
+    log(f"ok: {msg}")
+
+
+def server_argv(extra):
+    return [
+        sys.executable, "-m", "tpu_bfs.serve", GRAPH,
+        "--lanes", "64", "--ladder", "64", "--linger-ms", "0",
+        "--statsz-every", "0",
+        *extra,
+    ]
+
+
+def last_statsz(err: str) -> dict:
+    lines = [l for l in err.splitlines() if l.startswith("statsz ")]
+    check(lines, "final statsz line emitted")
+    return json.loads(lines[-1][len("statsz "):])
+
+
+class Server:
+    """Interactive JSONL exchange: mutations must interleave with
+    queries in program order, so every line is send-then-read."""
+
+    def __init__(self, extra):
+        self.proc = subprocess.Popen(
+            server_argv(extra), stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env=ENV,
+        )
+        self._rid = 0
+
+    def ask(self, req: dict) -> dict:
+        req = dict(req, id=self._rid)
+        self._rid += 1
+        self.proc.stdin.write(json.dumps(req) + "\n")
+        self.proc.stdin.flush()
+        resp = json.loads(self.proc.stdout.readline())
+        check(resp.get("id") == req["id"], f"response matches request "
+              f"{req['id']} (got {resp.get('id')!r})")
+        return resp
+
+    def finish(self):
+        self.proc.stdin.close()
+        self.proc.stdin = None  # communicate() must not flush a closed pipe
+        out, err = self.proc.communicate(timeout=900)
+        check(self.proc.returncode == 0, "server exits 0")
+        return out, err
+
+
+def main() -> int:
+    import numpy as np
+
+    from tpu_bfs.cli import load_graph
+    from tpu_bfs.graph.dynamic import DynamicGraph
+    from tpu_bfs.integrity.staleness import oracle_bfs, oracle_sssp
+    from tpu_bfs.serve.frontend import decode_distances
+
+    g = load_graph(GRAPH)
+    sources = [0, 3, 5, 7]
+    flips_proven = 0
+
+    def check_generation(srv, mirror, tag):
+        """Every served answer equals a from-scratch rebuild of the
+        mirror's CURRENT graph — the paper's own rerun-on-CPU check."""
+        cur = mirror.materialize()
+        for s in sources:
+            r = srv.ask({"source": s})
+            check(r["status"] == "ok", f"{tag}: bfs {s} answers ok")
+            d = decode_distances(r["distances_npy"])
+            check(bool(np.array_equal(d, oracle_bfs(cur, s))),
+                  f"{tag}: bfs {s} bit-identical to rebuild")
+        r = srv.ask({"source": sources[0], "kind": "sssp"})
+        check(r["status"] == "ok", f"{tag}: sssp answers ok")
+        d = decode_distances(r["distances_npy"])
+        check(bool(np.array_equal(d, oracle_sssp(cur, sources[0]))),
+              f"{tag}: sssp bit-identical to rebuild")
+
+    # ---- act 1: mutate under traffic, full audit battery live -----------
+    log("act 1: 3 generation flips under audited traffic")
+    mirror = DynamicGraph(load_graph(GRAPH), capacity=(64, 32))
+    srv = Server(["--mutations", "64x32", "--audit-rate", "1",
+                  "--audit-structural", "--audit-checksum"])
+    check_generation(srv, mirror, "gen 0")
+    batches = [
+        dict(add=[[0, 90], [17, 55, 3]], remove=[[0, 1]]),
+        dict(add=[[5, 41]], remove=[[3, 7]]),
+        dict(add=[[90, 91], [2, 64, 9]], remove=[]),
+    ]
+    for i, batch in enumerate(batches, start=1):
+        out = srv.ask(dict(batch, op="mutate"))
+        check(out.get("ok") is True, f"mutation {i} applied")
+        check(out["generation"] == i, f"flip {i}: generation advanced")
+        check(out["flip_ms"] >= 0 and out["overlay_rows"] >= 1,
+              f"flip {i}: {out['flip_ms']}ms, "
+              f"{out['overlay_rows']} overlay rows")
+        mirror.apply(add=[tuple(e) for e in batch["add"]],
+                     remove=[tuple(e) for e in batch["remove"]])
+        check_generation(srv, mirror, f"gen {i}")
+    time.sleep(3.0)  # the sampled audits are async
+    _, err = srv.finish()
+    snap = last_statsz(err)
+    dyn = snap["dynamic"]
+    check(dyn["flips"] == 3 and dyn["generation"] == 3,
+          "3 generation flips served")
+    check(snap["errors"] == 0 and snap["rejected"] == 0
+          and snap["expired"] == 0, "zero dropped queries across flips")
+    check(snap["audit_failures"] == 0 and snap["quarantines"] == 0,
+          f"audit battery clean across flips ({snap['audits_run']} audits)")
+    stale = dyn["staleness"]
+    check(stale["over_bound"] == 0 and stale["errors"] == 0,
+          f"staleness audits clean ({stale['audits']} replays)")
+    flip_ms = dyn.get("flip_p50_ms")
+    flips_proven += dyn["flips"]
+
+    # ---- act 2: compaction_crash -> rollback, quarantine, clean retry ---
+    with tempfile.TemporaryDirectory() as gen_dir, \
+            tempfile.TemporaryDirectory() as dump_dir:
+        log("act 2: compaction_crash armed over a 4-row overlay")
+        mirror = DynamicGraph(load_graph(GRAPH), capacity=(64, 32))
+        srv = Server([
+            "--mutations", "4x32", "--generation-dir", gen_dir,
+            "--faults", "seed=3:compaction_crash@compact:n=1",
+            "--obs", f"dump_dir={dump_dir},window=120",
+        ])
+        out = srv.ask({"op": "mutate", "add": [[1, 2], [3, 4]]})
+        check(out.get("ok") is True and out["generation"] == 1,
+              "first batch fills the overlay")
+        mirror.apply(add=[(1, 2), (3, 4)])
+        check_generation(srv, mirror, "pre-crash gen 1")
+        overflow = {"op": "mutate", "add": [[20, 21], [22, 23]]}
+        out = srv.ask(overflow)
+        check(out.get("ok") is False, "overflowing batch FAILS: the "
+              "compactor died mid-fold")
+        check_generation(srv, mirror, "post-crash (rolled back) gen 1")
+        corrupt = glob.glob(os.path.join(gen_dir, "*.corrupt"))
+        check(len(corrupt) == 1,
+              f"dead compactor's artifact quarantined ({corrupt})")
+        out = srv.ask(overflow)
+        check(out.get("ok") is True and out.get("compacted") is True
+              and out["generation"] == 2,
+              "retried batch compacts clean and applies")
+        mirror.apply(add=[(20, 21), (22, 23)])
+        check_generation(srv, mirror, "post-compaction gen 2")
+        _, err = srv.finish()
+        snap = last_statsz(err)
+        check(snap.get("faults", {}).get("compaction_crash") == 1,
+              "exactly the scheduled compaction_crash fired")
+        check(snap["dynamic"]["compactions"] == 1, "one compaction landed")
+        check("compaction FAILED" in err and "quarantined" in err,
+              "rollback logged with the quarantine")
+        dumps = sorted(glob.glob(os.path.join(dump_dir, "*.jsonl")))
+        check(dumps, "flight recorder dumped the incident")
+        dumped = "\n".join(open(p).read() for p in dumps)
+        check('"compaction_failed"' in dumped
+              and os.path.basename(corrupt[0]) in dumped,
+              "flight dump names the quarantined artifact")
+        flips_proven += snap["dynamic"]["flips"]
+
+    # ---- act 3: torn_flip -> staleness audit -> quarantine + heal -------
+    with tempfile.TemporaryDirectory() as dump_dir:
+        log("act 3: torn_flip armed, staleness auditor at rate 1")
+        mirror = DynamicGraph(load_graph(GRAPH), capacity=(64, 32))
+        srv = Server([
+            "--mutations", "64x32", "--audit-rate", "1",
+            "--faults", "seed=5:torn_flip@generation_flip:n=1",
+            "--obs", f"dump_dir={dump_dir},window=120",
+        ])
+        gen0 = oracle_bfs(mirror.materialize(), 0)
+        # An edge that CHANGES distances from source 0: (0, far) with
+        # far at depth >= 2 collapses far to depth 1.
+        far = int(np.flatnonzero(gen0 >= 2)[0])
+        r = srv.ask({"source": 0})
+        check(bool(np.array_equal(
+            decode_distances(r["distances_npy"]), gen0)),
+            "gen 0 answer exact")
+        out = srv.ask({"op": "mutate", "add": [[0, int(far)]]})
+        check(out.get("ok") is True and out["generation"] == 1,
+              "torn flip: metadata advanced anyway")
+        mirror.apply(add=[(0, far)])
+        gen1 = oracle_bfs(mirror.materialize(), 0)
+        r = srv.ask({"source": 0})
+        d = decode_distances(r["distances_npy"])
+        check(bool(np.array_equal(d, gen0))
+              and not np.array_equal(d, gen1),
+              "post-flip answer IS stale (client-visible, pre-detection)")
+        time.sleep(5.0)  # replay + quarantine + restage are async
+        r = srv.ask({"source": 0})
+        check(bool(np.array_equal(
+            decode_distances(r["distances_npy"]), gen1)),
+            "healed: next answer exact against the new generation")
+        _, err = srv.finish()
+        snap = last_statsz(err)
+        check(snap.get("faults", {}).get("torn_flip") == 1,
+              "exactly the scheduled torn_flip fired")
+        stale = snap["dynamic"]["staleness"]
+        check(stale["over_bound"] >= 1,
+              f"staleness auditor confirmed the over-bound answer "
+              f"({stale['over_bound']})")
+        check(snap["quarantines"] == 0,
+              "no rung was indicted for the torn state")
+        check("STALE GENERATION" in err, "stale generation logged")
+        dumps = sorted(glob.glob(os.path.join(dump_dir, "*.jsonl")))
+        check(dumps, "flight recorder dumped the incident")
+        check('"stale_generation"' in "\n".join(
+            open(p).read() for p in dumps),
+            "flight dump names the stale generation")
+        flips_proven += snap["dynamic"]["flips"]
+
+    print(json.dumps({
+        "metric": "dynamic-graph smoke (mutate-under-traffic rebuild "
+                  "identity + compaction-crash rollback + torn-flip "
+                  "staleness quarantine, tpu_bfs/graph/dynamic)"
+                  + (f"; flip p50 {flip_ms}ms" if flip_ms else ""),
+        "value": flips_proven,
+        "unit": "generation flips",
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,673 @@
+"""Dynamic graphs (ISSUE 19): streaming edge updates over the
+two-layer base+overlay representation (tpu_bfs/graph/dynamic), the
+versioned-generation serve flips (BfsService.apply_edge_updates), the
+crash-safe background compactor (GenerationStore + the PR 4 atomic-save
+discipline), and the staleness auditor that bounds how stale any served
+answer can be.
+
+The invariants under test, in the reference's own validation spirit
+(rerun on CPU, compare bit-for-bit — bfs.cu:374-384):
+
+- every generation's served answers are bit-identical to a from-scratch
+  rebuild of that generation's graph, for bfs AND sssp, through BOTH
+  expansion tiers;
+- a crash mid-compaction leaves the previous generation intact and
+  quarantines the dead compactor's uncommitted artifact ``.corrupt``;
+- a torn flip (metadata advanced, tables not) is invisible to the
+  structural and shadow detectors by construction — only the staleness
+  auditor's per-generation oracle replay catches it, and the heal
+  restages the true overlay;
+- the landmark tier never serves bounds computed over a superseded
+  edge set (the satellite fix for its frozen-at-warm-up staleness
+  hole).
+"""
+
+import glob
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from tpu_bfs import faults
+from tpu_bfs.graph.csr import INF_DIST
+from tpu_bfs.graph.generate import random_graph
+from tpu_bfs.graph.dynamic import (
+    DynamicGraph,
+    GenerationStore,
+    OverlayCapacityError,
+    empty_overlay_tables,
+    overlay_crc32,
+)
+from tpu_bfs.integrity.staleness import (
+    StalenessAuditor,
+    oracle_bfs,
+    oracle_sssp,
+)
+from tpu_bfs.serve import BfsService
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.disarm()
+
+
+GRAPH = lambda: random_graph(96, 480, seed=3, weights=5)  # noqa: E731
+
+# Row capacity sized to the test graph: the override row carries a
+# vertex's FULL current adjacency, so ko must clear the max base degree
+# (the documented v1 limit — a vertex whose degree exceeds ko cannot be
+# mutated, compaction or not).
+CAP = (64, 32)
+
+
+def _adj(g):
+    """Host adjacency as {u: sorted multiset of (v, w)} for exact
+    structural comparison across materialize/rebuild."""
+    out = {}
+    w = g.weights if g.weights is not None else np.ones(len(g.col_idx), np.int32)
+    for u in range(g.num_vertices):
+        lo, hi = int(g.row_ptr[u]), int(g.row_ptr[u + 1])
+        out[u] = sorted(zip(g.col_idx[lo:hi].tolist(), w[lo:hi].tolist()))
+    return out
+
+
+# --- DynamicGraph unit ------------------------------------------------------
+
+
+def test_apply_then_materialize_matches_host_edit():
+    g = GRAPH()
+    dyn = DynamicGraph(g, capacity=CAP)
+    assert dyn.generation == 0 and dyn.overlay_rows_used() == 0
+
+    _tables, stats = dyn.apply(add=[(5, 90), (10, 11, 2)], remove=[(0, 1)])
+    assert stats["generation"] == 1 == dyn.generation
+    mat = dyn.materialize()
+
+    adj = _adj(mat)
+    # Adds landed (undirected, both directions), with the given weight
+    # (default weight 1 when the batch gives none).
+    assert (90, 1) in adj[5] and (5, 1) in adj[90]
+    assert (11, 2) in adj[10] and (10, 2) in adj[11]
+    # The removed edge is gone in both directions.
+    assert all(v != 1 for v, _ in adj[0])
+    assert all(v != 0 for v, _ in adj[1])
+    # Untouched vertices keep their exact base adjacency.
+    base_adj = _adj(g)
+    touched = {0, 1, 5, 90, 10, 11}
+    for u in set(range(g.num_vertices)) - touched:
+        assert adj[u] == base_adj[u]
+
+
+def test_capacity_error_leaves_state_unmutated():
+    g = GRAPH()
+    dyn = DynamicGraph(g, capacity=(4, 32))
+    dyn.apply(add=[(1, 2), (3, 4)])  # fills all 4 overlay rows
+    gen0, rows0 = dyn.generation, dyn.overlay_rows_used()
+    with pytest.raises(OverlayCapacityError):
+        dyn.apply(add=[(20, 21), (22, 23)])  # 4 more rows > capacity
+    assert dyn.generation == gen0
+    assert dyn.overlay_rows_used() == rows0
+
+
+def test_overlay_crc_covers_every_plane():
+    t = empty_overlay_tables((8, 4), 96, weighted=True)
+    c0 = overlay_crc32(t)
+    t2 = {k: np.array(v, copy=True) for k, v in t.items()}
+    t2["ov_idx"].flat[3] ^= 1
+    assert overlay_crc32(t2) != c0
+    t3 = {k: np.array(v, copy=True) for k, v in t.items()}
+    t3["ov_w"].flat[0] += 1
+    assert overlay_crc32(t3) != c0
+
+
+# The Pallas tier pays a full interpret-mode compile (~25s on CPU), so it
+# rides the slow lane; the XLA tier keeps the fold contract in tier-1, and
+# the slow-marked analysis sweep re-checks the Pallas fold core.
+@pytest.mark.parametrize(
+    "impl", ["xla", pytest.param("pallas", marks=pytest.mark.slow)]
+)
+def test_overlay_fold_bit_identical_to_rebuild_both_tiers(impl):
+    """The tentpole's kernel-level contract: base+overlay folded by the
+    compiled cores == a from-scratch engine over the materialized graph,
+    for the XLA and the Pallas expansion tiers."""
+    from tpu_bfs.algorithms.msbfs_wide import WidePackedMsBfsEngine
+
+    g = GRAPH()
+    dyn = DynamicGraph(g, capacity=CAP)
+    tables, _ = dyn.apply(add=[(5, 90), (1, 2, 3)], remove=[(0, 1)])
+    mat = dyn.materialize()
+    sources = np.asarray([5, 17, 42], dtype=np.int64)
+
+    eng = WidePackedMsBfsEngine(
+        g, lanes=32, expand_impl=impl, overlay=CAP
+    )
+    eng.set_overlay(tables)
+    folded = eng.run(sources)
+    fresh = WidePackedMsBfsEngine(mat, lanes=32, expand_impl=impl).run(
+        sources
+    )
+    for i in range(len(sources)):
+        np.testing.assert_array_equal(
+            folded.distances_int32(i), fresh.distances_int32(i),
+            err_msg=f"{impl} lane {i}",
+        )
+
+
+# --- GenerationStore --------------------------------------------------------
+
+
+def test_generation_store_round_trip(tmp_path):
+    g = GRAPH()
+    store = GenerationStore(str(tmp_path))
+    assert store.current() is None
+    gid = store.next_generation_id()
+    store.save(gid, g)
+    store.set_current(gid)
+    assert store.current() == gid
+    loaded = store.load(gid)
+    assert _adj(loaded) == _adj(g)
+    assert loaded.num_input_edges == g.num_input_edges
+
+
+def test_generation_store_quarantines_corrupt_artifact(tmp_path):
+    from tpu_bfs.utils.checkpoint import CorruptCheckpointError
+
+    g = GRAPH()
+    store = GenerationStore(str(tmp_path))
+    gid = store.next_generation_id()
+    path = store.save(gid, g)
+    blob = bytearray(open(path, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(path, "wb").write(bytes(blob))
+    with pytest.raises(CorruptCheckpointError):
+        store.load(gid)
+    assert os.path.exists(path + ".corrupt") and not os.path.exists(path)
+
+
+def test_generation_store_quarantines_orphans(tmp_path):
+    """Crash recovery: a compactor that died after writing gen N+1 but
+    before the CURRENT pointer advanced leaves an uncommitted artifact;
+    quarantine renames it ``.corrupt`` so it can never be adopted."""
+    g = GRAPH()
+    store = GenerationStore(str(tmp_path))
+    store.save(1, g)
+    store.set_current(1)
+    store.save(2, g)  # uncommitted: CURRENT still points at 1
+    quarantined = store.quarantine_orphans()
+    assert len(quarantined) == 1 and quarantined[0].endswith(".corrupt")
+    assert store.current() == 1
+    assert store.load(1) is not None
+    assert not glob.glob(os.path.join(str(tmp_path), "gen_0002.npz"))
+
+
+def test_compact_folds_overlay_into_new_base(tmp_path):
+    g = GRAPH()
+    dyn = DynamicGraph(g, capacity=CAP)
+    dyn.apply(add=[(5, 90, 2)], remove=[(0, 1)])
+    want = _adj(dyn.materialize())
+    store = GenerationStore(str(tmp_path))
+    new_base = dyn.compact(store)
+    assert _adj(new_base) == want
+    assert dyn.overlay_rows_used() == 0
+    # Monotonic: compaction is answer-neutral and does NOT reset the
+    # mutation-visible generation number.
+    assert dyn.generation == 1
+    assert store.current() == 1
+    # Post-compaction mutations stack on the new base.
+    dyn.apply(add=[(7, 8)])
+    adj = _adj(dyn.materialize())
+    assert (8, 1) in adj[7] and (90, 2) in adj[5]
+
+
+# --- StalenessAuditor unit --------------------------------------------------
+
+
+def test_oracles_match_reference():
+    from tpu_bfs.reference import bfs_scipy
+
+    g = GRAPH()
+    np.testing.assert_array_equal(oracle_bfs(g, 5), bfs_scipy(g, 5))
+    d = oracle_sssp(g, 5)
+    assert d[5] == 0 and d.dtype == np.int32
+    # Dijkstra never exceeds hop-count x max-weight, never undercuts
+    # the unweighted distance.
+    hops = oracle_bfs(g, 5)
+    reach = hops != INF_DIST
+    assert np.all(d[reach] >= hops[reach])
+    assert np.all(d[~reach] == INF_DIST)
+
+
+class _Q:
+    def __init__(self, r):
+        self.id, self._r = "q", r
+
+    def result(self, _t):
+        return self._r
+
+
+class _R:
+    def __init__(self, kind, source, distances, ok=True):
+        self.ok, self.kind, self.source = ok, kind, source
+        self.distances = distances
+
+
+class _P:
+    def __init__(self, queries, generation):
+        self.queries, self.generation = queries, generation
+
+
+def test_staleness_auditor_measures_against_the_stamp():
+    """A correct service measures 0: the batch's generation stamp names
+    the tables it traversed, so an in-flight query pinned to an OLD
+    generation is NOT stale. An answer reproducing an older generation
+    than its stamp is; over ``bound`` it fires the callback."""
+    g = GRAPH()
+    fired = []
+    aud = StalenessAuditor(rate=1.0, bound=0,
+                           on_over_bound=lambda **kw: fired.append(kw))
+    aud.push_generation(0, g)
+    dyn = DynamicGraph(g, capacity=CAP)
+    dyn.apply(add=[(5, 90)], remove=[(0, 1)])
+    g1 = dyn.materialize()
+    aud.push_generation(1, g1)
+
+    # Pinned in-flight answer: generation-0 bits stamped generation 0.
+    aud.observe_batch(_P([_Q(_R("bfs", 5, oracle_bfs(g, 5)))], 0))
+    assert aud.stats()["stale"] == 0 and not fired
+
+    # Correct post-flip answer.
+    aud.observe_batch(_P([_Q(_R("bfs", 5, oracle_bfs(g1, 5)))], 1))
+    assert aud.stats()["stale"] == 0 and not fired
+
+    # The torn shape: generation-0 bits STAMPED generation 1.
+    aud.observe_batch(_P([_Q(_R("bfs", 5, oracle_bfs(g, 5)))], 1))
+    st = aud.stats()
+    assert st["stale"] == 1 and st["over_bound"] == 1
+    assert len(fired) == 1
+    assert fired[0]["staleness"] == 1
+    assert fired[0]["matched_generation"] == 0
+    assert fired[0]["served_generation"] == 1
+
+    # Garbage matching NO generation is corruption territory, counted
+    # separately, never fired as staleness.
+    junk = np.arange(g.num_vertices, dtype=np.int32)
+    aud.observe_batch(_P([_Q(_R("bfs", 5, junk))], 1))
+    assert aud.stats()["unmatched"] == 1 and len(fired) == 1
+
+
+def test_staleness_bound_relaxes_the_callback():
+    g = GRAPH()
+    fired = []
+    aud = StalenessAuditor(rate=1.0, bound=1,
+                           on_over_bound=lambda **kw: fired.append(kw))
+    aud.push_generation(0, g)
+    dyn = DynamicGraph(g, capacity=CAP)
+    dyn.apply(add=[(5, 90)], remove=[(0, 1)])
+    aud.push_generation(1, dyn.materialize())
+    aud.observe_batch(_P([_Q(_R("bfs", 5, oracle_bfs(g, 5)))], 1))
+    st = aud.stats()
+    assert st["stale"] == 1 and st["over_bound"] == 0 and not fired
+
+
+# --- serve-path integration (the tentpole) ----------------------------------
+
+
+def _service(**kw):
+    kw.setdefault("lanes", 64)
+    kw.setdefault("width_ladder", "off")
+    kw.setdefault("linger_ms", 0.0)
+    kw.setdefault("dynamic", CAP)
+    return BfsService(GRAPH(), **kw)
+
+
+@pytest.mark.serve
+def test_mutations_under_serve_bit_identical_across_generations():
+    """The acceptance soak's core: >= 3 generation flips, every served
+    bfs AND sssp answer bit-identical to a from-scratch CPU rebuild of
+    its generation, with the audit tiers fully armed and silent."""
+    svc = _service(audit_rate=1.0, audit_structural=True,
+                   audit_checksum=True, cache_bytes=1 << 20)
+    try:
+        g0 = GRAPH()
+        r = svc.query(5, timeout=180)
+        np.testing.assert_array_equal(r.distances, oracle_bfs(g0, 5))
+
+        for add, rm in [
+            ([(5, 90), (10, 11, 2)], [(0, 1)]),
+            ([(0, 95)], [(5, 90)]),
+            ([(7, 8, 1)], []),
+        ]:
+            out = svc.apply_edge_updates(add=add, remove=rm)
+            mat = svc._dynamic.materialize()
+            rb = svc.query(5, timeout=180)
+            np.testing.assert_array_equal(
+                rb.distances, oracle_bfs(mat, 5),
+                err_msg=f"bfs at generation {out['generation']}",
+            )
+            rs = svc.query(5, kind="sssp", timeout=180)
+            np.testing.assert_array_equal(
+                rs.distances, oracle_sssp(mat, 5),
+                err_msg=f"sssp at generation {out['generation']}",
+            )
+
+        svc.flush_audits()
+        snap = svc.statsz()
+        dyn = snap["dynamic"]
+        assert dyn["flips"] == 3 and dyn["generation"] == 3
+        assert svc.graph_generation == 3
+        st = dyn["staleness"]
+        assert st["audits"] > 0
+        assert st["stale"] == 0 and st["over_bound"] == 0
+        assert st["unmatched"] == 0 and st["errors"] == 0
+        # No detector indicted anything on a correct mutation stream.
+        assert not snap.get("quarantined_widths")
+    finally:
+        svc.close()
+
+
+@pytest.mark.serve
+def test_cc_relabels_after_flip():
+    """cc's cached component index must drop on flip: bridging two
+    components with one added edge changes the label/size/count."""
+    import scipy.sparse as sp
+    from scipy.sparse.csgraph import connected_components
+
+    svc = _service(kinds=("bfs", "cc"))
+    try:
+        svc.apply_edge_updates(add=[(5, 90)], remove=[(0, 1)])
+        mat = svc._dynamic.materialize()
+        m = sp.csr_matrix(
+            (np.ones(len(mat.col_idx)), mat.col_idx, mat.row_ptr),
+            shape=(mat.num_vertices, mat.num_vertices),
+        )
+        n, labels = connected_components(m, directed=False)
+        r = svc.query(5, kind="cc", timeout=180)
+        ex = r.extras
+        assert ex["components"] == n
+        comp = labels == labels[5]
+        assert ex["component_size"] == int(comp.sum())
+        assert ex["component"] == int(np.flatnonzero(comp)[0])
+    finally:
+        svc.close()
+
+
+@pytest.mark.serve
+def test_capacity_overflow_compacts_and_reapplies():
+    svc = _service(dynamic=(4, 32))
+    try:
+        svc.apply_edge_updates(add=[(1, 2), (3, 4)])  # 4 overlay rows
+        out = svc.apply_edge_updates(add=[(20, 21), (22, 23)])
+        assert out["compacted"] is True
+        assert out["generation"] == 2
+        snap = svc.statsz()["dynamic"]
+        assert snap["compactions"] == 1
+        mat = svc._dynamic.materialize()
+        adj = _adj(mat)
+        for u, v in [(1, 2), (3, 4), (20, 21), (22, 23)]:
+            assert (v, 1) in adj[u]
+        r = svc.query(5, timeout=180)
+        np.testing.assert_array_equal(r.distances, oracle_bfs(mat, 5))
+    finally:
+        svc.close()
+
+
+@pytest.mark.serve
+def test_cross_flip_straggler_does_not_cache():
+    """A batch resolved under generation G-1 after a flip to G must NOT
+    file its payloads under the new generation's cache keys. The
+    sentinel pending would blow up if the guard let iteration start."""
+
+    class _Boom:
+        def result(self, _t):  # pragma: no cover - guard must not reach
+            raise AssertionError("straggler reached the cache put loop")
+
+    svc = _service(cache_bytes=1 << 20)
+    try:
+        svc.apply_edge_updates(add=[(5, 90)])
+        stale = _P([_Boom()], generation=0)  # current generation is 1
+        svc._populate_cache(stale)  # returns silently, caches nothing
+        assert svc._cache.stats()["entries"] == 0
+    finally:
+        svc.close()
+
+
+@pytest.mark.serve
+def test_p2p_refused_in_dynamic_mode():
+    """parent_scan path reconstruction reads BUILD-TIME edge tables, so
+    dynamic services drop p2p at construction and the registry refuses
+    an overlay-armed p2p spec outright."""
+    from tpu_bfs.serve.registry import EngineSpec
+
+    svc = _service()
+    try:
+        assert "p2p" not in svc._kinds
+    finally:
+        svc.close()
+    with pytest.raises(ValueError):
+        EngineSpec(graph_key="g", kind="p2p", overlay=CAP).validate()
+    with pytest.raises(ValueError):
+        BfsService(GRAPH(), lanes=64, width_ladder="off",
+                   dynamic=CAP, kinds=("p2p",))
+
+
+@pytest.mark.serve
+def test_landmark_tier_invalidated_and_rewarmed_on_flip():
+    """Satellite 2, spy-pinned: the flip path must invalidate the
+    landmark distance columns BEFORE the new generation serves and
+    re-warm them over an overlay-synced engine — the tier's
+    frozen-at-warm-up staleness hole."""
+    events = []
+
+    class _SpyIndex:
+        k = 4
+
+        def invalidate(self):
+            events.append("invalidate")
+
+        def warm(self, run_batch):
+            # The re-warm engine must already fold the NEW overlay:
+            # prove it by traversing through the handed run_batch.
+            res = run_batch([5])
+            events.append(("warm", np.asarray(res.distances_int32(0))))
+
+    svc = _service()
+    try:
+        svc._landmarks = _SpyIndex()
+        svc.apply_edge_updates(add=[(5, 90)], remove=[(0, 1)])
+        mat = svc._dynamic.materialize()
+        assert events and events[0] == "invalidate"
+        tag, dist = events[1]
+        assert tag == "warm"
+        np.testing.assert_array_equal(dist, oracle_bfs(mat, 5))
+    finally:
+        svc.close()
+
+
+# --- chaos: the three new fault kinds (red-before-green) --------------------
+
+
+@pytest.mark.serve
+@pytest.mark.chaos
+def test_torn_flip_caught_by_staleness_auditor_and_healed():
+    """torn_flip@generation_flip: metadata advances, tables do not.
+    Structural checks pass and a shadow replay reproduces the stale
+    answer, so ONLY the staleness auditor's per-generation oracle
+    replay can catch it; the heal restages the true overlay."""
+    svc = _service(audit_rate=1.0)
+    try:
+        assert svc.query(5, timeout=180).ok
+
+        faults.arm_from_spec("torn_flip@generation_flip:n=1")
+        out = svc.apply_edge_updates(add=[(5, 90)], remove=[(0, 1)])
+        faults.disarm()
+        assert out["generation"] == 1  # metadata DID advance
+
+        mat = svc._dynamic.materialize()
+        r = svc.query(5, timeout=180)
+        # Red: the served answer is one flip stale.
+        assert not np.array_equal(np.asarray(r.distances),
+                                  oracle_bfs(mat, 5))
+
+        svc.flush_audits()
+        st = svc.statsz()["dynamic"]["staleness"]
+        assert st["stale"] >= 1 and st["over_bound"] >= 1
+
+        # Green: the over-bound callback restaged the overlay; the next
+        # acquire re-syncs every engine and answers are exact again.
+        r2 = svc.query(5, timeout=180)
+        np.testing.assert_array_equal(r2.distances, oracle_bfs(mat, 5))
+        # The heal indicts the stale STATE, never a serving rung.
+        svc.flush_audits()
+        assert not svc.statsz().get("quarantined_widths")
+    finally:
+        svc.close()
+
+
+@pytest.mark.serve
+@pytest.mark.chaos
+def test_corrupt_overlay_restaged_by_crc_recheck():
+    """corrupt_overlay@generation_flip: one table word flips between
+    the CRC computation and the install; the pre-swap re-check catches
+    it and the flip proceeds on tables restaged from host truth."""
+    logs = []
+    svc = _service(log=logs.append)
+    try:
+        faults.arm_from_spec("corrupt_overlay@generation_flip:n=1")
+        svc.apply_edge_updates(add=[(2, 93, 4)])
+        faults.disarm()
+        assert any("CRC re-check" in m for m in logs)
+        mat = svc._dynamic.materialize()
+        r = svc.query(5, timeout=180)
+        np.testing.assert_array_equal(r.distances, oracle_bfs(mat, 5))
+    finally:
+        svc.close()
+
+
+@pytest.mark.serve
+@pytest.mark.chaos
+def test_compaction_crash_rolls_back_to_intact_generation(tmp_path):
+    """compaction_crash@compact: the compactor dies after writing the
+    new generation artifact but before the commit pointer advances.
+    The orphan is quarantined ``.corrupt``, serving continues on the
+    previous generation, and a retry folds cleanly."""
+    svc = _service(generation_dir=str(tmp_path))
+    try:
+        svc.apply_edge_updates(add=[(5, 90, 2)], remove=[(0, 1)])
+        mat = svc._dynamic.materialize()
+
+        faults.arm_from_spec("compaction_crash@compact:n=1")
+        with svc._flip_lock:
+            with pytest.raises(RuntimeError):
+                svc._compact_locked()
+        faults.disarm()
+
+        # The uncommitted artifact is quarantined, CURRENT never moved.
+        corrupts = glob.glob(os.path.join(str(tmp_path), "*.corrupt"))
+        assert len(corrupts) == 1
+        assert svc._gen_store.current() is None
+        assert svc.statsz()["dynamic"]["compactions"] == 0
+
+        # Serving is intact on base + overlay.
+        r = svc.query(5, timeout=180)
+        np.testing.assert_array_equal(r.distances, oracle_bfs(mat, 5))
+
+        # The retry succeeds; answers unchanged (compaction is
+        # answer-neutral).
+        with svc._flip_lock:
+            svc._compact_locked()
+        assert svc._gen_store.current() == 1
+        assert svc.statsz()["dynamic"]["compactions"] == 1
+        r2 = svc.query(5, timeout=180)
+        np.testing.assert_array_equal(r2.distances, oracle_bfs(mat, 5))
+    finally:
+        svc.close()
+
+
+@pytest.mark.serve
+@pytest.mark.chaos
+def test_new_fault_kinds_parse_and_round_trip():
+    sched = faults.FaultSchedule.from_spec(
+        "torn_flip@generation_flip:n=1,"
+        "corrupt_overlay@generation_flip:n=1,"
+        "compaction_crash@compact:n=1"
+    )
+    assert len(sched.rules) == 3
+    assert sched.to_spec() == sched.to_spec()  # canonical round-trip
+    # compaction_crash is a RAISING kind at its site; the flip kinds are
+    # take-style (consumed by the flip path, never raised).
+    faults.arm_from_spec("compaction_crash@compact:n=1")
+    with pytest.raises(RuntimeError):
+        faults.ACTIVE.hit("compact", generation=1)
+    faults.disarm()
+    faults.arm_from_spec("torn_flip@generation_flip:n=1")
+    assert faults.ACTIVE.take("generation_flip", "torn_flip") is True
+    assert faults.ACTIVE.take("generation_flip", "torn_flip") is False
+    faults.disarm()
+
+
+@pytest.mark.serve
+@pytest.mark.chaos
+def test_maybe_corrupt_overlay_copies_never_mutates():
+    t = empty_overlay_tables((8, 4), 96, weighted=False)
+    before = {k: np.array(v, copy=True) for k, v in t.items()}
+    faults.arm_from_spec("corrupt_overlay@generation_flip:n=1")
+    out, fired = faults.maybe_corrupt_overlay(t, generation=1)
+    faults.disarm()
+    assert fired
+    assert overlay_crc32(out) != overlay_crc32(before)
+    for k in t:
+        np.testing.assert_array_equal(t[k], before[k])
+
+
+# --- concurrency ------------------------------------------------------------
+
+
+@pytest.mark.serve
+def test_no_dropped_queries_across_concurrent_flips():
+    """The acceptance soak in miniature: live query threads across
+    multiple generation flips, zero errors, final answers exact."""
+    svc = _service(linger_ms=2.0, audit_rate=0.25, cache_bytes=1 << 20)
+    try:
+        rng = np.random.default_rng(7)
+        stop = threading.Event()
+        errs: list = []
+        served = [0]
+
+        def traffic():
+            while not stop.is_set():
+                try:
+                    r = svc.query(int(rng.integers(0, 96)), timeout=180)
+                    if not r.ok:
+                        errs.append((r.status, r.error))
+                    served[0] += 1
+                except Exception as exc:  # noqa: BLE001 — recorded, asserted
+                    errs.append(("exc", repr(exc)))
+
+        threads = [threading.Thread(target=traffic) for _ in range(3)]
+        for t in threads:
+            t.start()
+        mut = np.random.default_rng(11)
+        for _ in range(4):
+            add = [
+                (int(mut.integers(0, 96)), int(mut.integers(0, 96)),
+                 int(mut.integers(1, 6)))
+                for _ in range(2)
+            ]
+            svc.apply_edge_updates(add=add)
+        stop.set()
+        for t in threads:
+            t.join()
+
+        assert not errs, errs[:3]
+        assert served[0] > 0
+        mat = svc._dynamic.materialize()
+        for src in (0, 5, 42):
+            r = svc.query(src, timeout=180)
+            np.testing.assert_array_equal(r.distances, oracle_bfs(mat, src))
+        svc.flush_audits()
+        st = svc.statsz()["dynamic"]["staleness"]
+        assert st["over_bound"] == 0 and st["errors"] == 0
+    finally:
+        svc.close()

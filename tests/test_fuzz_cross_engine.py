@@ -286,6 +286,9 @@ def test_sparse_delta_sieve_bit_identical():
             np.testing.assert_array_equal(ref.parent, r_pl.parent)
 
 
+# Slow lane: ~18s of packed-engine rebuilds pins a knob no-op; the
+# tier-1 budget goes to semantic coverage instead.
+@pytest.mark.slow
 def test_wire_pack_noop_on_packed_ms_engines():
     """The packed MS engines' exchange already ships uint32 lane words —
     one bit per (vertex, source) pair — so their ``wire_pack`` flag (kept
@@ -332,6 +335,10 @@ def test_wire_pack_noop_on_packed_ms_engines():
         assert plain.last_exchange_bytes == packed.last_exchange_bytes
 
 
+# Slow lane (joining its _full sibling): ~33s of interpret-mode Pallas
+# across four engine shapes; tier-1 keeps Pallas build/run/serialization
+# coverage via test_aot and test_roofline.
+@pytest.mark.slow
 def test_expand_impl_bit_identical():
     """ISSUE 16 acceptance (tier-1 arm): the Pallas expansion tier is a
     KERNEL substitution, never a semantic change — expand_impl='pallas'
@@ -584,6 +591,9 @@ def test_serve_chaos_matches_oracle(name, make):
 
 @pytest.mark.serve
 @pytest.mark.chaos
+# Slow lane: the every-kind sweep costs ~23s; test_integrity keeps the
+# per-surface corruption checks in tier-1.
+@pytest.mark.slow
 def test_corruption_at_fetch_caught_for_every_kind():
     """ISSUE 15 fuzz arm: a seeded ``corrupt_result`` bit-flip at the
     fetch boundary is CAUGHT by the audit tier for every query kind
@@ -902,3 +912,74 @@ def test_zipfian_stream_with_answer_tier_bit_identical_to_off():
             assert lo == true
     # The arm must see both regimes or the bracketing claim is vacuous.
     assert inexact >= 0  # (hub-to-hub pairs are often exact by design)
+
+
+@pytest.mark.serve
+# The Pallas arm recompiles the interpret-mode core per kind (~33s on CPU);
+# it runs in the slow lane while the XLA arm keeps the mutate/query fuzz
+# contract in tier-1.
+@pytest.mark.parametrize(
+    "impl", ["xla", pytest.param("pallas", marks=pytest.mark.slow)]
+)
+def test_dynamic_mutation_stream_bit_identical_to_rebuild(impl):
+    """ISSUE 19 fuzz arm: an interleaved mutate/query stream through a
+    dynamic service — at EVERY generation, served bfs and sssp answers
+    are bit-identical to a from-scratch CPU rebuild of that generation's
+    graph, and cc's relabeled component index matches scipy over the
+    same rebuild — through both expansion tiers. The overlay fold plus
+    lazy engine sync must be indistinguishable from rebuilding the
+    compiled cores on the mutated graph."""
+    import scipy.sparse as sp
+    from scipy.sparse.csgraph import connected_components
+
+    from tpu_bfs.integrity.staleness import oracle_bfs, oracle_sssp
+    from tpu_bfs.serve import BfsService
+
+    g = random_graph(128, 640, seed=211, weights=5)
+    svc = BfsService(g, lanes=64, width_ladder="off", linger_ms=0.0,
+                     expand_impl=impl, dynamic=(64, 32),
+                     kinds=("bfs", "sssp", "cc"))
+    rng = np.random.default_rng(503)
+    try:
+        for gen in range(1, 4):
+            add = [
+                (int(rng.integers(0, 128)), int(rng.integers(0, 128)),
+                 int(rng.integers(1, 6)))
+                for _ in range(int(rng.integers(1, 4)))
+            ]
+            # Remove a real current edge sometimes (against the live
+            # materialized adjacency, so the removal always bites).
+            cur = svc._dynamic.materialize()
+            remove = []
+            if rng.integers(2):
+                u = int(rng.choice(np.flatnonzero(np.diff(cur.row_ptr))))
+                v = int(cur.col_idx[cur.row_ptr[u]])
+                remove = [(u, v)]
+            out = svc.apply_edge_updates(add=add, remove=remove)
+            assert out["generation"] == gen
+
+            mat = svc._dynamic.materialize()
+            for s in (int(rng.integers(0, 128)), 0):
+                rb = svc.query(s, timeout=120)
+                np.testing.assert_array_equal(
+                    rb.distances, oracle_bfs(mat, s),
+                    err_msg=f"{impl} bfs gen {gen} src {s}",
+                )
+                rs = svc.query(s, kind="sssp", timeout=120)
+                np.testing.assert_array_equal(
+                    rs.distances, oracle_sssp(mat, s),
+                    err_msg=f"{impl} sssp gen {gen} src {s}",
+                )
+            m = sp.csr_matrix(
+                (np.ones(len(mat.col_idx)), mat.col_idx, mat.row_ptr),
+                shape=(mat.num_vertices, mat.num_vertices),
+            )
+            n_comp, labels = connected_components(m, directed=False)
+            s = int(rng.integers(0, 128))
+            rc = svc.query(s, kind="cc", timeout=120)
+            comp = labels == labels[s]
+            assert rc.extras["components"] == n_comp
+            assert rc.extras["component_size"] == int(comp.sum())
+            assert rc.extras["component"] == int(np.flatnonzero(comp)[0])
+    finally:
+        svc.close()

@@ -91,7 +91,7 @@ from tpu_bfs.algorithms._packed_common import PackedBatchResult as WideBfsResult
 
 def _make_core(ell: EllGraph, w: int, num_planes: int, push_cfg=None,
                gate_levels: int = 0, expand_impl: str = "xla",
-               interpret: bool = False):
+               interpret: bool = False, overlay: bool = False):
     act = ell.num_active
     spec = ExpandSpec(
         kcap=ell.kcap,
@@ -121,6 +121,14 @@ def _make_core(ell: EllGraph, w: int, num_planes: int, push_cfg=None,
     # fw is [act+1, w]: frontier bits; sentinel row act is all-zero and is
     # never written (expand emits zero there, and `& ~vis` keeps it zero).
     expand = make_expand(spec, w, impl=expand_impl, interpret=interpret)
+    if overlay:
+        # Dynamic-graph delta overlay (ISSUE 19): fold the bounded
+        # mutation tables over the base expansion output — a jnp
+        # epilogue outside either expansion tier's kernel, so xla and
+        # pallas engines share one fold and one compiled-shape contract.
+        from tpu_bfs.graph.dynamic import make_overlay_fold
+
+        expand = make_overlay_fold(expand, op="or")
     if push_cfg is None:
         return make_packed_loop(expand, num_planes)
     # Level-adaptive expansion (experimental): see
@@ -162,10 +170,20 @@ class WidePackedMsBfsEngine(PackedRunProtocol, PullGateHost,
         pull_gate: bool = False,
         expand_impl: str = "xla",
         interpret: bool | None = None,
+        overlay: tuple = (),
     ):
         if not (1 <= num_planes <= 8):
             raise ValueError("num_planes must be in [1, 8]")
         validate_expand_impl(expand_impl)
+        self.overlay = tuple(int(x) for x in overlay) if overlay else ()
+        if self.overlay and (pull_gate or adaptive_push is not None):
+            # Both gate which rows/blocks the per-level scan touches by
+            # BASE-graph keys; overlay edges would escape the gate and
+            # silently go untraversed. The delta overlay serves the
+            # plain scan only (ISSUE 19).
+            raise ValueError(
+                "overlay does not compose with pull_gate or adaptive_push"
+            )
         if interpret is None:
             # Same resolution as the hybrid engine's tile kernel: emulate
             # the Pallas tier off-TPU so CPU tests drive the real kernel.
@@ -242,6 +260,17 @@ class WidePackedMsBfsEngine(PackedRunProtocol, PullGateHost,
             # all-zero row act).
             for name, tbl in pallas_expand_arrays(ell, self._act).items():
                 self.arrs[name] = jnp.asarray(tbl)
+        if self.overlay:
+            # Arm the fold with all-pad tables (every row scatters the
+            # combine identity into the sentinel row): the overlay keys
+            # are part of the arrs pytree from the FIRST compile, so a
+            # later mutation swaps values without a retrace.
+            from tpu_bfs.graph.dynamic import empty_overlay_tables
+
+            for name, tbl in empty_overlay_tables(
+                self.overlay, self._act
+            ).items():
+                self.arrs[name] = jnp.asarray(tbl)
         if adaptive_push is not None:
             self._build_push_table(adaptive_push)
         self._table_rows = self._act + 1  # + the all-zero sentinel row
@@ -273,6 +302,7 @@ class WidePackedMsBfsEngine(PackedRunProtocol, PullGateHost,
             self._core, self._core_from, self._core_from_donate = _make_core(
                 ell, self.w, num_planes, adaptive_push,
                 expand_impl=expand_impl, interpret=self._interpret,
+                overlay=bool(self.overlay),
             )
         in_deg_ranked = ell.in_degree[ell.old_of_new].astype(np.int32)
         (
@@ -297,6 +327,29 @@ class WidePackedMsBfsEngine(PackedRunProtocol, PullGateHost,
         )
         self.arrs["push_t"] = jnp.asarray(pt)
         self.arrs["push_inelig"] = jnp.asarray(inelig)
+
+    def set_overlay(self, tables) -> None:
+        """Swap the delta-overlay tables under the already-compiled core
+        (ISSUE 19): shapes must match the armed capacity (the compiled
+        pytree is fixed — a shape change would be a silent retrace), and
+        the swap is one atomic dict rebind so a concurrently-running
+        batch sees either the old tables or the new, never a mix."""
+        if not self.overlay:
+            raise ValueError(
+                "engine built without an overlay — pass overlay=(rows, "
+                "kcap) at construction to serve a dynamic graph"
+            )
+        rows, kcap = self.overlay
+        new = {}
+        for name in ("ov_rows", "ov_idx", "ov_override"):
+            arr = np.asarray(tables[name], np.int32)
+            want = (rows, kcap) if name == "ov_idx" else (rows,)
+            if arr.shape != want:
+                raise ValueError(
+                    f"{name} shape {arr.shape} != armed capacity {want}"
+                )
+            new[name] = jnp.asarray(arr)
+        self.arrs = {**self.arrs, **new}
 
     @property
     def num_vertices(self) -> int:

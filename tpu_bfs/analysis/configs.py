@@ -164,6 +164,21 @@ def _build_engine(config: str):
             # onto (K=16 -> the 32 rung) — analyze the exact compile
             # the serve warm-up dispatches.
             "serve-landmark-warm": dict(engine="wide", lanes=32),
+            # Dynamic-graph programs (ISSUE 19): the SAME serve specs
+            # with an overlay capacity — the compiled core then carries
+            # the delta-overlay fold (add plane OR'd in / min-plus'd
+            # in, tombstone plane masked out), so every pass walks the
+            # folded expansion the mutation flip actually serves.
+            "serve-dynamic": dict(
+                engine="wide", lanes=32, overlay=(64, 32),
+            ),
+            "serve-dynamic-pallas": dict(
+                engine="wide", lanes=32, expand_impl="pallas",
+                overlay=(64, 32),
+            ),
+            "serve-dynamic-sssp": dict(
+                kind="sssp", engine="wide", lanes=32, overlay=(64, 32),
+            ),
         }.get(config)
         if kw is None:
             raise KeyError(config)
@@ -188,6 +203,7 @@ ALL_CONFIGS = (
     "serve-sssp", "serve-khop", "serve-cc", "serve-p2p",
     "serve-landmark-warm",
     "serve-wide-pallas", "serve-sssp-pallas",
+    "serve-dynamic", "serve-dynamic-pallas", "serve-dynamic-sssp",
 )
 
 

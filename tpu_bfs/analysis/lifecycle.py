@@ -78,6 +78,11 @@ DEFAULT_MODULES = (
     # ISSUE 18: the answer tier — the landmark warm-up opens an obs
     # span that must close on the warm-up failure path too.
     "tpu_bfs/serve/answercache.py",
+    # ISSUE 19: dynamic graphs — a compaction crash must never
+    # leave the flip lock held or a half-written generation
+    # admitted; the staleness audit path must shed, not leak.
+    "tpu_bfs/graph/dynamic.py",
+    "tpu_bfs/integrity/staleness.py",
     "tpu_bfs/workloads/landmarks.py",
 )
 

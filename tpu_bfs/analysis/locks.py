@@ -496,6 +496,11 @@ DEFAULT_MODULES = (
     # counters are mutated from every client thread at once.
     "tpu_bfs/serve/answercache.py",
     "tpu_bfs/workloads/landmarks.py",
+    # ISSUE 19: dynamic graphs — the overlay apply/compact state
+    # machine and the staleness auditor's sample ring are mutated
+    # by the mutation thread while serving threads read them.
+    "tpu_bfs/graph/dynamic.py",
+    "tpu_bfs/integrity/staleness.py",
 )
 
 

@@ -25,6 +25,8 @@ sssp_fetch  workloads/sssp.SsspEngine.fetch (blocking result half)
 audit_structural integrity/structural.StructuralAuditor.audit
 audit_shadow integrity/shadow.ShadowAuditor replay (background)
 cache_lookup serve/answercache.AnswerCache.get (hit verification)
+generation_flip serve/frontend.BfsService.apply_edge_updates (overlay swap)
+compact     graph/dynamic.DynamicGraph.compact (fold into new generation)
 ========== =======================================================
 
 Production code never pays for this when disabled: every site guard is
@@ -46,6 +48,7 @@ Spec grammar (``--faults`` / ``TPU_BFS_FAULTS``)::
              | "corrupt_ckpt" | "corrupt_aot"
              | "corrupt_result" | "corrupt_wire"
              | "stale_cache" | "corrupt_cache_entry"
+             | "torn_flip" | "corrupt_overlay" | "compaction_crash"
              | "device_lost" | "collective_hang" | "backend_restart"
 
 Examples::
@@ -120,6 +123,18 @@ SITES = (
     # sampled shadow audit can catch it (the generation-quarantine
     # drive).
     "cache_lookup",
+    # ISSUE 19: the dynamic-graph mutation path. generation_flip is the
+    # serve tier's overlay swap (frontend.apply_edge_updates) — torn_flip
+    # bumps the generation WITHOUT swapping the engines' overlay tables
+    # (the stale serving the staleness auditor must catch), and
+    # corrupt_overlay rots the staged tables between CRC computation and
+    # device upload (the pre-upload verification's red). compact is the
+    # compactor's crash window (graph/dynamic.DynamicGraph.compact) —
+    # compaction_crash raises AFTER the new generation's files hit disk
+    # but BEFORE the CURRENT pointer advances, the exact torn state the
+    # rollback guarantee covers.
+    "generation_flip",
+    "compact",
 )
 
 # Where a clause lands when it names no "@site". slow_extract is the
@@ -143,6 +158,11 @@ DEFAULT_SITE = {
     # at the answer cache's lookup site only.
     "stale_cache": "cache_lookup",
     "corrupt_cache_entry": "cache_lookup",
+    # ISSUE 19 dynamic-graph kinds: torn_flip/corrupt_overlay act in
+    # place at the serve flip; compaction_crash raises mid-compaction.
+    "torn_flip": "generation_flip",
+    "corrupt_overlay": "generation_flip",
+    "compaction_crash": "compact",
     "device_lost": "fetch",
     "collective_hang": "fetch",
     "backend_restart": "fetch",
@@ -157,7 +177,7 @@ MESH_KINDS = ("device_lost", "collective_hang", "backend_restart")
 # Raising kinds produce messages the shared classifier (utils/recovery.py)
 # routes like real infrastructure failures; the non-raising kinds act in
 # place (sleep / corrupt-after-write).
-_RAISING_KINDS = ("transient", "oom", *MESH_KINDS)
+_RAISING_KINDS = ("transient", "oom", "compaction_crash", *MESH_KINDS)
 
 # Context-qualifier aliases: "rung" reads the site's "lanes" context key
 # (the spec grammar talks about ladder rungs; the sites report widths).
@@ -440,6 +460,16 @@ class FaultSchedule:
                 f"(awaiting completion of an all-reduce that a lost "
                 f"participant will never join) {tail}"
             )
+        if raising.kind == "compaction_crash":
+            # The compactor dying mid-fold: new generation files are on
+            # disk, CURRENT still points at the old one. INTERNAL so the
+            # shared classifier treats it as a crash, not a retryable
+            # transient — the caller's contract is rollback, not retry.
+            raise RuntimeError(
+                f"INTERNAL: injected compactor crash — the compaction "
+                f"process died after writing the new generation but "
+                f"before the commit pointer advanced {tail}"
+            )
         if raising.kind == "backend_restart":
             raise RuntimeError(
                 f"UNAVAILABLE: injected backend restart — slice health "
@@ -633,6 +663,27 @@ def maybe_stale_cache(dist, extras, reached, **ctx):
                 extras[key] = val + 1
                 return dist, extras, reached, True
     return dist, extras, (reached if reached is None else reached + 1), True
+
+
+def maybe_corrupt_overlay(tables: dict, **ctx) -> tuple[dict, bool]:
+    """``generation_flip`` site hook for ``corrupt_overlay`` rules
+    (ISSUE 19): flip one neighbor-slot value of the STAGED overlay
+    tables between the host's CRC computation and the device upload, so
+    the pre-swap CRC re-verification fires and the serve tier restages
+    from host truth instead of swapping a torn table under the compiled
+    cores. Returns ``(tables, fired)``; the input dict's arrays are
+    never mutated in place (the touched plane is copied)."""
+    sched = ACTIVE
+    if sched is None or not sched.take("generation_flip",
+                                       "corrupt_overlay", **ctx):
+        return tables, False
+    import numpy as np
+
+    out = dict(tables)
+    idx = np.array(out["ov_idx"], copy=True)
+    idx.flat[idx.size // 2] ^= 1
+    out["ov_idx"] = idx
+    return out, True
 
 
 def maybe_corrupt_file(path: str) -> bool:

@@ -19,6 +19,13 @@ continuously, in the serve path, without touching serving latency:
 - **wire checksums** (wire.py): an order-sensitive uint32 fold shared
   by the exchange frame codec (HLO byte cost proven in wirecheck) and
   the extraction-transfer check behind the ``audit_checksum`` flag.
+- **staleness audits** (staleness.py, ISSUE 19): on a dynamic-graph
+  service, sampled served answers replay against CPU oracles of a
+  bounded ring of recent generation snapshots, measuring how many
+  flips behind the served answer sits — the only detector that can
+  catch a torn generation flip (a stale answer passes every structural
+  predicate, and a shadow replay on the same torn service reproduces
+  it); over-bound staleness quarantines the stale serving state.
 - **quarantine** (this module): a confirmed finding evicts the suspect
   rung from the registry (the rebuild clears wedged device state),
   force-opens its (width, devices, kind) circuit breaker so routing
@@ -47,6 +54,9 @@ from tpu_bfs.integrity.shadow import (  # noqa: F401 — package API
     ShadowAuditor,
     ShadowJob,
     compare_payloads,
+)
+from tpu_bfs.integrity.staleness import (  # noqa: F401 — package API
+    StalenessAuditor,
 )
 from tpu_bfs.integrity.structural import (  # noqa: F401 — package API
     StructuralAuditor,
@@ -152,6 +162,10 @@ class IntegrityTier:
                 metrics=service.metrics,
                 log=service._log,
                 max_pending=max_pending,
+                current_state=lambda: (
+                    getattr(service, "graph_generation", 0),
+                    getattr(service, "_overlay_epoch", 0),
+                ),
             )
             if self.rate > 0 else None
         )
@@ -255,6 +269,8 @@ class IntegrityTier:
                         reached=r.reached,
                         extras=dict(r.extras) if r.extras else None,
                         t_resolved=now,
+                        generation=int(getattr(pending, "generation", 0)),
+                        epoch=int(getattr(pending, "overlay_epoch", 0)),
                     )
                     self._shadow.offer(job)
             except Exception as exc:  # noqa: BLE001 — the seal: audits never
@@ -273,10 +289,24 @@ class IntegrityTier:
 
     def _audit_structural(self, pending, q, r) -> None:
         svc = self._service
+        # Generation gate (ISSUE 19): the auditor's edge tables track
+        # the LIVE generation (the flip path rebinds them), so a batch
+        # stamped with a superseded generation cannot be structurally
+        # judged — its removed edges would read as violations. Skip;
+        # the staleness auditor owns cross-generation correctness.
+        gen = int(getattr(pending, "generation", 0))
+        if gen != int(getattr(svc, "graph_generation", 0)):
+            return
         t0 = time.monotonic()
         try:
             self._structural.audit(r.kind, r)
         except StructuralFinding as exc:
+            if gen != int(getattr(svc, "graph_generation", 0)):
+                # The flip landed DURING the audit — the tables may have
+                # been rebound mid-check, so the finding indicts the
+                # graph changing, not the rung. Shed it.
+                svc.metrics.record_audit_dropped()
+                return
             svc.metrics.record_audit(
                 (time.monotonic() - t0) * 1e3, failed=True
             )
@@ -322,6 +352,8 @@ class IntegrityTier:
                 extras=dict(r.extras) if r.extras else None,
                 t_resolved=time.monotonic(),
                 origin=origin,
+                generation=int(getattr(svc, "graph_generation", 0)),
+                epoch=int(getattr(svc, "_overlay_epoch", 0)),
             )
             self._shadow.offer(job)
         except Exception as exc:  # noqa: BLE001 — audits never become

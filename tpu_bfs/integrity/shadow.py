@@ -108,6 +108,20 @@ class ShadowJob:
     # The quarantine routing keys on this — a stale cached answer
     # indicts the cache generation, never the replay rung.
     origin: str = "serve"
+    # Graph generation the answer was served under (ISSUE 19). The
+    # replay worker drops jobs whose generation no longer matches the
+    # live service: a replay engine always syncs to the CURRENT overlay,
+    # so comparing a pre-flip answer against it would indict a healthy
+    # rung for the graph having legitimately changed. Cross-generation
+    # correctness is the staleness auditor's jurisdiction, not shadow's.
+    generation: int = 0
+    # Overlay install epoch at resolution (ISSUE 19). The epoch bumps on
+    # events the generation number cannot see — a restage healing a torn
+    # flip, a compaction folding the overlay away — and a replay across
+    # either compares answers from two different table installs: a
+    # torn-state answer vs a healed engine is STALENESS (already
+    # quarantined by that auditor), not rung corruption.
+    epoch: int = 0
 
 
 #: Extras keys that legitimately vary with batch composition (the sssp
@@ -182,9 +196,14 @@ class ShadowAuditor:
 
     def __init__(self, *, acquire_engine, on_mismatch, metrics, log=None,
                  max_pending: int = 64, retries: int = 1,
-                 max_pending_bytes: int = 256 * 1024 * 1024):
+                 max_pending_bytes: int = 256 * 1024 * 1024,
+                 current_state=None):
         self._acquire_engine = acquire_engine  # (width, kind) -> engine
         self._on_mismatch = on_mismatch  # (job, detail) -> None
+        # () -> (generation, epoch): the service's live overlay state
+        # (ISSUE 19). None on static services — every job's stamps are
+        # (0, 0) and nothing is ever dropped.
+        self._current_state = current_state
         self._metrics = metrics
         self._log = log or (lambda msg: None)
         self._retries = max(int(retries), 0)
@@ -302,6 +321,20 @@ class ShadowAuditor:
         )
 
     def _audit(self, job: ShadowJob) -> None:
+        if (self._current_state is not None
+                and (job.generation, job.epoch) != self._current_state()):
+            # A flip, restage, or compaction landed between resolution
+            # and replay: the served bits came from a different table
+            # install than any engine we could replay on. Not a finding
+            # — shed it (the staleness auditor replays such answers
+            # against their own generation's host truth).
+            self._metrics.record_audit_dropped()
+            self._log(
+                f"shadow audit shed (query {job.query_id!r}): served "
+                f"overlay state (gen {job.generation}, epoch "
+                f"{job.epoch}) superseded"
+            )
+            return
         attempt = 0
         while True:
             try:
@@ -315,6 +348,18 @@ class ShadowAuditor:
                     continue
                 raise
         detail = compare_payloads(job, res)
+        if (detail is not None and self._current_state is not None
+                and (job.generation, job.epoch) != self._current_state()):
+            # The flip/restage landed DURING the replay (after the entry
+            # check, before the compare): the replay engine may have
+            # synced to the new overlay mid-acquire, so the mismatch is
+            # the graph changing, not corruption. Shed, don't indict.
+            self._metrics.record_audit_dropped()
+            self._log(
+                f"shadow audit shed (query {job.query_id!r}): "
+                f"overlay state changed mid-replay"
+            )
+            return
         lag_ms = (time.monotonic() - job.t_resolved) * 1e3
         self._metrics.record_audit(lag_ms, failed=detail is not None)
         if detail is not None:

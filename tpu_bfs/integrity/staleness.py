@@ -1,0 +1,247 @@
+"""Staleness auditor for dynamic-graph serving (ISSUE 19).
+
+The dynamic tier's failure mode the other integrity detectors cannot
+see: a TORN FLIP. A mutation batch advances the served generation (the
+registry rekeys, the cache adopts the new key, the response metadata
+says generation G) but some engine's overlay swap never landed — the
+device tables still encode G-1 (or older). Every structural predicate
+passes (the answer IS a valid BFS over *some* graph) and a shadow
+replay on a disjoint rung of the same torn service reproduces the same
+stale answer, so both existing detectors certify it. Only a replay
+against the GENERATION'S OWN host truth can tell.
+
+This auditor keeps a bounded ring of recent generation snapshots (host
+:class:`~tpu_bfs.graph.csr.Graph` objects, pushed by the serve flip
+path) and replays a deterministic sample of resolved queries against
+CPU oracles (the reference discipline — bfsCPU/checkOutput,
+bfs.cu:374-384 — applied per generation): queue BFS for bfs, binary-heap
+Dijkstra for sssp. For each sampled answer it walks the ring newest
+generation first and reports how many flips behind the newest matching
+generation sits:
+
+    staleness = (generation the batch was stamped with at dispatch)
+              - (newest generation whose oracle reproduces the answer)
+
+A correct service always measures 0 — batches are stamped inside the
+flip lock, so the stamp names the exact tables the traversal read, and
+in-flight queries pinned to an older generation match that older
+generation's stamp. Anything > ``bound`` (default 0) is a CONFIRMED
+over-bound stale answer: the ``on_over_bound`` callback quarantines the
+stale serving state (the frontend restages the overlay onto every
+resident engine, quarantines the answer cache, and flight-dumps naming
+the stale generation's artifact). An answer matching NO ringed
+generation is not a staleness finding — it is corruption, the shadow /
+structural tier's jurisdiction — and is counted separately.
+
+Runs synchronously on the extraction worker inside the observe hook
+(the IntegrityTier seal applies: an auditor bug must never become a
+serving incident), so the cost is one host-oracle traversal per sampled
+query — bounded by the sampling rate, zero on un-audited services.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+from collections import OrderedDict, deque
+
+import numpy as np
+
+from tpu_bfs.graph.csr import INF_DIST
+
+#: Default ring depth: how many recent generations a stale answer can be
+#: attributed to. Older-than-the-ring answers report as unmatched.
+DEFAULT_WINDOW = 4
+
+
+def oracle_bfs(graph, source: int) -> np.ndarray:
+    """Queue BFS distances (int32, INF_DIST unreached) — the bfsCPU
+    analog, independent of every device code path."""
+    n = graph.num_vertices
+    dist = np.full(n, INF_DIST, np.int32)
+    dist[source] = 0
+    q = deque([int(source)])
+    row_ptr, col_idx = graph.row_ptr, graph.col_idx
+    while q:
+        u = q.popleft()
+        du = dist[u] + 1
+        for v in col_idx[row_ptr[u]:row_ptr[u + 1]]:
+            if dist[v] == INF_DIST:
+                dist[v] = du
+                q.append(int(v))
+    return dist
+
+
+def oracle_sssp(graph, source: int) -> np.ndarray:
+    """Binary-heap Dijkstra over the int32 weights plane (int32,
+    INF_DIST unreached) — matches SsspBatchResult.distances_int32's
+    sentinel convention."""
+    n = graph.num_vertices
+    dist = np.full(n, INF_DIST, np.int32)
+    done = np.zeros(n, bool)
+    dist[source] = 0
+    heap = [(0, int(source))]
+    row_ptr, col_idx, wts = graph.row_ptr, graph.col_idx, graph.weights
+    while heap:
+        d, u = heapq.heappop(heap)
+        if done[u]:
+            continue
+        done[u] = True
+        for j in range(int(row_ptr[u]), int(row_ptr[u + 1])):
+            v = int(col_idx[j])
+            nd = d + int(wts[j])
+            if not done[v] and nd < dist[v]:
+                dist[v] = nd
+                heapq.heappush(heap, (nd, v))
+    return dist
+
+
+#: Kind -> oracle. Only kinds with a full distance row are auditable
+#: here; metadata-only kinds (cc/khop) are covered by the structural
+#: tier and the fuzz oracle, not per-generation replay.
+ORACLES = {"bfs": oracle_bfs, "sssp": oracle_sssp}
+
+
+class StalenessAuditor:
+    """The ring + sampled per-generation replay. The serve flip path
+    calls :meth:`push_generation` after every applied mutation batch;
+    the extraction worker calls :meth:`observe_batch` after every
+    resolved batch."""
+
+    def __init__(self, *, rate: float, seed: int = 0, bound: int = 0,
+                 window: int = DEFAULT_WINDOW, on_over_bound=None,
+                 log=None):
+        from tpu_bfs.integrity.shadow import AuditSampler
+
+        self.bound = max(int(bound), 0)
+        self.window = max(int(window), 2)
+        # Decorrelated from the shadow sampler (seed + 1): the two
+        # audits should not always pick the same queries.
+        self._sampler = AuditSampler(rate, seed + 1)
+        self._on_over_bound = on_over_bound or (lambda **kw: None)
+        self._log = log or (lambda msg: None)
+        self._lock = threading.Lock()
+        self._ring: OrderedDict = OrderedDict()  # guarded-by: _lock — gen -> Graph
+        self._oracle_cache: OrderedDict = OrderedDict()  # guarded-by: _lock
+        self._audits = 0  # guarded-by: _lock
+        self._stale = 0  # guarded-by: _lock — matched an OLDER generation
+        self._over_bound = 0  # guarded-by: _lock
+        self._unmatched = 0  # guarded-by: _lock — corruption, not staleness
+        self._errors = 0  # guarded-by: _lock
+
+    # --- the flip-path hook -----------------------------------------------
+
+    def push_generation(self, generation: int, graph) -> None:
+        """Adopt ``graph`` as generation ``generation``'s host truth
+        (the DynamicGraph's materialized from-scratch twin). Evicts past
+        the window; drops the oracle memo rows of evicted generations."""
+        with self._lock:
+            self._ring[int(generation)] = graph
+            self._ring.move_to_end(int(generation))
+            while len(self._ring) > self.window:
+                old, _ = self._ring.popitem(last=False)
+                for key in [k for k in self._oracle_cache if k[0] == old]:
+                    del self._oracle_cache[key]
+
+    # --- the extraction-worker hook ---------------------------------------
+
+    def observe_batch(self, pending) -> None:
+        """Sampled replay of one resolved batch. Sealed: never lets an
+        exception reach the serving path."""
+        served_gen = int(getattr(pending, "generation", 0))
+        for q in pending.queries:
+            try:
+                r = q.result(0)
+                if not r.ok or r.kind not in ORACLES:
+                    continue
+                if getattr(r, "distances", None) is None:
+                    continue
+                if not self._sampler.should_sample():
+                    continue
+                self._audit_one(q, r, served_gen)
+            except Exception as exc:  # noqa: BLE001 — the integrity seal
+                with self._lock:
+                    self._errors += 1
+                self._log(
+                    f"staleness audit errored (query "
+                    f"{getattr(q, 'id', None)!r}): "
+                    f"{type(exc).__name__}: {str(exc)[:200]}"
+                )
+
+    def _oracle_row(self, generation: int, kind: str,
+                    source: int) -> np.ndarray | None:
+        with self._lock:
+            graph = self._ring.get(generation)
+            key = (generation, kind, int(source))
+            row = self._oracle_cache.get(key)
+        if graph is None:
+            return None
+        if row is None:
+            row = ORACLES[kind](graph, int(source))
+            with self._lock:
+                self._oracle_cache[key] = row
+                while len(self._oracle_cache) > 4 * self.window:
+                    self._oracle_cache.popitem(last=False)
+        return row
+
+    def _audit_one(self, q, r, served_gen: int) -> None:
+        with self._lock:
+            self._audits += 1
+            gens = list(self._ring)
+        served = np.asarray(r.distances, np.int32)
+        # Newest first: the common case (staleness 0) matches on the
+        # first replay and pays exactly one oracle traversal.
+        for gen in sorted(gens, reverse=True):
+            if gen > served_gen:
+                continue
+            truth = self._oracle_row(gen, r.kind, r.source)
+            if truth is None or truth.shape != served.shape:
+                continue
+            if not np.array_equal(truth, served):
+                continue
+            staleness = served_gen - gen
+            if staleness <= 0:
+                return
+            with self._lock:
+                self._stale += 1
+                over = staleness > self.bound
+                if over:
+                    self._over_bound += 1
+            if over:
+                self._on_over_bound(
+                    query_id=q.id, kind=r.kind, source=r.source,
+                    served_generation=served_gen, matched_generation=gen,
+                    staleness=staleness,
+                    detail=(
+                        f"{r.kind} answer stamped generation "
+                        f"{served_gen} reproduces generation {gen}'s "
+                        f"oracle ({staleness} flip(s) stale, bound "
+                        f"{self.bound})"
+                    ),
+                )
+            return
+        # No ringed generation reproduces it: that is a wrong answer,
+        # not a stale one — count it and leave the indictment to the
+        # shadow/structural detectors (which compare against the LIVE
+        # config and own rung quarantine).
+        with self._lock:
+            self._unmatched += 1
+        self._log(
+            f"staleness audit: query {q.id!r} ({r.kind}) matches no "
+            f"generation in the window {sorted(gens)} — corruption "
+            f"territory, deferred to the shadow/structural tier"
+        )
+
+    # --- introspection ----------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "audits": self._audits,
+                "stale": self._stale,
+                "over_bound": self._over_bound,
+                "unmatched": self._unmatched,
+                "errors": self._errors,
+                "bound": self.bound,
+                "window": len(self._ring),
+            }

@@ -63,8 +63,24 @@ class StructuralAuditor:
         self._kern = {}  # guarded-by: _lock — jitted check kernels
         self._csum = None  # guarded-by: _lock — device checksum kernel
         self._edge_keys = None  # guarded-by: _lock — sorted int64 edge keys
+        self._bind_token = 0  # guarded-by: _lock — bumped by rebind()
 
     # --- lazy device state ------------------------------------------------
+
+    def rebind(self, graph) -> None:
+        """Swap the audited graph (ISSUE 19: the serve flip path rebinds
+        the auditor to each new generation's materialized twin). Drops
+        the cached device edge tables, the host edge-key set, AND the
+        jitted check kernels — they close over the edge tables as
+        compile-time constants, and E changes across generations anyway.
+        The V-shaped checksum kernel survives (V never changes).
+        Everything rebuilds lazily on the next audit."""
+        with self._lock:
+            self._g = graph
+            self._dev = None
+            self._edge_keys = None
+            self._kern = {}
+            self._bind_token += 1
 
     def prepare(self) -> None:
         """Pay the one-time costs NOW (the integrity tier calls this on
@@ -106,6 +122,7 @@ class StructuralAuditor:
 
         with self._lock:
             k = self._kern.get(kind)
+            token = self._bind_token
         if k is not None:
             return k
         srcv, dstv, wv = self._edges_dev()
@@ -126,7 +143,11 @@ class StructuralAuditor:
                 return jnp.sum(bad.astype(jnp.int32))
 
         with self._lock:
-            self._kern[kind] = check
+            # A rebind() racing this build means the captured tables may
+            # be the superseded generation's — usable for THIS call
+            # (the caller's generation gate decides), but never cached.
+            if self._bind_token == token:
+                self._kern[kind] = check
         return check
 
     def _checksum_kernel(self):

@@ -121,6 +121,15 @@ class AnswerCache:
         with self._lock:
             return self._generation
 
+    def set_graph_generation(self, generation: int) -> None:
+        """Dynamic-graph flip (ISSUE 19): adopt the new served graph
+        version. Entries keyed under the old generation become
+        unreachable INSTANTLY (invalidation by key, never by scan —
+        exactly what the key field was reserved for); their bytes drain
+        off the cold end of the LRU as fresh traffic inserts."""
+        with self._lock:
+            self.graph_generation = int(generation)
+
     # --- store ------------------------------------------------------------
 
     def put(self, *, kind: str, source: int, k=None, target=None,

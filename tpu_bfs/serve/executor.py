@@ -244,7 +244,8 @@ class PendingBatch:
 
     __slots__ = ("engine", "queries", "n", "padded", "handle", "attempt",
                  "lanes", "bid", "devices", "t_dispatch", "device_ms",
-                 "wire_bytes", "kind", "params")
+                 "wire_bytes", "kind", "params", "generation",
+                 "overlay_epoch")
 
     def __init__(self, engine, queries, n: int, padded: np.ndarray,
                  kind: str = "bfs", params: dict | None = None):
@@ -281,6 +282,17 @@ class PendingBatch:
         # Process-wide batch ordinal: the span-correlation id every obs
         # event of this batch (and its queries) carries.
         self.bid = next(_BATCH_SEQ)
+        # Graph generation this batch was ADMITTED under (ISSUE 19):
+        # stamped by the scheduler inside the flip lock at dispatch, so
+        # the stamp always names the generation of the engine tables the
+        # batch actually traversed — the staleness auditor's ground
+        # truth. Static services leave it 0.
+        self.generation = 0
+        # Overlay install epoch at the same dispatch point: bumps on
+        # table events the generation number cannot see (restage heals,
+        # compactions), so the shadow auditor can tell "replayable
+        # against the live tables" from "superseded install".
+        self.overlay_epoch = 0
 
 
 class _Ready:

@@ -10,6 +10,13 @@ behind a line protocol:
                "reached": 104857, "latency_ms": 18.4, "batch_lanes": 31,
                "dispatched_lanes": 32, "distances_npy": "<base64 .npy>"}
 
+With ``--mutations`` (ISSUE 19) the wire also takes edge updates:
+
+    request   {"id": 9, "op": "mutate", "add": [[1, 2], [3, 4, 7]],
+               "remove": [[5, 6]]}
+    response  {"id": 9, "op": "mutate", "ok": true, "generation": 3,
+               "flip_ms": 1.8, "overlay_rows": 2, "compacted": false}
+
 Non-ok responses carry ``status`` in {rejected, deadline_exceeded,
 error, shutdown} plus ``error``. Responses are emitted as queries
 complete (batch order, not arrival order); ``id`` is the correlation
@@ -258,6 +265,9 @@ class BfsService:
         audit_seed: int = 0,
         cache_bytes: int = 0,
         landmarks: int = 0,
+        dynamic=(),
+        generation_dir: str | None = None,
+        staleness_bound: int = 0,
         single_flight: bool = True,
         distances: bool = True,
         kinds=None,
@@ -344,6 +354,95 @@ class BfsService:
             self._kinds = kinds
         if not self._kinds:
             raise ValueError("service must serve at least one kind")
+        # Dynamic-graph tier (ISSUE 19): ``dynamic=(rows, kcap)`` (or
+        # True for the default capacity) arms streaming edge updates —
+        # every engine builds with a bounded overlay of that shape, the
+        # flip lock serializes mutation flips against batch dispatch,
+        # and ``apply_edge_updates`` becomes the mutation API. The flip
+        # state below exists (cheap, inert) even on static services so
+        # the scheduler loop stays branch-free.
+        self._flip_lock = threading.RLock()
+        self._dynamic = None
+        self._gen_store = None
+        self._gen_tmp = None
+        self._overlay_cap = ()
+        # Writes serialize under _flip_lock; reads are deliberately
+        # lock-free (a torn-free CPython int snapshot) — _spec and the
+        # cache straggler guard run on paths that also hold _width_lock,
+        # and taking the flip lock there would close a lock-order cycle.
+        self._graph_generation = 0
+        self._overlay_tables = None  # guarded-by: _flip_lock
+        self._overlay_epoch = 0  # guarded-by: _flip_lock
+        self._flips = 0  # guarded-by: _flip_lock
+        self._compactions = 0  # guarded-by: _flip_lock
+        self._flip_ms: list = []  # guarded-by: _flip_lock (last 64)
+        self._staleness = None
+        if dynamic:
+            from tpu_bfs.graph.dynamic import (
+                DEFAULT_CAPACITY,
+                DynamicGraph,
+                GenerationStore,
+            )
+
+            cap = (DEFAULT_CAPACITY if dynamic is True
+                   else (int(dynamic[0]), int(dynamic[1])))
+            self._overlay_cap = cap
+            # Raises on an undirected/engine/pull_gate mismatch before
+            # any build (DynamicGraph checks the base; EngineSpec
+            # .validate below checks the engine combos).
+            self._dynamic = DynamicGraph(
+                self._graph, capacity=cap, log=self._log
+            )
+            if generation_dir is None:
+                import tempfile
+
+                # Service-owned store: compactions still get the full
+                # crash-safe commit protocol, just not a survivable
+                # location (pass generation_dir for that).
+                self._gen_tmp = tempfile.TemporaryDirectory(
+                    prefix="tpu-bfs-generations-"
+                )
+                generation_dir = self._gen_tmp.name
+            self._gen_store = GenerationStore(generation_dir,
+                                              log=self._log)
+            if "p2p" in self._kinds:
+                # p2p's path reconstruction scans the BUILD-TIME edge
+                # tables (parent_scan), which the overlay fold never
+                # touches — a reconstructed path could walk a removed
+                # edge. Dropped from dynamic serving until the scan
+                # learns the overlay (EngineSpec.validate enforces the
+                # same).
+                self._kinds = tuple(
+                    k for k in self._kinds if k != "p2p"
+                )
+                self._log(
+                    "dynamic serving: p2p dropped from the served kinds "
+                    "(path reconstruction reads build-time edge tables)"
+                )
+                if not self._kinds:
+                    raise ValueError(
+                        "dynamic serving cannot serve p2p alone"
+                    )
+            if audit_rate > 0:
+                from tpu_bfs.integrity.staleness import StalenessAuditor
+
+                # The generation-staleness arm of the integrity tier:
+                # same sampling rate as the shadow audits, replaying
+                # against the generation ring instead of a disjoint
+                # rung. Disarmed (with the rest of the audits) at
+                # rate 0.
+                self._staleness = StalenessAuditor(
+                    rate=audit_rate, seed=audit_seed,
+                    bound=staleness_bound,
+                    on_over_bound=self._on_stale_generation,
+                    log=self._log,
+                )
+                self._staleness.push_generation(0, self._graph)
+        elif generation_dir is not None:
+            raise ValueError(
+                "generation_dir without dynamic=(rows, kcap): the "
+                "generation store only exists to persist compactions"
+            )
         if registry is None and len(self._kinds) > 1:
             # The internally-owned registry must hold the warmed primary
             # ladder PLUS one resident engine per additional kind (their
@@ -444,6 +543,8 @@ class BfsService:
         cfg = self._mesh_cfg if cfg is None else cfg
         return EngineSpec(
             graph_key=self._graph_key,
+            graph_generation=self._graph_generation,
+            overlay=self._overlay_cap,
             kind=kind,
             engine=cfg.engine,
             lanes=self.lanes if width is None else width,
@@ -546,6 +647,14 @@ class BfsService:
             for q in self._queue.next_batch(self._queue.cap, 0.0):
                 if q.resolve_status(STATUS_SHUTDOWN, error="service closed"):
                     self.metrics.record_shutdown()
+        if self._gen_tmp is not None:
+            # Service-owned generation store (no generation_dir given):
+            # reclaim it now instead of at interpreter teardown.
+            try:
+                self._gen_tmp.cleanup()
+            except OSError:
+                pass
+            self._gen_tmp = None
 
     def __enter__(self) -> "BfsService":
         return self
@@ -827,6 +936,282 @@ class BfsService:
         if self._cache is not None:
             self._cache.quarantine_generation(detail=detail)
 
+    # --- dynamic graphs (ISSUE 19) ----------------------------------------
+
+    @property
+    def graph_generation(self) -> int:
+        """The served graph generation: bumps on every applied mutation
+        batch (0 on a static service, and before the first mutation)."""
+        return self._graph_generation
+
+    def apply_edge_updates(self, add=(), remove=()) -> dict:
+        """One streaming mutation batch: ``add`` edges ``(u, v)`` /
+        ``(u, v, w)``, ``remove`` edges ``(u, v)``. Stages the bounded
+        overlay on the host, CRC-verifies it across the hand-off, and
+        flips the served generation atomically BETWEEN batches (the flip
+        lock excludes the scheduler's dispatch section): the registry
+        rekeys resident engines to the new generation, the answer cache
+        invalidates by key, the landmark columns recompute, and the
+        staleness auditor adopts the generation's host truth. When the
+        batch does not fit the overlay, a COMPACTION runs first (new
+        persisted base generation, every engine rebuilt over the
+        verified artifact) and the batch re-applies on the empty
+        overlay; a compaction failure rolls back — serving continues on
+        base + overlay and the error propagates with nothing mutated.
+        Thread-safe; callable from any thread (the JSONL server calls
+        it from the reader thread). Returns a stats dict (generation,
+        flip_ms, overlay_rows, compacted)."""
+        if self._dynamic is None:
+            raise RuntimeError(
+                "service is static: construct with dynamic=(rows, kcap) "
+                "(or --mutations) to serve edge updates"
+            )
+        if self._closed:
+            raise RuntimeError("service is closed")
+        from tpu_bfs.graph.dynamic import OverlayCapacityError
+
+        t0 = time.monotonic()
+        with self._flip_lock:
+            compacted = False
+            try:
+                tables, stats = self._dynamic.apply(add=add, remove=remove)
+            except OverlayCapacityError as exc:
+                self._log(
+                    f"overlay at capacity ({str(exc)[:200]}); compacting "
+                    f"before applying the batch"
+                )
+                self._compact_locked()  # raises on failure (rolled back)
+                compacted = True
+                # Re-apply on the empty overlay over the new base. A
+                # second capacity error (a single batch larger than the
+                # whole overlay, or an edge at a still-inactive vertex)
+                # is a caller error and propagates — the compaction
+                # stands, nothing was mutated.
+                tables, stats = self._dynamic.apply(add=add, remove=remove)
+            self._install_overlay_locked(tables)
+            gen = self._graph_generation
+            flip_ms = (time.monotonic() - t0) * 1e3
+            self._flips += 1
+            self._flip_ms.append(flip_ms)
+            del self._flip_ms[:-64]
+            overlay_rows = stats["overlay_rows"]
+        rec = _obs.ACTIVE
+        if rec is not None:
+            rec.event("generation_flip", cat="serve.dynamic",
+                      generation=gen, overlay_rows=overlay_rows,
+                      compacted=compacted, flip_ms=round(flip_ms, 3))
+        return {
+            "generation": gen,
+            "flip_ms": round(flip_ms, 3),
+            "overlay_rows": overlay_rows,
+            "compacted": compacted,
+        }
+
+    def _install_overlay_locked(self, tables) -> None:  # requires-lock: _flip_lock
+        """The flip proper (caller holds the flip lock): CRC the staged
+        tables across the host hand-off, then advance the generation and
+        rekey every serve tier. Engines adopt the new tables lazily at
+        their next acquire (_sync_engine_overlay) — the flip lock makes
+        that indistinguishable from an eager swap, with no dependence on
+        the registry's non-blocking resident listing."""
+        from tpu_bfs.graph.dynamic import overlay_crc32
+
+        dyn = self._dynamic
+        gen = dyn.generation
+        want_crc = overlay_crc32(tables)
+        if _faults.ACTIVE is not None:
+            # Chaos site generation_flip / corrupt_overlay: one table
+            # word flips between CRC computation and installation —
+            # exactly the host-memory rot window the re-check covers.
+            tables, _fired = _faults.maybe_corrupt_overlay(
+                tables, generation=gen
+            )
+        if overlay_crc32(tables) != want_crc:
+            self._log(
+                "staged overlay failed its CRC re-check before install "
+                "— restaging from the host truth"
+            )
+            rec = _obs.ACTIVE
+            if rec is not None:
+                rec.event("overlay_corrupt", cat="serve.dynamic",
+                          generation=gen)
+                rec.flight_dump("overlay_corrupt")
+            tables = dyn.overlay_tables()
+        torn = (_faults.ACTIVE is not None
+                and _faults.ACTIVE.take("generation_flip", "torn_flip",
+                                        generation=gen))
+        if torn:
+            # Chaos site generation_flip / torn_flip: the metadata
+            # advances (generation, registry keys, cache) but the DATA
+            # does not — the previous tables stay installed, so every
+            # answer is one flip stale while claiming the new
+            # generation. Only the staleness auditor can catch this
+            # (structural checks pass, a shadow replay reproduces it).
+            self._log(
+                "TORN FLIP injected: generation advanced without the "
+                "overlay table swap"
+            )
+        else:
+            self._overlay_tables = tables
+        self._overlay_epoch += 1
+        self._graph_generation = gen
+        self._registry.rekey_generation(self._graph_key, gen)
+        if self._cache is not None:
+            self._cache.set_graph_generation(gen)
+        lm = self._landmarks
+        if lm is not None:
+            # Satellite fix for the tier's frozen-at-warm-up staleness
+            # hole: one added edge can tighten d(l, v) everywhere, so
+            # the columns are disabled FIRST (no answer window over
+            # stale bounds) and recomputed over the flipped engine.
+            lm.invalidate()
+            try:
+                self._rewarm_landmarks_locked(lm)
+            except Exception as exc:  # noqa: BLE001 — optimization tier
+                self._landmarks = None
+                self._log(
+                    f"landmark re-warm failed after the flip "
+                    f"({type(exc).__name__}: {str(exc)[:200]}); tier "
+                    f"disabled"
+                )
+        if self._staleness is not None:
+            self._staleness.push_generation(gen, dyn.materialize())
+        tier = self._integrity
+        if tier is not None and tier._structural is not None:
+            # The structural auditor's edge tables must track the live
+            # generation: a removed edge left in them would read a
+            # CORRECT post-flip answer as an edge-slack violation. The
+            # tier's generation gate sheds audits of superseded batches.
+            tier._structural.rebind(dyn.materialize())
+
+    def _rewarm_landmarks_locked(self, index) -> None:
+        """Recompute the landmark columns over the flipped graph with
+        one flagship batch (caller holds the flip lock, so the acquired
+        engine is overlay-synced to the new generation)."""
+        engine = self._acquire_engine(self._route_width(index.k), "bfs")
+        index.warm(
+            lambda sources: engine.run(
+                np.asarray(sources, dtype=np.int64), time_it=False
+            )
+        )
+
+    def _sync_engine_overlay(self, engine) -> None:
+        """Bring one engine's overlay tables up to the installed epoch
+        (every acquire path funnels here, under the flip lock). Engines
+        build with an EMPTY armed overlay; lazily-built ones (a degrade
+        rung, a shadow rung, a non-primary kind's first query) would
+        otherwise silently serve the base graph after a flip — the
+        per-engine epoch stamp closes that hole, and re-arms every
+        engine after a restage heals a torn flip."""
+        if self._dynamic is None:
+            return
+        with self._flip_lock:
+            epoch = self._overlay_epoch
+            if getattr(engine, "_overlay_epoch", 0) == epoch:
+                return
+            if self._overlay_tables is not None:
+                engine.set_overlay(self._overlay_tables)
+            engine._overlay_epoch = epoch
+
+    def _restage_overlay(self) -> None:
+        """Re-install the CURRENT overlay from the dynamic graph's host
+        truth — the heal after a confirmed torn flip (or staged-table
+        corruption): the epoch bump forces every engine to re-adopt the
+        true tables at its next acquire."""
+        with self._flip_lock:
+            if self._dynamic is None:
+                return
+            self._overlay_tables = self._dynamic.overlay_tables()
+            self._overlay_epoch += 1
+
+    def _compact_locked(self) -> None:  # requires-lock: _flip_lock
+        """Fold the overlay into a new persisted base generation (caller
+        holds the flip lock). On success the registry's graph is
+        replaced by the VERIFIED loaded artifact and every resident
+        engine drops (their ELL tables bake the old base; rebuilds are
+        lazy). On ANY failure — the compactor dying at the
+        ``compaction_crash`` site, or the new artifact failing its CRC
+        at load (quarantined ``.corrupt``) — the previous generation
+        stays served (base + overlay), orphaned uncommitted artifacts
+        are quarantined, and the error propagates to the mutation
+        caller."""
+        dyn = self._dynamic
+        store = self._gen_store
+        t0 = time.monotonic()
+        try:
+            new_graph = dyn.compact(store)
+        except Exception as exc:
+            quarantined = store.quarantine_orphans()
+            err = f"{type(exc).__name__}: {str(exc)[:300]}"
+            self._log(
+                f"compaction FAILED ({err}); rolled back — serving "
+                f"continues on the previous generation"
+                + (f"; quarantined {quarantined}" if quarantined else "")
+            )
+            rec = _obs.ACTIVE
+            if rec is not None:
+                # Flight-recorder trigger naming the quarantined
+                # artifact(s): the run-up to a dead compactor is exactly
+                # the window worth keeping.
+                rec.event("compaction_failed", cat="serve.dynamic",
+                          error=err, quarantined=quarantined)
+                rec.flight_dump("compaction_failed")
+            raise
+        self._registry.add_graph(self._graph_key, new_graph)
+        self._graph = new_graph
+        dropped = self._registry.drop_graph_engines(self._graph_key)
+        self._overlay_tables = None
+        self._overlay_epoch += 1
+        self._compactions += 1
+        ms = (time.monotonic() - t0) * 1e3
+        self._log(
+            f"compacted into base generation {store.current()} in "
+            f"{ms:.0f}ms ({dropped} resident engines dropped; rebuilds "
+            f"are lazy)"
+        )
+        rec = _obs.ACTIVE
+        if rec is not None:
+            rec.event("compaction", cat="serve.dynamic",
+                      base_generation=store.current(), dropped=dropped,
+                      ms=round(ms, 1))
+
+    def _on_stale_generation(self, *, query_id, kind, source,
+                             served_generation, matched_generation,
+                             staleness, detail) -> None:
+        """A CONFIRMED over-bound stale answer (the staleness auditor's
+        oracle replay). The suspect is the stale serving STATE — the old
+        generation's tables still installed past a flip — not a rung:
+        quarantine the old generation (flight dump naming its artifact),
+        drop the answer cache's trust, and heal by restaging the true
+        overlay onto every engine."""
+        art = None
+        if self._gen_store is not None:
+            p = self._gen_store._path(matched_generation)
+            art = p if os.path.exists(p) else None
+        self._log(
+            f"STALE GENERATION on query {query_id!r}: {detail[:300]} — "
+            f"quarantining generation {matched_generation}"
+            + (f" (artifact {art})" if art else "")
+        )
+        rec = _obs.ACTIVE
+        if rec is not None:
+            rec.event("stale_generation", cat="serve.dynamic",
+                      query=query_id, kind=kind, source=source,
+                      served_generation=served_generation,
+                      stale_generation=matched_generation,
+                      staleness=staleness,
+                      artifact=art or f"generation {matched_generation} "
+                                      f"(in-memory overlay state)",
+                      detail=detail[:300])
+            rec.flight_dump("stale_generation")
+        if self._cache is not None:
+            # Cache entries were admitted under the torn state's keys.
+            self._cache.quarantine_generation(
+                detail=f"stale generation {matched_generation} served "
+                       f"as {served_generation}"
+            )
+        self._restage_overlay()
+
     def query(self, source, *, timeout: float | None = None,
               deadline_ms: float | None = None,
               want_distances: bool | None = None, kind: str = "bfs",
@@ -874,6 +1259,21 @@ class BfsService:
         lm = self._landmarks
         if lm is not None:
             out["landmarks"] = lm.config_summary()
+        if self._dynamic is not None:
+            # Dynamic-graph echo (ISSUE 19): what generation the
+            # counters on this line were served under, how full the
+            # overlay is, and the staleness-audit verdict counters.
+            with self._flip_lock:
+                dyn = {
+                    "generation": self._graph_generation,
+                    "overlay_rows": self._dynamic.overlay_rows_used(),
+                    "overlay_capacity": list(self._overlay_cap),
+                    "flips": self._flips,
+                    "compactions": self._compactions,
+                }
+            if self._staleness is not None:
+                dyn["staleness"] = self._staleness.stats()
+            out["dynamic"] = dyn
         store = self._registry.aot_store
         if store is not None:
             # AOT preheat visibility: artifact hits vs JIT fallbacks —
@@ -957,7 +1357,9 @@ class BfsService:
         while True:
             width = min(width, self.lanes)
             try:
-                return self._registry.get(self._spec(width, kind=kind))
+                engine = self._registry.get(self._spec(width, kind=kind))
+                self._sync_engine_overlay(engine)
+                return engine
             except Exception as exc:  # noqa: BLE001 — gated by classifiers
                 if is_oom_failure(exc) and self._degrade(width):
                     continue
@@ -1396,8 +1798,13 @@ class BfsService:
 
     def _acquire_shadow_engine(self, width: int, kind: str):
         """The shadow auditor's engine hook: warm (and keep resident) the
-        disjoint rung through the ordinary registry path."""
-        return self._registry.get(self._shadow_spec(width, kind))
+        disjoint rung through the ordinary registry path. The overlay
+        sync matters here too — a shadow replay must run against the
+        SERVED generation or every audited answer on a dynamic service
+        would spuriously mismatch."""
+        engine = self._registry.get(self._shadow_spec(width, kind))
+        self._sync_engine_overlay(engine)
+        return engine
 
     def flush_audits(self, timeout: float = 60.0) -> bool:
         """Barrier: every enqueued shadow audit processed (bench/smoke
@@ -1418,6 +1825,11 @@ class BfsService:
         try:
             self._executor.finish_batch(pending)
             self._populate_cache(pending)
+            if self._staleness is not None:
+                # Generation-staleness arm (ISSUE 19): sampled oracle
+                # replay against the generation ring, synchronous on
+                # this worker, sealed internally like observe_batch.
+                self._staleness.observe_batch(pending)
             tier = self._integrity
             if tier is not None:
                 # The audit hook (ISSUE 15): every query of this batch is
@@ -1471,6 +1883,14 @@ class BfsService:
         turn a served batch into an incident."""
         cache = self._cache
         if cache is None:
+            return
+        if (self._dynamic is not None
+                and pending.generation != self.graph_generation):
+            # A flip landed while this batch was in flight: its answers
+            # are correct for the generation they were pinned to, but
+            # caching them now would file generation G-1 payloads under
+            # generation G keys — the exact staleness the key axis
+            # exists to prevent. Stragglers just don't cache.
             return
         for q in pending.queries:
             try:
@@ -1546,15 +1966,26 @@ class BfsService:
                               width=width, kind=kind,
                               queries=[q.id for q in live],
                               queue_depth=self._queue.depth())
-                engine = self._acquire_engine(width, kind)
-                if len(live) > engine.lanes:
-                    # An OOM degraded the cap AFTER this batch was popped
-                    # at the old one: serve what fits, re-admit the tail
-                    # at the front (same contract as OomRequeue — degrade
-                    # must never turn into error responses).
-                    self._queue.requeue(live[engine.lanes:])
-                    live = live[: engine.lanes]
-                pending = self._executor.dispatch_batch(engine, live)
+                # The dispatch section runs under the flip lock (ISSUE
+                # 19): generation flips happen BETWEEN batches, never
+                # between an engine's overlay sync and its dispatch, so
+                # the generation stamp below always names the tables the
+                # batch actually traversed. Uncontended (and reentrant —
+                # _acquire_engine syncs under it) on static services.
+                with self._flip_lock:
+                    engine = self._acquire_engine(width, kind)
+                    if len(live) > engine.lanes:
+                        # An OOM degraded the cap AFTER this batch was
+                        # popped at the old one: serve what fits,
+                        # re-admit the tail at the front (same contract
+                        # as OomRequeue — degrade must never turn into
+                        # error responses).
+                        self._queue.requeue(live[engine.lanes:])
+                        live = live[: engine.lanes]
+                    pending = self._executor.dispatch_batch(engine, live)
+                    if pending is not None:
+                        pending.generation = self._graph_generation
+                        pending.overlay_epoch = self._overlay_epoch
             except OomRequeue as exc:
                 # Drop this frame's reference to the OOM'd engine before
                 # the narrower rebuild (OomRequeue is only raised by
@@ -1802,6 +2233,30 @@ def build_arg_parser() -> argparse.ArgumentParser:
                     "bounds meet answer exactly in microseconds, the "
                     "rest fall back to traversal. 0 disables (default); "
                     "needs p2p served (undirected graph)")
+    ap.add_argument("--mutations", default=None, metavar="DxK", nargs="?",
+                    const="default",
+                    help="dynamic-graph serving (ISSUE 19): arm streaming "
+                    "edge updates over a bounded overlay of D mutated "
+                    "rows x K neighbor slots (bare --mutations uses "
+                    "256x16). Requests {\"op\":\"mutate\",\"add\":[[u,v],"
+                    "[u,v,w]...],\"remove\":[[u,v]...]} flip the served "
+                    "generation atomically between batches; an "
+                    "overflowing batch compacts into a new persisted "
+                    "base generation first. Needs the single-chip wide "
+                    "engine on an undirected graph; p2p drops from the "
+                    "served kinds")
+    ap.add_argument("--generation-dir", default=None, metavar="DIR",
+                    help="persist compacted base generations here "
+                    "through the CRC checkpoint machinery (atomic "
+                    "writes, CURRENT pointer committed last, corrupt "
+                    "artifacts quarantined .corrupt); default: a "
+                    "service-owned temporary directory")
+    ap.add_argument("--staleness-bound", type=int, default=0, metavar="N",
+                    help="max generation flips a sampled served answer "
+                    "may trail before the staleness auditor quarantines "
+                    "the stale generation (needs --mutations and "
+                    "--audit-rate > 0; default 0 — answers must match "
+                    "the generation they were stamped with)")
     ap.add_argument("--faults", default=None, metavar="SPEC",
                     help="arm a deterministic fault-injection schedule "
                     "(tpu_bfs/faults.py), e.g. 'seed=7:transient@dispatch:"
@@ -2032,6 +2487,20 @@ def run_server(args, stdin=None, stdout=None, stderr=None,
         from tpu_bfs.resilience.resume import set_default_dir
 
         set_default_dir(resume_dir)
+    dyn_raw = getattr(args, "mutations", None)
+    dynamic = ()
+    if dyn_raw:
+        if dyn_raw == "default":
+            dynamic = True
+        else:
+            try:
+                d, k = (int(x) for x in str(dyn_raw).lower().split("x"))
+                dynamic = (d, k)
+            except ValueError:
+                raise SystemExit(
+                    f"--mutations must look like DxK (e.g. 256x16), "
+                    f"got {dyn_raw!r}"
+                ) from None
     service = BfsService(
         args.graph,
         engine=args.engine,
@@ -2065,6 +2534,9 @@ def run_server(args, stdin=None, stdout=None, stderr=None,
         audit_seed=getattr(args, "audit_seed", 0),
         cache_bytes=getattr(args, "cache_bytes", 0),
         landmarks=getattr(args, "landmarks", 0),
+        dynamic=dynamic,
+        generation_dir=getattr(args, "generation_dir", None),
+        staleness_bound=getattr(args, "staleness_bound", 0),
         distances=not args.no_distances,
         kinds=(
             tuple(t for t in str(args.kinds).replace(",", " ").split())
@@ -2181,11 +2653,47 @@ def run_server(args, stdin=None, stdout=None, stderr=None,
         f"pipeline={not args.no_pipeline} linger={args.linger_ms}ms "
         f"queue_cap={args.queue_cap}")
 
+    def mutate_line(line: str) -> bool:
+        """The {"op": "mutate"} request (ISSUE 19), handled ON the
+        reader thread — mutations serialize with each other for free
+        and apply_edge_updates flips between dispatched batches via the
+        flip lock. Returns False when the line is not a mutate op (it
+        falls through to the query path). Every failure answers a
+        structured line; nothing here may kill the reader."""
+        try:
+            req = json.loads(line)
+        except Exception:  # noqa: BLE001 — the query path answers it
+            return False
+        if not (isinstance(req, dict) and req.get("op") == "mutate"):
+            return False
+        qid = req.get("id")
+        try:
+            add = req.get("add") or ()
+            remove = req.get("remove") or ()
+            if not isinstance(add, (list, tuple)) or not isinstance(
+                    remove, (list, tuple)):
+                raise TypeError(
+                    "add/remove must be arrays of [u, v] / [u, v, w]"
+                )
+            out = service.apply_edge_updates(
+                add=[tuple(int(x) for x in e) for e in add],
+                remove=[tuple(int(x) for x in e) for e in remove],
+            )
+            emit({"id": qid, "op": "mutate", "ok": True, **out})
+        except Exception as exc:  # noqa: BLE001 — answered, never fatal
+            emit({
+                "id": qid, "op": "mutate", "ok": False,
+                "error": f"{type(exc).__name__}: {str(exc)[:300]}",
+            })
+        return True
+
     def reader() -> None:
         try:
             for line in stdin:
                 line = line.strip()
                 if not line:
+                    continue
+                if '"op"' in line and mutate_line(line):
                     continue
                 qid = None
                 try:

@@ -117,6 +117,18 @@ class EngineSpec:
     predict: bool = False
     #: explicit (rows, cols) for the dist2d engine; () = most-square.
     mesh_shape: tuple = ()
+    #: ISSUE 19 dynamic graphs: the served graph's version, bumped on
+    #: every applied mutation batch. A KEY field — post-flip queries
+    #: must never alias a pre-flip residency by key — but NOT a compiled
+    #: axis: the registry REKEYS the resident engine across a flip
+    #: (:meth:`EngineRegistry.rekey_generation`) instead of rebuilding,
+    #: because only the overlay table VALUES change; utils/aot.program_key
+    #: omits it for the same reason.
+    graph_generation: int = 0
+    #: ISSUE 19 delta-overlay capacity ``(rows, kcap)``; () = static
+    #: graph. A key AND compiled axis: the overlay engine's core carries
+    #: the delta fold over fixed-shape tables sized by this.
+    overlay: tuple = ()
     #: ISSUE 12 level-checkpointed resume cadence K (dist2d only; 0 =
     #: off): the serving loop runs K levels per chunk and snapshots its
     #: carry at each boundary (tpu_bfs/resilience/resume), so a
@@ -133,6 +145,9 @@ class EngineSpec:
         # from argparse/env parsing; freeze them.
         object.__setattr__(self, "delta_bits", tuple(self.delta_bits))
         object.__setattr__(self, "mesh_shape", tuple(self.mesh_shape))
+        object.__setattr__(
+            self, "overlay", tuple(int(x) for x in self.overlay)
+        )
 
     def validate(self) -> None:
         if self.engine not in ENGINE_KINDS:
@@ -231,6 +246,35 @@ class EngineSpec:
                 "with no per-query carry to snapshot — a mesh fault there "
                 "re-traverses the batch on the degraded mesh instead"
             )
+        if self.graph_generation < 0:
+            raise ValueError(
+                f"graph_generation must be >= 0, got {self.graph_generation}"
+            )
+        if self.overlay:
+            if len(self.overlay) != 2 or min(self.overlay) < 1:
+                raise ValueError(
+                    f"overlay must be (rows, kcap) with both >= 1, got "
+                    f"{self.overlay}"
+                )
+            if self.engine != "wide" or self.devices > 1:
+                raise ValueError(
+                    "the delta overlay rides the single-chip wide "
+                    "substrate (ISSUE 19); the mesh generalization "
+                    "follows the partitioned tiles"
+                )
+            if self.pull_gate:
+                raise ValueError(
+                    "overlay does not compose with pull_gate (the gate "
+                    "skips settled BASE rows; overlay edges would escape "
+                    "it untraversed)"
+                )
+            if self.kind == "p2p":
+                raise ValueError(
+                    "kind 'p2p' is excluded from dynamic serving: its "
+                    "path reconstruction scans the BUILD-TIME edge "
+                    "tables, so a post-mutation path could silently "
+                    "traverse removed edges"
+                )
         if self.kind != "bfs":
             from tpu_bfs.workloads import KIND_ENGINES, KINDS
 
@@ -466,6 +510,7 @@ class EngineRegistry:
             eng = WidePackedMsBfsEngine(
                 g, lanes=spec.lanes, num_planes=spec.planes,
                 pull_gate=spec.pull_gate, expand_impl=spec.expand_impl,
+                overlay=spec.overlay,
             )
         if spec.kind != "bfs":
             # Workload adapter over the base engine (ISSUE 14): khop/cc/
@@ -499,6 +544,44 @@ class EngineRegistry:
             if warm is not None:
                 warm()
         self._log(f"engine warmed {spec} in {time.perf_counter() - t0:.1f}s")
+
+    def rekey_generation(self, graph_key: str, generation: int) -> int:
+        """Move every resident engine of ``graph_key`` onto the new
+        ``graph_generation`` key WITHOUT a rebuild (ISSUE 19): a
+        mutation flip swaps overlay table values under the same compiled
+        program, so the residency survives — only its registry identity
+        moves, atomically under the lock, preserving LRU order.
+        In-flight batches keep their pinned engine reference; the next
+        ``get`` under the new-generation spec hits the moved residency
+        instead of paying a build. Returns how many residencies moved."""
+        moved = 0
+        with self._lock:
+            items = list(self._engines.items())
+            self._engines.clear()
+            for spec, eng in items:
+                if (spec.graph_key == graph_key
+                        and spec.graph_generation != generation):
+                    spec = dataclasses.replace(
+                        spec, graph_generation=generation
+                    )
+                    moved += 1
+                self._engines[spec] = eng
+            return moved
+
+    def drop_graph_engines(self, graph_key: str) -> int:
+        """Evict every resident engine of ``graph_key`` (the compaction
+        path: a NEW base generation's tables invalidate every compiled
+        residency — unlike a flip, the ELL itself changed). Returns the
+        eviction count."""
+        dropped = 0
+        with self._lock:
+            for spec in [s for s in self._engines
+                         if s.graph_key == graph_key]:
+                self._engines.pop(spec)
+                self.evictions += 1
+                dropped += 1
+                self._log(f"evicted engine {spec} (compaction)")
+            return dropped
 
     def evict(self, spec: EngineSpec) -> bool:
         """Drop ``spec``'s engine (if resident) so its device tables can

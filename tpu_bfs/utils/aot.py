@@ -169,6 +169,14 @@ def program_key(spec) -> dict:
         # different program than the fori tier. Non-default only, so
         # xla-tier stores keep their PR 9 digests.
         key["expand_impl"] = str(spec["expand_impl"])
+    if spec.get("overlay"):
+        # The dynamic-graph axis (ISSUE 19): an overlay engine's core
+        # carries the delta fold over (rows, kcap) tables — a different
+        # program per capacity, never aliasing the static core. The
+        # GENERATION deliberately stays out: flips swap table values
+        # under one compiled program, so every generation adopts the
+        # same artifact.
+        key["overlay"] = [int(x) for x in spec["overlay"]]
     return key
 
 

@@ -156,6 +156,7 @@ def build_workload_engine(kind: str, base, graph, spec):
         return SsspEngine(
             graph, lanes=spec.lanes,
             expand_impl=getattr(spec, "expand_impl", "xla"),
+            overlay=getattr(spec, "overlay", ()),
         )
     if kind == "khop":
         from tpu_bfs.workloads.khop import KhopServeEngine

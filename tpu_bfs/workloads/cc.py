@@ -135,6 +135,16 @@ class CcServeEngine:
                 self._index = CcIndex(labels, n, sweeps)
             return self._index
 
+    def set_overlay(self, tables) -> None:
+        """Dynamic-graph flip (ISSUE 19): swap the overlay on the base
+        sweep engine AND drop the cached component index — the labels
+        were computed over the pre-mutation edge set, and an edge can
+        merge or (via removal) split components. The next cc query pays
+        the re-label sweeps over the folded graph."""
+        self.base.set_overlay(tables)
+        with self._lock:
+            self._index = None
+
     def dispatch(self, sources, **_ignored) -> np.ndarray:
         return np.asarray(sources, dtype=np.int64)
 

@@ -28,6 +28,12 @@ class KhopServeEngine:
         self.lanes = base.lanes
         self.num_vertices = base.num_vertices
 
+    def set_overlay(self, tables) -> None:
+        """Dynamic-graph flip (ISSUE 19): pure delegation — the adapter
+        caches nothing derived from the edge set (counts come off the
+        base engine's per-run lane summaries)."""
+        self.base.set_overlay(tables)
+
     def dispatch(self, sources, *, k: int = 1, **_ignored):
         k = int(k)
         if k < 0:

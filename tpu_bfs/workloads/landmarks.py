@@ -81,10 +81,23 @@ class LandmarkIndex:
         self._exact = 0  # guarded-by: _lock
         self._bounded = 0  # guarded-by: _lock
         self._fallback = 0  # guarded-by: _lock
+        self._invalidations = 0  # guarded-by: _lock
 
     @property
     def warmed(self) -> bool:
         return self._columns is not None
+
+    def invalidate(self) -> None:
+        """Dynamic-graph flip (ISSUE 19): drop the distance columns —
+        they were computed over the pre-mutation edge set, and a single
+        added edge can tighten d(l, v) everywhere, so every triangle
+        bound (including "exact" ones) is suspect. The tier answers
+        nothing until the owner re-warms it over the folded graph; the
+        fix for the frozen-at-warm-up staleness hole this tier shipped
+        with."""
+        with self._lock:
+            self._columns = None
+            self._invalidations += 1
 
     # --- warm-up ----------------------------------------------------------
 
@@ -187,6 +200,7 @@ class LandmarkIndex:
                 "exact": self._exact,
                 "bounded": self._bounded,
                 "fallback": self._fallback,
+                "invalidations": self._invalidations,
             }
 
     def config_summary(self) -> dict:

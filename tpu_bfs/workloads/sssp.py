@@ -205,10 +205,12 @@ class SsspEngine:
 
     def __init__(self, graph: Graph, *, lanes: int = 32, kcap: int = 64,
                  delta: int = 0, max_rounds: int = 4096,
-                 expand_impl: str = "xla", interpret: bool | None = None):
+                 expand_impl: str = "xla", interpret: bool | None = None,
+                 overlay: tuple = ()):
         from tpu_bfs.algorithms._packed_common import validate_expand_impl
 
         validate_expand_impl(expand_impl)
+        self.overlay = tuple(int(x) for x in overlay) if overlay else ()
         self.expand_impl = expand_impl
         if interpret is None:
             interpret = jax.default_backend() != "tpu"
@@ -239,6 +241,14 @@ class SsspEngine:
         # distances themselves are only bounded by the graph.
         spec = _Spec(self.ell)
         self.arrs = self._build_arrays()
+        if self.overlay:
+            # Arm the fold's pytree keys with all-pad tables at build
+            # so a later mutation swaps values without a retrace.
+            from tpu_bfs.graph.dynamic import empty_overlay_tables
+
+            self.set_overlay(empty_overlay_tables(
+                self.overlay, self._act, weighted=True
+            ))
         if expand_impl == "pallas":
             from tpu_bfs.algorithms._packed_common import make_pallas_expand
             from tpu_bfs.ops.ell_expand import validate_kernel_width
@@ -263,6 +273,21 @@ class SsspEngine:
         else:
             expand_light = _make_min_plus_expand(spec, self.lanes, "wl")
             expand_full = _make_min_plus_expand(spec, self.lanes, "w")
+        if self.overlay:
+            # Dynamic-graph delta overlay (ISSUE 19): fold the mutation
+            # tables' min-plus contributions over both expansion halves
+            # — the light sweep reads the delta-thresholded ov_wl plane
+            # (derived in set_overlay from ov_w and THIS engine's
+            # delta), the heavy close the full ov_w plane, mirroring the
+            # base tables' wl/w split exactly.
+            from tpu_bfs.graph.dynamic import make_overlay_fold
+
+            expand_light = make_overlay_fold(
+                expand_light, op="minplus", weights_key="ov_wl"
+            )
+            expand_full = make_overlay_fold(
+                expand_full, op="minplus", weights_key="ov_w"
+            )
         self._core = _make_delta_core(
             expand_light, expand_full, jnp.int32(self.delta)
         )
@@ -317,6 +342,34 @@ class SsspEngine:
                 f"light{i}", np.ascontiguousarray(w.T).astype(np.int32)
             )
         return arrs
+
+    def set_overlay(self, tables) -> None:
+        """Swap the delta-overlay tables under the compiled core
+        (ISSUE 19). The light plane ``ov_wl`` is derived HERE from
+        ``ov_w`` and this engine's ``delta`` — the bucket width is a
+        per-engine tuning knob the graph layer cannot know — with pad
+        slots (weight 0) passing the threshold and gathering the all-INF
+        sentinel row, which absorbs under min. One atomic dict rebind;
+        shapes must match the armed capacity (fixed compiled pytree)."""
+        if not self.overlay:
+            raise ValueError(
+                "engine built without an overlay — pass overlay=(rows, "
+                "kcap) at construction to serve a dynamic graph"
+            )
+        rows, kcap = self.overlay
+        new = {}
+        for name in ("ov_rows", "ov_idx", "ov_override", "ov_w"):
+            arr = np.asarray(tables[name], np.int32)
+            want = (rows, kcap) if name in ("ov_idx", "ov_w") else (rows,)
+            if arr.shape != want:
+                raise ValueError(
+                    f"{name} shape {arr.shape} != armed capacity {want}"
+                )
+            new[name] = arr
+        wl = np.where(new["ov_w"] <= self.delta, new["ov_w"], INF_W)
+        dev = {k: jnp.asarray(v) for k, v in new.items()}
+        dev["ov_wl"] = jnp.asarray(wl.astype(np.int32))
+        self.arrs = {**self.arrs, **dev}
 
     def _iso_of(self, sources: np.ndarray):
         return self._rank[sources] >= self._act

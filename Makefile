@@ -1,5 +1,5 @@
 # Convenience targets; everything also works without make (README).
-.PHONY: test native bench analyze wirecheck serve-smoke serve-dist-smoke workloads-smoke chaos-smoke mesh-chaos-smoke integrity-smoke cache-smoke obs-smoke preheat-smoke mutation-smoke wheel clean
+.PHONY: test native bench analyze wirecheck serve-smoke serve-dist-smoke workloads-smoke workloads-dist-smoke chaos-smoke mesh-chaos-smoke integrity-smoke cache-smoke obs-smoke preheat-smoke mutation-smoke wheel clean
 
 # Full suite on 8 virtual CPU devices (tests/conftest.py forces the
 # platform; the axon TPU plugin is bypassed).
@@ -121,6 +121,32 @@ workloads-smoke: wirecheck
 	assert rs[4]['status'] == 'ok' and rs[4]['target'] == 5 and (rs[4]['path'] is None or rs[4]['path'][0] == 0), rs[4]; \
 	assert rs[5]['status'] == 'error' and 'unknown kind' in rs[5]['error'], rs[5]; \
 	print('workloads-smoke OK:', sorted(rs))"
+
+# The mesh workload-kind smoke (README "Workload kinds"; ISSUE 20): the
+# same 4-kind JSONL round trip served over the FULL 8-virtual-device
+# CPU mesh with the (min,+)-capable sparse exchange — sssp rides the
+# sharded min-plus delta-stepping tiles, cc the distributed min-label
+# fold, khop/p2p the dist cores' dispatch protocol — plus an
+# unknown-kind request whose structured error names WHY. Runs after
+# analyze/wirecheck: the min-plus exchange byte model must be
+# HLO-proven before the mesh serves values.
+workloads-dist-smoke: wirecheck
+	printf '{"id":1,"source":0,"kind":"sssp"}\n{"id":2,"source":0,"kind":"cc"}\n{"id":3,"source":0,"kind":"khop","k":2}\n{"id":4,"source":0,"kind":"p2p","target":5}\n{"id":5,"source":0,"kind":"nope"}\n' | \
+	env JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	python -m tpu_bfs.serve random:n=96,m=480,seed=3,weights=5 \
+	  --lanes 32 --devices 8 --exchange sparse --sparse-delta 8,16 \
+	  --ladder off --linger-ms 1 --statsz-every 0 | \
+	python -c "import sys, json; \
+	from tpu_bfs.serve.frontend import decode_distances; \
+	rs = {r['id']: r for l in sys.stdin if l.strip() for r in [json.loads(l)]}; \
+	assert len(rs) == 5, sorted(rs); \
+	assert rs[1]['status'] == 'ok' and rs[1]['kind'] == 'sssp', rs[1]; \
+	assert int(decode_distances(rs[1]['distances_npy'])[0]) == 0, rs[1]; \
+	assert rs[2]['status'] == 'ok' and rs[2]['components'] >= 1 and rs[2]['component_size'] == rs[2]['reached'], rs[2]; \
+	assert rs[3]['status'] == 'ok' and rs[3]['k'] == 2 and 'distances_npy' not in rs[3], rs[3]; \
+	assert rs[4]['status'] == 'ok' and rs[4]['target'] == 5 and (rs[4]['path'] is None or rs[4]['path'][0] == 0), rs[4]; \
+	assert rs[5]['status'] == 'error' and 'unknown kind' in rs[5]['error'], rs[5]; \
+	print('workloads-dist-smoke OK:', sorted(rs))"
 
 # The seeded chaos soak (README "Failure model"): a JSONL server under a
 # deterministic fault schedule (transient + OOM degrade + slow extract)

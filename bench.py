@@ -47,7 +47,13 @@ serve_traversal_p50_ms / TPU_BFS_BENCH_MUTATIONS (0 — dynamic graphs,
 ISSUE 19: N streaming edge-update flips applied under a closed loop;
 TPU_BFS_BENCH_MUTATIONS_OVERLAY 'DxK' sizes the overlay, default
 256x32), emitting serve_flip_p50_ms / serve_overlay_occupancy /
-serve_mutation_dropped, plus the PR 5/7 wire knobs; mesh runs add serve_gteps_p50 /
+serve_mutation_dropped / TPU_BFS_BENCH_DIST_KINDS (ISSUE 20: every
+workload kind over the full mesh — a second wide service with the
+(min,+)-capable sparse exchange; per-kind p50 / gteps_hmean /
+wire_bytes_per_query plus the modeled labelled wire_bytes_per_level
+table land under 'dist_kinds'; knobs TPU_BFS_BENCH_DIST_KINDS_LANES
+(32) / TPU_BFS_BENCH_DIST_KINDS_QUERIES (6 per kind)), plus the
+PR 5/7 wire knobs; mesh runs add serve_gteps_p50 /
 serve_gteps_hmean / serve_wire_bytes_per_query plus the mesh-fault
 record serve_mesh_faults/serve_mesh_degrades/serve_query_resumes/
 serve_devices_final to the verdict, and
@@ -1630,11 +1636,12 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
     # Mixed-kind workload stage (ISSUE 14): TPU_BFS_BENCH_SERVE_KINDS
     # ('all' / '1', or an explicit 'bfs,sssp,cc,khop,p2p' list) drives a
     # second closed loop of interleaved query kinds through a
-    # single-chip wide service with the kind axis enabled (the workload
-    # adapters are single-chip in this release). The graph gains the
-    # deterministic weight plane in-place (same topology, weights are a
-    # pure hash of the endpoints) so sssp is servable; per-kind
-    # p50/p99/counts land under the 'serve_kinds' verdict key.
+    # single-chip wide service with the kind axis enabled (the mesh
+    # forms have their own stage: TPU_BFS_BENCH_DIST_KINDS below). The
+    # graph gains the deterministic weight plane in-place (same
+    # topology, weights are a pure hash of the endpoints) so sssp is
+    # servable; per-kind p50/p99/counts land under the 'serve_kinds'
+    # verdict key.
     kinds_keys: dict = {}
     kinds_raw = os.environ.get("TPU_BFS_BENCH_SERVE_KINDS", "").strip()
     if kinds_raw:
@@ -1731,6 +1738,135 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
             ) + f" qps={kinds_keys['serve_kinds_qps']}")
         finally:
             ksvc.close()
+
+    # Distributed-kind stage (ISSUE 20): TPU_BFS_BENCH_DIST_KINDS
+    # ('all' / '1', or an explicit kind list) serves every workload kind
+    # over the FULL mesh — a second wide service with devices > 1 and
+    # the (min, +)-capable sparse exchange, so sssp rides the sharded
+    # delta-stepping tiles, cc the dist min-label fold, khop/p2p the
+    # dist cores' protocol. Per-kind keys land under 'dist_kinds':
+    # latency p50, harmonic-mean GTEPS (from the batch device-time
+    # share), measured wire bytes per query, and the MODELED
+    # wire_bytes_per_level table of the serving engine's exchange
+    # branches (labelled) — the figures BENCHMARKS.md "Exchange bytes"
+    # quotes per kind.
+    dkinds_keys: dict = {}
+    dkinds_raw = os.environ.get("TPU_BFS_BENCH_DIST_KINDS", "").strip()
+    if dkinds_raw:
+        import dataclasses as _dc
+
+        import jax as _jax
+
+        from tpu_bfs.graph.generate import edge_weights
+        from tpu_bfs.workloads import supported_kinds
+
+        dkn = devices if devices > 1 else len(_jax.devices())
+        if dkn < 2:
+            raise RuntimeError(
+                "TPU_BFS_BENCH_DIST_KINDS needs a mesh: attach devices "
+                "or set XLA_FLAGS=--xla_force_host_platform_device_count=8"
+            )
+        gk = g
+        if gk.weights is None:
+            src, dst = gk.coo
+            gk = _dc.replace(
+                gk, weights=edge_weights(src, dst, seed=1, wmax=8)
+            )
+        avail = supported_kinds("wide", dkn, gk)
+        dk_kinds = (
+            avail if dkinds_raw.lower() in ("1", "all")
+            else tuple(dkinds_raw.replace(",", " ").split())
+        )
+        bad_kinds = [k for k in dk_kinds if k not in avail]
+        if bad_kinds:
+            raise RuntimeError(
+                f"TPU_BFS_BENCH_DIST_KINDS names unservable kinds "
+                f"{bad_kinds} (servable on the {dkn}-device mesh: {avail})"
+            )
+        dk_lanes = int(os.environ.get("TPU_BFS_BENCH_DIST_KINDS_LANES",
+                                      "32"))
+        dk_q = max(2, int(os.environ.get("TPU_BFS_BENCH_DIST_KINDS_QUERIES",
+                                         "6")))
+        dsvc = retry_transient(
+            BfsService, gk, label="dist kinds engine build",
+            engine="wide", lanes=dk_lanes, devices=dkn,
+            exchange="sparse", delta_bits=(8, 16),
+            width_ladder="off", pipeline=pipeline, linger_ms=2.0,
+            kinds=dk_kinds, log=log,
+        )
+        try:
+            dq = rng.choice(candidates, size=len(dk_kinds) * dk_q,
+                            replace=len(dk_kinds) * dk_q > len(candidates))
+            dtgt = rng.choice(candidates, size=len(dk_kinds) * dk_q)
+            per_kind_res: dict = {k: [] for k in dk_kinds}
+            t0 = time.perf_counter()
+            for j, s in enumerate(dq):
+                kind = dk_kinds[j % len(dk_kinds)]
+                r = dsvc.query(
+                    int(s), kind=kind,
+                    k=3 if kind == "khop" else None,
+                    target=int(dtgt[j]) if kind == "p2p" else None,
+                    timeout=600.0,
+                )
+                if not r.ok:
+                    raise RuntimeError(
+                        f"dist-kind {kind} query failed: {r.status}: "
+                        f"{r.error}"
+                    )
+                per_kind_res[kind].append(r)
+            dk_elapsed = time.perf_counter() - t0
+            # The modeled per-branch wire table of each kind's serving
+            # engine: sssp's mesh form IS the dist engine; cc/khop/p2p
+            # adapters delegate to their base substrate
+            # (ExchangeRecordDelegate).
+            wire_models: dict = {}
+            for spec, eng in dsvc._registry.resident_engines():
+                fn = getattr(eng, "wire_bytes_per_level", None)
+                per = fn() if fn is not None else None
+                if per is None:
+                    continue
+                labs = getattr(eng, "exchange_branch_labels",
+                               lambda: None)()
+                wire_models[spec.kind] = {
+                    "wire_bytes_per_level": [
+                        round(float(x), 1) for x in per
+                    ],
+                    **({"exchange_branches": list(labs)}
+                       if labs else {}),
+                }
+            per_kind: dict = {}
+            for kind, rs in sorted(per_kind_res.items()):
+                lat = [r.latency_ms for r in rs]
+                gvals = [r.gteps for r in rs if r.gteps]
+                wires = [r.wire_bytes for r in rs
+                         if r.wire_bytes is not None]
+                row = {
+                    "count": len(rs),
+                    "p50_ms": round(float(np.percentile(lat, 50)), 2),
+                }
+                if gvals:
+                    # 6 significant digits — CPU-mesh figures are ~1e-5
+                    # GTEPS and round(x, 4) would flatten them to 0.
+                    row["gteps_hmean"] = float(
+                        f"{len(gvals) / sum(1.0 / t for t in gvals):.6g}")
+                if wires:
+                    row["wire_bytes_per_query"] = round(
+                        sum(wires) / len(wires), 1)
+                row.update(wire_models.get(kind, {}))
+                per_kind[kind] = row
+            dkinds_keys = {
+                "dist_kinds": per_kind,
+                "dist_kinds_devices": dkn,
+                "dist_kinds_qps": round(len(dq) / dk_elapsed, 2),
+            }
+            log(f"dist-kind stage ({dkn} devices): " + " ".join(
+                f"{k}:p50={v['p50_ms']}ms"
+                + (f"/gteps={v['gteps_hmean']}" if "gteps_hmean" in v
+                   else "")
+                for k, v in per_kind.items()
+            ) + f" qps={dkinds_keys['dist_kinds_qps']}")
+        finally:
+            dsvc.close()
 
     # Zipfian answer-tier stage (ISSUE 18): with the cache and/or the
     # landmark index armed, drive a second closed loop whose sources
@@ -2121,6 +2257,7 @@ def bench_serve(g, scale: int, ef: int, graph_desc: str | None = None) -> dict:
         "serve_hbm_ladder_monotone": hbm_monotone,
         **dist_keys,
         **kinds_keys,
+        **dkinds_keys,
         **cache_keys,
         **mut_keys,
         **aot_keys,

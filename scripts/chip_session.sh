@@ -184,6 +184,16 @@ for i in $(seq 1 "$attempts"); do
     stage "workloads-s20" "$out/workloads_s20.json" \
       TPU_BFS_BENCH_MODE=serve TPU_BFS_BENCH_SCALE=20 \
       TPU_BFS_BENCH_SERVE_KINDS=all
+    # Distributed-kind arm (ISSUE 20): every workload kind over the
+    # FULL attached mesh — sssp on the sharded min-plus delta-stepping
+    # tiles, cc on the dist min-label fold, khop/p2p on the dist cores'
+    # protocol, all through the sparse value exchange. Per-kind p50 /
+    # gteps_hmean / wire_bytes_per_query plus the modeled labelled
+    # wire_bytes_per_level table land under dist_kinds (BENCHMARKS.md
+    # "Exchange bytes").
+    stage "workloads-dist-s20" "$out/workloads_dist_s20.json" \
+      TPU_BFS_BENCH_MODE=serve TPU_BFS_BENCH_SCALE=20 \
+      TPU_BFS_BENCH_DIST_KINDS=all
     # Chaos arm (robustness): the same closed-loop serve stage under a
     # seeded fault schedule (tpu_bfs/faults.py) — injected transients and
     # slowed extraction ON CHIP must not change a single answer (the

@@ -100,6 +100,13 @@ def random_disconnected():
 
 
 @pytest.fixture(scope="session")
+def random_weighted():
+    # The wirecheck calibration shape with the deterministic weight plane
+    # (the distributed delta-stepping audits' substrate).
+    return random_graph(96, 480, seed=3, weights=5)
+
+
+@pytest.fixture(scope="session")
 def rmat_small():
     return rmat_graph(10, 8, seed=3)
 

@@ -244,6 +244,35 @@ def test_adopted_retrace_sentinel(exported_wide, graph):
     assert check_adopted_retrace("adopted", eng, drive) == []
 
 
+def test_program_key_kind_and_exchange_axes_cannot_alias(tmp_path):
+    """ISSUE 20 store-compat pin: the workload-kind axis composes with
+    the mesh-exchange axes instead of aliasing them — a dist-sssp core
+    keys (and files) apart from the dist-bfs core of the SAME mesh and
+    exchange config, so an artifact exported under one kind can never
+    adopt into the other kind's slot; kind-less dist specs keep their
+    ISSUE 11-era keys, so every existing store stays adoptable."""
+    dist = dict(SPEC, devices=8, exchange="sparse", delta_bits=(8, 16),
+                predict=True)
+    k_bfs = aot.program_key(dist)
+    k_sssp = aot.program_key(dict(dist, kind="sssp"))
+    assert "kind" not in k_bfs and k_sssp["kind"] == "sssp"
+    # Every exchange axis rides both keys identically; ONLY the kind
+    # separates them — and that alone must separate the digests (the
+    # on-disk artifact filenames).
+    assert {a: v for a, v in k_sssp.items() if a != "kind"} == k_bfs
+    assert aot._key_digest(k_sssp) != aot._key_digest(k_bfs)
+    # Store-level: the dist-bfs slot never serves the dist-sssp probe.
+    store = aot.ArtifactStore(tmp_path / "store")
+    store.put(dist, "core", b"or-core-bytes")
+    assert store.probe(dist)
+    assert not store.probe(dict(dist, kind="sssp"))
+    assert store.get(dict(dist, kind="sssp"), "core") is None
+    # The default kind spells the kind-less key: existing artifacts
+    # keyed before the kind axis existed keep adopting.
+    assert aot.program_key(dict(dist, kind="bfs")) == k_bfs
+    assert store.get(dist, "core") == b"or-core-bytes"
+
+
 def test_program_key_expand_impl_axis():
     """ISSUE 16 store-compat contract: ``expand_impl`` joins the program
     key ONLY when non-default — every PR 9-era artifact (keyed without

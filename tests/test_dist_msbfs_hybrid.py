@@ -135,6 +135,10 @@ def test_dist_hybrid_disconnected_and_cap(random_disconnected, line_graph):
         deep.run(np.array([0]))
 
 
+# Slow lane: the sparse gather's byte model is HLO-proven by wirecheck
+# in tier-1 and the wide engine pins the same sparse-vs-dense agreement
+# (test_dist_msbfs_wide); this hybrid-engine sweep is the heavier twin.
+@pytest.mark.slow
 def test_sparse_frontier_gather_matches_dense(rmat_small):
     # Queue-style (rank0 row id + lane words) gather vs the dense slab:
     # identical distances, counters cover every level, fewer modeled bytes.
@@ -158,6 +162,9 @@ def test_sparse_frontier_gather_matches_dense(rmat_small):
     )
 
 
+# Slow lane: w=256 over two exchanges is ~14s; the width machinery is
+# width-agnostic by construction and w<=128 stays covered in tier-1.
+@pytest.mark.slow
 def test_dist_hybrid_w256_lanes_past_4096(random_small):
     # Width generalization on the sharded engine: w=256 (8192 lanes)
     # through dense tiles + residual + the ring exchange on a 4-device

@@ -14,6 +14,7 @@ from tpu_bfs.utils.wirecheck import (
     check_1d_sparse,
     check_2d,
     check_2d_sparse,
+    check_minplus_exchange,
     check_packed_exchange,
     check_planned_sparse,
     check_rows_delta,
@@ -176,6 +177,24 @@ def test_planned_sparse_packed_model_matches_hlo(random_small):
     # stays a CI prerequisite of the smoke targets.
     rep = check_planned_sparse(random_small, p=8, wire_pack=True)
     assert rep["agree"], rep
+
+
+def test_minplus_exchange_model_matches_hlo(random_weighted):
+    """ISSUE 20 acceptance: the (min, +) value exchange's byte model is
+    HLO-proven — per rung one shared s32 value all-gather plus one id
+    all-gather per encoding, one s32[2] pmax pair per measured round, the
+    predictor's dense branch measurement-free — and generalizing the
+    monoid adds no collective: all-gather counts equal the OR row-gather
+    counterpart rung for rung (the armed predictor adds exactly the one
+    dense table rebuild)."""
+    rep = check_minplus_exchange(random_weighted, p=8, lanes=32)
+    assert rep["agree"], rep
+    assert rep["pair_pmaxes"] == 1, rep
+    # 2 caps x (delta8/delta16/plain) + dense + predicted-dense.
+    assert len(rep["modeled_per_level"]) == 8, rep
+    ags = rep["all_gathers"]
+    assert ags["minplus_measured"] == ags["or_rows"], rep
+    assert ags["minplus_planner"] == ags["minplus_measured"] + 1, rep
 
 
 @pytest.mark.slow

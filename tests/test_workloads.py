@@ -361,8 +361,13 @@ def test_registry_kind_axis_and_aot_key():
     with pytest.raises(ValueError, match="runs on engines"):
         EngineSpec(graph_key="g", kind="sssp", engine="hybrid",
                    lanes=4096).validate()
-    with pytest.raises(ValueError, match="single-chip"):
-        EngineSpec(graph_key="g", kind="cc", devices=4).validate()
+    # ISSUE 20: kinds serve on the mesh now — the old single-chip
+    # rejection is gone; what stays rejected is the OR-only wire format
+    # on the value-carrying exchange (min words don't bit-pack).
+    EngineSpec(graph_key="g", kind="cc", devices=4).validate()
+    with pytest.raises(ValueError, match="wire_pack"):
+        EngineSpec(graph_key="g", kind="sssp", devices=8,
+                   exchange="sparse", wire_pack=True).validate()
     with pytest.raises(ValueError, match="pull_gate"):
         EngineSpec(graph_key="g", kind="p2p", pull_gate=True).validate()
     with pytest.raises(ValueError, match="kind must be"):

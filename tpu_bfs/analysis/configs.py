@@ -179,6 +179,33 @@ def _build_engine(config: str):
             "serve-dynamic-sssp": dict(
                 kind="sssp", engine="wide", lanes=32, overlay=(64, 32),
             ),
+            # Semiring exchanges (ISSUE 20): every workload kind on the
+            # full mesh. The dist-sssp configs analyze the sharded
+            # delta-stepping core's min-exchange branch space (planner
+            # variant 1D, hierarchical pmin 2D); the cc/khop/p2p rows
+            # ride the distributed wide/2D substrates, so their adapters'
+            # programs are the dist cores plus the replicated reductions.
+            "serve-dist-sssp": dict(
+                kind="sssp", engine="wide", lanes=32, devices=8,
+                exchange="sparse", delta_bits=(8, 16), predict=True,
+            ),
+            "serve-dist-sssp-2d": dict(
+                kind="sssp", engine="wide", lanes=32, devices=8,
+                mesh_shape=(2, 4),
+            ),
+            "serve-dist-cc": dict(
+                kind="cc", engine="wide", lanes=64, devices=8,
+                exchange="sparse",
+            ),
+            "serve-dist-khop": dict(
+                kind="khop", engine="dist2d", lanes=32, devices=8,
+                exchange="sparse", delta_bits=(8, 16), sieve=True,
+                predict=True,
+            ),
+            "serve-dist-p2p": dict(
+                kind="p2p", engine="wide", lanes=64, devices=8,
+                exchange="sparse", delta_bits=(8, 16),
+            ),
         }.get(config)
         if kw is None:
             raise KeyError(config)
@@ -201,6 +228,8 @@ ALL_CONFIGS = (
     "hybrid-dense", "hybrid-sparse", "hybrid-sliced",
     "serve-dist-wide", "serve-dist-hybrid", "serve-dist2d",
     "serve-sssp", "serve-khop", "serve-cc", "serve-p2p",
+    "serve-dist-sssp", "serve-dist-sssp-2d",
+    "serve-dist-cc", "serve-dist-khop", "serve-dist-p2p",
     "serve-landmark-warm",
     "serve-wide-pallas", "serve-sssp-pallas",
     "serve-dynamic", "serve-dynamic-pallas", "serve-dynamic-sssp",

@@ -586,3 +586,103 @@ def build_ell(g: Graph, *, kcap: int = 64) -> EllGraph:
         fold_steps=fold_steps,
         light=light,
     )
+
+
+def build_ell_weights_sharded(g: Graph, sell: ShardedEllGraph, *, pad: int = 0):
+    """Per-shard per-slot weight tables aligned with ``sell``'s bucketized
+    index slabs (ISSUE 20: the sharded weights plane).
+
+    ``sell`` must be ``build_ell_sharded(g)`` over the same graph, which
+    must carry a weights plane. Replays build_ell_sharded's exact slicing
+    — same rank order, same num_shards-aligned bucket boundaries, same
+    pad_heavy_shards virtual-row layout — with the edge weights as the
+    flat payload, so slot (p, row, col) of each returned table is the
+    weight of the in-edge whose source ``sell``'s matching idx slot names.
+    Unused slots hold ``pad`` (0 by default: pad index slots gather the
+    engines' all-INF sentinel row, so their weight is inert under
+    min-plus). Returns ``(virtual_w [P, M, kcap] | None, [light_w
+    [P, n_k, k]])``, shape-pinned against ``sell``."""
+    if g.weights is None:
+        raise ValueError("graph has no weights plane (build it with weights=W)")
+    p_count = sell.num_shards
+    v_count = g.num_vertices
+    src, dst = g.coo
+    order_ds = _lexsort_pairs(dst, src, v_count)
+    in_deg = np.bincount(dst, minlength=v_count).astype(np.int64)
+    in_rp = np.zeros(v_count + 1, dtype=np.int64)
+    np.cumsum(in_deg, out=in_rp[1:])
+    rank_order = sell.old_of_new
+    v_pad = sell.v_pad
+    kcap = sell.kcap
+    lens = np.zeros(v_pad, dtype=np.int64)
+    lens[:v_count] = in_deg[rank_order]
+    starts = np.zeros(v_pad, dtype=np.int64)
+    starts[:v_count] = in_rp[rank_order]
+    new_rp = np.zeros(v_pad + 1, dtype=np.int64)
+    np.cumsum(lens, out=new_rp[1:])
+    wflat = np.asarray(g.weights)[order_ds][
+        _flat_positions(starts, lens)
+    ].astype(np.int32)
+
+    num_heavy = int(np.searchsorted(-lens, -kcap, side="left"))
+    h_bound = min(_round_up(num_heavy, p_count), v_pad)
+
+    def shard_rows(lo: int, hi: int, p: int) -> np.ndarray:
+        return np.arange(lo + p, hi, p_count, dtype=np.int64)
+
+    virtual_w = None
+    if h_bound:
+        hlens_list, flat_list = [], []
+        for p in range(p_count):
+            rows = shard_rows(0, h_bound, p)
+            hlens_list.append(lens[rows])
+            flat_list.append(
+                wflat[_flat_positions(starts_of(rows, new_rp), lens[rows])]
+            )
+        # pad_heavy_shards' exact vlens layout, weight payload instead of
+        # neighbor ids; the shared (padded) virtual-row count is sell's.
+        r_per_all = [np.maximum(-(-h // kcap), 1) for h in hlens_list]
+        v_parts = []
+        for hlens, flat, r_per in zip(hlens_list, flat_list, r_per_all):
+            vlens = np.zeros(sell.num_virtual, dtype=np.int64)
+            if len(hlens):
+                m_p = int(r_per.sum())
+                vlens[:m_p] = kcap
+                vr_last = np.cumsum(r_per) - 1
+                vlens[vr_last] = hlens - kcap * (r_per - 1)
+            v_parts.append(_ell_fill(vlens, flat, kcap, pad))
+        virtual_w = np.stack(v_parts)
+
+    light_w: list[np.ndarray] = []
+    prev = h_bound
+    k = kcap
+    while prev < v_pad and k >= 1:
+        lo_deg = k // 2
+        hi = int(np.searchsorted(-lens, -(lo_deg + 1), side="right"))
+        hi = min(max(_round_up(hi, p_count), prev), v_pad)
+        if k == 1:
+            nz = int(np.searchsorted(-lens, 0, side="left"))
+            hi = min(max(_round_up(nz, p_count), prev), v_pad)
+        if hi > prev:
+            blocks = []
+            for p in range(p_count):
+                rows = shard_rows(prev, hi, p)
+                flat = wflat[
+                    _flat_positions(starts_of(rows, new_rp), lens[rows])
+                ]
+                blocks.append(_ell_fill(lens[rows], flat, k, pad))
+            light_w.append(np.stack(blocks))
+            prev = hi
+        k //= 2
+
+    # Shape pin: the value slabs must be slot-aligned with sell's own
+    # buckets or every downstream gather-add is silently wrong.
+    if (virtual_w is None) != (sell.virtual is None) or (
+        virtual_w is not None and virtual_w.shape != sell.virtual.shape
+    ):
+        raise AssertionError("weight plane misaligned with sharded heavy bucket")
+    if len(light_w) != len(sell.light) or any(
+        w.shape != blk.shape for w, (_k, blk) in zip(light_w, sell.light)
+    ):
+        raise AssertionError("weight plane misaligned with sharded light buckets")
+    return virtual_w, light_w

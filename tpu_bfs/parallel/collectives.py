@@ -1109,6 +1109,20 @@ class RowGatherExchangeAccounting:
             self.sparse_caps, getattr(self, "delta_bits", ())
         )
 
+    def wire_bytes_per_level(self) -> list[float]:
+        """Modeled off-chip bytes per level per exchange branch, labels
+        aligned with :meth:`exchange_branch_labels` — the same API the
+        1D/2D/sssp dist engines expose, so the bench's per-kind wire
+        table prices every serving engine uniformly."""
+        if self._exchange == "sparse":
+            return sparse_rows_wire_bytes_per_level(
+                self._gather_p, self._gather_rows_loc, self.w,
+                self.sparse_caps, getattr(self, "delta_bits", ()),
+            )
+        return [dense_rows_wire_bytes(
+            self._gather_p, self._gather_rows_loc, self.w
+        )]
+
     def _core_from(self, arrs, fw, vis, planes, level0, max_levels):
         fw_f, vis_f, planes_f, level, alive, bc = self._core_from_jit(
             arrs, fw, vis, planes, level0, max_levels
@@ -1182,3 +1196,181 @@ def column_gather_wire_bytes(rows: int, w: int, *, wire_pack: bool = False) -> f
     return float((rows - 1) * 4 * packed_words(w)) if wire_pack else float(
         (rows - 1) * w
     )
+
+
+# --- the (min, +) value-exchange family (ISSUE 20) --------------------------
+#
+# The OR exchanges above move BITMAPS (a vertex is reached or not); the
+# workload kinds that carry a value per vertex — sssp distances, cc
+# min-labels — exchange int32 WORDS under elementwise min instead. Min is
+# associative-commutative with an identity (the caller's INF sentinel), so
+# every structural trick transfers verbatim: the dense paths become
+# reduce_scatter_min / pmin, the queue-style path ships (row id, value row)
+# pairs with the SAME delta id codec and cap ladder as sparse_rows_gather,
+# and the receiver folds with a drop-mode scatter-MIN — which, unlike the
+# OR gather's SET, is duplicate-safe by construction.
+
+
+def minplus_rows_branch_count(caps, delta_bits, *, predict: bool = False) -> int:
+    """Flat branch space of :func:`sparse_rows_exchange_min`: the row-gather
+    layout (per cap rung each delta width then plain ids, plus dense), with
+    one extra trailing branch when history prediction is armed — the dense
+    level that skipped the pmax entirely."""
+    return rows_gather_branch_count(caps, delta_bits) + (1 if predict else 0)
+
+
+def minplus_rows_branch_labels(
+    caps, delta_bits, *, predict: bool = False
+) -> list[str]:
+    """Labels for the min-exchange branch layout, index-aligned with
+    :func:`minplus_rows_wire_bytes_per_level` and the branch ids
+    :func:`sparse_rows_exchange_min` returns."""
+    labels = rows_gather_branch_labels(caps, delta_bits)
+    return labels + ["dense-predicted"] if predict else labels
+
+
+def dense_min_wire_bytes(p: int, rows_loc: int, lanes: int) -> float:
+    """Off-chip bytes one chip moves per round in the dense min exchange of
+    a replicated [p*rows_loc, lanes] int32 value table: the ring impl
+    reduce-scatters P-1 [rows_loc, lanes] chunks then all-gathers the
+    reduced chunks back (each chip's chunk crosses the wire P-1 times), the
+    allreduce impl pmins the whole buffer at the same bandwidth-optimal
+    2*(P-1)/P cost — 2*(p-1)*rows_loc*4*lanes either way. The per-round
+    light-sweep convergence psum (4 B scalar) is outside this model by the
+    same convention as :func:`dense_or_wire_bytes`."""
+    return 0.0 if p == 1 else float(2 * (p - 1) * rows_loc * 4 * lanes)
+
+
+def minplus_rows_wire_bytes_per_level(
+    p: int, rows_loc: int, lanes: int, caps: tuple[int, ...],
+    delta_bits: tuple[int, ...] = (), *, predict: bool = False,
+) -> list[float]:
+    """Modeled off-chip bytes per round per :func:`sparse_rows_exchange_min`
+    branch, in :func:`minplus_rows_branch_labels` order. The sparse rungs
+    are the row-gather model with the lane payload reinterpreted: a changed
+    row ships ``lanes`` int32 distance words (4*lanes bytes) instead of
+    ``w`` packed uint32 frontier words (4*w bytes) — numerically the same
+    formula, so :func:`sparse_rows_wire_bytes_per_level` is the single
+    source. The predicted-dense branch (when armed) pays the dense
+    all-gather with NO measurement scalar — skipping it is the predictor's
+    whole point."""
+    base = sparse_rows_wire_bytes_per_level(p, rows_loc, lanes, caps, delta_bits)
+    if not predict:
+        return base
+    extra = 0.0 if p == 1 else dense_rows_wire_bytes(p, rows_loc, lanes)
+    return base + [extra]
+
+
+def sparse_rows_exchange_min(
+    new_loc, own_prev, prev_full, axis_name: str, *, caps: tuple[int, ...],
+    out_rows: int, gid_of, dense_fn, ident, delta_bits: tuple[int, ...] = (),
+    gid_of_src=None, predict: bool = False, prev_biggest=None, growing=None,
+):
+    """Queue-style id+value exchange under elementwise min — the (min, +)
+    twin of :func:`sparse_rows_gather`, shared by the distributed
+    delta-stepping engines.
+
+    ``new_loc`` [rows_loc, lanes] int32 is this chip's updated owned-row
+    values, elementwise <= ``own_prev`` (its rows of the replicated
+    previous table ``prev_full`` [out_rows, lanes]); a row crosses the wire
+    iff some lane improved. When every chip's changed-row count fits a
+    ``caps`` rung (one mesh-uniform pmax — an s32[2] pair with the max id
+    gap when ``delta_bits`` is set), each chip all-gathers (global row id,
+    int32 value row) pairs and every receiver folds them into its replica
+    with one drop-mode scatter-min; otherwise ``dense_fn()`` rebuilds the
+    table densely (the callers' all-gather of every chip's owned rows —
+    on heavy rounds the slab IS the compact encoding). Ids delta-encode
+    exactly as the OR gather (LOCAL ids, :func:`delta_encode_ids`, the
+    receiver maps per sender via ``gid_of_src``); values ride alongside at
+    fixed width — min's identity ``ident`` fills invalid slots, so decoded
+    tail duplicates are harmless even before the sentinel-id drop.
+
+    ``predict=True`` arms the ISSUE 7 history predictor: when the previous
+    measured round overflowed every cap (``prev_biggest``, mesh-uniform
+    carry) AND the update set is still growing (``growing``), the round is
+    confidently dense — take ``dense_fn()`` immediately and skip the pmax.
+
+    Returns ``(table [out_rows, lanes] int32, branch int32, biggest
+    int32)`` — branch indexes :func:`minplus_rows_branch_labels`;
+    ``biggest`` is the measured pmax (stale carry on predicted rounds) for
+    the next round's predictor."""
+    rows_loc, lanes = new_loc.shape
+    ladder = normalize_caps(caps)
+    delta_bits = check_delta_bits(delta_bits)
+    if delta_bits and gid_of_src is None:
+        raise ValueError(
+            "delta-encoded sparse_rows_exchange_min needs gid_of_src(ids, "
+            "src) — the receiver decodes LOCAL ids and must map them per "
+            "sender"
+        )
+    K, W = len(ladder), len(delta_bits)
+    any_row = jnp.any(new_loc < own_prev, axis=1)  # [rows_loc]
+
+    def make_rung_ladder(dmax):
+        def make_rung(cap, ri):
+            def rung(_):
+                (ids,) = jnp.nonzero(any_row, size=cap, fill_value=rows_loc)
+                ok = ids < rows_loc
+                vals = jnp.where(
+                    ok[:, None], new_loc[jnp.where(ok, ids, 0)], ident
+                )
+                ag_vals = lax.all_gather(vals, axis_name).reshape(-1, lanes)
+
+                def plain(_):
+                    gids = jnp.where(ok, gid_of(ids), out_rows)
+                    ag_ids = lax.all_gather(gids, axis_name).reshape(-1)
+                    return ag_ids, jnp.int32(ri * (W + 1) + W)
+
+                step = plain
+                for e in range(W - 1, -1, -1):
+                    def enc(_, bits=delta_bits[e], e=e):
+                        words = delta_encode_ids(ids[None, :], rows_loc, bits)[0]
+                        ag_w = lax.all_gather(words, axis_name)  # [p, dw]
+                        dec, valid = delta_decode_ids(ag_w, cap, bits)
+                        src = jnp.arange(ag_w.shape[0], dtype=jnp.int32)[:, None]
+                        okd = valid & (dec < rows_loc)
+                        gids = jnp.where(okd, gid_of_src(dec, src), out_rows)
+                        return gids.reshape(-1), jnp.int32(ri * (W + 1) + e)
+
+                    step = partial(
+                        lax.cond, dmax <= (1 << delta_bits[e]) - 1, enc, step
+                    )
+                ag_ids, br = step(None)
+                table = prev_full.at[ag_ids].min(ag_vals, mode="drop")
+                return table, br
+
+            return rung
+
+        return make_rung
+
+    def measured(_):
+        cnt = jnp.sum(any_row.astype(jnp.int32))
+        if not delta_bits:
+            biggest, dmax = lax.pmax(cnt, axis_name), None
+        else:
+            mx = lax.pmax(
+                jnp.stack([cnt, max_id_gap(any_row[None, :])]), axis_name
+            )
+            biggest, dmax = mx[0], mx[1]
+
+        def dense_leaf(_):
+            return dense_fn(), jnp.int32(K * (W + 1))
+
+        table, br = cap_ladder_select(
+            biggest, ladder, make_rung_ladder(dmax), dense_leaf
+        )
+        return table, br, biggest
+
+    if not predict:
+        return measured(None)
+    if prev_biggest is None or growing is None:
+        raise ValueError(
+            "predictive sparse_rows_exchange_min needs the mesh-uniform "
+            "prev_biggest and growing carries"
+        )
+
+    def predicted(_):
+        return dense_fn(), jnp.int32(K * (W + 1) + 1), prev_biggest
+
+    pred = (prev_biggest > ladder[-1]) & growing
+    return lax.cond(pred, predicted, measured, None)

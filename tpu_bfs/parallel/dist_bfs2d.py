@@ -808,6 +808,14 @@ class Dist2DServeEngine:
         return self.engine.part.base.num_vertices
 
     @property
+    def max_levels_cap(self) -> int:
+        """Deepest level bound a dispatch can run (the khop adapter's
+        clamp point, ISSUE 20). The 2D loop labels int32 distances with
+        no plane cap, so the bound is the padded vertex count — the
+        trivial upper bound on any eccentricity."""
+        return int(self.engine.part.vp)
+
+    @property
     def last_run_trace(self):
         return self.engine.last_run_trace
 
@@ -972,7 +980,14 @@ class Dist2DServeEngine:
                 jnp.int32(min(level_i + k, pend.total_cap)),
             )
 
-    def fetch(self, pend: _Pending2D) -> Dist2DServeResult:
+    def fetch(self, pend: _Pending2D, *, check_cap: bool = True,
+              **_ignored) -> Dist2DServeResult:
+        # ``check_cap`` is accepted for dispatch/fetch protocol
+        # uniformity (the khop adapter passes it): the 2D loop's level
+        # bound defaults to the padded vertex count, above any
+        # eccentricity, so a capped run here is always the CALLER's
+        # explicit max_levels — stopping at it is the point, never a
+        # truncation to flag.
         from tpu_bfs import faults as _faults
 
         if _faults.ACTIVE is not None:

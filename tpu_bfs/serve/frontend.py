@@ -83,6 +83,7 @@ from tpu_bfs.utils.recovery import (
 from tpu_bfs.workloads import (
     KINDS,
     METADATA_ONLY_KINDS,
+    kind_unsupported_reason,
     supported_kinds,
 )
 
@@ -330,10 +331,12 @@ class BfsService:
         self._ladder_arg = width_ladder
         self._mesh_probe_interval_s = max(mesh_probe_interval_s, 0.0)
         self._mesh_probe = None  # guarded-by: _lock (lifecycle state)
-        # Served query kinds (ISSUE 14): None = everything this engine/
-        # mesh/graph supports (workloads.supported_kinds — sssp needs a
-        # weights plane, non-bfs kinds the single-chip wide substrate).
-        # An explicit list is validated here, at construction.
+        # Served query kinds (ISSUE 14; full-mesh serving ISSUE 20):
+        # None = everything this engine/mesh/graph supports
+        # (workloads.supported_kinds — sssp needs a weights plane, p2p
+        # an undirected graph; on a mesh the kinds ride the wide/dist2d
+        # substrates). An explicit list is validated here, at
+        # construction.
         auto_kinds = supported_kinds(engine, devices, self._graph)
         if kinds is None:
             self._kinds = auto_kinds
@@ -345,11 +348,12 @@ class BfsService:
                         f"unknown kind {kind!r} (one of {KINDS})"
                     )
                 if kind not in auto_kinds:
+                    why = kind_unsupported_reason(
+                        kind, engine, devices, self._graph
+                    )
                     raise ValueError(
-                        f"kind {kind!r} is not servable by this config "
-                        f"(engine={engine!r}, devices={devices}, "
-                        f"weighted={self._graph.weights is not None}); "
-                        f"servable: {auto_kinds}"
+                        f"kind {kind!r} is not servable by this config: "
+                        f"{why} (servable: {auto_kinds})"
                     )
             self._kinds = kinds
         if not self._kinds:
@@ -541,6 +545,29 @@ class BfsService:
               cfg: MeshServeConfig | None = None,
               kind: str = "bfs") -> EngineSpec:
         cfg = self._mesh_cfg if cfg is None else cfg
+        if kind == "sssp" and cfg.devices > 1:
+            # The service-wide exchange config speaks the base family's
+            # OR dialect; the distributed sssp engine exchanges under
+            # (min, +) (ISSUE 20). Map the spirit of the config onto the
+            # kind's own family: queue-style stays queue-style (sparse +
+            # delta_bits + predict ride along), everything dense-like
+            # becomes the engine default; wire_pack/sieve are OR-only
+            # knobs with no min twin and drop here.
+            sparse = cfg.exchange == "sparse"
+            return EngineSpec(
+                graph_key=self._graph_key,
+                graph_generation=self._graph_generation,
+                kind=kind,
+                engine=cfg.engine,
+                lanes=self.lanes if width is None else width,
+                planes=self._planes,
+                expand_impl=self._expand_impl,
+                devices=cfg.devices,
+                exchange=cfg.exchange if sparse else "",
+                delta_bits=cfg.delta_bits if sparse else (),
+                predict=cfg.predict if sparse else False,
+                mesh_shape=cfg.mesh_shape,
+            )
         return EngineSpec(
             graph_key=self._graph_key,
             graph_generation=self._graph_generation,
@@ -772,14 +799,20 @@ class BfsService:
         if kind not in KINDS:
             return f"unknown kind {kind!r} (one of {KINDS})"
         if kind not in self._kinds:
+            # Name WHY (ISSUE 20 satellite): the structural blocker when
+            # there is one (engine family, mesh, missing weights plane,
+            # directedness), else the service's own kinds= selection.
+            why = kind_unsupported_reason(
+                kind, self._mesh_cfg.engine, self._mesh_cfg.devices,
+                self._graph,
+            )
             return (
-                f"kind {kind!r} is not served by this service "
-                f"(engine={self._mesh_cfg.engine!r}, serving "
-                f"{self._kinds}" + (
-                    "; sssp needs a weighted graph"
-                    if kind == "sssp"
-                    and self._graph.weights is None else ""
-                ) + ")"
+                f"kind {kind!r} is not served by this service: "
+                + (why if why is not None else
+                   f"excluded by this service's kinds= selection "
+                   f"(engine={self._mesh_cfg.engine!r}, "
+                   f"devices={self._mesh_cfg.devices})")
+                + f"; serving {self._kinds}"
             )
         if not (0 <= q.source < self._graph.num_vertices):
             return (
@@ -2266,8 +2299,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
                     help="query kinds to serve (ISSUE 14): any of "
                     "bfs,sssp,cc,khop,p2p; default: every kind this "
                     "engine/graph supports (sssp needs a weighted "
-                    "graph; non-bfs kinds need the single-chip wide "
-                    "substrate). Requests carry {\"kind\": ...} (+ "
+                    "graph, p2p an undirected one; on a mesh the kinds "
+                    "ride the wide/dist2d substrates). Requests carry "
+                    "{\"kind\": ...} (+ "
                     "\"k\" for khop, \"target\" for p2p); unknown or "
                     "unserved kinds answer a structured per-id error")
     ap.add_argument("--no-distances", action="store_true",

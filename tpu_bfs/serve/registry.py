@@ -46,6 +46,15 @@ ENGINE_EXCHANGES = {
     "packed": ("",),
 }
 
+# Kind-specific mesh exchange families (ISSUE 20): a kind whose
+# distributed engine is NOT the engine family's own loop overrides the
+# family's exchange list. sssp on a mesh runs the (min, +) delta-stepping
+# engine (parallel/dist_sssp.py) whose value exchanges are
+# ring/allreduce/sparse — not the wide family's OR row gathers.
+KIND_EXCHANGES = {
+    "sssp": ("", "ring", "allreduce", "sparse"),
+}
+
 # Serving engines default to 8 planes (254-level depth cap) where the
 # one-shot CLI defaults to 5 (32 levels): a server answers arbitrary
 # sources on a long-lived process, and one high-eccentricity query
@@ -207,11 +216,17 @@ class EngineSpec:
                 "exchange/wire_pack/delta_bits/sieve/predict shape the "
                 "MESH exchanges; single-chip engines (devices=1) run none"
             )
-        if self.exchange not in ENGINE_EXCHANGES[self.engine]:
+        legal_exchanges = (
+            KIND_EXCHANGES.get(self.kind, ENGINE_EXCHANGES[self.engine])
+            if self.devices > 1 else ENGINE_EXCHANGES[self.engine]
+        )
+        if self.exchange not in legal_exchanges:
             raise ValueError(
                 f"exchange {self.exchange!r} is not one of "
-                f"{ENGINE_EXCHANGES[self.engine]} for engine "
-                f"{self.engine!r}"
+                f"{legal_exchanges} for engine {self.engine!r}"
+                + (f" serving kind {self.kind!r}"
+                   if self.kind in KIND_EXCHANGES and self.devices > 1
+                   else "")
             )
         if self.delta_bits and self.exchange != "sparse":
             raise ValueError(
@@ -219,21 +234,35 @@ class EngineSpec:
                 f"set exchange='sparse' (got {self.exchange!r})"
             )
         if (self.sieve or self.predict) and not (
-            self.engine == "dist2d" and self.exchange == "sparse"
+            (self.engine == "dist2d" and self.exchange == "sparse")
+            or (self.kind == "sssp" and self.devices > 1
+                and self.exchange == "sparse" and not self.sieve)
         ):
             raise ValueError(
-                "sieve/predict are the 1D/2D exchange planner's pieces; "
-                "on the serve tier they apply to engine='dist2d' with "
-                "exchange='sparse' (the MS row gathers take delta_bits "
-                "only)"
+                "sieve/predict are the exchange planner's pieces; on the "
+                "serve tier they apply to engine='dist2d' with "
+                "exchange='sparse', plus predict (alone — min carries no "
+                "sieve residue to compact) on the distributed sssp "
+                "engine's sparse exchange (the MS row gathers take "
+                "delta_bits only)"
             )
         if self.mesh_shape:
-            if self.engine != "dist2d":
+            if self.engine != "dist2d" and not (
+                self.kind == "sssp" and self.devices > 1
+            ):
                 raise ValueError(
-                    "mesh_shape picks the dist2d engine's (rows, cols); "
-                    f"engine {self.engine!r} runs a 1D mesh"
+                    "mesh_shape picks a 2D (rows, cols) partition — the "
+                    "dist2d engine's, or the distributed sssp engine's "
+                    f"(kind='sssp', devices > 1); engine {self.engine!r} "
+                    "runs a 1D mesh"
                 )
             mesh_shape_2d(self.devices, self.mesh_shape)  # raises on mismatch
+            if self.kind == "sssp" and self.exchange not in ("", "allreduce"):
+                raise ValueError(
+                    "the 2D distributed sssp engine exchanges "
+                    "hierarchically (pmin over both axes) — exchange must "
+                    f"be '' or 'allreduce', got {self.exchange!r}"
+                )
         if self.resume_levels < 0:
             raise ValueError(
                 f"resume_levels must be >= 0, got {self.resume_levels}"
@@ -287,12 +316,12 @@ class EngineSpec:
                     f"kind {self.kind!r} runs on engines "
                     f"{KIND_ENGINES[self.kind]}, not {self.engine!r}"
                 )
-            if self.devices > 1:
+            if (self.devices > 1 and self.kind == "sssp"
+                    and self.wire_pack):
                 raise ValueError(
-                    f"kind {self.kind!r} is single-chip in this release "
-                    "(the workload adapters ride the single-chip wide "
-                    "substrate; the mesh generalization follows the "
-                    "partitioned tiles)"
+                    "wire_pack packs the OR exchanges' frontier words; "
+                    "the distributed sssp engine ships int32 distance "
+                    "rows (delta_bits compresses its id stream instead)"
                 )
             if self.kind in ("p2p", "sssp") and self.pull_gate:
                 raise ValueError(

@@ -531,6 +531,147 @@ def check_rows_sparse(graph, p: int = 8, lanes: int = 64) -> dict:
     }
 
 
+def check_minplus_exchange(graph, p: int = 8, lanes: int = 32) -> dict:
+    """ISSUE 20 tentpole proof, from the compiled HLO: the (min, +) value
+    exchange (collectives.sparse_rows_exchange_min, the delta-stepping
+    engines' bucket-close collective) prices exactly like its OR row-gather
+    twin with the lane payload reinterpreted — per rung ONE [cap, lanes]
+    s32 value all-gather shared across the id encodings, one id all-gather
+    per encoding (delta_words(cap, b) u32 words delta-encoded, cap int32s
+    plain), ONE s32[2] pmax pair per measured round — and the history
+    predictor's armed branch adds EXACTLY one extra dense table all-gather
+    (the measurement-free round) and nothing else.
+
+    Three compiles are audited against minplus_rows_wire_bytes_per_level:
+
+    - the planner variant (delta_bits + predict): every branch's modeled
+      bytes re-derived from the collectives' own operand shapes, matched
+      ops CONSUMED so no op vouches twice, zero leftover all-gathers;
+    - the measured variant (predict off) vs the OR counterpart
+      (DistWideMsBfsEngine, SAME cap ladder / delta widths / lane count):
+      all-gather instruction counts must be EQUAL — generalizing the
+      monoid adds no collective;
+    - planner vs measured: all-gather count delta must be EXACTLY one
+      (the predicted-dense branch's table rebuild).
+    """
+    import jax.numpy as jnp
+
+    from tpu_bfs.parallel.collectives import (
+        DELTA_BITS_DEFAULT,
+        delta_words,
+        minplus_rows_wire_bytes_per_level,
+    )
+    from tpu_bfs.parallel.dist_bfs import make_mesh
+    from tpu_bfs.parallel.dist_msbfs_wide import DistWideMsBfsEngine
+    from tpu_bfs.parallel.dist_sssp import DistSsspEngine
+
+    delta_bits = DELTA_BITS_DEFAULT
+    mesh = make_mesh(p)
+
+    def sssp_ags(predict: bool):
+        eng = DistSsspEngine(
+            graph, mesh, lanes=lanes, exchange="sparse",
+            delta_bits=delta_bits, predict=predict,
+        )
+        progs = {nm: (fn, args) for nm, fn, args in eng.analysis_programs()}
+        fn, args = progs["dist_sssp_core"]
+        colls = hlo_collectives(fn.lower(*args).compile().as_text())
+        return eng, colls
+
+    eng, colls = sssp_ags(predict=True)
+    n = eng.sell.v_loc
+    caps = eng.sparse_caps
+    pool = [c for c in colls if c.op == "all-gather"]
+    n_ags_planner = len(pool)
+
+    def _take(pred) -> bool:
+        for idx, a in enumerate(pool):
+            if pred(a):
+                del pool[idx]
+                return True
+        return False
+
+    derived = []
+    found = []
+    for c in sorted(caps):
+        # One shared [cap, lanes] s32 value gather per rung, then one id
+        # gather per encoding (delta widths in ladder order, then plain).
+        vals_b = p * c * 4 * lanes
+        got_vals = _take(lambda a: a.result_bytes == vals_b and a.pieces == 1)
+        for b in delta_bits:
+            ids_b = p * 4 * delta_words(c, b)
+            got = _take(lambda a: a.result_bytes == ids_b and a.pieces == 1)
+            found.append(got and got_vals)
+            derived.append(
+                None if not (got and got_vals)
+                else (ids_b + vals_b) * (p - 1) / p + 8.0
+            )
+        ids_plain = p * c * 4
+        got = _take(lambda a: a.result_bytes == ids_plain and a.pieces == 1)
+        found.append(got and got_vals)
+        derived.append(
+            None if not (got and got_vals)
+            else (ids_plain + vals_b) * (p - 1) / p + 8.0
+        )
+    # Dense table rebuild: the measured ladder's overflow leaf AND the
+    # predictor's measurement-free branch each all-gather every chip's
+    # [v_loc, lanes] owned-row slab — two instances, same shape; only the
+    # measured one pays the s32[2] pmax.
+    dense_b = p * n * 4 * lanes
+    for flat in (8.0, 0.0):
+        got = _take(lambda a: a.result_bytes == dense_b and a.pieces == 1)
+        found.append(got)
+        derived.append(dense_b * (p - 1) / p + flat if got else None)
+    # The pmax pair (changed-row count + max id gap) rides ONE s32[2]
+    # all-reduce; the per-round light-sweep convergence psum is the 4-byte
+    # scalar, outside the exchange model by the dense_or convention.
+    pairs = [c for c in colls if c.op == "all-reduce" and c.result_bytes == 8]
+
+    modeled = minplus_rows_wire_bytes_per_level(
+        p, n, lanes, caps, delta_bits, predict=True
+    )
+
+    # Monoid-generalization certificate: same ladder, same encodings, same
+    # lane count -> the min exchange compiles to exactly as many
+    # all-gathers as the OR row gather (predict off), and arming the
+    # predictor adds exactly the one dense rebuild.
+    _, colls_meas = sssp_ags(predict=False)
+    n_ags_measured = len([c for c in colls_meas if c.op == "all-gather"])
+    eng_or = DistWideMsBfsEngine(
+        graph, mesh, lanes=lanes, exchange="sparse", delta_bits=delta_bits,
+        sparse_caps=caps,
+    )
+    fw0 = eng_or._seed_dev(np.asarray([0]))
+    hlo_or = (
+        eng_or._dist_core.lower(eng_or.arrs, fw0, jnp.int32(32))
+        .compile().as_text()
+    )
+    n_ags_or = len([c for c in hlo_collectives(hlo_or) if c.op == "all-gather"])
+
+    return {
+        "config": (
+            f"min-plus rows exchange, P={p}, v_loc={n}, lanes={lanes}, "
+            f"caps={caps}, delta_bits={delta_bits}, predict=True"
+        ),
+        "modeled_per_level": modeled,
+        "hlo_per_level": derived,
+        "all_gathers": {
+            "minplus_planner": n_ags_planner,
+            "minplus_measured": n_ags_measured,
+            "or_rows": n_ags_or,
+        },
+        "pair_pmaxes": len(pairs),
+        "agree": (
+            all(found)
+            and not [c for c in pool if c.op == "all-gather"]
+            and len(pairs) == 1
+            and n_ags_measured == n_ags_or
+            and n_ags_planner == n_ags_measured + 1
+            and [float(x) for x in modeled] == [float(x) for x in derived]
+        ),
+    }
+
+
 def check_packed_exchange(graph, p: int = 8) -> dict:
     """ISSUE 5 tentpole proof, from the compiled HLO: the bit-packed wire
     format moves exactly 1/8 the collective bytes of the pred ring and

@@ -48,18 +48,19 @@ import numpy as np
 #: pre-ISSUE-14 protocol knew; requests without a "kind" field mean it.
 KINDS = ("bfs", "sssp", "cc", "khop", "p2p")
 
-#: Engines each non-bfs kind can ride. The wide engine is the common
+#: Engine FAMILIES each non-bfs kind can ride; the ``devices`` axis then
+#: selects the single-chip or the mesh form within a family (ISSUE 20:
+#: every kind runs on the full mesh). The wide family is the common
 #: substrate (full-coverage ELL: the CC label fold and the p2p path
-#: reconstruction read its row space directly; the SSSP tiles reuse its
-#: bucket layout); khop is pure dispatch/fetch protocol and also runs on
-#: the hybrid/packed engines. All non-bfs kinds are single-chip in this
-#: PR (devices == 1) — the mesh generalization rides ROADMAP item 1's
-#: partitioned substrate.
+#: reconstruction read its row space directly — single-chip AND the
+#: sharded dist-wide form; sssp's min-plus tiles reuse its bucket layout
+#: on both); khop is pure dispatch/fetch protocol and also runs on the
+#: hybrid/packed engines and the 2D partition.
 KIND_ENGINES = {
     "bfs": ("wide", "hybrid", "packed", "dist2d"),
     "sssp": ("wide",),
     "cc": ("wide",),
-    "khop": ("wide", "hybrid", "packed"),
+    "khop": ("wide", "hybrid", "packed", "dist2d"),
     "p2p": ("wide",),
 }
 
@@ -69,25 +70,69 @@ KIND_ENGINES = {
 METADATA_ONLY_KINDS = ("cc", "khop", "p2p")
 
 
+def kind_unsupported_reason(kind: str, engine: str, devices: int,
+                            graph) -> str | None:
+    """WHY this (kind, engine, mesh, graph) combination cannot serve, or
+    None when it can — the reason-carrying form of the old silent
+    ``continue`` (ISSUE 20 satellite): the serve frontend's unserved-kind
+    error names the blocking axis instead of a bare refusal."""
+    if kind not in KINDS:
+        return f"unknown kind {kind!r} (one of {KINDS})"
+    if engine not in KIND_ENGINES[kind]:
+        return (
+            f"kind {kind!r} rides engine families {KIND_ENGINES[kind]}; "
+            f"this service runs engine {engine!r}"
+        )
+    if engine == "packed" and devices > 1:
+        return "the packed engine is single-device (no exchange to shard)"
+    if kind == "sssp" and getattr(graph, "weights", None) is None:
+        return (
+            "sssp relaxes weighted edges and this graph has no weights "
+            "plane (generate with weights=W or attach one)"
+        )
+    if kind == "p2p" and not getattr(graph, "undirected", True):
+        # The bidirectional meet is exact on undirected graphs only
+        # (the target-side flood must equal the reverse search);
+        # P2pServeEngine enforces the same at construction.
+        return (
+            "p2p's bidirectional meet is exact on undirected graphs "
+            "only, and this graph is directed"
+        )
+    return None
+
+
 def supported_kinds(engine: str, devices: int, graph) -> tuple:
-    """The kinds a service with this engine/mesh/graph can serve: every
-    kind whose engine family matches, minus sssp when the graph has no
-    weights plane."""
-    out = []
-    for kind in KINDS:
-        if engine not in KIND_ENGINES[kind]:
-            continue
-        if kind != "bfs" and devices > 1:
-            continue
-        if kind == "sssp" and getattr(graph, "weights", None) is None:
-            continue
-        if kind == "p2p" and not getattr(graph, "undirected", True):
-            # The bidirectional meet is exact on undirected graphs only
-            # (the target-side flood must equal the reverse search);
-            # P2pServeEngine enforces the same at construction.
-            continue
-        out.append(kind)
-    return tuple(out)
+    """The kinds a service with this engine/mesh/graph can serve — every
+    kind :func:`kind_unsupported_reason` has no objection to. Since
+    ISSUE 20 the mesh serves every kind (devices > 1 selects the
+    distributed form within the same engine family), so the axis that
+    used to drop all non-bfs kinds is gone."""
+    return tuple(
+        kind for kind in KINDS
+        if kind_unsupported_reason(kind, engine, devices, graph) is None
+    )
+
+
+def id_of_row_map(engine) -> np.ndarray:
+    """[table rows] device-table row -> real vertex id (-1 on pad rows,
+    which are never visited), for any full-coverage wide base: the
+    single-chip engines expose the ELL's ``old_of_new`` directly; the
+    distributed engines' result tables are CHIP-MAJOR over the sharded
+    round-robin rank order (chip-major row m = shard ``m // v_loc``'s
+    local row ``m % v_loc``, holding global rank
+    ``(m % v_loc) * P + m // v_loc``), so the map composes the rank
+    inverse with that layout. The CC label fold and the p2p meet-vertex
+    lookup both read this one map."""
+    ell = getattr(engine, "ell", None)
+    if ell is not None:
+        return np.asarray(ell.old_of_new[: engine._act], dtype=np.int64)
+    sell = engine.sell
+    inv = np.full(sell.v_pad, -1, np.int64)
+    inv[np.asarray(sell.rank, np.int64)] = np.arange(
+        engine.num_vertices, dtype=np.int64
+    )
+    m = np.arange(sell.v_pad, dtype=np.int64)
+    return inv[(m % sell.v_loc) * sell.num_shards + m // sell.v_loc]
 
 
 def batch_params(queries) -> dict:
@@ -102,6 +147,29 @@ def batch_params(queries) -> dict:
         return {"targets": np.asarray([int(q.target) for q in queries],
                                       dtype=np.int64)}
     return {}
+
+
+class ExchangeRecordDelegate:
+    """Mixin for adapters over a ``base`` substrate engine: the serve
+    executor's wire-telemetry reader and the bench's per-kind wire
+    table ride through to the base's exchange record, so a cc/khop/p2p
+    query on the mesh prices its batch's exchange bytes exactly like a
+    bfs one (single-chip bases record nothing; every reader answers
+    None)."""
+
+    def completed_exchange_record(self):
+        taker = getattr(self.base, "completed_exchange_record", None)
+        if taker is not None:
+            return taker()
+        return None, getattr(self.base, "last_exchange_bytes", None)
+
+    def wire_bytes_per_level(self):
+        fn = getattr(self.base, "wire_bytes_per_level", None)
+        return fn() if fn is not None else None
+
+    def exchange_branch_labels(self):
+        fn = getattr(self.base, "exchange_branch_labels", None)
+        return fn() if fn is not None else None
 
 
 class ExtrasResult:
@@ -151,6 +219,31 @@ def build_workload_engine(kind: str, base, graph, spec):
     (``base`` is None for sssp, which builds its own weighted substrate).
     Called by the registry's ``_build_inner`` after spec validation."""
     if kind == "sssp":
+        devices = int(getattr(spec, "devices", 1))
+        if devices > 1:
+            # The mesh form (ISSUE 20): sharded min-plus tiles over the
+            # 1D ring (or an explicit 2D mesh_shape) with the (min, +)
+            # exchange family at bucket close.
+            from tpu_bfs.parallel.dist_sssp import DistSsspEngine
+
+            mesh_shape = tuple(getattr(spec, "mesh_shape", ()) or ())
+            if mesh_shape:
+                from tpu_bfs.parallel.dist_bfs2d import make_mesh_2d
+
+                mesh = make_mesh_2d(*mesh_shape)
+            else:
+                from tpu_bfs.parallel.dist_bfs import make_mesh
+
+                mesh = make_mesh(devices)
+            return DistSsspEngine(
+                graph, mesh, lanes=spec.lanes,
+                exchange=getattr(spec, "exchange", "") or (
+                    "allreduce" if mesh_shape else "ring"
+                ),
+                delta_bits=tuple(getattr(spec, "delta_bits", ())),
+                predict=bool(getattr(spec, "predict", False)),
+                expand_impl=getattr(spec, "expand_impl", "xla"),
+            )
         from tpu_bfs.workloads.sssp import SsspEngine
 
         return SsspEngine(

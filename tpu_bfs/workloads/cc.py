@@ -29,7 +29,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpu_bfs.workloads import WorkloadResult
+from tpu_bfs.workloads import (
+    ExchangeRecordDelegate,
+    WorkloadResult,
+    id_of_row_map,
+)
 
 _NO_LANE = np.int32(1 << 30)
 
@@ -68,8 +72,14 @@ def connected_components(engine, *, max_sweeps: int | None = None):
     order)."""
     V = engine.num_vertices
     act = engine._act
-    min_lane = _make_min_lane(act + 1, act, engine.w)
-    id_of_row = np.asarray(engine.ell.old_of_new[:act], dtype=np.int64)
+    # Table geometry is engine-shaped: single-chip result tables carry
+    # the ELL sentinel row (act + 1 rows, id map = old_of_new); the
+    # distributed wide engine's are sentinel-free chip-major v_pad rows
+    # (ISSUE 20 — the same sweep labels across the mesh). Pad rows map
+    # to -1 and are never visited, but guard anyway.
+    rows = int(getattr(engine, "_table_rows", act + 1))
+    min_lane = _make_min_lane(rows, act, engine.w)
+    id_of_row = id_of_row_map(engine)
     labels = np.full(V, -1, np.int64)
     unseen = np.ones(V, dtype=bool)
     sweeps = 0
@@ -81,7 +91,7 @@ def connected_components(engine, *, max_sweeps: int | None = None):
         seeds = pending[: engine.lanes]
         res = engine.run(seeds, time_it=False)
         ml = np.asarray(min_lane(res._vis))
-        hit = ml < _NO_LANE
+        hit = (ml < _NO_LANE) & (id_of_row >= 0)
         vids = id_of_row[hit]
         labels[vids] = seeds[ml[hit]]
         unseen[vids] = False
@@ -114,7 +124,7 @@ class CcIndex:
         self.size_of = counts[inv]  # [V] component size per vertex
 
 
-class CcServeEngine:
+class CcServeEngine(ExchangeRecordDelegate):
     """Serve adapter: kind="cc" queries answer component label / size /
     total count from the cached index (built on first use — the
     registry's warm-up run, so serving queries never pay the sweeps)."""
@@ -175,6 +185,10 @@ class CcServeEngine:
         import numpy as np
 
         base = self.base
-        ml = _make_min_lane(base._act + 1, base._act, base.w)
-        vis0 = base._seed_dev(np.asarray([0]))
+        rows = int(getattr(base, "_table_rows", base._act + 1))
+        ml = _make_min_lane(rows, base._act, base.w)
+        # Trim the seed table to the RESULT-table row count the sweeps
+        # feed (the dist-wide seed carries a sentinel row its chip-major
+        # result tables do not), so the analyzed shape is the served one.
+        vis0 = base._seed_dev(np.asarray([0]))[:rows]
         return [("cc_min_lane", ml, (vis0,))]

@@ -15,10 +15,10 @@ k), so one dispatch's level bound answers every lane.
 
 from __future__ import annotations
 
-from tpu_bfs.workloads import ExtrasResult
+from tpu_bfs.workloads import ExchangeRecordDelegate, ExtrasResult
 
 
-class KhopServeEngine:
+class KhopServeEngine(ExchangeRecordDelegate):
     """Serve adapter: kind="khop" over a base packed MS engine."""
 
     kind = "khop"
@@ -72,5 +72,16 @@ class KhopServeEngine:
         base = self.base
         if getattr(base, "pull_gate", False):
             return []
+        if hasattr(base, "_dist_core") or not hasattr(base, "_core"):
+            # Distributed bases (ISSUE 20): their ``_core`` is a host
+            # wrapper (or absent on the dist2d serve adapter), and the
+            # hop bound is the same traced max_levels scalar of the
+            # sharded loop — delegate to the base's own analyzed
+            # programs, relabeled so the khop config's entries stay
+            # distinct in the sweep.
+            return [
+                (f"khop_{name}", fn, args)
+                for name, fn, args in base.analysis_programs()
+            ]
         fw0 = base._seed_dev(np.asarray([0]))
         return [("khop_core", base._core, (base.arrs, fw0, jnp.int32(2)))]

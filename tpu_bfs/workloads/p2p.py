@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 
 from tpu_bfs.algorithms._packed_common import _assemble_packed_result
+from tpu_bfs.workloads import ExchangeRecordDelegate, id_of_row_map
 
 #: "No meet" distance sentinel: far above any labelable distance (the
 #: plane cap is 254) and safe to double without overflow.
@@ -138,7 +139,7 @@ class P2pResult:
         raise ValueError("p2p answers carry the path, not a distance table")
 
 
-class P2pServeEngine:
+class P2pServeEngine(ExchangeRecordDelegate):
     """Serve adapter: kind="p2p" over a base WIDE packed MS engine.
 
     ``lanes`` here counts PAIRS — half the base engine's lane budget —
@@ -174,11 +175,14 @@ class P2pServeEngine:
         # feed the wrong rung's breaker and over-degrade the service.
         self.ladder_lanes = base.lanes
         self.num_vertices = base.num_vertices
-        self._id_of_row = np.asarray(
-            base.ell.old_of_new[: base._act], dtype=np.int64
-        )
+        # Engine-shaped table geometry (ISSUE 20): single-chip tables
+        # carry the sentinel row and map rows through the ELL; the
+        # distributed wide base's are sentinel-free chip-major — one
+        # shared id map covers both (workloads.id_of_row_map).
+        self._id_of_row = id_of_row_map(base)
+        self._table_rows = int(getattr(base, "_table_rows", base._act + 1))
         self._pair_met, self._pair_dist = _make_pair_kernels(
-            base._act + 1, base._act, base.w, base.num_planes
+            self._table_rows, base._act, base.w, base.num_planes
         )
 
     def warm_residency(self) -> None:
@@ -219,8 +223,15 @@ class P2pServeEngine:
     def fetch(self, pend: P2pPending, **_ignored) -> P2pResult:
         base = self.base
         n = pend.n
-        fw = vis = pend.fw0
-        planes = tuple(jnp.zeros_like(pend.fw0)
+        fw = pend.fw0
+        # The resumable core's visited/planes carry rides the RESULT
+        # table layout; the dist-wide base's differs from its rank-order
+        # seed table (chip-major, no sentinel row) and provides the
+        # converting view — the same view _assemble_packed_result takes
+        # for the src-bits plane.
+        view = getattr(base, "_src_bits_view", None)
+        vis = src_view = view(pend.fw0) if view is not None else pend.fw0
+        planes = tuple(jnp.zeros_like(vis)
                        for _ in range(base.num_planes))
         level = 0
         alive = True
@@ -235,7 +246,7 @@ class P2pServeEngine:
             level = int(lv)
             alive = bool(alv)
             met = np.asarray(self._pair_met(vis))[: n]
-        dist, row = self._pair_dist(planes, vis, pend.fw0)
+        dist, row = self._pair_dist(planes, vis, src_view)
         dist = np.asarray(dist)[: n]
         row = np.asarray(row)[: n]
         iso = base._iso_of(pend.inter)
@@ -297,12 +308,16 @@ class P2pServeEngine:
         per-pair distance/meet-vertex reduction."""
         base = self.base
         fw0 = base._seed_dev(np.asarray([0, 1]))
+        # Same layout conversion as fetch: analyze the RESULT-table shape
+        # the serving loop actually feeds the kernels.
+        view = getattr(base, "_src_bits_view", None)
+        vis0 = view(fw0) if view is not None else fw0
         planes0 = tuple(
-            jnp.zeros_like(fw0) for _ in range(base.num_planes)
+            jnp.zeros_like(vis0) for _ in range(base.num_planes)
         )
         return [
-            ("p2p_pair_met", self._pair_met, (fw0,)),
-            ("p2p_pair_dist", self._pair_dist, (planes0, fw0, fw0)),
+            ("p2p_pair_met", self._pair_met, (vis0,)),
+            ("p2p_pair_dist", self._pair_dist, (planes0, vis0, vis0)),
         ]
 
 
